@@ -1,0 +1,28 @@
+"""TPU-native Kubernetes device-plugin framework.
+
+A from-scratch rebuild of the capability set of
+``uppercaveman/k8s-gpu-device-plugin`` (a Go NVIDIA/MIG device-plugin daemon,
+surveyed in SURVEY.md) for TPU hosts:
+
+- ``device/``    chip model, ICI topology, sub-slice partitioning
+                 (reference: device/devices.go, device/device_map.go, device/mig.go)
+- ``resource/``  resource naming + slice strategies (reference: resource/)
+- ``plugin/``    kubelet device-plugin v1beta1 gRPC servers + manager
+                 (reference: plugin/plugin.go, plugin/manager.go)
+- ``server/``    HTTP control plane (reference: server/, router/, middleware/)
+- ``metrics/``   per-chip device metrics — the package the reference left empty
+                 (reference: metrics/metrics.go is a one-line placeholder)
+- ``config/``    layered config (reference: config/config.go)
+- ``utils/``     logging / latch / watch / version (reference: modules/)
+- ``native/``    C++ enumeration & ICI-topology core (replaces the reference's
+                 cgo go-nvml / go-nvlib / go-gpuallocator surface)
+- ``models/``, ``ops/``, ``parallel/``, ``benchmark/``
+                 JAX/XLA/Pallas workload stack: the rewritten benchmark launches
+                 real TPU workloads (matmul MFU, ICI all-reduce, Llama training)
+                 on plugin-allocated chips (reference benchmark/benchmark.go only
+                 wrote Go pprof profiles).
+"""
+
+from k8s_gpu_device_plugin_tpu.utils.version import VERSION
+
+__version__ = VERSION

@@ -1,0 +1,5 @@
+"""Layered configuration (reference: config/config.go + config.yml)."""
+
+from k8s_gpu_device_plugin_tpu.config.config import Config, LogSettings, load_config
+
+__all__ = ["Config", "LogSettings", "load_config"]

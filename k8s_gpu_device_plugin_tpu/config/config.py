@@ -1,0 +1,162 @@
+"""Layered configuration: defaults <- YAML file <- CLI flags <- env.
+
+Reference: config/config.go (typed ``Config`` with ``SetDefaultConfig``,
+config.go:9-22) loaded by viper in three tiers — defaults, then
+``./<configFile>.yml``, then pflag overrides (main.go:31-52).
+
+The reference exposed four knobs: ``webListenAddress``, ``migStrategy``,
+``benchmark``, ``log{level, fileDir}``. The TPU build keeps the same tiering
+and renames the partitioning knob to ``sliceStrategy`` (the MIG analogue is
+ICI sub-slice partitioning), adding a topology override and kubelet paths so
+tests can point the daemon at a fake kubelet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import yaml
+
+from k8s_gpu_device_plugin_tpu.resource.naming import (
+    SLICE_STRATEGY_MIXED,
+    SLICE_STRATEGY_NONE,
+    SLICE_STRATEGY_SINGLE,
+)
+
+_VALID_STRATEGIES = (SLICE_STRATEGY_NONE, SLICE_STRATEGY_SINGLE, SLICE_STRATEGY_MIXED)
+
+
+@dataclass
+class LogSettings:
+    """Reference config.go:13 ``Log{Level, FileDir}``."""
+
+    level: str = "debug"
+    file_dir: str = "./logs"
+
+
+@dataclass
+class Config:
+    """Daemon configuration (reference config/config.go:9-14 + TPU additions)."""
+
+    web_listen_address: str = "9002"           # reference default (config.go:18)
+    slice_strategy: str = SLICE_STRATEGY_NONE  # ≙ migStrategy (config.go:19)
+    benchmark: bool = False                    # reference config.go:20
+    log: LogSettings = field(default_factory=LogSettings)
+
+    # TPU-specific additions (no reference equivalent):
+    topology: str = "auto"                     # e.g. "v5p-8" to override discovery
+    kubelet_socket_dir: str = "/var/lib/kubelet/device-plugins"
+    libtpu_path: str = "/lib/libtpu.so"
+    backend: str = "auto"                      # auto | native | fake
+    slice_shape: str = ""                      # for strategy "single", e.g. "2x2"
+    slice_plan: str = ""                       # for strategy "mixed", e.g. "2x2,2x2"
+    shared_replicas: int = 0                   # >0 => time-sliced sharing
+
+    def validate(self) -> None:
+        if self.slice_strategy not in _VALID_STRATEGIES:
+            raise ValueError(
+                f"sliceStrategy must be one of {_VALID_STRATEGIES}, "
+                f"got {self.slice_strategy!r}"
+            )
+
+    @property
+    def listen_addr(self) -> tuple[str, int]:
+        """Split ``webListenAddress`` into (host, port); bare port binds all."""
+        addr = self.web_listen_address
+        if ":" in addr:
+            host, _, port = addr.rpartition(":")
+            return host or "0.0.0.0", int(port)
+        return "0.0.0.0", int(addr)
+
+
+# YAML key -> attribute path, mirroring the reference's config.yml keys.
+_KEY_MAP = {
+    "webListenAddress": "web_listen_address",
+    "sliceStrategy": "slice_strategy",
+    "migStrategy": "slice_strategy",  # accepted alias for drop-in migration
+    "benchmark": "benchmark",
+    "topology": "topology",
+    "kubeletSocketDir": "kubelet_socket_dir",
+    "libtpuPath": "libtpu_path",
+    "backend": "backend",
+    "sliceShape": "slice_shape",
+    "slicePlan": "slice_plan",
+    "sharedReplicas": "shared_replicas",
+}
+
+
+def _apply_mapping(cfg: Config, data: dict[str, Any]) -> None:
+    for key, value in data.items():
+        if key == "log" and isinstance(value, dict):
+            if "level" in value:
+                cfg.log.level = str(value["level"])
+            if "fileDir" in value:
+                cfg.log.file_dir = str(value["fileDir"])
+            continue
+        attr = _KEY_MAP.get(key)
+        if attr is None:
+            continue  # unknown keys are ignored, like viper
+        current = getattr(cfg, attr)
+        setattr(cfg, attr, type(current)(value) if current is not None else value)
+
+
+def load_config(
+    argv: Sequence[str] | None = None,
+    config_file: str | None = None,
+) -> Config:
+    """Three-tier load: defaults <- yaml <- flags (reference main.go:37-52)."""
+    parser = argparse.ArgumentParser(prog="tpu-device-plugin")
+    parser.add_argument("--configFile", default=config_file or "config",
+                        help="config file name, resolved as ./<name>.yml (main.go:31)")
+    parser.add_argument("--webListenAddress", default=None)
+    parser.add_argument("--sliceStrategy", default=None,
+                        choices=list(_VALID_STRATEGIES))
+    parser.add_argument("--benchmark", default=None, action="store_const", const=True)
+    parser.add_argument("--topology", default=None)
+    parser.add_argument("--kubeletSocketDir", default=None)
+    parser.add_argument("--libtpuPath", default=None)
+    parser.add_argument("--backend", default=None, choices=["auto", "native", "fake"])
+    parser.add_argument("--sliceShape", default=None)
+    parser.add_argument("--slicePlan", default=None)
+    parser.add_argument("--sharedReplicas", default=None, type=int)
+    parser.add_argument("--logLevel", default=None)
+    parser.add_argument("--logFileDir", default=None)
+    args = parser.parse_args(argv)
+
+    cfg = Config()
+
+    # Tier 2: YAML file (missing file is not an error, like viper's soft read).
+    path = args.configFile
+    if not path.endswith((".yml", ".yaml")):
+        path = f"./{path}.yml"
+    if os.path.exists(path):
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        if not isinstance(data, dict):
+            raise ValueError(f"config file {path} must contain a mapping")
+        _apply_mapping(cfg, data)
+
+    # Tier 3: explicit flags override the file.
+    flag_overrides = {
+        "webListenAddress": args.webListenAddress,
+        "sliceStrategy": args.sliceStrategy,
+        "benchmark": args.benchmark,
+        "topology": args.topology,
+        "kubeletSocketDir": args.kubeletSocketDir,
+        "libtpuPath": args.libtpuPath,
+        "backend": args.backend,
+        "sliceShape": args.sliceShape,
+        "slicePlan": args.slicePlan,
+        "sharedReplicas": args.sharedReplicas,
+    }
+    _apply_mapping(cfg, {k: v for k, v in flag_overrides.items() if v is not None})
+    if args.logLevel is not None:
+        cfg.log.level = args.logLevel
+    if args.logFileDir is not None:
+        cfg.log.file_dir = args.logFileDir
+
+    cfg.validate()
+    return cfg

@@ -1,0 +1,44 @@
+"""Device model & enumeration (reference: device/).
+
+The reference wrapped NVML handles (device/device.go) into a ``deviceInfo``
+seam and built a ``DeviceMap`` keyed by resource name (device/device_map.go).
+Here the hardware is a TPU host: chips on an ICI mesh, enumerated by a backend
+(fake for tests, C++ native core for hosts), with MIG partitioning replaced by
+ICI sub-slice partitioning (device/slices.py ≙ device/mig.go).
+"""
+
+from k8s_gpu_device_plugin_tpu.device.chip import AnnotatedID, Chip, Chips
+from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap, new_chip_map
+from k8s_gpu_device_plugin_tpu.device.topology import (
+    GENERATIONS,
+    HostTopology,
+    TpuGeneration,
+    parse_topology,
+)
+from k8s_gpu_device_plugin_tpu.device.slices import (
+    SlicePlacement,
+    SliceProfile,
+    partition_host,
+    supported_profiles,
+)
+from k8s_gpu_device_plugin_tpu.device.backend import ChipBackend, ChipSpec
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+
+__all__ = [
+    "AnnotatedID",
+    "Chip",
+    "Chips",
+    "ChipMap",
+    "new_chip_map",
+    "ChipBackend",
+    "ChipSpec",
+    "FakeBackend",
+    "GENERATIONS",
+    "HostTopology",
+    "TpuGeneration",
+    "parse_topology",
+    "SliceProfile",
+    "SlicePlacement",
+    "partition_host",
+    "supported_profiles",
+]

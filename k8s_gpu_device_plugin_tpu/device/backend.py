@@ -1,0 +1,58 @@
+"""Chip enumeration backend seam.
+
+Reference: the ``deviceInfo`` interface (device/devices.go:12-18) was the seam
+between the device model and NVML; go-nvlib's ``VisitDevices`` /
+``VisitMigDevices`` (device/device_map.go:50,80) was the traversal layer. The
+TPU build keeps one seam — ``ChipBackend`` — with two implementations:
+
+- ``FakeBackend`` (device/fake.py): topologies as data, for tests and the
+  zero-hardware control-plane path (BASELINE config #1);
+- ``NativeBackend`` (device/native.py): ctypes binding over the C++
+  enumeration core (native/), which reads ``/dev/accel*`` and sysfs without
+  taking the libtpu runtime lock (SURVEY §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Raw facts about one physical chip, as reported by a backend.
+
+    ≙ the queries the reference's deviceInfo contract exposed: GetUUID
+    (device.go:37-43), GetPaths (46-57), GetComputeCapability (60-66),
+    GetNumaNode (69-93), GetTotalMemory (96-102).
+    """
+
+    index: int
+    uuid: str
+    paths: tuple[str, ...]
+    coord: tuple[int, ...]
+    numa_node: int
+    hbm_bytes: int
+    generation: str
+
+
+@runtime_checkable
+class ChipBackend(Protocol):
+    """Enumeration + health backend for one host's chips."""
+
+    name: str
+
+    def host_topology(self) -> HostTopology: ...
+
+    def enumerate_chips(self) -> list[ChipSpec]: ...
+
+    def check_health(self) -> dict[int, bool]:
+        """Current health per chip index (True = healthy).
+
+        This is the producer the reference never implemented: its ``health``
+        channel (plugin/plugin.go:40) had no writer anywhere in the repo. The
+        manager polls this and pushes unhealthy transitions to ListAndWatch.
+        """
+        ...

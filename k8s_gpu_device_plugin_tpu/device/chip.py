@@ -1,0 +1,152 @@
+"""Chip (device) model and set operations.
+
+Reference: device/devices.go — ``Device`` wraps a kubelet ``pluginapi.Device``
+with ``Paths``, ``Index``, ``TotalMemory``, ``ComputeCapability``, ``Replicas``
+(devices.go:21-29); ``Devices`` is a map with set operations
+(devices.go:88-184); ``AnnotatedID`` is the ``uuid::replica`` scheme for
+time-sliced sharing (devices.go:222-265).
+
+Here the schedulable unit is a ``Chip`` — either one physical TPU chip
+(strategy ``none``) or an ICI sub-slice of chips advertised as one device
+(strategies ``single``/``mixed``, see device/slices.py). ComputeCapability
+becomes the TPU generation; ``coords`` carries ICI mesh position for the
+topology-aware allocator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Iterable, Iterator
+
+HEALTHY = "Healthy"      # pluginapi.Healthy
+UNHEALTHY = "Unhealthy"  # pluginapi.Unhealthy
+
+ANNOTATION_SEP = "::"
+
+
+@dataclass(frozen=True)
+class AnnotatedID:
+    """``<id>::<replica>`` device-ID scheme for shared chips (devices.go:222-265)."""
+
+    device_id: str
+    replica: int
+
+    def __str__(self) -> str:
+        return f"{self.device_id}{ANNOTATION_SEP}{self.replica}"
+
+    @staticmethod
+    def parse(s: str) -> "AnnotatedID":
+        if not AnnotatedID.is_annotated(s):
+            raise ValueError(f"{s!r} is not an annotated ID")
+        device_id, _, replica = s.rpartition(ANNOTATION_SEP)
+        return AnnotatedID(device_id, int(replica))
+
+    @staticmethod
+    def is_annotated(s: str) -> bool:
+        head, sep, tail = s.rpartition(ANNOTATION_SEP)
+        return bool(sep) and bool(head) and tail.isdigit()
+
+    @staticmethod
+    def any_annotated(ids: Iterable[str]) -> bool:
+        return any(AnnotatedID.is_annotated(i) for i in ids)
+
+
+@dataclass(frozen=True)
+class Chip:
+    """One schedulable TPU device (≙ reference ``Device``, devices.go:21-29)."""
+
+    id: str                                  # stable unique ID (≙ UUID)
+    index: int                               # enumeration index on the host
+    paths: tuple[str, ...]                   # /dev/accel* (+ /dev/vfio/*) nodes
+    coords: tuple[tuple[int, ...], ...]      # ICI coords of member chips
+    generation: str                          # ≙ ComputeCapability
+    total_memory: int                        # HBM bytes across member chips
+    numa_node: int = -1                      # host NUMA node, -1 = unknown
+    health: str = HEALTHY
+    replicas: int = 0                        # >0 => time-sliced shared device
+    slice_profile: str = ""                  # "" for whole chips; "2x2" for slices
+    chip_indices: tuple[int, ...] = ()       # physical chip indices of members
+
+    @property
+    def is_slice(self) -> bool:
+        return bool(self.slice_profile)
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.coords) or 1
+
+    def with_health(self, health: str) -> "Chip":
+        return replace(self, health=health)
+
+
+class Chips(dict[str, Chip]):
+    """Set of chips keyed by device ID (≙ ``Devices``, devices.go:31-38)."""
+
+    @staticmethod
+    def of(chips: Iterable[Chip]) -> "Chips":
+        out = Chips()
+        for chip in chips:
+            out[chip.id] = chip
+        return out
+
+    # --- set operations (devices.go:88-184) ---
+
+    def contains(self, *ids: str) -> bool:
+        return all(i in self for i in ids)
+
+    def get_by_id(self, chip_id: str) -> Chip | None:
+        return self.get(chip_id)
+
+    def get_by_index(self, index: int) -> Chip | None:
+        for chip in self.values():
+            if chip.index == index:
+                return chip
+        return None
+
+    def subset(self, ids: Iterable[str]) -> "Chips":
+        return Chips({i: self[i] for i in ids if i in self})
+
+    def difference(self, other: "Chips") -> "Chips":
+        return Chips({i: c for i, c in self.items() if i not in other})
+
+    def ids(self) -> list[str]:
+        return sorted(self.keys())
+
+    def indices(self) -> list[int]:
+        return sorted(c.index for c in self.values())
+
+    def all_paths(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for chip in sorted(self.values(), key=lambda c: c.index):
+            for p in chip.paths:
+                seen.setdefault(p, None)
+        return list(seen)
+
+    def healthy(self) -> "Chips":
+        return Chips({i: c for i, c in self.items() if c.health == HEALTHY})
+
+    def iter_sorted(self) -> Iterator[Chip]:
+        return iter(sorted(self.values(), key=lambda c: c.index))
+
+    # --- shared/replicated devices ---
+
+    def physical_ids(self) -> list[str]:
+        """Collapse annotated replicas to their physical device IDs."""
+        out: dict[str, None] = {}
+        for i in self.keys():
+            if AnnotatedID.is_annotated(i):
+                out.setdefault(AnnotatedID.parse(i).device_id, None)
+            else:
+                out.setdefault(i, None)
+        return list(out)
+
+    # --- allocation support (devices.go:186-214) ---
+
+    def aligned_allocation_supported(self) -> bool:
+        """Topology-aligned allocation needs whole chips with known coords.
+
+        ≙ AlignedAllocationSupported, false for MIG devices or /dev/dxg
+        (devices.go:186-209): sub-slice devices are pre-partitioned, so mesh
+        alignment was already decided at partition time.
+        """
+        return all(not c.is_slice and c.coords for c in self.values())

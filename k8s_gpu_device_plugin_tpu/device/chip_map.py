@@ -1,0 +1,154 @@
+"""ChipMap builder: resource name -> set of schedulable devices.
+
+Reference: device/device_map.go — strategy dispatch (``none``/``single`` walk
+physical GPUs, ``mixed`` walks MIG instances; device_map.go:34-45), wildcard
+pattern matching against device/profile names with unmatched names a hard
+error (device_map.go:62-71,95), and ``setEntry`` assembling stored devices
+(device_map.go:101-111).
+
+TPU mapping of the strategies (see device/slices.py for the MIG analogue):
+
+- ``none``   — every physical chip is one ``google.com/tpu`` device.
+- ``single`` — the host mesh is carved into equal sub-slices of the configured
+               ``sliceShape``; each sub-slice is one ``google.com/tpu`` device
+               (like MIG single: partitioned hardware under the plain name).
+- ``mixed``  — the host is carved per ``slicePlan``; each profile gets its own
+               resource ``google.com/tpu-slice-<shape>`` (≙ nvidia.com/mig-*).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import replace
+
+from k8s_gpu_device_plugin_tpu.device.backend import ChipBackend, ChipSpec
+from k8s_gpu_device_plugin_tpu.device.chip import AnnotatedID, Chip, Chips
+from k8s_gpu_device_plugin_tpu.device.slices import (
+    SlicePlacement,
+    SliceProfile,
+    default_plan,
+    partition_host,
+    uniform_plan,
+)
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+from k8s_gpu_device_plugin_tpu.resource.naming import (
+    SLICE_STRATEGY_MIXED,
+    SLICE_STRATEGY_NONE,
+    SLICE_STRATEGY_SINGLE,
+    Resource,
+)
+
+
+class ChipMap(dict[str, Chips]):
+    """resource name -> Chips (≙ ``DeviceMap``, device_map.go:19-22)."""
+
+    def total_devices(self) -> int:
+        return sum(len(chips) for chips in self.values())
+
+
+def _slice_device_id(specs: list[ChipSpec]) -> str:
+    h = hashlib.sha256("|".join(s.uuid for s in specs).encode()).hexdigest()
+    return f"TPUSLICE-{h[:12]}"
+
+
+def _build_chip(spec: ChipSpec) -> Chip:
+    """≙ BuildDevice (devices.go:41-85) for a whole physical chip."""
+    return Chip(
+        id=spec.uuid,
+        index=spec.index,
+        paths=spec.paths,
+        coords=(spec.coord,),
+        generation=spec.generation,
+        total_memory=spec.hbm_bytes,
+        numa_node=spec.numa_node,
+        chip_indices=(spec.index,),
+    )
+
+
+def _build_slice(
+    placement: SlicePlacement, topo: HostTopology, by_index: dict[int, ChipSpec], index: int
+) -> Chip:
+    """Assemble one sub-slice device from its member chips."""
+    indices = placement.chip_indices(topo)
+    specs = [by_index[i] for i in indices]
+    numa_nodes = {s.numa_node for s in specs}
+    paths: list[str] = []
+    for s in specs:
+        paths.extend(s.paths)
+    return Chip(
+        id=_slice_device_id(specs),
+        index=index,
+        paths=tuple(paths),
+        coords=tuple(s.coord for s in specs),
+        generation=specs[0].generation,
+        total_memory=sum(s.hbm_bytes for s in specs),
+        numa_node=numa_nodes.pop() if len(numa_nodes) == 1 else -1,
+        slice_profile=placement.profile.name,
+        chip_indices=tuple(indices),
+    )
+
+
+def _match_resource(name: str, resources: list[Resource]) -> Resource:
+    """First pattern match wins; no match is a hard error (device_map.go:72,95)."""
+    for resource in resources:
+        if resource.pattern.matches(name):
+            return resource
+    raise ValueError(
+        f"no resource pattern matches device name {name!r} "
+        f"(patterns: {[str(r.pattern) for r in resources]})"
+    )
+
+
+def new_chip_map(
+    backend: ChipBackend,
+    resources: list[Resource],
+    strategy: str,
+    slice_shape: str = "",
+    slice_plan: str = "",
+    shared_replicas: int = 0,
+) -> ChipMap:
+    """Build the ChipMap (≙ NewDeviceMap, device_map.go:24-45).
+
+    ``shared_replicas`` > 0 advertises each device ``n`` times under annotated
+    IDs for time-sliced sharing — the machinery the reference carried
+    (devices.go:222-265) but never wired to a setter.
+    """
+    topo = backend.host_topology()
+    specs = backend.enumerate_chips()
+    by_index = {s.index: s for s in specs}
+    chip_map = ChipMap()
+
+    def add(resource: Resource, chip: Chip) -> None:
+        chips = chip_map.setdefault(str(resource.name), Chips())
+        if shared_replicas > 0:
+            for r in range(shared_replicas):
+                rid = str(AnnotatedID(chip.id, r))
+                chips[rid] = replace(chip, id=rid, replicas=shared_replicas)
+        else:
+            chips[chip.id] = chip
+
+    if strategy == SLICE_STRATEGY_NONE or (
+        strategy == SLICE_STRATEGY_SINGLE and not slice_shape
+    ):
+        for spec in specs:
+            add(_match_resource(spec.generation, resources), _build_chip(spec))
+        return chip_map
+
+    if strategy == SLICE_STRATEGY_SINGLE:
+        plan = uniform_plan(topo, SliceProfile.parse(slice_shape))
+        for i, placement in enumerate(partition_host(topo, plan)):
+            chip = _build_slice(placement, topo, by_index, i)
+            add(_match_resource(chip.generation, resources), chip)
+        return chip_map
+
+    if strategy == SLICE_STRATEGY_MIXED:
+        if slice_plan:
+            plan = [SliceProfile.parse(p) for p in slice_plan.split(",") if p.strip()]
+        else:
+            plan = default_plan(topo)
+        for i, placement in enumerate(partition_host(topo, plan)):
+            chip = _build_slice(placement, topo, by_index, i)
+            add(_match_resource(chip.slice_profile, resources), chip)
+        return chip_map
+
+    raise ValueError(f"unknown slice strategy {strategy!r}")

@@ -1,0 +1,74 @@
+"""Fake chip backend: TPU topologies as data.
+
+≙ SURVEY §4's recommended seam: a fake ``deviceInfo`` implementation so the
+whole control plane runs with zero accelerators (BASELINE config #1). The fake
+models any parseable topology (v5e-1/-4/-8, v5p-8/-16/-32, explicit shapes),
+synthesizes stable UUIDs and device nodes, and lets tests flip per-chip health
+to exercise the ListAndWatch health path the reference left vestigial.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from k8s_gpu_device_plugin_tpu.device.backend import ChipSpec
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology, parse_topology
+
+
+def _stable_uuid(seed: str) -> str:
+    h = hashlib.sha256(seed.encode()).hexdigest()
+    return f"TPU-{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:32]}"
+
+
+class FakeBackend:
+    """In-memory backend over a synthetic topology."""
+
+    name = "fake"
+
+    def __init__(
+        self,
+        topology: str | HostTopology = "v5e-4",
+        host_id: str = "fakehost",
+        numa_nodes: int = 2,
+    ) -> None:
+        self._topo = (
+            topology if isinstance(topology, HostTopology) else parse_topology(topology)
+        )
+        self._host_id = host_id
+        self._numa_nodes = max(1, numa_nodes)
+        self._unhealthy: set[int] = set()
+
+    def host_topology(self) -> HostTopology:
+        return self._topo
+
+    def enumerate_chips(self) -> list[ChipSpec]:
+        gen = self._topo.generation
+        chips = []
+        coords = self._topo.coords()
+        half = (len(coords) + 1) // 2
+        for index, coord in enumerate(coords):
+            chips.append(
+                ChipSpec(
+                    index=index,
+                    uuid=_stable_uuid(f"{self._host_id}/{gen.name}/{index}"),
+                    paths=(f"/dev/accel{index}",),
+                    coord=coord,
+                    numa_node=0 if index < half else self._numa_nodes - 1,
+                    hbm_bytes=gen.hbm_bytes,
+                    generation=gen.name,
+                )
+            )
+        return chips
+
+    def check_health(self) -> dict[int, bool]:
+        return {
+            i: i not in self._unhealthy for i in range(self._topo.num_chips)
+        }
+
+    # --- test hooks ---
+
+    def set_unhealthy(self, *indices: int) -> None:
+        self._unhealthy.update(indices)
+
+    def set_healthy(self, *indices: int) -> None:
+        self._unhealthy.difference_update(indices)

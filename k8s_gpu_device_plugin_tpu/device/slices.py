@@ -1,0 +1,168 @@
+"""ICI sub-slice partitioning — the TPU analogue of MIG.
+
+Reference: device/mig.go parsed ``/proc/driver/nvidia-caps/mig-minors`` into
+capability device paths (mig.go:25-80), and resource/resources.go walked MIG
+profiles to emit one resource per profile (resources.go:43-51). A MIG instance
+is a hardware partition of one GPU; the TPU equivalent of "partition the
+accelerator complex" is carving a host's chip mesh into contiguous ICI
+sub-slices, each advertised as a schedulable device. Contiguity is what makes
+ring collectives possible inside the slice, so placements are restricted to
+axis-aligned sub-meshes.
+
+Profiles are named like MIG profiles are (``1g.5gb`` -> ``2x2``): the shape
+string doubles as the resource-name suffix in mixed strategy
+(``google.com/tpu-slice-2x2`` ≙ ``nvidia.com/mig-1g.5gb``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass
+
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+
+
+@dataclass(frozen=True)
+class SliceProfile:
+    """A sub-slice shape, e.g. (2, 2) on a v5e host (≙ a MIG profile)."""
+
+    shape: tuple[int, ...]
+
+    @property
+    def name(self) -> str:
+        return "x".join(str(d) for d in self.shape)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.shape)
+
+    @staticmethod
+    def parse(name: str) -> "SliceProfile":
+        try:
+            shape = tuple(int(d) for d in name.strip().split("x"))
+        except ValueError:
+            raise ValueError(f"bad slice profile {name!r}; want e.g. '2x2'") from None
+        if not shape or any(d < 1 for d in shape):
+            raise ValueError(f"bad slice profile {name!r}")
+        return SliceProfile(shape)
+
+
+@dataclass(frozen=True)
+class SlicePlacement:
+    """A concrete placement of a profile on the host mesh: anchor + shape."""
+
+    profile: SliceProfile
+    anchor: tuple[int, ...]
+
+    @property
+    def name(self) -> str:
+        return f"{self.profile.name}@{','.join(str(c) for c in self.anchor)}"
+
+    def coords(self) -> list[tuple[int, ...]]:
+        return [
+            tuple(a + d for a, d in zip(self.anchor, delta))
+            for delta in itertools.product(*(range(s) for s in self.profile.shape))
+        ]
+
+    def chip_indices(self, topo: HostTopology) -> list[int]:
+        return [topo.index_of(c) for c in self.coords()]
+
+
+def _fits(shape: tuple[int, ...], bounds: tuple[int, ...]) -> bool:
+    return len(shape) == len(bounds) and all(s <= b for s, b in zip(shape, bounds))
+
+
+def _normalize(shape: tuple[int, ...], dims: int) -> tuple[int, ...]:
+    if len(shape) < dims:
+        return shape + (1,) * (dims - len(shape))
+    return shape
+
+
+def supported_profiles(topo: HostTopology) -> list[SliceProfile]:
+    """All power-of-two sub-mesh shapes that tile the host mesh.
+
+    ≙ VisitMigProfiles filtering to C==G slices (resources.go:43-51): only
+    shapes whose every axis divides the host bound are supported, so any
+    profile can tile the host without leftovers and placements stay
+    ICI-contiguous.
+    """
+    per_axis = [
+        [d for d in range(1, b + 1) if b % d == 0]
+        for b in topo.bounds
+    ]
+    profiles = {
+        SliceProfile(shape)
+        for shape in itertools.product(*per_axis)
+        if math.prod(shape) < topo.num_chips  # strict sub-slices only
+    }
+    return sorted(profiles, key=lambda p: (p.num_chips, p.shape))
+
+
+def enumerate_placements(topo: HostTopology, profile: SliceProfile) -> list[SlicePlacement]:
+    """Every axis-aligned placement of ``profile`` at multiples of its shape.
+
+    Anchors are restricted to multiples of the profile shape so that the set
+    of placements of one profile is a disjoint tiling (like MIG instances,
+    which occupy fixed slots), and placements of *different* profiles nest.
+    """
+    shape = _normalize(profile.shape, len(topo.bounds))
+    if not _fits(shape, topo.bounds):
+        raise ValueError(f"profile {profile.name} does not fit host {topo.bounds}")
+    anchors = itertools.product(
+        *(range(0, b, s) for b, s in zip(topo.bounds, shape))
+    )
+    return [SlicePlacement(SliceProfile(shape), a) for a in anchors]
+
+
+def default_plan(topo: HostTopology) -> list[SliceProfile]:
+    """Tile the host with its largest strict sub-slice profile.
+
+    Used when mixed strategy is selected without an explicit plan: the host
+    splits into two half-host slices (the coarsest partitioning that is still
+    a partitioning), mirroring how MIG 'mixed' with a lone large profile looks.
+    """
+    profiles = supported_profiles(topo)
+    if not profiles:
+        raise ValueError(f"host {topo.bounds} has no strict sub-slice profiles")
+    largest = profiles[-1]
+    count = topo.num_chips // largest.num_chips
+    return [largest] * count
+
+
+def uniform_plan(topo: HostTopology, profile: SliceProfile) -> list[SliceProfile]:
+    """A plan tiling the whole host with one profile (strategy ``single``)."""
+    if topo.num_chips % profile.num_chips != 0:
+        raise ValueError(
+            f"profile {profile.name} does not evenly tile host {topo.bounds}"
+        )
+    return [profile] * (topo.num_chips // profile.num_chips)
+
+
+def partition_host(
+    topo: HostTopology, plan: list[SliceProfile]
+) -> list[SlicePlacement]:
+    """Carve the host mesh into the disjoint sub-slices listed in ``plan``.
+
+    ≙ the admin-created MIG instance set the reference enumerated via
+    VisitMigDevices (device_map.go:78-98). Greedy first-fit over tiling slots,
+    largest profiles first; raises if the plan does not fit disjointly.
+    """
+    used: set[tuple[int, ...]] = set()
+    out: list[SlicePlacement] = []
+    for profile in sorted(plan, key=lambda p: -p.num_chips):
+        placed = False
+        for placement in enumerate_placements(topo, profile):
+            cells = set(placement.coords())
+            if cells & used:
+                continue
+            used |= cells
+            out.append(placement)
+            placed = True
+            break
+        if not placed:
+            raise ValueError(
+                f"slice plan does not fit: no room for {profile.name} on "
+                f"{topo.bounds} (used {len(used)}/{topo.num_chips} chips)"
+            )
+    return sorted(out, key=lambda p: p.anchor)

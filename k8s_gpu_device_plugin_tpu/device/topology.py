@@ -1,0 +1,173 @@
+"""TPU generations and host/slice ICI topology model.
+
+Reference analogue: the compute-capability / memory / board metadata NVML gave
+the reference for free (device/device.go:60-66,96-102) plus the NVLink/PCIe
+topology that go-gpuallocator consumed (plugin/plugin.go:256-282). On TPU the
+interconnect is the ICI mesh/torus, so topology is first-class here: every
+chip has integer mesh coordinates, and allocation quality is measured in
+contiguous sub-meshes rather than NVLink hops.
+
+Peak-FLOPs / HBM figures are public spec-sheet numbers; they feed both the
+device model (``TotalMemory`` analogue, devices.go:96-102) and the benchmark
+MFU math (benchmark/ — rewritten per BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    """Static per-generation hardware description (≙ compute capability)."""
+
+    name: str
+    hbm_bytes: int
+    peak_bf16_tflops: float      # per chip, dense
+    cores_per_chip: int
+    ici_dims: int                # 2 => 2D mesh/torus (v5e/v6e), 3 => 3D (v4/v5p)
+    default_host_shape: tuple[int, ...]   # chips per host as a mesh
+    ici_link_gbps: float         # per link per direction, approximate public figure
+
+
+_GB = 1024**3
+
+GENERATIONS: dict[str, TpuGeneration] = {
+    "v4": TpuGeneration("v4", 32 * _GB, 275.0, 2, 3, (2, 2, 1), 50.0),
+    "v5e": TpuGeneration("v5e", 16 * _GB, 197.0, 1, 2, (2, 4), 50.0),
+    "v5p": TpuGeneration("v5p", 95 * _GB, 459.0, 2, 3, (2, 2, 1), 100.0),
+    "v6e": TpuGeneration("v6e", 32 * _GB, 918.0, 1, 2, (2, 4), 100.0),
+}
+
+# Well-known mesh shapes for a given (generation, chip count). Chip counts not
+# listed fall back to a near-square factorization.
+_KNOWN_SHAPES: dict[tuple[str, int], tuple[int, ...]] = {
+    ("v5e", 1): (1, 1),
+    ("v5e", 4): (2, 2),
+    ("v5e", 8): (2, 4),
+    ("v5e", 16): (4, 4),
+    ("v6e", 1): (1, 1),
+    ("v6e", 4): (2, 2),
+    ("v6e", 8): (2, 4),
+    ("v4", 4): (2, 2, 1),
+    ("v4", 8): (2, 2, 2),
+    ("v5p", 4): (2, 2, 1),
+    ("v5p", 8): (2, 2, 2),
+    ("v5p", 16): (4, 2, 2),
+    ("v5p", 32): (4, 4, 2),
+    ("v5p", 64): (4, 4, 4),
+}
+
+
+def _factorize(n: int, dims: int) -> tuple[int, ...]:
+    """Near-square factorization of ``n`` into ``dims`` factors, descending."""
+    best: tuple[int, ...] | None = None
+    best_score = math.inf
+
+    def candidates(remaining: int, slots: int):
+        if slots == 1:
+            yield (remaining,)
+            return
+        for d in range(1, remaining + 1):
+            if remaining % d == 0:
+                for rest in candidates(remaining // d, slots - 1):
+                    yield (d, *rest)
+
+    for combo in candidates(n, dims):
+        score = max(combo) - min(combo)
+        if score < best_score:
+            best_score, best = score, tuple(sorted(combo, reverse=True))
+    assert best is not None
+    return best
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """The chips of one host, as a sub-mesh of a (possibly multi-host) slice.
+
+    ``bounds`` is the host-local mesh shape (chips this daemon hands out);
+    ``slice_bounds``/``host_offset`` place the host inside a larger slice for
+    multi-host scheduling (reference never faced cross-node anything — SURVEY
+    §7 hard parts; here it is modeled from the start).
+    """
+
+    generation: TpuGeneration
+    bounds: tuple[int, ...]
+    slice_bounds: tuple[int, ...] | None = None
+    host_offset: tuple[int, ...] = ()
+    wraparound: tuple[bool, ...] = ()
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.bounds)
+
+    def coords(self) -> list[tuple[int, ...]]:
+        """Host-local chip coordinates in index order (row-major)."""
+        return list(itertools.product(*(range(b) for b in self.bounds)))
+
+    def index_of(self, coord: tuple[int, ...]) -> int:
+        idx = 0
+        for c, b in zip(coord, self.bounds):
+            idx = idx * b + c
+        return idx
+
+    def global_coord(self, coord: tuple[int, ...]) -> tuple[int, ...]:
+        if not self.host_offset:
+            return coord
+        return tuple(o + c for o, c in zip(self.host_offset, coord))
+
+    def neighbors(self, coord: tuple[int, ...]) -> list[tuple[int, ...]]:
+        """ICI neighbors of ``coord`` within host bounds (torus-aware)."""
+        wrap = self.wraparound or tuple(False for _ in self.bounds)
+        out = []
+        for axis, bound in enumerate(self.bounds):
+            for delta in (-1, 1):
+                n = list(coord)
+                n[axis] += delta
+                if 0 <= n[axis] < bound:
+                    out.append(tuple(n))
+                elif wrap[axis] and bound > 2:
+                    n[axis] %= bound
+                    out.append(tuple(n))
+        return out
+
+
+_TOPOLOGY_RE = re.compile(r"^(v\d+[a-z]*)-(\d+)$")
+_SHAPE_RE = re.compile(r"^(v\d+[a-z]*)-(\d+(?:x\d+)+)$")
+
+
+def parse_topology(spec: str) -> HostTopology:
+    """Parse ``v5e-4`` / ``v5p-8`` / ``v5e-2x4`` into a HostTopology.
+
+    Chip-count specs use well-known mesh shapes; explicit ``AxBxC`` shapes are
+    honored as written.
+    """
+    m = _SHAPE_RE.match(spec)
+    if m:
+        gen_name, shape_s = m.groups()
+        shape = tuple(int(x) for x in shape_s.split("x"))
+    else:
+        m = _TOPOLOGY_RE.match(spec)
+        if not m:
+            raise ValueError(f"unrecognized topology spec {spec!r}")
+        gen_name, count_s = m.groups()
+        count = int(count_s)
+        gen0 = GENERATIONS.get(gen_name)
+        if gen0 is None:
+            raise ValueError(f"unknown TPU generation {gen_name!r} in {spec!r}")
+        shape = _KNOWN_SHAPES.get((gen_name, count)) or _factorize(count, gen0.ici_dims)
+    gen = GENERATIONS.get(gen_name)
+    if gen is None:
+        raise ValueError(f"unknown TPU generation {gen_name!r} in {spec!r}")
+    if len(shape) != gen.ici_dims:
+        # pad or reject: pad trailing 1s for 3D gens given 2D shapes
+        if len(shape) < gen.ici_dims:
+            shape = shape + (1,) * (gen.ici_dims - len(shape))
+        else:
+            raise ValueError(
+                f"shape {shape} has more dims than {gen_name}'s ICI ({gen.ici_dims}D)"
+            )
+    return HostTopology(generation=gen, bounds=shape)

@@ -1,0 +1,27 @@
+"""Resource naming & partition strategy (reference: resource/)."""
+
+from k8s_gpu_device_plugin_tpu.resource.naming import (
+    MAX_RESOURCE_NAME_LENGTH,
+    RESOURCE_PREFIX,
+    SHARED_SUFFIX,
+    SLICE_STRATEGY_MIXED,
+    SLICE_STRATEGY_NONE,
+    SLICE_STRATEGY_SINGLE,
+    Resource,
+    ResourceName,
+    ResourcePattern,
+)
+from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
+
+__all__ = [
+    "Resource",
+    "ResourceName",
+    "ResourcePattern",
+    "RESOURCE_PREFIX",
+    "SHARED_SUFFIX",
+    "MAX_RESOURCE_NAME_LENGTH",
+    "SLICE_STRATEGY_NONE",
+    "SLICE_STRATEGY_SINGLE",
+    "SLICE_STRATEGY_MIXED",
+    "discover_resources",
+]

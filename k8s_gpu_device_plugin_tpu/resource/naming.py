@@ -1,0 +1,85 @@
+"""Resource naming rules and slice strategies.
+
+Reference: resource/resource.go —
+- constants: prefix ``nvidia.com``, shared suffix ``.shared``, max name length
+  63 (resource.go:8-12); here the prefix becomes ``google.com`` and the
+  canonical whole-chip resource is ``google.com/tpu`` (the name GKE's TPU
+  stack already schedules against, so workload manifests carry over).
+- MIG strategies ``none/single/mixed`` (resource.go:15-19) become *slice*
+  strategies: the TPU analogue of a MIG instance is an ICI sub-slice of the
+  host's chips (see device/slices.py).
+- ``Resource{Pattern, Name}`` with auto-prefixing (resource.go:27-40) and the
+  split/prefix helpers (resource.go:43-66).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass
+
+RESOURCE_PREFIX = "google.com"
+DEFAULT_RESOURCE = "tpu"
+SHARED_SUFFIX = ".shared"
+MAX_RESOURCE_NAME_LENGTH = 63
+
+SLICE_STRATEGY_NONE = "none"      # whole chips only, one resource
+SLICE_STRATEGY_SINGLE = "single"  # homogeneous sub-slices, one resource
+SLICE_STRATEGY_MIXED = "mixed"    # one resource per sub-slice shape
+
+
+class ResourceName(str):
+    """A fully-qualified extended-resource name, e.g. ``google.com/tpu``."""
+
+    def split(self) -> tuple[str, str]:  # type: ignore[override]
+        """Split into (prefix, base) (reference resource.go:43-50)."""
+        if "/" in self:
+            prefix, _, base = self.partition("/")
+            return prefix, base
+        return "", str(self)
+
+    @property
+    def is_shared(self) -> bool:
+        return self.endswith(SHARED_SUFFIX)
+
+    def shared(self) -> "ResourceName":
+        if self.is_shared:
+            return self
+        return ResourceName(str(self) + SHARED_SUFFIX)
+
+    def validate(self) -> None:
+        if len(self) > MAX_RESOURCE_NAME_LENGTH:
+            raise ValueError(
+                f"resource name {self!r} exceeds {MAX_RESOURCE_NAME_LENGTH} chars"
+            )
+        prefix, base = self.split()
+        if not prefix or not base:
+            raise ValueError(f"resource name {self!r} must be <prefix>/<name>")
+
+
+class ResourcePattern(str):
+    """A wildcard pattern matched against chip/slice-profile names.
+
+    The reference compiled shell wildcards to a regex by hand
+    (device/device_map.go:114-125); fnmatch.translate is the same transform.
+    """
+
+    def matches(self, name: str) -> bool:
+        return re.fullmatch(fnmatch.translate(str(self)), name) is not None
+
+
+@dataclass(frozen=True)
+class Resource:
+    """A (pattern -> resource name) pairing (reference resource.go:27-30)."""
+
+    pattern: ResourcePattern
+    name: ResourceName
+
+    @staticmethod
+    def new(pattern: str, name: str) -> "Resource":
+        """Auto-prefix bare names (reference NewResource, resource.go:32-40)."""
+        if "/" not in name:
+            name = f"{RESOURCE_PREFIX}/{name}"
+        resource = Resource(ResourcePattern(pattern), ResourceName(name))
+        resource.name.validate()
+        return resource

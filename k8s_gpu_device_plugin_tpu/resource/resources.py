@@ -1,0 +1,49 @@
+"""Resource discovery per slice strategy.
+
+Reference: resource/resources.go — ``none``/``single`` emit one resource
+("GPU" pattern -> ``nvidia.com/gpu``, resources.go:18-22); ``mixed`` walks MIG
+profiles and emits one resource per profile (``nvidia.com/mig-<profile>``,
+resources.go:43-51).
+
+TPU build: ``none``/``single`` emit ``google.com/tpu`` with a match-all
+pattern (devices are matched by generation name); ``mixed`` emits one resource
+per sub-slice profile in the plan, named ``google.com/tpu-slice-<shape>``.
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_device_plugin_tpu.device.slices import SliceProfile, default_plan
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+from k8s_gpu_device_plugin_tpu.resource.naming import (
+    DEFAULT_RESOURCE,
+    SLICE_STRATEGY_MIXED,
+    Resource,
+)
+
+
+def discover_resources(
+    strategy: str,
+    topology: HostTopology | None = None,
+    slice_plan: str = "",
+) -> list[Resource]:
+    """Enumerate the extended resources this host will advertise."""
+    if strategy != SLICE_STRATEGY_MIXED:
+        return [Resource.new("*", DEFAULT_RESOURCE)]
+
+    if slice_plan:
+        profiles = [SliceProfile.parse(p) for p in slice_plan.split(",") if p.strip()]
+    else:
+        if topology is None:
+            raise ValueError("mixed strategy needs a topology or explicit slicePlan")
+        profiles = default_plan(topology)
+
+    out: list[Resource] = []
+    seen: set[str] = set()
+    for profile in profiles:
+        if profile.name in seen:
+            continue
+        seen.add(profile.name)
+        out.append(
+            Resource.new(profile.name, f"{DEFAULT_RESOURCE}-slice-{profile.name}")
+        )
+    return out

@@ -1,0 +1,7 @@
+"""Shared modules (reference: modules/{log,util,watch,version})."""
+
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+from k8s_gpu_device_plugin_tpu.utils.envelope import failed, success
+from k8s_gpu_device_plugin_tpu.utils.version import VERSION
+
+__all__ = ["Latch", "success", "failed", "VERSION"]

@@ -1,0 +1,18 @@
+"""JSON response envelope (reference: modules/util/http.go:3-15).
+
+The reference wraps every HTTP payload as ``{"code": 200|500, "data": ...,
+"msg": "..."}``; `success` and `failed` mirror that contract so operators'
+tooling carries over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def success(data: Any = None, msg: str = "success") -> dict[str, Any]:
+    return {"code": 200, "data": data, "msg": msg}
+
+
+def failed(msg: str, code: int = 500, data: Any = None) -> dict[str, Any]:
+    return {"code": code, "data": data, "msg": msg}

@@ -1,0 +1,49 @@
+"""Idempotent readiness latch.
+
+Reference: ``util.CloseOnce`` (modules/util/util.go:10-14) — a channel closed
+exactly once, used to delay the HTTP server until the plugin manager has
+registered with the kubelet (main.go:63-71, plugin/manager.go:72).
+
+This version is usable from both sync code and asyncio: ``set()`` is
+idempotent and thread-safe; waiters can block (``wait``) or await
+(``wait_async``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class Latch:
+    """A one-shot, idempotent readiness signal."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._async_waiters: list[tuple[asyncio.AbstractEventLoop, asyncio.Event]] = []
+
+    def set(self) -> None:
+        """Open the latch. Safe to call any number of times from any thread."""
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._event.set()
+            waiters, self._async_waiters = self._async_waiters, []
+        for loop, event in waiters:
+            loop.call_soon_threadsafe(event.set)
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    async def wait_async(self) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            loop = asyncio.get_running_loop()
+            event = asyncio.Event()
+            self._async_waiters.append((loop, event))
+        await event.wait()

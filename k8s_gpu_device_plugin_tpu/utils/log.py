@@ -1,0 +1,171 @@
+"""Structured logging with per-level rotated JSON files.
+
+Reference: modules/log/log.go — a zap wrapper writing per-level JSON files
+(``app-{error,warn,info,debug}.log``) through lumberjack rotation
+(100MB x 60 backups x 30 days, compressed; log.go:131-146), a tee of four
+level-filtered cores (log.go:148-184), and an optional colored console in dev
+mode (log.go:173-180).
+
+This rebuild keeps the operational contract (same file names, same JSON field
+names ``level/ts/caller/msg``, same rotation budget) on the stdlib ``logging``
+stack, and fixes the reference's quirk at log.go:113 where error output was
+routed over stdout instead of stderr.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import logging.handlers
+import os
+import sys
+from dataclasses import dataclass, field
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+# Reference rotation budget (modules/log/log.go:91-93).
+MAX_BYTES = 100 * 1024 * 1024
+BACKUP_COUNT = 60
+
+
+def parse_level(name: str) -> int:
+    """Parse a level name, defaulting to INFO (reference log.go:258-273)."""
+    return _LEVELS.get(name.strip().lower(), logging.INFO)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: {"level", "ts", "caller", "msg", ...extras}."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "level": record.levelname.lower(),
+            "ts": round(record.created, 6),
+            "caller": f"{record.filename}:{record.lineno}",
+            "msg": record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            entry["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict):
+            entry.update(extra)
+        return json.dumps(entry, default=str)
+
+
+class _ExactLevelFilter(logging.Filter):
+    """Admit records of exactly one level (the per-level tee, log.go:148-170)."""
+
+    def __init__(self, level: int, and_above: bool = False) -> None:
+        super().__init__()
+        self._level = level
+        self._and_above = and_above
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if self._and_above:
+            return record.levelno >= self._level
+        return record.levelno == self._level
+
+
+class GzipRotatingFileHandler(logging.handlers.RotatingFileHandler):
+    """RotatingFileHandler that gzips rolled files (lumberjack Compress=true)."""
+
+    def rotation_filename(self, default_name: str) -> str:
+        return default_name + ".gz"
+
+    def rotate(self, source: str, dest: str) -> None:
+        try:
+            with open(source, "rb") as fsrc, gzip.open(dest, "wb") as fdst:
+                while chunk := fsrc.read(1 << 20):
+                    fdst.write(chunk)
+            os.remove(source)
+        except OSError:  # rotation must never take the daemon down
+            pass
+
+
+@dataclass
+class LogConfig:
+    """Reference ``LogConfig`` knobs (modules/log/log.go + config/config.go:13)."""
+
+    level: str = "debug"
+    file_dir: str | None = None  # None => console only
+    console: bool = True
+    name: str = "tpu-device-plugin"
+    max_bytes: int = MAX_BYTES
+    backup_count: int = BACKUP_COUNT
+    extra_fields: dict = field(default_factory=dict)
+
+
+# Per-level file tee: (filename suffix, exact level) — log.go:131-146.
+_FILE_LEVELS = [
+    ("error", logging.ERROR),
+    ("warn", logging.WARNING),
+    ("info", logging.INFO),
+    ("debug", logging.DEBUG),
+]
+
+_logger: logging.Logger | None = None
+
+
+def init_logger(cfg: LogConfig | None = None) -> logging.Logger:
+    """Build (or rebuild) the global logger (reference log.InitLogger, log.go:66)."""
+    global _logger
+    cfg = cfg or LogConfig()
+    logger = logging.getLogger(cfg.name)
+    logger.setLevel(parse_level(cfg.level))
+    logger.propagate = False
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        h.close()
+
+    formatter = JsonFormatter()
+    if cfg.file_dir:
+        os.makedirs(cfg.file_dir, exist_ok=True)
+        for suffix, level in _FILE_LEVELS:
+            if level < logger.level:
+                continue
+            handler = GzipRotatingFileHandler(
+                os.path.join(cfg.file_dir, f"app-{suffix}.log"),
+                maxBytes=cfg.max_bytes,
+                backupCount=cfg.backup_count,
+            )
+            # error file collects >= ERROR (incl. fatal); others are exact-level.
+            handler.addFilter(_ExactLevelFilter(level, and_above=level == logging.ERROR))
+            handler.setFormatter(formatter)
+            logger.addHandler(handler)
+
+    if cfg.console or not cfg.file_dir:
+        console = logging.StreamHandler(sys.stderr)
+        console.setFormatter(formatter)
+        logger.addHandler(console)
+
+    _logger = logger
+    return logger
+
+
+def get_logger() -> logging.Logger:
+    """The process-global logger (reference ``log.Logger``, log.go:25)."""
+    global _logger
+    if _logger is None:
+        _logger = init_logger()
+    return _logger
+
+
+def with_fields(logger: logging.Logger, **fields) -> logging.LoggerAdapter:
+    """Attach structured fields to every record (zap's With)."""
+
+    class _Adapter(logging.LoggerAdapter):
+        def process(self, msg, kwargs):
+            extra = kwargs.setdefault("extra", {})
+            merged = dict(fields)
+            merged.update(extra.get("fields", {}))
+            extra["fields"] = merged
+            return msg, kwargs
+
+    return _Adapter(logger, {})

@@ -1,0 +1,3 @@
+"""Framework version (reference: modules/version/version.go:4)."""
+
+VERSION = "0.1.0"

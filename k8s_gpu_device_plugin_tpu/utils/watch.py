@@ -1,0 +1,186 @@
+"""Filesystem and signal watching.
+
+Reference: modules/watch/watch.go — ``Files(...)`` builds an fsnotify watcher
+over a path list (watch.go:11-26); the manager uses it to detect the kubelet
+restarting (re-creation of ``kubelet.sock``, plugin/manager.go:59,80-84).
+``Signals(...)`` (watch.go:29-34) wraps signal.Notify.
+
+Instead of a third-party fsnotify dependency this uses the Linux ``inotify``
+syscalls directly through ctypes (the platform the kubelet device-plugin API
+exists on is Linux), with a polling fallback for non-Linux dev machines.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import select
+import signal
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+# inotify event masks (linux/inotify.h)
+IN_CREATE = 0x00000100
+IN_DELETE = 0x00000200
+IN_MOVED_TO = 0x00000080
+IN_MODIFY = 0x00000002
+IN_ATTRIB = 0x00000004
+IN_DELETE_SELF = 0x00000400
+
+_EVENT_HDR = struct.Struct("iIII")  # wd, mask, cookie, len
+
+
+@dataclass(frozen=True)
+class FileEvent:
+    path: str      # watched directory
+    name: str      # entry name within it ("" for self events)
+    mask: int
+
+    @property
+    def full_path(self) -> str:
+        return os.path.join(self.path, self.name) if self.name else self.path
+
+    @property
+    def is_create(self) -> bool:
+        return bool(self.mask & (IN_CREATE | IN_MOVED_TO))
+
+
+class FileWatcher:
+    """Watch directories for entry create/delete/modify events.
+
+    Usage mirrors the reference's fsnotify watcher: construct over paths, then
+    iterate ``events()`` (blocking generator) or poll ``poll(timeout)``.
+    """
+
+    def __init__(self, paths: Iterable[str]) -> None:
+        self._paths = [str(p) for p in paths]
+        self._wd_to_path: dict[int, str] = {}
+        self._libc = None
+        self._fd = -1
+        self._closed = False
+        self._start()
+
+    def _start(self) -> None:
+        try:
+            libc_name = ctypes.util.find_library("c") or "libc.so.6"
+            libc = ctypes.CDLL(libc_name, use_errno=True)
+            fd = libc.inotify_init1(os.O_NONBLOCK)
+            if fd < 0:
+                raise OSError(ctypes.get_errno(), "inotify_init1")
+            mask = IN_CREATE | IN_DELETE | IN_MOVED_TO | IN_MODIFY | IN_DELETE_SELF
+            for path in self._paths:
+                wd = libc.inotify_add_watch(fd, path.encode(), mask)
+                if wd < 0:
+                    err = ctypes.get_errno()
+                    os.close(fd)
+                    raise OSError(err, f"inotify_add_watch({path})")
+                self._wd_to_path[wd] = path
+            self._libc, self._fd = libc, fd
+        except (OSError, AttributeError):
+            # Non-Linux or restricted environment: fall back to polling.
+            self._libc, self._fd = None, -1
+            self._snapshots = {p: self._snapshot(p) for p in self._paths}
+
+    @staticmethod
+    def _snapshot(path: str) -> dict[str, float]:
+        try:
+            out = {}
+            for name in os.listdir(path):
+                try:
+                    out[name] = os.stat(os.path.join(path, name)).st_mtime
+                except OSError:
+                    pass
+            return out
+        except OSError:
+            return {}
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def poll(self, timeout: float | None = None) -> list[FileEvent]:
+        """Return pending events, waiting up to ``timeout`` seconds."""
+        if self._closed:
+            return []
+        if self._fd >= 0:
+            ready, _, _ = select.select([self._fd], [], [], timeout)
+            if not ready:
+                return []
+            return self._drain()
+        # polling fallback
+        import time
+
+        time.sleep(min(timeout or 0.5, 0.5))
+        events: list[FileEvent] = []
+        for path in self._paths:
+            old, new = self._snapshots.get(path, {}), self._snapshot(path)
+            for name in new.keys() - old.keys():
+                events.append(FileEvent(path, name, IN_CREATE))
+            for name in old.keys() - new.keys():
+                events.append(FileEvent(path, name, IN_DELETE))
+            for name in new.keys() & old.keys():
+                if new[name] != old[name]:
+                    events.append(FileEvent(path, name, IN_MODIFY))
+            self._snapshots[path] = new
+        return events
+
+    def _drain(self) -> list[FileEvent]:
+        events: list[FileEvent] = []
+        try:
+            data = os.read(self._fd, 64 * 1024)
+        except OSError as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return events
+            raise
+        offset = 0
+        while offset + _EVENT_HDR.size <= len(data):
+            wd, mask, _cookie, name_len = _EVENT_HDR.unpack_from(data, offset)
+            offset += _EVENT_HDR.size
+            raw = data[offset : offset + name_len]
+            offset += name_len
+            name = raw.split(b"\0", 1)[0].decode(errors="replace")
+            path = self._wd_to_path.get(wd, "")
+            events.append(FileEvent(path, name, mask))
+        return events
+
+    def close(self) -> None:
+        self._closed = True
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    def __enter__(self) -> "FileWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def signals(
+    handler: Callable[[int], None],
+    signums: Iterable[int] = (signal.SIGHUP, signal.SIGINT, signal.SIGTERM, signal.SIGQUIT),
+) -> None:
+    """Install a handler for shutdown signals (reference watch.go:29-34)."""
+    for signum in signums:
+        signal.signal(signum, lambda s, _frame: handler(s))
+
+
+class SignalLatch:
+    """Collects the first received signal and wakes waiters (main.go:83-110)."""
+
+    def __init__(self, signums: Iterable[int] = (signal.SIGINT, signal.SIGTERM)) -> None:
+        self.received: int | None = None
+        self._event = threading.Event()
+        signals(self._on_signal, signums)
+
+    def _on_signal(self, signum: int) -> None:
+        if self.received is None:
+            self.received = signum
+        self._event.set()
+
+    def wait(self, timeout: float | None = None) -> int | None:
+        self._event.wait(timeout)
+        return self.received

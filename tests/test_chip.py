@@ -1,0 +1,108 @@
+"""Chip model & AnnotatedID tests (≙ device/devices.go:88-265 table tests)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.chip import (
+    HEALTHY,
+    UNHEALTHY,
+    AnnotatedID,
+    Chip,
+    Chips,
+)
+
+
+def make_chip(i: int, health: str = HEALTHY) -> Chip:
+    return Chip(
+        id=f"TPU-{i:04d}",
+        index=i,
+        paths=(f"/dev/accel{i}",),
+        coords=((i % 2, i // 2),),
+        generation="v5e",
+        total_memory=16 << 30,
+        health=health,
+        chip_indices=(i,),
+    )
+
+
+def test_annotated_id_roundtrip():
+    aid = AnnotatedID("TPU-abc", 3)
+    assert str(aid) == "TPU-abc::3"
+    parsed = AnnotatedID.parse("TPU-abc::3")
+    assert parsed == aid
+
+
+def test_annotated_id_detection():
+    assert AnnotatedID.is_annotated("TPU-abc::0")
+    assert not AnnotatedID.is_annotated("TPU-abc")
+    assert not AnnotatedID.is_annotated("TPU-abc::x")
+    assert not AnnotatedID.is_annotated("::3")
+    assert AnnotatedID.any_annotated(["a", "b::1"])
+    assert not AnnotatedID.any_annotated(["a", "b"])
+
+
+def test_annotated_parse_rejects_plain():
+    with pytest.raises(ValueError):
+        AnnotatedID.parse("TPU-abc")
+
+
+def test_chips_set_ops():
+    chips = Chips.of([make_chip(i) for i in range(4)])
+    assert chips.contains("TPU-0000", "TPU-0003")
+    assert not chips.contains("TPU-0000", "nope")
+    assert chips.get_by_index(2).id == "TPU-0002"
+    assert chips.get_by_index(9) is None
+
+    sub = chips.subset(["TPU-0001", "TPU-0002", "missing"])
+    assert sub.ids() == ["TPU-0001", "TPU-0002"]
+
+    diff = chips.difference(sub)
+    assert diff.ids() == ["TPU-0000", "TPU-0003"]
+    assert chips.indices() == [0, 1, 2, 3]
+
+
+def test_chips_paths_deduped_ordered():
+    chips = Chips.of([make_chip(1), make_chip(0)])
+    assert chips.all_paths() == ["/dev/accel0", "/dev/accel1"]
+
+
+def test_chips_healthy_filter():
+    chips = Chips.of([make_chip(0), make_chip(1, UNHEALTHY)])
+    assert chips.healthy().ids() == ["TPU-0000"]
+
+
+def test_physical_ids_collapse_replicas():
+    chips = Chips.of(
+        [
+            Chip(
+                id=str(AnnotatedID(f"TPU-{i}", r)),
+                index=i,
+                paths=(),
+                coords=(),
+                generation="v5e",
+                total_memory=0,
+                replicas=2,
+            )
+            for i in range(2)
+            for r in range(2)
+        ]
+    )
+    assert sorted(chips.physical_ids()) == ["TPU-0", "TPU-1"]
+
+
+def test_aligned_allocation_supported():
+    whole = Chips.of([make_chip(0)])
+    assert whole.aligned_allocation_supported()
+    sliced = Chips.of(
+        [
+            Chip(
+                id="S",
+                index=0,
+                paths=(),
+                coords=((0, 0), (0, 1)),
+                generation="v5e",
+                total_memory=0,
+                slice_profile="1x2",
+            )
+        ]
+    )
+    assert not sliced.aligned_allocation_supported()

@@ -1,0 +1,103 @@
+"""ChipMap strategy tests (≙ device/device_map.go dispatch + matching)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.chip import AnnotatedID
+from k8s_gpu_device_plugin_tpu.device.chip_map import new_chip_map
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.resource.naming import Resource
+from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
+
+
+def test_strategy_none_whole_chips():
+    backend = FakeBackend("v5e-4")
+    resources = discover_resources("none")
+    chip_map = new_chip_map(backend, resources, "none")
+    assert list(chip_map) == ["google.com/tpu"]
+    chips = chip_map["google.com/tpu"]
+    assert len(chips) == 4
+    assert all(not c.is_slice for c in chips.values())
+    assert chips.all_paths() == [f"/dev/accel{i}" for i in range(4)]
+
+
+def test_strategy_single_slices_under_plain_name():
+    backend = FakeBackend("v5e-8")
+    resources = discover_resources("single")
+    chip_map = new_chip_map(backend, resources, "single", slice_shape="2x2")
+    chips = chip_map["google.com/tpu"]
+    assert len(chips) == 2
+    for chip in chips.values():
+        assert chip.slice_profile == "2x2"
+        assert chip.num_chips == 4
+        assert chip.total_memory == 4 * 16 * 1024**3
+
+
+def test_strategy_single_without_shape_falls_back_to_chips():
+    backend = FakeBackend("v5e-4")
+    chip_map = new_chip_map(backend, discover_resources("single"), "single")
+    assert len(chip_map["google.com/tpu"]) == 4
+
+
+def test_strategy_mixed_one_resource_per_profile():
+    backend = FakeBackend("v5e-8")
+    resources = discover_resources(
+        "mixed", backend.host_topology(), slice_plan="2x2,1x2,1x2"
+    )
+    chip_map = new_chip_map(
+        backend, resources, "mixed", slice_plan="2x2,1x2,1x2"
+    )
+    assert set(chip_map) == {
+        "google.com/tpu-slice-2x2",
+        "google.com/tpu-slice-1x2",
+    }
+    assert len(chip_map["google.com/tpu-slice-2x2"]) == 1
+    assert len(chip_map["google.com/tpu-slice-1x2"]) == 2
+    # all 8 chips covered, disjointly
+    indices = [
+        i
+        for chips in chip_map.values()
+        for c in chips.values()
+        for i in c.chip_indices
+    ]
+    assert sorted(indices) == list(range(8))
+
+
+def test_strategy_mixed_default_plan_halves_host():
+    backend = FakeBackend("v5p-8")
+    topo = backend.host_topology()
+    resources = discover_resources("mixed", topo)
+    chip_map = new_chip_map(backend, resources, "mixed")
+    assert len(chip_map) == 1
+    (chips,) = chip_map.values()
+    assert len(chips) == 2  # two half-host slices
+
+
+def test_shared_replicas_annotated_ids():
+    backend = FakeBackend("v5e-4")
+    chip_map = new_chip_map(
+        backend, discover_resources("none"), "none", shared_replicas=2
+    )
+    chips = chip_map["google.com/tpu"]
+    assert len(chips) == 8
+    assert all(AnnotatedID.is_annotated(i) for i in chips)
+    assert len(chips.physical_ids()) == 4
+    assert all(c.replicas == 2 for c in chips.values())
+
+
+def test_unmatched_pattern_is_hard_error():
+    backend = FakeBackend("v5e-4")
+    bad = [Resource.new("h100*", "tpu")]
+    with pytest.raises(ValueError, match="no resource pattern"):
+        new_chip_map(backend, bad, "none")
+
+
+def test_slice_ids_stable_across_rebuilds():
+    backend = FakeBackend("v5e-8")
+    kwargs = dict(
+        resources=discover_resources("single"),
+        strategy="single",
+        slice_shape="2x2",
+    )
+    a = new_chip_map(backend, **kwargs)
+    b = new_chip_map(backend, **kwargs)
+    assert a["google.com/tpu"].ids() == b["google.com/tpu"].ids()

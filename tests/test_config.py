@@ -1,0 +1,64 @@
+"""Config tier tests (≙ main.go:37-52 defaults <- yaml <- flags)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.config import Config, load_config
+
+
+def test_defaults(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = load_config([])
+    assert cfg.web_listen_address == "9002"
+    assert cfg.slice_strategy == "none"
+    assert cfg.benchmark is False
+    assert cfg.log.level == "debug"
+
+
+def test_yaml_tier(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "config.yml").write_text(
+        """
+webListenAddress: "127.0.0.1:9100"
+sliceStrategy: mixed
+slicePlan: "2x2,2x2"
+benchmark: true
+log:
+  level: info
+  fileDir: /tmp/logs
+"""
+    )
+    cfg = load_config([])
+    assert cfg.web_listen_address == "127.0.0.1:9100"
+    assert cfg.slice_strategy == "mixed"
+    assert cfg.slice_plan == "2x2,2x2"
+    assert cfg.benchmark is True
+    assert cfg.log.level == "info"
+    assert cfg.log.file_dir == "/tmp/logs"
+
+
+def test_flags_override_yaml(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "config.yml").write_text("sliceStrategy: mixed\n")
+    cfg = load_config(["--sliceStrategy", "single", "--sliceShape", "2x2"])
+    assert cfg.slice_strategy == "single"
+    assert cfg.slice_shape == "2x2"
+
+
+def test_mig_strategy_alias(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "config.yml").write_text("migStrategy: single\n")
+    assert load_config([]).slice_strategy == "single"
+
+
+def test_invalid_strategy_rejected(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "config.yml").write_text("sliceStrategy: bogus\n")
+    with pytest.raises(ValueError):
+        load_config([])
+
+
+def test_listen_addr_forms():
+    cfg = Config()
+    assert cfg.listen_addr == ("0.0.0.0", 9002)
+    cfg.web_listen_address = "127.0.0.1:8080"
+    assert cfg.listen_addr == ("127.0.0.1", 8080)

@@ -1,0 +1,71 @@
+"""Resource naming tests (≙ resource/resource.go:32-66 table tests)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.topology import parse_topology
+from k8s_gpu_device_plugin_tpu.resource.naming import (
+    Resource,
+    ResourceName,
+    ResourcePattern,
+)
+from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
+
+
+def test_auto_prefix():
+    r = Resource.new("*", "tpu")
+    assert str(r.name) == "google.com/tpu"
+
+
+def test_explicit_prefix_preserved():
+    r = Resource.new("*", "example.com/accel")
+    assert str(r.name) == "example.com/accel"
+
+
+def test_name_split():
+    prefix, base = ResourceName("google.com/tpu").split()
+    assert (prefix, base) == ("google.com", "tpu")
+
+
+def test_shared_suffix():
+    n = ResourceName("google.com/tpu")
+    assert not n.is_shared
+    s = n.shared()
+    assert str(s) == "google.com/tpu.shared"
+    assert s.is_shared
+    assert s.shared() == s
+
+
+def test_name_length_limit():
+    with pytest.raises(ValueError, match="exceeds"):
+        Resource.new("*", "x" * 64)
+
+
+def test_pattern_wildcards():
+    assert ResourcePattern("*").matches("v5e")
+    assert ResourcePattern("v5*").matches("v5p")
+    assert not ResourcePattern("v5*").matches("v4")
+    assert ResourcePattern("2x2").matches("2x2")
+    assert not ResourcePattern("2x2").matches("2x2x1")
+
+
+def test_discover_none_single():
+    for strategy in ("none", "single"):
+        (r,) = discover_resources(strategy)
+        assert str(r.name) == "google.com/tpu"
+
+
+def test_discover_mixed_from_plan():
+    resources = discover_resources("mixed", slice_plan="2x2,1x2,1x2")
+    names = [str(r.name) for r in resources]
+    assert names == ["google.com/tpu-slice-2x2", "google.com/tpu-slice-1x2"]
+
+
+def test_discover_mixed_default_plan():
+    topo = parse_topology("v5e-8")
+    (r,) = discover_resources("mixed", topo)
+    assert str(r.name) == "google.com/tpu-slice-2x2"
+
+
+def test_discover_mixed_requires_topology_or_plan():
+    with pytest.raises(ValueError):
+        discover_resources("mixed")

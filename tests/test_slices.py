@@ -1,0 +1,78 @@
+"""Sub-slice partitioning tests (≙ MIG semantics, device/mig.go + resources.go)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.slices import (
+    SliceProfile,
+    enumerate_placements,
+    partition_host,
+    supported_profiles,
+)
+from k8s_gpu_device_plugin_tpu.device.topology import parse_topology
+
+
+def test_profile_parse_and_name():
+    p = SliceProfile.parse("2x2")
+    assert p.shape == (2, 2)
+    assert p.name == "2x2"
+    assert p.num_chips == 4
+    with pytest.raises(ValueError):
+        SliceProfile.parse("2xx2")
+    with pytest.raises(ValueError):
+        SliceProfile.parse("0x2")
+
+
+def test_supported_profiles_v5e8():
+    topo = parse_topology("v5e-8")  # 2x4
+    names = {p.name for p in supported_profiles(topo)}
+    # divisors of (2,4), strictly smaller than 8 chips
+    assert names == {"1x1", "1x2", "1x4", "2x1", "2x2"}
+
+
+def test_supported_profiles_v5p8():
+    topo = parse_topology("v5p-8")  # 2x2x2
+    names = {p.name for p in supported_profiles(topo)}
+    assert "1x1x1" in names
+    assert "2x2x1" in names
+    assert "2x2x2" not in names  # whole host is not a strict sub-slice
+
+
+def test_placements_are_disjoint_tiling():
+    topo = parse_topology("v5e-8")
+    placements = enumerate_placements(topo, SliceProfile.parse("2x2"))
+    assert len(placements) == 2
+    cells = [c for p in placements for c in p.coords()]
+    assert len(cells) == len(set(cells)) == 8
+
+
+def test_partition_full_host():
+    topo = parse_topology("v5e-8")
+    plan = [SliceProfile.parse("2x2"), SliceProfile.parse("2x2")]
+    placements = partition_host(topo, plan)
+    assert len(placements) == 2
+    all_cells = {c for p in placements for c in p.coords()}
+    assert len(all_cells) == 8
+
+
+def test_partition_mixed_shapes():
+    topo = parse_topology("v5e-8")
+    plan = [SliceProfile.parse(s) for s in ("2x2", "1x2", "1x1", "1x1")]
+    placements = partition_host(topo, plan)
+    covered = [c for p in placements for c in p.coords()]
+    assert len(covered) == len(set(covered)) == 8
+
+
+def test_partition_overflow_raises():
+    topo = parse_topology("v5e-4")
+    plan = [SliceProfile.parse("2x2"), SliceProfile.parse("1x1")]
+    with pytest.raises(ValueError, match="does not fit"):
+        partition_host(topo, plan)
+
+
+def test_placement_chip_indices_match_topology():
+    topo = parse_topology("v5p-8")
+    placements = enumerate_placements(topo, SliceProfile.parse("2x2x1"))
+    seen = []
+    for p in placements:
+        seen.extend(p.chip_indices(topo))
+    assert sorted(seen) == list(range(8))

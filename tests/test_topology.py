@@ -1,0 +1,69 @@
+"""Topology model tests (≙ reference device metadata seams, device/device.go)."""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.topology import (
+    GENERATIONS,
+    HostTopology,
+    parse_topology,
+)
+
+
+def test_parse_known_shapes():
+    assert parse_topology("v5e-4").bounds == (2, 2)
+    assert parse_topology("v5e-8").bounds == (2, 4)
+    assert parse_topology("v5p-8").bounds == (2, 2, 2)
+    assert parse_topology("v5p-16").bounds == (4, 2, 2)
+    assert parse_topology("v5p-32").bounds == (4, 4, 2)
+    assert parse_topology("v5e-1").bounds == (1, 1)
+
+
+def test_parse_explicit_shape():
+    topo = parse_topology("v5e-2x4")
+    assert topo.bounds == (2, 4)
+    assert topo.generation.name == "v5e"
+    # 2D shape on a 3D generation pads with trailing 1s
+    assert parse_topology("v5p-2x2").bounds == (2, 2, 1)
+
+
+def test_parse_fallback_factorization():
+    topo = parse_topology("v5e-2")
+    assert sorted(topo.bounds) == [1, 2]
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_topology("h100-8")
+    with pytest.raises(ValueError):
+        parse_topology("v5e")
+    with pytest.raises(ValueError):
+        parse_topology("v5e-2x2x2")  # 3D shape on 2D generation
+
+
+def test_coords_and_index_roundtrip():
+    topo = parse_topology("v5p-8")
+    coords = topo.coords()
+    assert len(coords) == 8
+    for c in coords:
+        assert coords[topo.index_of(c)] == c
+
+
+def test_neighbors_mesh_interior_and_edge():
+    topo = parse_topology("v5e-16")  # 4x4
+    assert len(topo.neighbors((1, 1))) == 4
+    assert len(topo.neighbors((0, 0))) == 2
+
+
+def test_neighbors_torus_wrap():
+    topo = HostTopology(
+        generation=GENERATIONS["v5e"], bounds=(4, 4), wraparound=(True, True)
+    )
+    assert len(topo.neighbors((0, 0))) == 4
+
+
+def test_generation_table_sane():
+    for gen in GENERATIONS.values():
+        assert gen.hbm_bytes > 0
+        assert gen.peak_bf16_tflops > 0
+        assert gen.ici_dims in (2, 3)
+        assert len(gen.default_host_shape) == gen.ici_dims

@@ -1,0 +1,84 @@
+"""Utils tests: latch, envelope, logging, file watcher (≙ modules/)."""
+
+import json
+import logging
+import os
+import threading
+import time
+
+from k8s_gpu_device_plugin_tpu.utils.envelope import failed, success
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+from k8s_gpu_device_plugin_tpu.utils.log import JsonFormatter, LogConfig, init_logger, parse_level
+from k8s_gpu_device_plugin_tpu.utils.watch import FileWatcher
+
+
+def test_latch_idempotent_and_threadsafe():
+    latch = Latch()
+    assert not latch.is_set()
+    results = []
+
+    t = threading.Thread(target=lambda: results.append(latch.wait(5)))
+    t.start()
+    latch.set()
+    latch.set()  # second close is a no-op (CloseOnce semantics)
+    t.join(5)
+    assert results == [True]
+    assert latch.wait(0)
+
+
+def test_envelope_contract():
+    assert success({"a": 1}) == {"code": 200, "data": {"a": 1}, "msg": "success"}
+    assert failed("boom") == {"code": 500, "data": None, "msg": "boom"}
+
+
+def test_parse_level():
+    assert parse_level("warn") == logging.WARNING
+    assert parse_level("bogus") == logging.INFO
+
+
+def test_json_formatter_fields():
+    record = logging.LogRecord("t", logging.INFO, "f.py", 10, "hello %s", ("x",), None)
+    record.fields = {"resource": "google.com/tpu"}
+    entry = json.loads(JsonFormatter().format(record))
+    assert entry["msg"] == "hello x"
+    assert entry["level"] == "info"
+    assert entry["resource"] == "google.com/tpu"
+    assert "caller" in entry and "ts" in entry
+
+
+def test_per_level_files(tmp_path):
+    logger = init_logger(
+        LogConfig(level="debug", file_dir=str(tmp_path), console=False, name="t1")
+    )
+    logger.debug("d")
+    logger.info("i")
+    logger.warning("w")
+    logger.error("e")
+    for h in logger.handlers:
+        h.flush()
+    files = {p for p in os.listdir(tmp_path)}
+    assert files == {"app-debug.log", "app-info.log", "app-warn.log", "app-error.log"}
+    # exact-level routing: info file has only the info record
+    lines = (tmp_path / "app-info.log").read_text().strip().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["msg"] == "i"
+
+
+def test_file_watcher_sees_create_and_delete(tmp_path):
+    with FileWatcher([str(tmp_path)]) as watcher:
+        target = tmp_path / "kubelet.sock"
+        target.write_text("")
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline:
+            events += watcher.poll(0.2)
+            if any(e.name == "kubelet.sock" and e.is_create for e in events):
+                break
+        assert any(e.name == "kubelet.sock" and e.is_create for e in events)
+
+        target.unlink()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            events += watcher.poll(0.2)
+            if any(e.name == "kubelet.sock" and not e.is_create for e in events):
+                break
+        assert any(e.name == "kubelet.sock" and not e.is_create for e in events)
