@@ -1,0 +1,15 @@
+"""Benchmark subsystem.
+
+Two halves, mirroring what the reference had and what it was supposed to have:
+
+- ``profiler.py`` — daemon self-profiling (≙ benchmark/benchmark.go, which
+  despite its name only wrote Go pprof profiles);
+- ``workloads/`` — the *real* device benchmarks the north star requires
+  (BASELINE.md): JAX matmul MFU, ICI all-reduce sweeps, and Llama train-step
+  MFU on plugin-allocated chips, plus the zero-hardware control-plane
+  round-trip (config #1).
+"""
+
+from k8s_gpu_device_plugin_tpu.benchmark.profiler import Profiler
+
+__all__ = ["Profiler"]

@@ -1,0 +1,66 @@
+"""Daemon self-profiling harness.
+
+Reference: benchmark/benchmark.go — despite the package name, a pprof
+self-profiler: ``Run`` (54-89) started a CPU profile and set memory/block/
+mutex sample rates, ``Stop`` (92-124) flushed ``cpu.prof``/``mem.prof``/
+``block.prof``/``mutex.prof`` to a temp dir. Zero device interaction.
+
+Python equivalents: cProfile for CPU, tracemalloc for allocations. Real
+device benchmarks live in benchmark/workloads (the north-star rewrite).
+"""
+
+from __future__ import annotations
+
+import cProfile
+import logging
+import os
+import tempfile
+import tracemalloc
+
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+# ≙ MemProfileRate 64KiB (benchmark.go:71): sample every N bytes.
+TRACEMALLOC_FRAMES = 16
+
+
+class Profiler:
+    """Start/stop CPU + allocation profiling, writing into a profile dir."""
+
+    def __init__(self, logger: logging.Logger | None = None, out_dir: str | None = None) -> None:
+        self.log = logger or get_logger()
+        self.out_dir = out_dir or tempfile.mkdtemp(prefix="tpu-plugin-prof-")
+        self._cpu = cProfile.Profile()
+        self._running = False
+
+    def run(self) -> None:
+        """Begin profiling (≙ Benchmark.Run, benchmark.go:54-89)."""
+        if self._running:
+            return
+        os.makedirs(self.out_dir, exist_ok=True)
+        self._cpu.enable()
+        tracemalloc.start(TRACEMALLOC_FRAMES)
+        self._running = True
+        self.log.info(
+            "profiling started", extra={"fields": {"out_dir": self.out_dir}}
+        )
+
+    def stop(self) -> dict[str, str]:
+        """Flush profiles (≙ Benchmark.Stop, benchmark.go:92-124)."""
+        if not self._running:
+            return {}
+        self._cpu.disable()
+        cpu_path = os.path.join(self.out_dir, "cpu.prof")
+        self._cpu.dump_stats(cpu_path)
+
+        mem_path = os.path.join(self.out_dir, "mem.prof")
+        snapshot = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        with open(mem_path, "w") as f:
+            for stat in snapshot.statistics("lineno")[:200]:
+                f.write(f"{stat}\n")
+        self._running = False
+        self.log.info(
+            "profiling stopped",
+            extra={"fields": {"cpu": cpu_path, "mem": mem_path}},
+        )
+        return {"cpu": cpu_path, "mem": mem_path}

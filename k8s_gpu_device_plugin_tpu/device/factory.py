@@ -1,0 +1,41 @@
+"""Backend factory: pick native C++ enumeration or the fake, per config.
+
+≙ the reference's hard dependency on NVML at manager construction
+(plugin/manager.go:44, ``nvml.New()``); here the seam is explicit so the
+zero-hardware path (BASELINE config #1) is a first-class mode, not a crash.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from k8s_gpu_device_plugin_tpu.device.backend import ChipBackend
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+
+
+def make_backend(
+    kind: str = "auto",
+    topology: str = "auto",
+    logger: logging.Logger | None = None,
+) -> ChipBackend:
+    """Build a chip backend.
+
+    kind="native" requires the C++ core; "fake" forces the synthetic backend;
+    "auto" tries native hardware first and falls back to fake. A topology of
+    "auto" with the fake backend defaults to a v5e-4 host.
+    """
+    log = logger or logging.getLogger(__name__)
+    if kind in ("auto", "native"):
+        try:
+            from k8s_gpu_device_plugin_tpu.device.native import NativeBackend
+
+            backend = NativeBackend(topology_override=topology)
+            if backend.available():
+                return backend
+            if kind == "native":
+                raise RuntimeError("native TPU enumeration found no chips")
+        except Exception as e:  # noqa: BLE001 - any native failure falls back
+            if kind == "native":
+                raise
+            log.debug("native backend unavailable, using fake: %s", e)
+    return FakeBackend("v5e-4" if topology == "auto" else topology)

@@ -1,0 +1,149 @@
+"""ctypes binding to the C++ enumeration/topology core (native/).
+
+≙ the reference's cgo surface: go-nvml device handles (device/device.go),
+go-nvlib traversal, go-gpuallocator topology scoring. The C++ library
+(``libtpuenum.so``, sources in ``k8s_gpu_device_plugin_tpu/native/``)
+enumerates TPU chips from ``/dev/accel*``/``/dev/vfio`` and sysfs **without
+instantiating a PjRt client** — libtpu is single-client per chip, so the
+daemon must never hold the runtime lock workload pods need (SURVEY §7 hard
+part #1, unlike NVML's concurrent read-only access).
+
+If the shared library is missing (not built) or finds no chips, the factory
+falls back to the fake backend.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from k8s_gpu_device_plugin_tpu.device.backend import ChipSpec
+from k8s_gpu_device_plugin_tpu.device.topology import (
+    GENERATIONS,
+    HostTopology,
+    parse_topology,
+)
+
+_LIB_NAMES = ("libtpuenum.so",)
+_LIB_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "native", "build"),
+    os.path.join(os.path.dirname(__file__), "..", "native"),
+    "/usr/local/lib",
+)
+
+
+class _CChipInfo(ctypes.Structure):
+    """Mirrors ``TpuChipInfo`` in native/tpuenum.h."""
+
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("numa_node", ctypes.c_int32),
+        ("coord", ctypes.c_int32 * 3),
+        ("hbm_bytes", ctypes.c_int64),
+        ("uuid", ctypes.c_char * 64),
+        ("path", ctypes.c_char * 64),
+        ("generation", ctypes.c_char * 16),
+    ]
+
+
+def _load_library() -> ctypes.CDLL | None:
+    for lib_dir in _LIB_DIRS:
+        for name in _LIB_NAMES:
+            path = os.path.abspath(os.path.join(lib_dir, name))
+            if os.path.exists(path):
+                try:
+                    lib = ctypes.CDLL(path)
+                except OSError:
+                    continue
+                lib.tpuenum_chip_count.restype = ctypes.c_int32
+                lib.tpuenum_enumerate.restype = ctypes.c_int32
+                lib.tpuenum_enumerate.argtypes = [
+                    ctypes.POINTER(_CChipInfo),
+                    ctypes.c_int32,
+                ]
+                lib.tpuenum_generation.restype = ctypes.c_int32
+                lib.tpuenum_generation.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_int32,
+                ]
+                return lib
+    return None
+
+
+class NativeBackend:
+    """Chip backend over the C++ core."""
+
+    name = "native"
+
+    def __init__(self, topology_override: str = "auto") -> None:
+        self._lib = _load_library()
+        self._topology_override = topology_override
+        self._topo: HostTopology | None = None
+
+    def available(self) -> bool:
+        return self._lib is not None and self._lib.tpuenum_chip_count() > 0
+
+    def _enumerate_raw(self) -> list[_CChipInfo]:
+        if self._lib is None:
+            return []
+        count = self._lib.tpuenum_chip_count()
+        if count <= 0:
+            return []
+        buf = (_CChipInfo * count)()
+        n = self._lib.tpuenum_enumerate(buf, count)
+        return list(buf[: max(0, n)])
+
+    def _generation_name(self) -> str:
+        if self._lib is None:
+            return "v5e"
+        out = ctypes.create_string_buffer(16)
+        self._lib.tpuenum_generation(out, len(out))
+        name = out.value.decode() or "v5e"
+        return name if name in GENERATIONS else "v5e"
+
+    def host_topology(self) -> HostTopology:
+        if self._topo is not None:
+            return self._topo
+        if self._topology_override not in ("", "auto"):
+            self._topo = parse_topology(self._topology_override)
+            return self._topo
+        chips = self._enumerate_raw()
+        gen = self._generation_name()
+        self._topo = parse_topology(f"{gen}-{max(1, len(chips))}")
+        return self._topo
+
+    def enumerate_chips(self) -> list[ChipSpec]:
+        topo = self.host_topology()
+        coords = topo.coords()
+        specs = []
+        for info in self._enumerate_raw():
+            index = int(info.index)
+            coord = tuple(int(c) for c in info.coord[: len(topo.bounds)])
+            if all(c == 0 for c in coord) and index < len(coords):
+                coord = coords[index]  # driver exposed no coords; use row-major
+            specs.append(
+                ChipSpec(
+                    index=index,
+                    uuid=info.uuid.decode() or f"TPU-native-{index}",
+                    paths=(info.path.decode() or f"/dev/accel{index}",),
+                    coord=coord,
+                    numa_node=int(info.numa_node),
+                    hbm_bytes=int(info.hbm_bytes)
+                    or topo.generation.hbm_bytes,
+                    generation=topo.generation.name,
+                )
+            )
+        return specs
+
+    def check_health(self) -> dict[int, bool]:
+        """A chip is healthy while its device node exists and is accessible.
+
+        (PjRt-level health probing would require taking the runtime lock;
+        node presence + readability is the non-intrusive signal, matching the
+        'enumerate via sysfs, not a chip-pinning client' rule.)
+        """
+        out: dict[int, bool] = {}
+        for spec in self.enumerate_chips():
+            path = spec.paths[0]
+            out[spec.index] = os.path.exists(path) and os.access(path, os.R_OK)
+        return out

@@ -1,0 +1,86 @@
+"""Daemon entry point.
+
+Reference: main.go — flag/config parse (31-52), logger init (55-60),
+readiness channel (63-71), PluginManager (74) + web server (77) wired into an
+oklog/run group with a signal handler (79-138), optional profiling harness
+(141-154). Here the run group is an asyncio gather; SIGINT/SIGTERM set the
+shared stop event; the HTTP server starts only after the manager signals
+readiness (≙ main.go:128), which the Server itself awaits.
+
+Run:  python -m k8s_gpu_device_plugin_tpu.main --configFile config
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import sys
+
+from k8s_gpu_device_plugin_tpu.benchmark.profiler import Profiler
+from k8s_gpu_device_plugin_tpu.config import Config, load_config
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.server.server import Server
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+from k8s_gpu_device_plugin_tpu.utils.log import LogConfig, init_logger
+
+
+async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> None:
+    """Run manager + HTTP server until the stop event fires."""
+    logger = init_logger(
+        LogConfig(level=cfg.log.level, file_dir=cfg.log.file_dir or None)
+    )
+    stop = stop_event or asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for signum in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(signum, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread or non-unix: tests drive stop directly
+
+    profiler: Profiler | None = None
+    if cfg.benchmark:  # ≙ main.go:141-154
+        profiler = Profiler(logger)
+        profiler.run()
+
+    ready = Latch()
+    manager = PluginManager(cfg, ready, logger=logger)
+    server = Server(cfg, manager, ready, logger=logger)
+
+    manager_task = asyncio.create_task(manager.start(), name="plugin-manager")
+    server_task = asyncio.create_task(server.run(stop), name="http-server")
+    logger.info(
+        "daemon starting",
+        extra={"fields": {"strategy": cfg.slice_strategy,
+                          "backend": manager.backend.name}},
+    )
+    stop_task = asyncio.create_task(stop.wait(), name="stop-wait")
+    try:
+        # ≙ the oklog/run group (main.go:79-138): the first actor to fail
+        # takes the whole daemon down; a clean stop shuts everything down.
+        done, _ = await asyncio.wait(
+            {stop_task, manager_task, server_task},
+            return_when=asyncio.FIRST_COMPLETED,
+        )
+        for task in done:
+            if task is not stop_task and task.exception() is not None:
+                raise task.exception()
+    finally:
+        stop.set()
+        stop_task.cancel()
+        await manager.stop()
+        await asyncio.gather(
+            manager_task, server_task, stop_task, return_exceptions=True
+        )
+        if profiler is not None:
+            profiler.stop()
+        logger.info("daemon stopped")
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = load_config(argv if argv is not None else sys.argv[1:])
+    asyncio.run(run_daemon(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

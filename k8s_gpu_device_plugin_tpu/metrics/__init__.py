@@ -1,0 +1,15 @@
+"""Prometheus metrics: HTTP middleware + per-chip device gauges.
+
+The reference's ``metrics/`` package was an empty placeholder
+(metrics/metrics.go:1 — one line) and its README's driver-monitoring promise
+had no implementation; the only real metrics were the HTTP
+counter/histogram middleware (middleware/echo_metric.go:80-93). This package
+provides both: the HTTP middleware contract (same buckets, same label set)
+and the device metrics the reference never shipped (HBM, duty cycle,
+tensorcore utilization per chip — what DCGM would have fed there).
+"""
+
+from k8s_gpu_device_plugin_tpu.metrics.device_metrics import DeviceMetrics
+from k8s_gpu_device_plugin_tpu.metrics.http_metrics import HttpMetrics
+
+__all__ = ["DeviceMetrics", "HttpMetrics"]

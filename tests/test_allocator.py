@@ -1,0 +1,128 @@
+"""Allocator unit tests over simulated topologies (≙ plugin.go:248-326 logic).
+
+≙ SURVEY §4 "multi-node without a cluster": sub-slice selection is pure logic
+over a topology description, so it is tested here with zero hardware.
+"""
+
+from k8s_gpu_device_plugin_tpu.device.chip import AnnotatedID, Chips
+from k8s_gpu_device_plugin_tpu.device.chip_map import new_chip_map
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.plugin.allocator import (
+    aligned_alloc,
+    distributed_alloc,
+    preferred_allocation,
+)
+from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
+
+
+def build(topology: str, shared_replicas: int = 0):
+    backend = FakeBackend(topology)
+    cm = new_chip_map(
+        backend, discover_resources("none"), "none", shared_replicas=shared_replicas
+    )
+    return backend.host_topology(), cm["google.com/tpu"]
+
+
+def coords_of(chips: Chips, ids):
+    return sorted(chips[i].coords[0] for i in ids)
+
+
+def test_aligned_prefers_submesh_over_scattered():
+    topo, chips = build("v5e-8")  # 2x4
+    ids = preferred_allocation(chips, chips.ids(), [], 4, topo)
+    coords = coords_of(chips, ids)
+    # must be a contiguous 2x2 (or 1x4/2x2-shaped) sub-mesh: 4 internal edges
+    xs = {c[0] for c in coords}
+    ys = {c[1] for c in coords}
+    assert len(xs) * len(ys) == 4
+    assert max(ys) - min(ys) == len(ys) - 1
+
+
+def test_aligned_respects_must_include():
+    topo, chips = build("v5e-8")
+    corner = chips.get_by_index(3)  # coord (0, 3)
+    ids = preferred_allocation(chips, chips.ids(), [corner.id], 2, topo)
+    assert corner.id in ids
+    coords = coords_of(chips, ids)
+    # partner must be an ICI neighbor of (0,3)
+    other = [c for c in coords if c != (0, 3)][0]
+    assert other in topo.neighbors((0, 3))
+
+
+def test_aligned_with_partial_availability_falls_back_greedy():
+    topo, chips = build("v5e-8")
+    # remove chips so no full 2x2 sub-mesh of 4 is available: keep a ragged L
+    keep = [
+        c.id
+        for c in chips.values()
+        if c.coords[0] in [(0, 0), (0, 1), (1, 1), (1, 2), (0, 3)]
+    ]
+    ids = preferred_allocation(chips, keep, [], 4, topo)
+    assert len(ids) == 4
+    assert set(ids) <= set(keep)
+    # greedy should pick the connected L-cluster, not the isolated (0,3)
+    coords = coords_of(chips, ids)
+    assert (0, 3) not in coords
+
+
+def test_aligned_size_exceeding_available_clamps():
+    topo, chips = build("v5e-4")
+    ids = preferred_allocation(chips, chips.ids()[:2], [], 99, topo)
+    assert len(ids) == 2
+
+
+def test_aligned_3d_topology():
+    topo, chips = build("v5p-8")  # 2x2x2
+    ids = preferred_allocation(chips, chips.ids(), [], 4, topo)
+    coords = coords_of(chips, ids)
+    # 4 chips in a 2x2x1-shaped plane: bounding box volume 4
+    vol = 1
+    for axis in range(3):
+        vals = [c[axis] for c in coords]
+        vol *= max(vals) - min(vals) + 1
+    assert vol == 4
+
+
+def test_distributed_spreads_over_physical_chips():
+    _, chips = build("v5e-4", shared_replicas=2)  # 8 annotated over 4 chips
+    ids = preferred_allocation(chips, chips.ids(), [], 4, None)
+    physical = {AnnotatedID.parse(i).device_id for i in ids}
+    assert len(physical) == 4  # one replica from each chip, not two from two
+
+
+def test_distributed_prefers_least_loaded():
+    _, chips = build("v5e-4", shared_replicas=2)
+    # one of chip 0's two replicas is already taken (unavailable)
+    phys0 = chips.physical_ids()[0]
+    available = [
+        i
+        for i in chips.ids()
+        if AnnotatedID.parse(i).device_id != phys0 or i.endswith("::0")
+    ]
+    ids = distributed_alloc(chips, available, [], 3)
+    # least-loaded chips (full availability) picked before the loaded one
+    picked_phys = [AnnotatedID.parse(i).device_id for i in ids]
+    assert phys0 not in picked_phys
+
+
+def test_distributed_must_include_first():
+    _, chips = build("v5e-4", shared_replicas=2)
+    target = chips.ids()[5]
+    ids = distributed_alloc(chips, chips.ids(), [target], 2)
+    assert target in ids
+
+
+def test_empty_and_zero_size():
+    topo, chips = build("v5e-4")
+    assert preferred_allocation(chips, chips.ids(), [], 0, topo) == []
+    assert preferred_allocation(chips, [], [], 2, topo) == []
+
+
+def test_aligned_alloc_numa_tiebreak():
+    topo, chips = build("v5e-8")
+    # size 2: many 1x2/2x1 placements tie on edges; NUMA concentration and
+    # low indices break the tie deterministically
+    a = aligned_alloc(chips, chips.ids(), [], 2, topo)
+    b = aligned_alloc(chips, chips.ids(), [], 2, topo)
+    assert a == b
+    assert len({chips[i].numa_node for i in a}) == 1

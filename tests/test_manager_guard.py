@@ -1,0 +1,63 @@
+"""Crash-loop guard & fatality propagation (≙ plugin.go:111-127 semantics).
+
+The reference kept the 5-per-hour restart budget per plugin instance (reset
+on every rebuild) and its "give up" was log.Fatal. Here the budget lives in
+the manager, keyed by resource, and exhaustion raises out of ``start()`` so
+the main.py run group terminates the daemon.
+"""
+
+import asyncio
+import tempfile
+
+import pytest
+
+import k8s_gpu_device_plugin_tpu.plugin.plugin as plugin_mod
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.main import run_daemon
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+
+def test_crash_loop_budget_is_fatal(monkeypatch, tmp_path):
+    """No kubelet + fast retries -> budget exhausted -> RuntimeError."""
+    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.2)
+
+    async def body():
+        cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
+        manager = PluginManager(
+            cfg,
+            Latch(),
+            backend=FakeBackend("v5e-4"),
+            health_interval=30,
+            retry_interval=0.1,
+        )
+        with pytest.raises(RuntimeError, match="crash-looped"):
+            await asyncio.wait_for(manager.start(), timeout=30)
+
+    asyncio.run(body())
+
+
+def test_run_daemon_exits_on_manager_failure(monkeypatch, tmp_path):
+    """A manager that can never start must take run_daemon down, not hang.
+
+    (Review finding: the reference's oklog run group exits when any actor
+    fails; the first draft of run_daemon awaited stop.wait() forever.)
+    """
+    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.2)
+    import k8s_gpu_device_plugin_tpu.plugin.manager as manager_mod
+
+    monkeypatch.setattr(manager_mod, "RETRY_INTERVAL_SECONDS", 0.1)
+
+    async def body():
+        cfg = Config(
+            kubelet_socket_dir=str(tmp_path),
+            web_listen_address="127.0.0.1:0",
+            libtpu_path="",
+            backend="fake",
+        )
+        cfg.log.file_dir = ""
+        with pytest.raises(RuntimeError, match="crash-looped"):
+            await asyncio.wait_for(run_daemon(cfg), timeout=30)
+
+    asyncio.run(body())

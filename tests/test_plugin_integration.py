@@ -1,0 +1,232 @@
+"""End-to-end control-plane tests against a fake kubelet (BASELINE config #1).
+
+≙ SURVEY §4 integration strategy: an in-process gRPC kubelet drives the full
+register/ListAndWatch/GetPreferredAllocation/Allocate handshake against a
+plugin manager backed by a fake chip backend — every layer, zero accelerators.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.plugin import PluginManager, api
+from k8s_gpu_device_plugin_tpu.plugin.api import pb
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+from fake_kubelet import FakeKubelet
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def start_stack(tmp_path, topology="v5e-4", **cfg_kwargs):
+    """Boot fake kubelet + manager; returns (kubelet, manager, task, backend)."""
+    kubelet = FakeKubelet(str(tmp_path))
+    await kubelet.start()
+    cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="", **cfg_kwargs)
+    backend = FakeBackend(topology)
+    ready = Latch()
+    manager = PluginManager(cfg, ready, backend=backend, health_interval=0.1)
+    task = asyncio.create_task(manager.start())
+    await asyncio.wait_for(ready.wait_async(), 10)
+    return kubelet, manager, task, backend
+
+
+async def stop_stack(kubelet, manager, task):
+    await manager.stop()
+    await asyncio.wait_for(task, 10)
+    await kubelet.stop()
+
+
+def test_register_and_list_and_watch(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            assert reg.resource_name == "google.com/tpu"
+            assert reg.version == api.VERSION
+            assert reg.options.get_preferred_allocation_available
+
+            async with kubelet.plugin_channel(reg.endpoint) as channel:
+                stub = api.DevicePluginStub(channel)
+                stream = stub.ListAndWatch(pb.Empty())
+                first = await asyncio.wait_for(stream.read(), 5)
+                assert len(first.devices) == 4
+                assert all(d.health == api.HEALTHY for d in first.devices)
+                assert all(d.topology.nodes for d in first.devices)
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_allocate_wires_devices_and_envs(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            chips = manager.plugins[0].chips
+            ids = chips.ids()[:2]
+
+            async with kubelet.plugin_channel(reg.endpoint) as channel:
+                stub = api.DevicePluginStub(channel)
+                resp = await stub.Allocate(
+                    pb.AllocateRequest(
+                        container_requests=[
+                            pb.ContainerAllocateRequest(devicesIDs=ids)
+                        ]
+                    )
+                )
+                (cresp,) = resp.container_responses
+                envs = dict(cresp.envs)
+                assert envs["TPU_VISIBLE_CHIPS"]
+                assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"]
+                assert envs["TPU_ACCELERATOR_TYPE"].startswith("v5e-")
+                assert envs["TPU_SKIP_MDS_QUERY"] == "true"
+                assert len(cresp.devices) == 2
+                for spec in cresp.devices:
+                    assert spec.host_path.startswith("/dev/accel")
+                    assert spec.permissions == "rw"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_allocate_unknown_id_rejected(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            async with kubelet.plugin_channel(reg.endpoint) as channel:
+                stub = api.DevicePluginStub(channel)
+                with pytest.raises(grpc.aio.AioRpcError) as err:
+                    await stub.Allocate(
+                        pb.AllocateRequest(
+                            container_requests=[
+                                pb.ContainerAllocateRequest(devicesIDs=["nope"])
+                            ]
+                        )
+                    )
+                assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+                assert "nope" in err.value.details()
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_preferred_allocation_is_ici_contiguous(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path, topology="v5e-8")
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            chips = manager.plugins[0].chips
+            async with kubelet.plugin_channel(reg.endpoint) as channel:
+                stub = api.DevicePluginStub(channel)
+                resp = await stub.GetPreferredAllocation(
+                    pb.PreferredAllocationRequest(
+                        container_requests=[
+                            pb.ContainerPreferredAllocationRequest(
+                                available_deviceIDs=chips.ids(),
+                                allocation_size=4,
+                            )
+                        ]
+                    )
+                )
+                ids = list(resp.container_responses[0].deviceIDs)
+                assert len(ids) == 4
+                coords = sorted(chips[i].coords[0] for i in ids)
+                # a 2x2 sub-mesh of the 2x4 host
+                xs = {c[0] for c in coords}
+                ys = {c[1] for c in coords}
+                assert len(xs) == 2 and len(ys) == 2
+                assert max(ys) - min(ys) == 1
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_health_transition_pushes_update(tmp_path):
+    async def body():
+        kubelet, manager, task, backend = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            async with kubelet.plugin_channel(reg.endpoint) as channel:
+                stub = api.DevicePluginStub(channel)
+                stream = stub.ListAndWatch(pb.Empty())
+                first = await asyncio.wait_for(stream.read(), 5)
+                assert all(d.health == api.HEALTHY for d in first.devices)
+
+                backend.set_unhealthy(0)
+                second = await asyncio.wait_for(stream.read(), 5)
+                unhealthy = [d for d in second.devices if d.health == api.UNHEALTHY]
+                assert len(unhealthy) == 1
+
+                backend.set_healthy(0)
+                third = await asyncio.wait_for(stream.read(), 5)
+                assert all(d.health == api.HEALTHY for d in third.devices)
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_kubelet_restart_triggers_reregistration(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            # Simulate kubelet restart: close + re-create kubelet.sock.
+            await kubelet.stop()
+            await kubelet.start()
+            await kubelet.wait_for_registrations(2)
+            assert kubelet.registrations[-1].resource_name == "google.com/tpu"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_manual_restart_reregisters(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(tmp_path)
+        try:
+            await kubelet.wait_for_registrations(1)
+            manager.restart()  # HTTP /restart path (router/api.go:50-54)
+            await kubelet.wait_for_registrations(2)
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_mixed_strategy_registers_per_profile(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5e-8",
+            slice_strategy="mixed",
+            slice_plan="2x2,1x2,1x2",
+        )
+        try:
+            await kubelet.wait_for_registrations(2)
+            names = {r.resource_name for r in kubelet.registrations}
+            assert names == {
+                "google.com/tpu-slice-2x2",
+                "google.com/tpu-slice-1x2",
+            }
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())

@@ -22,7 +22,7 @@ def test_explicit_prefix_preserved():
 
 
 def test_name_split():
-    prefix, base = ResourceName("google.com/tpu").split()
+    prefix, base = ResourceName("google.com/tpu").split_name()
     assert (prefix, base) == ("google.com", "tpu")
 
 

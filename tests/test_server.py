@@ -1,0 +1,138 @@
+"""HTTP control-plane tests (≙ server/, router/, middleware/ behavior)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from prometheus_client import CollectorRegistry
+
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.metrics.http_metrics import normalize_status
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.server.server import Server
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def start_http_stack(tmp_path, **cfg_kwargs):
+    cfg = Config(
+        kubelet_socket_dir=str(tmp_path),
+        web_listen_address="127.0.0.1:0",
+        libtpu_path="",
+        **cfg_kwargs,
+    )
+    ready = Latch()
+    manager = PluginManager(
+        cfg, ready, backend=FakeBackend("v5e-4"), health_interval=0.1
+    )
+    registry = CollectorRegistry()
+    server = Server(cfg, manager, ready, registry=registry)
+    stop = asyncio.Event()
+    mtask = asyncio.create_task(manager.start())
+    stask = asyncio.create_task(server.run(stop))
+    for _ in range(100):
+        if server.port:
+            break
+        await asyncio.sleep(0.05)
+    assert server.port, "server did not bind"
+    base = f"http://127.0.0.1:{server.port}"
+
+    async def teardown():
+        stop.set()
+        await manager.stop()
+        await asyncio.gather(mtask, stask, return_exceptions=True)
+
+    return base, manager, teardown
+
+
+def test_routes_and_envelope(tmp_path):
+    async def body():
+        base, _, teardown = await start_http_stack(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/") as resp:
+                    data = await resp.json()
+                    assert resp.status == 200
+                    assert data["code"] == 200
+                    assert "version" in data["data"]
+
+                async with session.get(f"{base}/health") as resp:
+                    data = await resp.json()
+                    assert data == {"code": 200, "data": "ok", "msg": "success"}
+
+                async with session.get(f"{base}/nope") as resp:
+                    assert resp.status == 404
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_metrics_exposition(tmp_path):
+    async def body():
+        base, _, teardown = await start_http_stack(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                await session.get(f"{base}/health")
+                await session.get(f"{base}/bogus")
+                async with session.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+                assert resp.status == 200
+                # HTTP middleware metrics (reference echo_http_* contract)
+                assert 'tpu_plugin_http_requests_total{' in text
+                assert 'handler="/health"' in text
+                assert 'handler="/not-found"' in text  # 404 collapse
+                assert "tpu_plugin_http_request_duration_seconds_bucket" in text
+                # device metrics the reference left unimplemented
+                assert 'tpu_plugin_chips{resource="google.com/tpu",state="healthy"} 4.0' in text
+                assert "tpu_plugin_chip_hbm_total_bytes" in text
+                assert "tpu_plugin_build_info" in text
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_restart_endpoint_reloads_plugins(tmp_path):
+    async def body():
+        base, manager, teardown = await start_http_stack(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/restart") as resp:
+                    data = await resp.json()
+                    assert data["code"] == 200
+            # restart event consumed by manager loop
+            await asyncio.sleep(0.5)
+            assert not manager._restart_event.is_set()
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_cors_headers(tmp_path):
+    async def body():
+        base, _, teardown = await start_http_stack(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.options(f"{base}/health") as resp:
+                    assert resp.status == 204
+                    assert resp.headers["Access-Control-Allow-Origin"] == "*"
+                async with session.get(f"{base}/health") as resp:
+                    assert resp.headers["Access-Control-Allow-Origin"] == "*"
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_normalize_status():
+    assert normalize_status(200) == "2xx"
+    assert normalize_status(404) == "4xx"
+    assert normalize_status(503) == "5xx"
+    assert normalize_status(700) == "700"
