@@ -1,0 +1,26 @@
+# Top-level build: native core, protobuf codegen, tests, bench.
+
+NATIVE_DIR := k8s_gpu_device_plugin_tpu/native
+API_DIR := k8s_gpu_device_plugin_tpu/plugin/api
+
+all: native proto
+
+native:
+	$(MAKE) -C $(NATIVE_DIR)
+
+native-test:
+	$(MAKE) -C $(NATIVE_DIR) test
+
+proto:
+	protoc --python_out=$(API_DIR) --proto_path=$(API_DIR) deviceplugin.proto
+
+test: native-test
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C $(NATIVE_DIR) clean
+
+.PHONY: all native native-test proto test bench clean

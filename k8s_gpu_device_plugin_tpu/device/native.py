@@ -55,19 +55,63 @@ def _load_library() -> ctypes.CDLL | None:
                     lib = ctypes.CDLL(path)
                 except OSError:
                     continue
-                lib.tpuenum_chip_count.restype = ctypes.c_int32
-                lib.tpuenum_enumerate.restype = ctypes.c_int32
-                lib.tpuenum_enumerate.argtypes = [
-                    ctypes.POINTER(_CChipInfo),
-                    ctypes.c_int32,
-                ]
-                lib.tpuenum_generation.restype = ctypes.c_int32
-                lib.tpuenum_generation.argtypes = [
-                    ctypes.c_char_p,
-                    ctypes.c_int32,
-                ]
-                return lib
+                try:
+                    return _declare_signatures(lib)
+                except AttributeError:
+                    # stale library missing a symbol: not usable, keep looking
+                    continue
     return None
+
+
+def _declare_signatures(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.tpuenum_chip_count.restype = ctypes.c_int32
+    lib.tpuenum_enumerate.restype = ctypes.c_int32
+    lib.tpuenum_enumerate.argtypes = [
+        ctypes.POINTER(_CChipInfo),
+        ctypes.c_int32,
+    ]
+    lib.tpuenum_generation.restype = ctypes.c_int32
+    lib.tpuenum_generation.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int32,
+    ]
+    lib.tpuenum_internal_edges.restype = ctypes.c_int32
+    lib.tpuenum_internal_edges.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    return lib
+
+
+_edges_lib: ctypes.CDLL | None = None
+_edges_lib_loaded = False
+
+
+def native_internal_edges(
+    coords: list[tuple[int, ...]], bounds: tuple[int, ...]
+) -> int | None:
+    """ICI edges internal to ``coords`` via the C++ core, or None if the
+    library is unavailable (callers fall back to the Python scorer).
+
+    No wraparound: only valid for mesh (non-torus) bounds, matching the C
+    implementation.
+    """
+    global _edges_lib, _edges_lib_loaded
+    if not _edges_lib_loaded:
+        _edges_lib = _load_library()
+        _edges_lib_loaded = True
+    if _edges_lib is None or not coords:
+        return 0 if not coords and _edges_lib is not None else None
+    dims = len(bounds)
+    flat = [c for coord in coords for c in coord]
+    c_coords = (ctypes.c_int32 * len(flat))(*flat)
+    c_bounds = (ctypes.c_int32 * dims)(*bounds)
+    result = _edges_lib.tpuenum_internal_edges(
+        c_coords, len(coords), c_bounds, dims
+    )
+    return None if result < 0 else int(result)
 
 
 class NativeBackend:
@@ -142,8 +186,14 @@ class NativeBackend:
         node presence + readability is the non-intrusive signal, matching the
         'enumerate via sysfs, not a chip-pinning client' rule.)
         """
+        root = os.environ.get("TPUENUM_ROOT", "")
+        specs = {s.index: s for s in self.enumerate_chips()}
         out: dict[int, bool] = {}
-        for spec in self.enumerate_chips():
-            path = spec.paths[0]
-            out[spec.index] = os.path.exists(path) and os.access(path, os.R_OK)
+        for i in range(self.host_topology().num_chips):
+            spec = specs.get(i)
+            if spec is None:  # expected by topology, gone from enumeration
+                out[i] = False
+                continue
+            path = root + spec.paths[0]
+            out[i] = os.path.exists(path) and os.access(path, os.R_OK)
         return out

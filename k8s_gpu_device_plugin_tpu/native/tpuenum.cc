@@ -1,0 +1,234 @@
+// TPU chip enumeration & ICI topology core — implementation.
+//
+// Enumerates TPU chips from device nodes and sysfs without touching the TPU
+// runtime (no PjRt client, no libtpu load — the daemon must never hold the
+// single-client runtime lock workload pods need).
+//
+// Sources scanned, in order:
+//   1. $TPUENUM_ROOT/dev/accel<N>          (TPU v4+ "accel"/gasket driver)
+//   2. $TPUENUM_ROOT/dev/vfio/<N>          (VFIO-attached chips, v5e pods)
+// Per-chip metadata from sysfs:
+//   /sys/class/accel/accel<N>/device/numa_node
+//   /sys/class/accel/accel<N>/device/device   (PCI device id -> generation)
+// Stable UUIDs are derived from /etc/machine-id + chip index (FNV-1a), the
+// same role NVML UUIDs played for the reference (device/device.go:37-43).
+
+#include "tpuenum.h"
+
+#include <dirent.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <algorithm>
+#include <fstream>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string Root() {
+  const char* root = getenv("TPUENUM_ROOT");
+  return root ? std::string(root) : std::string();
+}
+
+// PCI device ids of Google TPU generations (vendor 0x1ae0), as exposed by
+// the accel driver. Best-effort public table; unknown ids yield "".
+struct GenEntry {
+  uint32_t device_id;
+  const char* name;
+};
+constexpr GenEntry kGenerations[] = {
+    {0x0027, "v2"}, {0x0037, "v3"}, {0x005e, "v4"},
+    {0x0062, "v5p"}, {0x0063, "v5e"}, {0x006f, "v6e"},
+};
+
+std::string ReadTrimmed(const std::string& path) {
+  std::ifstream f(path);
+  if (!f.good()) return "";
+  std::string s;
+  std::getline(f, s);
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' || s.back() == ' '))
+    s.pop_back();
+  return s;
+}
+
+bool DirEntries(const std::string& dir, std::vector<std::string>* out) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return false;
+  while (dirent* e = readdir(d)) {
+    const std::string name = e->d_name;
+    if (name != "." && name != "..") out->push_back(name);
+  }
+  closedir(d);
+  return true;
+}
+
+struct RawChip {
+  int index;
+  std::string path;        // absolute device node path (without root prefix)
+  std::string sysfs_base;  // sysfs dir for this chip ("" if none)
+};
+
+// Numeric suffix of `name` after `prefix`, or -1.
+int NumSuffix(const std::string& name, const std::string& prefix) {
+  if (name.rfind(prefix, 0) != 0 || name.size() == prefix.size()) return -1;
+  const std::string digits = name.substr(prefix.size());
+  if (!std::all_of(digits.begin(), digits.end(),
+                   [](char c) { return c >= '0' && c <= '9'; }))
+    return -1;
+  return atoi(digits.c_str());
+}
+
+std::vector<RawChip> ScanChips() {
+  const std::string root = Root();
+  std::vector<RawChip> chips;
+  std::set<int> seen;
+
+  // 1) accel driver nodes.
+  std::vector<std::string> names;
+  if (DirEntries(root + "/dev", &names)) {
+    for (const auto& name : names) {
+      const int idx = NumSuffix(name, "accel");
+      if (idx < 0 || seen.count(idx)) continue;
+      seen.insert(idx);
+      RawChip chip;
+      chip.index = idx;
+      chip.path = "/dev/" + name;
+      chip.sysfs_base = root + "/sys/class/accel/" + name + "/device";
+      chips.push_back(chip);
+    }
+  }
+
+  // 2) VFIO nodes (numeric entries under /dev/vfio, excluding the control
+  //    node "vfio"). Only used when no accel nodes exist — a host exposes
+  //    chips through one driver.
+  if (chips.empty()) {
+    names.clear();
+    if (DirEntries(root + "/dev/vfio", &names)) {
+      std::vector<int> groups;
+      for (const auto& name : names) {
+        const int idx = NumSuffix(name, "");
+        if (idx >= 0) groups.push_back(idx);
+      }
+      std::sort(groups.begin(), groups.end());
+      int logical = 0;
+      for (int group : groups) {
+        RawChip chip;
+        chip.index = logical++;
+        chip.path = "/dev/vfio/" + std::to_string(group);
+        chips.push_back(chip);
+      }
+    }
+  }
+
+  std::sort(chips.begin(), chips.end(),
+            [](const RawChip& a, const RawChip& b) { return a.index < b.index; });
+  return chips;
+}
+
+std::string DetectGeneration(const std::vector<RawChip>& chips) {
+  for (const auto& chip : chips) {
+    if (chip.sysfs_base.empty()) continue;
+    const std::string id_s = ReadTrimmed(chip.sysfs_base + "/device");
+    if (id_s.empty()) continue;
+    const uint32_t id = strtoul(id_s.c_str(), nullptr, 16);
+    for (const auto& gen : kGenerations) {
+      if (gen.device_id == id) return gen.name;
+    }
+  }
+  // Fallback: the TPU VM environment often states the type directly.
+  const char* accel_type = getenv("TPU_ACCELERATOR_TYPE");
+  if (accel_type != nullptr) {
+    const std::string s(accel_type);
+    const size_t dash = s.find('-');
+    return dash == std::string::npos ? s : s.substr(0, dash);
+  }
+  return "";
+}
+
+// FNV-1a 64-bit over machine-id + index for stable, distinct UUIDs.
+uint64_t Fnv1a(const std::string& data) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : data) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+void FillUuid(char* out, size_t out_len, const std::string& machine_id, int index) {
+  const uint64_t h = Fnv1a(machine_id + "/" + std::to_string(index));
+  snprintf(out, out_len, "TPU-%08x-%04x-%04x-%04x-%08x%04x",
+           static_cast<uint32_t>(h >> 32),
+           static_cast<uint32_t>((h >> 16) & 0xffff),
+           static_cast<uint32_t>(h & 0xffff),
+           static_cast<uint32_t>((h >> 48) & 0xffff), static_cast<uint32_t>(h),
+           static_cast<uint32_t>(index & 0xffff));
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t tpuenum_chip_count(void) {
+  return static_cast<int32_t>(ScanChips().size());
+}
+
+int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max) {
+  if (out == nullptr || max < 0) return -1;
+  const std::string root = Root();
+  const std::vector<RawChip> chips = ScanChips();
+  const std::string gen = DetectGeneration(chips);
+  std::string machine_id = ReadTrimmed(root + "/etc/machine-id");
+  if (machine_id.empty()) machine_id = "tpuhost";
+
+  const int32_t n = std::min<int32_t>(max, static_cast<int32_t>(chips.size()));
+  for (int32_t i = 0; i < n; ++i) {
+    const RawChip& chip = chips[i];
+    TpuChipInfo* info = &out[i];
+    memset(info, 0, sizeof(*info));
+    info->index = chip.index;
+    info->numa_node = -1;
+    info->hbm_bytes = 0;
+    if (!chip.sysfs_base.empty()) {
+      const std::string numa = ReadTrimmed(chip.sysfs_base + "/numa_node");
+      if (!numa.empty()) info->numa_node = atoi(numa.c_str());
+    }
+    snprintf(info->path, sizeof(info->path), "%s", chip.path.c_str());
+    snprintf(info->generation, sizeof(info->generation), "%s", gen.c_str());
+    FillUuid(info->uuid, sizeof(info->uuid), machine_id, chip.index);
+  }
+  return n;
+}
+
+int32_t tpuenum_generation(char* out, int32_t max) {
+  if (out == nullptr || max <= 0) return 0;
+  const std::string gen = DetectGeneration(ScanChips());
+  snprintf(out, static_cast<size_t>(max), "%s", gen.c_str());
+  return static_cast<int32_t>(strlen(out));
+}
+
+int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,
+                               const int32_t* bounds, int32_t dims) {
+  if (coords == nullptr || bounds == nullptr || n < 0 || dims <= 0 || dims > 3)
+    return -1;
+  std::set<std::vector<int32_t>> cells;
+  for (int32_t i = 0; i < n; ++i) {
+    cells.insert(std::vector<int32_t>(coords + i * dims, coords + (i + 1) * dims));
+  }
+  int32_t edges = 0;
+  for (const auto& cell : cells) {
+    for (int32_t axis = 0; axis < dims; ++axis) {
+      std::vector<int32_t> neighbor = cell;
+      neighbor[axis] += 1;  // count each edge once (positive direction)
+      if (neighbor[axis] >= bounds[axis]) continue;
+      if (cells.count(neighbor)) ++edges;
+    }
+  }
+  return edges;
+}
+
+}  // extern "C"

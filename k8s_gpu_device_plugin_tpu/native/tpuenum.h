@@ -1,0 +1,56 @@
+// TPU chip enumeration & ICI topology core — C ABI.
+//
+// Native replacement for the reference's cgo surface (go-nvml device
+// handles, go-nvlib traversal, go-gpuallocator topology scoring; see
+// SURVEY.md §2 native table). Consumed from Python via ctypes
+// (k8s_gpu_device_plugin_tpu/device/native.py).
+//
+// Design constraint (SURVEY §7 hard part #1): libtpu is single-client —
+// enumeration must NOT create a PjRt client or otherwise take the TPU
+// runtime lock. Everything here reads device nodes and sysfs only.
+//
+// Testability: all filesystem access is rooted at $TPUENUM_ROOT (default
+// ""), so tests point the library at a synthetic /dev + /sys tree.
+
+#ifndef TPUENUM_H_
+#define TPUENUM_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct TpuChipInfo {
+  int32_t index;
+  int32_t numa_node;      // -1 if unknown
+  int32_t coord[3];       // ICI mesh coordinate; all-zero if driver-unknown
+  int64_t hbm_bytes;      // 0 if unknown (caller fills from generation table)
+  char uuid[64];          // stable id: machine-id + chip index
+  char path[64];          // /dev/accel<N> or /dev/vfio/<N>
+  char generation[16];    // "v4"/"v5e"/"v5p"/"v6e" or "" if unknown
+} TpuChipInfo;
+
+// Number of TPU chips visible on this host (accel + vfio device nodes).
+int32_t tpuenum_chip_count(void);
+
+// Fill up to `max` entries; returns number written, or -1 on error.
+int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max);
+
+// Host TPU generation name into `out` (NUL-terminated, truncated to `max`).
+// Returns length written, 0 if unknown.
+int32_t tpuenum_generation(char* out, int32_t max);
+
+// ICI edges internal to the chip set `coords` (len = n*dims, row-major)
+// within a mesh of shape `bounds` (len = dims). Neighbors differ by 1 on one
+// axis (no wraparound). Returns edge count, or -1 on bad arguments.
+// This is the scoring kernel behind aligned allocation (the go-gpuallocator
+// analogue); Python falls back to its own implementation if absent.
+int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,
+                               const int32_t* bounds, int32_t dims);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // TPUENUM_H_

@@ -1,0 +1,100 @@
+// C++ unit test for the enumeration core, run against a synthetic
+// $TPUENUM_ROOT tree (no hardware). `make test`.
+
+#include "tpuenum.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+
+#include <fstream>
+#include <string>
+
+static int failures = 0;
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+
+static void WriteFile(const std::string& path, const std::string& content) {
+  std::ofstream f(path);
+  f << content;
+}
+
+static std::string MakeFakeHost(int chips) {
+  char tmpl[] = "/tmp/tpuenum_test_XXXXXX";
+  std::string root = mkdtemp(tmpl);
+  mkdir((root + "/dev").c_str(), 0755);
+  mkdir((root + "/sys").c_str(), 0755);
+  mkdir((root + "/sys/class").c_str(), 0755);
+  mkdir((root + "/sys/class/accel").c_str(), 0755);
+  mkdir((root + "/etc").c_str(), 0755);
+  WriteFile(root + "/etc/machine-id", "deadbeefcafe\n");
+  for (int i = 0; i < chips; ++i) {
+    WriteFile(root + "/dev/accel" + std::to_string(i), "");
+    const std::string base =
+        root + "/sys/class/accel/accel" + std::to_string(i);
+    mkdir(base.c_str(), 0755);
+    mkdir((base + "/device").c_str(), 0755);
+    WriteFile(base + "/device/numa_node", i < chips / 2 ? "0\n" : "1\n");
+    WriteFile(base + "/device/device", "0x0062\n");  // v5p
+  }
+  return root;
+}
+
+int main() {
+  const std::string root = MakeFakeHost(4);
+  setenv("TPUENUM_ROOT", root.c_str(), 1);
+
+  CHECK(tpuenum_chip_count() == 4);
+
+  TpuChipInfo infos[8];
+  const int n = tpuenum_enumerate(infos, 8);
+  CHECK(n == 4);
+  for (int i = 0; i < n; ++i) {
+    CHECK(infos[i].index == i);
+    CHECK(strncmp(infos[i].path, "/dev/accel", 10) == 0);
+    CHECK(strncmp(infos[i].uuid, "TPU-", 4) == 0);
+    CHECK(strcmp(infos[i].generation, "v5p") == 0);
+    CHECK(infos[i].numa_node == (i < 2 ? 0 : 1));
+  }
+  // UUIDs distinct & stable
+  CHECK(strcmp(infos[0].uuid, infos[1].uuid) != 0);
+  TpuChipInfo again[8];
+  tpuenum_enumerate(again, 8);
+  CHECK(strcmp(infos[0].uuid, again[0].uuid) == 0);
+
+  char gen[16];
+  CHECK(tpuenum_generation(gen, sizeof(gen)) == 3);
+  CHECK(strcmp(gen, "v5p") == 0);
+
+  // Empty root (no devices)
+  setenv("TPUENUM_ROOT", "/nonexistent_tpuenum", 1);
+  CHECK(tpuenum_chip_count() == 0);
+  setenv("TPUENUM_ROOT", root.c_str(), 1);
+
+  // internal_edges: a 2x2 block in a 2x4 mesh has 4 edges
+  const int32_t coords[] = {0, 0, 0, 1, 1, 0, 1, 1};
+  const int32_t bounds[] = {2, 4};
+  CHECK(tpuenum_internal_edges(coords, 4, bounds, 2) == 4);
+  // a 1x4 row has 3 edges
+  const int32_t row[] = {0, 0, 0, 1, 0, 2, 0, 3};
+  CHECK(tpuenum_internal_edges(row, 4, bounds, 2) == 3);
+  // scattered corners: 0 edges
+  const int32_t corners[] = {0, 0, 1, 3};
+  CHECK(tpuenum_internal_edges(corners, 2, bounds, 2) == 0);
+  // bad args
+  CHECK(tpuenum_internal_edges(nullptr, 1, bounds, 2) == -1);
+  CHECK(tpuenum_internal_edges(coords, 4, bounds, 9) == -1);
+
+  if (failures == 0) {
+    printf("tpuenum_test: all checks passed\n");
+    return 0;
+  }
+  fprintf(stderr, "tpuenum_test: %d failures\n", failures);
+  return 1;
+}

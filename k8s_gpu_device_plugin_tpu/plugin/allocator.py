@@ -61,6 +61,15 @@ def preferred_allocation(
 
 
 def _edges_within(coords: set[tuple[int, ...]], topo: HostTopology) -> int:
+    # Hot scoring kernel: delegate to the C++ core when available (the
+    # go-gpuallocator analogue); it does not model torus wraparound, so only
+    # non-torus hosts take the native path.
+    if not any(topo.wraparound):
+        from k8s_gpu_device_plugin_tpu.device.native import native_internal_edges
+
+        native = native_internal_edges(sorted(coords), topo.bounds)
+        if native is not None:
+            return native
     count = 0
     for c in coords:
         for n in topo.neighbors(c):

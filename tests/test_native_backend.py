@@ -1,0 +1,96 @@
+"""NativeBackend <-> libtpuenum.so integration over a synthetic host tree.
+
+The C++ core roots all filesystem access at $TPUENUM_ROOT, so these tests
+build a fake /dev + /sys + /etc tree and exercise the full ctypes path.
+Skipped if the shared library has not been built (``make -C
+k8s_gpu_device_plugin_tpu/native``).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.device.native import NativeBackend, _load_library
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "k8s_gpu_device_plugin_tpu", "native"
+)
+
+
+def ensure_lib():
+    if _load_library() is None:
+        build = subprocess.run(
+            ["make", "-C", NATIVE_DIR], capture_output=True, text=True
+        )
+        if build.returncode != 0 or _load_library() is None:
+            pytest.skip("libtpuenum.so not buildable in this environment")
+
+
+@pytest.fixture
+def fake_host(tmp_path, monkeypatch):
+    ensure_lib()
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "etc").mkdir()
+    (tmp_path / "etc" / "machine-id").write_text("0123456789abcdef\n")
+    accel_root = tmp_path / "sys" / "class" / "accel"
+    for i in range(4):
+        (tmp_path / "dev" / f"accel{i}").write_text("")
+        dev_dir = accel_root / f"accel{i}" / "device"
+        dev_dir.mkdir(parents=True)
+        (dev_dir / "numa_node").write_text("0\n" if i < 2 else "1\n")
+        (dev_dir / "device").write_text("0x0063\n")  # v5e
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))
+    return tmp_path
+
+
+def test_native_enumeration(fake_host):
+    backend = NativeBackend()
+    assert backend.available()
+    topo = backend.host_topology()
+    assert topo.generation.name == "v5e"
+    assert topo.num_chips == 4
+
+    chips = backend.enumerate_chips()
+    assert len(chips) == 4
+    assert [c.index for c in chips] == [0, 1, 2, 3]
+    assert all(c.uuid.startswith("TPU-") for c in chips)
+    assert len({c.uuid for c in chips}) == 4
+    assert chips[0].numa_node == 0 and chips[3].numa_node == 1
+    # coords assigned row-major over the inferred 2x2 mesh
+    assert sorted(c.coord for c in chips) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    # HBM filled from the generation table when sysfs has none
+    assert chips[0].hbm_bytes == 16 * 1024**3
+
+
+def test_native_health_follows_device_nodes(fake_host):
+    backend = NativeBackend(topology_override="v5e-4")
+    health = backend.check_health()
+    assert health == {0: True, 1: True, 2: True, 3: True}
+    # Removing the node must flip that chip unhealthy (check_health resolves
+    # device paths under TPUENUM_ROOT, same as the C++ core).
+    os.unlink(fake_host / "dev" / "accel3")
+    assert backend._lib.tpuenum_chip_count() == 3
+    assert backend.check_health()[3] is False
+
+
+def test_native_topology_override(fake_host):
+    backend = NativeBackend(topology_override="v5e-2x2")
+    assert backend.host_topology().bounds == (2, 2)
+
+
+def test_native_unavailable_without_devices(tmp_path, monkeypatch):
+    ensure_lib()
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))  # empty tree
+    backend = NativeBackend()
+    assert not backend.available()
+
+
+def test_internal_edges_matches_python(fake_host):
+    import ctypes
+
+    backend = NativeBackend()
+    lib = backend._lib
+    coords = (ctypes.c_int32 * 8)(0, 0, 0, 1, 1, 0, 1, 1)
+    bounds = (ctypes.c_int32 * 2)(2, 4)
+    assert lib.tpuenum_internal_edges(coords, 4, bounds, 2) == 4
