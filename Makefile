@@ -3,7 +3,9 @@
 NATIVE_DIR := k8s_gpu_device_plugin_tpu/native
 API_DIR := k8s_gpu_device_plugin_tpu/plugin/api
 
-all: native proto
+# proto output (deviceplugin_pb2.py) is checked in; regen is opt-in via
+# `make proto` so a plain `make` works without protoc installed.
+all: native
 
 native:
 	$(MAKE) -C $(NATIVE_DIR)

@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Driver benchmark entry point: ONE JSON line on stdout.
+
+Primary metric (BASELINE config #2): single-chip bf16 matmul MFU on the real
+TPU. ``vs_baseline`` is the ratio against the north-star 45% MFU target from
+BASELINE.md (the reference publishes no numbers of its own — BASELINE.json
+"published": {}).
+
+Extra diagnostics (control-plane round-trip, device info) go to stderr so
+stdout stays a single parseable line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+NORTH_STAR_MFU = 0.45  # BASELINE.md: >=45% MFU Llama-3-8B on v5p-16
+
+
+def main() -> int:
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
+
+    device = jax.devices()[0]
+    print(
+        f"bench: device={device.device_kind!r} backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+
+    result = matmul_mfu(n=4096)
+    print(
+        f"bench: matmul 4096^3 bf16: {result.tflops:.1f} TFLOP/s "
+        f"(peak {result.peak_tflops:.0f}, mfu {result.mfu * 100:.1f}%) "
+        f"over {result.iters} iters in {result.seconds:.3f}s",
+        file=sys.stderr,
+    )
+
+    try:
+        from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
+            control_plane_roundtrip,
+        )
+
+        rt = control_plane_roundtrip(iters=50)
+        print(
+            f"bench: control-plane roundtrip: {rt.allocs_per_second:.0f} "
+            f"alloc/s, first registration in {rt.first_register_seconds:.2f}s",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
+        print(f"bench: roundtrip skipped: {type(e).__name__}: {e}", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "matmul_bf16_mfu",
+                "value": round(result.mfu * 100, 2),
+                "unit": "% of peak",
+                "vs_baseline": round(result.mfu / NORTH_STAR_MFU, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Real device benchmarks (the north-star rewrite of benchmark/).
+
+The reference's benchmark package profiled the Go daemon and touched no
+device (benchmark/benchmark.go:54-124). These workloads are what BASELINE.md
+actually scores:
+
+- config #1  control-plane round-trip with zero accelerators (roundtrip.py)
+- config #2  single-chip bf16 matmul MFU (matmul_mfu.py)
+- config #3  ICI all-reduce bandwidth sweep (allreduce_sweep.py)
+- config #4+ Llama train-step MFU on a mesh (train_bench.py)
+"""
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.allreduce_sweep import (
+    allreduce_sweep,
+)
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
+
+__all__ = ["matmul_mfu", "allreduce_sweep", "train_mfu"]

@@ -1,0 +1,82 @@
+"""ICI all-reduce bandwidth sweep (BASELINE config #3).
+
+psum over every device on the mesh, buffer sizes swept 1MB..1GB. Reports
+algorithm bandwidth (bytes/sec of the input buffer) and bus bandwidth
+(x 2(n-1)/n — the standard ring-all-reduce wire-traffic normalization) per
+size. On a plugin-allocated contiguous sub-slice the ring rides ICI
+neighbor links, which is exactly what aligned allocation is for.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+@dataclass(frozen=True)
+class AllReducePoint:
+    bytes_per_device: int
+    seconds_per_op: float
+    algbw_gbps: float  # GB/s, input-buffer bytes / time
+    busbw_gbps: float  # GB/s, x 2(n-1)/n
+
+
+def allreduce_sweep(
+    sizes_mb: tuple[float, ...] = (1, 4, 16, 64, 256, 1024),
+    iters: int = 20,
+    warmup: int = 2,
+    devices: list | None = None,
+) -> list[AllReducePoint]:
+    devices = devices or jax.devices()
+    n = len(devices)
+    mesh = Mesh(devices, ("x",))
+    results = []
+    for mb in sizes_mb:
+        nbytes = int(mb * 1024 * 1024)
+        elems = max(128, nbytes // 4)
+        # per-device shard of f32[elems*n] -> psum moves `elems` f32 each
+        x = jnp.arange(elems * n, dtype=jnp.float32)
+        x = jax.device_put(
+            x, NamedSharding(mesh, P("x"))
+        )
+
+        def allreduce(x):
+            def body(x):
+                def step(c, _):
+                    return jax.lax.psum(c, "x") * (1.0 / n), None
+
+                out, _ = jax.lax.scan(step, x, None, length=iters)
+                return out
+
+            return shard_map(
+                body, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                check_vma=False,
+            )(x)
+
+        fn = jax.jit(allreduce)
+        for _ in range(warmup):
+            fn(x).block_until_ready()
+        start = time.perf_counter()
+        fn(x).block_until_ready()
+        seconds = (time.perf_counter() - start) / iters
+
+        algbw = nbytes / seconds / 1e9
+        busbw = algbw * (2 * (n - 1) / n)
+        results.append(
+            AllReducePoint(
+                bytes_per_device=nbytes,
+                seconds_per_op=seconds,
+                algbw_gbps=algbw,
+                busbw_gbps=busbw,
+            )
+        )
+    return results

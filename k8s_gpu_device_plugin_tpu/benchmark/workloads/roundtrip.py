@@ -1,0 +1,96 @@
+"""Control-plane round-trip benchmark (BASELINE config #1).
+
+Zero accelerators: fake kubelet + fake backend, measures the full
+enumerate -> register -> ListAndWatch -> GetPreferredAllocation -> Allocate
+path end-to-end in-process, reporting allocations/second. This is the
+framework analogue of the reference's own benchmark entry point finally
+doing something observable (benchmark/benchmark.go measured nothing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RoundTripResult:
+    registrations: int
+    allocations: int
+    allocs_per_second: float
+    first_register_seconds: float
+
+
+async def _run(topology: str, iters: int, socket_dir: str) -> RoundTripResult:
+    import sys
+
+    from k8s_gpu_device_plugin_tpu.config import Config
+    from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+    from k8s_gpu_device_plugin_tpu.plugin import PluginManager, api
+    from k8s_gpu_device_plugin_tpu.plugin.api import pb
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    sys.path.insert(0, "tests")
+    from fake_kubelet import FakeKubelet  # noqa: PLC0415
+
+    kubelet = FakeKubelet(socket_dir)
+    await kubelet.start()
+    cfg = Config(kubelet_socket_dir=socket_dir, libtpu_path="")
+    ready = Latch()
+    manager = PluginManager(
+        cfg, ready, backend=FakeBackend(topology), health_interval=3600
+    )
+    task = asyncio.create_task(manager.start())
+    t0 = time.perf_counter()
+    await asyncio.wait_for(ready.wait_async(), 30)
+    await kubelet.wait_for_registrations(1)
+    first_register = time.perf_counter() - t0
+
+    reg = kubelet.registrations[0]
+    chips = manager.plugins[0].chips
+    ids = chips.ids()
+    allocs = 0
+    async with kubelet.plugin_channel(reg.endpoint) as channel:
+        stub = api.DevicePluginStub(channel)
+        start = time.perf_counter()
+        for i in range(iters):
+            pref = await stub.GetPreferredAllocation(
+                pb.PreferredAllocationRequest(
+                    container_requests=[
+                        pb.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=ids, allocation_size=2
+                        )
+                    ]
+                )
+            )
+            picked = list(pref.container_responses[0].deviceIDs)
+            resp = await stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devicesIDs=picked)
+                    ]
+                )
+            )
+            assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"]
+            allocs += 1
+        elapsed = time.perf_counter() - start
+
+    await manager.stop()
+    await asyncio.wait_for(task, 10)
+    await kubelet.stop()
+    return RoundTripResult(
+        registrations=len(kubelet.registrations),
+        allocations=allocs,
+        allocs_per_second=allocs / elapsed,
+        first_register_seconds=first_register,
+    )
+
+
+def control_plane_roundtrip(
+    topology: str = "v5e-8", iters: int = 100, socket_dir: str | None = None
+) -> RoundTripResult:
+    import tempfile
+
+    socket_dir = socket_dir or tempfile.mkdtemp(prefix="tpu-bench-kubelet-")
+    return asyncio.run(_run(topology, iters, socket_dir))

@@ -1,0 +1,79 @@
+"""Llama train-step MFU (BASELINE configs #4/#5 analogue).
+
+Times the full jitted training step (fwd + bwd + optimizer) of a Llama
+config on the given mesh and reports model FLOPs utilization against the
+aggregate peak of the participating chips. The north star is >=45% MFU for
+Llama-3-8B on a v5p-16 slice; on smaller hardware a scaled config with the
+same arithmetic shape is used and the math is identical.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import detect_generation
+from k8s_gpu_device_plugin_tpu.device.topology import GENERATIONS
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.train import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+@dataclass(frozen=True)
+class TrainBenchResult:
+    tflops_per_chip: float
+    peak_tflops: float
+    mfu: float
+    tokens_per_second: float
+    step_seconds: float
+    n_devices: int
+
+
+def train_mfu(
+    cfg: LlamaConfig,
+    batch_size: int,
+    seq_len: int,
+    mesh_spec: MeshSpec | None = None,
+    steps: int = 10,
+    warmup: int = 2,
+    devices: list | None = None,
+) -> TrainBenchResult:
+    devices = devices or jax.devices()
+    spec = mesh_spec or MeshSpec.for_devices(len(devices))
+    mesh = make_mesh(spec, devices)
+    n = spec.num_devices
+
+    optimizer = make_optimizer(total_steps=steps + warmup + 1)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len, mesh)
+    train_step = make_train_step(cfg, mesh, optimizer)
+
+    for _ in range(warmup):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(state)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(state)
+    seconds = (time.perf_counter() - start) / steps
+
+    tokens = batch_size * seq_len
+    flops = cfg.flops_per_token() * tokens
+    tflops_per_chip = flops / seconds / n / 1e12
+    peak = GENERATIONS[detect_generation(devices[0])].peak_bf16_tflops
+    return TrainBenchResult(
+        tflops_per_chip=tflops_per_chip,
+        peak_tflops=peak,
+        mfu=tflops_per_chip / peak,
+        tokens_per_second=tokens / seconds,
+        step_seconds=seconds,
+        n_devices=n,
+    )

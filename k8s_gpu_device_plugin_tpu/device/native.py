@@ -15,6 +15,7 @@ falls back to the fake backend.
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
 
 from k8s_gpu_device_plugin_tpu.device.backend import ChipSpec
@@ -46,6 +47,7 @@ class _CChipInfo(ctypes.Structure):
     ]
 
 
+@functools.cache
 def _load_library() -> ctypes.CDLL | None:
     for lib_dir in _LIB_DIRS:
         for name in _LIB_NAMES:
@@ -85,10 +87,6 @@ def _declare_signatures(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-_edges_lib: ctypes.CDLL | None = None
-_edges_lib_loaded = False
-
-
 def native_internal_edges(
     coords: list[tuple[int, ...]], bounds: tuple[int, ...]
 ) -> int | None:
@@ -98,19 +96,16 @@ def native_internal_edges(
     No wraparound: only valid for mesh (non-torus) bounds, matching the C
     implementation.
     """
-    global _edges_lib, _edges_lib_loaded
-    if not _edges_lib_loaded:
-        _edges_lib = _load_library()
-        _edges_lib_loaded = True
-    if _edges_lib is None or not coords:
-        return 0 if not coords and _edges_lib is not None else None
+    lib = _load_library()
+    if lib is None:
+        return None
+    if not coords:
+        return 0
     dims = len(bounds)
     flat = [c for coord in coords for c in coord]
     c_coords = (ctypes.c_int32 * len(flat))(*flat)
     c_bounds = (ctypes.c_int32 * dims)(*bounds)
-    result = _edges_lib.tpuenum_internal_edges(
-        c_coords, len(coords), c_bounds, dims
-    )
+    result = lib.tpuenum_internal_edges(c_coords, len(coords), c_bounds, dims)
     return None if result < 0 else int(result)
 
 
@@ -187,13 +182,11 @@ class NativeBackend:
         'enumerate via sysfs, not a chip-pinning client' rule.)
         """
         root = os.environ.get("TPUENUM_ROOT", "")
-        specs = {s.index: s for s in self.enumerate_chips()}
         out: dict[int, bool] = {}
-        for i in range(self.host_topology().num_chips):
-            spec = specs.get(i)
-            if spec is None:  # expected by topology, gone from enumeration
-                out[i] = False
-                continue
+        for spec in self.enumerate_chips():
             path = root + spec.paths[0]
-            out[i] = os.path.exists(path) and os.access(path, os.R_OK)
+            out[spec.index] = os.path.exists(path) and os.access(path, os.R_OK)
+        # A chip that was advertised but is no longer enumerated has no entry
+        # here; the manager treats missing indices as unhealthy
+        # (PluginManager._with_health defaults absent chips to False).
         return out

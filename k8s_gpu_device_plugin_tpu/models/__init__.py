@@ -1,0 +1,18 @@
+"""Benchmark model families (workload half of the north star).
+
+The reference daemon ships no models (SURVEY §2); BASELINE configs #4/#5
+require Llama-3-style training on plugin-allocated slices. ``llama.py`` is a
+TPU-first implementation: layer-stacked ``lax.scan`` (constant compile time
+in depth), bf16 compute with f32 accumulation, explicit jax.sharding rules
+for dp/fsdp/tp/sp, rematerialized blocks, and ring/Ulysses attention for
+long context.
+"""
+
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    param_specs,
+)
+
+__all__ = ["LlamaConfig", "forward", "init_params", "param_specs"]

@@ -1,0 +1,6 @@
+"""Compute ops: attention (XLA reference + Pallas TPU flash kernel), fused
+primitives. The hot paths BASELINE's MFU targets depend on."""
+
+from k8s_gpu_device_plugin_tpu.ops.attention import attention, mha_reference
+
+__all__ = ["attention", "mha_reference"]

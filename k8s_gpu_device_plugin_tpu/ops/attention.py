@@ -1,0 +1,69 @@
+"""Single-device attention: XLA reference now, Pallas flash kernel on TPU.
+
+``mha_reference`` is the numerics oracle (f32 softmax, causal masking, GQA).
+``attention`` dispatches to the Pallas TPU flash-attention kernel
+(ops/flash_attention.py) when running on TPU with shapes it supports, else
+falls back to the reference — XLA's fusion already keeps the fallback
+respectable; the kernel exists to control VMEM blocking on long sequences.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _expand_kv(k: jax.Array, n_q_heads: int) -> jax.Array:
+    if k.shape[2] == n_q_heads:
+        return k
+    return jnp.repeat(k, n_q_heads // k.shape[2], axis=2)
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """(B, S, H, D) attention with f32 softmax; K/V may be grouped."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = _expand_kv(k, q.shape[2])
+    v = _expand_kv(v, q.shape[2])
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        )
+        * scale
+    )
+    if causal:
+        s_q, s_k = scores.shape[-2:]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0) >= (
+            jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        )
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Dispatching attention entry point used by the models."""
+    if jax.default_backend() == "tpu":
+        try:
+            from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
+                flash_attention,
+                supports,
+            )
+
+            if supports(q, k, v):
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            pass
+    return mha_reference(q, k, v, causal=causal, scale=scale)

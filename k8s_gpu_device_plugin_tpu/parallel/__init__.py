@@ -1,0 +1,35 @@
+"""SPMD parallelism layer: mesh conventions, shardings, sequence parallelism.
+
+The reference daemon contains no parallelism (SURVEY §2: no DP/TP/PP/SP/EP,
+no NCCL/MPI) — its contribution to parallel jobs is handing out contiguous
+ICI sub-slices. This package is the workload half the north star requires:
+jax.sharding meshes whose collectives ride the ICI slices the plugin
+allocates, ring attention + Ulysses all-to-all for long-context sequence
+parallelism, and the sharding rules for the benchmark models.
+"""
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshSpec,
+    batch_spec,
+    make_mesh,
+)
+from k8s_gpu_device_plugin_tpu.parallel.ring_attention import ring_attention
+from k8s_gpu_device_plugin_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "AXIS_EP",
+    "MeshSpec",
+    "make_mesh",
+    "batch_spec",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,114 @@
+"""Device mesh construction and axis conventions.
+
+Axis vocabulary (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+- ``dp``   pure data parallelism (gradients all-reduced over ICI/DCN)
+- ``fsdp`` data parallelism with parameter sharding (ZeRO-3 style;
+           params all-gathered per layer, grads reduce-scattered)
+- ``tp``   tensor (megatron-style) parallelism within attention/MLP blocks
+- ``sp``   sequence/context parallelism for long sequences (ring attention
+           or Ulysses all-to-all over this axis)
+- ``ep``   expert parallelism for MoE layers
+
+On hardware, mesh axes should be laid out so ``tp``/``sp`` (latency-bound,
+per-layer collectives) map to the innermost ICI dimensions of the slice the
+plugin allocated, and ``dp``/``fsdp`` to the outer dimensions / DCN.
+``jax.experimental.mesh_utils.create_device_mesh`` does that given the axis
+sizes in this order.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+# Outer-to-inner order: dp/fsdp ride DCN / outer ICI; tp/sp want the
+# innermost (fastest, all-neighbors) ICI links.
+AXIS_ORDER = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Axes of size 1 still exist in the Mesh (so the
+    same PartitionSpecs work at any scale)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            AXIS_DP: self.dp,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EP: self.ep,
+            AXIS_SP: self.sp,
+            AXIS_TP: self.tp,
+        }
+
+    @staticmethod
+    def for_devices(
+        n: int, tp: int = 1, sp: int = 1, ep: int = 1, fsdp: int | None = None
+    ) -> "MeshSpec":
+        """Fill dp (or fsdp) with whatever ``n`` leaves over tp*sp*ep."""
+        inner = tp * sp * ep
+        if n % inner != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp*ep={inner}")
+        rest = n // inner
+        if fsdp is None:
+            return MeshSpec(dp=rest, fsdp=1, tp=tp, sp=sp, ep=ep)
+        if rest % fsdp != 0:
+            raise ValueError(f"remainder {rest} not divisible by fsdp={fsdp}")
+        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+
+
+def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    """Build a Mesh with ICI-friendly physical layout."""
+    devices = devices if devices is not None else jax.devices()
+    if spec.num_devices > len(devices):
+        raise ValueError(
+            f"mesh needs {spec.num_devices} devices, have {len(devices)}"
+        )
+    devices = devices[: spec.num_devices]
+    shape = tuple(spec.sizes()[a] for a in AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def batch_spec() -> P:
+    """Sharding of the batch dimension: data-parallel over dp+fsdp."""
+    return P((AXIS_DP, AXIS_FSDP))
+
+
+def data_sharding(mesh: Mesh, *trailing: object) -> NamedSharding:
+    """NamedSharding for (batch, seq, ...) arrays: batch over dp/fsdp, seq
+    over sp."""
+    return NamedSharding(mesh, P((AXIS_DP, AXIS_FSDP), AXIS_SP, *trailing))
+
+
+def constrain(x, spec: P):
+    """with_sharding_constraint that is a no-op outside jit/mesh contexts."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x

@@ -1,0 +1,93 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+The second long-context strategy (DeepSpeed-Ulysses construction): instead of
+rotating K/V blocks (ring_attention.py), re-shard with two all-to-alls —
+(B, S/sp, H, D) -> (B, S, H/sp, D) — run *full-sequence* attention on each
+device's head subset, and shard back. One pair of all-to-alls per attention
+call (cheap on ICI) versus sp ppermute rounds for ring; the trade is HBM:
+Ulysses materializes full-length K/V per device, so ring wins at extreme
+sequence lengths while Ulysses wins when heads >> sp and S fits.
+
+Requires Hq and Hkv divisible by sp.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _attn_full(q, k, v, causal, scale):
+    """Plain f32 softmax attention over full sequences (b, s, h, d)."""
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0) >= (
+            jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        )
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis: str = AXIS_SP,
+    batch_axes: tuple[str, ...] = (AXIS_DP, AXIS_FSDP),
+    head_axis: str | None = "tp",
+    scale: float | None = None,
+) -> jax.Array:
+    """Attention over sequence-sharded (B, S, H, D) via all-to-all resharding."""
+    sp = mesh.shape[axis]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        if t.shape[2] % sp != 0:
+            raise ValueError(
+                f"ulysses needs {name} heads ({t.shape[2]}) divisible by sp={sp}"
+            )
+    spec = P(batch_axes, axis, head_axis, None)
+
+    local = functools.partial(_ulysses_local, causal=causal, axis=axis, scale=scale)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, causal, axis, scale):
+    # (b, s_local, h, d) -> (b, s_full, h_local, d): gather seq, scatter heads
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    out = _attn_full(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal, scale
+    )
+    return heads_to_seq(out)

@@ -154,11 +154,15 @@ class PluginManager:
         ]
 
     def _with_health(self, chips: Chips) -> Chips:
-        """Apply current per-chip health; a slice is unhealthy if any member is."""
+        """Apply current per-chip health; a slice is unhealthy if any member is.
+
+        A chip absent from the health map (no longer enumerated by the
+        backend, e.g. its device node vanished) counts as unhealthy.
+        """
         out = Chips()
         for cid, chip in chips.items():
             ok = all(
-                self._chip_health.get(i, True) for i in chip.chip_indices
+                self._chip_health.get(i, False) for i in chip.chip_indices
             )
             out[cid] = chip.with_health(HEALTHY if ok else UNHEALTHY)
         return out

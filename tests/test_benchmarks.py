@@ -1,0 +1,70 @@
+"""Benchmark machinery tests at CPU scale (numbers are not meaningful on
+CPU; shape/finiteness/plumbing are what is asserted)."""
+
+import jax
+import pytest
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.allreduce_sweep import (
+    allreduce_sweep,
+)
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import (
+    detect_generation,
+    matmul_mfu,
+)
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
+    control_plane_roundtrip,
+)
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec
+
+
+def test_matmul_mfu_machinery():
+    result = matmul_mfu(n=256, iters=8, repeats=1)
+    assert result.tflops > 0
+    assert result.seconds > 0
+    assert result.mfu == pytest.approx(result.tflops / result.peak_tflops)
+
+
+def test_detect_generation_defaults():
+    assert detect_generation(jax.devices()[0]) in ("v4", "v5e", "v5p", "v6e")
+
+
+def test_allreduce_sweep_machinery():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    points = allreduce_sweep(sizes_mb=(0.25, 1), iters=3, warmup=1)
+    assert len(points) == 2
+    for p in points:
+        assert p.algbw_gbps > 0
+        assert p.busbw_gbps == pytest.approx(
+            p.algbw_gbps * 2 * (len(jax.devices()) - 1) / len(jax.devices())
+        )
+    assert points[1].bytes_per_device > points[0].bytes_per_device
+
+
+def test_train_mfu_machinery():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg = LlamaConfig.tiny(attn_impl="ring")
+    result = train_mfu(
+        cfg,
+        batch_size=4,
+        seq_len=64,
+        mesh_spec=MeshSpec(dp=1, tp=2, sp=2),
+        steps=2,
+        warmup=1,
+        devices=jax.devices()[:4],
+    )
+    assert result.tflops_per_chip > 0
+    assert result.tokens_per_second > 0
+    assert result.n_devices == 4
+
+
+def test_control_plane_roundtrip(tmp_path):
+    result = control_plane_roundtrip(
+        topology="v5e-4", iters=10, socket_dir=str(tmp_path)
+    )
+    assert result.allocations == 10
+    assert result.allocs_per_second > 0
+    assert result.registrations >= 1

@@ -23,6 +23,7 @@ def ensure_lib():
         build = subprocess.run(
             ["make", "-C", NATIVE_DIR], capture_output=True, text=True
         )
+        _load_library.cache_clear()  # the None result was memoized
         if build.returncode != 0 or _load_library() is None:
             pytest.skip("libtpuenum.so not buildable in this environment")
 
@@ -67,11 +68,37 @@ def test_native_health_follows_device_nodes(fake_host):
     backend = NativeBackend(topology_override="v5e-4")
     health = backend.check_health()
     assert health == {0: True, 1: True, 2: True, 3: True}
-    # Removing the node must flip that chip unhealthy (check_health resolves
-    # device paths under TPUENUM_ROOT, same as the C++ core).
+    # A removed node drops out of enumeration; its index must vanish from the
+    # health map, and the manager treats absent indices as unhealthy.
     os.unlink(fake_host / "dev" / "accel3")
     assert backend._lib.tpuenum_chip_count() == 3
-    assert backend.check_health()[3] is False
+    health = backend.check_health()
+    assert 3 not in health
+    assert health == {0: True, 1: True, 2: True}
+
+
+def test_manager_marks_missing_chip_unhealthy(fake_host):
+    """End of the pipeline: a vanished device node turns its advertised
+    device Unhealthy through PluginManager._with_health."""
+    from k8s_gpu_device_plugin_tpu.config import Config
+    from k8s_gpu_device_plugin_tpu.device.chip import UNHEALTHY
+    from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    backend = NativeBackend(topology_override="v5e-4")
+    manager = PluginManager(
+        Config(backend="native"), Latch(), backend=backend
+    )
+    manager._load_plugins()
+    assert all(
+        c.health != UNHEALTHY for c in manager.plugins[0].chips.values()
+    )
+    os.unlink(fake_host / "dev" / "accel3")
+    manager._chip_health = backend.check_health()
+    refreshed = manager._with_health(manager.chip_map["google.com/tpu"])
+    unhealthy = [c for c in refreshed.values() if c.health == UNHEALTHY]
+    assert len(unhealthy) == 1
+    assert unhealthy[0].chip_indices == (3,)
 
 
 def test_native_topology_override(fake_host):
