@@ -43,11 +43,13 @@ def allreduce_sweep(
     for mb in sizes_mb:
         nbytes = int(mb * 1024 * 1024)
         elems = max(128, nbytes // 4)
-        # per-device shard of f32[elems*n] -> psum moves `elems` f32 each
-        x = jnp.arange(elems * n, dtype=jnp.float32)
-        x = jax.device_put(
-            x, NamedSharding(mesh, P("x"))
-        )
+        # per-device shard of f32[elems*n] -> psum moves `elems` f32 each.
+        # Created pre-sharded: materializing the global buffer on one device
+        # first would OOM a single chip at the 1GB point of the sweep.
+        x = jax.jit(
+            lambda: jnp.arange(elems * n, dtype=jnp.float32),
+            out_shardings=NamedSharding(mesh, P("x")),
+        )()
 
         def allreduce(x):
             def body(x):

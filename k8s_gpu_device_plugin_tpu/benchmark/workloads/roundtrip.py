@@ -23,16 +23,12 @@ class RoundTripResult:
 
 
 async def _run(topology: str, iters: int, socket_dir: str) -> RoundTripResult:
-    import sys
-
     from k8s_gpu_device_plugin_tpu.config import Config
     from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
     from k8s_gpu_device_plugin_tpu.plugin import PluginManager, api
     from k8s_gpu_device_plugin_tpu.plugin.api import pb
+    from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
     from k8s_gpu_device_plugin_tpu.utils.latch import Latch
-
-    sys.path.insert(0, "tests")
-    from fake_kubelet import FakeKubelet  # noqa: PLC0415
 
     kubelet = FakeKubelet(socket_dir)
     await kubelet.start()

@@ -88,14 +88,18 @@ class LlamaConfig:
         return replace(cfg, **overrides)
 
     def flops_per_token(self) -> float:
-        """Dense training FLOPs/token (fwd+bwd ~= 6 * params-matmul + attn)."""
+        """Dense training FLOPs/token: 6 * matmul params (fwd+bwd).
+
+        attn term = wq + wo (each d*Hq*hd) + wk + wv (each d*Hkv*hd); the
+        O(S) attention-score FLOPs are deliberately excluded (standard 6N
+        model-FLOPs accounting), making reported MFU slightly conservative.
+        """
         d, f, L = self.d_model, self.d_ff, self.n_layers
         hd = self.head_dim
         attn_proj = 2 * d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd)
         mlp = 3 * d * f
-        per_layer = attn_proj + d * self.n_heads * hd + mlp  # + wo
-        embed = self.vocab_size * d
-        params_matmul = L * per_layer + embed
+        embed = self.vocab_size * d  # lm_head (embed table itself is a gather)
+        params_matmul = L * (attn_proj + mlp) + embed
         return 6.0 * params_matmul
 
 

@@ -30,13 +30,7 @@ except AttributeError:  # pragma: no cover
 
 _NEG_BIG = -1e30
 
-
-def _expand_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
-    """Grouped-query attention: repeat KV heads up to the Q head count."""
-    n_kv = k.shape[2]
-    if n_kv == num_q_heads:
-        return k
-    return jnp.repeat(k, num_q_heads // n_kv, axis=2)
+from k8s_gpu_device_plugin_tpu.ops.attention import _expand_kv  # noqa: E402
 
 
 def _block_attn_update(carry, scores, v, mask):

@@ -27,27 +27,6 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _attn_full(q, k, v, causal, scale):
-    """Plain f32 softmax attention over full sequences (b, s, h, d)."""
-    n_rep = q.shape[2] // k.shape[2]
-    if n_rep > 1:
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
-    scores = (
-        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-        * scale
-    )
-    if causal:
-        s_q, s_k = scores.shape[-2], scores.shape[-1]
-        mask = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0) >= (
-            jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        )
-        scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
-
-
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -62,10 +41,14 @@ def ulysses_attention(
     """Attention over sequence-sharded (B, S, H, D) via all-to-all resharding."""
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    # heads are already sharded over head_axis before the all-to-all splits
+    # the LOCAL head dim by sp, so divisibility is on the per-shard count
+    tp = mesh.shape[head_axis] if head_axis else 1
     for name, t in (("q", q), ("k", k), ("v", v)):
-        if t.shape[2] % sp != 0:
+        if t.shape[2] % tp != 0 or (t.shape[2] // tp) % sp != 0:
             raise ValueError(
-                f"ulysses needs {name} heads ({t.shape[2]}) divisible by sp={sp}"
+                f"ulysses needs {name} heads ({t.shape[2]}) divisible by "
+                f"{head_axis or 'tp'}({tp}) x sp({sp})"
             )
     spec = P(batch_axes, axis, head_axis, None)
 
@@ -87,7 +70,9 @@ def _ulysses_local(q, k, v, *, causal, axis, scale):
     def heads_to_seq(x):
         return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
-    out = _attn_full(
-        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal, scale
+    from k8s_gpu_device_plugin_tpu.ops.attention import mha_reference
+
+    out = mha_reference(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal, scale=scale
     )
     return heads_to_seq(out)

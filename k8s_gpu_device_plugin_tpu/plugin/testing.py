@@ -3,7 +3,9 @@
 A gRPC server on ``<dir>/kubelet.sock`` implementing the Registration service
 and recording every RegisterRequest, so the whole plugin handshake —
 Register -> ListAndWatch -> GetPreferredAllocation -> Allocate — runs with
-zero accelerators (BASELINE config #1).
+zero accelerators (BASELINE config #1). Lives in the package (not tests/)
+because the shipped control-plane round-trip benchmark drives it too
+(benchmark/workloads/roundtrip.py).
 """
 
 from __future__ import annotations

@@ -1,0 +1,74 @@
+"""Flash attention kernel vs the reference oracle (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.ops.attention import mha_reference
+from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
+    _HAS_PLTPU,
+    flash_attention,
+    supports,
+)
+
+pytestmark = pytest.mark.skipif(not _HAS_PLTPU, reason="pallas tpu unavailable")
+
+
+def make_qkv(key, b=1, s=256, hq=4, hkv=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, hq, d), dtype),
+        jax.random.normal(kk, (b, s, hkv, d), dtype),
+        jax.random.normal(kv, (b, s, hkv, d), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_reference(causal):
+    q, k, v = make_qkv(jax.random.key(0))
+    expected = mha_reference(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_flash_gqa_and_mha():
+    q, k, v = make_qkv(jax.random.key(1), hq=4, hkv=4)
+    expected = mha_reference(q, k, v)
+    got = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_flash_grads_match_reference():
+    q, k, v = make_qkv(jax.random.key(2), s=256)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_supports_gates():
+    q, k, v = make_qkv(jax.random.key(3))
+    assert supports(q, k, v)
+    q2, k2, v2 = make_qkv(jax.random.key(3), s=200)  # not block-aligned
+    assert not supports(q2, k2, v2)
+    q3, k3, v3 = make_qkv(jax.random.key(3), d=32)  # narrow head dim
+    assert not supports(q3, k3, v3)
+
+
+def test_flash_bf16():
+    q, k, v = make_qkv(jax.random.key(4), dtype=jnp.bfloat16)
+    expected = mha_reference(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expected, np.float32), atol=3e-2
+    )

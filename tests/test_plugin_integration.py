@@ -16,7 +16,7 @@ from k8s_gpu_device_plugin_tpu.plugin import PluginManager, api
 from k8s_gpu_device_plugin_tpu.plugin.api import pb
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 
-from fake_kubelet import FakeKubelet
+from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
 
 
 def run(coro):
