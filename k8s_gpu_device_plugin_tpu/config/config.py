@@ -131,7 +131,7 @@ def load_config(
     # Tier 2: YAML file (missing file is not an error, like viper's soft read).
     path = args.configFile
     if not path.endswith((".yml", ".yaml")):
-        path = f"./{path}.yml"
+        path = f"{path}.yml"  # relative names resolve against cwd (main.go:31)
     if os.path.exists(path):
         with open(path) as f:
             data = yaml.safe_load(f) or {}

@@ -58,10 +58,27 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attn_impl: str = "auto"  # auto | full | ring | ulysses
+    # MoE (0 experts = dense MLP); Mixtral-style top-k routing, GShard dispatch
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_z_weight: float = 1e-3
+    # GShard routing-group size: capacity competition is local to groups of
+    # this many tokens, keeping dispatch-tensor memory linear in seq length
+    # (0 = one group per batch row).
+    moe_group_size: int = 4096
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def with_group_size(self, g: int) -> "LlamaConfig":
+        return replace(self, moe_group_size=g)
 
     # --- presets ---
 
@@ -77,6 +94,14 @@ class LlamaConfig:
         return LlamaConfig(
             vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
             n_kv_heads=8, d_ff=28672, rope_theta=500000.0, max_seq=8192,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, rope_theta=1e6, max_seq=32768,
+            n_experts=8, n_experts_per_token=2,
         )
 
     @staticmethod
@@ -97,7 +122,10 @@ class LlamaConfig:
         d, f, L = self.d_model, self.d_ff, self.n_layers
         hd = self.head_dim
         attn_proj = 2 * d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd)
-        mlp = 3 * d * f
+        if self.is_moe:  # activated params only: k experts + router per token
+            mlp = 3 * d * f * self.n_experts_per_token + d * self.n_experts
+        else:
+            mlp = 3 * d * f
         embed = self.vocab_size * d  # lm_head (embed table itself is a gather)
         params_matmul = L * (attn_proj + mlp) + embed
         return 6.0 * params_matmul
@@ -126,10 +154,17 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         "wk": norm_init(ks[1], (L, d, cfg.n_kv_heads * hd), std),
         "wv": norm_init(ks[2], (L, d, cfg.n_kv_heads * hd), std),
         "wo": norm_init(ks[3], (L, cfg.n_heads * hd, d), out_std),
-        "w1": norm_init(ks[4], (L, d, cfg.d_ff), std),
-        "w3": norm_init(ks[5], (L, d, cfg.d_ff), std),
-        "w2": norm_init(ks[6], (L, cfg.d_ff, d), out_std),
     }
+    if cfg.is_moe:
+        from k8s_gpu_device_plugin_tpu.models.moe import moe_param_init
+
+        layers.update(moe_param_init(ks[4], cfg))
+    else:
+        layers.update({
+            "w1": norm_init(ks[4], (L, d, cfg.d_ff), std),
+            "w3": norm_init(ks[5], (L, d, cfg.d_ff), std),
+            "w2": norm_init(ks[6], (L, cfg.d_ff, d), out_std),
+        })
     return {
         "embed": norm_init(k_embed, (cfg.vocab_size, d), std),
         "layers": layers,
@@ -141,19 +176,27 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
 def param_specs(cfg: LlamaConfig) -> dict:
     """PartitionSpecs per parameter: tp shards head/ff dims, fsdp shards the
     complementary dim (ZeRO-3); layer axis is replicated (it is scanned)."""
-    return {
-        "embed": P(AXIS_TP, AXIS_FSDP),
-        "layers": {
-            "attn_norm": P(None, None),
-            "mlp_norm": P(None, None),
-            "wq": P(None, AXIS_FSDP, AXIS_TP),
-            "wk": P(None, AXIS_FSDP, AXIS_TP),
-            "wv": P(None, AXIS_FSDP, AXIS_TP),
-            "wo": P(None, AXIS_TP, AXIS_FSDP),
+    layers = {
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "wq": P(None, AXIS_FSDP, AXIS_TP),
+        "wk": P(None, AXIS_FSDP, AXIS_TP),
+        "wv": P(None, AXIS_FSDP, AXIS_TP),
+        "wo": P(None, AXIS_TP, AXIS_FSDP),
+    }
+    if cfg.is_moe:
+        from k8s_gpu_device_plugin_tpu.models.moe import moe_param_specs
+
+        layers.update(moe_param_specs())
+    else:
+        layers.update({
             "w1": P(None, AXIS_FSDP, AXIS_TP),
             "w3": P(None, AXIS_FSDP, AXIS_TP),
             "w2": P(None, AXIS_TP, AXIS_FSDP),
-        },
+        })
+    return {
+        "embed": P(AXIS_TP, AXIS_FSDP),
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(AXIS_FSDP, AXIS_TP),
     }
@@ -204,7 +247,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh: Mesh | None) -> jax.Array:
 
 
 def _block(x, layer, cfg: LlamaConfig, positions, mesh):
-    """One transformer block: (B, S, D) -> (B, S, D)."""
+    """One transformer block: (B, S, D) -> ((B, S, D), aux losses)."""
     b, s, d = x.shape
     hd = cfg.head_dim
 
@@ -222,20 +265,28 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
     x = x + constrain(attn @ layer["wo"], P(BATCH, AXIS_SP, None))
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-    up = h @ layer["w3"]
-    ff = constrain(gate * up, P(BATCH, AXIS_SP, AXIS_TP))
-    x = x + constrain(ff @ layer["w2"], P(BATCH, AXIS_SP, None))
-    return x
+    if cfg.is_moe:
+        from k8s_gpu_device_plugin_tpu.models.moe import moe_mlp
+
+        ff_out, aux = moe_mlp(h, layer, cfg)
+    else:
+        gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+        up = h @ layer["w3"]
+        ff = constrain(gate * up, P(BATCH, AXIS_SP, AXIS_TP))
+        ff_out = constrain(ff @ layer["w2"], P(BATCH, AXIS_SP, None))
+        aux = {}
+    x = x + ff_out
+    return x, aux
 
 
-def forward(
+def forward_with_aux(
     params: dict,
     tokens: jax.Array,
     cfg: LlamaConfig,
     mesh: Mesh | None = None,
-) -> jax.Array:
-    """Token ids (B, S) -> logits (B, S, V) in f32."""
+) -> tuple[jax.Array, dict]:
+    """Token ids (B, S) -> (logits (B, S, V) f32, aux losses summed over
+    layers — empty dict for dense configs, MoE balance/z terms otherwise)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, P(BATCH, AXIS_SP, None))
@@ -248,9 +299,21 @@ def forward(
         )
 
     def scan_body(carry, layer):
-        return block(carry, layer), None
+        out, aux = block(carry, layer)
+        return out, aux
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
+    aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
-    return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP))
+    return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP)), aux
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Token ids (B, S) -> logits (B, S, V) in f32."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]

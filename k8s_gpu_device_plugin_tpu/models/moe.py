@@ -1,0 +1,209 @@
+"""Mixture-of-Experts MLP, TPU-first (GShard/Switch-style dense dispatch).
+
+Why this shape and not a torch-style gather/scatter loop: TPUs want static
+shapes and big einsums. The canonical TPU MoE (GShard, Switch, Flaxformer)
+routes tokens with *capacity-based one-hot dispatch tensors* so that expert
+computation is one batched einsum over a (experts, capacity) buffer and the
+token shuffle is an all-to-all that XLA derives from sharding annotations on
+the dispatch einsums — no dynamic shapes, no sort, no host control flow.
+
+Reference framework has no MoE (it is a device-plugin daemon; SURVEY.md §2
+"parallelism strategies: absent in reference") — this exists because the
+rebuilt benchmark stack must exercise the ``ep`` mesh axis the same way real
+TPU workloads do.
+
+Pieces:
+- ``router``: f32 logits -> top-k gating (Mixtral-style renormalized top-k
+  softmax), Switch load-balancing aux loss + router z-loss.
+- dispatch/combine tensors (B, S, E, C) built from cumsum positions —
+  tokens over capacity are dropped (standard capacity_factor semantics).
+- expert FFN: stacked (E, d, f) SwiGLU weights, einsum'd with the expert
+  axis sharded over ``ep`` and the ff dim over ``tp``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    constrain,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+BATCH = (AXIS_DP, AXIS_FSDP)
+
+
+def expert_capacity(cfg: "LlamaConfig", seq_len: int) -> int:
+    """Per-expert token-slot budget for one batch row.
+
+    k slots are assigned per token, spread over E experts; capacity_factor
+    head-room absorbs routing imbalance. Always >= k so a single token can
+    never be dropped solely because E > S*k/E.
+    """
+    k = cfg.n_experts_per_token
+    ideal = seq_len * k / cfg.n_experts
+    return max(int(math.ceil(ideal * cfg.capacity_factor)), k)
+
+
+def router_topk(
+    router_logits: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(B,S,E) f32 logits -> (gates (B,S,k), expert idx (B,S,k), probs)."""
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, k)
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    return gates, idx, probs
+
+
+def load_balance_loss(probs: jax.Array, idx: jax.Array, n_experts: int) -> jax.Array:
+    """Switch-transformer aux loss: E * sum_e fraction_e * mean_prob_e.
+
+    Minimized (=1) at uniform routing; grows quadratically with imbalance.
+    Uses all k assignments for the dispatch fraction.
+    """
+    assign = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # (B,S,k,E)
+    fraction = jnp.mean(jnp.sum(assign, axis=2), axis=(0, 1))  # (E,) mean slots/token
+    fraction = fraction / jnp.maximum(jnp.sum(fraction), 1e-9)
+    mean_prob = jnp.mean(probs, axis=(0, 1))  # (E,)
+    return n_experts * jnp.sum(fraction * mean_prob)
+
+
+def make_dispatch_combine(
+    gates: jax.Array, idx: jax.Array, n_experts: int, capacity: int
+) -> tuple[jax.Array, jax.Array]:
+    """Build (B,S,E,C) dispatch mask and combine weights.
+
+    Slot positions come from a cumsum over the flattened (S*k) token-slot
+    axis per batch row; slots past ``capacity`` are dropped (their gate mass
+    is simply lost, as in GShard — combine weights were already renormalized
+    over top-k before drops).
+    """
+    b, s, k = gates.shape
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # (B,S,k,E)
+    flat = onehot.reshape(b, s * k, n_experts)
+    # position of each slot within its expert's buffer (first slot -> 0)
+    pos = jnp.cumsum(flat, axis=1) * flat - 1.0
+    within = flat * (pos < capacity).astype(jnp.float32)
+    slot = jnp.where(within > 0, pos, -1.0).astype(jnp.int32)  # -1 -> no slot
+    cap_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # (B,S*k,E,C)
+    dispatch = cap_onehot.reshape(b, s, k, n_experts, capacity)
+    combine = jnp.sum(dispatch * gates[..., None, None], axis=2)  # (B,S,E,C)
+    dispatch = jnp.sum(dispatch, axis=2)  # (B,S,E,C) 0/1
+    return dispatch, combine
+
+
+def _group_size(requested: int, seq_len: int) -> int:
+    """Largest divisor of seq_len that is <= the requested group size, so
+    long sequences NEVER fall through to the quadratic ungrouped dispatch
+    (for awkward seq lengths the groups just get smaller, which only
+    tightens capacity locality — numerics stay exact when capacity is
+    ample)."""
+    if requested <= 0 or requested >= seq_len:
+        return seq_len
+    for g in range(requested, 0, -1):
+        if seq_len % g == 0:
+            return g
+    return seq_len  # unreachable: 1 always divides
+
+
+def moe_mlp(
+    h: jax.Array, layer: dict, cfg: "LlamaConfig"
+) -> tuple[jax.Array, dict]:
+    """Sparse SwiGLU MoE layer: (B,S,D) -> ((B,S,D), aux losses).
+
+    ``layer`` carries ``router`` (D,E) and stacked expert weights
+    ``moe_w1``/``moe_w3`` (E,D,F), ``moe_w2`` (E,F,D). Expert axis is
+    sharded over ``ep``; the dispatch einsums below are where XLA inserts
+    the token all-to-all (tokens resharded batch->expert and back).
+
+    Long sequences are split into GShard-style *routing groups* of
+    ``cfg.moe_group_size`` tokens (capacity and dispatch tensors are per
+    group), keeping dispatch memory linear in S rather than quadratic —
+    without grouping, a 32k-seq Mixtral dispatch one-hot alone would be
+    ~20 GB/row. Routing decisions stay per-token; only the capacity
+    competition is group-local.
+    """
+    b, s, d = h.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+
+    g = _group_size(cfg.moe_group_size, s)
+    if g < s:
+        out, aux = moe_mlp(
+            h.reshape(b * (s // g), g, d), layer, cfg.with_group_size(0)
+        )
+        return out.reshape(b, s, d), aux
+    capacity = expert_capacity(cfg, s)
+
+    router_logits = h.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    gates, idx, probs = router_topk(router_logits, k)
+    aux = {
+        "moe_load_balance": load_balance_loss(probs, idx, E),
+        "moe_router_z": jnp.mean(
+            jnp.square(jax.nn.logsumexp(router_logits, axis=-1))
+        ),
+    }
+
+    dispatch, combine = make_dispatch_combine(gates, idx, E, capacity)
+
+    # tokens -> per-expert buffers (the forward all-to-all over ep)
+    expert_in = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(cfg.dtype), h
+    )
+    expert_in = constrain(expert_in, P(AXIS_EP, BATCH, None, None))
+
+    gate = jax.nn.silu(
+        jnp.einsum("ebcd,edf->ebcf", expert_in, layer["moe_w1"]).astype(jnp.float32)
+    ).astype(cfg.dtype)
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["moe_w3"])
+    ff = constrain(gate * up, P(AXIS_EP, BATCH, None, AXIS_TP))
+    expert_out = jnp.einsum("ebcf,efd->ebcd", ff, layer["moe_w2"])
+    expert_out = constrain(expert_out, P(AXIS_EP, BATCH, None, None))
+
+    # per-expert buffers -> tokens (the return all-to-all), gate-weighted
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(cfg.dtype), expert_out)
+    return constrain(out, P(BATCH, AXIS_SP, None)), aux
+
+
+def moe_param_init(key: jax.Array, cfg: "LlamaConfig") -> dict:
+    """Stacked (L, E, ...) expert weights + per-layer router."""
+    L, E, d, f = cfg.n_layers, cfg.n_experts, cfg.d_model, cfg.d_ff
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+    ks = jax.random.split(key, 4)
+
+    def init(key, shape, scale):
+        return (
+            jax.random.truncated_normal(key, -3, 3, shape, jnp.float32) * scale
+        ).astype(cfg.dtype)
+
+    return {
+        # router stays f32: tiny, and routing decisions are precision-sensitive
+        "router": jax.random.truncated_normal(
+            ks[0], -3, 3, (L, d, E), jnp.float32
+        ) * std,
+        "moe_w1": init(ks[1], (L, E, d, f), std),
+        "moe_w3": init(ks[2], (L, E, d, f), std),
+        "moe_w2": init(ks[3], (L, E, f, d), out_std),
+    }
+
+
+def moe_param_specs() -> dict:
+    """ep shards the expert axis, tp the ff dim, fsdp the model dim."""
+    return {
+        "router": P(None, None, None),
+        "moe_w1": P(None, AXIS_EP, AXIS_FSDP, AXIS_TP),
+        "moe_w3": P(None, AXIS_EP, AXIS_FSDP, AXIS_TP),
+        "moe_w2": P(None, AXIS_EP, AXIS_TP, AXIS_FSDP),
+    }

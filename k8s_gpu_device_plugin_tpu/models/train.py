@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_device_plugin_tpu.models.llama import (
     LlamaConfig,
-    forward,
+    forward_with_aux,
     init_params,
     param_shardings,
 )
@@ -63,9 +63,18 @@ def make_optimizer(
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh: Mesh | None):
-    logits = forward(params, batch["inputs"], cfg, mesh)
+    logits, aux = forward_with_aux(params, batch["inputs"], cfg, mesh)
     loss, accuracy = cross_entropy(logits, batch["targets"])
-    return loss, {"loss": loss, "accuracy": accuracy}
+    metrics = {"loss": loss, "accuracy": accuracy}
+    if aux:  # MoE: add router balance + z losses (weights from config)
+        total = (
+            loss
+            + cfg.moe_aux_weight * aux["moe_load_balance"]
+            + cfg.moe_z_weight * aux["moe_router_z"]
+        )
+        metrics.update(aux)
+        return total, metrics
+    return loss, metrics
 
 
 def make_train_step(

@@ -45,10 +45,11 @@ def supports(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
     """Shapes the kernel handles without padding logic."""
     if not _HAS_PLTPU:
         return False
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        return False
     b, s, h, d = q.shape
     return (
-        q.ndim == 4
-        and k.shape == v.shape
+        k.shape == v.shape
         and k.shape[0] == b
         and k.shape[1] == s
         and h % k.shape[2] == 0
@@ -289,9 +290,19 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """(B, S, H, D) flash attention; K/V may have grouped heads."""
+    """(B, S, H, D) flash attention; K/V may have grouped heads.
+
+    Raises on shapes the kernel cannot tile (the grid drops tail rows, so a
+    silent fallthrough would return uninitialized output): use
+    ``ops.attention.attention`` for automatic XLA fallback.
+    """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = q.shape[1]
     block_q = _fit_block(block_q, s)
     block_k = _fit_block(block_k, s)
+    if s % block_q != 0 or s % block_k != 0:
+        raise ValueError(
+            f"flash_attention: seq_len {s} not divisible by blocks "
+            f"({block_q}, {block_k}); pad the sequence or use ops.attention"
+        )
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -63,6 +63,20 @@ def test_supports_gates():
     assert not supports(q2, k2, v2)
     q3, k3, v3 = make_qkv(jax.random.key(3), d=32)  # narrow head dim
     assert not supports(q3, k3, v3)
+    # non-4D input: supports() must answer False, not raise
+    assert not supports(q[0], k[0], v[0])
+
+
+def test_flash_rejects_unaligned_seq():
+    """Tail rows past the last full block would be uninitialized; the entry
+    point must refuse rather than silently return garbage."""
+    import pytest
+
+    from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = make_qkv(jax.random.key(5), s=200)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, interpret=True)
 
 
 def test_flash_bf16():

@@ -1,0 +1,221 @@
+"""MoE layer tests: routing/dispatch numerics vs a dense per-expert oracle,
+aux-loss properties, capacity-drop behavior, and expert-parallel sharded
+training on the virtual 8-device CPU mesh.
+
+The reference framework has no MoE (SURVEY.md §2: parallelism absent in
+reference); the oracle here IS the spec: with capacity ample, each token's
+output must equal the top-k gate-weighted sum of its experts' SwiGLU FFNs.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    forward_with_aux,
+    init_params,
+)
+from k8s_gpu_device_plugin_tpu.models.moe import (
+    expert_capacity,
+    load_balance_loss,
+    make_dispatch_combine,
+    moe_mlp,
+    moe_param_init,
+    router_topk,
+)
+from k8s_gpu_device_plugin_tpu.models.train import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def moe_cfg(**overrides):
+    base = dict(n_experts=4, n_experts_per_token=2, capacity_factor=4.0)
+    base.update(overrides)
+    return LlamaConfig.tiny(**base)
+
+
+def single_layer(cfg, key):
+    """One layer's MoE params, unstacked from the (L, ...) pytree."""
+    stacked = moe_param_init(key, cfg)
+    return jax.tree.map(lambda w: w[0], stacked)
+
+
+def dense_oracle(h, layer, cfg):
+    """Per-token loop-free oracle: run EVERY expert on EVERY token, then
+    combine with the top-k gates. Correct whenever nothing is dropped."""
+    logits = h.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    gates, idx, _ = router_topk(logits, cfg.n_experts_per_token)
+    outs = []
+    for e in range(cfg.n_experts):
+        gate = jax.nn.silu(
+            (h @ layer["moe_w1"][e]).astype(jnp.float32)
+        ).astype(h.dtype)
+        up = h @ layer["moe_w3"][e]
+        outs.append((gate * up) @ layer["moe_w2"][e])
+    outs = jnp.stack(outs, axis=2)  # (B,S,E,D)
+    onehot = jax.nn.one_hot(idx, cfg.n_experts, dtype=jnp.float32)  # (B,S,k,E)
+    weights = jnp.sum(onehot * gates[..., None], axis=2)  # (B,S,E)
+    return jnp.einsum("bse,bsed->bsd", weights.astype(h.dtype), outs)
+
+
+def test_moe_matches_dense_oracle():
+    cfg = moe_cfg(dtype=jnp.float32)
+    layer = single_layer(cfg, jax.random.key(0))
+    h = jax.random.normal(jax.random.key(1), (2, 16, cfg.d_model), jnp.float32)
+    got, aux = moe_mlp(h, layer, cfg)
+    want = dense_oracle(h, layer, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    assert float(aux["moe_load_balance"]) >= 1.0 - 1e-5
+
+
+def test_dispatch_combine_shapes_and_mass():
+    gates = jnp.array([[[0.7, 0.3], [0.6, 0.4], [1.0, 0.0]]])  # (1,3,2)
+    idx = jnp.array([[[0, 1], [0, 2], [3, 0]]])
+    dispatch, combine = make_dispatch_combine(gates, idx, n_experts=4, capacity=4)
+    assert dispatch.shape == (1, 3, 4, 4)
+    # every slot landed (capacity ample): combine mass per token == 1
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(2, 3))), 1.0)
+    # expert 0 received tokens 0,1,2 in order at positions 0,1,2
+    assert float(dispatch[0, 0, 0, 0]) == 1.0
+    assert float(dispatch[0, 1, 0, 1]) == 1.0
+    assert float(dispatch[0, 2, 0, 2]) == 1.0
+
+
+def test_capacity_drops_tokens_not_numerics():
+    """Tiny capacity: overflow slots are dropped (less combine mass), and
+    the layer still produces finite outputs."""
+    cfg = moe_cfg(capacity_factor=0.25, dtype=jnp.float32)
+    layer = single_layer(cfg, jax.random.key(0))
+    h = jax.random.normal(jax.random.key(1), (2, 32, cfg.d_model), jnp.float32)
+    out, _ = moe_mlp(h, layer, cfg)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    # with the same inputs and ample capacity, outputs differ (drops happened)
+    ample = dataclasses.replace(cfg, capacity_factor=8.0)
+    full, _ = moe_mlp(h, layer, ample)
+    assert not np.allclose(np.asarray(out), np.asarray(full))
+
+
+def test_routing_groups_match_ungrouped_when_capacity_ample():
+    """Group-local capacity competition must be numerics-neutral when no
+    tokens are dropped; only dispatch-tensor shapes change."""
+    cfg = moe_cfg(capacity_factor=8.0, dtype=jnp.float32, moe_group_size=8)
+    layer = single_layer(cfg, jax.random.key(0))
+    h = jax.random.normal(jax.random.key(1), (2, 32, cfg.d_model), jnp.float32)
+    grouped, aux_g = moe_mlp(h, layer, cfg)
+    ungrouped, _ = moe_mlp(h, layer, cfg.with_group_size(0))
+    np.testing.assert_allclose(
+        np.asarray(grouped), np.asarray(ungrouped), atol=1e-5
+    )
+    assert bool(jnp.isfinite(aux_g["moe_load_balance"]))
+
+
+def test_group_size_always_divides():
+    from k8s_gpu_device_plugin_tpu.models.moe import _group_size
+
+    assert _group_size(4096, 32768) == 4096
+    assert _group_size(4096, 10000) == 2500  # largest divisor <= 4096
+    assert _group_size(4096, 9973) == 1      # prime: per-token groups
+    assert _group_size(0, 128) == 128        # disabled -> one group
+    assert _group_size(256, 128) == 128      # request >= seq -> one group
+    for req, s in [(4096, 10000), (7, 30), (13, 64)]:
+        g = _group_size(req, s)
+        assert s % g == 0 and g <= max(req, s)
+
+
+def test_odd_seq_len_routes_through_groups():
+    """A seq length not divisible by the requested group size must still be
+    grouped (smaller divisor groups), never the quadratic fallthrough."""
+    cfg = moe_cfg(capacity_factor=8.0, dtype=jnp.float32, moe_group_size=8)
+    layer = single_layer(cfg, jax.random.key(0))
+    h = jax.random.normal(jax.random.key(1), (1, 24, cfg.d_model), jnp.float32)
+    out, _ = moe_mlp(h, layer, cfg)  # 24 % 8 == 0 -> groups of 8
+    out_odd, _ = moe_mlp(
+        jax.random.normal(jax.random.key(2), (1, 30, cfg.d_model), jnp.float32),
+        layer,
+        cfg,  # 30 % 8 != 0 -> groups of 6
+    )
+    assert bool(jnp.all(jnp.isfinite(out))) and bool(jnp.all(jnp.isfinite(out_odd)))
+
+
+def test_load_balance_loss_uniform_is_one():
+    b, s, E = 4, 32, 8
+    probs = jnp.full((b, s, E), 1.0 / E)
+    # perfectly balanced assignments: round-robin over experts
+    idx = (jnp.arange(s)[None, :, None] + jnp.arange(2)[None, None, :]) % E
+    idx = jnp.broadcast_to(idx, (b, s, 2))
+    loss = load_balance_loss(probs, idx, E)
+    np.testing.assert_allclose(float(loss), 1.0, rtol=1e-5)
+
+
+def test_load_balance_loss_collapsed_is_high():
+    b, s, E = 2, 16, 8
+    probs = jnp.zeros((b, s, E)).at[..., 0].set(1.0)
+    idx = jnp.zeros((b, s, 2), jnp.int32)
+    loss = load_balance_loss(probs, idx, E)
+    assert float(loss) == pytest.approx(E, rel=1e-5)
+
+
+def test_expert_capacity_floor():
+    cfg = moe_cfg(n_experts=64, n_experts_per_token=2, capacity_factor=1.0)
+    # 8 tokens over 64 experts: ideal capacity <1, floor keeps k slots
+    assert expert_capacity(cfg, 8) >= 2
+
+
+def test_moe_forward_aux_and_flops():
+    cfg = moe_cfg()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits, aux = forward_with_aux(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert set(aux) == {"moe_load_balance", "moe_router_z"}
+    assert all(bool(jnp.isfinite(v)) for v in aux.values())
+    dense = LlamaConfig.tiny()
+    # activated-param FLOPs: k=2 experts ~ 2x dense MLP term
+    assert cfg.flops_per_token() > dense.flops_per_token()
+
+
+def test_moe_train_step_ep_sharded():
+    """Full train step with a real ep axis: dp=2, ep=2, tp=2 over 8 CPU
+    devices; loss finite and decreasing over a few overfit steps."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2), jax.devices()[:8])
+    cfg = moe_cfg(n_layers=2)
+    optimizer = make_optimizer(total_steps=10, warmup_steps=0, learning_rate=1e-2)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, batch_size=4, seq_len=32, mesh=mesh)
+    step = make_train_step(cfg, mesh, optimizer)
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert "moe_load_balance" in metrics
+
+
+def test_moe_sharded_matches_unsharded():
+    """The ep/tp-sharded forward must equal the single-device forward —
+    sharding is an implementation detail, not a numerics change."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg = moe_cfg(n_layers=1, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    want, _ = forward_with_aux(params, tokens, cfg)
+    mesh = make_mesh(MeshSpec(ep=2, tp=2), jax.devices()[:4])
+    from k8s_gpu_device_plugin_tpu.models.llama import param_shardings
+
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    got, _ = jax.jit(
+        lambda p, t: forward_with_aux(p, t, cfg, mesh)
+    )(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
