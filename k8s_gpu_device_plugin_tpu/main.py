@@ -23,6 +23,8 @@ from k8s_gpu_device_plugin_tpu.server.server import Server
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 from k8s_gpu_device_plugin_tpu.utils.log import LogConfig, init_logger
 
+SHUTDOWN_TIMEOUT_SECONDS = 10.0  # bounded SIGTERM drain (2x the 5s dial timeout)
+
 
 async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> None:
     """Run manager + HTTP server until the stop event fires."""
@@ -68,9 +70,20 @@ async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> No
         stop.set()
         stop_task.cancel()
         await manager.stop()
-        await asyncio.gather(
-            manager_task, server_task, stop_task, return_exceptions=True
-        )
+        tasks = (manager_task, server_task, stop_task)
+        try:
+            # Bounded drain: if an actor is wedged (e.g. a gRPC call with a
+            # peer that stopped answering), cancel it rather than hang the
+            # whole process on SIGTERM.
+            await asyncio.wait_for(
+                asyncio.gather(*tasks, return_exceptions=True),
+                timeout=SHUTDOWN_TIMEOUT_SECONDS,
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            logger.warning("shutdown deadline exceeded; cancelling actors")
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
         if profiler is not None:
             profiler.stop()
         logger.info("daemon stopped")

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from k8s_gpu_device_plugin_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_FSDP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     constrain,
@@ -68,6 +69,9 @@ class LlamaConfig:
     # this many tokens, keeping dispatch-tensor memory linear in seq length
     # (0 = one group per batch row).
     moe_group_size: int = 4096
+    # pipeline parallelism: microbatches per step when the mesh has pp > 1
+    # (bubble fraction is (pp-1)/(n_microbatches+pp-1))
+    n_microbatches: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -173,9 +177,11 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     }
 
 
-def param_specs(cfg: LlamaConfig) -> dict:
+def param_specs(cfg: LlamaConfig, pp: int = 1) -> dict:
     """PartitionSpecs per parameter: tp shards head/ff dims, fsdp shards the
-    complementary dim (ZeRO-3); layer axis is replicated (it is scanned)."""
+    complementary dim (ZeRO-3); layer axis is replicated (it is scanned).
+    With ``pp > 1`` every layer leaf gains a leading *stage* dimension
+    sharded over ``pp`` (shape (pp, L//pp, ...), see parallel/pipeline.py)."""
     layers = {
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),
@@ -194,6 +200,8 @@ def param_specs(cfg: LlamaConfig) -> dict:
             "w3": P(None, AXIS_FSDP, AXIS_TP),
             "w2": P(None, AXIS_TP, AXIS_FSDP),
         })
+    if pp > 1:
+        layers = {k: P(AXIS_PP, *spec) for k, spec in layers.items()}
     return {
         "embed": P(AXIS_TP, AXIS_FSDP),
         "layers": layers,
@@ -203,9 +211,10 @@ def param_specs(cfg: LlamaConfig) -> dict:
 
 
 def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
+    pp = mesh.shape.get(AXIS_PP, 1)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg),
+        param_specs(cfg, pp=pp),
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -298,12 +307,41 @@ def forward_with_aux(
             block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
 
-    def scan_body(carry, layer):
-        out, aux = block(carry, layer)
-        return out, aux
+    pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1
+    if pp > 1:
+        # Looped GSPMD pipeline (parallel/pipeline.py): embed/head are cheap
+        # and replicated over pp; only the block stack is pipelined.
+        if cfg.is_moe:
+            raise NotImplementedError(
+                "MoE aux-loss collection through the pipeline is not "
+                "supported yet; use pp=1 for MoE configs"
+            )
+        from k8s_gpu_device_plugin_tpu.parallel.pipeline import pipeline_blocks
 
-    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
-    aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
+        def stage_fn(stage_layers, h):
+            def body(carry, layer):
+                out, _ = block(carry, layer)
+                return out, None
+
+            h, _ = jax.lax.scan(body, h, stage_layers)
+            return h
+
+        x = pipeline_blocks(
+            stage_fn,
+            params["layers"],
+            x,
+            n_stages=pp,
+            n_microbatches=max(cfg.n_microbatches, 1),
+        )
+        aux = {}
+    else:
+
+        def scan_body(carry, layer):
+            out, aux = block(carry, layer)
+            return out, aux
+
+        x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
+        aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP)), aux

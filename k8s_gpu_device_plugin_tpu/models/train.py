@@ -24,7 +24,12 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     init_params,
     param_shardings,
 )
-from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP
+from k8s_gpu_device_plugin_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+)
 
 
 def cross_entropy(
@@ -109,11 +114,21 @@ def init_train_state(
     optimizer: optax.GradientTransformation,
 ) -> dict:
     """Initialize params directly into their target shardings (no host-side
-    full materialization), then the optimizer state (inherits shardings)."""
+    full materialization), then the optimizer state (inherits shardings).
+    With pp > 1 the layer leaves are reshaped to (pp, L//pp, ...) so the
+    stage dimension shards over the pipeline axis."""
     shardings = param_shardings(cfg, mesh)
-    params = jax.jit(
-        partial(init_params, cfg=cfg), out_shardings=shardings
-    )(key)
+    pp = mesh.shape.get(AXIS_PP, 1)
+
+    def init_fn(key):
+        params = init_params(key, cfg)
+        if pp > 1:
+            from k8s_gpu_device_plugin_tpu.parallel.pipeline import stack_for_stages
+
+            params = {**params, "layers": stack_for_stages(params["layers"], pp)}
+        return params
+
+    params = jax.jit(init_fn, out_shardings=shardings)(key)
     opt_state = jax.jit(optimizer.init)(params)
     return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 

@@ -12,11 +12,17 @@ from k8s_gpu_device_plugin_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_EP,
     AXIS_FSDP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     MeshSpec,
     batch_spec,
     make_mesh,
+)
+from k8s_gpu_device_plugin_tpu.parallel.pipeline import (
+    pipeline_blocks,
+    stack_for_stages,
+    unstack_stages,
 )
 from k8s_gpu_device_plugin_tpu.parallel.ring_attention import ring_attention
 from k8s_gpu_device_plugin_tpu.parallel.ulysses import ulysses_attention
@@ -27,9 +33,13 @@ __all__ = [
     "AXIS_TP",
     "AXIS_SP",
     "AXIS_EP",
+    "AXIS_PP",
     "MeshSpec",
     "make_mesh",
     "batch_spec",
+    "pipeline_blocks",
+    "stack_for_stages",
+    "unstack_stages",
     "ring_attention",
     "ulysses_attention",
 ]

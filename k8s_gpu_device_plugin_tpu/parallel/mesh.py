@@ -33,10 +33,12 @@ AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 AXIS_EP = "ep"
+AXIS_PP = "pp"
 
-# Outer-to-inner order: dp/fsdp ride DCN / outer ICI; tp/sp want the
-# innermost (fastest, all-neighbors) ICI links.
-AXIS_ORDER = (AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+# Outer-to-inner order: dp/pp ride DCN / outer ICI (pipeline stage hops are
+# infrequent point-to-point transfers, tolerant of low bandwidth); fsdp next;
+# tp/sp want the innermost (fastest, all-neighbors) ICI links.
+AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 @dataclass(frozen=True)
@@ -49,14 +51,16 @@ class MeshSpec:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
 
     def sizes(self) -> dict[str, int]:
         return {
             AXIS_DP: self.dp,
+            AXIS_PP: self.pp,
             AXIS_FSDP: self.fsdp,
             AXIS_EP: self.ep,
             AXIS_SP: self.sp,
@@ -65,18 +69,23 @@ class MeshSpec:
 
     @staticmethod
     def for_devices(
-        n: int, tp: int = 1, sp: int = 1, ep: int = 1, fsdp: int | None = None
+        n: int,
+        tp: int = 1,
+        sp: int = 1,
+        ep: int = 1,
+        pp: int = 1,
+        fsdp: int | None = None,
     ) -> "MeshSpec":
-        """Fill dp (or fsdp) with whatever ``n`` leaves over tp*sp*ep."""
-        inner = tp * sp * ep
+        """Fill dp (or fsdp) with whatever ``n`` leaves over tp*sp*ep*pp."""
+        inner = tp * sp * ep * pp
         if n % inner != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp*ep={inner}")
+            raise ValueError(f"{n} devices not divisible by tp*sp*ep*pp={inner}")
         rest = n // inner
         if fsdp is None:
-            return MeshSpec(dp=rest, fsdp=1, tp=tp, sp=sp, ep=ep)
+            return MeshSpec(dp=rest, fsdp=1, tp=tp, sp=sp, ep=ep, pp=pp)
         if rest % fsdp != 0:
             raise ValueError(f"remainder {rest} not divisible by fsdp={fsdp}")
-        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+        return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp)
 
 
 def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:

@@ -1,0 +1,126 @@
+"""Pipeline parallelism: GSPMD looped pipeline over the ``pp`` mesh axis.
+
+TPU-first construction (no reference analogue — the reference daemon has no
+parallelism, SURVEY §2): instead of per-stage processes exchanging
+activations over point-to-point sends (the GPU/NCCL idiom), the pipeline is
+a single SPMD program. Layer-stacked parameters get an extra leading
+*stage* dimension sharded over ``pp``; a circulating activation buffer of
+shape ``(pp, microbatch, S, D)`` is also sharded over ``pp`` on its stage
+dimension. One pipeline *tick* is:
+
+1. ``jnp.roll(state, 1, axis=0)`` — because the stage dim is sharded over
+   ``pp``, XLA lowers this to a single collective-permute hop per tick
+   (stage i's output moves to stage i+1's device over ICI/DCN);
+2. stage 0's slot is overwritten with the next microbatch;
+3. ``vmap`` over the stage dimension applies every stage's layers to the
+   microbatch it currently holds — all devices compute every tick.
+
+Running ``n_microbatches + pp - 1`` ticks drains the pipeline; the bubble
+fraction is the usual ``(pp-1)/(M+pp-1)``. Autodiff just works: the
+transpose of roll is roll, so the backward pass pipelines in reverse with
+no hand-written schedule. This is the standard JAX/XLA pipelining idiom
+(as used by MaxText/praxis) rather than a port of torch-style stage
+processes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+    constrain,
+)
+
+
+def stack_for_stages(layer_params, n_stages: int):
+    """Reshape layer-stacked params (L, ...) -> (pp, L//pp, ...).
+
+    Layer order is preserved: stage 0 gets layers [0, L/pp), stage 1 the
+    next chunk, etc.
+    """
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(
+                f"layer count {L} not divisible by pp={n_stages}"
+            )
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+
+    return jax.tree.map(reshape, layer_params)
+
+
+def unstack_stages(layer_params):
+    """Inverse of :func:`stack_for_stages`: (pp, Lp, ...) -> (L, ...)."""
+    return jax.tree.map(
+        lambda leaf: leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:]),
+        layer_params,
+    )
+
+
+def pipeline_blocks(
+    stage_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    *,
+    n_stages: int,
+    n_microbatches: int,
+) -> jax.Array:
+    """Run ``x`` (B, S, D) through ``n_stages`` pipeline stages.
+
+    ``stage_fn(stage_params_i, x_mb) -> x_mb`` applies ONE stage's layers to
+    one microbatch; it is vmapped over the leading stage dimension of
+    ``stage_params`` (each leaf shaped (pp, L//pp, ...), sharded over
+    ``pp``). ``n_microbatches`` must divide the batch B.
+    """
+    B, S, D = x.shape
+    M = n_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by n_microbatches={M}")
+    mb = B // M
+
+    inputs = x.reshape(M, mb, S, D)
+    state_spec = P(AXIS_PP, (AXIS_DP, AXIS_FSDP), AXIS_SP, None)
+    state = jnp.zeros((n_stages, mb, S, D), x.dtype)
+    state = constrain(state, state_spec)
+    outputs = jnp.zeros((M, mb, S, D), x.dtype)
+    outputs = constrain(outputs, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP, None))
+
+    # spmd_axis_name keeps the vmapped stage dimension sharded over pp when
+    # stage_fn crosses a shard_map boundary (ring/ulysses attention): without
+    # it the batching rule threads the stage dim in replicated, all-gathering
+    # q/k/v over pp and making every device compute every stage's attention.
+    vstages = jax.vmap(stage_fn, spmd_axis_name=AXIS_PP)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage-dim roll = one collective-permute hop stage i -> i+1
+        state = jnp.roll(state, 1, axis=0)
+        inp = jax.lax.dynamic_index_in_dim(
+            inputs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        state = state.at[0].set(inp)
+        state = constrain(state, state_spec)
+        state = vstages(stage_params, state)
+        state = constrain(state, state_spec)
+        # collect the last stage's result once the pipeline has filled
+        done = state[n_stages - 1]
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, done, out_idx, axis=0
+        )
+        outputs = jnp.where(t >= n_stages - 1, updated, outputs)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(M + n_stages - 1)
+    )
+    return outputs.reshape(B, S, D)

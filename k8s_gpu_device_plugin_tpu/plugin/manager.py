@@ -108,7 +108,23 @@ class PluginManager:
                         raise t.exception()
                 if self._restart_event.is_set():
                     self._restart_event.clear()
-                    await self._restart_plugins()
+                    # Race the restart against stop so shutdown never waits
+                    # on a wedged re-registration (e.g. unresponsive kubelet).
+                    restart_task = asyncio.create_task(self._restart_plugins())
+                    stop_wait = asyncio.create_task(self._stop_event.wait())
+                    done, _ = await asyncio.wait(
+                        {restart_task, stop_wait},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if restart_task in done:
+                        stop_wait.cancel()
+                        if restart_task.exception() is not None:
+                            raise restart_task.exception()
+                    else:
+                        restart_task.cancel()
+                    await asyncio.gather(
+                        restart_task, stop_wait, return_exceptions=True
+                    )
         finally:
             for t in self._tasks:
                 t.cancel()

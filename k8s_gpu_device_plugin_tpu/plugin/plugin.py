@@ -107,6 +107,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 channel.channel_ready(), timeout=DIAL_TIMEOUT_SECONDS
             )
             stub = api.RegistrationStub(channel)
+            # Deadline on the RPC itself, not just the dial: a kubelet that
+            # accepts the connection but never answers would otherwise wedge
+            # plugin start (and any in-flight restart) forever.
             await stub.Register(
                 pb.RegisterRequest(
                     version=api.VERSION,
@@ -115,7 +118,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     options=pb.DevicePluginOptions(
                         get_preferred_allocation_available=True
                     ),
-                )
+                ),
+                timeout=DIAL_TIMEOUT_SECONDS,
             )
 
     async def stop(self) -> None:

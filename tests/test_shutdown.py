@@ -1,0 +1,85 @@
+"""Shutdown robustness: a stalled kubelet must not wedge start or SIGTERM.
+
+Regression tests for two defects found by driving the real daemon: the
+Register RPC had no deadline (only the dial did, cf. plugin.go:130,141), so
+a kubelet that accepts connections but never answers blocked plugin start —
+and an in-flight restart — forever, which in turn made the manager ignore
+stop() indefinitely.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.plugin import plugin as plugin_mod
+from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
+from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+
+class StalledKubelet(FakeKubelet):
+    """Accepts the connection and the RPC, then never answers Register."""
+
+    async def Register(self, request, context):
+        await asyncio.sleep(3600)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def test_register_times_out_against_stalled_kubelet(tmp_path, monkeypatch):
+    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.5)
+
+    async def body():
+        kubelet = StalledKubelet(str(tmp_path))
+        await kubelet.start()
+        cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
+        manager = PluginManager(
+            cfg, Latch(), backend=FakeBackend("v5e-4"), health_interval=30
+        )
+        manager._load_plugins()
+        plugin = manager.plugins[0]
+        t0 = time.monotonic()
+        with pytest.raises(Exception):  # noqa: B017 - any deadline error
+            await plugin.start()
+        assert time.monotonic() - t0 < 5.0, "Register must hit its deadline"
+        await plugin.stop()
+        await kubelet.stop()
+
+    run(body())
+
+
+def test_stop_during_wedged_restart_returns_promptly(tmp_path, monkeypatch):
+    """stop() while a restart is stuck re-registering must still tear down."""
+    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 30.0)
+
+    async def body():
+        kubelet = FakeKubelet(str(tmp_path))
+        await kubelet.start()
+        cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
+        ready = Latch()
+        manager = PluginManager(
+            cfg, ready, backend=FakeBackend("v5e-4"), health_interval=30
+        )
+        task = asyncio.create_task(manager.start())
+        await asyncio.wait_for(ready.wait_async(), 10)
+
+        # Swap the healthy kubelet for one that never answers, then restart:
+        # the re-register leg wedges (30s deadline >> test budget).
+        await kubelet.stop()
+        stalled = StalledKubelet(str(tmp_path))
+        await stalled.start()
+        manager.restart()
+        await asyncio.sleep(0.3)  # let the restart reach the Register call
+
+        t0 = time.monotonic()
+        await manager.stop()
+        await asyncio.wait_for(task, 5)
+        assert time.monotonic() - t0 < 5.0
+        await stalled.stop()
+
+    run(body())
