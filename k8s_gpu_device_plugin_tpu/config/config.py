@@ -55,12 +55,64 @@ class Config:
     slice_plan: str = ""                       # for strategy "mixed", e.g. "2x2,2x2"
     shared_replicas: int = 0                   # >0 => time-sliced sharing
 
+    # Multi-host slice membership (SURVEY §7 hard parts; BASELINE config #5).
+    # Empty sliceTopology = single-host operation (the reference's only mode).
+    slice_topology: str = ""                   # FULL slice, e.g. "v5p-32"
+    worker_id: int = 0                         # this host's rank in the slice
+    worker_hostnames: str = ""                 # comma list, rank order
+    # Multislice (DCN-connected slices): exported as MEGASCALE_* envs.
+    num_slices: int = 1
+    slice_id: int = 0
+    megascale_coordinator: str = ""            # host:port of slice-0 worker-0
+
     def validate(self) -> None:
         if self.slice_strategy not in _VALID_STRATEGIES:
             raise ValueError(
                 f"sliceStrategy must be one of {_VALID_STRATEGIES}, "
                 f"got {self.slice_strategy!r}"
             )
+        if self.worker_id < 0:
+            raise ValueError(f"workerId must be >= 0, got {self.worker_id}")
+        hostnames = self.worker_hostname_list
+        if self.slice_topology:
+            # A multi-host slice cannot rendezvous without its peer list —
+            # missing hostnames would hang every pod at jax.distributed init.
+            if not hostnames:
+                raise ValueError(
+                    "workerHostnames is required when sliceTopology is set"
+                )
+            if self.worker_id >= len(hostnames):
+                raise ValueError(
+                    f"workerId {self.worker_id} out of range for "
+                    f"{len(hostnames)} workerHostnames"
+                )
+        if not 0 <= self.slice_id < self.num_slices:
+            raise ValueError(
+                f"sliceId {self.slice_id} out of range for {self.num_slices} slices"
+            )
+        if self.num_slices > 1:
+            # Without one shared coordinator each slice dials its own
+            # worker-0 and every pod hangs at jax.distributed init; without
+            # hostnames pods cannot even count the job's processes.
+            if not self.megascale_coordinator:
+                raise ValueError(
+                    "megascaleCoordinator is required when numSlices > 1"
+                )
+            if not self.worker_hostname_list:
+                raise ValueError(
+                    "workerHostnames is required when numSlices > 1"
+                )
+        if self.shared_replicas > 0 and (self.slice_topology or self.num_slices > 1):
+            # Time-sliced sharing hands the same chips to several pods; a
+            # distributed job would then see duplicate worker ranks on one
+            # ICI mesh — undefined libtpu behavior. Refuse the combination.
+            raise ValueError(
+                "sharedReplicas cannot be combined with sliceTopology/numSlices"
+            )
+
+    @property
+    def worker_hostname_list(self) -> list[str]:
+        return [h.strip() for h in self.worker_hostnames.split(",") if h.strip()]
 
     @property
     def listen_addr(self) -> tuple[str, int]:
@@ -85,6 +137,12 @@ _KEY_MAP = {
     "sliceShape": "slice_shape",
     "slicePlan": "slice_plan",
     "sharedReplicas": "shared_replicas",
+    "sliceTopology": "slice_topology",
+    "workerId": "worker_id",
+    "workerHostnames": "worker_hostnames",
+    "numSlices": "num_slices",
+    "sliceId": "slice_id",
+    "megascaleCoordinator": "megascale_coordinator",
 }
 
 
@@ -122,6 +180,12 @@ def load_config(
     parser.add_argument("--sliceShape", default=None)
     parser.add_argument("--slicePlan", default=None)
     parser.add_argument("--sharedReplicas", default=None, type=int)
+    parser.add_argument("--sliceTopology", default=None)
+    parser.add_argument("--workerId", default=None, type=int)
+    parser.add_argument("--workerHostnames", default=None)
+    parser.add_argument("--numSlices", default=None, type=int)
+    parser.add_argument("--sliceId", default=None, type=int)
+    parser.add_argument("--megascaleCoordinator", default=None)
     parser.add_argument("--logLevel", default=None)
     parser.add_argument("--logFileDir", default=None)
     args = parser.parse_args(argv)
@@ -151,6 +215,12 @@ def load_config(
         "sliceShape": args.sliceShape,
         "slicePlan": args.slicePlan,
         "sharedReplicas": args.sharedReplicas,
+        "sliceTopology": args.sliceTopology,
+        "workerId": args.workerId,
+        "workerHostnames": args.workerHostnames,
+        "numSlices": args.numSlices,
+        "sliceId": args.sliceId,
+        "megascaleCoordinator": args.megascaleCoordinator,
     }
     _apply_mapping(cfg, {k: v for k, v in flag_overrides.items() if v is not None})
     if args.logLevel is not None:

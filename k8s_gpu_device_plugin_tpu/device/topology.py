@@ -17,7 +17,7 @@ from __future__ import annotations
 import itertools
 import math
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 
 @dataclass(frozen=True)
@@ -104,6 +104,39 @@ class HostTopology:
     def num_chips(self) -> int:
         return math.prod(self.bounds)
 
+    # --- multi-host slice placement (SURVEY §7 hard parts: "multi-host
+    # slices"; reference never faced cross-node anything) ---
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.slice_bounds is not None and self.slice_bounds != self.bounds
+
+    @property
+    def host_grid(self) -> tuple[int, ...]:
+        """Process grid: how many hosts tile the slice along each axis.
+
+        This is exactly libtpu's ``TPU_PROCESS_BOUNDS``; the host-local
+        ``bounds`` is its ``TPU_CHIPS_PER_PROCESS_BOUNDS``.
+        """
+        if self.slice_bounds is None:
+            return tuple(1 for _ in self.bounds)
+        return tuple(s // b for s, b in zip(self.slice_bounds, self.bounds))
+
+    @property
+    def num_hosts(self) -> int:
+        return math.prod(self.host_grid)
+
+    @property
+    def worker_index(self) -> int:
+        """This host's rank in the slice (row-major over ``host_grid``),
+        the value libtpu expects in ``TPU_WORKER_ID``."""
+        if self.slice_bounds is None or not self.host_offset:
+            return 0
+        idx = 0
+        for off, b, g in zip(self.host_offset, self.bounds, self.host_grid):
+            idx = idx * g + off // b
+        return idx
+
     def coords(self) -> list[tuple[int, ...]]:
         """Host-local chip coordinates in index order (row-major)."""
         return list(itertools.product(*(range(b) for b in self.bounds)))
@@ -133,6 +166,61 @@ class HostTopology:
                     n[axis] %= bound
                     out.append(tuple(n))
         return out
+
+
+def as_slice_member(
+    host: HostTopology, slice_spec: str, worker_id: int
+) -> HostTopology:
+    """Place a host's chips inside a multi-host slice.
+
+    ``slice_spec`` names the FULL slice (e.g. ``v5p-32`` = 8 hosts of 4
+    chips); ``worker_id`` is this host's rank. The host tile is ``host.bounds``
+    (what the backend enumerated); the slice must tile evenly by it. Hosts are
+    ranked row-major over the host grid — the same convention
+    ``worker_index`` inverts, and the order multi-host deployments list
+    workers in ``TPU_WORKER_HOSTNAMES``.
+
+    The reference's device model was strictly single-node (SURVEY §7 "the
+    reference never faced cross-node anything"); this is the TPU-native
+    extension that makes BASELINE config #5 (v5p-32 multi-host) schedulable.
+    """
+    full = parse_topology(slice_spec)
+    if full.generation.name != host.generation.name:
+        raise ValueError(
+            f"slice generation {full.generation.name} != host {host.generation.name}"
+        )
+    slice_bounds = full.bounds
+    if len(slice_bounds) != len(host.bounds):
+        raise ValueError(
+            f"slice shape {slice_bounds} and host shape {host.bounds} differ in rank"
+        )
+    if any(s % b != 0 for s, b in zip(slice_bounds, host.bounds)):
+        raise ValueError(
+            f"slice {slice_bounds} does not tile evenly by host {host.bounds}"
+        )
+    # Full-torus wraparound exists only when the slice closes each axis; a
+    # single host's sub-mesh never wraps onto itself.
+    placed = HostTopology(
+        generation=host.generation,
+        bounds=host.bounds,
+        slice_bounds=slice_bounds,
+        host_offset=tuple(0 for _ in host.bounds),
+        wraparound=tuple(False for _ in host.bounds),
+    )
+    grid = placed.host_grid
+    if not 0 <= worker_id < placed.num_hosts:
+        raise ValueError(
+            f"workerId {worker_id} out of range for {placed.num_hosts} hosts"
+        )
+    # row-major unravel of worker_id over the host grid (the inverse of
+    # HostTopology.worker_index)
+    offset = []
+    rem = worker_id
+    for g in reversed(grid):
+        offset.append(rem % g)
+        rem //= g
+    offset = tuple(o * b for o, b in zip(reversed(offset), host.bounds))
+    return replace(placed, host_offset=offset)
 
 
 _TOPOLOGY_RE = re.compile(r"^(v\d+[a-z]*)-(\d+)$")

@@ -1,0 +1,171 @@
+"""Multi-host / multislice workload initialization and mesh construction.
+
+The daemon side of multi-host scheduling lives in plugin/plugin.py (Allocate
+injects TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / TPU_PROCESS_BOUNDS /
+MEGASCALE_*); this module is the matching WORKLOAD side: a pod entrypoint
+calls :func:`initialize` before any other JAX API, then builds a global mesh
+with :func:`make_global_mesh`, and every pjit'd step function works unchanged
+— XLA routes intra-slice collectives over ICI and inter-slice traffic over
+DCN (the scaling-book recipe; the reference has no analogue — its only
+cross-process channel was kubelet gRPC, SURVEY §2 "distributed communication
+backend: absent").
+
+Design notes:
+- ``jax.distributed.initialize`` wants (coordinator, num_processes,
+  process_id); all three derive from the envs the plugin injected, so the
+  common case is a zero-argument call.
+- The DCN axis must be OUTERMOST: ``mesh_utils.create_hybrid_device_mesh``
+  places slow (DCN) axes first, matching parallel/mesh.py's AXIS_ORDER where
+  dp/pp lead — gradient all-reduces tolerate DCN latency, per-layer tp/sp
+  collectives do not.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_ORDER, MeshSpec, make_mesh
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class WorkerEnv:
+    """The multi-host identity a pod reads from its plugin-injected envs."""
+
+    worker_id: int
+    hostnames: tuple[str, ...]
+    num_slices: int = 1
+    slice_id: int = 0
+    coordinator: str = ""    # MEGASCALE_COORDINATOR_ADDRESS (multislice only)
+
+    @property
+    def num_workers(self) -> int:
+        return max(len(self.hostnames), 1) * self.num_slices
+
+    @property
+    def process_id(self) -> int:
+        """Global process rank: slices are ranked outer, workers inner."""
+        return self.slice_id * max(len(self.hostnames), 1) + self.worker_id
+
+    @property
+    def coordinator_host(self) -> str:
+        """Host every process must agree on: for multislice that is the
+        MEGASCALE coordinator (slice 0 / worker 0 of the JOB — hostnames[0]
+        is only slice-local and would split the job into per-slice groups);
+        single slice, the rank-0 worker."""
+        if self.num_slices > 1 and self.coordinator:
+            return self.coordinator.rsplit(":", 1)[0]
+        return self.hostnames[0] if self.hostnames else "localhost"
+
+
+def worker_env() -> WorkerEnv | None:
+    """Parse the plugin's env contract; None on single-process pods.
+
+    A pod is distributed if it has peers on its own slice
+    (TPU_WORKER_HOSTNAMES) OR peers on other slices (MEGASCALE_NUM_SLICES>1)
+    — gating on hostnames alone would silently skip jax.distributed init for
+    a multislice job of single-host slices.
+    """
+    hostnames = tuple(
+        h.strip()
+        for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+        if h.strip()
+    )
+    if not hostnames and int(os.environ.get("MEGASCALE_NUM_SLICES", "1")) <= 1:
+        return None
+    return WorkerEnv(
+        worker_id=int(os.environ.get("TPU_WORKER_ID", "0")),
+        hostnames=hostnames,
+        num_slices=int(os.environ.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(os.environ.get("MEGASCALE_SLICE_ID", "0")),
+        coordinator=os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", ""),
+    )
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    port: int = DEFAULT_COORDINATOR_PORT,
+) -> WorkerEnv | None:
+    """``jax.distributed.initialize`` from the plugin's Allocate envs.
+
+    Call FIRST in a multi-host pod (before any jax.devices()/jit). On a
+    single-process pod (no TPU_WORKER_HOSTNAMES) this is a no-op, so the
+    same entrypoint works at every scale.
+    """
+    env = worker_env()
+    if env is None and coordinator_address is None:
+        return None
+    if coordinator_address is None:
+        coordinator_address = f"{env.coordinator_host}:{port}"
+    if num_processes is None:
+        num_processes = env.num_workers if env else 1
+    if process_id is None:
+        process_id = env.process_id if env else 0
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return env
+
+
+def make_global_mesh(
+    spec: MeshSpec,
+    num_slices: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Global mesh over every process's devices, DCN-aware.
+
+    For one slice this is parallel/mesh.make_mesh over ``jax.devices()``
+    (which, after :func:`initialize`, spans hosts). For multislice, the
+    leading dp axis is split over DCN: dp must be a multiple of
+    ``num_slices`` and each slice keeps dp/num_slices of it locally.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices, have {len(devices)}"
+        )
+    sizes = spec.sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if num_slices > 1:
+        if spec.dp % num_slices != 0:
+            raise ValueError(
+                f"dp={spec.dp} must be a multiple of num_slices={num_slices}"
+            )
+        # (dcn dp) x (ici dp, pp, fsdp, ep, sp, tp)
+        ici_shape = (spec.dp // num_slices,) + shape[1:]
+        dcn_shape = (num_slices,) + tuple(1 for _ in shape[1:])
+        has_slice_meta = all(
+            getattr(d, "slice_index", None) is not None for d in devices
+        )
+        if has_slice_meta:
+            # Real multislice hardware: any error here is a genuine
+            # placement problem and must propagate, not be papered over.
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        else:
+            # Test platforms (CPU) carry no slice metadata; a row-major
+            # reshape keeps the outer-dp-over-DCN axis semantics.
+            dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+    return make_mesh(spec, devices)
+
+
+def process_local_batch_size(global_batch: int) -> int:
+    """Per-process batch share for data loading (global arrays are formed
+    with jax.make_array_from_process_local_data)."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    return global_batch // n

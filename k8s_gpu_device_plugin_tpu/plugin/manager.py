@@ -28,8 +28,9 @@ from k8s_gpu_device_plugin_tpu.device.backend import ChipBackend
 from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY, UNHEALTHY, Chips
 from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap, new_chip_map
 from k8s_gpu_device_plugin_tpu.device.factory import make_backend
+from k8s_gpu_device_plugin_tpu.device.topology import as_slice_member
 from k8s_gpu_device_plugin_tpu.plugin import api
-from k8s_gpu_device_plugin_tpu.plugin.plugin import TpuDevicePlugin
+from k8s_gpu_device_plugin_tpu.plugin.plugin import SliceMembership, TpuDevicePlugin
 from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
@@ -145,6 +146,35 @@ class PluginManager:
     def _load_plugins(self) -> None:
         """Re-enumerate chips and build one plugin per resource (manager.go:156-174)."""
         topo = self.backend.host_topology()
+        membership = None
+        if self.cfg.slice_topology:
+            # This host is one worker of a multi-host slice (BASELINE #5).
+            topo = as_slice_member(
+                topo, self.cfg.slice_topology, self.cfg.worker_id
+            )
+            hostnames = self.cfg.worker_hostname_list
+            if len(hostnames) != topo.num_hosts:
+                # Fail fast here rather than letting libtpu and
+                # jax.distributed disagree about process count at runtime.
+                raise ValueError(
+                    f"workerHostnames lists {len(hostnames)} hosts but slice "
+                    f"{self.cfg.slice_topology} spans {topo.num_hosts}"
+                )
+        elif self.cfg.num_slices > 1 and len(self.cfg.worker_hostname_list) > 1:
+            # Single-host slices: the per-slice worker list is exactly this
+            # host; more entries would inflate the derived process count.
+            raise ValueError(
+                "workerHostnames must list exactly one host per single-host "
+                f"slice, got {len(self.cfg.worker_hostname_list)}"
+            )
+        if self.cfg.slice_topology or self.cfg.num_slices > 1:
+            # Multislice of single-host slices still needs rank/peer envs.
+            membership = SliceMembership(
+                hostnames=tuple(self.cfg.worker_hostname_list),
+                num_slices=self.cfg.num_slices,
+                slice_id=self.cfg.slice_id,
+                coordinator=self.cfg.megascale_coordinator,
+            )
         resources = discover_resources(
             self.cfg.slice_strategy, topo, self.cfg.slice_plan
         )
@@ -165,6 +195,7 @@ class PluginManager:
                 socket_dir=self.cfg.kubelet_socket_dir,
                 libtpu_path=self.cfg.libtpu_path,
                 logger=self.log,
+                membership=membership,
             )
             for name, chips in sorted(self.chip_map.items())
         ]

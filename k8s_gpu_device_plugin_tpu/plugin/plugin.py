@@ -26,6 +26,7 @@ import asyncio
 import logging
 import math
 import os
+from dataclasses import dataclass
 
 import grpc
 
@@ -40,6 +41,27 @@ from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 DIAL_TIMEOUT_SECONDS = 5.0       # plugin.go:130,141
 
 
+@dataclass(frozen=True)
+class SliceMembership:
+    """Cross-host identity of this daemon's slice (BASELINE config #5).
+
+    The reference had no cross-node concept at all (SURVEY §7); on TPU a
+    multi-host slice needs every worker pod to agree on ranks and peers, so
+    the per-node daemon injects them at Allocate time. ``hostnames`` is in
+    worker-rank order. ``num_slices``/``slice_id``/``coordinator`` describe
+    multislice (DCN) training and surface as MEGASCALE_* envs.
+    """
+
+    hostnames: tuple[str, ...] = ()
+    num_slices: int = 1
+    slice_id: int = 0
+    coordinator: str = ""        # host:port of slice 0 / worker 0
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+
 class TpuDevicePlugin(api.DevicePluginServicer):
     """One device-plugin gRPC server for one extended resource."""
 
@@ -51,10 +73,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         socket_dir: str = api.DEVICE_PLUGIN_PATH,
         libtpu_path: str = "/lib/libtpu.so",
         logger: logging.Logger | None = None,
+        membership: SliceMembership | None = None,
     ) -> None:
         self.resource_name = resource_name
         self.chips = chips
         self.topology = topology
+        self.membership = membership or SliceMembership()
         self.socket_dir = socket_dir
         self.libtpu_path = libtpu_path
         self.log = logger or get_logger()
@@ -195,23 +219,65 @@ class TpuDevicePlugin(api.DevicePluginServicer):
           so XLA lays collectives on the actual ICI shape;
         - TPU_ACCELERATOR_TYPE: generation-chips spec (e.g. v5e-8);
         - TPU_SKIP_MDS_QUERY: no GCE metadata server inside bare k8s pods.
+
+        Multi-host slices (topology.slice_bounds set, BASELINE config #5):
+        when the container takes every chip this host owns, the process grid
+        spans hosts — TPU_PROCESS_BOUNDS becomes the host grid and
+        TPU_WORKER_ID / TPU_WORKER_HOSTNAMES give the pod its rank and peer
+        set (what jax.distributed + libtpu mesh init consume). A PARTIAL
+        allocation on a multi-host member degrades to the single-process
+        contract: a fraction of a host cannot join a cross-host ICI mesh.
+        Multislice adds the MEGASCALE_* DCN contract on top.
         """
         selected = self.chips.subset(ids)
         phys_indices = sorted(
             {i for chip in selected.values() for i in chip.chip_indices}
         )
         coords = [c for chip in selected.values() for c in chip.coords]
-        bounds = self._bounds_of(coords)
         gen = next(iter(selected.values())).generation if selected else "unknown"
+        topo = self.topology
+        whole_host = len(phys_indices) == topo.num_chips
 
         response = pb.ContainerAllocateResponse()
         response.envs["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in phys_indices)
-        response.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(
-            str(b) for b in bounds
-        )
-        response.envs["TPU_PROCESS_BOUNDS"] = ",".join("1" for _ in bounds)
-        response.envs["TPU_ACCELERATOR_TYPE"] = f"{gen}-{len(phys_indices)}"
         response.envs["TPU_SKIP_MDS_QUERY"] = "true"
+        # Worker identity makes sense only for a whole-host allocation that is
+        # part of a distributed job — a multi-host slice, or one slice of a
+        # multislice run (where a single-host slice still needs its rank).
+        distributed = topo.is_multihost or self.membership.is_multislice
+        if whole_host and distributed:
+            response.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(
+                str(b) for b in topo.bounds
+            )
+            response.envs["TPU_PROCESS_BOUNDS"] = ",".join(
+                str(g) for g in topo.host_grid
+            )
+            response.envs["TPU_WORKER_ID"] = str(topo.worker_index)
+            if self.membership.hostnames:
+                response.envs["TPU_WORKER_HOSTNAMES"] = ",".join(
+                    self.membership.hostnames
+                )
+            slice_chips = math.prod(topo.slice_bounds or topo.bounds)
+            response.envs["TPU_ACCELERATOR_TYPE"] = f"{gen}-{slice_chips}"
+            # Multislice (DCN) contract rides on top of a full slice member
+            # only — a partial host cannot represent its slice in a
+            # cross-slice job.
+            if self.membership.is_multislice:
+                response.envs["MEGASCALE_NUM_SLICES"] = str(
+                    self.membership.num_slices
+                )
+                response.envs["MEGASCALE_SLICE_ID"] = str(self.membership.slice_id)
+                if self.membership.coordinator:
+                    response.envs["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                        self.membership.coordinator
+                    )
+        else:
+            bounds = self._bounds_of(coords)
+            response.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(
+                str(b) for b in bounds
+            )
+            response.envs["TPU_PROCESS_BOUNDS"] = ",".join("1" for _ in bounds)
+            response.envs["TPU_ACCELERATOR_TYPE"] = f"{gen}-{len(phys_indices)}"
 
         for path in selected.all_paths():
             response.devices.append(

@@ -1,0 +1,389 @@
+"""Multi-host slice support (SURVEY §7 hard parts; BASELINE config #5).
+
+Covers the placement math (as_slice_member), the Allocate-time env contract
+(TPU_PROCESS_BOUNDS / TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / MEGASCALE_*),
+config plumbing, and the workload-side WorkerEnv / global-mesh helpers —
+all without hardware, per SURVEY §4 "multi-node without a cluster".
+"""
+
+import asyncio
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.config import Config
+from k8s_gpu_device_plugin_tpu.config.config import load_config
+from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+from k8s_gpu_device_plugin_tpu.device.topology import as_slice_member, parse_topology
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec
+from k8s_gpu_device_plugin_tpu.parallel.multihost import (
+    WorkerEnv,
+    make_global_mesh,
+    worker_env,
+)
+from k8s_gpu_device_plugin_tpu.plugin import api
+from k8s_gpu_device_plugin_tpu.plugin.api import pb
+from k8s_gpu_device_plugin_tpu.plugin.plugin import SliceMembership
+
+from tests.test_plugin_integration import run, start_stack, stop_stack
+
+
+# --- placement math -------------------------------------------------------
+
+
+def test_as_slice_member_v5p_32():
+    # v5p-32 = (4,4,2) slice; each v5p host is (2,2,1) = 4 chips => 8 hosts
+    host = parse_topology("v5p-4")
+    placed = as_slice_member(host, "v5p-32", worker_id=0)
+    assert placed.slice_bounds == (4, 4, 2)
+    assert placed.host_grid == (2, 2, 2)
+    assert placed.num_hosts == 8
+    assert placed.is_multihost
+    assert placed.worker_index == 0
+    assert placed.host_offset == (0, 0, 0)
+
+    last = as_slice_member(host, "v5p-32", worker_id=7)
+    assert last.worker_index == 7
+    assert last.host_offset == (2, 2, 1)
+
+
+def test_as_slice_member_worker_index_roundtrips():
+    host = parse_topology("v5e-8")  # (2,4) per host
+    for wid in range(4):  # v5e-32 would be (8,4)? use explicit shape
+        placed = as_slice_member(host, "v5e-4x8", worker_id=wid)
+        assert placed.worker_index == wid
+        assert placed.num_hosts == 4
+
+
+def test_as_slice_member_rejects_bad_inputs():
+    host = parse_topology("v5p-4")
+    with pytest.raises(ValueError, match="out of range"):
+        as_slice_member(host, "v5p-32", worker_id=8)
+    with pytest.raises(ValueError, match="generation"):
+        as_slice_member(host, "v5e-16", worker_id=0)
+    with pytest.raises(ValueError, match="tile"):
+        as_slice_member(host, "v5p-3x2x1", worker_id=0)
+
+
+def test_single_host_topology_is_not_multihost():
+    topo = parse_topology("v5e-4")
+    assert not topo.is_multihost
+    assert topo.num_hosts == 1
+    assert topo.worker_index == 0
+    assert topo.host_grid == (1, 1)
+
+
+# --- config plumbing ------------------------------------------------------
+
+
+def test_config_multihost_keys(tmp_path):
+    p = tmp_path / "c.yml"
+    p.write_text(
+        "sliceTopology: v5p-32\n"
+        "workerId: 3\n"
+        "workerHostnames: h0,h1,h2,h3,h4,h5,h6,h7\n"
+        "numSlices: 2\n"
+        "sliceId: 1\n"
+        "megascaleCoordinator: h0:8080\n"
+    )
+    cfg = load_config([], config_file=str(p))
+    assert cfg.slice_topology == "v5p-32"
+    assert cfg.worker_id == 3
+    assert cfg.worker_hostname_list == [f"h{i}" for i in range(8)]
+    assert cfg.num_slices == 2 and cfg.slice_id == 1
+    assert cfg.megascale_coordinator == "h0:8080"
+
+
+def test_config_rejects_out_of_range_worker():
+    cfg = Config(slice_topology="v5p-32", worker_id=2, worker_hostnames="a,b")
+    with pytest.raises(ValueError, match="workerId"):
+        cfg.validate()
+    with pytest.raises(ValueError, match="sliceId"):
+        Config(num_slices=1, slice_id=1).validate()
+
+
+def test_config_multihost_requires_hostnames():
+    with pytest.raises(ValueError, match="workerHostnames is required"):
+        Config(slice_topology="v5p-32", worker_id=0).validate()
+
+
+def test_manager_rejects_multislice_hostname_overcount(tmp_path):
+    from k8s_gpu_device_plugin_tpu.plugin import PluginManager
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    cfg = Config(
+        kubelet_socket_dir=str(tmp_path),
+        libtpu_path="",
+        topology="v5e-4",
+        num_slices=2,
+        megascale_coordinator="c:8080",
+        worker_hostnames="a,b,c",  # copy-paste of the all-slices list
+    )
+    manager = PluginManager(cfg, Latch(), backend=FakeBackend("v5e-4"))
+    with pytest.raises(ValueError, match="exactly one host"):
+        manager._load_plugins()
+
+
+def test_config_multislice_requires_coordinator_and_hostnames():
+    with pytest.raises(ValueError, match="megascaleCoordinator"):
+        Config(num_slices=2, worker_hostnames="a,b").validate()
+    with pytest.raises(ValueError, match="workerHostnames"):
+        Config(num_slices=2, megascale_coordinator="c:8080").validate()
+    Config(
+        num_slices=2, megascale_coordinator="c:8080", worker_hostnames="a"
+    ).validate()
+
+
+def test_config_rejects_shared_replicas_with_distributed():
+    """Duplicate worker ranks on one ICI mesh are undefined — refuse."""
+    with pytest.raises(ValueError, match="sharedReplicas"):
+        Config(
+            shared_replicas=2, slice_topology="v5p-32",
+            worker_hostnames=",".join(f"h{i}" for i in range(8)),
+        ).validate()
+    with pytest.raises(ValueError, match="sharedReplicas"):
+        Config(
+            shared_replicas=2, num_slices=2,
+            megascale_coordinator="c:1", worker_hostnames="a",
+        ).validate()
+    Config(shared_replicas=2).validate()  # sharing alone is fine
+
+
+# --- Allocate env contract ------------------------------------------------
+
+
+def _allocate(kubelet, endpoint, ids):
+    async def call():
+        async with kubelet.plugin_channel(endpoint) as channel:
+            stub = api.DevicePluginStub(channel)
+            return await stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(devicesIDs=ids)]
+                )
+            )
+
+    return call()
+
+
+def test_allocate_whole_host_on_multihost_slice(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5p-4",
+            slice_topology="v5p-32",
+            worker_id=5,
+            worker_hostnames=",".join(f"w{i}" for i in range(8)),
+        )
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            ids = [c.id for c in manager.plugins[0].chips.iter_sorted()]
+            resp = await _allocate(kubelet, reg.endpoint, ids)
+            envs = dict(resp.container_responses[0].envs)
+            assert envs["TPU_PROCESS_BOUNDS"] == "2,2,2"
+            assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+            assert envs["TPU_WORKER_ID"] == "5"
+            assert envs["TPU_WORKER_HOSTNAMES"] == ",".join(
+                f"w{i}" for i in range(8)
+            )
+            assert envs["TPU_ACCELERATOR_TYPE"] == "v5p-32"
+            assert "MEGASCALE_NUM_SLICES" not in envs
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_allocate_partial_host_degrades_to_single_process(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5p-4",
+            slice_topology="v5p-32",
+            worker_id=0,
+            worker_hostnames="w0,w1,w2,w3,w4,w5,w6,w7",
+        )
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            ids = [c.id for c in manager.plugins[0].chips.iter_sorted()][:2]
+            resp = await _allocate(kubelet, reg.endpoint, ids)
+            envs = dict(resp.container_responses[0].envs)
+            assert envs["TPU_PROCESS_BOUNDS"] == "1,1,1"
+            assert "TPU_WORKER_ID" not in envs
+            assert envs["TPU_ACCELERATOR_TYPE"] == "v5p-2"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_allocate_multislice_megascale_envs(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5p-4",
+            slice_topology="v5p-8",
+            worker_id=1,
+            worker_hostnames="w0,w1",
+            num_slices=2,
+            slice_id=1,
+            megascale_coordinator="s0w0:8080",
+        )
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            ids = [c.id for c in manager.plugins[0].chips.iter_sorted()]
+            resp = await _allocate(kubelet, reg.endpoint, ids)
+            envs = dict(resp.container_responses[0].envs)
+            assert envs["MEGASCALE_NUM_SLICES"] == "2"
+            assert envs["MEGASCALE_SLICE_ID"] == "1"
+            assert envs["MEGASCALE_COORDINATOR_ADDRESS"] == "s0w0:8080"
+            assert envs["TPU_WORKER_ID"] == "1"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+# --- workload side --------------------------------------------------------
+
+
+def test_worker_env_parses_plugin_contract(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b,c,d")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    env = worker_env()
+    assert env == WorkerEnv(
+        worker_id=2, hostnames=("a", "b", "c", "d"), num_slices=2, slice_id=1
+    )
+    assert env.num_workers == 8
+    assert env.process_id == 6  # slice 1, worker 2
+    assert env.coordinator_host == "a"
+
+
+def test_worker_env_absent_on_single_process(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("MEGASCALE_NUM_SLICES", raising=False)
+    assert worker_env() is None
+
+
+def test_worker_env_multislice_without_hostnames(monkeypatch):
+    """Single-host slices in a multislice job still must init distributed."""
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "s0:8080")
+    env = worker_env()
+    assert env is not None
+    assert env.num_workers == 2
+    assert env.process_id == 1
+    assert env.coordinator_host == "s0"
+
+
+def test_make_global_mesh_multislice_shape():
+    import jax
+
+    spec = MeshSpec.for_devices(8, tp=2)  # dp=4, tp=2
+    mesh = make_global_mesh(spec, num_slices=2, devices=jax.devices()[:8])
+    assert dict(mesh.shape)["dp"] == 4
+    assert dict(mesh.shape)["tp"] == 2
+
+    with pytest.raises(ValueError, match="multiple of num_slices"):
+        make_global_mesh(MeshSpec.for_devices(8, tp=2, sp=2), num_slices=4)
+
+
+def test_membership_defaults():
+    m = SliceMembership()
+    assert not m.is_multislice
+    assert SliceMembership(num_slices=2).is_multislice
+
+
+def test_worker_env_multislice_coordinator(monkeypatch):
+    """Every slice must agree on ONE coordinator — the MEGASCALE address,
+    not the slice-local hostnames[0]."""
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "s1w0,s1w1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "s0w0:8080")
+    env = worker_env()
+    assert env.coordinator_host == "s0w0"
+    # single slice ignores megascale coordinator
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "1")
+    assert worker_env().coordinator_host == "s1w0"
+
+
+def test_partial_host_never_gets_megascale(tmp_path):
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5p-4",
+            slice_topology="v5p-8",
+            worker_id=0,
+            worker_hostnames="w0,w1",
+            num_slices=2,
+            slice_id=0,
+            megascale_coordinator="w0:8080",
+        )
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            ids = [c.id for c in manager.plugins[0].chips.iter_sorted()][:2]
+            resp = await _allocate(kubelet, reg.endpoint, ids)
+            envs = dict(resp.container_responses[0].envs)
+            assert "MEGASCALE_NUM_SLICES" not in envs
+            assert "TPU_WORKER_ID" not in envs
+            assert envs["TPU_PROCESS_BOUNDS"] == "1,1,1"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_multislice_of_single_host_slices_gets_worker_envs(tmp_path):
+    """numSlices>1 with slice == host must still hand out rank/peer envs."""
+
+    async def body():
+        kubelet, manager, task, _ = await start_stack(
+            tmp_path,
+            topology="v5e-4",
+            num_slices=2,
+            slice_id=1,
+            worker_hostnames="me",
+            megascale_coordinator="s0:8080",
+        )
+        try:
+            await kubelet.wait_for_registrations(1)
+            reg = kubelet.registrations[0]
+            ids = [c.id for c in manager.plugins[0].chips.iter_sorted()]
+            resp = await _allocate(kubelet, reg.endpoint, ids)
+            envs = dict(resp.container_responses[0].envs)
+            assert envs["TPU_WORKER_ID"] == "0"
+            assert envs["TPU_WORKER_HOSTNAMES"] == "me"
+            assert envs["TPU_PROCESS_BOUNDS"] == "1,1"
+            assert envs["MEGASCALE_NUM_SLICES"] == "2"
+            assert envs["MEGASCALE_SLICE_ID"] == "1"
+            assert envs["MEGASCALE_COORDINATOR_ADDRESS"] == "s0:8080"
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
+def test_manager_rejects_hostname_count_mismatch(tmp_path):
+    """4 hostnames for an 8-host slice must fail at load, not wedge at runtime."""
+    from k8s_gpu_device_plugin_tpu.plugin import PluginManager
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    cfg = Config(
+        kubelet_socket_dir=str(tmp_path),
+        libtpu_path="",
+        topology="v5p-4",
+        slice_topology="v5p-32",
+        worker_id=3,
+        worker_hostnames="a,b,c,d",
+    )
+    manager = PluginManager(cfg, Latch(), backend=FakeBackend("v5p-4"))
+    with pytest.raises(ValueError, match="spans 8"):
+        manager._load_plugins()
