@@ -1,0 +1,8 @@
+from k8s_gpu_device_plugin_tpu.data.pipeline import (
+    DataLoader,
+    MemmapSource,
+    SyntheticSource,
+    TokenSource,
+)
+
+__all__ = ["DataLoader", "MemmapSource", "SyntheticSource", "TokenSource"]

@@ -1,0 +1,189 @@
+"""Token data pipeline with host→device prefetch.
+
+The reference daemon has no data path at all; this feeds the benchmark
+training workloads (BASELINE configs #4/#5). TPU-first requirements it
+satisfies:
+
+- **Static shapes**: every batch is exactly (batch, seq+1) int32 — no
+  ragged tails (the last partial window of an epoch is dropped), so the
+  jitted train step never recompiles.
+- **Prefetch**: a background thread assembles and device-puts the next
+  batches while the current step runs, overlapping host IO with TPU compute
+  (the HBM-bandwidth rule: never let the MXU wait on the host).
+- **Multi-process**: under jax.distributed each process materializes only
+  its own rows and the global array is assembled with
+  ``jax.make_array_from_process_local_data`` — no cross-host token traffic.
+- **Deterministic + resumable**: batch content is a pure function of
+  (seed, step), so ``state()``/``seek()`` give exact resume after a
+  checkpoint restore with no iterator pickling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Protocol
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP
+from jax.sharding import PartitionSpec as P
+
+
+class TokenSource(Protocol):
+    """Pure window server: (step, rows, seq_len) -> (rows, seq_len+1) int32.
+
+    Implementations must be deterministic in ``step`` — resume correctness
+    (and multi-process row disjointness) depends on it.
+    """
+
+    def windows(self, step: int, rows: slice, batch_rows: int, seq_len: int) -> np.ndarray: ...
+
+
+class SyntheticSource:
+    """Deterministic random tokens (benchmark default; zero IO)."""
+
+    def __init__(self, vocab_size: int, seed: int = 0) -> None:
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def windows(self, step, rows, batch_rows, seq_len):
+        rng = np.random.default_rng((self.seed, step))
+        full = rng.integers(
+            0, self.vocab_size, (batch_rows, seq_len + 1), dtype=np.int32
+        )
+        return full[rows]
+
+
+class MemmapSource:
+    """Flat binary token file (np.memmap) served as shuffled windows.
+
+    The file is one continuous token stream (the common packed-corpus
+    format, e.g. uint16/uint32 little-endian). Windows are drawn at
+    pseudo-random offsets keyed by (seed, step) — deterministic, collision
+    -tolerant sampling rather than an epoch shuffle table, which keeps
+    startup O(1) for terabyte corpora.
+    """
+
+    def __init__(self, path: str, dtype: str = "uint16", seed: int = 0) -> None:
+        self.tokens = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        self.seed = seed
+        if len(self.tokens) < 2:
+            raise ValueError(f"token file {path} too small ({len(self.tokens)})")
+
+    def windows(self, step, rows, batch_rows, seq_len):
+        n = len(self.tokens) - (seq_len + 1)
+        if n < 1:
+            raise ValueError(
+                f"corpus of {len(self.tokens)} tokens shorter than seq {seq_len}+1"
+            )
+        rng = np.random.default_rng((self.seed, step))
+        starts = rng.integers(0, n + 1, size=batch_rows)[rows]
+        return np.stack(
+            [self.tokens[s : s + seq_len + 1] for s in starts]
+        ).astype(np.int32)
+
+
+class DataLoader:
+    """Sharded, prefetching batch iterator.
+
+    Yields ``{"inputs": (B,S), "targets": (B,S)}`` jax Arrays laid out
+    batch-over-(dp,fsdp), sequence-over-sp on ``mesh`` — the shardings
+    models/train.py expects. ``B`` is the GLOBAL batch; each process holds
+    only its rows.
+    """
+
+    def __init__(
+        self,
+        source: TokenSource,
+        batch_size: int,
+        seq_len: int,
+        mesh: Mesh,
+        start_step: int = 0,
+        prefetch: int = 2,
+    ) -> None:
+        n_proc = jax.process_count()
+        if batch_size % n_proc != 0:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {n_proc} processes"
+            )
+        self.source = source
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.mesh = mesh
+        self._step = start_step
+        self._prefetch = max(prefetch, 0)
+        per = batch_size // n_proc
+        self._rows = slice(jax.process_index() * per, (jax.process_index() + 1) * per)
+        self._sharding = NamedSharding(mesh, P((AXIS_DP, AXIS_FSDP), AXIS_SP))
+
+    # --- resumability ---
+
+    def state(self) -> dict:
+        """Checkpointable iterator position (pair with models/checkpoint.py)."""
+        return {"step": self._step}
+
+    def seek(self, step: int) -> None:
+        self._step = step
+
+    # --- batch production ---
+
+    def _make_batch(self, step: int) -> dict:
+        local = self.source.windows(
+            step, self._rows, self.batch_size, self.seq_len
+        )
+        inputs, targets = local[:, :-1], local[:, 1:]
+        if jax.process_count() > 1:
+            make = lambda x: jax.make_array_from_process_local_data(
+                self._sharding, x
+            )
+        else:
+            make = lambda x: jax.device_put(x, self._sharding)
+        return {"inputs": make(inputs), "targets": make(targets)}
+
+    def __iter__(self) -> Iterator[dict]:
+        if self._prefetch == 0:
+            while True:
+                batch = self._make_batch(self._step)
+                self._step += 1
+                yield batch
+        else:
+            yield from self._prefetch_iter()
+
+    def _prefetch_iter(self) -> Iterator[dict]:
+        """Background producer thread, bounded queue (double buffering)."""
+        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+
+        def produce(start: int) -> None:
+            step = start
+            try:
+                while not stop.is_set():
+                    q.put(
+                        ("ok", step, self._make_batch(step)),
+                    )
+                    step += 1
+            except Exception as e:  # noqa: BLE001 - surface on the consumer side
+                q.put(("err", step, e))
+
+        t = threading.Thread(
+            target=produce, args=(self._step,), daemon=True, name="data-prefetch"
+        )
+        t.start()
+        try:
+            while True:
+                kind, step, payload = q.get()
+                if kind == "err":
+                    raise payload
+                self._step = step + 1
+                yield payload
+        finally:
+            stop.set()
+            # unblock a producer waiting on a full queue
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -129,8 +129,25 @@ def init_train_state(
         return params
 
     params = jax.jit(init_fn, out_shardings=shardings)(key)
-    opt_state = jax.jit(optimizer.init)(params)
-    return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+    # Optimizer moments must SHARE the param shardings (zeros_like carries no
+    # data dependence, so GSPMD would not propagate them — and an fsdp run
+    # with replicated mu/nu is ZeRO in name only); scalars (adam count) are
+    # replicated on the mesh. Explicit out_shardings also pins every leaf to
+    # the mesh, so a checkpoint restore reproduces mesh-wide placements
+    # instead of committed single-device ones (which jit rejects when mixed).
+    replicated = NamedSharding(mesh, P())
+    abstract_opt = jax.eval_shape(optimizer.init, params)
+    opt_out_shardings = optax.tree_map_params(
+        optimizer,
+        lambda _, s: s,
+        abstract_opt,
+        shardings,
+        transform_non_params=lambda _: replicated,
+    )
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_out_shardings)(params)
+    step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+    return {"params": params, "opt_state": opt_state, "step": step}
 
 
 def batch_shardings(mesh: Mesh) -> dict:

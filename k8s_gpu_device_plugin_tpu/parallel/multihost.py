@@ -102,19 +102,28 @@ def initialize(
     same entrypoint works at every scale.
     """
     env = worker_env()
-    if env is None and coordinator_address is None:
-        return None
+    if coordinator_address is None and (env is None or env.num_workers <= 1):
+        # Nothing to rendezvous: no worker contract, or a 1-worker job
+        # (e.g. single-host environments that still export
+        # TPU_WORKER_HOSTNAMES=localhost). jax.distributed would only add a
+        # failure mode here.
+        return env
     if coordinator_address is None:
         coordinator_address = f"{env.coordinator_host}:{port}"
     if num_processes is None:
         num_processes = env.num_workers if env else 1
     if process_id is None:
         process_id = env.process_id if env else 0
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # idempotent re-entry: a second call in the same process is fine
+        if "already initialized" not in str(e).lower():
+            raise
     return env
 
 

@@ -1,0 +1,141 @@
+"""Checkpoint/resume for training workloads (SURVEY §5: checkpointing lives
+in the benchmark workloads, not the daemon).
+
+Validates on the virtual 8-device CPU mesh: shardings survive the round
+trip, training continues bit-identically after restore, retention and
+cadence policies hold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.checkpoint import (
+    TrainCheckpointer,
+    abstract_like,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.train import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    mesh = make_mesh(MeshSpec.for_devices(8, tp=2, sp=2))
+    optimizer = make_optimizer(total_steps=10)
+
+    # the train step DONATES its input state, so every test takes a fresh
+    # state from this factory rather than sharing one live tree
+    def make_state():
+        return init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+
+    step_fn = make_train_step(cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+    return cfg, mesh, optimizer, make_state, step_fn, batch
+
+
+def test_optimizer_moments_share_param_shardings():
+    """ZeRO correctness: adam mu/nu must carry the fsdp param shardings
+    (zeros_like has no data dependence, so GSPMD would otherwise leave them
+    unsharded); scalars are mesh-replicated so checkpoint restore never
+    produces single-device committed leaves."""
+    cfg = LlamaConfig.tiny(n_layers=2)
+    mesh = make_mesh(MeshSpec.for_devices(8, tp=2, fsdp=4))
+    opt = make_optimizer(total_steps=10)
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+
+    adam = next(
+        x
+        for x in jax.tree.leaves(
+            state["opt_state"], is_leaf=lambda n: hasattr(n, "mu")
+        )
+        if hasattr(x, "mu")
+    )
+    for name, p in state["params"]["layers"].items():
+        assert adam.mu["layers"][name].sharding.spec == p.sharding.spec, name
+        assert adam.nu["layers"][name].sharding.spec == p.sharding.spec, name
+    assert len(adam.count.sharding.device_set) == 8
+    assert len(state["step"].sharding.device_set) == 8
+
+
+def _leaves_equal(a, b) -> bool:
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(fa) == len(fb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(fa, fb)
+    )
+
+
+def test_save_restore_roundtrip_preserves_shardings(tmp_path, setup):
+    _, _, _, make_state, step_fn, batch = setup
+    state1, _ = step_fn(make_state(), batch)
+
+    with TrainCheckpointer(str(tmp_path / "ckpt"), save_interval=1) as ckpt:
+        assert ckpt.save(state1)
+        ckpt.wait()
+        assert ckpt.latest_step() == 1
+        restored = ckpt.restore(abstract_like(state1))
+
+    assert _leaves_equal(state1, restored)
+    # shardings preserved leaf-for-leaf, not just values
+    for orig, rest in zip(jax.tree.leaves(state1), jax.tree.leaves(restored)):
+        assert orig.sharding.is_equivalent_to(rest.sharding, orig.ndim)
+
+
+def test_resume_continues_bit_identically(tmp_path, setup):
+    _, _, _, make_state, step_fn, batch = setup
+    batch2 = dict(batch)
+
+    # run 2 steps straight through
+    s_a, _ = step_fn(make_state(), batch)
+    s_ab, m_ab = step_fn(s_a, batch2)
+
+    # run 1 step, checkpoint, restore, run the 2nd step
+    s_b, _ = step_fn(make_state(), batch)
+    with TrainCheckpointer(str(tmp_path / "ckpt2"), save_interval=1) as ckpt:
+        ckpt.save(s_b)
+        ckpt.wait()
+        resumed, was_resumed = ckpt.restore_or_pass(abstract_like(s_b))
+        assert was_resumed
+    s_resumed, m_resumed = step_fn(resumed, batch2)
+
+    assert int(jax.device_get(s_resumed["step"])) == 2
+    assert float(m_resumed["loss"]) == float(m_ab["loss"])
+    assert _leaves_equal(s_ab["params"], s_resumed["params"])
+
+
+def test_restore_or_pass_without_checkpoint(tmp_path, setup):
+    _, _, _, make_state, _, _ = setup
+    state = make_state()
+    with TrainCheckpointer(str(tmp_path / "empty")) as ckpt:
+        out, resumed = ckpt.restore_or_pass(state)
+        assert not resumed
+        assert out is state
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(abstract_like(state))
+
+
+def test_retention_and_cadence(tmp_path, setup):
+    _, _, _, make_state, step_fn, batch = setup
+    with TrainCheckpointer(
+        str(tmp_path / "keep"), max_to_keep=2, save_interval=2
+    ) as ckpt:
+        s = make_state()
+        for _ in range(5):
+            s, _ = step_fn(s, batch)
+            ckpt.save(s)
+        ckpt.wait()
+        steps = ckpt.all_steps()
+        # cadence 2 => steps 2 and 4 saved (1,3,5 skipped); retention 2 keeps both
+        assert steps == [2, 4]
+        # force overrides cadence
+        ckpt.save(s, force=True)
+        ckpt.wait()
+        assert ckpt.latest_step() == 5
+        assert len(ckpt.all_steps()) <= 2  # retention pruned the oldest
