@@ -38,6 +38,24 @@ def main() -> int:
     )
 
     try:
+        from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
+        from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+        tcfg = LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=8192, max_seq=2048,
+        )
+        tr = train_mfu(tcfg, batch_size=8, seq_len=2048, steps=5, warmup=2)
+        print(
+            f"bench: llama train (0.6B, S=2048, flash+remat): "
+            f"{tr.mfu * 100:.1f}% MFU, {tr.tokens_per_second:.0f} tok/s, "
+            f"step {tr.step_seconds * 1000:.0f}ms",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
+        print(f"bench: train bench skipped: {type(e).__name__}: {e}", file=sys.stderr)
+
+    try:
         from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
             control_plane_roundtrip,
         )

@@ -57,12 +57,17 @@ def train_mfu(
 
     for _ in range(warmup):
         state, metrics = train_step(state, batch)
-    jax.block_until_ready(state)
+    # Force completion by FETCHING a scalar, not block_until_ready: on a
+    # tunneled/relayed chip block_until_ready can return before execution
+    # finishes (see matmul_mfu methodology notes), producing absurd timings.
+    # state["step"] also covers warmup=0, where no metrics exist yet.
+    int(state["step"][()])
 
     start = time.perf_counter()
     for _ in range(steps):
         state, metrics = train_step(state, batch)
-    jax.block_until_ready(state)
+    # the loss fetch serializes on the whole dependent step chain
+    float(metrics["loss"])
     seconds = (time.perf_counter() - start) / steps
 
     tokens = batch_size * seq_len

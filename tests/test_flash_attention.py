@@ -56,6 +56,41 @@ def test_flash_grads_match_reference():
         )
 
 
+@pytest.mark.parametrize("bq,bk", [(128, 128), (128, 256), (256, 128)])
+def test_flash_grads_multiblock(bq, bk):
+    """Exercise the backward kernels' cross-block accumulation and causal
+    block-skip paths (nq>1 and/or nk>1), which the 1024 defaults reduce to
+    a single block at test sizes."""
+    q, k, v = make_qkv(jax.random.key(5), s=256)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+            )
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_forward_multiblock_noncausal():
+    q, k, v = make_qkv(jax.random.key(6), s=256)
+    expected = mha_reference(q, k, v, causal=False)
+    got = flash_attention(
+        q, k, v, causal=False, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
 def test_supports_gates():
     q, k, v = make_qkv(jax.random.key(3))
     assert supports(q, k, v)
