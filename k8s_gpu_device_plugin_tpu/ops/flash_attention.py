@@ -215,6 +215,41 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
+# Variant exposing lse as a differentiable output, shaped (B, H, S) — what
+# blockwise consumers (ring attention) need to merge partial softmaxes. The
+# lse cotangent folds into the backward's delta: d lse_i / d s_ij = p_ij, so
+# ds = p * (dp - delta + dlse) * ... == the standard formula with
+# delta := rowsum(do*o) - dlse.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+    b, s, h, d = q.shape
+    return o, lse.reshape(b, h, s)
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+    b, s, h, d = q.shape
+    return (o, lse.reshape(b, h, s)), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, residuals, cts):
+    do, dlse = cts
+    q, k, v, o, lse = residuals
+    b, s, h, d = q.shape
+    dlse_col = dlse.astype(jnp.float32).reshape(b * h, s, 1)
+    return _flash_bwd_impl(
+        q, k, v, o, lse, do, dlse_col,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # --- backward kernels -----------------------------------------------------
 # Shared algebra per (q block i, kv block j), all f32 in VMEM:
 #   s_ij = q_i k_j^T * scale        p_ij = exp(s_ij - lse_i)   (causal mask)
@@ -380,8 +415,9 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, scale, causal,
     return dq, dk, dv
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
-    q, k, v, o, lse = residuals
+def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
+                    block_q, block_k, interpret):
+    """Shared backward: dlse_col is (BH, S, 1) f32 or None."""
     b, s, h, d = q.shape
     n_kv = k.shape[2]
     group = h // n_kv
@@ -395,6 +431,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
         do_b.astype(jnp.float32) * o_b.astype(jnp.float32),
         axis=-1, keepdims=True,
     )                                            # (BH, S, 1)
+    if dlse_col is not None:  # lse cotangent folds into delta (see above)
+        delta = delta - dlse_col
 
     dq, dk, dv = _flash_bwd_bhsd(
         q_b, k_b, v_b, do_b, lse, delta,
@@ -408,6 +446,15 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
         dk = dk.reshape(b, s, n_kv, group, d).sum(axis=3)
         dv = dv.reshape(b, s, n_kv, group, d).sum(axis=3)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, o, lse = residuals
+    return _flash_bwd_impl(
+        q, k, v, o, lse, do, None,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -431,8 +478,12 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
-) -> jax.Array:
+    return_lse: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """(B, S, H, D) flash attention; K/V may have grouped heads.
+
+    With ``return_lse`` also returns the per-row logsumexp (B, H, S) f32 —
+    differentiable, for blockwise softmax merging (ring attention).
 
     Raises on shapes the kernel cannot tile (the grid drops tail rows, so a
     silent fallthrough would return uninitialized output): use
@@ -447,4 +498,6 @@ def flash_attention(
             f"flash_attention: seq_len {s} not divisible by blocks "
             f"({block_q}, {block_k}); pad the sequence or use ops.attention"
         )
+    if return_lse:
+        return _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

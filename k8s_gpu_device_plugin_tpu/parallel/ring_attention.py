@@ -83,13 +83,90 @@ def ring_attention(
     )(q, k, v)
 
 
+def _flash_ok(lq, lk, d) -> bool:
+    """Local shapes the Pallas kernel tiles without padding."""
+    from k8s_gpu_device_plugin_tpu.ops.flash_attention import _HAS_PLTPU
+
+    return (
+        _HAS_PLTPU
+        and d in (64, 128)
+        and lq % 128 == 0
+        and lk % 128 == 0
+        and lq >= 128
+        and lk >= 128
+    )
+
+
 def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
-    """Per-device body: rotate K/V blocks around the ring, accumulate."""
+    """Per-device body: rotate K/V blocks around the ring, accumulate.
+
+    The hot path computes each (q-shard, kv-shard) pair with the Pallas
+    flash kernel and merges partial softmaxes via the kernel's lse output;
+    a causal ring step is one of three static cases by block owner:
+    diagonal (flash causal), past (flash non-causal), future (skipped —
+    zero contribution). Falls back to a plain f32 einsum online-softmax
+    body when the local shard shapes don't tile the kernel.
+    """
     b, lq, h, d = q.shape
     k = _expand_kv(k, h)
     v = _expand_kv(v, h)
     lk = k.shape[1]
     my_idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    if _flash_ok(lq, lk, d) and lq == lk:
+        from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
+
+        interpret = jax.default_backend() != "tpu"
+
+        def fa(causal_step):
+            o_t, lse_t = flash_attention(
+                q, k_blk_ref[0], v_blk_ref[0], causal=causal_step,
+                scale=scale, interpret=interpret, return_lse=True,
+            )
+            return o_t.astype(jnp.float32), lse_t
+
+        # captured via a mutable cell so both cond branches see the carry
+        k_blk_ref = [k]
+        v_blk_ref = [v]
+
+        def step(carry, t):
+            lse, o, k_blk, v_blk = carry
+            k_blk_ref[0] = k_blk
+            v_blk_ref[0] = v_blk
+            kv_idx = (my_idx - t) % sp
+            if causal:
+                o_t, lse_t = jax.lax.cond(
+                    kv_idx == my_idx,
+                    lambda: fa(True),
+                    lambda: jax.lax.cond(
+                        kv_idx < my_idx,
+                        lambda: fa(False),
+                        lambda: (
+                            jnp.zeros((b, lq, h, d), jnp.float32),
+                            jnp.full((b, h, lq), _NEG_BIG, jnp.float32),
+                        ),
+                    ),
+                )
+            else:
+                o_t, lse_t = fa(False)
+            # merge normalized partials by their logsumexp weights
+            m = jnp.maximum(lse, lse_t)
+            w1 = jnp.exp(lse - m)
+            w2 = jnp.exp(lse_t - m)
+            tot = w1 + w2
+            wa = (w1 / tot).transpose(0, 2, 1)[..., None]
+            wb = (w2 / tot).transpose(0, 2, 1)[..., None]
+            o = o * wa + o_t * wb
+            lse = m + jnp.log(tot)
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            return (lse, o, k_blk, v_blk), None
+
+        lse0 = jnp.full((b, h, lq), _NEG_BIG, jnp.float32)
+        o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+        (lse, o, _, _), _ = jax.lax.scan(step, (lse0, o0, k, v), jnp.arange(sp))
+        return o.astype(q.dtype)
 
     qf = q.astype(jnp.float32)
     m0 = jnp.full((b, h, lq), _NEG_BIG, jnp.float32)
@@ -116,7 +193,6 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
             mask = jnp.ones((lq, lk), bool)
         m, l, o = _block_attn_update((m, l, o), scores, v_blk, mask)
         # rotate K/V to the next device; after sp steps they are back home
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
         return (m, l, o, k_blk, v_blk), None
