@@ -93,6 +93,11 @@ class TrainCheckpointer:
         """
         if step is None:
             step = int(jax.device_get(state["step"]))
+        if step in self._mngr.all_steps():
+            # Already on disk (e.g. the trainer's final force-save landing on
+            # a step the cadence just wrote): orbax raises
+            # StepAlreadyExistsError even with force=True, so skip instead.
+            return False
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )

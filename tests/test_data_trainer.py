@@ -152,6 +152,25 @@ def test_trainer_checkpoints_and_resumes(tmp_path):
     assert abs(r2.final_loss - r3.final_loss) < 1e-4
 
 
+def test_trainer_final_step_on_cadence_boundary(tmp_path):
+    """total_steps % checkpoint_interval == 0: the cadence saves the final
+    step, then the finally-block force-save hits the same step — orbax
+    raises StepAlreadyExistsError even with force=True unless skipped."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = _trainer_cfg(
+        total_steps=4, checkpoint_dir=ckpt_dir, checkpoint_interval=2
+    )
+    r1 = Trainer(cfg).run()  # must not raise
+    assert r1.steps_run == 4
+
+    cfg2 = _trainer_cfg(
+        total_steps=6, checkpoint_dir=ckpt_dir, checkpoint_interval=2
+    )
+    r2 = Trainer(cfg2).run()
+    assert r2.resumed_from == 4
+    assert r2.steps_run == 2
+
+
 def test_trainer_writes_profiler_trace(tmp_path):
     trace_dir = str(tmp_path / "trace")
     cfg = _trainer_cfg(trace_dir=trace_dir, trace_start=1, trace_stop=3)
