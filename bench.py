@@ -1,84 +1,169 @@
 #!/usr/bin/env python3
-"""Driver benchmark entry point: ONE JSON line on stdout.
+"""Driver benchmark entry point: ONE JSON line on stdout, no matter what.
 
-Primary metric (BASELINE config #2): single-chip bf16 matmul MFU on the real
-TPU. ``vs_baseline`` is the ratio against the north-star 45% MFU target from
-BASELINE.md (the reference publishes no numbers of its own — BASELINE.json
-"published": {}).
+North-star metric (BASELINE.md): Llama training MFU on the real chip, target
+>=45%. The JSON line carries the train MFU as the primary value plus the
+single-chip matmul MFU (BASELINE config #2) alongside, so both numbers are
+driver-recorded.
 
-Extra diagnostics (control-plane round-trip, device info) go to stderr so
-stdout stays a single parseable line.
+Robustness (the round-1 postmortem): this parent process NEVER imports jax.
+Each workload runs in a child process with a hard timeout — a wedged
+tunneled backend is killed and retried with bounded backoff, and on final
+failure the JSON line still appears with ``value: null`` and an ``error``.
+All diagnostics go to stderr; stdout is exactly one parseable line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
 
-NORTH_STAR_MFU = 0.45  # BASELINE.md: >=45% MFU Llama-3-8B on v5p-16
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+NORTH_STAR_TRAIN_MFU_PCT = 45.0  # BASELINE.md: >=45% train MFU north star
+
+ATTEMPTS = 3
+BACKOFF_SECONDS = 30.0
+DEADLINE_SECONDS = 1500.0  # global budget; retries stop when exceeded
+
+_T0 = time.monotonic()
+
+
+def _log(msg: str) -> None:
+    print(f"bench [{time.monotonic() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _run_child(workload: str, timeout: float, platforms: str | None) -> dict:
+    """One attempt: spawn the runner, parse its last JSON stdout line."""
+    # cwd must be the repo root: the tunneled TPU backend fails to register
+    # from other working directories. APPEND to PYTHONPATH — the TPU
+    # backend's PJRT plugin registers via a sitecustomize dir already on it;
+    # clobbering that path would cut every child off from the real chip.
+    existing = os.environ.get("PYTHONPATH", "")
+    env = {
+        **os.environ,
+        "PYTHONPATH": f"{REPO_ROOT}{os.pathsep}{existing}" if existing else REPO_ROOT,
+    }
+    if platforms is not None:
+        env["JAX_PLATFORMS"] = platforms
+    proc = subprocess.run(
+        [sys.executable, "-m", "k8s_gpu_device_plugin_tpu.benchmark.runner", workload],
+        cwd=REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    for line in proc.stderr.splitlines():
+        _log(f"{workload}> {line}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no JSON line from {workload} runner (rc={proc.returncode})")
+
+
+def run_workload(
+    workload: str, timeout: float, platforms: tuple[str | None, ...] = (None,)
+) -> dict | None:
+    """Up to ATTEMPTS tries with backoff, all inside the global deadline.
+
+    ``platforms`` cycles JAX_PLATFORMS values across attempts (None =
+    inherit): the tunneled chip has been seen failing as the pinned backend
+    name while still reachable under another ('axon' vs 'tpu' vs auto)."""
+    for attempt in range(1, ATTEMPTS + 1):
+        remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
+        if remaining <= 5:
+            _log(f"{workload}: global deadline exhausted before attempt {attempt}")
+            return None
+        plat = platforms[(attempt - 1) % len(platforms)]
+        _log(
+            f"{workload}: attempt {attempt}/{ATTEMPTS} "
+            f"(timeout {timeout:.0f}s, JAX_PLATFORMS={'inherit' if plat is None else plat!r})"
+        )
+        try:
+            result = _run_child(workload, timeout=min(timeout, remaining), platforms=plat)
+        except subprocess.TimeoutExpired:
+            _log(f"{workload}: attempt {attempt} timed out (backend wedged?)")
+            result = None
+        except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
+            _log(f"{workload}: attempt {attempt} failed: {type(e).__name__}: {e}")
+            result = None
+        if result is not None and "error" not in result:
+            return result
+        if result is not None:
+            _log(f"{workload}: runner error: {result['error']}")
+        if attempt < ATTEMPTS:
+            _log(f"{workload}: backing off {BACKOFF_SECONDS:.0f}s")
+            time.sleep(BACKOFF_SECONDS)
+    return None
 
 
 def main() -> int:
-    import jax
-
-    from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
-
-    device = jax.devices()[0]
-    print(
-        f"bench: device={device.device_kind!r} backend={jax.default_backend()}",
-        file=sys.stderr,
+    tpu_platforms = (None, "tpu", "")  # pinned name -> libtpu name -> auto
+    matmul = run_workload("matmul", timeout=300, platforms=tpu_platforms)
+    train = (
+        run_workload("train", timeout=480, platforms=tpu_platforms) if matmul else None
+    )
+    roundtrip = run_workload("roundtrip", timeout=120)
+    # BASELINE #2 exercised THROUGH the plugin (Allocate env contract ->
+    # subprocess workload); diagnostic unless the direct path also worked
+    allocated = (
+        run_workload("allocated", timeout=300, platforms=tpu_platforms)
+        if matmul
+        else None
     )
 
-    result = matmul_mfu(n=4096)
-    print(
-        f"bench: matmul 4096^3 bf16: {result.tflops:.1f} TFLOP/s "
-        f"(peak {result.peak_tflops:.0f}, mfu {result.mfu * 100:.1f}%) "
-        f"over {result.iters} iters in {result.seconds:.3f}s",
-        file=sys.stderr,
-    )
-
-    try:
-        from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
-        from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
-
-        tcfg = LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
-            n_kv_heads=8, d_ff=8192, max_seq=2048,
-        )
-        tr = train_mfu(tcfg, batch_size=8, seq_len=2048, steps=5, warmup=2)
-        print(
-            f"bench: llama train (0.6B, S=2048, flash+remat): "
-            f"{tr.mfu * 100:.1f}% MFU, {tr.tokens_per_second:.0f} tok/s, "
-            f"step {tr.step_seconds * 1000:.0f}ms",
-            file=sys.stderr,
-        )
-    except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
-        print(f"bench: train bench skipped: {type(e).__name__}: {e}", file=sys.stderr)
-
-    try:
-        from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
-            control_plane_roundtrip,
+    extra: dict = {}
+    if matmul:
+        extra["matmul_bf16_mfu_pct"] = matmul["mfu_pct"]
+        extra["matmul_tflops"] = matmul["tflops"]
+        extra["device_kind"] = matmul.get("device_kind", "")
+    if train:
+        extra["train_tokens_per_second"] = train["tokens_per_second"]
+        extra["train_step_ms"] = train["step_ms"]
+    if roundtrip:
+        extra["control_plane_allocs_per_second"] = roundtrip["allocs_per_second"]
+    if allocated:
+        extra["allocated_matmul_mfu_pct"] = allocated["mfu_pct"]
+        extra["allocated_via"] = (
+            f"{allocated['backend_used']}:TPU_VISIBLE_CHIPS="
+            f"{allocated['visible_chips']}"
         )
 
-        rt = control_plane_roundtrip(iters=50)
-        print(
-            f"bench: control-plane roundtrip: {rt.allocs_per_second:.0f} "
-            f"alloc/s, first registration in {rt.first_register_seconds:.2f}s",
-            file=sys.stderr,
-        )
-    except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
-        print(f"bench: roundtrip skipped: {type(e).__name__}: {e}", file=sys.stderr)
+    if train:
+        payload = {
+            "metric": "llama_train_bf16_mfu",
+            "value": train["mfu_pct"],
+            "unit": "% of peak",
+            "vs_baseline": round(train["mfu_pct"] / NORTH_STAR_TRAIN_MFU_PCT, 3),
+            **extra,
+        }
+    elif matmul:
+        # Train bench unavailable: report the matmul MFU under its own name
+        # (no vs_baseline — the 45% north star is a TRAIN-MFU target and the
+        # ratio would be apples-to-oranges).
+        payload = {
+            "metric": "matmul_bf16_mfu",
+            "value": matmul["mfu_pct"],
+            "unit": "% of peak",
+            "vs_baseline": None,
+            "error": "train bench failed; matmul-only result",
+            **extra,
+        }
+    else:
+        payload = {
+            "metric": "llama_train_bf16_mfu",
+            "value": None,
+            "unit": "% of peak",
+            "vs_baseline": None,
+            "error": "TPU workloads failed after retries (see stderr diagnostics)",
+            **extra,
+        }
 
-    print(
-        json.dumps(
-            {
-                "metric": "matmul_bf16_mfu",
-                "value": round(result.mfu * 100, 2),
-                "unit": "% of peak",
-                "vs_baseline": round(result.mfu / NORTH_STAR_MFU, 3),
-            }
-        )
-    )
+    print(json.dumps(payload))
     return 0
 
 

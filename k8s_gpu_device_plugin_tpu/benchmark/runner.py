@@ -1,0 +1,127 @@
+"""Subprocess benchmark runner: run ONE workload, print ONE JSON line.
+
+``bench.py`` orchestrates these as child processes so that a wedged TPU
+backend (the round-1 failure mode: the tunneled backend blocking forever in
+``jax.devices()``) can be killed from outside and retried — an in-process
+watchdog thread cannot interrupt a blocked C call. Each invocation prints a
+single JSON object as its LAST stdout line; anything else goes to stderr.
+
+Usage: python -m k8s_gpu_device_plugin_tpu.benchmark.runner {matmul|train|roundtrip}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _require_accelerator():
+    """First device, guaranteed non-CPU: when the parent retries with
+    JAX_PLATFORMS='' (auto-choose), a dead tunnel must surface as an error
+    here rather than silently timing a CPU matmul against TPU peak."""
+    import jax
+
+    device = jax.devices()[0]
+    print(
+        f"runner: device={device.device_kind!r} backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+    if device.platform == "cpu":
+        raise RuntimeError("no accelerator: auto-chosen backend is cpu-only")
+    return device
+
+
+def _run_matmul() -> dict:
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
+
+    device = _require_accelerator()
+    r = matmul_mfu(n=4096)
+    return {
+        "workload": "matmul",
+        "mfu_pct": round(r.mfu * 100, 2),
+        "tflops": round(r.tflops, 1),
+        "peak_tflops": r.peak_tflops,
+        "n": r.n,
+        "iters": r.iters,
+        "seconds": round(r.seconds, 3),
+        "device_kind": device.device_kind,
+    }
+
+
+def _run_train() -> dict:
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+    _require_accelerator()
+
+    cfg = LlamaConfig(
+        vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=8192, max_seq=2048,
+    )
+    r = train_mfu(cfg, batch_size=8, seq_len=2048, steps=5, warmup=2)
+    return {
+        "workload": "train",
+        "mfu_pct": round(r.mfu * 100, 2),
+        "tokens_per_second": round(r.tokens_per_second, 1),
+        "step_ms": round(r.step_seconds * 1000, 1),
+    }
+
+
+def _run_roundtrip() -> dict:
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
+        control_plane_roundtrip,
+    )
+
+    r = control_plane_roundtrip(iters=50)
+    return {
+        "workload": "roundtrip",
+        "allocs_per_second": round(r.allocs_per_second, 1),
+        "first_register_seconds": round(r.first_register_seconds, 3),
+    }
+
+
+def _run_allocated() -> dict:
+    """BASELINE #2 through the plugin: Allocate -> subprocess matmul."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.allocated_matmul import (
+        allocated_matmul,
+    )
+
+    r = allocated_matmul(topology="v5e-1", size=1)
+    if r.device_platform == "cpu":
+        raise RuntimeError("allocated subprocess saw no accelerator")
+    return {
+        "workload": "allocated",
+        "backend_used": r.backend_used,
+        "allocated_ids": r.allocated_ids,
+        "visible_chips": r.envs.get("TPU_VISIBLE_CHIPS", ""),
+        "device_kind": r.device_kind,
+        "mfu_pct": r.mfu_pct,
+        "tflops": r.tflops,
+    }
+
+
+WORKLOADS = {
+    "matmul": _run_matmul,
+    "train": _run_train,
+    "roundtrip": _run_roundtrip,
+    "allocated": _run_allocated,
+}
+
+
+def main(argv: list[str]) -> int:
+    name = argv[1] if len(argv) > 1 else ""
+    fn = WORKLOADS.get(name)
+    if fn is None:
+        print(json.dumps({"error": f"unknown workload {name!r}"}))
+        return 2
+    try:
+        payload = fn()
+    except Exception as e:  # noqa: BLE001 - the contract is one JSON line, always
+        print(json.dumps({"workload": name, "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
