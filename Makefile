@@ -13,10 +13,22 @@ native:
 native-test:
 	$(MAKE) -C $(NATIVE_DIR) test
 
+METRICS_DIR := k8s_gpu_device_plugin_tpu/metrics
+
 proto:
 	protoc --python_out=$(API_DIR) --proto_path=$(API_DIR) deviceplugin.proto
+	protoc --python_out=$(METRICS_DIR) --proto_path=$(METRICS_DIR) runtime_metrics.proto
 
 test: native-test
+	python -m pytest tests/ -q
+
+san-test:
+	$(MAKE) -C $(NATIVE_DIR) san-test
+
+# Full CI gate (SURVEY §5 race-detection/sanitizer row): plain native build
+# + unit test, ASan/UBSan build + test, and the Python suite (which includes
+# the manager concurrency stress in tests/test_manager_stress.py).
+ci: native native-test san-test
 	python -m pytest tests/ -q
 
 bench:
@@ -25,4 +37,4 @@ bench:
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
-.PHONY: all native native-test proto test bench clean
+.PHONY: all native native-test proto san-test ci test bench clean

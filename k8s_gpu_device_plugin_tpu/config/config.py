@@ -54,6 +54,10 @@ class Config:
     slice_shape: str = ""                      # for strategy "single", e.g. "2x2"
     slice_plan: str = ""                       # for strategy "mixed", e.g. "2x2,2x2"
     shared_replicas: int = 0                   # >0 => time-sliced sharing
+    # Workload-served libtpu runtime-metrics endpoints to scrape for usage
+    # gauges ("" = TPU_RUNTIME_METRICS_PORTS env or default 8431; "off"
+    # disables scraping entirely).
+    runtime_metrics_ports: str = ""
 
     # Multi-host slice membership (SURVEY §7 hard parts; BASELINE config #5).
     # Empty sliceTopology = single-host operation (the reference's only mode).
@@ -143,6 +147,7 @@ _KEY_MAP = {
     "numSlices": "num_slices",
     "sliceId": "slice_id",
     "megascaleCoordinator": "megascale_coordinator",
+    "runtimeMetricsPorts": "runtime_metrics_ports",
 }
 
 
@@ -186,6 +191,7 @@ def load_config(
     parser.add_argument("--numSlices", default=None, type=int)
     parser.add_argument("--sliceId", default=None, type=int)
     parser.add_argument("--megascaleCoordinator", default=None)
+    parser.add_argument("--runtimeMetricsPorts", default=None)
     parser.add_argument("--logLevel", default=None)
     parser.add_argument("--logFileDir", default=None)
     args = parser.parse_args(argv)
@@ -221,6 +227,7 @@ def load_config(
         "numSlices": args.numSlices,
         "sliceId": args.sliceId,
         "megascaleCoordinator": args.megascaleCoordinator,
+        "runtimeMetricsPorts": args.runtimeMetricsPorts,
     }
     _apply_mapping(cfg, {k: v for k, v in flag_overrides.items() if v is not None})
     if args.logLevel is not None:

@@ -21,6 +21,7 @@ pod publishes one.
 
 from __future__ import annotations
 
+import threading
 from typing import Protocol
 
 from prometheus_client import Gauge, Info, REGISTRY
@@ -52,6 +53,11 @@ class DeviceMetrics:
 
     def __init__(self, usage_reader: UsageReader | None = None, registry=REGISTRY) -> None:
         self._usage_reader = usage_reader or NullUsageReader()
+        self._usage_chips: set[int] = set()  # chips with live usage series
+        # update_usage may run on executor threads (server offloads the
+        # blocking gRPC scrape); serialize scrapes so concurrent /metrics
+        # hits cannot interleave a stale reading over a fresh zeroing
+        self._usage_lock = threading.Lock()
         ns = "tpu_plugin"
         self.build_info = Info("tpu_plugin_build", "Build information", registry=registry)
         self.build_info.info({"version": VERSION})
@@ -92,9 +98,21 @@ class DeviceMetrics:
             self.hbm_total.labels(chip=str(idx), generation=gen).set(mem)
 
     def update_usage(self) -> None:
-        for idx, usage in self._usage_reader.read().items():
+        with self._usage_lock:
+            self._update_usage_locked()
+
+    def _update_usage_locked(self) -> None:
+        reading = self._usage_reader.read()
+        for idx, usage in reading.items():
             self.hbm_used.labels(chip=str(idx)).set(usage.hbm_used_bytes)
             self.duty_cycle.labels(chip=str(idx)).set(usage.duty_cycle_percent)
             self.tensorcore_util.labels(chip=str(idx)).set(
                 usage.tensorcore_utilization
             )
+        # Workload gone (or no longer reporting a chip) -> that chip is idle:
+        # zero its gauges rather than exporting the last reading forever.
+        for idx in self._usage_chips - set(reading):
+            self.hbm_used.labels(chip=str(idx)).set(0)
+            self.duty_cycle.labels(chip=str(idx)).set(0)
+            self.tensorcore_util.labels(chip=str(idx)).set(0)
+        self._usage_chips = set(reading)

@@ -207,6 +207,18 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 int(creq.allocation_size),
                 self.topology,
             )
+            # Audit log: the first thing an operator debugging a bad
+            # placement needs is which IDs the scorer picked from what pool.
+            self.log.info(
+                "GetPreferredAllocation",
+                extra={"fields": {
+                    "resource": self.resource_name,
+                    "size": int(creq.allocation_size),
+                    "available": len(creq.available_deviceIDs),
+                    "must_include": list(creq.must_include_deviceIDs),
+                    "preferred": ids,
+                }},
+            )
             responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
         return pb.PreferredAllocationResponse(container_responses=responses)
 
@@ -324,11 +336,26 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             ids = list(creq.devicesIDs)
             if not self.chips.contains(*ids):
                 missing = [i for i in ids if i not in self.chips]
+                self.log.warning(
+                    "Allocate rejected",
+                    extra={"fields": {
+                        "resource": self.resource_name,
+                        "devices": ids,
+                        "unknown": missing,
+                    }},
+                )
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"invalid allocation request for {self.resource_name}: "
                     f"unknown device IDs {missing}",
                 )
+            self.log.info(
+                "Allocate",
+                extra={"fields": {
+                    "resource": self.resource_name,
+                    "devices": ids,
+                }},
+            )
             responses.append(self._container_allocate(ids))
         return pb.AllocateResponse(container_responses=responses)
 

@@ -22,14 +22,16 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from aiohttp import web
 from prometheus_client import REGISTRY, generate_latest, CONTENT_TYPE_LATEST
 
 from k8s_gpu_device_plugin_tpu.config import Config
 from k8s_gpu_device_plugin_tpu.metrics import DeviceMetrics, HttpMetrics
+from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import usage_reader_from_config
 from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
-from k8s_gpu_device_plugin_tpu.utils.envelope import success
+from k8s_gpu_device_plugin_tpu.utils.envelope import failed, success
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 from k8s_gpu_device_plugin_tpu.utils.version import VERSION
@@ -54,15 +56,21 @@ class Server:
         self.log = logger or get_logger()
         self.registry = registry
         self.http_metrics = HttpMetrics(registry=registry)
-        self.device_metrics = DeviceMetrics(registry=registry)
+        self.device_metrics = DeviceMetrics(
+            usage_reader=usage_reader_from_config(cfg), registry=registry
+        )
         self.routes = {"/", "/health", "/metrics", "/restart"}
         self.app = self._build_app()
         self._runner: web.AppRunner | None = None
         self.port: int | None = None  # actual bound port (useful when 0)
 
     def _build_app(self) -> web.Application:
+        # Outermost first: recovery+access-log wraps everything (≙ the
+        # reference wiring Recover and the request logger before metrics,
+        # server/server.go:40-43).
         app = web.Application(
             middlewares=[
+                self._recovery_middleware,
                 self.http_metrics.aiohttp_middleware(self.routes),
                 self._cors_middleware,
             ]
@@ -84,7 +92,12 @@ class Server:
     async def _metrics(self, request: web.Request) -> web.Response:
         # refresh device gauges from the live (health-applied) device sets
         self.device_metrics.update_inventory(self.manager.live_chip_map())
-        self.device_metrics.update_usage()
+        # usage scrape does blocking gRPC calls (up to 1s/port on a hung
+        # workload endpoint) -> keep the event loop (health probes, kubelet
+        # RPCs) responsive by scraping in a worker thread
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.device_metrics.update_usage
+        )
         body = generate_latest(self.registry)
         return web.Response(body=body, headers={"Content-Type": CONTENT_TYPE_LATEST})
 
@@ -92,7 +105,47 @@ class Server:
         self.manager.restart()
         return web.json_response(success("restart scheduled"))
 
+    # --- middleware (≙ echo Recover + request logger, server/server.go:40-43) ---
+
+    @web.middleware
+    async def _recovery_middleware(self, request: web.Request, handler):
+        """Structured access log for every request; unexpected handler
+        exceptions become an enveloped 500 with a stack trace in the log
+        instead of aiohttp's bare error page."""
+        start = time.monotonic()
+        try:
+            response = await handler(request)
+        except web.HTTPException as http_err:
+            response = http_err  # deliberate status (404 etc.): log + pass on
+        except Exception:  # noqa: BLE001 - the recovery seam by definition
+            self.log.exception(
+                "handler panic recovered",
+                extra={"fields": {"method": request.method, "path": request.path}},
+            )
+            response = web.json_response(failed("internal server error"), status=500)
+            # this response short-circuits the inner CORS middleware
+            self._apply_cors(response)
+        self.log.info(
+            "http request",
+            extra={"fields": {
+                "method": request.method,
+                "path": request.path,
+                "status": response.status,
+                "remote": request.remote,
+                "duration_ms": round((time.monotonic() - start) * 1000, 2),
+            }},
+        )
+        if isinstance(response, web.HTTPException):
+            raise response
+        return response
+
     # --- middleware (≙ hand-rolled CORS, server/server.go:77-96) ---
+
+    @staticmethod
+    def _apply_cors(response) -> None:
+        response.headers["Access-Control-Allow-Origin"] = "*"
+        response.headers["Access-Control-Allow-Methods"] = "GET,OPTIONS"
+        response.headers["Access-Control-Allow-Headers"] = "Content-Type"
 
     @web.middleware
     async def _cors_middleware(self, request: web.Request, handler):
@@ -100,9 +153,7 @@ class Server:
             response = web.Response(status=204)
         else:
             response = await handler(request)
-        response.headers["Access-Control-Allow-Origin"] = "*"
-        response.headers["Access-Control-Allow-Methods"] = "GET,OPTIONS"
-        response.headers["Access-Control-Allow-Headers"] = "Content-Type"
+        self._apply_cors(response)
         return response
 
     # --- lifecycle (≙ Server.Run, server/server.go:55-68) ---

@@ -33,3 +33,34 @@ except Exception:  # noqa: BLE001 - best effort; devices check below is the gate
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --- shared fixtures ------------------------------------------------------
+
+import logging  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def captured_log_records():
+    """Attach a capture handler to the project logger for one test.
+
+    (The JSON logger does not propagate to root, so pytest's caplog never
+    sees it — capture at the source instead.)
+    """
+    from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+    records: list[logging.LogRecord] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record: logging.LogRecord) -> None:
+            records.append(record)
+
+    handler = Capture(level=logging.INFO)
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)

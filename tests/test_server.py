@@ -131,6 +131,97 @@ def test_cors_headers(tmp_path):
     run(body())
 
 
+def test_usage_gauges_scrape_runtime_metrics(tmp_path):
+    """A workload-published runtime-metrics endpoint (faked) must surface as
+    populated hbm_used/duty_cycle/tensorcore gauges via GET /metrics."""
+    from k8s_gpu_device_plugin_tpu.metrics import runtime_metrics as rm
+
+    fake = rm.FakeRuntimeMetricsServer({
+        rm.HBM_USAGE: {0: 12_000_000_000, 1: 8_500_000_000},
+        rm.DUTY_CYCLE: {0: 87.5, 1: 12.0},
+        rm.TENSORCORE_UTIL: {0: 64.2, 1: 3.3},
+    })
+    port = fake.start()
+
+    async def body():
+        base, _, teardown = await start_http_stack(
+            tmp_path, runtime_metrics_ports=str(port)
+        )
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+            assert 'tpu_plugin_chip_hbm_used_bytes{chip="0"} 1.2e+010' in text
+            assert 'tpu_plugin_chip_hbm_used_bytes{chip="1"} 8.5e+09' in text
+            assert 'tpu_plugin_chip_duty_cycle_percent{chip="0"} 87.5' in text
+            assert 'tpu_plugin_chip_tensorcore_utilization{chip="0"} 64.2' in text
+            assert 'tpu_plugin_chip_tensorcore_utilization{chip="1"} 3.3' in text
+
+            # gauges move when the workload's numbers move
+            fake.values[rm.DUTY_CYCLE][0] = 42.0
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+            assert 'tpu_plugin_chip_duty_cycle_percent{chip="0"} 42.0' in text
+
+            # workload exits -> endpoint gone -> gauges read idle, not stale
+            fake.stop()
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/metrics") as resp:
+                    text = await resp.text()
+            assert 'tpu_plugin_chip_duty_cycle_percent{chip="0"} 0.0' in text
+            assert 'tpu_plugin_chip_hbm_used_bytes{chip="0"} 0.0' in text
+        finally:
+            await teardown()
+
+    try:
+        run(body())
+    finally:
+        fake.stop()
+
+
+def test_usage_reader_absent_endpoint_is_silent(tmp_path):
+    """No workload holding the chips -> no endpoint -> empty usage, no error."""
+    from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import LibtpuUsageReader
+
+    reader = LibtpuUsageReader(ports=[1], timeout_seconds=0.2)  # nothing listens
+    assert reader.read() == {}
+    reader.close()
+
+
+def test_recovery_middleware_and_access_log(tmp_path, captured_log_records):
+    """Handler exceptions become an enveloped 500 (≙ echo Recover,
+    server/server.go:40-43) and every request leaves a structured
+    access-log line."""
+    records = captured_log_records
+
+    async def body():
+        base, manager, teardown = await start_http_stack(tmp_path)
+        try:
+            # /restart delegates to manager.restart -> make it panic for real
+            def boom():
+                raise RuntimeError("boom")
+
+            manager.restart = boom
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/restart") as resp:
+                    assert resp.status == 500
+                    data = await resp.json()
+                    assert data["code"] == 500
+                    assert data["msg"] == "internal server error"
+                    assert resp.headers["Access-Control-Allow-Origin"] == "*"
+                async with session.get(f"{base}/health") as resp:
+                    assert resp.status == 200
+            messages = [r.getMessage() for r in records]
+            assert "handler panic recovered" in messages
+            access = [r for r in records if r.getMessage() == "http request"]
+            assert len(access) >= 2  # one per request, including the 500
+        finally:
+            await teardown()
+
+    run(body())
+
+
 def test_normalize_status():
     assert normalize_status(200) == "2xx"
     assert normalize_status(404) == "4xx"
