@@ -310,30 +310,26 @@ def forward_with_aux(
     pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1
     if pp > 1:
         # Looped GSPMD pipeline (parallel/pipeline.py): embed/head are cheap
-        # and replicated over pp; only the block stack is pipelined.
-        if cfg.is_moe:
-            raise NotImplementedError(
-                "MoE aux-loss collection through the pipeline is not "
-                "supported yet; use pp=1 for MoE configs"
-            )
+        # and replicated over pp; only the block stack is pipelined. MoE aux
+        # losses ride the pipeline as per-stage scalars: summed over stages,
+        # averaged over microbatches (per-microbatch router statistics — the
+        # standard pipelined-MoE semantics).
         from k8s_gpu_device_plugin_tpu.parallel.pipeline import pipeline_blocks
 
         def stage_fn(stage_layers, h):
             def body(carry, layer):
-                out, _ = block(carry, layer)
-                return out, None
+                return block(carry, layer)
 
-            h, _ = jax.lax.scan(body, h, stage_layers)
-            return h
+            h, aux_stacked = jax.lax.scan(body, h, stage_layers)
+            return h, {k: jnp.sum(v) for k, v in aux_stacked.items()}
 
-        x = pipeline_blocks(
+        x, aux = pipeline_blocks(
             stage_fn,
             params["layers"],
             x,
             n_stages=pp,
             n_microbatches=max(cfg.n_microbatches, 1),
         )
-        aux = {}
     else:
 
         def scan_body(carry, layer):

@@ -73,13 +73,22 @@ def pipeline_blocks(
     *,
     n_stages: int,
     n_microbatches: int,
-) -> jax.Array:
+) -> tuple[jax.Array, dict]:
     """Run ``x`` (B, S, D) through ``n_stages`` pipeline stages.
 
-    ``stage_fn(stage_params_i, x_mb) -> x_mb`` applies ONE stage's layers to
-    one microbatch; it is vmapped over the leading stage dimension of
-    ``stage_params`` (each leaf shaped (pp, L//pp, ...), sharded over
-    ``pp``). ``n_microbatches`` must divide the batch B.
+    ``stage_fn(stage_params_i, x_mb) -> (x_mb, aux)`` applies ONE stage's
+    layers to one microbatch and returns that stage's scalar aux losses
+    (``{}`` for dense stacks); it is vmapped over the leading stage
+    dimension of ``stage_params`` (each leaf shaped (pp, L//pp, ...),
+    sharded over ``pp``). ``n_microbatches`` must divide the batch B.
+
+    Returns ``(out (B, S, D), aux)`` where each aux leaf is summed over
+    stages and averaged over microbatches — matching the unpipelined
+    semantics of "sum over layers of a full-batch mean loss" (MoE balance /
+    router-z terms are per-token means, so microbatch means average, not
+    add). During fill and drain ticks, stages holding no live microbatch
+    contribute zero: stage ``s`` holds microbatch ``t - s`` at tick ``t``,
+    valid only when ``0 <= t - s < M``.
     """
     B, S, D = x.shape
     M = n_microbatches
@@ -100,8 +109,12 @@ def pipeline_blocks(
     # q/k/v over pp and making every device compute every stage's attention.
     vstages = jax.vmap(stage_fn, spmd_axis_name=AXIS_PP)
 
+    # aux accumulator structure (leaves are (pp,)-shaped per-stage scalars)
+    aux_struct = jax.eval_shape(vstages, stage_params, state)[1]
+    aux_acc0 = jax.tree.map(lambda _: jnp.zeros((), jnp.float32), aux_struct)
+
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         # stage-dim roll = one collective-permute hop stage i -> i+1
         state = jnp.roll(state, 1, axis=0)
         inp = jax.lax.dynamic_index_in_dim(
@@ -109,8 +122,16 @@ def pipeline_blocks(
         )
         state = state.at[0].set(inp)
         state = constrain(state, state_spec)
-        state = vstages(stage_params, state)
+        state, aux_stage = vstages(stage_params, state)
         state = constrain(state, state_spec)
+        # mask out stages computing on fill/drain garbage, then accumulate
+        live = ((t - jnp.arange(n_stages)) >= 0) & ((t - jnp.arange(n_stages)) < M)
+        aux_acc = jax.tree.map(
+            lambda acc, leaf: acc
+            + jnp.sum(jnp.where(live, leaf.astype(jnp.float32), 0.0)),
+            aux_acc,
+            aux_stage,
+        )
         # collect the last stage's result once the pipeline has filled
         done = state[n_stages - 1]
         out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
@@ -118,9 +139,10 @@ def pipeline_blocks(
             outputs, done, out_idx, axis=0
         )
         outputs = jnp.where(t >= n_stages - 1, updated, outputs)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    (_, outputs), _ = jax.lax.scan(
-        tick, (state, outputs), jnp.arange(M + n_stages - 1)
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        tick, (state, outputs, aux_acc0), jnp.arange(M + n_stages - 1)
     )
-    return outputs.reshape(B, S, D)
+    aux = jax.tree.map(lambda a: a / M, aux_acc)
+    return outputs.reshape(B, S, D), aux
