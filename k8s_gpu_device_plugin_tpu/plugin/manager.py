@@ -218,12 +218,18 @@ class PluginManager:
         self._load_plugins()
         await self._start_plugins()
 
-    def _guard_crash_loop(self, resource: str) -> None:
-        """≤5 starts per rolling hour per resource, then fatal (plugin.go:111-127).
+    def _check_crash_budget(self, resource: str) -> None:
+        """≤5 successful starts per rolling hour per resource, then fatal.
 
-        The raised error propagates out of ``start()`` and — via the run
-        group in main.py — terminates the daemon, matching the reference's
-        ``log.Fatal`` semantics.
+        Semantics refined from plugin.go:111-127: the budget meters *restart
+        cycles of a working plugin* (restart storms — kubelet crash-looping,
+        /restart spam), and — unlike the reference, which zeroes its count on
+        every rebuild — it survives rebuilds because it is keyed manager-side
+        by resource. FAILED start attempts (kubelet away, socket errors) do
+        NOT consume it: those are the 30s retry loop's domain and retry
+        forever, matching manager.go:137 — a kubelet outage must never be
+        fatal. The raised error propagates out of ``start()`` and — via the
+        run group in main.py — terminates the daemon (``log.Fatal`` ≙).
         """
         now = time.monotonic()
         times = [
@@ -231,13 +237,15 @@ class PluginManager:
             for t in self._start_times.get(resource, [])
             if now - t < START_WINDOW_SECONDS
         ]
+        self._start_times[resource] = times
         if len(times) >= MAX_STARTS:
             raise RuntimeError(
                 f"plugin {resource} crash-looped {MAX_STARTS} times within "
                 f"{START_WINDOW_SECONDS:.0f}s; giving up"
             )
-        times.append(now)
-        self._start_times[resource] = times
+
+    def _consume_crash_budget(self, resource: str) -> None:
+        self._start_times.setdefault(resource, []).append(time.monotonic())
 
     async def _start_plugins(self) -> bool:
         """Start all plugins; returns True if every start succeeded.
@@ -250,7 +258,7 @@ class PluginManager:
         for plugin in self.plugins:
             if plugin.started:
                 continue
-            self._guard_crash_loop(plugin.resource_name)
+            self._check_crash_budget(plugin.resource_name)
             try:
                 await plugin.start()
             except Exception as e:  # noqa: BLE001
@@ -260,6 +268,8 @@ class PluginManager:
                     extra={"fields": {"resource": plugin.resource_name,
                                       "error": f"{type(e).__name__}: {e}"}},
                 )
+            else:
+                self._consume_crash_budget(plugin.resource_name)
         return ok
 
     async def _stop_plugins(self) -> None:

@@ -1,13 +1,15 @@
 """Crash-loop guard & fatality propagation (≙ plugin.go:111-127 semantics).
 
-The reference kept the 5-per-hour restart budget per plugin instance (reset
-on every rebuild) and its "give up" was log.Fatal. Here the budget lives in
-the manager, keyed by resource, and exhaustion raises out of ``start()`` so
-the main.py run group terminates the daemon.
+Refined budget semantics (see manager._check_crash_budget): failed start
+attempts retry forever on the 30s loop (manager.go:137 — a kubelet outage is
+never fatal); SUCCESSFUL restart cycles are metered at 5 per rolling hour
+per resource, and the budget survives rebuilds (manager-side, keyed by
+resource — stricter than the reference, which zeroes its count on every
+rebuild). Exhaustion raises out of ``start()`` so the main.py run group
+terminates the daemon (``log.Fatal`` ≙).
 """
 
 import asyncio
-import tempfile
 
 import pytest
 
@@ -15,13 +17,15 @@ import k8s_gpu_device_plugin_tpu.plugin.plugin as plugin_mod
 from k8s_gpu_device_plugin_tpu.config import Config
 from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
 from k8s_gpu_device_plugin_tpu.main import run_daemon
-from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+from k8s_gpu_device_plugin_tpu.plugin.manager import MAX_STARTS, PluginManager
+from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 
 
-def test_crash_loop_budget_is_fatal(monkeypatch, tmp_path):
-    """No kubelet + fast retries -> budget exhausted -> RuntimeError."""
-    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.2)
+def test_start_failures_retry_forever_without_fatal(monkeypatch, tmp_path):
+    """No kubelet -> every start attempt fails -> NOT fatal: the manager must
+    still be alive and retrying well past MAX_STARTS attempts."""
+    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.1)
 
     async def body():
         cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
@@ -30,24 +34,59 @@ def test_crash_loop_budget_is_fatal(monkeypatch, tmp_path):
             Latch(),
             backend=FakeBackend("v5e-4"),
             health_interval=30,
-            retry_interval=0.1,
+            retry_interval=0.05,
         )
-        with pytest.raises(RuntimeError, match="crash-looped"):
-            await asyncio.wait_for(manager.start(), timeout=30)
+        task = asyncio.create_task(manager.start())
+        # > MAX_STARTS failed attempts happen within ~a second at this pace
+        await asyncio.sleep(2.0)
+        assert not task.done(), task.exception() if task.done() else None
+        await manager.stop()
+        await asyncio.wait_for(task, 10)
+
+    asyncio.run(body())
+
+
+def test_restart_storm_exhausts_budget_and_is_fatal(tmp_path):
+    """> MAX_STARTS successful restart cycles within the window -> fatal."""
+
+    async def body():
+        kubelet = FakeKubelet(str(tmp_path))
+        await kubelet.start()
+        cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
+        manager = PluginManager(
+            cfg, Latch(), backend=FakeBackend("v5e-4"), health_interval=30
+        )
+        task = asyncio.create_task(manager.start())
+        try:
+            await kubelet.wait_for_registrations(1)
+            for n in range(2, MAX_STARTS + 2):
+                manager.restart()
+                if n <= MAX_STARTS:
+                    await kubelet.wait_for_registrations(n)
+                else:
+                    with pytest.raises(RuntimeError, match="crash-looped"):
+                        await asyncio.wait_for(task, 10)
+        finally:
+            if not task.done():
+                await manager.stop()
+                await asyncio.gather(task, return_exceptions=True)
+            await kubelet.stop()
 
     asyncio.run(body())
 
 
 def test_run_daemon_exits_on_manager_failure(monkeypatch, tmp_path):
-    """A manager that can never start must take run_daemon down, not hang.
+    """A manager whose start() raises must take run_daemon down, not hang.
 
     (Review finding: the reference's oklog run group exits when any actor
     fails; the first draft of run_daemon awaited stop.wait() forever.)
     """
-    monkeypatch.setattr(plugin_mod, "DIAL_TIMEOUT_SECONDS", 0.2)
     import k8s_gpu_device_plugin_tpu.plugin.manager as manager_mod
 
-    monkeypatch.setattr(manager_mod, "RETRY_INTERVAL_SECONDS", 0.1)
+    def explode(self):
+        raise RuntimeError("enumeration exploded")
+
+    monkeypatch.setattr(manager_mod.PluginManager, "_load_plugins", explode)
 
     async def body():
         cfg = Config(
@@ -57,7 +96,7 @@ def test_run_daemon_exits_on_manager_failure(monkeypatch, tmp_path):
             backend="fake",
         )
         cfg.log.file_dir = ""
-        with pytest.raises(RuntimeError, match="crash-looped"):
+        with pytest.raises(RuntimeError, match="enumeration exploded"):
             await asyncio.wait_for(run_daemon(cfg), timeout=30)
 
     asyncio.run(body())
