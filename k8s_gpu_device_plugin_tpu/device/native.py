@@ -19,6 +19,7 @@ import functools
 import os
 
 from k8s_gpu_device_plugin_tpu.device.backend import ChipSpec
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 from k8s_gpu_device_plugin_tpu.device.topology import (
     GENERATIONS,
     HostTopology,
@@ -155,7 +156,21 @@ class NativeBackend:
         topo = self.host_topology()
         coords = topo.coords()
         specs = []
-        for info in self._enumerate_raw():
+        raw = self._enumerate_raw()
+        # If every chip reports coord (0,...,0) the driver exposed no mesh
+        # coordinates at all; we substitute row-major positions. Warn once:
+        # the allocator's ICI-contiguity scoring runs on these coords, so
+        # placements are a guess until the driver provides real ones.
+        fabricated = len(raw) > 1 and all(
+            all(int(c) == 0 for c in info.coord[: len(topo.bounds)]) for info in raw
+        )
+        if fabricated:
+            get_logger().warning(
+                "driver exposed no mesh coordinates; assuming row-major "
+                "chip layout for ICI scoring",
+                extra={"fields": {"chips": len(raw), "topology": str(topo)}},
+            )
+        for info in raw:
             index = int(info.index)
             coord = tuple(int(c) for c in info.coord[: len(topo.bounds)])
             if all(c == 0 for c in coord) and index < len(coords):

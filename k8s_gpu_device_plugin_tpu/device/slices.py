@@ -80,7 +80,7 @@ def _normalize(shape: tuple[int, ...], dims: int) -> tuple[int, ...]:
 
 
 def supported_profiles(topo: HostTopology) -> list[SliceProfile]:
-    """All power-of-two sub-mesh shapes that tile the host mesh.
+    """All divisor sub-mesh shapes that tile the host mesh.
 
     ≙ VisitMigProfiles filtering to C==G slices (resources.go:43-51): only
     shapes whose every axis divides the host bound are supported, so any

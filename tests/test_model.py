@@ -56,6 +56,35 @@ def test_ring_attention_matches_reference(sp_mesh):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_path_matches_reference(sp_mesh, causal):
+    """Shard shapes that pass _flash_ok (s=512/sp=4 -> lq=128, d=64): the
+    Pallas flash kernel + lse softmax-merge path, values AND grads."""
+    from k8s_gpu_device_plugin_tpu.parallel.ring_attention import _flash_ok
+
+    assert _flash_ok(128, 128, 64), "shapes no longer hit the flash path"
+    q, k, v = make_qkv(jax.random.key(2), b=1, s=512, hq=4, hkv=2, d=64)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, sp_mesh, causal=causal) ** 2)
+
+    expected = mha_reference(q, k, v, causal=causal)
+    got = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, sp_mesh, causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-4)
+
+    grads_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    grads_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for gr, gg in zip(grads_ref, grads_ring):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gr), atol=5e-3, rtol=1e-3
+        )
+
+
 def test_ring_attention_non_causal(sp_mesh):
     q, k, v = make_qkv(jax.random.key(1))
     expected = mha_reference(q, k, v, causal=False)
@@ -133,3 +162,33 @@ def test_graft_entry_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_graft_entry_forces_cpu_before_backend_init():
+    """The driver scenario: fresh process, a NON-cpu platform pinned in the
+    env, no host-device-count flag. _acquire_devices must reach the virtual
+    CPU mesh without ever initializing the pinned platform (round-1 failure:
+    it hung inside jax.devices() on a wedged tunneled backend)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["JAX_PLATFORMS"] = "tpu"  # pinned non-cpu platform (no TPU attached)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "from __graft_entry__ import _acquire_devices\n"
+        "devices = _acquire_devices(8)\n"
+        "assert len(devices) == 8, devices\n"
+        "assert devices[0].platform == 'cpu', devices[0]\n"
+        "print('fallback-ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "fallback-ok" in proc.stdout

@@ -65,7 +65,9 @@ def test_register_and_list_and_watch(tmp_path):
     run(body())
 
 
-def test_allocate_wires_devices_and_envs(tmp_path):
+def test_allocate_wires_devices_and_envs(tmp_path, captured_log_records):
+    records = captured_log_records
+
     async def body():
         kubelet, manager, task, _ = await start_stack(tmp_path)
         try:
@@ -93,6 +95,9 @@ def test_allocate_wires_devices_and_envs(tmp_path):
                 for spec in cresp.devices:
                     assert spec.host_path.startswith("/dev/accel")
                     assert spec.permissions == "rw"
+            # RPC audit log: the allocated device IDs must be in the record
+            audits = [r for r in records if r.getMessage() == "Allocate"]
+            assert audits and audits[-1].fields["devices"] == ids
         finally:
             await stop_stack(kubelet, manager, task)
 
@@ -123,7 +128,9 @@ def test_allocate_unknown_id_rejected(tmp_path):
     run(body())
 
 
-def test_preferred_allocation_is_ici_contiguous(tmp_path):
+def test_preferred_allocation_is_ici_contiguous(tmp_path, captured_log_records):
+    records = captured_log_records
+
     async def body():
         kubelet, manager, task, _ = await start_stack(tmp_path, topology="v5e-8")
         try:
@@ -150,6 +157,10 @@ def test_preferred_allocation_is_ici_contiguous(tmp_path):
                 ys = {c[1] for c in coords}
                 assert len(xs) == 2 and len(ys) == 2
                 assert max(ys) - min(ys) == 1
+            audits = [
+                r for r in records if r.getMessage() == "GetPreferredAllocation"
+            ]
+            assert audits and sorted(audits[-1].fields["preferred"]) == sorted(ids)
         finally:
             await stop_stack(kubelet, manager, task)
 
