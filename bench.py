@@ -111,7 +111,7 @@ def main() -> int:
     # BASELINE #2 exercised THROUGH the plugin (Allocate env contract ->
     # subprocess workload); diagnostic unless the direct path also worked
     allocated = (
-        run_workload("allocated", timeout=300, platforms=tpu_platforms)
+        run_workload("allocated", timeout=480, platforms=tpu_platforms)
         if matmul
         else None
     )
@@ -124,10 +124,13 @@ def main() -> int:
     if train:
         extra["train_tokens_per_second"] = train["tokens_per_second"]
         extra["train_step_ms"] = train["step_ms"]
+        extra["train_model_dims"] = train.get("model")
     if roundtrip:
         extra["control_plane_allocs_per_second"] = roundtrip["allocs_per_second"]
     if allocated:
         extra["allocated_matmul_mfu_pct"] = allocated["mfu_pct"]
+        extra["allocated_matmul_n"] = allocated.get("n")
+        extra["allocated_matmul_iters"] = allocated.get("iters")
         extra["allocated_via"] = (
             f"{allocated['backend_used']}:TPU_VISIBLE_CHIPS="
             f"{allocated['visible_chips']}"

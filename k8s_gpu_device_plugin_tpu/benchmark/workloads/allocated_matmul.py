@@ -39,6 +39,8 @@ class AllocatedRunResult:
     device_platform: str
     mfu_pct: float | None
     tflops: float | None
+    n: int | None = None       # problem size the child actually ran
+    iters: int | None = None
 
 
 _CHILD_CODE = r"""
@@ -55,9 +57,17 @@ from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
 device = jax.devices()[0]
 out = {"device_kind": device.device_kind, "platform": device.platform}
 if device.platform != "cpu":
-    r = matmul_mfu(n=2048, iters=128, repeats=2)
+    # IDENTICAL workload to the direct path (runner._run_matmul: n=4096 with
+    # matmul_mfu defaults) — the whole point of this workload is proving the
+    # Allocate env contract costs nothing, which only a like-for-like
+    # comparison can show. Shrink only for CPU-backed smoke tests via env.
+    n = int(os.environ.get("ALLOCATED_MATMUL_N", "4096"))
+    iters = int(os.environ.get("ALLOCATED_MATMUL_ITERS", "512"))
+    r = matmul_mfu(n=n, iters=iters)
     out["mfu_pct"] = round(r.mfu * 100, 2)
     out["tflops"] = round(r.tflops, 1)
+    out["n"] = r.n
+    out["iters"] = r.iters
 print(json.dumps(out))
 """
 
@@ -159,4 +169,6 @@ def allocated_matmul(
         device_platform=seen["platform"],
         mfu_pct=seen.get("mfu_pct"),
         tflops=seen.get("tflops"),
+        n=seen.get("n"),
+        iters=seen.get("iters"),
     )

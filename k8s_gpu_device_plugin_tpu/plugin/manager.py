@@ -326,9 +326,12 @@ class PluginManager:
                 }},
             )
             self._chip_health = health
-            for plugin, (name, chips) in zip(
-                self.plugins, sorted(self.chip_map.items())
-            ):
+            for plugin in self.plugins:
+                chips = self.chip_map.get(plugin.resource_name)
+                if chips is None:
+                    # A rebuild is in flight and this plugin's resource is
+                    # gone from the map; the restart path re-pushes state.
+                    continue
                 plugin.update_health(self._with_health(chips))
 
     # --- introspection for /metrics and tests ---

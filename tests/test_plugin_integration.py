@@ -193,6 +193,48 @@ def test_health_transition_pushes_update(tmp_path):
     run(body())
 
 
+def test_health_fanout_keys_by_resource_name(tmp_path):
+    """Health deltas must route by resource NAME, not list position.
+
+    Regression (r2 verdict weak #5): ``_health_loop`` used to pair plugins
+    with chip sets via ``zip(self.plugins, sorted(chip_map.items()))`` —
+    any ordering divergence silently pushed one resource's chips into
+    another plugin's ListAndWatch stream.
+    """
+    from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY, UNHEALTHY
+
+    async def body():
+        kubelet, manager, task, backend = await start_stack(
+            tmp_path,
+            topology="v5e-8",
+            slice_strategy="mixed",
+            slice_plan="2x2,1x2,1x2",
+        )
+        try:
+            await kubelet.wait_for_registrations(2)
+            # Force the plugins list out of sorted-map order — exactly the
+            # divergence the positional zip mis-paired.
+            manager.plugins = list(reversed(manager.plugins))
+            by_name = {p.resource_name: p for p in manager.plugins}
+            affected = by_name["google.com/tpu-slice-2x2"]
+            other = by_name["google.com/tpu-slice-1x2"]
+            # chip index 0 is a member of the 2x2 slice only
+            assert any(0 in c.chip_indices for c in affected.chips.values())
+            assert all(0 not in c.chip_indices for c in other.chips.values())
+
+            backend.set_unhealthy(0)
+            for _ in range(50):
+                await asyncio.sleep(0.1)
+                if any(c.health == UNHEALTHY for c in affected.chips.values()):
+                    break
+            assert any(c.health == UNHEALTHY for c in affected.chips.values())
+            assert all(c.health == HEALTHY for c in other.chips.values())
+        finally:
+            await stop_stack(kubelet, manager, task)
+
+    run(body())
+
+
 def test_kubelet_restart_triggers_reregistration(tmp_path):
     async def body():
         kubelet, manager, task, _ = await start_stack(tmp_path)
