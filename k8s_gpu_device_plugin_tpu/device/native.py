@@ -85,17 +85,27 @@ def _declare_signatures(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int32,
     ]
+    lib.tpuenum_internal_edges_wrap.restype = ctypes.c_int32
+    lib.tpuenum_internal_edges_wrap.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
     return lib
 
 
 def native_internal_edges(
-    coords: list[tuple[int, ...]], bounds: tuple[int, ...]
+    coords: list[tuple[int, ...]],
+    bounds: tuple[int, ...],
+    wraparound: tuple[bool, ...] | None = None,
 ) -> int | None:
     """ICI edges internal to ``coords`` via the C++ core, or None if the
     library is unavailable (callers fall back to the Python scorer).
 
-    No wraparound: only valid for mesh (non-torus) bounds, matching the C
-    implementation.
+    ``wraparound`` flags axes whose ICI closes into a ring (torus slices);
+    None/all-False scores a plain mesh.
     """
     lib = _load_library()
     if lib is None:
@@ -106,7 +116,13 @@ def native_internal_edges(
     flat = [c for coord in coords for c in coord]
     c_coords = (ctypes.c_int32 * len(flat))(*flat)
     c_bounds = (ctypes.c_int32 * dims)(*bounds)
-    result = lib.tpuenum_internal_edges(c_coords, len(coords), c_bounds, dims)
+    if wraparound and any(wraparound):
+        c_wrap = (ctypes.c_int32 * dims)(*(1 if w else 0 for w in wraparound))
+        result = lib.tpuenum_internal_edges_wrap(
+            c_coords, len(coords), c_bounds, c_wrap, dims
+        )
+    else:
+        result = lib.tpuenum_internal_edges(c_coords, len(coords), c_bounds, dims)
     return None if result < 0 else int(result)
 
 

@@ -198,16 +198,21 @@ def as_slice_member(
         raise ValueError(
             f"slice {slice_bounds} does not tile evenly by host {host.bounds}"
         )
-    # Full-torus wraparound exists only when the slice closes each axis; a
-    # single host's sub-mesh never wraps onto itself.
+    # Wraparound is a property of the FULL slice (generation rules in
+    # wraparound_for). A host tile sees the ring-closing link as host-LOCAL
+    # only on axes it spans entirely (host_grid == 1 there); on split axes
+    # the wrap link connects chips of different hosts and host-local
+    # allocation must not count it.
+    grid = tuple(s // b for s, b in zip(slice_bounds, host.bounds))
     placed = HostTopology(
         generation=host.generation,
         bounds=host.bounds,
         slice_bounds=slice_bounds,
         host_offset=tuple(0 for _ in host.bounds),
-        wraparound=tuple(False for _ in host.bounds),
+        wraparound=tuple(
+            w and g == 1 for w, g in zip(full.wraparound, grid)
+        ),
     )
-    grid = placed.host_grid
     if not 0 <= worker_id < placed.num_hosts:
         raise ValueError(
             f"workerId {worker_id} out of range for {placed.num_hosts} hosts"
@@ -221,6 +226,33 @@ def as_slice_member(
         rem //= g
     offset = tuple(o * b for o, b in zip(reversed(offset), host.bounds))
     return replace(placed, host_offset=offset)
+
+
+def wraparound_for(gen: TpuGeneration, bounds: tuple[int, ...]) -> tuple[bool, ...]:
+    """Per-axis torus closure for a slice of this shape (generation rules).
+
+    - 2D generations (v5e/v6e, fixed board wiring): 4x4-and-larger slices
+      are modeled as tori (all axes wrap); smaller slices are plain meshes.
+    - 3D generations (v4/v5p, OCS-reconfigurable fabric): the optical
+      switches close any axis whose extent is a multiple of 4 — standard
+      slices (all dims multiples of 4) are full 3D tori; a 2-extent axis
+      (e.g. the trailing 2 of a 4x4x2) stays a mesh.
+
+    An axis of extent <= 2 never wraps: its "closing" link would be the same
+    physical link already counted (neighbors()/the C scorer guard this too).
+
+    Caveat: public docs are ambiguous on exactly which sub-pod v5e/v6e
+    shapes get physical ring closure (some read as full-pod axes only,
+    e.g. 8x16/16x16). Scoring a phantom wrap link can prefer a boundary
+    placement over a genuinely better interior one, so deployments whose
+    fabric lacks closure should override per-host via
+    ``dataclasses.replace(topo, wraparound=...)`` — the allocator and
+    neighbor math take whatever flags the topology carries.
+    """
+    if gen.ici_dims == 2:
+        closed = all(b >= 4 for b in bounds)
+        return tuple(closed for _ in bounds)
+    return tuple(b % 4 == 0 for b in bounds)
 
 
 _TOPOLOGY_RE = re.compile(r"^(v\d+[a-z]*)-(\d+)$")
@@ -258,4 +290,6 @@ def parse_topology(spec: str) -> HostTopology:
             raise ValueError(
                 f"shape {shape} has more dims than {gen_name}'s ICI ({gen.ici_dims}D)"
             )
-    return HostTopology(generation=gen, bounds=shape)
+    return HostTopology(
+        generation=gen, bounds=shape, wraparound=wraparound_for(gen, shape)
+    )

@@ -213,6 +213,12 @@ int32_t tpuenum_generation(char* out, int32_t max) {
 
 int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,
                                const int32_t* bounds, int32_t dims) {
+  return tpuenum_internal_edges_wrap(coords, n, bounds, nullptr, dims);
+}
+
+int32_t tpuenum_internal_edges_wrap(const int32_t* coords, int32_t n,
+                                    const int32_t* bounds, const int32_t* wrap,
+                                    int32_t dims) {
   if (coords == nullptr || bounds == nullptr || n < 0 || dims <= 0 || dims > 3)
     return -1;
   std::set<std::vector<int32_t>> cells;
@@ -224,7 +230,13 @@ int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,
     for (int32_t axis = 0; axis < dims; ++axis) {
       std::vector<int32_t> neighbor = cell;
       neighbor[axis] += 1;  // count each edge once (positive direction)
-      if (neighbor[axis] >= bounds[axis]) continue;
+      if (neighbor[axis] >= bounds[axis]) {
+        // Torus closure: the +1 step off the boundary lands on cell 0. Only
+        // a real extra link when the ring has > 2 cells (at 2, forward and
+        // "wrap" are the same physical link, already counted).
+        if (wrap == nullptr || wrap[axis] == 0 || bounds[axis] <= 2) continue;
+        neighbor[axis] = 0;
+      }
       if (cells.count(neighbor)) ++edges;
     }
   }

@@ -49,6 +49,15 @@ int32_t tpuenum_generation(char* out, int32_t max);
 int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,
                                const int32_t* bounds, int32_t dims);
 
+// Torus-aware variant: `wrap` (len = dims, may be NULL = no wrap) flags axes
+// whose ICI closes into a ring — v5e/v6e 4x4-and-larger slices, v4/v5p
+// cube-multiple slices (OCS wraparound). A wrap edge on an axis exists only
+// when that axis extent is > 2 (at extent 2 the "wrap" link is the same
+// physical link counted forward). Returns edge count, or -1 on bad args.
+int32_t tpuenum_internal_edges_wrap(const int32_t* coords, int32_t n,
+                                    const int32_t* bounds, const int32_t* wrap,
+                                    int32_t dims);
+
 #ifdef __cplusplus
 }  // extern "C"
 #endif

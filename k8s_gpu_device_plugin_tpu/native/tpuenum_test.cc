@@ -91,6 +91,21 @@ int main() {
   CHECK(tpuenum_internal_edges(nullptr, 1, bounds, 2) == -1);
   CHECK(tpuenum_internal_edges(coords, 4, bounds, 9) == -1);
 
+  // torus wraparound: a full column of a 4x4 torus closes into a ring
+  const int32_t sq_bounds[] = {4, 4};
+  const int32_t wrap_yes[] = {1, 1};
+  const int32_t col[] = {0, 0, 1, 0, 2, 0, 3, 0};
+  CHECK(tpuenum_internal_edges_wrap(col, 4, sq_bounds, nullptr, 2) == 3);
+  CHECK(tpuenum_internal_edges_wrap(col, 4, sq_bounds, wrap_yes, 2) == 4);
+  // boundary pair joined only by the wrap link
+  const int32_t ends[] = {0, 0, 3, 0};
+  CHECK(tpuenum_internal_edges_wrap(ends, 2, sq_bounds, wrap_yes, 2) == 1);
+  CHECK(tpuenum_internal_edges_wrap(ends, 2, sq_bounds, nullptr, 2) == 0);
+  // extent-2 axis never gains a wrap edge (same physical link)
+  const int32_t pair[] = {0, 0, 1, 0};
+  const int32_t small_bounds[] = {2, 4};
+  CHECK(tpuenum_internal_edges_wrap(pair, 2, small_bounds, wrap_yes, 2) == 1);
+
   if (failures == 0) {
     printf("tpuenum_test: all checks passed\n");
     return 0;

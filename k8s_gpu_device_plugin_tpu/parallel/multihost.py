@@ -94,12 +94,15 @@ def initialize(
     num_processes: int | None = None,
     process_id: int | None = None,
     port: int = DEFAULT_COORDINATOR_PORT,
+    initialization_timeout: int = 300,
 ) -> WorkerEnv | None:
     """``jax.distributed.initialize`` from the plugin's Allocate envs.
 
     Call FIRST in a multi-host pod (before any jax.devices()/jit). On a
     single-process pod (no TPU_WORKER_HOSTNAMES) this is a no-op, so the
-    same entrypoint works at every scale.
+    same entrypoint works at every scale. ``initialization_timeout`` bounds
+    the rendezvous wait — preflight checks want a short fuse so a wrong
+    coordinator/rank/world-size fails in seconds, not minutes.
     """
     env = worker_env()
     if coordinator_address is None and (env is None or env.num_workers <= 1):
@@ -119,6 +122,7 @@ def initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            initialization_timeout=initialization_timeout,
         )
     except RuntimeError as e:
         # idempotent re-entry: a second call in the same process is fine

@@ -1,0 +1,123 @@
+"""Multi-host contract preflight: rendezvous + one cross-process psum.
+
+Run as a pod entrypoint on EVERY worker of a slice (or every slice of a
+multislice job). It initializes ``jax.distributed`` from the plugin's
+Allocate env contract (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / MEGASCALE_*,
+plugin/plugin.py:_container_allocate), runs one psum over all processes'
+devices, and prints ONE JSON line ``{rank, nprocs, ndev, psum, ok}``.
+Exit 0 iff the collective produced the expected value on this process.
+
+This is the TPU analogue of running nccl-tests before a job: a cheap,
+CI-able proof that every worker agrees on coordinator, rank, and world size
+before real training starts. The reference has no equivalent — its only
+cross-process channel was kubelet gRPC (SURVEY §2 "distributed
+communication backend: absent"); here the contract is first-class and this
+tool makes a wrong coordinator/rank/world-size fail loudly at t=0 instead
+of stranding a slice at first collective.
+
+Usage: ``python -m k8s_gpu_device_plugin_tpu.parallel.rendezvous_check
+[--port N]`` — the coordinator HOST and this process's rank come from the
+injected envs; only the jax coordination port is a flag (it is a jobset
+choice, not part of the allocation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def run_check(port: int | None = None, init_timeout: int = 60) -> dict:
+    """Initialize from envs, psum across every process, return the report.
+
+    Raises on a broken contract (failed rendezvous, rank mismatch, wrong
+    collective result) — callers wanting a process exit code use main().
+    """
+    import jax
+
+    # A sitecustomize may have pinned another platform at interpreter start;
+    # re-assert what this process was handed (same recipe as the allocated
+    # bench child) so CPU-mesh callers are not routed to a TPU tunnel.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    # Cross-process collectives on the CPU backend need an implementation
+    # picked explicitly; gloo is the in-tree one. No effect on TPU.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from k8s_gpu_device_plugin_tpu.parallel import multihost
+
+    env = multihost.initialize(
+        port=port or multihost.DEFAULT_COORDINATOR_PORT,
+        initialization_timeout=init_timeout,
+    )
+    if env is None or env.num_workers <= 1:
+        return {"rank": 0, "nprocs": 1, "distributed": False, "ok": True}
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if jax.process_count() != env.num_workers:
+        raise RuntimeError(
+            f"world size mismatch: envs promise {env.num_workers} processes, "
+            f"jax.distributed sees {jax.process_count()}"
+        )
+    if jax.process_index() != env.process_id:
+        raise RuntimeError(
+            f"rank mismatch: envs assign process_id {env.process_id}, "
+            f"jax.distributed assigned {jax.process_index()}"
+        )
+
+    devices = jax.devices()  # global device list, spans processes
+    mesh = Mesh(np.array(devices), ("x",))
+    x = jax.jit(
+        lambda: jnp.arange(len(devices), dtype=jnp.float32),
+        out_shardings=NamedSharding(mesh, P("x")),
+    )()
+    psum = jax.jit(
+        jax.shard_map(
+            lambda v: jax.lax.psum(v, "x"), mesh=mesh,
+            in_specs=P("x"), out_specs=P("x"),
+        )
+    )(x)
+    # every shard must hold sum(0..ndev-1); check the locally-addressable ones
+    expected = float(len(devices) * (len(devices) - 1) // 2)
+    local = [float(np.asarray(s.data)[0]) for s in psum.addressable_shards]
+    if any(v != expected for v in local):
+        raise RuntimeError(f"psum produced {local}, expected {expected}")
+    return {
+        "rank": jax.process_index(),
+        "nprocs": jax.process_count(),
+        "ndev": len(devices),
+        "psum": expected,
+        "distributed": True,
+        "ok": True,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--port", type=int, default=None,
+        help="jax.distributed coordination port (host + rank come from envs)",
+    )
+    parser.add_argument(
+        "--init-timeout", type=int, default=60,
+        help="seconds to wait for the rendezvous before failing (short fuse: "
+        "a preflight should fail in seconds, not jax's 300s default)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        report = run_check(port=args.port, init_timeout=args.init_timeout)
+    except Exception as e:  # noqa: BLE001 - the contract is one JSON line
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -62,14 +62,14 @@ def preferred_allocation(
 
 def _edges_within(coords: set[tuple[int, ...]], topo: HostTopology) -> int:
     # Hot scoring kernel: delegate to the C++ core when available (the
-    # go-gpuallocator analogue); it does not model torus wraparound, so only
-    # non-torus hosts take the native path.
-    if not any(topo.wraparound):
-        from k8s_gpu_device_plugin_tpu.device.native import native_internal_edges
+    # go-gpuallocator analogue); torus wraparound rides along as per-axis
+    # flags so boundary placements on v5e 4x4+ / v4/v5p tori score their
+    # ring-closing links.
+    from k8s_gpu_device_plugin_tpu.device.native import native_internal_edges
 
-        native = native_internal_edges(sorted(coords), topo.bounds)
-        if native is not None:
-            return native
+    native = native_internal_edges(sorted(coords), topo.bounds, topo.wraparound)
+    if native is not None:
+        return native
     count = 0
     for c in coords:
         for n in topo.neighbors(c):
@@ -127,15 +127,23 @@ def aligned_alloc(
     best: list[str] | None = None
     best_score: tuple | None = None
 
-    # Phase 1: exact axis-aligned sub-mesh placements made of available chips.
+    # Phase 1: exact axis-aligned sub-mesh placements made of available
+    # chips. On torus axes (wraparound) a placement may cross the boundary —
+    # anchors run over the full ring and cells wrap modulo the bound, so a
+    # 2x2 spanning x=3..0 of a v5e 4x4 is as eligible as an interior one.
+    wrap = topo.wraparound or tuple(False for _ in topo.bounds)
     for shape in _submesh_shapes(size, topo.bounds):
-        for anchor in itertools.product(
-            *(range(b - s + 1) for b, s in zip(topo.bounds, shape))
-        ):
-            cells = {
-                tuple(a + d for a, d in zip(anchor, delta))
+        # Wrapped anchors only widen the range while s < b, so every
+        # (shape, anchor) pair yields a distinct cell set — no dedup needed.
+        anchor_ranges = [
+            range(b) if (w and b > 2 and s < b) else range(b - s + 1)
+            for b, s, w in zip(topo.bounds, shape, wrap)
+        ]
+        for anchor in itertools.product(*anchor_ranges):
+            cells = frozenset(
+                tuple((a + d) % b for a, d, b in zip(anchor, delta, topo.bounds))
                 for delta in itertools.product(*(range(s) for s in shape))
-            }
+            )
             if not cells <= by_coord.keys():
                 continue
             if not must_coords <= cells:

@@ -5,6 +5,7 @@ import pytest
 from k8s_gpu_device_plugin_tpu.device.topology import (
     GENERATIONS,
     HostTopology,
+    as_slice_member,
     parse_topology,
 )
 
@@ -49,9 +50,24 @@ def test_coords_and_index_roundtrip():
 
 
 def test_neighbors_mesh_interior_and_edge():
-    topo = parse_topology("v5e-16")  # 4x4
-    assert len(topo.neighbors((1, 1))) == 4
+    topo = parse_topology("v5e-8")  # 2x4, no torus closure (< 4x4)
+    assert topo.wraparound == (False, False)
+    assert len(topo.neighbors((1, 1))) == 3
     assert len(topo.neighbors((0, 0))) == 2
+
+
+def test_parse_topology_sets_generation_wraparound():
+    # v5e/v6e: 4x4-and-larger slices are wired as tori
+    assert parse_topology("v5e-16").wraparound == (True, True)   # 4x4
+    assert parse_topology("v5e-4x8").wraparound == (True, True)
+    assert parse_topology("v5e-4").wraparound == (False, False)  # 2x2
+    assert parse_topology("v5e-8").wraparound == (False, False)  # 2x4
+    # v4/v5p: OCS closes cube-multiple axes; 2-extent axes stay meshes
+    assert parse_topology("v5p-32").wraparound == (True, True, False)  # 4x4x2
+    assert parse_topology("v5p-64").wraparound == (True, True, True)   # 4x4x4
+    assert parse_topology("v5p-8").wraparound == (False, False, False)
+    # a boundary chip on the closed 4x4 torus has a full set of 4 links
+    assert len(parse_topology("v5e-16").neighbors((0, 0))) == 4
 
 
 def test_neighbors_torus_wrap():
@@ -67,3 +83,19 @@ def test_generation_table_sane():
         assert gen.peak_bf16_tflops > 0
         assert gen.ici_dims in (2, 3)
         assert len(gen.default_host_shape) == gen.ici_dims
+
+
+def test_as_slice_member_host_local_wraparound():
+    """A host tile inherits the slice's torus closure only on axes it spans
+    entirely (host_grid == 1 there); split axes wrap between hosts, which
+    host-local allocation must not count."""
+    host = parse_topology("v5e-2x4")  # (2, 4) host tile
+    placed = as_slice_member(host, "v5e-4x4", worker_id=0)
+    # slice (4,4) wraps both axes; host spans axis1 fully (grid (2,1))
+    assert placed.host_grid == (2, 1)
+    assert placed.wraparound == (False, True)
+    # boundary chip gains its ring link on the spanned axis only
+    assert (0, 0) in placed.neighbors((0, 3))
+
+    small = as_slice_member(parse_topology("v5e-4"), "v5e-8", worker_id=0)
+    assert small.wraparound == (False, False)  # 2x4 slice: no torus at all
