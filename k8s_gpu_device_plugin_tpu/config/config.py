@@ -31,10 +31,12 @@ _VALID_STRATEGIES = (SLICE_STRATEGY_NONE, SLICE_STRATEGY_SINGLE, SLICE_STRATEGY_
 
 @dataclass
 class LogSettings:
-    """Reference config.go:13 ``Log{Level, FileDir}``."""
+    """Reference config.go:13 ``Log{Level, FileDir}`` + dev console mode
+    (≙ zap dev-mode colored console, log.go:173-180)."""
 
     level: str = "debug"
     file_dir: str = "./logs"
+    dev_mode: bool = False
 
 
 @dataclass
@@ -158,6 +160,8 @@ def _apply_mapping(cfg: Config, data: dict[str, Any]) -> None:
                 cfg.log.level = str(value["level"])
             if "fileDir" in value:
                 cfg.log.file_dir = str(value["fileDir"])
+            if "devMode" in value:
+                cfg.log.dev_mode = bool(value["devMode"])
             continue
         attr = _KEY_MAP.get(key)
         if attr is None:
@@ -194,6 +198,7 @@ def load_config(
     parser.add_argument("--runtimeMetricsPorts", default=None)
     parser.add_argument("--logLevel", default=None)
     parser.add_argument("--logFileDir", default=None)
+    parser.add_argument("--logDevMode", default=None, action="store_const", const=True)
     args = parser.parse_args(argv)
 
     cfg = Config()
@@ -234,6 +239,8 @@ def load_config(
         cfg.log.level = args.logLevel
     if args.logFileDir is not None:
         cfg.log.file_dir = args.logFileDir
+    if args.logDevMode is not None:
+        cfg.log.dev_mode = args.logDevMode
 
     cfg.validate()
     return cfg

@@ -78,6 +78,8 @@ def _declare_signatures(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.c_int32,
     ]
+    lib.tpuenum_generation_source.restype = ctypes.c_int32
+    lib.tpuenum_generation_source.argtypes = []
     lib.tpuenum_internal_edges.restype = ctypes.c_int32
     lib.tpuenum_internal_edges.argtypes = [
         ctypes.POINTER(ctypes.c_int32),
@@ -126,6 +128,10 @@ def native_internal_edges(
     return None if result < 0 else int(result)
 
 
+# tpuenum_generation_source() values (native/tpuenum.h TPUENUM_GEN_*)
+GEN_SOURCE_NAMES = {0: "unknown", 1: "pci", 2: "env"}
+
+
 class NativeBackend:
     """Chip backend over the C++ core."""
 
@@ -135,6 +141,11 @@ class NativeBackend:
         self._lib = _load_library()
         self._topology_override = topology_override
         self._topo: HostTopology | None = None
+        #: where the generation name came from: "pci" is measured from the
+        #: device id; "config" is a deliberate operator override; "env"/
+        #: "unknown" are guesses that skew MFU/HBM math if wrong. Populated
+        #: on first host_topology()/enumerate call.
+        self.generation_source: str = "unknown"
 
     def available(self) -> bool:
         return self._lib is not None and self._lib.tpuenum_chip_count() > 0
@@ -149,19 +160,55 @@ class NativeBackend:
         n = self._lib.tpuenum_enumerate(buf, count)
         return list(buf[: max(0, n)])
 
-    def _generation_name(self) -> str:
+    def _generation_name(self, warn: bool = True) -> str:
         if self._lib is None:
+            self.generation_source = "unknown"
             return "v5e"
         out = ctypes.create_string_buffer(16)
         self._lib.tpuenum_generation(out, len(out))
+        self.generation_source = GEN_SOURCE_NAMES.get(
+            int(self._lib.tpuenum_generation_source()), "unknown"
+        )
         name = out.value.decode() or "v5e"
-        return name if name in GENERATIONS else "v5e"
+        if name not in GENERATIONS:
+            self.generation_source = "unknown"
+            name = "v5e"
+        if warn and self.generation_source != "pci":
+            # A guessed generation silently skews every MFU/HBM figure
+            # derived from the GENERATIONS spec table — say so loudly.
+            get_logger().warning(
+                "TPU generation is GUESSED, not measured from PCI ids; "
+                "MFU/HBM figures derived from the generation table may be "
+                "wrong on this host",
+                extra={"fields": {
+                    "generation": name, "source": self.generation_source,
+                }},
+            )
+        return name
 
     def host_topology(self) -> HostTopology:
         if self._topo is not None:
             return self._topo
         if self._topology_override not in ("", "auto"):
-            self._topo = parse_topology(self._topology_override)
+            topo = parse_topology(self._topology_override)
+            # An explicit override is a deliberate operator claim — source
+            # "config" (not a guess), so no GUESSED warning here; only a
+            # PCI-id contradiction deserves one.
+            measured = self._generation_name(warn=False)
+            if self.generation_source == "pci":
+                if measured != topo.generation.name:
+                    get_logger().warning(
+                        "configured topology generation disagrees with "
+                        "PCI-measured generation; honoring the config",
+                        extra={"fields": {
+                            "configured": topo.generation.name,
+                            "measured": measured,
+                        }},
+                    )
+                    self.generation_source = "config"
+            else:
+                self.generation_source = "config"
+            self._topo = topo
             return self._topo
         chips = self._enumerate_raw()
         gen = self._generation_name()

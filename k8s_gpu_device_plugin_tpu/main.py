@@ -29,7 +29,11 @@ SHUTDOWN_TIMEOUT_SECONDS = 10.0  # bounded SIGTERM drain (2x the 5s dial timeout
 async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> None:
     """Run manager + HTTP server until the stop event fires."""
     logger = init_logger(
-        LogConfig(level=cfg.log.level, file_dir=cfg.log.file_dir or None)
+        LogConfig(
+            level=cfg.log.level,
+            file_dir=cfg.log.file_dir or None,
+            dev_mode=cfg.log.dev_mode,
+        )
     )
     stop = stop_event or asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -81,6 +81,22 @@ class DeviceMetrics:
             "chip_tensorcore_utilization", "Tensorcore (MXU) utilization percent",
             labelnames=("chip",), namespace=ns, registry=registry,
         )
+        # 1 when the TPU generation was inferred (env claim / default), 0
+        # when measured from PCI ids or served by the fake backend. A guessed
+        # generation skews every figure derived from the spec table, so
+        # operators get a scrapeable signal, not just a log line.
+        self.generation_guessed = Gauge(
+            "generation_guessed",
+            "1 if the TPU generation is a guess (not measured from PCI ids)",
+            labelnames=("generation", "source"), namespace=ns, registry=registry,
+        )
+
+    def set_generation_source(self, generation: str, source: str) -> None:
+        # "pci" is measured, "config" is a deliberate operator override,
+        # "fake" is the test backend — none of those are guesses.
+        self.generation_guessed.labels(
+            generation=generation, source=source
+        ).set(0 if source in ("pci", "config", "fake") else 1)
 
     def update_inventory(self, chip_map: ChipMap) -> None:
         seen_chips: dict[int, tuple[str, int]] = {}

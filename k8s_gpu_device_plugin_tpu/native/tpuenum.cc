@@ -104,7 +104,10 @@ std::vector<RawChip> ScanChips() {
 
   // 2) VFIO nodes (numeric entries under /dev/vfio, excluding the control
   //    node "vfio"). Only used when no accel nodes exist — a host exposes
-  //    chips through one driver.
+  //    chips through one driver. Metadata (NUMA node, PCI device id) is
+  //    recovered through the IOMMU group's member device in sysfs:
+  //    /sys/kernel/iommu_groups/<N>/devices/<pci-addr> is a (symlinked)
+  //    PCI device dir carrying numa_node + device like the accel path.
   if (chips.empty()) {
     names.clear();
     if (DirEntries(root + "/dev/vfio", &names)) {
@@ -119,6 +122,13 @@ std::vector<RawChip> ScanChips() {
         RawChip chip;
         chip.index = logical++;
         chip.path = "/dev/vfio/" + std::to_string(group);
+        const std::string group_dir =
+            root + "/sys/kernel/iommu_groups/" + std::to_string(group) + "/devices";
+        std::vector<std::string> members;
+        if (DirEntries(group_dir, &members) && !members.empty()) {
+          std::sort(members.begin(), members.end());
+          chip.sysfs_base = group_dir + "/" + members[0];
+        }
         chips.push_back(chip);
       }
     }
@@ -129,24 +139,50 @@ std::vector<RawChip> ScanChips() {
   return chips;
 }
 
-std::string DetectGeneration(const std::vector<RawChip>& chips) {
+std::string DetectGeneration(const std::vector<RawChip>& chips,
+                             int32_t* source /* may be null */) {
+  if (source != nullptr) *source = TPUENUM_GEN_UNKNOWN;
   for (const auto& chip : chips) {
     if (chip.sysfs_base.empty()) continue;
     const std::string id_s = ReadTrimmed(chip.sysfs_base + "/device");
     if (id_s.empty()) continue;
     const uint32_t id = strtoul(id_s.c_str(), nullptr, 16);
     for (const auto& gen : kGenerations) {
-      if (gen.device_id == id) return gen.name;
+      if (gen.device_id == id) {
+        if (source != nullptr) *source = TPUENUM_GEN_PCI;
+        return gen.name;
+      }
     }
   }
-  // Fallback: the TPU VM environment often states the type directly.
+  // Fallback: the TPU VM environment often states the type directly. An
+  // env-derived generation is a CLAIM, not a measurement — callers should
+  // surface it loudly (a wrong value skews every MFU/HBM figure derived
+  // from the generation table).
   const char* accel_type = getenv("TPU_ACCELERATOR_TYPE");
   if (accel_type != nullptr) {
     const std::string s(accel_type);
     const size_t dash = s.find('-');
+    if (source != nullptr) *source = TPUENUM_GEN_ENV;
     return dash == std::string::npos ? s : s.substr(0, dash);
   }
   return "";
+}
+
+// sysfs attribute names probed for per-chip memory size, in preference
+// order. Best-effort forward-compat: current accel/gasket drivers expose
+// none of these (callers then fill from the generation table); a driver
+// that does expose capacity gets the measured value.
+const char* kHbmAttrs[] = {"hbm_bytes", "memory_size", "mem_size"};
+
+int64_t ReadHbmBytes(const std::string& sysfs_base) {
+  if (sysfs_base.empty()) return 0;
+  for (const char* attr : kHbmAttrs) {
+    const std::string s = ReadTrimmed(sysfs_base + "/" + attr);
+    if (s.empty()) continue;
+    const long long v = strtoll(s.c_str(), nullptr, 10);
+    if (v > 0) return static_cast<int64_t>(v);
+  }
+  return 0;
 }
 
 // FNV-1a 64-bit over machine-id + index for stable, distinct UUIDs.
@@ -181,7 +217,7 @@ int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max) {
   if (out == nullptr || max < 0) return -1;
   const std::string root = Root();
   const std::vector<RawChip> chips = ScanChips();
-  const std::string gen = DetectGeneration(chips);
+  const std::string gen = DetectGeneration(chips, nullptr);
   std::string machine_id = ReadTrimmed(root + "/etc/machine-id");
   if (machine_id.empty()) machine_id = "tpuhost";
 
@@ -192,7 +228,7 @@ int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max) {
     memset(info, 0, sizeof(*info));
     info->index = chip.index;
     info->numa_node = -1;
-    info->hbm_bytes = 0;
+    info->hbm_bytes = ReadHbmBytes(chip.sysfs_base);
     if (!chip.sysfs_base.empty()) {
       const std::string numa = ReadTrimmed(chip.sysfs_base + "/numa_node");
       if (!numa.empty()) info->numa_node = atoi(numa.c_str());
@@ -206,9 +242,15 @@ int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max) {
 
 int32_t tpuenum_generation(char* out, int32_t max) {
   if (out == nullptr || max <= 0) return 0;
-  const std::string gen = DetectGeneration(ScanChips());
+  const std::string gen = DetectGeneration(ScanChips(), nullptr);
   snprintf(out, static_cast<size_t>(max), "%s", gen.c_str());
   return static_cast<int32_t>(strlen(out));
+}
+
+int32_t tpuenum_generation_source(void) {
+  int32_t source = TPUENUM_GEN_UNKNOWN;
+  DetectGeneration(ScanChips(), &source);
+  return source;
 }
 
 int32_t tpuenum_internal_edges(const int32_t* coords, int32_t n,

@@ -41,6 +41,15 @@ int32_t tpuenum_enumerate(TpuChipInfo* out, int32_t max);
 // Returns length written, 0 if unknown.
 int32_t tpuenum_generation(char* out, int32_t max);
 
+// Where the generation name came from. PCI-id detection is a measurement;
+// the TPU_ACCELERATOR_TYPE env fallback is an unverified claim, and callers
+// should surface non-PCI sources loudly (a wrong generation skews every
+// MFU/HBM figure derived from the per-generation spec table).
+#define TPUENUM_GEN_UNKNOWN 0
+#define TPUENUM_GEN_PCI 1
+#define TPUENUM_GEN_ENV 2
+int32_t tpuenum_generation_source(void);
+
 // ICI edges internal to the chip set `coords` (len = n*dims, row-major)
 // within a mesh of shape `bounds` (len = dims). Neighbors differ by 1 on one
 // axis (no wraparound). Returns edge count, or -1 on bad arguments.

@@ -60,6 +60,11 @@ def run_check(port: int | None = None, init_timeout: int = 60) -> dict:
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
     if jax.process_count() != env.num_workers:
         raise RuntimeError(
             f"world size mismatch: envs promise {env.num_workers} processes, "
@@ -78,7 +83,7 @@ def run_check(port: int | None = None, init_timeout: int = 60) -> dict:
         out_shardings=NamedSharding(mesh, P("x")),
     )()
     psum = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda v: jax.lax.psum(v, "x"), mesh=mesh,
             in_specs=P("x"), out_specs=P("x"),
         )

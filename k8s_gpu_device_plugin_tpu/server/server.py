@@ -92,6 +92,11 @@ class Server:
     async def _metrics(self, request: web.Request) -> web.Response:
         # refresh device gauges from the live (health-applied) device sets
         self.device_metrics.update_inventory(self.manager.live_chip_map())
+        backend = self.manager.backend
+        self.device_metrics.set_generation_source(
+            backend.host_topology().generation.name,
+            getattr(backend, "generation_source", backend.name),
+        )
         # usage scrape does blocking gRPC calls (up to 1s/port on a hung
         # workload endpoint) -> keep the event loop (health probes, kubelet
         # RPCs) responsive by scraping in a worker thread

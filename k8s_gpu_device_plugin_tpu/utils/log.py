@@ -59,6 +59,49 @@ class JsonFormatter(logging.Formatter):
         return json.dumps(entry, default=str)
 
 
+class ConsoleFormatter(logging.Formatter):
+    """Dev-mode human console line (≙ zap's colored console, log.go:173-180):
+
+    ``HH:MM:SS.mmm LEVEL caller  msg  k=v k=v``, level colorized when the
+    stream is a terminal (or ``color`` is forced). Files always stay JSON —
+    this formatter is console-only sugar.
+    """
+
+    _COLORS = {
+        logging.DEBUG: "\x1b[35m",     # magenta
+        logging.INFO: "\x1b[34m",      # blue
+        logging.WARNING: "\x1b[33m",   # yellow
+        logging.ERROR: "\x1b[31m",     # red
+        logging.CRITICAL: "\x1b[31m",
+    }
+    _RESET = "\x1b[0m"
+
+    def __init__(self, color: bool | None = None) -> None:
+        super().__init__()
+        self._color = color
+
+    def format(self, record: logging.LogRecord) -> str:
+        level = record.levelname
+        color = self._color
+        if color is None:
+            color = getattr(sys.stderr, "isatty", lambda: False)()
+        if color:
+            code = self._COLORS.get(record.levelno, "")
+            level = f"{code}{level}{self._RESET}"
+        ts = self.formatTime(record, "%H:%M:%S") + f".{int(record.msecs):03d}"
+        line = (
+            f"{ts} {level:<7} {record.filename}:{record.lineno}  "
+            f"{record.getMessage()}"
+        )
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict) and extra:
+            kv = " ".join(f"{k}={v}" for k, v in extra.items())
+            line = f"{line}  {kv}"
+        if record.exc_info and record.exc_info[0] is not None:
+            line = f"{line}\n{self.formatException(record.exc_info)}"
+        return line
+
+
 class _ExactLevelFilter(logging.Filter):
     """Admit records of exactly one level (the per-level tee, log.go:148-170)."""
 
@@ -96,6 +139,7 @@ class LogConfig:
     level: str = "debug"
     file_dir: str | None = None  # None => console only
     console: bool = True
+    dev_mode: bool = False       # human console lines instead of JSON
     name: str = "tpu-device-plugin"
     max_bytes: int = MAX_BYTES
     backup_count: int = BACKUP_COUNT
@@ -142,7 +186,7 @@ def init_logger(cfg: LogConfig | None = None) -> logging.Logger:
 
     if cfg.console or not cfg.file_dir:
         console = logging.StreamHandler(sys.stderr)
-        console.setFormatter(formatter)
+        console.setFormatter(ConsoleFormatter() if cfg.dev_mode else formatter)
         logger.addHandler(console)
 
     _logger = logger

@@ -62,3 +62,18 @@ def test_listen_addr_forms():
     assert cfg.listen_addr == ("0.0.0.0", 9002)
     cfg.web_listen_address = "127.0.0.1:8080"
     assert cfg.listen_addr == ("127.0.0.1", 8080)
+
+
+def test_log_dev_mode_plumbing(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "config.yml").write_text(
+        "log:\n  level: info\n  devMode: true\n"
+    )
+    cfg = load_config([])
+    assert cfg.log.dev_mode is True
+    # flag overrides the file default (three-tier contract)
+    (tmp_path / "config.yml").write_text("log:\n  level: info\n")
+    cfg = load_config([])
+    assert cfg.log.dev_mode is False
+    cfg = load_config(["--logDevMode"])
+    assert cfg.log.dev_mode is True

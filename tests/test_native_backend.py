@@ -121,3 +121,136 @@ def test_internal_edges_matches_python(fake_host):
     coords = (ctypes.c_int32 * 8)(0, 0, 0, 1, 1, 0, 1, 1)
     bounds = (ctypes.c_int32 * 2)(2, 4)
     assert lib.tpuenum_internal_edges(coords, 4, bounds, 2) == 4
+
+
+# --- metadata hardening (r2 verdict weak #6) ---
+
+
+@pytest.fixture
+def vfio_host(tmp_path, monkeypatch):
+    """Synthetic VFIO host: chips behind /dev/vfio with sysfs metadata
+    reachable through the IOMMU group's member PCI device."""
+    ensure_lib()
+    (tmp_path / "dev" / "vfio").mkdir(parents=True)
+    (tmp_path / "etc").mkdir()
+    (tmp_path / "etc" / "machine-id").write_text("fedcba9876543210\n")
+    for group, (numa, pci_id) in enumerate([("0", "0x0063"), ("1", "0x0063")]):
+        (tmp_path / "dev" / "vfio" / str(group)).write_text("")
+        member = (
+            tmp_path / "sys" / "kernel" / "iommu_groups" / str(group)
+            / "devices" / f"0000:00:0{group + 4}.0"
+        )
+        member.mkdir(parents=True)
+        (member / "numa_node").write_text(numa + "\n")
+        (member / "device").write_text(pci_id + "\n")
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    return tmp_path
+
+
+def test_vfio_enumeration_recovers_sysfs_metadata(vfio_host):
+    backend = NativeBackend()
+    assert backend.available()
+    topo = backend.host_topology()
+    assert topo.generation.name == "v5e"      # measured via IOMMU-group PCI id
+    assert backend.generation_source == "pci"
+    chips = backend.enumerate_chips()
+    assert [c.numa_node for c in chips] == [0, 1]
+    assert all(c.paths[0].startswith("/dev/vfio/") for c in chips)
+
+
+def test_generation_env_fallback_is_flagged_as_guess(
+    tmp_path, monkeypatch, captured_log_records
+):
+    """No PCI ids anywhere: TPU_ACCELERATOR_TYPE is trusted but flagged."""
+    ensure_lib()
+    (tmp_path / "dev").mkdir()
+    for i in range(4):
+        (tmp_path / "dev" / f"accel{i}").write_text("")
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+    backend = NativeBackend()
+    topo = backend.host_topology()
+    assert topo.generation.name == "v5p"
+    assert backend.generation_source == "env"
+    warnings = [
+        r for r in captured_log_records
+        if "GUESSED" in r.getMessage() and r.fields["source"] == "env"
+    ]
+    assert warnings
+
+
+def test_generation_unknown_defaults_loudly(
+    tmp_path, monkeypatch, captured_log_records
+):
+    ensure_lib()
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    backend = NativeBackend()
+    assert backend.host_topology().generation.name == "v5e"  # default guess
+    assert backend.generation_source == "unknown"
+    assert any("GUESSED" in r.getMessage() for r in captured_log_records)
+
+
+def test_sysfs_hbm_size_overrides_generation_table(fake_host):
+    """A driver exposing per-chip memory beats the spec-table fallback."""
+    attr = (
+        fake_host / "sys" / "class" / "accel" / "accel0" / "device" / "hbm_bytes"
+    )
+    attr.write_text(str(32 * 1024**3) + "\n")
+    backend = NativeBackend(topology_override="v5e-4")
+    chips = backend.enumerate_chips()
+    assert chips[0].hbm_bytes == 32 * 1024**3
+    assert chips[1].hbm_bytes == 16 * 1024**3  # others still from the table
+
+
+def test_generation_guessed_metric():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.device_metrics import DeviceMetrics
+
+    reg = CollectorRegistry()
+    m = DeviceMetrics(registry=reg)
+    m.set_generation_source("v5e", "env")
+    assert reg.get_sample_value(
+        "tpu_plugin_generation_guessed", {"generation": "v5e", "source": "env"}
+    ) == 1
+    m.set_generation_source("v5e", "pci")
+    assert reg.get_sample_value(
+        "tpu_plugin_generation_guessed", {"generation": "v5e", "source": "pci"}
+    ) == 0
+    m.set_generation_source("v5e", "fake")
+    assert reg.get_sample_value(
+        "tpu_plugin_generation_guessed", {"generation": "v5e", "source": "fake"}
+    ) == 0
+
+
+def test_topology_override_sets_config_source(
+    tmp_path, monkeypatch, captured_log_records
+):
+    """An explicit topology override is a deliberate claim: source 'config'
+    (not a guess, no GUESSED warning) when PCI ids cannot confirm; a PCI
+    contradiction is honored but warned about."""
+    ensure_lib()
+    (tmp_path / "dev").mkdir()
+    for i in range(4):
+        (tmp_path / "dev" / f"accel{i}").write_text("")
+    monkeypatch.setenv("TPUENUM_ROOT", str(tmp_path))
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    backend = NativeBackend(topology_override="v5e-4")
+    assert backend.host_topology().generation.name == "v5e"
+    assert backend.generation_source == "config"
+    assert not any("GUESSED" in r.getMessage() for r in captured_log_records)
+
+    # PCI says v5e but config pins v5p: config wins, loudly
+    accel_root = tmp_path / "sys" / "class" / "accel"
+    for i in range(4):
+        dev_dir = accel_root / f"accel{i}" / "device"
+        dev_dir.mkdir(parents=True)
+        (dev_dir / "device").write_text("0x0063\n")  # v5e
+    backend2 = NativeBackend(topology_override="v5p-4")
+    assert backend2.host_topology().generation.name == "v5p"
+    assert backend2.generation_source == "config"
+    assert any("disagrees" in r.getMessage() for r in captured_log_records)

@@ -82,3 +82,42 @@ def test_file_watcher_sees_create_and_delete(tmp_path):
             if any(e.name == "kubelet.sock" and not e.is_create for e in events):
                 break
         assert any(e.name == "kubelet.sock" and not e.is_create for e in events)
+
+
+def test_console_formatter_dev_mode():
+    """Dev mode (≙ zap colored console, log.go:173-180): human line with
+    colored level, structured fields as k=v; JSON files are unaffected."""
+    import logging
+
+    from k8s_gpu_device_plugin_tpu.utils.log import ConsoleFormatter
+
+    record = logging.LogRecord(
+        "t", logging.WARNING, "plugin.py", 42, "chip health changed",
+        None, None,
+    )
+    record.fields = {"unhealthy": [3]}
+    plain = ConsoleFormatter(color=False).format(record)
+    assert "WARNING" in plain and "plugin.py:42" in plain
+    assert "chip health changed" in plain and "unhealthy=[3]" in plain
+    assert "\x1b[" not in plain
+    colored = ConsoleFormatter(color=True).format(record)
+    assert "\x1b[33m" in colored and "\x1b[0m" in colored  # yellow WARNING
+
+
+def test_init_logger_dev_mode_console(tmp_path, capsys):
+    import json as _json
+
+    from k8s_gpu_device_plugin_tpu.utils.log import LogConfig, init_logger
+
+    logger = init_logger(
+        LogConfig(
+            level="info", file_dir=str(tmp_path), dev_mode=True,
+            name="test-dev-console",
+        )
+    )
+    logger.info("hello", extra={"fields": {"k": "v"}})
+    err = capsys.readouterr().err
+    assert "hello" in err and "k=v" in err
+    with open(tmp_path / "app-info.log") as f:   # files stay JSON
+        entry = _json.loads(f.readline())
+    assert entry["msg"] == "hello" and entry["k"] == "v"
