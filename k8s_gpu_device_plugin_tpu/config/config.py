@@ -198,7 +198,10 @@ def load_config(
     parser.add_argument("--runtimeMetricsPorts", default=None)
     parser.add_argument("--logLevel", default=None)
     parser.add_argument("--logFileDir", default=None)
-    parser.add_argument("--logDevMode", default=None, action="store_const", const=True)
+    # value-taking so the CLI can override a YAML devMode:true back to false
+    # (three-tier contract); bare --logDevMode means true.
+    parser.add_argument("--logDevMode", default=None, nargs="?", const="true",
+                        choices=["true", "false"])
     args = parser.parse_args(argv)
 
     cfg = Config()
@@ -240,7 +243,7 @@ def load_config(
     if args.logFileDir is not None:
         cfg.log.file_dir = args.logFileDir
     if args.logDevMode is not None:
-        cfg.log.dev_mode = args.logDevMode
+        cfg.log.dev_mode = args.logDevMode == "true"
 
     cfg.validate()
     return cfg

@@ -29,6 +29,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_device_plugin_tpu.parallel.mesh import (
@@ -59,6 +60,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attn_impl: str = "auto"  # auto | full | ring | ulysses
+    # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
+    # int8 path (ops/quant.py: quantized fwd, bf16 bwd); "none" = pure bf16.
+    quant: str = "none"
     # MoE (0 experts = dense MLP); Mixtral-style top-k routing, GShard dispatch
     n_experts: int = 0
     n_experts_per_token: int = 2
@@ -222,6 +226,35 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
 # --- model pieces ---------------------------------------------------------
 
 
+@jax.custom_vjp
+def _lm_head_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """bf16-operand head projection with f32 accumulation (MXU rate).
+
+    Upcasting both operands to f32 (the obvious ``x.astype(f32) @ w``) runs
+    the single largest matmul in the model off the MXU's native bf16 path —
+    measured on v5e it costs ~25 points of train MFU for no usable precision:
+    what the loss needs is f32 *accumulation* and f32 logits, which
+    ``preferred_element_type`` provides. The custom vjp keeps the backward
+    dots on the bf16 path too by casting the (f32) logits cotangent to bf16
+    — numerically the same information the bf16 parameter grads can hold.
+    """
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+def _lm_head_fwd(x, w):
+    return _lm_head_matmul(x, w), (x, w)
+
+
+def _lm_head_bwd(res, g):
+    from k8s_gpu_device_plugin_tpu.ops.quant import bf16_ste_bwd
+
+    x, w = res
+    return bf16_ste_bwd(x, w, g)
+
+
+_lm_head_matmul.defvjp(_lm_head_fwd, _lm_head_bwd)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -260,18 +293,33 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
     b, s, d = x.shape
     hd = cfg.head_dim
 
+    if cfg.quant == "int8":
+        from k8s_gpu_device_plugin_tpu.ops.quant import int8_matmul
+
+        # custom_vjp calls are opaque to dot-matching remat policies, so tag
+        # outputs by name — forward_with_aux's policy saves "quant_dot"
+        # alongside plain dots (else the backward re-runs every quantized
+        # matmul, erasing the int8 win).
+        def mm(a, b):
+            return checkpoint_name(int8_matmul(a, b), "quant_dot")
+    else:
+        mm = jnp.matmul
+
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = mm(h, layer["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = mm(h, layer["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = mm(h, layer["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     qkv_spec = P(BATCH, AXIS_SP, AXIS_TP, None)
     q, k, v = (constrain(t, qkv_spec) for t in (q, k, v))
 
     attn = _attention(q, k, v, cfg, mesh)
+    # Named so the remat policy can SAVE it: recomputing flash attention in
+    # the backward is the one recompute that costs real MXU time.
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, cfg.n_heads * hd)
-    x = x + constrain(attn @ layer["wo"], P(BATCH, AXIS_SP, None))
+    x = x + constrain(mm(attn, layer["wo"]), P(BATCH, AXIS_SP, None))
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.is_moe:
@@ -279,10 +327,10 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
 
         ff_out, aux = moe_mlp(h, layer, cfg)
     else:
-        gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-        up = h @ layer["w3"]
+        gate = jax.nn.silu(mm(h, layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+        up = mm(h, layer["w3"])
         ff = constrain(gate * up, P(BATCH, AXIS_SP, AXIS_TP))
-        ff_out = constrain(ff @ layer["w2"], P(BATCH, AXIS_SP, None))
+        ff_out = constrain(mm(ff, layer["w2"]), P(BATCH, AXIS_SP, None))
         aux = {}
     x = x + ff_out
     return x, aux
@@ -303,9 +351,17 @@ def forward_with_aux(
 
     block = partial(_block, cfg=cfg, positions=positions, mesh=mesh)
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        # Projection/MLP dot outputs are saveable (no batch dims), plus the
+        # named attention output — everything recomputed in the backward is
+        # then cheap VPU elementwise (norms, rope, silu), never the flash
+        # kernel or an MXU matmul.
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "quant_dot"
+            ),
         )
+        block = jax.checkpoint(block, policy=policy)
 
     pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1
     if pp > 1:
@@ -339,7 +395,7 @@ def forward_with_aux(
         x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
         aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    logits = _lm_head_matmul(x, params["lm_head"].astype(cfg.dtype))
     return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP)), aux
 
 

@@ -204,10 +204,17 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpointDir", default="")
     parser.add_argument("--checkpointInterval", type=int, default=1000)
     parser.add_argument("--traceDir", default="")
+    parser.add_argument("--quant", default="none", choices=["none", "int8"],
+                        help="int8 runs block matmuls on the MXU double-rate "
+                        "path (quantized fwd, bf16 bwd)")
     args = parser.parse_args(argv)
 
     initialize()  # multi-host rendezvous BEFORE jax.devices()
     model = getattr(LlamaConfig, args.preset)()
+    if args.quant != "none":
+        from dataclasses import replace as _replace
+
+        model = _replace(model, quant=args.quant)
     spec = MeshSpec.for_devices(
         len(jax.devices()), tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         fsdp=args.fsdp,

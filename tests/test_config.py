@@ -71,7 +71,9 @@ def test_log_dev_mode_plumbing(tmp_path, monkeypatch):
     )
     cfg = load_config([])
     assert cfg.log.dev_mode is True
-    # flag overrides the file default (three-tier contract)
+    # flag overrides the file default (three-tier contract), BOTH directions
+    cfg = load_config(["--logDevMode", "false"])
+    assert cfg.log.dev_mode is False
     (tmp_path / "config.yml").write_text("log:\n  level: info\n")
     cfg = load_config([])
     assert cfg.log.dev_mode is False

@@ -192,3 +192,35 @@ def test_graft_entry_forces_cpu_before_backend_init():
     )
     assert proc.returncode == 0, proc.stderr
     assert "fallback-ok" in proc.stdout
+
+
+def test_lm_head_matmul_numerics_and_grads():
+    """bf16-operand head projection: f32 accumulation keeps logits close to
+    the full-f32 product, and the custom vjp produces grads matching
+    autodiff of the plain dot to bf16 precision."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_device_plugin_tpu.models.llama import _lm_head_matmul
+
+    key = jax.random.key(7)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (4, 32, 64), jnp.bfloat16)
+    w = jax.random.normal(kw, (64, 128), jnp.bfloat16)
+
+    out = _lm_head_matmul(x, w)
+    assert out.dtype == jnp.float32
+    ref = jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+    assert jnp.allclose(out, ref, atol=2e-1, rtol=2e-2)
+
+    def loss_new(x, w):
+        return jnp.sum(jnp.sin(_lm_head_matmul(x, w)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.sin(jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))))
+
+    gx, gw = jax.grad(loss_new, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    assert gx.dtype == x.dtype and gw.dtype == w.dtype
+    assert jnp.allclose(gx.astype(jnp.float32), rx.astype(jnp.float32), atol=0.5, rtol=0.1)
+    assert jnp.allclose(gw.astype(jnp.float32), rw.astype(jnp.float32), atol=0.5, rtol=0.1)

@@ -1,0 +1,78 @@
+"""Int8 quantized matmul (ops/quant.py): numerics, grads, training.
+
+CPU-verifiable semantics for the MXU double-rate path: the forward product
+must track the f32 product within quantization error, the straight-through
+backward must match the unquantized matmul's grads, and an int8 tiny-config
+train run must still reduce loss.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.ops.quant import int8_matmul, quantize_int8
+
+
+def test_quantize_roundtrip_error_bounded():
+    x = jax.random.normal(jax.random.key(0), (64, 128), jnp.float32)
+    q, scale = quantize_int8(x, axis=-1)
+    assert q.dtype == jnp.int8
+    deq = q.astype(jnp.float32) * scale
+    # max error is half an int8 step of the per-row scale
+    assert float(jnp.max(jnp.abs(deq - x) / scale)) <= 0.5 + 1e-3
+
+
+def test_int8_matmul_tracks_f32_product():
+    kx, kw = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kx, (8, 32, 256), jnp.bfloat16)
+    w = jax.random.normal(kw, (256, 128), jnp.bfloat16)
+    y = int8_matmul(x, w)
+    ref = jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+    assert y.dtype == x.dtype
+    rel = jnp.linalg.norm(y.astype(jnp.float32) - ref) / jnp.linalg.norm(ref)
+    assert float(rel) < 0.02  # ~1% quantization noise at K=256
+
+
+def test_int8_matmul_grads_are_straight_through():
+    kx, kw = jax.random.split(jax.random.key(2))
+    x = jax.random.normal(kx, (4, 64), jnp.bfloat16)
+    w = jax.random.normal(kw, (64, 32), jnp.bfloat16)
+
+    def loss_q(x, w):
+        return jnp.sum(jnp.tanh(int8_matmul(x, w).astype(jnp.float32)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.tanh(jnp.dot(x, w).astype(jnp.float32)))
+
+    gx, gw = jax.grad(loss_q, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    assert gx.dtype == x.dtype and gw.dtype == w.dtype
+    # straight-through grads differ from ref only through the (quantized)
+    # tanh inputs — directions must agree closely
+    cos = jnp.sum(gx.astype(jnp.float32) * rx.astype(jnp.float32)) / (
+        jnp.linalg.norm(gx.astype(jnp.float32))
+        * jnp.linalg.norm(rx.astype(jnp.float32))
+    )
+    assert float(cos) > 0.99
+    cos_w = jnp.sum(gw.astype(jnp.float32) * rw.astype(jnp.float32)) / (
+        jnp.linalg.norm(gw.astype(jnp.float32))
+        * jnp.linalg.norm(rw.astype(jnp.float32))
+    )
+    assert float(cos_w) > 0.99
+
+
+def test_int8_train_step_reduces_loss():
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+    from k8s_gpu_device_plugin_tpu.models.train import (
+        init_train_state, make_optimizer, make_train_step, synthetic_batch)
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = LlamaConfig.tiny(n_layers=2, quant="int8")
+    mesh = make_mesh(MeshSpec.for_devices(1), jax.devices()[:1])
+    opt = make_optimizer(learning_rate=1e-2, warmup_steps=1, total_steps=30)
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    batch = synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+    step = make_train_step(cfg, mesh, opt)
+    state, first = step(state, batch)
+    for _ in range(20):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])
