@@ -53,7 +53,9 @@ def train_mfu(
     optimizer = make_optimizer(total_steps=steps + warmup + 1)
     state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
     batch = synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len, mesh)
-    train_step = make_train_step(cfg, mesh, optimizer)
+    # throughput bench: skip the accuracy argmax (an extra full pass over
+    # the (B,S,V) f32 logits that trains nothing)
+    train_step = make_train_step(cfg, mesh, optimizer, with_accuracy=False)
 
     for _ in range(warmup):
         state, metrics = train_step(state, batch)

@@ -77,6 +77,13 @@ class LlamaConfig:
     # (bubble fraction is (pp-1)/(n_microbatches+pp-1))
     n_microbatches: int = 1
 
+    def __post_init__(self) -> None:
+        if self.quant not in ("none", "int8"):
+            raise ValueError(
+                f"quant must be 'none' or 'int8', got {self.quant!r} — "
+                "an unknown value would silently run pure bf16"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

@@ -28,6 +28,7 @@ from typing import TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from k8s_gpu_device_plugin_tpu.parallel.mesh import (
@@ -118,6 +119,23 @@ def _group_size(requested: int, seq_len: int) -> int:
     return seq_len  # unreachable: 1 always divides
 
 
+def _expert_mm(x: jax.Array, w: jax.Array, cfg: "LlamaConfig") -> jax.Array:
+    """(E,B,C,K) x (E,K,N) -> (E,B,C,N); int8 per-expert path when enabled.
+
+    The quantized output is checkpoint-named "quant_dot" so the remat
+    policy saves it (custom_vjp calls are opaque to dot-matching policies,
+    same as the dense path in models/llama.py)."""
+    e, b, c, k = x.shape
+    if cfg.quant == "int8":
+        from k8s_gpu_device_plugin_tpu.ops.quant import int8_expert_matmul
+
+        out = checkpoint_name(
+            int8_expert_matmul(x.reshape(e, b * c, k), w), "quant_dot"
+        )
+        return out.reshape(e, b, c, -1)
+    return jnp.einsum("ebck,ekn->ebcn", x, w)
+
+
 def moe_mlp(
     h: jax.Array, layer: dict, cfg: "LlamaConfig"
 ) -> tuple[jax.Array, dict]:
@@ -164,11 +182,11 @@ def moe_mlp(
     expert_in = constrain(expert_in, P(AXIS_EP, BATCH, None, None))
 
     gate = jax.nn.silu(
-        jnp.einsum("ebcd,edf->ebcf", expert_in, layer["moe_w1"]).astype(jnp.float32)
+        _expert_mm(expert_in, layer["moe_w1"], cfg).astype(jnp.float32)
     ).astype(cfg.dtype)
-    up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["moe_w3"])
+    up = _expert_mm(expert_in, layer["moe_w3"], cfg)
     ff = constrain(gate * up, P(AXIS_EP, BATCH, None, AXIS_TP))
-    expert_out = jnp.einsum("ebcf,efd->ebcd", ff, layer["moe_w2"])
+    expert_out = _expert_mm(ff, layer["moe_w2"], cfg)
     expert_out = constrain(expert_out, P(AXIS_EP, BATCH, None, None))
 
     # per-expert buffers -> tokens (the return all-to-all), gate-weighted

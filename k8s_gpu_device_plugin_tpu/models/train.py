@@ -36,8 +36,14 @@ def cross_entropy(
     logits: jax.Array,
     targets: jax.Array,
     z_loss_weight: float = 1e-4,
+    with_accuracy: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """Mean token cross-entropy (f32) + z-loss; returns (loss, accuracy)."""
+    """Mean token cross-entropy (f32) + z-loss; returns (loss, accuracy).
+
+    ``with_accuracy=False`` skips the argmax — a full extra pass over the
+    (B, S, V) f32 logits that pure-throughput callers (the train bench)
+    should not pay for; accuracy is then reported as -1.
+    """
     logits = logits.astype(jnp.float32)
     logsumexp = jax.nn.logsumexp(logits, axis=-1)
     target_logit = jnp.take_along_axis(
@@ -45,7 +51,12 @@ def cross_entropy(
     ).squeeze(-1)
     nll = logsumexp - target_logit
     z_loss = z_loss_weight * jnp.square(logsumexp)
-    accuracy = jnp.mean((jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32))
+    if with_accuracy:
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+        )
+    else:
+        accuracy = jnp.float32(-1.0)
     return jnp.mean(nll + z_loss), accuracy
 
 
@@ -67,9 +78,13 @@ def make_optimizer(
     )
 
 
-def loss_fn(params, batch, cfg: LlamaConfig, mesh: Mesh | None):
+def loss_fn(
+    params, batch, cfg: LlamaConfig, mesh: Mesh | None, with_accuracy: bool = True
+):
     logits, aux = forward_with_aux(params, batch["inputs"], cfg, mesh)
-    loss, accuracy = cross_entropy(logits, batch["targets"])
+    loss, accuracy = cross_entropy(
+        logits, batch["targets"], with_accuracy=with_accuracy
+    )
     metrics = {"loss": loss, "accuracy": accuracy}
     if aux:  # MoE: add router balance + z losses (weights from config)
         total = (
@@ -86,12 +101,17 @@ def make_train_step(
     cfg: LlamaConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
+    with_accuracy: bool = True,
 ) -> Callable:
-    """Build the jitted (state, batch) -> (state, metrics) step."""
+    """Build the jitted (state, batch) -> (state, metrics) step.
+
+    ``with_accuracy=False`` drops the accuracy argmax from the step (one
+    full pass over the f32 logits) for throughput benchmarking."""
 
     def step(state, batch):
         grad_fn = jax.value_and_grad(
-            partial(loss_fn, cfg=cfg, mesh=mesh), has_aux=True
+            partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=with_accuracy),
+            has_aux=True,
         )
         (_, metrics), grads = grad_fn(state["params"], batch)
         updates, opt_state = optimizer.update(

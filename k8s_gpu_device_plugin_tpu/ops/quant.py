@@ -84,3 +84,46 @@ def _int8_matmul_bwd(res, g):
 
 
 int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+@jax.custom_vjp
+def int8_expert_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Batched ``einsum('emd,edf->emf')`` with int8 operands on the MXU.
+
+    The MoE expert-FFN shape (models/moe.py): x is (E, M, D) per-expert
+    token buffers, w is (E, D, F) stacked expert weights. Scales are
+    per-(e, m) row for x and per-(e, f) column for w, so each expert
+    quantizes independently. Backward is the same straight-through bf16
+    recipe as int8_matmul, batched over E.
+    """
+    qx, sx = quantize_int8(x, axis=-1)              # (E,M,D), (E,M,1)
+    qw, sw = quantize_int8(w, axis=1)               # (E,D,F), (E,1,F)
+    y = jax.lax.dot_general(
+        qx, qw,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )                                               # (E,M,F)
+    return (y.astype(jnp.float32) * sx * sw).astype(x.dtype)
+
+
+def _int8_expert_fwd(x, w):
+    return int8_expert_matmul(x, w), (x, w)
+
+
+def _int8_expert_bwd(res, g):
+    x, w = res
+    gb = g.astype(x.dtype)
+    # dx (E,M,D) = g (E,M,F) @ w^T (E,F,D); dw (E,D,F) = x^T (E,D,M) @ g
+    dx = jax.lax.dot_general(
+        gb, w, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        x, gb,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    return dx, dw
+
+
+int8_expert_matmul.defvjp(_int8_expert_fwd, _int8_expert_bwd)
