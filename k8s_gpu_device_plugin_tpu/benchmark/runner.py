@@ -74,19 +74,24 @@ def _model_dims(cfg) -> dict:
     }
 
 
-def _run_train() -> dict:
+def _train_result(workload: str, quant: str) -> dict:
+    """Shared train-bench runner so bf16 and int8 stay like-for-like."""
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
 
     _require_accelerator()
-    cfg = _bench_model_cfg()
+    cfg = _bench_model_cfg(quant=quant)
     r = train_mfu(cfg, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ, steps=5, warmup=2)
     return {
-        "workload": "train",
+        "workload": workload,
         "mfu_pct": round(r.mfu * 100, 2),
         "tokens_per_second": round(r.tokens_per_second, 1),
         "step_ms": round(r.step_seconds * 1000, 1),
         "model": _model_dims(cfg),
     }
+
+
+def _run_train() -> dict:
+    return _train_result("train", quant="none")
 
 
 def _run_train_int8() -> dict:
@@ -95,17 +100,23 @@ def _run_train_int8() -> dict:
     figure keeps the standard accounting (bf16 6N model FLOPs vs bf16
     peak), so >100% of bf16 peak is possible in principle — the honest
     reading is 'bf16-equivalent throughput'."""
-    from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
+    return _train_result("train_int8", quant="int8")
+
+
+def _run_breakdown() -> dict:
+    """Differential step-time breakdown on the bench proxy model (dev tool;
+    not part of the driver's JSON line — run via
+    ``python -m ...benchmark.runner breakdown``)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
+        step_breakdown,
+    )
 
     _require_accelerator()
-    cfg = _bench_model_cfg(quant="int8")
-    r = train_mfu(cfg, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ, steps=5, warmup=2)
+    r = step_breakdown(_bench_model_cfg(), BENCH_BATCH, BENCH_SEQ)
     return {
-        "workload": "train_int8",
-        "mfu_pct": round(r.mfu * 100, 2),
-        "tokens_per_second": round(r.tokens_per_second, 1),
-        "step_ms": round(r.step_seconds * 1000, 1),
-        "model": _model_dims(cfg),
+        "workload": "breakdown",
+        "variants_ms": {k: round(v, 1) for k, v in r.variants_ms.items()},
+        "attributed_ms": {k: round(v, 1) for k, v in r.attributed_ms.items()},
     }
 
 
@@ -148,6 +159,7 @@ WORKLOADS = {
     "matmul": _run_matmul,
     "train": _run_train,
     "train_int8": _run_train_int8,
+    "breakdown": _run_breakdown,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
 }

@@ -1,0 +1,168 @@
+"""Train-step time breakdown by ablation (profiling aux subsystem).
+
+``jax.profiler`` traces need a TensorBoard/xprof reader this environment
+does not ship, so the practical way to see where a training step's time
+goes is differential measurement: time the full step, then variants with
+one stage removed, and attribute the deltas. This is a first-class
+workload (not a notebook hack) so perf work is reproducible across rounds:
+
+- ``full``      fwd + bwd + optimizer (the real train step)
+- ``fwd_bwd``   no optimizer update
+- ``fwd``       loss only (no backward)
+- ``dummy_loss``fwd+bwd with sum(logits) instead of cross-entropy —
+                isolates the CE/softmax/argmax cost over (B,S,V) f32
+- ``ref_attn``  fwd+bwd with the XLA reference attention — isolates the
+                Pallas flash kernels' contribution
+
+Deltas are attributed as: optimizer = full - fwd_bwd, backward = fwd_bwd -
+fwd, cross-entropy = fwd_bwd - dummy_loss, flash-vs-xla = ref_attn -
+fwd_bwd (negative = flash faster). Each variant is jitted to a scalar so a
+single fetch serializes the whole computation (relay-safe timing, same
+methodology as matmul_mfu).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, forward_with_aux
+from k8s_gpu_device_plugin_tpu.models.train import (
+    init_train_state,
+    loss_fn,
+    make_optimizer,
+    synthetic_batch,
+)
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+@dataclass(frozen=True)
+class StepBreakdown:
+    variants_ms: dict          # variant name -> best-of-N milliseconds
+    attributed_ms: dict        # stage name -> attributed milliseconds
+    flops_per_step: float
+
+
+def _grads_scalar(g) -> jax.Array:
+    """Fold a grad pytree into a 0-cost scalar so jit cannot DCE the bwd."""
+    return sum(jnp.sum(x.astype(jnp.float32)) * 0.0 for x in jax.tree.leaves(g))
+
+
+def _time_scalar_fn(fn, args, repeats: int) -> float:
+    float(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t = time.perf_counter()
+        float(fn(*args))
+        best = min(best, time.perf_counter() - t)
+    return best
+
+
+def step_breakdown(
+    cfg: LlamaConfig,
+    batch_size: int,
+    seq_len: int,
+    repeats: int = 3,
+    devices: list | None = None,
+    variants: tuple[str, ...] = ("full", "fwd_bwd", "fwd", "dummy_loss", "ref_attn"),
+) -> StepBreakdown:
+    devices = devices or jax.devices()
+    mesh = make_mesh(MeshSpec.for_devices(len(devices)), devices)
+    optimizer = make_optimizer(total_steps=100)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len, mesh)
+    params = state["params"]
+
+    def fwd_bwd_of(loss):
+        def scalar(p, b):
+            out, g = jax.value_and_grad(loss, has_aux=True)(p, b)
+            l = out[0] if isinstance(out, tuple) else out
+            return l + _grads_scalar(g)
+        return scalar
+
+    times: dict[str, float] = {}
+    for name in variants:
+        if name == "full":
+            # Same computation as make_train_step but WITHOUT buffer
+            # donation (state is reused across variants and timed calls);
+            # new params/opt state fold into the scalar so nothing is DCE'd.
+            import optax
+
+            def run_full(p_state, b):
+                grad_fn = jax.value_and_grad(
+                    partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=False),
+                    has_aux=True,
+                )
+                (_, metrics), grads = grad_fn(p_state["params"], b)
+                updates, opt_state = optimizer.update(
+                    grads, p_state["opt_state"], p_state["params"]
+                )
+                new_params = optax.apply_updates(p_state["params"], updates)
+                return (
+                    metrics["loss"]
+                    + _grads_scalar(new_params)
+                    + _grads_scalar(opt_state)
+                )
+
+            times[name] = _time_scalar_fn(
+                jax.jit(run_full), (state, batch), repeats
+            )
+            continue
+        if name == "fwd":
+            fn = jax.jit(
+                lambda p, b: loss_fn(p, b, cfg, mesh, with_accuracy=False)[0]
+            )
+        elif name == "fwd_bwd":
+            fn = jax.jit(fwd_bwd_of(
+                partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=False)
+            ))
+        elif name == "dummy_loss":
+            def dummy(p, b):
+                logits, _ = forward_with_aux(p, b["inputs"], cfg, mesh)
+                return jnp.sum(logits) * 1e-9, {}
+            fn = jax.jit(fwd_bwd_of(dummy))
+        elif name == "ref_attn":
+            # ops/__init__ rebinds the name `attention` to the function, so
+            # resolve the MODULE explicitly for monkeypatching
+            import importlib
+
+            attn_mod = importlib.import_module(
+                "k8s_gpu_device_plugin_tpu.ops.attention"
+            )
+
+            orig = attn_mod.attention
+            attn_mod.attention = attn_mod.mha_reference
+            try:
+                fn = jax.jit(fwd_bwd_of(
+                    partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=False)
+                ))
+                times[name] = _time_scalar_fn(fn, (params, batch), repeats)
+            finally:
+                attn_mod.attention = orig
+            continue
+        else:
+            raise ValueError(f"unknown variant {name!r}")
+        times[name] = _time_scalar_fn(fn, (params, batch), repeats)
+
+    attributed = {}
+    if "full" in times and "fwd_bwd" in times:
+        attributed["optimizer"] = (times["full"] - times["fwd_bwd"]) * 1000
+    if "fwd_bwd" in times and "fwd" in times:
+        attributed["backward"] = (times["fwd_bwd"] - times["fwd"]) * 1000
+    if "fwd_bwd" in times and "dummy_loss" in times:
+        attributed["cross_entropy"] = (
+            (times["fwd_bwd"] - times["dummy_loss"]) * 1000
+        )
+    if "ref_attn" in times and "fwd_bwd" in times:
+        attributed["flash_vs_xla_attn"] = (
+            (times["ref_attn"] - times["fwd_bwd"]) * 1000
+        )
+    return StepBreakdown(
+        variants_ms={k: v * 1000 for k, v in times.items()},
+        attributed_ms=attributed,
+        flops_per_step=cfg.flops_per_token() * batch_size * seq_len,
+    )

@@ -63,6 +63,11 @@ class LlamaConfig:
     # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
     # int8 path (ops/quant.py: quantized fwd, bf16 bwd); "none" = pure bf16.
     quant: str = "none"
+    # Fused lm_head+cross-entropy (ops/fused_ce.py): never materializes the
+    # (B,S,V) logits. Training-loss only (no logits output, no accuracy);
+    # requires the vocab axis unsharded (tp == 1) — loss_fn falls back
+    # to the unfused path otherwise.
+    fused_ce: bool = False
     # MoE (0 experts = dense MLP); Mixtral-style top-k routing, GShard dispatch
     n_experts: int = 0
     n_experts_per_token: int = 2
@@ -348,9 +353,12 @@ def forward_with_aux(
     tokens: jax.Array,
     cfg: LlamaConfig,
     mesh: Mesh | None = None,
+    return_hidden: bool = False,
 ) -> tuple[jax.Array, dict]:
     """Token ids (B, S) -> (logits (B, S, V) f32, aux losses summed over
-    layers — empty dict for dense configs, MoE balance/z terms otherwise)."""
+    layers — empty dict for dense configs, MoE balance/z terms otherwise).
+    ``return_hidden`` stops before the lm_head and returns the final normed
+    hidden states (B, S, D) instead — the seam fused-CE training uses."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, P(BATCH, AXIS_SP, None))
@@ -402,6 +410,8 @@ def forward_with_aux(
         x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
         aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return constrain(x, P(BATCH, AXIS_SP, None)), aux
     logits = _lm_head_matmul(x, params["lm_head"].astype(cfg.dtype))
     return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP)), aux
 

@@ -81,10 +81,28 @@ def make_optimizer(
 def loss_fn(
     params, batch, cfg: LlamaConfig, mesh: Mesh | None, with_accuracy: bool = True
 ):
-    logits, aux = forward_with_aux(params, batch["inputs"], cfg, mesh)
-    loss, accuracy = cross_entropy(
-        logits, batch["targets"], with_accuracy=with_accuracy
+    fused = (
+        cfg.fused_ce
+        and (mesh is None or mesh.shape.get("tp", 1) == 1)
+        and not with_accuracy  # fused path has no logits to argmax over
     )
+    if fused:
+        from k8s_gpu_device_plugin_tpu.ops.fused_ce import (
+            fused_linear_cross_entropy,
+        )
+
+        hidden, aux = forward_with_aux(
+            params, batch["inputs"], cfg, mesh, return_hidden=True
+        )
+        loss = fused_linear_cross_entropy(
+            hidden, params["lm_head"].astype(cfg.dtype), batch["targets"]
+        )
+        accuracy = jnp.float32(-1.0)
+    else:
+        logits, aux = forward_with_aux(params, batch["inputs"], cfg, mesh)
+        loss, accuracy = cross_entropy(
+            logits, batch["targets"], with_accuracy=with_accuracy
+        )
     metrics = {"loss": loss, "accuracy": accuracy}
     if aux:  # MoE: add router balance + z losses (weights from config)
         total = (

@@ -87,7 +87,11 @@ class Trainer:
             warmup_steps=cfg.warmup_steps,
             total_steps=cfg.total_steps,
         )
-        self.step_fn = make_train_step(cfg.model, self.mesh, self.optimizer)
+        # fused CE has no logits to argmax, so accuracy is off on that path
+        self.step_fn = make_train_step(
+            cfg.model, self.mesh, self.optimizer,
+            with_accuracy=not cfg.model.fused_ce,
+        )
         self.loader = loader or DataLoader(
             SyntheticSource(cfg.model.vocab_size),
             cfg.batch_size,
@@ -207,14 +211,23 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--quant", default="none", choices=["none", "int8"],
                         help="int8 runs block matmuls on the MXU double-rate "
                         "path (quantized fwd, bf16 bwd)")
+    parser.add_argument("--fusedCE", action="store_true",
+                        help="fused lm_head+cross-entropy (no materialized "
+                        "logits; tp==1 only, accuracy reported as -1)")
     args = parser.parse_args(argv)
+    if args.fusedCE and args.tp > 1:
+        # loss_fn would silently fall back to the unfused path (the scan
+        # slices the vocab axis, which tp shards) while accuracy is already
+        # disabled — fail loudly instead of running a degraded combination.
+        parser.error("--fusedCE requires --tp 1 (the fused scan cannot "
+                     "slice a tp-sharded vocab axis)")
 
     initialize()  # multi-host rendezvous BEFORE jax.devices()
     model = getattr(LlamaConfig, args.preset)()
-    if args.quant != "none":
+    if args.quant != "none" or args.fusedCE:
         from dataclasses import replace as _replace
 
-        model = _replace(model, quant=args.quant)
+        model = _replace(model, quant=args.quant, fused_ce=args.fusedCE)
     spec = MeshSpec.for_devices(
         len(jax.devices()), tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         fsdp=args.fsdp,

@@ -1,0 +1,103 @@
+"""Fused lm_head + cross-entropy: never materializes (B, S, V) logits.
+
+The unfused loss path computes ``logits = x @ W`` into a (B, S, V) f32
+tensor (2.1 GB at B=8, S=2048, V=32000) and then runs logsumexp, a target
+gather, and the softmax backward over it — several full HBM passes over
+the biggest tensor in the step. This module chunks the vocabulary instead:
+a ``lax.scan`` over (D, Vc) weight slices keeps an online logsumexp
+(flash-attention's trick applied to the vocab axis), gathers the target
+logit from whichever chunk owns it, and wraps the body in
+``jax.checkpoint(..., nothing_saveable)`` so reverse-mode autodiff
+recomputes each chunk's logits instead of saving them. Peak extra memory
+is O(B*S*chunk) and the full logits tensor never exists, forward or
+backward — the standard fused-linear-cross-entropy recipe, built from
+scan + remat rather than a custom kernel so XLA still fuses the chunk
+matmul with the online-softmax update.
+
+Constraint: the vocab axis of ``w`` must not be sharded (the scan slices
+it); callers gate on tp == 1 (models/train.py falls back to the unfused
+path otherwise). bf16 operands, f32 accumulation throughout — numerically
+the same contract as the unfused ``_lm_head_matmul`` + ``cross_entropy``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_chunks(vocab: int, chunk: int) -> tuple[int, int]:
+    """(n_chunks, padded_vocab) for a FIXED chunk size: the last chunk is
+    zero-padded and masked rather than shrinking chunk to a divisor —
+    divisor-hunting degenerates for awkward vocabs (50257 = 29 x 1733
+    would mean 1733 tiny scan steps)."""
+    chunk = min(chunk, vocab)
+    n_chunks = -(-vocab // chunk)
+    return n_chunks, n_chunks * chunk
+
+
+def fused_linear_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    targets: jax.Array,
+    z_loss_weight: float = 1e-4,
+    chunk: int = 4096,
+) -> jax.Array:
+    """Mean token cross-entropy (+ z-loss) of ``softmax(x @ w)`` vs targets.
+
+    x: (B, S, D) activations (bf16), w: (D, V) head weights (bf16),
+    targets: (B, S) int32. Returns the scalar f32 loss; grads flow to both
+    x and w without materializing logits.
+    """
+    b, s, d = x.shape
+    v = w.shape[-1]
+    chunk = min(chunk, v)
+    n_chunks, padded_v = _pad_chunks(v, chunk)
+
+    x2 = x.reshape(b * s, d)
+    t = targets.reshape(b * s)
+    n = b * s
+    # (V, D) chunks scanned on the leading axis; transposing once here
+    # keeps each chunk matmul a plain (N, D) x (D, C) dot. The tail chunk
+    # is zero-padded; its phantom logits are masked to -inf below.
+    wt = w.T
+    if padded_v != v:
+        wt = jnp.pad(wt, ((0, padded_v - v), (0, 0)))
+    w_chunks = wt.reshape(n_chunks, chunk, d)
+    chunk_starts = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+
+    def body(carry, inp):
+        m, acc, tl = carry
+        wc, c0 = inp
+        logits = jax.lax.dot_general(
+            x2, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # (N, C) f32
+        col = c0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < v, logits, -jnp.inf)
+        cmax = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cmax)
+        acc = acc * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        idx = t - c0
+        in_chunk = (idx >= 0) & (idx < chunk)
+        gathered = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1
+        )[:, 0]
+        tl = jnp.where(in_chunk, gathered, tl)
+        return (m_new, acc, tl), None
+
+    body = jax.checkpoint(
+        body, policy=jax.checkpoint_policies.nothing_saveable
+    )
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, acc, tl), _ = jax.lax.scan(body, init, (w_chunks, chunk_starts))
+    lse = m + jnp.log(acc)
+    nll = lse - tl
+    z_loss = z_loss_weight * jnp.square(lse)
+    return jnp.mean(nll + z_loss)

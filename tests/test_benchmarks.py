@@ -68,3 +68,26 @@ def test_control_plane_roundtrip(tmp_path):
     assert result.allocations == 10
     assert result.allocs_per_second > 0
     assert result.registrations >= 1
+
+
+def test_step_breakdown_cpu():
+    """Differential breakdown machinery end-to-end on a tiny CPU config."""
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
+        step_breakdown,
+    )
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+    r = step_breakdown(
+        LlamaConfig.tiny(n_layers=2), batch_size=2, seq_len=64, repeats=1,
+        devices=jax.devices()[:1],
+    )
+    assert set(r.variants_ms) == {
+        "full", "fwd_bwd", "fwd", "dummy_loss", "ref_attn"
+    }
+    assert all(v > 0 for v in r.variants_ms.values())
+    assert {"optimizer", "backward", "cross_entropy", "flash_vs_xla_attn"} <= set(
+        r.attributed_ms
+    )
+    assert r.flops_per_step > 0

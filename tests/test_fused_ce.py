@@ -1,0 +1,68 @@
+"""Fused lm_head+cross-entropy (ops/fused_ce.py) vs the unfused reference."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.train import cross_entropy, loss_fn
+from k8s_gpu_device_plugin_tpu.ops.fused_ce import (
+    _pad_chunks,
+    fused_linear_cross_entropy,
+)
+
+
+def _ref_loss(x, w, targets):
+    logits = jnp.dot(
+        x, w, preferred_element_type=jnp.float32
+    )
+    return cross_entropy(logits, targets, with_accuracy=False)[0]
+
+
+def test_pad_chunks_fixed_size():
+    # chunk stays FIXED; awkward vocabs pad the tail instead of shrinking
+    assert _pad_chunks(32000, 4096) == (8, 8 * 4096)
+    assert _pad_chunks(4096, 4096) == (1, 4096)
+    assert _pad_chunks(50257, 4096) == (13, 13 * 4096)  # GPT-2: 13 steps, not 1733
+    assert _pad_chunks(7, 4096) == (1, 7)
+
+
+@pytest.mark.parametrize("vocab,chunk", [(512, 128), (500, 128), (512, 512)])
+def test_fused_matches_reference_loss_and_grads(vocab, chunk):
+    kx, kw, kt = jax.random.split(jax.random.key(0), 3)
+    b, s, d = 2, 16, 64
+    x = jax.random.normal(kx, (b, s, d), jnp.bfloat16)
+    w = jax.random.normal(kw, (d, vocab), jnp.bfloat16) * 0.1
+    t = jax.random.randint(kt, (b, s), 0, vocab, jnp.int32)
+
+    loss_f = fused_linear_cross_entropy(x, w, t, chunk=chunk)
+    loss_r = _ref_loss(x, w, t)
+    assert jnp.allclose(loss_f, loss_r, atol=2e-3, rtol=2e-3)
+
+    gf = jax.grad(lambda x, w: fused_linear_cross_entropy(x, w, t, chunk=chunk),
+                  argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: _ref_loss(x, w, t), argnums=(0, 1))(x, w)
+    for f, r in zip(gf, gr):
+        f32, r32 = f.astype(jnp.float32), r.astype(jnp.float32)
+        denom = jnp.linalg.norm(r32) + 1e-12
+        assert float(jnp.linalg.norm(f32 - r32) / denom) < 0.05
+
+
+def test_loss_fn_fused_path_matches_unfused():
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    cfg_f = LlamaConfig.tiny(n_layers=2, fused_ce=True)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 65), 0, cfg.vocab_size,
+                                jnp.int32)
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+    l_ref, m_ref = loss_fn(params, batch, cfg, None, with_accuracy=False)
+    l_fused, m_fused = loss_fn(params, batch, cfg_f, None, with_accuracy=False)
+    assert jnp.allclose(l_ref, l_fused, atol=2e-3, rtol=2e-3)
+    assert float(m_fused["accuracy"]) == -1.0
+
+    # with_accuracy=True forces the unfused fallback (fused has no logits)
+    l_acc, m_acc = loss_fn(params, batch, cfg_f, None, with_accuracy=True)
+    assert float(m_acc["accuracy"]) >= 0.0
