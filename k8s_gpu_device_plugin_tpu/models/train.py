@@ -29,13 +29,18 @@ from k8s_gpu_device_plugin_tpu.parallel.mesh import (
     AXIS_FSDP,
     AXIS_PP,
     AXIS_SP,
+    AXIS_TP,
 )
+
+# One z-loss weight for BOTH loss paths (unfused cross_entropy and the
+# fused ops/fused_ce.py call) so a perf flag can never change the objective.
+Z_LOSS_WEIGHT = 1e-4
 
 
 def cross_entropy(
     logits: jax.Array,
     targets: jax.Array,
-    z_loss_weight: float = 1e-4,
+    z_loss_weight: float = Z_LOSS_WEIGHT,
     with_accuracy: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
     """Mean token cross-entropy (f32) + z-loss; returns (loss, accuracy).
@@ -83,7 +88,7 @@ def loss_fn(
 ):
     fused = (
         cfg.fused_ce
-        and (mesh is None or mesh.shape.get("tp", 1) == 1)
+        and (mesh is None or mesh.shape.get(AXIS_TP, 1) == 1)
         and not with_accuracy  # fused path has no logits to argmax over
     )
     if fused:
@@ -95,7 +100,8 @@ def loss_fn(
             params, batch["inputs"], cfg, mesh, return_hidden=True
         )
         loss = fused_linear_cross_entropy(
-            hidden, params["lm_head"].astype(cfg.dtype), batch["targets"]
+            hidden, params["lm_head"].astype(cfg.dtype), batch["targets"],
+            z_loss_weight=Z_LOSS_WEIGHT,
         )
         accuracy = jnp.float32(-1.0)
     else:

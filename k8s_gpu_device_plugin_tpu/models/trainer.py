@@ -31,7 +31,7 @@ from k8s_gpu_device_plugin_tpu.models.train import (
     make_optimizer,
     make_train_step,
 )
-from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_TP, MeshSpec
 from k8s_gpu_device_plugin_tpu.parallel.multihost import initialize, make_global_mesh
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
@@ -82,6 +82,14 @@ class Trainer:
         # no-op on single-process pods; rendezvous via plugin-injected envs
         initialize()
         self.mesh = make_global_mesh(cfg.mesh, cfg.num_slices)
+        if cfg.model.fused_ce and self.mesh.shape.get(AXIS_TP, 1) > 1:
+            # loss_fn would silently fall back to the unfused path while
+            # accuracy is disabled below — fail loudly, for library callers
+            # and the CLI alike.
+            raise ValueError(
+                "fused_ce requires tp == 1 (the fused scan cannot slice a "
+                "tp-sharded vocab axis)"
+            )
         self.optimizer = make_optimizer(
             learning_rate=cfg.learning_rate,
             warmup_steps=cfg.warmup_steps,

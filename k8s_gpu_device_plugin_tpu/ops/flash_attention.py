@@ -130,10 +130,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0] = m_scr[:] + jnp.log(l_safe)[:, None]
 
 
-def _flash_fwd_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
-    """q: (BH, S, D) with k/v already head-expanded to (BH, S, D).
+def _kv_row(b, hq: int, hkv: int):
+    """GQA mapping: q-head row index in (B*Hq) -> kv row in (B*Hkv).
 
-    Returns (o (BH, S, D), lse (BH, S, 1) f32)."""
+    k/v stay at their native Hkv heads in HBM/VMEM — the expansion NVidia-
+    style implementations materialize (jnp.repeat to Hq heads) never
+    happens; the grid's block index map points each q head at its group's
+    kv head instead."""
+    group = hq // hkv
+    return (b // hq) * hkv + (b % hq) // group
+
+
+def _flash_fwd_bhsd(q, k, v, *, hq, hkv, scale, causal, block_q, block_k,
+                    interpret):
+    """q: (B*Hq, S, D); k/v: (B*Hkv, S, D) — GQA-native, no expansion.
+
+    Returns (o (B*Hq, S, D), lse (B*Hq, S, 1) f32)."""
     bh, s, d = q.shape
     nq = s // block_q
     nk = s // block_k
@@ -148,13 +160,16 @@ def _flash_fwd_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 1), jnp.float32),   # l
         pltpu.VMEM((block_q, d), jnp.float32),   # acc
     ]
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda b, i, j: (_kv_row(b, hq, hkv), j, 0)
+    )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -170,12 +185,6 @@ def _flash_fwd_bhsd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
 
 # --- custom-vjp wrapper ---------------------------------------------------
-
-
-def _expand_kv(k, h):
-    if k.shape[2] == h:
-        return k
-    return jnp.repeat(k, h // k.shape[2], axis=2)
 
 
 def _to_bhsd(x):
@@ -200,10 +209,9 @@ def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
     b, s, h, d = q.shape
-    kx = _expand_kv(k, h)
-    vx = _expand_kv(v, h)
     o, lse = _flash_fwd_bhsd(
-        _to_bhsd(q), _to_bhsd(kx), _to_bhsd(vx),
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+        hq=h, hkv=k.shape[2],
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
@@ -290,12 +298,17 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, causal, block_q, block_k):
+                    scale, causal, block_q, block_k, nq):
+    """Grid (B*Hkv, nk, nq*group): the inner axis walks every (q head of
+    this kv head's group) x (q block); dk/dv accumulate across BOTH in one
+    VMEM scratch, so GQA grads come out at native Hkv heads with no
+    expanded (B*Hq, S, D) f32 intermediates and no XLA fold pass."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    inner = pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    qi = inner % nq  # q-block index within the current group head
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -323,7 +336,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _compute()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(inner == n_inner - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -362,27 +375,43 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, scale, causal,
+def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, hq, hkv, scale, causal,
                     block_q, block_k, interpret):
-    """All inputs (BH, S, D) except lse/delta (BH, S, 1) f32."""
+    """q/do (B*Hq, S, D); k/v (B*Hkv, S, D); lse/delta (B*Hq, S, 1) f32.
+
+    Returns dq at (B*Hq, S, D) and dk/dv at native (B*Hkv, S, D)."""
     bh, s, d = q.shape
+    bhkv = k.shape[0]
+    group = hq // hkv
     nq = s // block_q
     nk = s // block_k
 
+    # dq grid: (B*Hq, q, kv); k/v blocks follow the GQA row mapping.
     qkv_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    qkv_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    qkv_k = pl.BlockSpec(
+        (1, block_k, d), lambda b, i, j: (_kv_row(b, hq, hkv), j, 0)
+    )
     row_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
-    # dkv grid: (bh, kv, q) — q innermost, so swap index roles
-    qkv_q_inner = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+
+    # dkv grid: (B*Hkv, kv, q*group) — the inner axis enumerates the group's
+    # q heads x q blocks; q-side operands decode their row from it.
+    def q_row(b, inner):
+        return (b // hkv) * hq + (b % hkv) * group + inner // nq
+
+    qkv_q_inner = pl.BlockSpec(
+        (1, block_q, d), lambda b, j, i: (q_row(b, i), i % nq, 0)
+    )
     qkv_k_outer = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    row_q_inner = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    row_q_inner = pl.BlockSpec(
+        (1, block_q, 1), lambda b, j, i: (q_row(b, i), i % nq, 0)
+    )
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, nq=nq,
         ),
-        grid=(bh, nk, nq),
+        grid=(bhkv, nk, nq * group),
         in_specs=[qkv_q_inner, qkv_k_outer, qkv_k_outer, qkv_q_inner,
                   row_q_inner, row_q_inner],
         out_specs=[
@@ -390,8 +419,8 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, scale, causal,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -417,14 +446,14 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, scale, causal,
 
 def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
                     block_q, block_k, interpret):
-    """Shared backward: dlse_col is (BH, S, 1) f32 or None."""
+    """Shared backward: dlse_col is (BH, S, 1) f32 or None. GQA-native:
+    k/v stay at Hkv heads; the dkv kernel folds the group sum in VMEM."""
     b, s, h, d = q.shape
     n_kv = k.shape[2]
-    group = h // n_kv
 
     q_b = _to_bhsd(q)
-    k_b = _to_bhsd(_expand_kv(k, h))
-    v_b = _to_bhsd(_expand_kv(v, h))
+    k_b = _to_bhsd(k)
+    v_b = _to_bhsd(v)
     do_b = _to_bhsd(do)
     o_b = _to_bhsd(o)
     delta = jnp.sum(
@@ -436,15 +465,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
 
     dq, dk, dv = _flash_bwd_bhsd(
         q_b, k_b, v_b, do_b, lse, delta,
+        hq=h, hkv=n_kv,
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     dq = _from_bhsd(dq, b, h)
-    dk = _from_bhsd(dk, b, h)
-    dv = _from_bhsd(dv, b, h)
-    if group > 1:  # fold expanded-head grads back onto the kv heads
-        dk = dk.reshape(b, s, n_kv, group, d).sum(axis=3)
-        dv = dv.reshape(b, s, n_kv, group, d).sum(axis=3)
+    dk = _from_bhsd(dk, b, n_kv)
+    dv = _from_bhsd(dv, b, n_kv)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
