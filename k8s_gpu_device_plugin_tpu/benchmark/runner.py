@@ -51,14 +51,15 @@ def _run_matmul() -> dict:
 BENCH_BATCH, BENCH_SEQ = 8, 2048
 
 
-def _bench_model_cfg(quant: str = "none"):
-    """THE single-chip proxy model both train workloads measure — one
-    definition so the bf16-vs-int8 comparison is always like-for-like."""
+def _bench_model_cfg(quant: str = "none", fused_ce: bool = False):
+    """THE single-chip proxy model every train workload measures — one
+    definition so all variants stay like-for-like."""
     from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 
     return LlamaConfig(
         vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
         n_kv_heads=8, d_ff=8192, max_seq=BENCH_SEQ, quant=quant,
+        fused_ce=fused_ce,
     )
 
 
@@ -70,16 +71,16 @@ def _model_dims(cfg) -> dict:
         "n_heads": cfg.n_heads, "n_kv_heads": cfg.n_kv_heads,
         "d_ff": cfg.d_ff, "vocab_size": cfg.vocab_size,
         "batch_size": BENCH_BATCH, "seq_len": BENCH_SEQ,
-        "quant": cfg.quant,
+        "quant": cfg.quant, "fused_ce": cfg.fused_ce,
     }
 
 
-def _train_result(workload: str, quant: str) -> dict:
-    """Shared train-bench runner so bf16 and int8 stay like-for-like."""
+def _train_result(workload: str, quant: str, fused_ce: bool = False) -> dict:
+    """Shared train-bench runner so all variants stay like-for-like."""
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
 
     _require_accelerator()
-    cfg = _bench_model_cfg(quant=quant)
+    cfg = _bench_model_cfg(quant=quant, fused_ce=fused_ce)
     r = train_mfu(cfg, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ, steps=5, warmup=2)
     return {
         "workload": workload,
@@ -101,6 +102,12 @@ def _run_train_int8() -> dict:
     peak), so >100% of bf16 peak is possible in principle — the honest
     reading is 'bf16-equivalent throughput'."""
     return _train_result("train_int8", quant="int8")
+
+
+def _run_train_fused() -> dict:
+    """Train bench with the fused lm_head+CE (bf16 math, same objective —
+    ops/fused_ce.py); a pure-perf candidate for the primary metric."""
+    return _train_result("train_fused", quant="none", fused_ce=True)
 
 
 def _run_breakdown() -> dict:
@@ -159,6 +166,7 @@ WORKLOADS = {
     "matmul": _run_matmul,
     "train": _run_train,
     "train_int8": _run_train_int8,
+    "train_fused": _run_train_fused,
     "breakdown": _run_breakdown,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,

@@ -64,8 +64,9 @@ def ring_attention(
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v of shape (B, S, H, D).
 
-    K/V may have fewer (grouped) heads; they are expanded locally. Returns
-    (B, S, Hq, D) in q's dtype, sharded like q.
+    K/V may have fewer (grouped) heads: the flash path keeps them grouped
+    end-to-end (smaller ring hops); the einsum fallback expands locally.
+    Returns (B, S, Hq, D) in q's dtype, sharded like q.
     """
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -108,13 +109,14 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
     body when the local shard shapes don't tile the kernel.
     """
     b, lq, h, d = q.shape
-    k = _expand_kv(k, h)
-    v = _expand_kv(v, h)
     lk = k.shape[1]
     my_idx = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     if _flash_ok(lq, lk, d) and lq == lk:
+        # GQA-native: K/V rotate around the ring at their Hkv heads — each
+        # ppermute hop moves group-times-fewer ICI bytes than the expanded
+        # form, and the flash kernel maps q heads onto kv groups itself.
         from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
 
         interpret = jax.default_backend() != "tpu"
@@ -168,6 +170,9 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
         (lse, o, _, _), _ = jax.lax.scan(step, (lse0, o0, k, v), jnp.arange(sp))
         return o.astype(q.dtype)
 
+    # einsum fallback only: expand grouped KV heads to match q's
+    k = _expand_kv(k, h)
+    v = _expand_kv(v, h)
     qf = q.astype(jnp.float32)
     m0 = jnp.full((b, h, lq), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
