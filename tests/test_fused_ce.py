@@ -66,3 +66,45 @@ def test_loss_fn_fused_path_matches_unfused():
     # with_accuracy=True forces the unfused fallback (fused has no logits)
     l_acc, m_acc = loss_fn(params, batch, cfg_f, None, with_accuracy=True)
     assert float(m_acc["accuracy"]) >= 0.0
+
+
+def test_fused_matches_unfused_on_sp_mesh():
+    """Fused path under a real sp-sharded mesh (the reshape folding the
+    sharded S axis into tokens must stay representable, no silent gather
+    of the vocab axis since tp == 1)."""
+    import numpy as np
+
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = LlamaConfig.tiny(n_layers=2, attn_impl="ring")
+    cfg_f = LlamaConfig.tiny(n_layers=2, attn_impl="ring", fused_ce=True)
+    mesh = make_mesh(MeshSpec.for_devices(4, sp=2), jax.devices()[:4])
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.key(1), (4, 65), 0, cfg.vocab_size, jnp.int32
+    )
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+    l_ref, _ = jax.jit(
+        lambda p, b: loss_fn(p, b, cfg, mesh, with_accuracy=False)
+    )(params, batch)
+    l_fused, _ = jax.jit(
+        lambda p, b: loss_fn(p, b, cfg_f, mesh, with_accuracy=False)
+    )(params, batch)
+    assert np.isclose(float(l_ref), float(l_fused), atol=2e-3, rtol=2e-3)
+
+
+def test_fused_ce_with_moe_aux_losses():
+    """MoE + fused CE: aux losses still ride out of the hidden-state path."""
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+
+    cfg = LlamaConfig.tiny(n_layers=2, n_experts=4, fused_ce=True)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+    total, metrics = loss_fn(params, batch, cfg, None, with_accuracy=False)
+    assert "moe_load_balance" in metrics and "moe_router_z" in metrics
+    assert float(total) > float(metrics["loss"]) - 1e-6  # aux terms added
