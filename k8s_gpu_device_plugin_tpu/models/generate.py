@@ -1,0 +1,202 @@
+"""KV-cache autoregressive generation (prefill + jitted decode loop).
+
+TPU-first decode design:
+
+- **Static shapes**: the cache is allocated at ``max_len`` up front and the
+  decode loop is one ``lax.scan`` over steps — one compile, no per-step
+  retrace, position handled by masking (dynamic-slice writes, masked
+  reads). The classic TPU decode shape.
+- **GQA-native cache**: K/V are cached at ``n_kv_heads`` (the same
+  no-expansion rule as ops/flash_attention.py) — a Llama-3-8B cache is
+  4x smaller than a naively expanded one; q heads fold onto their group
+  at score time via a reshape, not a materialized repeat.
+- **bf16 cache, f32 scores/softmax**: matches the training numerics
+  contract (models/llama.py).
+
+The layer math deliberately reuses the training building blocks
+(``rms_norm``/``rope`` and the same weight layout) so the decode block
+cannot drift from ``_block``; the oracle test pins cached decode against
+the full-context training forward exactly.
+
+The reference has no model stack at all (it is a device-plugin daemon,
+SURVEY §2); this completes the workload framework's model-family API
+(train + generate) the rebuilt benchmark ships.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    rms_norm,
+    rope,
+)
+
+
+@dataclass(frozen=True)
+class KVCache:
+    """Per-layer stacked K/V at native kv heads: (L, B, max_len, Hkv, hd)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @staticmethod
+    def init(cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
+        )
+
+
+jax.tree_util.register_dataclass(KVCache, ("k", "v"), ())
+
+
+def _cached_attention(q, k_cache, v_cache, length, cfg: LlamaConfig):
+    """q: (B, T, Hq, hd) attends over cache[:, :max_len] masked to
+    positions < length + T (rows are the T new tokens at absolute
+    positions length..length+T-1). All-f32 softmax."""
+    b, t, hq, hd = q.shape
+    max_len = k_cache.shape[1]
+    group = hq // cfg.n_kv_heads
+    # bf16 operands + f32 accumulation (MXU native rate); the bf16 cache is
+    # never upcast in HBM — decode is bandwidth-bound.
+    qg = q.reshape(b, t, cfg.n_kv_heads, group, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->btkgs", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * (hd ** -0.5)
+    q_pos = length + jnp.arange(t)[None, :, None, None, None]
+    k_pos = jnp.arange(max_len)[None, None, None, None, :]
+    scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)  # f32
+    out = jnp.einsum(
+        "btkgs,bskd->btkgd", probs.astype(q.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, hq, hd).astype(q.dtype)
+
+
+def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
+    """One transformer block over T new tokens with cache read+write.
+
+    Returns (x_out, k_cache, v_cache) with the new tokens' K/V written at
+    ``length + arange(T)``. Same algebra as the training ``_block``
+    (models/llama.py) minus sharding annotations and MoE (dense decode)."""
+    b, t, d = x.shape
+    hd = cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, length, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, length, 0, 0)
+    )
+
+    attn = _cached_attention(q, k_cache, v_cache, length, cfg)
+    x = x + (attn.reshape(b, t, cfg.n_heads * hd) @ layer["wo"])
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+    up = h @ layer["w3"]
+    x = x + ((gate * up) @ layer["w2"])
+    return x, k_cache, v_cache
+
+
+def _forward_cached(
+    params, tokens, cache: KVCache, length, cfg: LlamaConfig,
+    last_only: bool = False,
+):
+    """Run T tokens (starting at absolute position ``length``) through all
+    layers with cache update. Returns (logits (B, T, V) f32, new cache);
+    ``last_only`` projects only the final position (prefill wants one
+    next-token distribution, not a (B, P, V) logits tensor)."""
+    b, t = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = length + jnp.arange(t, dtype=jnp.int32)
+
+    def body(carry, layer_and_cache):
+        x = carry
+        layer, k_c, v_c = layer_and_cache
+        x, k_c, v_c = _decode_block(
+            x, layer, k_c, v_c, length, positions, cfg
+        )
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:]
+    logits = jnp.dot(
+        x, params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def prefill(params, prompt, cache: KVCache, cfg: LlamaConfig):
+    """Prompt (B, P) -> (last-position logits (B, V), filled cache)."""
+    logits, cache = _forward_cached(params, prompt, cache, 0, cfg, last_only=True)
+    return logits[:, -1], cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature"))
+def generate(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new: int,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled generation.
+
+    prompt: (B, P) int32; returns (B, max_new) generated ids. One compile:
+    prefill over the prompt, then a scanned single-token decode loop
+    against the static-size cache.
+    """
+    if cfg.is_moe:
+        raise NotImplementedError("decode path is dense-only for now")
+    b, p = prompt.shape
+    cache = KVCache.init(cfg, b, p + max_new)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    key = key if key is not None else jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    def step(carry, i):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        tok = pick(logits, sub)                       # (B,)
+        logits, cache = _forward_cached(
+            params, tok[:, None], cache, p + i, cfg
+        )
+        return (logits[:, -1], cache, key), tok
+
+    # max_new - 1 cached forwards; the final token needs only a pick from
+    # the last carried logits (no wasted trailing forward).
+    (logits, _, key), toks = jax.lax.scan(
+        step, (logits, cache, key), jnp.arange(max_new - 1)
+    )
+    key, sub = jax.random.split(key)
+    last = pick(logits, sub)[None]                    # (1, B)
+    toks = jnp.concatenate([toks, last], axis=0)
+    return toks.T                                     # (B, max_new)
