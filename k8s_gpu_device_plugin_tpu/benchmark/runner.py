@@ -113,17 +113,57 @@ def _run_train_fused() -> dict:
 def _run_breakdown() -> dict:
     """Differential step-time breakdown on the bench proxy model (dev tool;
     not part of the driver's JSON line — run via
-    ``python -m ...benchmark.runner breakdown``)."""
+    ``python -m ...benchmark.runner breakdown``). The XLA-reference-attention
+    variant is excluded here — its compile+run alone can eat a 10-minute
+    budget at the bench shape; run ``breakdown_attn`` for that comparison."""
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
         step_breakdown,
     )
 
     _require_accelerator()
-    r = step_breakdown(_bench_model_cfg(), BENCH_BATCH, BENCH_SEQ)
+    r = step_breakdown(
+        _bench_model_cfg(), BENCH_BATCH, BENCH_SEQ, repeats=2,
+        variants=("full", "fwd_bwd", "fwd", "dummy_loss"),
+    )
     return {
         "workload": "breakdown",
         "variants_ms": {k: round(v, 1) for k, v in r.variants_ms.items()},
         "attributed_ms": {k: round(v, 1) for k, v in r.attributed_ms.items()},
+    }
+
+
+def _run_breakdown_attn() -> dict:
+    """Flash-vs-XLA attention comparison only (slow: the XLA path
+    materializes (B, H, S, S) f32 scores)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
+        step_breakdown,
+    )
+
+    _require_accelerator()
+    r = step_breakdown(
+        _bench_model_cfg(), BENCH_BATCH, BENCH_SEQ, repeats=2,
+        variants=("fwd_bwd", "ref_attn"),
+    )
+    return {
+        "workload": "breakdown_attn",
+        "variants_ms": {k: round(v, 1) for k, v in r.variants_ms.items()},
+        "attributed_ms": {k: round(v, 1) for k, v in r.attributed_ms.items()},
+    }
+
+
+def _run_flash_tune() -> dict:
+    """Flash-kernel block-size sweep at the bench attention shape."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.flash_tune import flash_tune
+
+    _require_accelerator()
+    r = flash_tune()
+    return {
+        "workload": "flash_tune",
+        "shape": list(r.shape),
+        "fwd_ms": {k: round(v, 2) for k, v in r.fwd_ms.items()},
+        "bwd_ms": {k: round(v, 2) for k, v in r.bwd_ms.items()},
+        "best_fwd": r.best_fwd,
+        "best_bwd": r.best_bwd,
     }
 
 
@@ -168,6 +208,8 @@ WORKLOADS = {
     "train_int8": _run_train_int8,
     "train_fused": _run_train_fused,
     "breakdown": _run_breakdown,
+    "breakdown_attn": _run_breakdown_attn,
+    "flash_tune": _run_flash_tune,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
 }

@@ -1,0 +1,109 @@
+"""Flash-attention block-size sweep (kernel tuning aux workload).
+
+Times the Pallas flash kernels — forward alone and forward+backward — at
+the train-bench attention shape over a grid of (block_q, block_k) tilings,
+so the DEFAULT_BLOCK_* constants in ops/flash_attention.py are measured
+facts, not guesses. Methodology matches matmul_mfu: the timed quantity is
+a jitted scalar whose fetch serializes the whole computation (relay-safe),
+best-of-N.
+
+Run: python -m k8s_gpu_device_plugin_tpu.benchmark.runner flash_tune
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
+    _time_scalar_fn,
+)
+from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
+
+
+@dataclass(frozen=True)
+class FlashTuneResult:
+    shape: tuple          # (B, S, Hq, Hkv, D)
+    fwd_ms: dict          # "bq x bk" -> best-of-N ms
+    bwd_ms: dict          # "bq x bk" (backward tiling) -> best-of-N ms
+    best_fwd: str
+    best_bwd: str
+
+
+def _time_scalar(fn, args, repeats: int) -> float:
+    # same relay-safe methodology as step_breakdown (shared helper)
+    return _time_scalar_fn(jax.jit(fn), args, repeats)
+
+
+def flash_tune(
+    batch: int = 8,
+    seq: int = 2048,
+    n_heads: int = 16,
+    n_kv_heads: int = 8,
+    head_dim: int = 128,
+    blocks: tuple[tuple[int, int], ...] = (
+        (1024, 1024), (1024, 512), (512, 1024), (512, 512),
+        (256, 1024), (2048, 512), (512, 2048), (256, 512),
+    ),
+    repeats: int = 5,
+    iters: int = 8,
+) -> FlashTuneResult:
+    key = jax.random.key(0)
+    kq, kk, kv, kd = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (batch, seq, n_heads, head_dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, seq, n_kv_heads, head_dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, seq, n_kv_heads, head_dim), jnp.bfloat16)
+    do = jax.random.normal(kd, q.shape, jnp.bfloat16)
+
+    fwd_ms: dict[str, float] = {}
+    bwd_ms: dict[str, float] = {}
+    for bq, bk in blocks:
+        if seq % bq or seq % bk:
+            continue
+        label = f"{bq}x{bk}"
+
+        # forward: scan-amortized so per-call overhead cannot dominate
+        def fwd_scalar(q, k, v, _bq=bq, _bk=bk):
+            def body(c, _):
+                o = flash_attention(q, k, v, causal=True, block_q=_bq, block_k=_bk)
+                return c + jnp.sum(o.astype(jnp.float32)) * 1e-9, None
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+            return c
+
+        fwd_ms[label] = _time_scalar(
+            fwd_scalar, (q, k, v), repeats
+        ) / iters * 1000
+
+        # fwd+bwd with FIXED (default) fwd tiling: isolates the backward
+        # tiling's effect. Grads wrt ALL of q/k/v — dq and dk/dv are two
+        # separate Pallas kernels; grad-wrt-q-only would let XLA DCE the
+        # dkv kernel, the very one the sweep exists to tune.
+        def bwd_scalar(q, k, v, do, _bq=bq, _bk=bk):
+            def one(q, k, v):
+                o = flash_attention(
+                    q, k, v, causal=True,
+                    block_q_bwd=_bq, block_k_bwd=_bk,
+                )
+                return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
+
+            def body(c, _):
+                dq, dk, dv = jax.grad(one, argnums=(0, 1, 2))(q, k, v)
+                fold = sum(g.astype(jnp.float32).sum() for g in (dq, dk, dv))
+                return c + fold * 1e-9, None
+
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+            return c
+
+        bwd_ms[label] = _time_scalar(
+            bwd_scalar, (q, k, v, do), repeats
+        ) / iters * 1000
+
+    return FlashTuneResult(
+        shape=(batch, seq, n_heads, n_kv_heads, head_dim),
+        fwd_ms=fwd_ms,
+        bwd_ms=bwd_ms,
+        best_fwd=min(fwd_ms, key=fwd_ms.get),
+        best_bwd=min(bwd_ms, key=bwd_ms.get),
+    )

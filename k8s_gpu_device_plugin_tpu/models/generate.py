@@ -170,6 +170,11 @@ def generate(
     """
     if cfg.is_moe:
         raise NotImplementedError("decode path is dense-only for now")
+    if cfg.quant != "none":
+        # _decode_block runs plain bf16 matmuls; silently accepting an int8
+        # config would decode with different numerics than the training
+        # forward and greedy tokens could drift from the full-context oracle.
+        raise NotImplementedError("decode path is bf16-only (quant='none')")
     b, p = prompt.shape
     cache = KVCache.init(cfg, b, p + max_new)
     logits, cache = prefill(params, prompt, cache, cfg)

@@ -43,9 +43,14 @@ except ImportError:  # pragma: no cover
 # Tuned on v5e (scan-amortized timing, S=2048 fwd): (1024, 1024) sustains
 # ~31 TF/s vs ~17 at (128, 512); VMEM at (1024, 1024, d=128) is ~6MB of
 # blocks + scores, comfortably inside v5e's 128MB. _fit_block shrinks the
-# blocks for short sequences.
+# blocks for short sequences. The backward kernels hold more operands per
+# grid cell (q, k, v, do + two accumulators), so they are tiled
+# independently — sweep via benchmark/workloads/flash_tune.py; defaults
+# match the forward until a hardware sweep says otherwise.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+DEFAULT_BLOCK_Q_BWD = 1024
+DEFAULT_BLOCK_K_BWD = 1024
 _NEG_BIG = -1e30
 
 
@@ -197,12 +202,8 @@ def _from_bhsd(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_core(q, k, v, scale, causal, block_q, block_k, interpret)
-
-
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret):
     o, _ = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
     return o
 
@@ -218,7 +219,8 @@ def _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
     return _from_bhsd(o, b, h), lse  # lse stays (BH, S, 1)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
+               interpret):
     o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
@@ -230,27 +232,30 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # delta := rowsum(do*o) - dlse.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
+               interpret):
     o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
     b, s, h, d = q.shape
     return o, lse.reshape(b, h, s)
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
+                   interpret):
     o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
     b, s, h, d = q.shape
     return (o, lse.reshape(b, h, s)), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd(scale, causal, block_q, block_k, interpret, residuals, cts):
+def _flash_lse_bwd(scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret,
+                   residuals, cts):
     do, dlse = cts
     q, k, v, o, lse = residuals
     b, s, h, d = q.shape
     dlse_col = dlse.astype(jnp.float32).reshape(b * h, s, 1)
     return _flash_bwd_impl(
         q, k, v, o, lse, do, dlse_col,
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, block_q=bq_bwd, block_k=bk_bwd,
         interpret=interpret,
     )
 
@@ -475,11 +480,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
+def _flash_bwd(scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret,
+               residuals, do):
     q, k, v, o, lse = residuals
     return _flash_bwd_impl(
         q, k, v, o, lse, do, None,
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, block_q=bq_bwd, block_k=bk_bwd,
         interpret=interpret,
     )
 
@@ -504,6 +510,8 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
     interpret: bool = False,
     return_lse: bool = False,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
@@ -511,6 +519,10 @@ def flash_attention(
 
     With ``return_lse`` also returns the per-row logsumexp (B, H, S) f32 —
     differentiable, for blockwise softmax merging (ring attention).
+
+    ``block_q_bwd``/``block_k_bwd`` tile the backward kernels independently
+    of the forward (None = tuned defaults); the backward holds more VMEM
+    operands per cell, so its optimum differs.
 
     Raises on shapes the kernel cannot tile (the grid drops tail rows, so a
     silent fallthrough would return uninitialized output): use
@@ -520,11 +532,16 @@ def flash_attention(
     s = q.shape[1]
     block_q = _fit_block(block_q, s)
     block_k = _fit_block(block_k, s)
+    bq_bwd = _fit_block(block_q_bwd or DEFAULT_BLOCK_Q_BWD, s)
+    bk_bwd = _fit_block(block_k_bwd or DEFAULT_BLOCK_K_BWD, s)
     if s % block_q != 0 or s % block_k != 0:
         raise ValueError(
             f"flash_attention: seq_len {s} not divisible by blocks "
             f"({block_q}, {block_k}); pad the sequence or use ops.attention"
         )
     if return_lse:
-        return _flash_lse(q, k, v, scale, causal, block_q, block_k, interpret)
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+        return _flash_lse(
+            q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret
+        )
+    return _flash(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
+                  interpret)

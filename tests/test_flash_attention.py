@@ -60,13 +60,15 @@ def test_flash_grads_match_reference():
 def test_flash_grads_multiblock(bq, bk):
     """Exercise the backward kernels' cross-block accumulation and causal
     block-skip paths (nq>1 and/or nk>1), which the 1024 defaults reduce to
-    a single block at test sizes."""
+    a single block at test sizes. The backward is tiled independently of
+    the forward (block_*_bwd), so both are pinned here."""
     q, k, v = make_qkv(jax.random.key(5), s=256)
 
     def loss_flash(q, k, v):
         return jnp.sum(
             flash_attention(
-                q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                block_q_bwd=bq, block_k_bwd=bk, interpret=True
             )
             ** 2
         )
@@ -121,3 +123,29 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(expected, np.float32), atol=3e-2
     )
+
+
+def test_flash_bwd_blocks_differ_from_fwd():
+    """Backward tiling independent of forward: grads must match the oracle
+    when the two tilings disagree (the fwd lse/residuals feed bwd kernels
+    tiled differently)."""
+    q, k, v = make_qkv(jax.random.key(7), s=256)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=256, block_k=256,
+                block_q_bwd=128, block_k_bwd=128, interpret=True,
+            )
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )

@@ -64,3 +64,18 @@ def test_sampled_generate_shapes_and_determinism():
     # different key must change the sample (near-uniform random-init model;
     # a constant-key bug would make these identical)
     assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_generate_rejects_quantized_config():
+    """int8 configs must be refused: the decode block is bf16-only and
+    silently decoding with different numerics than training would let
+    greedy tokens drift from the full-context oracle."""
+    import pytest
+
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(n_layers=1, quant="int8")
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(NotImplementedError, match="bf16-only"):
+        generate(params, prompt, cfg, max_new=2)
