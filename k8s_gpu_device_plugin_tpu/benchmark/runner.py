@@ -167,6 +167,32 @@ def _run_flash_tune() -> dict:
     }
 
 
+def _run_decode() -> dict:
+    """KV-cache decode throughput on the bench proxy model (serving-side
+    companion to the train bench; reports prefill latency, tokens/s and
+    achieved HBM bandwidth vs peak)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
+        decode_bench,
+    )
+
+    _require_accelerator()
+    cfg = _bench_model_cfg()
+    r = decode_bench(cfg, batch=8, prompt_len=512, new_tokens=64)
+    return {
+        "workload": "decode",
+        "prefill_ms": round(r.prefill_ms, 1),
+        "decode_tokens_per_second": round(r.decode_tokens_per_second, 1),
+        "decode_step_ms": round(r.decode_step_ms, 2),
+        "hbm_gb_per_second": round(r.hbm_gb_per_second, 1),
+        "hbm_util_pct": round(r.hbm_util_pct, 1),
+        "model": _model_dims(cfg),
+        "decode_shape": {
+            "batch": r.batch, "prompt_len": r.prompt_len,
+            "new_tokens": r.new_tokens,
+        },
+    }
+
+
 def _run_roundtrip() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
         control_plane_roundtrip,
@@ -210,6 +236,7 @@ WORKLOADS = {
     "breakdown": _run_breakdown,
     "breakdown_attn": _run_breakdown_attn,
     "flash_tune": _run_flash_tune,
+    "decode": _run_decode,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
 }

@@ -1,0 +1,117 @@
+"""Decode throughput benchmark: prefill latency + steady-state tokens/s.
+
+Serving-side companion to train_bench: measures the KV-cache generation
+path (models/generate.py) on the bench proxy model. Decode is HBM-
+bandwidth-bound (every step streams all params + the cache), so alongside
+tokens/s this reports achieved bandwidth as a fraction of the chip's HBM
+peak — the decode analogue of train MFU.
+
+Methodology matches matmul_mfu: jitted end-to-end generate (one compile),
+timed around a device fetch so a relayed chip cannot return early;
+best-of-N over full generate calls.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import detect_generation
+from k8s_gpu_device_plugin_tpu.device.topology import GENERATIONS
+from k8s_gpu_device_plugin_tpu.models.generate import KVCache, generate, prefill
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+
+
+@dataclass(frozen=True)
+class DecodeBenchResult:
+    prefill_ms: float          # prompt -> first-token logits latency
+    decode_tokens_per_second: float
+    decode_step_ms: float      # per generated token (all B rows in parallel)
+    hbm_gb_per_second: float   # achieved: (params + cache) streamed per step
+    hbm_util_pct: float        # vs generation peak HBM bandwidth
+    batch: int
+    prompt_len: int
+    new_tokens: int
+
+
+def _param_bytes(cfg: LlamaConfig, batch: int) -> int:
+    """Bytes actually streamed per decode step: every weight matmul reads
+    its full operand, but the embed table is a B-row GATHER (llama.py's
+    FLOPs accounting makes the same distinction) — only lm_head reads the
+    full (d, vocab)."""
+    d, f, L, hd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.head_dim
+    attn = 2 * d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
+    mlp = 3 * d * f
+    norms = 2 * d
+    per_layer = attn + mlp + norms
+    total = L * per_layer + cfg.vocab_size * d + d + batch * d
+    return total * 2  # bf16
+
+
+def decode_bench(
+    cfg: LlamaConfig,
+    batch: int = 8,
+    prompt_len: int = 512,
+    new_tokens: int = 64,
+    repeats: int = 3,
+    devices: list | None = None,
+) -> DecodeBenchResult:
+    devices = devices or jax.devices()
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+
+    # prefill latency: its own jitted call (generate fuses it away)
+    cache = KVCache.init(cfg, batch, prompt_len + new_tokens)
+    pre = jax.jit(lambda pr, c: prefill(params, pr, c, cfg)[0])
+    float(pre(prompt, cache)[0, 0])  # compile + warm
+    best_pre = float("inf")
+    for _ in range(repeats):
+        t = time.perf_counter()
+        float(pre(prompt, cache)[0, 0])
+        best_pre = min(best_pre, time.perf_counter() - t)
+
+    int(generate(params, prompt, cfg, max_new=new_tokens)[0, 0])  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t = time.perf_counter()
+        int(generate(params, prompt, cfg, max_new=new_tokens)[0, 0])
+        best = min(best, time.perf_counter() - t)
+
+    # steady-state decode: subtract the measured prefill from the full call.
+    # A non-positive difference means the two measurements are inconsistent
+    # (noise on a relayed chip, tiny new_tokens) — refuse to report absurd
+    # throughput from it.
+    decode_seconds = best - best_pre
+    if decode_seconds <= 0:
+        raise RuntimeError(
+            f"inconsistent timing: full generate ({best * 1000:.1f} ms) <= "
+            f"prefill alone ({best_pre * 1000:.1f} ms); increase new_tokens "
+            "or repeats"
+        )
+    step_seconds = decode_seconds / new_tokens
+    tokens_per_second = batch * new_tokens / decode_seconds
+
+    # per decode step the chip streams all params once (batch rows share
+    # them) + the K/V cache once; activations are negligible at T=1
+    cache_bytes = (
+        2 * cfg.n_layers * batch * (prompt_len + new_tokens)
+        * cfg.n_kv_heads * cfg.head_dim * 2
+    )
+    gbps = (_param_bytes(cfg, batch) + cache_bytes) / step_seconds / 1e9
+    gen = GENERATIONS[detect_generation(devices[0])]
+    peak_gbps = gen.hbm_bandwidth_gbps
+    return DecodeBenchResult(
+        prefill_ms=best_pre * 1000,
+        decode_tokens_per_second=tokens_per_second,
+        decode_step_ms=step_seconds * 1000,
+        hbm_gb_per_second=gbps,
+        hbm_util_pct=100.0 * gbps / peak_gbps,
+        batch=batch,
+        prompt_len=prompt_len,
+        new_tokens=new_tokens,
+    )

@@ -64,11 +64,15 @@ def flash_tune(
             continue
         label = f"{bq}x{bk}"
 
-        # forward: scan-amortized so per-call overhead cannot dominate
+        # forward: scan-amortized so per-call overhead cannot dominate. The
+        # carry must FEED the kernel input (q + c*0) or the loop body is
+        # invariant and XLA's LICM hoists the kernel out of the scan,
+        # under-reporting time by up to iters x (matmul_mfu's `c @ b` trick).
         def fwd_scalar(q, k, v, _bq=bq, _bk=bk):
             def body(c, _):
-                o = flash_attention(q, k, v, causal=True, block_q=_bq, block_k=_bk)
-                return c + jnp.sum(o.astype(jnp.float32)) * 1e-9, None
+                qc = q + (c * 0).astype(q.dtype)
+                o = flash_attention(qc, k, v, causal=True, block_q=_bq, block_k=_bk)
+                return jnp.sum(o.astype(jnp.float32)) * 1e-9, None
             c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
             return c
 
@@ -89,9 +93,10 @@ def flash_tune(
                 return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))
 
             def body(c, _):
-                dq, dk, dv = jax.grad(one, argnums=(0, 1, 2))(q, k, v)
+                qc = q + (c * 0).astype(q.dtype)  # defeat LICM (see fwd)
+                dq, dk, dv = jax.grad(one, argnums=(0, 1, 2))(qc, k, v)
                 fold = sum(g.astype(jnp.float32).sum() for g in (dq, dk, dv))
-                return c + fold * 1e-9, None
+                return fold * 1e-9, None
 
             c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
             return c

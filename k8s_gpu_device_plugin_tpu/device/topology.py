@@ -31,15 +31,16 @@ class TpuGeneration:
     ici_dims: int                # 2 => 2D mesh/torus (v5e/v6e), 3 => 3D (v4/v5p)
     default_host_shape: tuple[int, ...]   # chips per host as a mesh
     ici_link_gbps: float         # per link per direction, approximate public figure
+    hbm_bandwidth_gbps: float = 819.0   # per chip, approximate public figure
 
 
 _GB = 1024**3
 
 GENERATIONS: dict[str, TpuGeneration] = {
-    "v4": TpuGeneration("v4", 32 * _GB, 275.0, 2, 3, (2, 2, 1), 50.0),
-    "v5e": TpuGeneration("v5e", 16 * _GB, 197.0, 1, 2, (2, 4), 50.0),
-    "v5p": TpuGeneration("v5p", 95 * _GB, 459.0, 2, 3, (2, 2, 1), 100.0),
-    "v6e": TpuGeneration("v6e", 32 * _GB, 918.0, 1, 2, (2, 4), 100.0),
+    "v4": TpuGeneration("v4", 32 * _GB, 275.0, 2, 3, (2, 2, 1), 50.0, 1228.0),
+    "v5e": TpuGeneration("v5e", 16 * _GB, 197.0, 1, 2, (2, 4), 50.0, 819.0),
+    "v5p": TpuGeneration("v5p", 95 * _GB, 459.0, 2, 3, (2, 2, 1), 100.0, 2765.0),
+    "v6e": TpuGeneration("v6e", 32 * _GB, 918.0, 1, 2, (2, 4), 100.0, 1640.0),
 }
 
 # Well-known mesh shapes for a given (generation, chip count). Chip counts not

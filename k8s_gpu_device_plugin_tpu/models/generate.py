@@ -36,6 +36,7 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     rms_norm,
     rope,
 )
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
 
 
 @dataclass(frozen=True)
@@ -153,7 +154,7 @@ def prefill(params, prompt, cache: KVCache, cfg: LlamaConfig):
     return logits[:, -1], cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature"))
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "sampler"))
 def generate(
     params,
     prompt: jax.Array,
@@ -161,12 +162,16 @@ def generate(
     max_new: int,
     key: jax.Array | None = None,
     temperature: float = 0.0,
+    sampler: "Sampler | None" = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled generation.
 
     prompt: (B, P) int32; returns (B, max_new) generated ids. One compile:
     prefill over the prompt, then a scanned single-token decode loop
     against the static-size cache.
+
+    ``sampler`` (models/sampling.py) gives top-k/top-p control; the plain
+    ``temperature`` arg is shorthand for ``Sampler(temperature=...)``.
     """
     if cfg.is_moe:
         raise NotImplementedError("decode path is dense-only for now")
@@ -175,17 +180,22 @@ def generate(
         # config would decode with different numerics than the training
         # forward and greedy tokens could drift from the full-context oracle.
         raise NotImplementedError("decode path is bf16-only (quant='none')")
+    if sampler is None:
+        sampler = Sampler(temperature=temperature)
+    elif temperature != 0.0:
+        # Both given: the sampler would silently win and e.g.
+        # generate(..., temperature=0.8, sampler=Sampler(top_k=50)) would
+        # decode greedily (Sampler's temperature defaults to 0).
+        raise ValueError(
+            "pass temperature inside the Sampler, not alongside it"
+        )
     b, p = prompt.shape
     cache = KVCache.init(cfg, b, p + max_new)
     logits, cache = prefill(params, prompt, cache, cfg)
     key = key if key is not None else jax.random.key(0)
 
     def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        return sample_logits(logits, key, sampler)
 
     def step(carry, i):
         logits, cache, key = carry

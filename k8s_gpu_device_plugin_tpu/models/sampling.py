@@ -1,0 +1,83 @@
+"""Token samplers for autoregressive decode (greedy / temperature / top-k /
+top-p), as a static, hashable config so ``generate`` stays one compile.
+
+TPU-first shape: everything is fixed-shape tensor algebra over the (B, V)
+logits — ``top_k`` uses ``lax.top_k`` and a threshold compare rather than
+scatter; ``top_p`` sorts once and masks by exclusive cumulative probability.
+No data-dependent control flow, so the sampler composes with ``lax.scan``
+decode loops and pjit.
+
+The reference daemon has no sampling analogue (SURVEY §2); this belongs to
+the model-family API of the workload stack (train + generate + sample).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+@dataclass(frozen=True)
+class Sampler:
+    """Static sampling config (hashable: usable as a jit static arg).
+
+    Applied in the standard order: temperature -> top_k -> top_p ->
+    categorical. ``temperature == 0`` is exact greedy (argmax) and ignores
+    the other knobs. ``top_k == 0`` / ``top_p >= 1.0`` disable those filters.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k largest logits per row; mask the rest to -inf."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]        # (B, 1) k-th largest
+    return jnp.where(logits >= kth, logits, _NEG)
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: smallest set of tokens with cumulative prob >= p.
+
+    Uses the EXCLUSIVE cumulative sum over descending-sorted probabilities,
+    so the token that crosses the threshold is kept (the set always reaches
+    >= p and is never empty) — the standard nucleus-sampling boundary rule.
+    """
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]           # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs                       # exclusive
+    keep_sorted = cum < p                                          # (B, V)
+    # threshold logit: smallest kept logit per row
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= kth, logits, _NEG)
+
+
+def sample_logits(logits: jax.Array, key: jax.Array, sampler: Sampler) -> jax.Array:
+    """(B, V) f32 logits -> (B,) int32 token ids."""
+    if sampler.is_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / sampler.temperature
+    if sampler.top_k > 0:
+        logits = _apply_top_k(logits, min(sampler.top_k, logits.shape[-1]))
+    if sampler.top_p < 1.0:
+        logits = _apply_top_p(logits, sampler.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -91,3 +91,20 @@ def test_step_breakdown_cpu():
         r.attributed_ms
     )
     assert r.flops_per_step > 0
+
+
+def test_decode_bench_cpu_smoke():
+    """decode_bench end-to-end on CPU with a tiny config: positive numbers,
+    sane shapes, prefill < full-generate time accounting holds."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
+        decode_bench,
+    )
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    r = decode_bench(cfg, batch=2, prompt_len=16, new_tokens=4, repeats=1)
+    assert r.decode_tokens_per_second > 0
+    assert r.decode_step_ms > 0
+    assert r.prefill_ms > 0
+    assert r.hbm_gb_per_second > 0
+    assert r.batch == 2 and r.prompt_len == 16 and r.new_tokens == 4

@@ -1,0 +1,104 @@
+"""Samplers: greedy/temperature/top-k/top-p semantics and generate wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    _apply_top_k,
+    _apply_top_p,
+    sample_logits,
+)
+
+
+def test_greedy_is_argmax():
+    logits = jnp.array([[0.1, 3.0, -1.0], [5.0, 0.0, 4.9]])
+    toks = sample_logits(logits, jax.random.key(0), Sampler())
+    np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+
+
+def test_top_k_masks_all_but_k():
+    logits = jnp.array([[1.0, 5.0, 3.0, 2.0]])
+    masked = _apply_top_k(logits, 2)
+    # tokens 1 (5.0) and 2 (3.0) survive; the rest are -inf-ish
+    assert np.asarray(masked[0, 1]) == 5.0
+    assert np.asarray(masked[0, 2]) == 3.0
+    assert np.asarray(masked[0, 0]) < -1e29
+    assert np.asarray(masked[0, 3]) < -1e29
+
+
+def test_top_k_sampling_never_leaves_the_set():
+    logits = jnp.tile(jnp.array([[0.0, 10.0, 9.0, 8.0]]), (64, 1))
+    keys = jax.random.split(jax.random.key(1), 64)
+    toks = jax.vmap(
+        lambda l, k: sample_logits(l[None], k, Sampler(temperature=5.0, top_k=2))
+    )(logits, keys)
+    assert set(np.asarray(toks).ravel().tolist()) <= {1, 2}
+
+
+def test_top_p_keeps_threshold_crosser():
+    # probs ~ [0.97, 0.02, ...]: top_p=0.5 must keep exactly the top token
+    logits = jnp.array([[10.0, 6.0, 1.0, 0.0]])
+    masked = _apply_top_p(logits, 0.5)
+    assert np.asarray(masked[0, 0]) == 10.0
+    assert np.asarray(masked[0, 1]) < -1e29
+    # top_p just over the top token's mass keeps the second as well
+    masked2 = _apply_top_p(logits, 0.99)
+    assert np.asarray(masked2[0, 1]) == 6.0
+
+
+def test_top_p_never_empty():
+    """Even tiny p keeps the single highest-probability token."""
+    logits = jnp.array([[2.0, 1.0, 0.0]])
+    masked = _apply_top_p(logits, 1e-6)
+    toks = sample_logits(
+        logits, jax.random.key(0), Sampler(temperature=1.0, top_p=1e-6)
+    )
+    assert np.asarray(masked[0, 0]) == 2.0
+    np.testing.assert_array_equal(np.asarray(toks), [0])
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError):
+        Sampler(temperature=-1.0)
+    with pytest.raises(ValueError):
+        Sampler(top_k=-1)
+    with pytest.raises(ValueError):
+        Sampler(top_p=0.0)
+    with pytest.raises(ValueError):
+        Sampler(top_p=1.5)
+
+
+def test_generate_accepts_sampler():
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(n_layers=1)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((2, 8), jnp.int32)
+    toks = generate(
+        params, prompt, cfg, max_new=4, key=jax.random.key(3),
+        sampler=Sampler(temperature=0.8, top_k=50, top_p=0.9),
+    )
+    assert toks.shape == (2, 4)
+    assert toks.dtype == jnp.int32
+    # greedy via sampler matches greedy via temperature=0 shorthand
+    g1 = generate(params, prompt, cfg, max_new=4)
+    g2 = generate(params, prompt, cfg, max_new=4, sampler=Sampler())
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_generate_rejects_temperature_and_sampler():
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(n_layers=1)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="inside the Sampler"):
+        generate(
+            params, prompt, cfg, max_new=2,
+            temperature=0.8, sampler=Sampler(top_k=50),
+        )
