@@ -126,18 +126,67 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     with_accuracy: bool = True,
+    grad_accum: int = 1,
 ) -> Callable:
     """Build the jitted (state, batch) -> (state, metrics) step.
 
     ``with_accuracy=False`` drops the accuracy argmax from the step (one
-    full pass over the f32 logits) for throughput benchmarking."""
+    full pass over the f32 logits) for throughput benchmarking.
+
+    ``grad_accum=A`` splits the batch's leading axis into A microbatches
+    and runs them through one ``lax.scan`` (one compile of the fwd+bwd,
+    A sequential executions), accumulating gradients in f32 before a
+    single optimizer update — the standard large-model recipe for fitting
+    a big global batch in HBM. The batch size must divide by A, and the
+    per-microbatch size must still divide the mesh's (dp, fsdp) extent.
+    Loss/accuracy are means over microbatches. For dense configs the
+    objective is identical to the unaccumulated step (every microbatch is
+    a uniform mean over equally many tokens); for MoE configs the router
+    aux losses are batch-level nonlinear statistics, so they are computed
+    PER MICROBATCH and averaged — the same semantics the pipelined path
+    uses (llama.py pipeline note), not the full-batch value."""
+
+    grad_fn = jax.value_and_grad(
+        partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=with_accuracy),
+        has_aux=True,
+    )
 
     def step(state, batch):
-        grad_fn = jax.value_and_grad(
-            partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=with_accuracy),
-            has_aux=True,
-        )
-        (_, metrics), grads = grad_fn(state["params"], batch)
+        if grad_accum == 1:
+            (_, metrics), grads = grad_fn(state["params"], batch)
+        else:
+            b = batch["inputs"].shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch size {b} not divisible by grad_accum {grad_accum}"
+                )
+            micro = jax.tree.map(
+                lambda x: x.reshape(grad_accum, b // grad_accum, *x.shape[1:]),
+                batch,
+            )
+            micro = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP))
+                ),
+                micro,
+            )
+
+            def accum_body(acc, mb):
+                (_, m), g = grad_fn(state["params"], mb)
+                acc = jax.tree.map(
+                    lambda a, gi: a + gi.astype(jnp.float32), acc, g
+                )
+                return acc, m
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            acc, metrics_stacked = jax.lax.scan(accum_body, zeros, micro)
+            grads = jax.tree.map(
+                lambda a, p: (a / grad_accum).astype(p.dtype),
+                acc, state["params"],
+            )
+            metrics = jax.tree.map(jnp.mean, metrics_stacked)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

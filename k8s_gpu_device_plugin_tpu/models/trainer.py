@@ -45,6 +45,8 @@ class TrainerConfig:
     num_slices: int = 1
     batch_size: int = 8
     seq_len: int = 128
+    # microbatches per optimizer update (1 = no accumulation)
+    grad_accum: int = 1
     total_steps: int = 20
     learning_rate: float = 3e-4
     warmup_steps: int = 100
@@ -99,6 +101,7 @@ class Trainer:
         self.step_fn = make_train_step(
             cfg.model, self.mesh, self.optimizer,
             with_accuracy=not cfg.model.fused_ce,
+            grad_accum=cfg.grad_accum,
         )
         self.loader = loader or DataLoader(
             SyntheticSource(cfg.model.vocab_size),
@@ -207,6 +210,9 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batchSize", type=int, default=8)
     parser.add_argument("--seqLen", type=int, default=128)
+    parser.add_argument("--gradAccum", type=int, default=1,
+                        help="microbatches per optimizer update (splits the "
+                        "batch; grads accumulate in f32)")
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1)
@@ -246,6 +252,7 @@ def _main(argv: list[str] | None = None) -> int:
         num_slices=args.numSlices,
         batch_size=args.batchSize,
         seq_len=args.seqLen,
+        grad_accum=args.gradAccum,
         total_steps=args.steps,
         checkpoint_dir=args.checkpointDir,
         checkpoint_interval=args.checkpointInterval,

@@ -179,3 +179,10 @@ def test_trainer_writes_profiler_trace(tmp_path):
 
     dumps = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
     assert dumps, "no xplane trace written"
+
+
+def test_trainer_grad_accum_wiring():
+    """grad_accum flows TrainerConfig -> make_train_step and the run trains."""
+    result = Trainer(_trainer_cfg(grad_accum=2, total_steps=4)).run()
+    assert result.steps_run == 4
+    assert np.isfinite(result.final_loss)

@@ -224,3 +224,47 @@ def test_lm_head_matmul_numerics_and_grads():
     assert gx.dtype == x.dtype and gw.dtype == w.dtype
     assert jnp.allclose(gx.astype(jnp.float32), rx.astype(jnp.float32), atol=0.5, rtol=0.1)
     assert jnp.allclose(gw.astype(jnp.float32), rw.astype(jnp.float32), atol=0.5, rtol=0.1)
+
+def test_grad_accum_matches_unaccumulated():
+    """grad_accum=A must produce the same update as one full-batch step:
+    same loss metric and (up to bf16 grad-cast noise) the same params."""
+    require_devices(4)
+    mesh = make_mesh(MeshSpec(dp=2, tp=2), jax.devices()[:4])
+    cfg = LlamaConfig.tiny()
+    optimizer = make_optimizer(learning_rate=1e-3, warmup_steps=1, total_steps=50)
+    batch = synthetic_batch(jax.random.key(1), cfg, 8, 64, mesh)
+
+    state1 = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    step1 = make_train_step(cfg, mesh, optimizer, grad_accum=1)
+    state1, m1 = step1(state1, batch)
+
+    state4 = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    step4 = make_train_step(cfg, mesh, optimizer, grad_accum=4)
+    state4, m4 = step4(state4, batch)
+
+    # each microbatch is a uniform mean over equally many tokens, so the
+    # mean-of-means equals the full-batch mean
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m4["loss"]), rtol=2e-2
+    )
+    for a, b in zip(
+        jax.tree.leaves(state1["params"]), jax.tree.leaves(state4["params"])
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=5e-2
+        )
+    assert float(m4["grad_norm"]) > 0
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    require_devices(2)
+    mesh = make_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    cfg = LlamaConfig.tiny()
+    optimizer = make_optimizer(total_steps=10)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, 6, 64, mesh)
+    step = make_train_step(cfg, mesh, optimizer, grad_accum=4)
+    import pytest
+
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, batch)
