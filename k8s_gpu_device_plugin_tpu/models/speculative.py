@@ -1,0 +1,164 @@
+"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+
+Latency lever for serving: a small draft model autoregressively proposes
+``gamma`` tokens (cheap), then the target model scores ALL of them in a
+single cached forward of T=gamma (one HBM pass over the target weights
+instead of gamma) and keeps the longest prefix that matches its own greedy
+choices, plus one bonus token from the verify logits. Output is provably
+IDENTICAL to target-only greedy decoding — acceptance only shortcuts
+compute, never changes tokens — and the oracle test pins exactly that.
+
+TPU-first shape (vs the pointer-chasing GPU implementations):
+
+- **Fixed shapes throughout**: every round is exactly gamma draft steps
+  (``lax.scan``) + one T=gamma verify forward; the accepted count ``n`` is
+  a traced scalar handled by masking and ``dynamic_update_slice``, never a
+  dynamic shape.
+- **Cache rollback is a length pointer**: rejected positions are not
+  erased — the cache mask (k_pos <= q_pos) hides them and the next round's
+  writes overwrite them. Both caches advance by the same accepted count.
+- **One compile**: the outer ``lax.while_loop`` runs until ``max_new``
+  tokens exist in a static (max_new + gamma) buffer (slack absorbs the
+  final round's overshoot), then slices.
+
+Batch is 1 (the latency-bound serving case speculative decoding exists
+for); sampled (temperature > 0) speculative decoding needs the residual-
+distribution rejection scheme and is not implemented yet.
+
+The reference daemon has no serving stack (SURVEY §2); this extends the
+model-family API (train + generate + sample + speculate).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.generate import KVCache, _forward_cached
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def _greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "max_new", "gamma"))
+def speculative_generate(
+    params_t,
+    cfg_t: LlamaConfig,
+    params_d,
+    cfg_d: LlamaConfig,
+    prompt: jax.Array,
+    max_new: int,
+    gamma: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """Greedy speculative decode.
+
+    prompt: (1, P) int32. Returns (tokens (1, max_new), rounds scalar) —
+    ``rounds`` is the number of verify forwards the target ran; the first
+    token comes from the prefill, so mean accepted-per-round is
+    ``(max_new - 1) / rounds`` (== gamma for a perfect draft).
+    Tokens are exactly ``generate(params_t, prompt, cfg_t, max_new)``.
+    """
+    if cfg_t.is_moe or cfg_d.is_moe:
+        raise NotImplementedError("speculative decode is dense-only")
+    if cfg_t.quant != "none" or cfg_d.quant != "none":
+        raise NotImplementedError("speculative decode is bf16-only")
+    if cfg_t.vocab_size != cfg_d.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: {cfg_d.vocab_size} vs "
+            f"{cfg_t.vocab_size}"
+        )
+    b, p = prompt.shape
+    if b != 1:
+        raise NotImplementedError(
+            "speculative decode is batch-1 (per-row accepted counts would "
+            "need per-row cache lengths)"
+        )
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
+
+    max_len = p + max_new + gamma  # slack: final round may overshoot
+    t_cache = KVCache.init(cfg_t, b, max_len)
+    d_cache = KVCache.init(cfg_d, b, max_len)
+
+    # Prefill both models over the prompt. The target's last-position
+    # logits immediately yield the FIRST generated token.
+    t_logits, t_cache = _forward_cached(
+        params_t, prompt, t_cache, 0, cfg_t, last_only=True
+    )
+    # last_only: the draft's prefill logits are never used — without it the
+    # full (1, P, vocab) projection is computed and dropped on the latency
+    # path this module exists to optimize
+    _, d_cache = _forward_cached(
+        params_d, prompt, d_cache, 0, cfg_d, last_only=True
+    )
+    first = _greedy(t_logits[:, -1])                       # (1,)
+
+    buf = jnp.zeros((b, max_new + gamma), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
+
+    def draft_propose(last, cache, length):
+        """gamma single-token draft steps; returns (d (1, gamma), cache).
+        Consumes [last, d_1 .. d_{gamma-1}], writing gamma cache rows."""
+
+        def body(carry, _):
+            tok, cache, length = carry
+            logits, cache = _forward_cached(
+                params_d, tok[:, None], cache, length, cfg_d
+            )
+            nxt = _greedy(logits[:, -1])
+            return (nxt, cache, length + 1), nxt
+
+        (_, cache, _), toks = jax.lax.scan(
+            body, (last, cache, length), None, length=gamma
+        )
+        return toks.T.astype(jnp.int32), cache             # (1, gamma)
+
+    def round_body(state):
+        buf, generated, last, t_cache, d_cache, length, rounds = state
+
+        d_toks, d_cache = draft_propose(last, d_cache, length)
+
+        # target verifies [last, d_1 .. d_{gamma-1}] in ONE forward
+        verify_in = jnp.concatenate([last[:, None], d_toks[:, :-1]], axis=1)
+        v_logits, t_cache = _forward_cached(
+            params_t, verify_in, t_cache, length, cfg_t
+        )
+        pred = _greedy(v_logits)                           # (1, gamma)
+
+        # longest accepted prefix; emit d_i below the cut, target's own
+        # prediction (the bonus) at the cut. Full acceptance (n == gamma)
+        # has no verify logits beyond d_gamma, so it emits gamma tokens
+        # and no bonus.
+        eq = (d_toks == pred).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)[0]    # scalar 0..gamma
+        count = jnp.minimum(n + 1, gamma)
+        idx = jnp.arange(gamma, dtype=jnp.int32)[None, :]
+        emit = jnp.where(idx < n, d_toks, pred)            # slot n = bonus
+
+        buf = jax.lax.dynamic_update_slice(buf, emit, (0, generated))
+        last = emit[:, count - 1]
+        # both caches wrote rows length..length+gamma-1 for the SAME token
+        # sequence [last, d_1..d_{gamma-1}]; rows beyond the accepted
+        # prefix are garbage, hidden by the position mask and overwritten
+        # next round.
+        return (
+            buf, generated + count, last,
+            t_cache, d_cache, length + count, rounds + 1,
+        )
+
+    def round_cond(state):
+        _, generated, *_ = state
+        return generated < max_new
+
+    state = (
+        buf, jnp.int32(1), first, t_cache, d_cache, jnp.int32(p),
+        jnp.int32(0),
+    )
+    buf, _, _, _, _, _, rounds = jax.lax.while_loop(
+        round_cond, round_body, state
+    )
+    return buf[:, :max_new], rounds

@@ -227,11 +227,17 @@ def test_lm_head_matmul_numerics_and_grads():
 
 def test_grad_accum_matches_unaccumulated():
     """grad_accum=A must produce the same update as one full-batch step:
-    same loss metric and (up to bf16 grad-cast noise) the same params."""
+    same loss metric and (up to bf16 grad-cast noise) the same params.
+
+    Plain SGD, not make_optimizer: the warmup schedule's LR is 0.0 at the
+    first step, which would zero both updates and make the param
+    comparison vacuous (init == init)."""
+    import optax
+
     require_devices(4)
     mesh = make_mesh(MeshSpec(dp=2, tp=2), jax.devices()[:4])
     cfg = LlamaConfig.tiny()
-    optimizer = make_optimizer(learning_rate=1e-3, warmup_steps=1, total_steps=50)
+    optimizer = optax.sgd(1e-2)
     batch = synthetic_batch(jax.random.key(1), cfg, 8, 64, mesh)
 
     state1 = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
@@ -268,3 +274,26 @@ def test_grad_accum_rejects_indivisible_batch():
 
     with pytest.raises(ValueError, match="not divisible"):
         step(state, batch)
+
+
+def test_grad_accum_params_actually_move():
+    """Companion to the equivalence test: the sgd update must be nonzero,
+    or the param comparison there would be vacuous."""
+    import optax
+
+    require_devices(2)
+    mesh = make_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    cfg = LlamaConfig.tiny()
+    optimizer = optax.sgd(1e-2)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    before = jax.tree.map(lambda x: np.asarray(x, np.float32), state["params"])
+    batch = synthetic_batch(jax.random.key(1), cfg, 8, 64, mesh)
+    step = make_train_step(cfg, mesh, optimizer, grad_accum=2)
+    state, _ = step(state, batch)
+    moved = any(
+        not np.array_equal(a, np.asarray(b, np.float32))
+        for a, b in zip(
+            jax.tree.leaves(before), jax.tree.leaves(state["params"])
+        )
+    )
+    assert moved

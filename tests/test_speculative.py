@@ -1,0 +1,76 @@
+"""Speculative decoding: losslessness oracle + acceptance accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.speculative import speculative_generate
+
+
+def _prompt(p=8):
+    return jnp.arange(1, p + 1, dtype=jnp.int32)[None, :]
+
+
+def test_self_draft_accepts_everything():
+    """Draft == target: every proposal matches, so each round advances by
+    gamma and the output equals plain greedy decode."""
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    max_new, gamma = 13, 4
+    toks, rounds = speculative_generate(
+        params, cfg, params, cfg, _prompt(), max_new=max_new, gamma=gamma
+    )
+    ref = generate(params, _prompt(), cfg, max_new=max_new)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    # first token comes from prefill; the remaining 12 need ceil(12/4)=3
+    # full-acceptance rounds
+    assert int(rounds) == -(-(max_new - 1) // gamma)
+
+
+def test_weak_draft_is_still_lossless():
+    """A different (differently-seeded, shallower) draft proposes mostly
+    wrong tokens; the output must STILL equal target-only greedy decode —
+    acceptance only shortcuts compute, never changes tokens."""
+    cfg_t = LlamaConfig.tiny(n_layers=2)
+    cfg_d = LlamaConfig.tiny(n_layers=1)
+    params_t = init_params(jax.random.key(0), cfg_t)
+    params_d = init_params(jax.random.key(7), cfg_d)
+    max_new = 12
+    toks, rounds = speculative_generate(
+        params_t, cfg_t, params_d, cfg_d, _prompt(), max_new=max_new, gamma=3
+    )
+    ref = generate(params_t, _prompt(), cfg_t, max_new=max_new)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    # a bad draft costs more rounds than a perfect one, never more than
+    # one per emitted token
+    assert -(-(max_new - 1) // 3) <= int(rounds) <= max_new - 1
+
+
+def test_single_token_needs_no_rounds():
+    cfg = LlamaConfig.tiny(n_layers=1)
+    params = init_params(jax.random.key(0), cfg)
+    toks, rounds = speculative_generate(
+        params, cfg, params, cfg, _prompt(), max_new=1
+    )
+    ref = generate(params, _prompt(), cfg, max_new=1)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert int(rounds) == 0
+
+
+def test_validation():
+    cfg = LlamaConfig.tiny(n_layers=1)
+    params = init_params(jax.random.key(0), cfg)
+    cfg_v = LlamaConfig.tiny(n_layers=1, vocab_size=256)
+    params_v = init_params(jax.random.key(1), cfg_v)
+    with pytest.raises(ValueError, match="vocab mismatch"):
+        speculative_generate(params, cfg, params_v, cfg_v, _prompt(), max_new=4)
+    with pytest.raises(NotImplementedError, match="batch-1"):
+        speculative_generate(
+            params, cfg, params, cfg, jnp.zeros((2, 8), jnp.int32), max_new=4
+        )
+    with pytest.raises(NotImplementedError, match="bf16-only"):
+        cfg_q = LlamaConfig.tiny(n_layers=1, quant="int8")
+        speculative_generate(params, cfg_q, params, cfg, _prompt(), max_new=4)
