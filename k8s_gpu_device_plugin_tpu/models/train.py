@@ -200,6 +200,47 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_eval_step(cfg: LlamaConfig, mesh: Mesh, micro: int = 1) -> Callable:
+    """Jitted held-out metrics: (params, batch) -> {loss, accuracy, ...}.
+
+    No gradients, no optimizer — one forward in the training numerics.
+    Always the unfused loss path (accuracy needs logits), so eval metrics
+    are comparable across fused/unfused training configs. Because the
+    unfused path materializes (B_eval, S, V) f32 logits — the very tensor
+    fused-CE/grad-accum training configs exist to avoid — ``micro=A``
+    scans the batch in A chunks so eval fits wherever training fits."""
+
+    def one(params, mb):
+        _, metrics = loss_fn(params, mb, cfg=cfg, mesh=mesh, with_accuracy=True)
+        return metrics
+
+    def step(params, batch):
+        if micro == 1:
+            return one(params, batch)
+        b = batch["inputs"].shape[0]
+        if b % micro:
+            raise ValueError(
+                f"eval batch {b} not divisible by eval micro {micro}"
+            )
+        mbs = jax.tree.map(
+            lambda x: x.reshape(micro, b // micro, *x.shape[1:]), batch
+        )
+        mbs = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP))
+            ),
+            mbs,
+        )
+
+        def body(_, mb):
+            return None, one(params, mb)
+
+        _, stacked = jax.lax.scan(body, None, mbs)
+        return jax.tree.map(jnp.mean, stacked)
+
+    return jax.jit(step)
+
+
 def init_train_state(
     key: jax.Array,
     cfg: LlamaConfig,

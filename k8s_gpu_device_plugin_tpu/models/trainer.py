@@ -28,6 +28,7 @@ from k8s_gpu_device_plugin_tpu.models.checkpoint import TrainCheckpointer
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.train import (
     init_train_state,
+    make_eval_step,
     make_optimizer,
     make_train_step,
 )
@@ -50,6 +51,13 @@ class TrainerConfig:
     total_steps: int = 20
     learning_rate: float = 3e-4
     warmup_steps: int = 100
+    # held-out evaluation (0 disables): every eval_every steps (and after
+    # the final step) run eval_batches deterministic validation batches.
+    # eval_micro chunks each eval batch so the unfused eval forward's
+    # (B, S, V) logits fit wherever training fits (0 = follow grad_accum).
+    eval_every: int = 0
+    eval_batches: int = 4
+    eval_micro: int = 0
     # checkpointing ("" disables)
     checkpoint_dir: str = ""
     checkpoint_interval: int = 1000
@@ -68,6 +76,7 @@ class TrainResult:
     tokens_per_second: float
     resumed_from: int | None
     metrics_history: list[dict]
+    final_eval: dict | None = None  # {"loss", "perplexity", "accuracy"}
 
 
 class Trainer:
@@ -77,6 +86,7 @@ class Trainer:
         self,
         cfg: TrainerConfig,
         loader: DataLoader | None = None,
+        eval_loader: DataLoader | None = None,
         logger: logging.Logger | None = None,
     ) -> None:
         self.cfg = cfg
@@ -109,6 +119,33 @@ class Trainer:
             cfg.seq_len,
             self.mesh,
         )
+        self.eval_loader: DataLoader | None = None
+        self.eval_step_fn = None
+        if eval_loader is not None and cfg.eval_every <= 0:
+            raise ValueError(
+                "eval_loader passed but eval_every is 0 — the loader would "
+                "be silently ignored; set eval_every > 0"
+            )
+        if cfg.eval_every > 0:
+            if cfg.eval_batches < 1:
+                raise ValueError(
+                    f"eval_batches must be >= 1 when eval_every > 0, got "
+                    f"{cfg.eval_batches}"
+                )
+            # held-out stream: a different seed than the train default, no
+            # prefetch thread (eval passes are short and restart at step 0
+            # every time so the SAME validation batches score every pass)
+            self.eval_loader = eval_loader or DataLoader(
+                SyntheticSource(cfg.model.vocab_size, seed=1),
+                cfg.batch_size,
+                cfg.seq_len,
+                self.mesh,
+                prefetch=0,
+            )
+            self.eval_step_fn = make_eval_step(
+                cfg.model, self.mesh,
+                micro=cfg.eval_micro or cfg.grad_accum,
+            )
         self.ckpt: TrainCheckpointer | None = None
         if cfg.checkpoint_dir:
             self.ckpt = TrainCheckpointer(
@@ -130,6 +167,27 @@ class Trainer:
                 self.loader.seek(resumed_from)
         return state, resumed_from
 
+    def _evaluate(self, params) -> dict:
+        """Mean held-out metrics over ``eval_batches`` deterministic batches
+        (the loader restarts at step 0 each pass, so every eval scores the
+        same validation set)."""
+        import math
+
+        assert self.eval_loader is not None and self.eval_step_fn is not None
+        self.eval_loader.seek(0)
+        it = iter(self.eval_loader)
+        loss_sum, acc_sum = 0.0, 0.0
+        for _ in range(self.cfg.eval_batches):
+            m = self.eval_step_fn(params, next(it))
+            loss_sum += float(m["loss"])
+            acc_sum += float(m["accuracy"])
+        loss = loss_sum / self.cfg.eval_batches
+        return {
+            "loss": loss,
+            "perplexity": math.exp(min(loss, 700.0)),
+            "accuracy": acc_sum / self.cfg.eval_batches,
+        }
+
     def run(self, on_step: Callable[[int, dict], None] | None = None) -> TrainResult:
         cfg = self.cfg
         state, resumed_from = self._init_or_resume()
@@ -141,6 +199,7 @@ class Trainer:
         metrics: dict[str, Any] = {}
         t_start = None
         steps_timed = 0
+        eval_seconds = 0.0
         tracing = False
         try:
             for step in range(start_step, cfg.total_steps):
@@ -172,6 +231,21 @@ class Trainer:
                     }
                     history.append(snap)
                     self.log.info("train step", extra={"fields": snap})
+                if (
+                    self.eval_loader is not None
+                    and (step + 1) % cfg.eval_every == 0
+                    and step + 1 != cfg.total_steps  # final eval runs below
+                ):
+                    # eval wall time must not deflate the reported train
+                    # tokens/s: finish in-flight work, then pause the clock
+                    jax.block_until_ready(metrics["loss"])
+                    t_eval = time.perf_counter()
+                    ev = self._evaluate(state["params"])
+                    eval_seconds += time.perf_counter() - t_eval
+                    self.log.info(
+                        "eval", extra={"fields": {"step": step + 1, **ev}}
+                    )
+                    history.append({"step": step + 1, "eval": ev})
                 if on_step is not None:
                     on_step(step + 1, metrics)
         finally:
@@ -184,14 +258,24 @@ class Trainer:
                 self.ckpt.wait()
 
         jax.block_until_ready(metrics["loss"] if metrics else state["step"])
-        elapsed = time.perf_counter() - t_start if t_start else 0.0
+        elapsed = (
+            time.perf_counter() - t_start - eval_seconds if t_start else 0.0
+        )
         tps = tokens_per_batch * steps_timed / elapsed if elapsed > 0 else 0.0
+        final_eval = None
+        if self.eval_loader is not None and cfg.total_steps > start_step:
+            final_eval = self._evaluate(state["params"])
+            self.log.info(
+                "final eval",
+                extra={"fields": {"step": cfg.total_steps, **final_eval}},
+            )
         return TrainResult(
             steps_run=cfg.total_steps - start_step,
             final_loss=float(metrics["loss"]) if metrics else float("nan"),
             tokens_per_second=tps,
             resumed_from=resumed_from,
             metrics_history=history,
+            final_eval=final_eval,
         )
 
 
@@ -213,6 +297,9 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--gradAccum", type=int, default=1,
                         help="microbatches per optimizer update (splits the "
                         "batch; grads accumulate in f32)")
+    parser.add_argument("--evalEvery", type=int, default=0,
+                        help="held-out eval cadence in steps (0 = off)")
+    parser.add_argument("--evalBatches", type=int, default=4)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1)
@@ -253,15 +340,24 @@ def _main(argv: list[str] | None = None) -> int:
         batch_size=args.batchSize,
         seq_len=args.seqLen,
         grad_accum=args.gradAccum,
+        eval_every=args.evalEvery,
+        eval_batches=args.evalBatches,
         total_steps=args.steps,
         checkpoint_dir=args.checkpointDir,
         checkpoint_interval=args.checkpointInterval,
         trace_dir=args.traceDir,
     )
     result = Trainer(cfg).run()
+    eval_str = (
+        f" eval_loss={result.final_eval['loss']:.4f}"
+        f" ppl={result.final_eval['perplexity']:.2f}"
+        if result.final_eval
+        else ""
+    )
     print(
         f"trainer: steps={result.steps_run} loss={result.final_loss:.4f} "
-        f"tokens/s={result.tokens_per_second:.0f} resumed_from={result.resumed_from}"
+        f"tokens/s={result.tokens_per_second:.0f} "
+        f"resumed_from={result.resumed_from}{eval_str}"
     )
     return 0
 

@@ -186,3 +186,81 @@ def test_trainer_grad_accum_wiring():
     result = Trainer(_trainer_cfg(grad_accum=2, total_steps=4)).run()
     assert result.steps_run == 4
     assert np.isfinite(result.final_loss)
+
+
+def test_trainer_eval_loop():
+    """Eval runs on cadence + finally, is deterministic across passes (same
+    validation set), and perplexity == exp(loss)."""
+    import math
+
+    result = Trainer(
+        _trainer_cfg(eval_every=2, eval_batches=2, total_steps=4)
+    ).run()
+    assert result.final_eval is not None
+    assert math.isclose(
+        result.final_eval["perplexity"],
+        math.exp(result.final_eval["loss"]),
+        rel_tol=1e-9,
+    )
+    assert 0.0 <= result.final_eval["accuracy"] <= 1.0
+    evals = [h["eval"] for h in result.metrics_history if "eval" in h]
+    assert len(evals) == 1  # step 2 (step 4 is the final eval, not in history)
+    assert np.isfinite(evals[0]["loss"])
+
+
+def test_eval_step_matches_loss_fn(mesh):
+    """make_eval_step reports the same loss the train step's loss_fn sees."""
+    from k8s_gpu_device_plugin_tpu.models.train import (
+        init_train_state,
+        make_eval_step,
+        make_optimizer,
+        synthetic_batch,
+    )
+
+    cfg = LlamaConfig.tiny()
+    optimizer = make_optimizer(total_steps=10)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+    ev = make_eval_step(cfg, mesh)(state["params"], batch)
+    from k8s_gpu_device_plugin_tpu.models.train import loss_fn
+
+    loss_direct, _ = loss_fn(state["params"], batch, cfg, mesh)
+    np.testing.assert_allclose(
+        float(ev["loss"]), float(loss_direct), rtol=1e-6
+    )
+    assert 0.0 <= float(ev["accuracy"]) <= 1.0
+
+
+def test_eval_micro_matches_full_batch(mesh):
+    """Microbatched eval (mean of equal-size chunk means) equals the
+    full-batch eval to numerical precision."""
+    from k8s_gpu_device_plugin_tpu.models.train import (
+        init_train_state,
+        make_eval_step,
+        make_optimizer,
+        synthetic_batch,
+    )
+
+    cfg = LlamaConfig.tiny()
+    optimizer = make_optimizer(total_steps=10)
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    batch = synthetic_batch(jax.random.key(1), cfg, 8, 64, mesh)
+    full = make_eval_step(cfg, mesh, micro=1)(state["params"], batch)
+    chunked = make_eval_step(cfg, mesh, micro=4)(state["params"], batch)
+    np.testing.assert_allclose(
+        float(chunked["loss"]), float(full["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(chunked["accuracy"]), float(full["accuracy"]), atol=1e-6
+    )
+
+
+def test_trainer_eval_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="eval_batches"):
+        Trainer(_trainer_cfg(eval_every=2, eval_batches=0))
+    with pytest.raises(ValueError, match="silently ignored"):
+        cfg = _trainer_cfg()  # eval_every defaults to 0
+        t = Trainer(cfg)
+        Trainer(cfg, eval_loader=t.loader)
