@@ -71,13 +71,29 @@ def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits >= kth, logits, _NEG)
 
 
-def sample_logits(logits: jax.Array, key: jax.Array, sampler: Sampler) -> jax.Array:
-    """(B, V) f32 logits -> (B,) int32 token ids."""
-    if sampler.is_greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filtered_logits(logits: jax.Array, sampler: Sampler) -> jax.Array:
+    """(..., V) logits after temperature + top-k + top-p filtering — the
+    distribution the sampler actually draws from (masked entries -> -inf).
+    Undefined for greedy samplers (temperature 0 has no distribution)."""
     logits = logits.astype(jnp.float32) / sampler.temperature
     if sampler.top_k > 0:
         logits = _apply_top_k(logits, min(sampler.top_k, logits.shape[-1]))
     if sampler.top_p < 1.0:
         logits = _apply_top_p(logits, sampler.top_p)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def filtered_probs(logits: jax.Array, sampler: Sampler) -> jax.Array:
+    """(..., V) normalized probabilities the sampler draws from; the input
+    to speculative rejection sampling (models/speculative.py), which needs
+    the draft and target to agree on the filtered distributions."""
+    return jax.nn.softmax(filtered_logits(logits, sampler), axis=-1)
+
+
+def sample_logits(logits: jax.Array, key: jax.Array, sampler: Sampler) -> jax.Array:
+    """(B, V) f32 logits -> (B,) int32 token ids."""
+    if sampler.is_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, filtered_logits(logits, sampler), axis=-1
+    ).astype(jnp.int32)

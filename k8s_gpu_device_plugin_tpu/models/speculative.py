@@ -1,12 +1,16 @@
-"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+"""Speculative decoding: draft proposes, target verifies in one pass.
 
 Latency lever for serving: a small draft model autoregressively proposes
 ``gamma`` tokens (cheap), then the target model scores ALL of them in a
 single cached forward of T=gamma (one HBM pass over the target weights
-instead of gamma) and keeps the longest prefix that matches its own greedy
-choices, plus one bonus token from the verify logits. Output is provably
-IDENTICAL to target-only greedy decoding — acceptance only shortcuts
-compute, never changes tokens — and the oracle test pins exactly that.
+instead of gamma). Greedy mode keeps the longest prefix matching the
+target's own greedy choices plus one bonus token — provably IDENTICAL
+output to target-only greedy decoding (the oracle test pins exactly
+that). Sampled mode (pass a ``Sampler``) keeps each proposal d ~ q with
+probability min(1, p/q) and resamples rejections from
+normalize(max(p - q, 0)), so every emitted token is exactly target-
+distributed under the same filtered distribution (the speculative
+sampling theorem; tested statistically on ``_accept_round``).
 
 TPU-first shape (vs the pointer-chasing GPU implementations):
 
@@ -22,8 +26,7 @@ TPU-first shape (vs the pointer-chasing GPU implementations):
   final round's overshoot), then slices.
 
 Batch is 1 (the latency-bound serving case speculative decoding exists
-for); sampled (temperature > 0) speculative decoding needs the residual-
-distribution rejection scheme and is not implemented yet.
+for).
 
 The reference daemon has no serving stack (SURVEY §2); this extends the
 model-family API (train + generate + sample + speculate).
@@ -38,13 +41,52 @@ import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.models.generate import KVCache, _forward_cached
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    filtered_probs,
+    sample_logits,
+)
 
 
 def _greedy(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "max_new", "gamma"))
+def _accept_round(
+    key: jax.Array,
+    d_toks: jax.Array,   # (gamma,) draft proposals, sampled from q
+    q_probs: jax.Array,  # (gamma, V) draft distributions at each position
+    p_probs: jax.Array,  # (gamma, V) target distributions at each position
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative rejection core: returns (n_accepted, bonus_token, count).
+
+    Standard leapfrog acceptance: token i is kept with probability
+    min(1, p_i(d_i) / q_i(d_i)); at the first rejection the replacement is
+    drawn from the residual distribution normalize(max(p - q, 0)), which
+    makes each emitted token exactly p-distributed (the speculative
+    sampling theorem). Full acceptance emits gamma tokens and no bonus.
+    """
+    gamma = d_toks.shape[0]
+    kacc, kbonus = jax.random.split(key)
+    qi = jnp.take_along_axis(q_probs, d_toks[:, None], 1)[:, 0]
+    pi = jnp.take_along_axis(p_probs, d_toks[:, None], 1)[:, 0]
+    u = jax.random.uniform(kacc, (gamma,))
+    accepted = u * qi < pi                       # u < p/q  (q > 0: d ~ q)
+    n = jnp.sum(jnp.cumprod(accepted.astype(jnp.int32)))
+    row = jnp.minimum(n, gamma - 1)              # rejection position
+    residual = jnp.clip(p_probs[row] - q_probs[row], 0.0)
+    total = jnp.sum(residual)
+    # p == q makes the residual vanish (rejection probability ~0; float
+    # noise can still land here) — fall back to the target distribution
+    residual = jnp.where(total > 1e-9, residual / total, p_probs[row])
+    bonus = jax.random.categorical(kbonus, jnp.log(residual + 1e-38))
+    count = jnp.minimum(n + 1, gamma)
+    return n, bonus.astype(jnp.int32), count
+
+
+@partial(
+    jax.jit, static_argnames=("cfg_t", "cfg_d", "max_new", "gamma", "sampler")
+)
 def speculative_generate(
     params_t,
     cfg_t: LlamaConfig,
@@ -53,14 +95,23 @@ def speculative_generate(
     prompt: jax.Array,
     max_new: int,
     gamma: int = 4,
+    sampler: "Sampler | None" = None,
+    key: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Greedy speculative decode.
+    """Speculative decode — greedy by default, sampled with a ``Sampler``.
 
     prompt: (1, P) int32. Returns (tokens (1, max_new), rounds scalar) —
     ``rounds`` is the number of verify forwards the target ran; the first
     token comes from the prefill, so mean accepted-per-round is
     ``(max_new - 1) / rounds`` (== gamma for a perfect draft).
-    Tokens are exactly ``generate(params_t, prompt, cfg_t, max_new)``.
+
+    Greedy (``sampler`` None or temperature 0): tokens are exactly
+    ``generate(params_t, prompt, cfg_t, max_new)``. Sampled: draft
+    proposals d ~ q are kept with probability min(1, p/q) and replaced
+    from normalize(max(p - q, 0)) on rejection, so every emitted token is
+    exactly target-distributed under the SAME filtered distribution
+    (temperature/top-k/top-p applied identically to both models) — the
+    speculative sampling theorem.
     """
     if cfg_t.is_moe or cfg_d.is_moe:
         raise NotImplementedError("speculative decode is dense-only")
@@ -95,49 +146,75 @@ def speculative_generate(
     _, d_cache = _forward_cached(
         params_d, prompt, d_cache, 0, cfg_d, last_only=True
     )
-    first = _greedy(t_logits[:, -1])                       # (1,)
+    greedy = sampler is None or sampler.is_greedy
+    key = key if key is not None else jax.random.key(0)
+    kfirst, kloop = jax.random.split(key)
+    if greedy:
+        first = _greedy(t_logits[:, -1])                   # (1,)
+    else:
+        first = sample_logits(t_logits[:, -1], kfirst, sampler)
 
     buf = jnp.zeros((b, max_new + gamma), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
 
-    def draft_propose(last, cache, length):
-        """gamma single-token draft steps; returns (d (1, gamma), cache).
-        Consumes [last, d_1 .. d_{gamma-1}], writing gamma cache rows."""
+    def draft_propose(last, cache, length, key):
+        """gamma single-token draft steps; returns (d (1, gamma),
+        q_probs (gamma, V), cache). Consumes [last, d_1 .. d_{gamma-1}],
+        writing gamma cache rows. The greedy path never reads q_probs and
+        emits all-zeros rows (do NOT feed them to _accept_round — qi=0
+        would accept anything); the sampled path emits the filtered draft
+        distribution each proposal was drawn from."""
 
         def body(carry, _):
-            tok, cache, length = carry
+            tok, cache, length, key = carry
             logits, cache = _forward_cached(
                 params_d, tok[:, None], cache, length, cfg_d
             )
-            nxt = _greedy(logits[:, -1])
-            return (nxt, cache, length + 1), nxt
+            if greedy:
+                nxt = _greedy(logits[:, -1])
+                q = jnp.zeros((logits.shape[-1],), jnp.float32)
+            else:
+                key, sub = jax.random.split(key)
+                q = filtered_probs(logits[:, -1], sampler)[0]
+                nxt = jax.random.categorical(
+                    sub, jnp.log(q + 1e-38)[None, :]
+                ).astype(jnp.int32)
+            return (nxt, cache, length + 1, key), (nxt, q)
 
-        (_, cache, _), toks = jax.lax.scan(
-            body, (last, cache, length), None, length=gamma
+        (_, cache, _, _), (toks, q_probs) = jax.lax.scan(
+            body, (last, cache, length, key), None, length=gamma
         )
-        return toks.T.astype(jnp.int32), cache             # (1, gamma)
+        return toks.T.astype(jnp.int32), q_probs, cache    # (1,g), (g,V)
 
     def round_body(state):
-        buf, generated, last, t_cache, d_cache, length, rounds = state
+        buf, generated, last, t_cache, d_cache, length, rounds, key = state
+        key, kdraft, kaccept = jax.random.split(key, 3)
 
-        d_toks, d_cache = draft_propose(last, d_cache, length)
+        d_toks, q_probs, d_cache = draft_propose(last, d_cache, length, kdraft)
 
         # target verifies [last, d_1 .. d_{gamma-1}] in ONE forward
         verify_in = jnp.concatenate([last[:, None], d_toks[:, :-1]], axis=1)
         v_logits, t_cache = _forward_cached(
             params_t, verify_in, t_cache, length, cfg_t
         )
-        pred = _greedy(v_logits)                           # (1, gamma)
 
-        # longest accepted prefix; emit d_i below the cut, target's own
-        # prediction (the bonus) at the cut. Full acceptance (n == gamma)
-        # has no verify logits beyond d_gamma, so it emits gamma tokens
-        # and no bonus.
-        eq = (d_toks == pred).astype(jnp.int32)
-        n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)[0]    # scalar 0..gamma
-        count = jnp.minimum(n + 1, gamma)
         idx = jnp.arange(gamma, dtype=jnp.int32)[None, :]
-        emit = jnp.where(idx < n, d_toks, pred)            # slot n = bonus
+        if greedy:
+            # longest prefix matching the target's own greedy choices; the
+            # target's prediction (the bonus) fills the cut slot. Full
+            # acceptance (n == gamma) has no verify logits beyond d_gamma,
+            # so it emits gamma tokens and no bonus.
+            pred = _greedy(v_logits)                       # (1, gamma)
+            eq = (d_toks == pred).astype(jnp.int32)
+            n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)[0]
+            count = jnp.minimum(n + 1, gamma)
+            emit = jnp.where(idx < n, d_toks, pred)        # slot n = bonus
+        else:
+            p_probs = filtered_probs(v_logits[0], sampler)  # (gamma, V)
+            n, bonus, count = _accept_round(
+                kaccept, d_toks[0], q_probs, p_probs
+            )
+            emit = jnp.where(idx < n, d_toks, bonus)       # slot n = bonus
 
         buf = jax.lax.dynamic_update_slice(buf, emit, (0, generated))
         last = emit[:, count - 1]
@@ -147,7 +224,7 @@ def speculative_generate(
         # next round.
         return (
             buf, generated + count, last,
-            t_cache, d_cache, length + count, rounds + 1,
+            t_cache, d_cache, length + count, rounds + 1, key,
         )
 
     def round_cond(state):
@@ -156,9 +233,9 @@ def speculative_generate(
 
     state = (
         buf, jnp.int32(1), first, t_cache, d_cache, jnp.int32(p),
-        jnp.int32(0),
+        jnp.int32(0), kloop,
     )
-    buf, _, _, _, _, _, rounds = jax.lax.while_loop(
+    buf, _, _, _, _, _, rounds, _ = jax.lax.while_loop(
         round_cond, round_body, state
     )
     return buf[:, :max_new], rounds

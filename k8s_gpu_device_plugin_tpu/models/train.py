@@ -121,6 +121,24 @@ def loss_fn(
     return loss, metrics
 
 
+def _microbatch(batch: dict, micro: int, mesh: Mesh, what: str) -> dict:
+    """Split every (B, S) leaf into (micro, B//micro, S), re-constrained to
+    the standard batch layout — shared by grad accumulation and microbatched
+    eval so the two can never drift onto different shardings."""
+    b = batch["inputs"].shape[0]
+    if b % micro:
+        raise ValueError(f"batch size {b} not divisible by {what} {micro}")
+    mbs = jax.tree.map(
+        lambda x: x.reshape(micro, b // micro, *x.shape[1:]), batch
+    )
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP))
+        ),
+        mbs,
+    )
+
+
 def make_train_step(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -155,21 +173,7 @@ def make_train_step(
         if grad_accum == 1:
             (_, metrics), grads = grad_fn(state["params"], batch)
         else:
-            b = batch["inputs"].shape[0]
-            if b % grad_accum:
-                raise ValueError(
-                    f"batch size {b} not divisible by grad_accum {grad_accum}"
-                )
-            micro = jax.tree.map(
-                lambda x: x.reshape(grad_accum, b // grad_accum, *x.shape[1:]),
-                batch,
-            )
-            micro = jax.tree.map(
-                lambda x: jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP))
-                ),
-                micro,
-            )
+            micro = _microbatch(batch, grad_accum, mesh, "grad_accum")
 
             def accum_body(acc, mb):
                 (_, m), g = grad_fn(state["params"], mb)
@@ -217,20 +221,7 @@ def make_eval_step(cfg: LlamaConfig, mesh: Mesh, micro: int = 1) -> Callable:
     def step(params, batch):
         if micro == 1:
             return one(params, batch)
-        b = batch["inputs"].shape[0]
-        if b % micro:
-            raise ValueError(
-                f"eval batch {b} not divisible by eval micro {micro}"
-            )
-        mbs = jax.tree.map(
-            lambda x: x.reshape(micro, b // micro, *x.shape[1:]), batch
-        )
-        mbs = jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(None, (AXIS_DP, AXIS_FSDP), AXIS_SP))
-            ),
-            mbs,
-        )
+        mbs = _microbatch(batch, micro, mesh, "eval micro")
 
         def body(_, mb):
             return None, one(params, mb)

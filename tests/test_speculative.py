@@ -74,3 +74,72 @@ def test_validation():
     with pytest.raises(NotImplementedError, match="bf16-only"):
         cfg_q = LlamaConfig.tiny(n_layers=1, quant="int8")
         speculative_generate(params, cfg_q, params, cfg, _prompt(), max_new=4)
+
+
+def test_accept_round_marginal_is_target_distribution():
+    """The speculative-sampling theorem, tested directly on _accept_round
+    with gamma=1: draft proposes d ~ q, the round keeps it w.p. min(1,p/q)
+    or resamples from the residual — the emitted token's marginal must be
+    exactly p. 4000 trials over an 8-token vocab; empirical frequencies
+    must match p well within 4-sigma multinomial noise."""
+    from k8s_gpu_device_plugin_tpu.models.speculative import _accept_round
+
+    v = 8
+    kp, kq = jax.random.split(jax.random.key(42))
+    p = jax.nn.softmax(jax.random.normal(kp, (v,)) * 1.5)
+    q = jax.nn.softmax(jax.random.normal(kq, (v,)) * 1.5)
+
+    def one(key):
+        kd, ka = jax.random.split(key)
+        d = jax.random.categorical(kd, jnp.log(q))[None].astype(jnp.int32)
+        n, bonus, count = _accept_round(
+            ka, d, q[None, :], p[None, :]
+        )
+        return jnp.where(n > 0, d[0], bonus)
+
+    trials = 4000
+    toks = jax.vmap(one)(jax.random.split(jax.random.key(0), trials))
+    counts = np.bincount(np.asarray(toks), minlength=v)
+    expected = np.asarray(p) * trials
+    sigma = np.sqrt(expected * (1 - np.asarray(p)))
+    assert (np.abs(counts - expected) < 4 * sigma + 1).all(), (
+        counts, expected.round(1)
+    )
+
+
+def test_sampled_self_draft_accepts_everything():
+    """Draft == target => p == q => acceptance probability 1: every round
+    advances gamma tokens, same as the greedy self-draft case. The draft's
+    T=1 forwards and the target's T=gamma verify forward may tile
+    differently on some backends, so p/q can dip fractionally below 1 —
+    allow one stray rejection rather than pinning bitwise agreement."""
+    from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    max_new, gamma = 13, 4
+    toks, rounds = speculative_generate(
+        params, cfg, params, cfg, _prompt(), max_new=max_new, gamma=gamma,
+        sampler=Sampler(temperature=0.9), key=jax.random.key(5),
+    )
+    assert toks.shape == (1, max_new)
+    floor = -(-(max_new - 1) // gamma)
+    assert floor <= int(rounds) <= floor + 1
+
+
+def test_sampled_with_filters_runs_and_stays_in_vocab():
+    from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+    cfg_t = LlamaConfig.tiny(n_layers=2)
+    cfg_d = LlamaConfig.tiny(n_layers=1)
+    params_t = init_params(jax.random.key(0), cfg_t)
+    params_d = init_params(jax.random.key(7), cfg_d)
+    toks, rounds = speculative_generate(
+        params_t, cfg_t, params_d, cfg_d, _prompt(), max_new=10, gamma=3,
+        sampler=Sampler(temperature=0.8, top_k=20, top_p=0.95),
+        key=jax.random.key(11),
+    )
+    a = np.asarray(toks)
+    assert a.shape == (1, 10)
+    assert (a >= 0).all() and (a < cfg_t.vocab_size).all()
+    assert 1 <= int(rounds) <= 9
