@@ -1,0 +1,121 @@
+"""HuggingFace Llama checkpoint import.
+
+Bridges the ecosystem's weight format to this framework's functional
+pytree so trained checkpoints (Llama-3, Mixtral-dense-equivalents, any
+LlamaForCausalLM) run on the TPU stack without retraining. Pure layout
+transformation — no torch ops beyond reading tensors, so the function also
+serves as the parity oracle seam: ``tests/test_convert.py`` builds a
+random-init HF model, converts it, and pins our forward's logits against
+``transformers``' reference implementation.
+
+Layout mapping (HF -> here):
+
+- torch ``Linear.weight`` is (out, in); our matmuls are ``x @ W`` with W
+  (in, out) -> transpose every projection.
+- per-layer tensors stack on a leading L axis (the ``lax.scan`` layout).
+- rope is the rotate-half convention in both; RMSNorm epsilon and theta
+  come from the HF config.
+
+No network access is required or attempted: callers pass an in-memory
+model/state_dict (e.g. loaded from local safetensors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
+    if getattr(hf_config, "tie_word_embeddings", False):
+        raise NotImplementedError(
+            "tied embeddings not supported: this stack keeps a separate "
+            "lm_head (untie the checkpoint before converting)"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(hf_config.rope_theta),
+        norm_eps=float(hf_config.rms_norm_eps),
+        max_seq=int(getattr(hf_config, "max_position_embeddings", 8192)),
+        dtype=dtype,
+    )
+
+
+def _to_np(t: Any) -> np.ndarray:
+    """torch tensor / np array -> f32 numpy (torch never imported here)."""
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], cfg: LlamaConfig
+) -> dict:
+    """HF ``LlamaForCausalLM.state_dict()`` -> this framework's pytree.
+
+    Accepts torch tensors or numpy arrays. Raises KeyError on missing
+    weights (a truncated checkpoint must not silently produce a random
+    layer) and ValueError on shape mismatches.
+    """
+    sd = dict(state_dict)
+
+    def take(name: str, transpose: bool = False) -> np.ndarray:
+        w = _to_np(sd.pop(name))
+        return w.T if transpose else w
+
+    def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
+        ws = [take(fmt.format(i), transpose) for i in range(cfg.n_layers)]
+        return jnp.asarray(np.stack(ws), cfg.dtype)
+
+    params = {
+        "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.dtype),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+            "mlp_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight"
+            ),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+            "w1": stack("model.layers.{}.mlp.gate_proj.weight", True),
+            "w3": stack("model.layers.{}.mlp.up_proj.weight", True),
+            "w2": stack("model.layers.{}.mlp.down_proj.weight", True),
+        },
+        "final_norm": jnp.asarray(take("model.norm.weight"), cfg.dtype),
+        "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.dtype),
+    }
+
+    expected = {
+        "embed": (cfg.vocab_size, cfg.d_model),
+        "lm_head": (cfg.d_model, cfg.vocab_size),
+    }
+    for name, shape in expected.items():
+        if params[name].shape != shape:
+            raise ValueError(
+                f"{name}: checkpoint shape {params[name].shape} != config "
+                f"shape {shape}"
+            )
+    hd = cfg.head_dim
+    if params["layers"]["wq"].shape != (
+        cfg.n_layers, cfg.d_model, cfg.n_heads * hd
+    ):
+        raise ValueError(
+            f"wq: checkpoint shape {params['layers']['wq'].shape} "
+            f"incompatible with config {cfg}"
+        )
+    # rotary_emb.inv_freq buffers etc. are derived, not parameters
+    leftover = [k for k in sd if "rotary_emb" not in k]
+    if leftover:
+        raise ValueError(f"unconsumed checkpoint tensors: {leftover[:5]}")
+    return params

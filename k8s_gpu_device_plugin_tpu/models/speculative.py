@@ -43,6 +43,7 @@ from k8s_gpu_device_plugin_tpu.models.generate import KVCache, _forward_cached
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import (
     Sampler,
+    filtered_logits,
     filtered_probs,
     sample_logits,
 )
@@ -175,10 +176,9 @@ def speculative_generate(
                 q = jnp.zeros((logits.shape[-1],), jnp.float32)
             else:
                 key, sub = jax.random.split(key)
-                q = filtered_probs(logits[:, -1], sampler)[0]
-                nxt = jax.random.categorical(
-                    sub, jnp.log(q + 1e-38)[None, :]
-                ).astype(jnp.int32)
+                fl = filtered_logits(logits[:, -1], sampler)
+                nxt = jax.random.categorical(sub, fl).astype(jnp.int32)
+                q = jax.nn.softmax(fl, axis=-1)[0]
             return (nxt, cache, length + 1, key), (nxt, q)
 
         (_, cache, _, _), (toks, q_probs) = jax.lax.scan(

@@ -143,3 +143,46 @@ def test_sampled_with_filters_runs_and_stays_in_vocab():
     assert a.shape == (1, 10)
     assert (a >= 0).all() and (a < cfg_t.vocab_size).all()
     assert 1 <= int(rounds) <= 9
+
+
+def test_accept_round_gamma2_marginals():
+    """Multi-position statistical oracle (gamma=2): slot 0's marginal over
+    ALL trials must be p_0, and slot 1's marginal CONDITIONAL on slot 0
+    being a kept draft token (n >= 1) must be p_1 — pinning the cumprod
+    prefix count, interior residual row, and bonus slot placement that the
+    gamma=1 test cannot see."""
+    from k8s_gpu_device_plugin_tpu.models.speculative import _accept_round
+
+    v = 8
+    ks = jax.random.split(jax.random.key(7), 4)
+    p = jax.nn.softmax(jax.random.normal(ks[0], (2, v)) * 1.5, axis=-1)
+    q = jax.nn.softmax(jax.random.normal(ks[1], (2, v)) * 1.5, axis=-1)
+
+    def one(key):
+        kd0, kd1, ka = jax.random.split(key, 3)
+        d = jnp.stack([
+            jax.random.categorical(kd0, jnp.log(q[0])),
+            jax.random.categorical(kd1, jnp.log(q[1])),
+        ]).astype(jnp.int32)
+        n, bonus, count = _accept_round(ka, d, q, p)
+        slot0 = jnp.where(n > 0, d[0], bonus)
+        slot1 = jnp.where(n > 1, d[1], bonus)
+        return slot0, slot1, n
+
+    trials = 8000
+    s0, s1, n = jax.vmap(one)(jax.random.split(jax.random.key(1), trials))
+    s0, s1, n = np.asarray(s0), np.asarray(s1), np.asarray(n)
+
+    # slot 0 marginal == p_0 over all trials
+    counts0 = np.bincount(s0, minlength=v)
+    exp0 = np.asarray(p[0]) * trials
+    sig0 = np.sqrt(exp0 * (1 - np.asarray(p[0])))
+    assert (np.abs(counts0 - exp0) < 4 * sig0 + 1).all(), (counts0, exp0)
+
+    # slot 1 marginal == p_1 conditional on n >= 1 (slot 1 exists & valid)
+    sel = s1[n >= 1]
+    counts1 = np.bincount(sel, minlength=v)
+    exp1 = np.asarray(p[1]) * len(sel)
+    sig1 = np.sqrt(exp1 * (1 - np.asarray(p[1])))
+    assert len(sel) > 1200  # enough mass for the bound to mean something
+    assert (np.abs(counts1 - exp1) < 4 * sig1 + 1).all(), (counts1, exp1)
