@@ -37,6 +37,20 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             "tied embeddings not supported: this stack keeps a separate "
             "lm_head (untie the checkpoint before converting)"
         )
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        # Llama-3.1+ ships rope_scaling (rope_type "llama3" frequency
+        # rescale); converting while silently dropping it would compute
+        # wrong rotary frequencies at every position — refuse instead.
+        raise NotImplementedError(
+            f"rope_scaling {scaling!r} not supported: this stack computes "
+            "plain rotary frequencies from rope_theta"
+        )
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise NotImplementedError(
+            f"hidden_act {act!r} not supported: the MLP hardcodes silu"
+        )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

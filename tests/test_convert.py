@@ -97,3 +97,21 @@ def test_shape_mismatch_raises():
     sd["model.embed_tokens.weight"] = torch.zeros(32, 64)
     with pytest.raises(ValueError, match="embed"):
         params_from_hf(sd, cfg)
+
+
+def test_rope_scaling_rejected():
+    _, hf_cfg = _tiny_hf()
+    hf_cfg.rope_scaling = {
+        "rope_type": "llama3", "factor": 8.0,
+        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 8192,
+    }
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
+
+
+def test_non_silu_activation_rejected():
+    _, hf_cfg = _tiny_hf()
+    hf_cfg.hidden_act = "gelu"
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        config_from_hf(hf_cfg)

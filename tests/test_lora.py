@@ -1,0 +1,91 @@
+"""LoRA: zero-init identity, frozen-base training, merge equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+)
+from k8s_gpu_device_plugin_tpu.models.lora import (
+    LoraConfig,
+    init_lora_params,
+    init_lora_state,
+    make_lora_train_step,
+    merge_lora,
+)
+from k8s_gpu_device_plugin_tpu.models.train import synthetic_batch
+from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _setup(targets=("wq", "wk", "wv", "wo")):
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    lora = LoraConfig(rank=4, alpha=8.0, targets=targets)
+    lp = init_lora_params(jax.random.key(1), cfg, lora)
+    return cfg, params, lora, lp
+
+
+def test_zero_init_is_identity():
+    """B = 0 => merged model == base model exactly at step 0."""
+    cfg, params, lora, lp = _setup()
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :]
+    base = forward(params, tokens, cfg)
+    merged = forward(merge_lora(params, lp, lora), tokens, cfg)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(base), atol=1e-6)
+
+
+def test_lora_training_reduces_loss_and_freezes_base():
+    cfg, params, lora, lp = _setup()
+    mesh = make_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    optimizer = optax.adam(1e-2)
+    state = init_lora_state(jax.random.key(1), cfg, lora, optimizer)
+    batch = synthetic_batch(jax.random.key(2), cfg, 4, 32, mesh)
+    step = make_lora_train_step(params, cfg, mesh, lora, optimizer)
+
+    base_before = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    first = None
+    for _ in range(12):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first  # overfit one batch through the factors alone
+    # the base pytree is untouched (it is a closure constant)
+    for a, b in zip(jax.tree.leaves(base_before), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(a, np.asarray(b, np.float32))
+    # only the targeted factors changed; every b is now nonzero somewhere
+    assert any(
+        float(jnp.abs(state["lora"][t]["b"]).sum()) > 0
+        for t in lora.targets
+    )
+
+
+def test_mlp_targets_work():
+    cfg, params, lora, lp = _setup(targets=("w1", "w2", "w3"))
+    tokens = jnp.arange(8, dtype=jnp.int32)[None, :]
+    merged = forward(merge_lora(params, lp, lora), tokens, cfg)
+    assert bool(jnp.isfinite(merged).all())
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="rank"):
+        LoraConfig(rank=0)
+    with pytest.raises(ValueError, match="untargetable"):
+        LoraConfig(targets=("embed",))
+    cfg = LlamaConfig.tiny(n_layers=1, n_experts=4)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        init_lora_params(
+            jax.random.key(0), cfg, LoraConfig(targets=("w1",))
+        )
+
+
+def test_moe_attention_targets_allowed():
+    cfg = LlamaConfig.tiny(n_layers=1, n_experts=4)
+    lp = init_lora_params(jax.random.key(0), cfg, LoraConfig())
+    assert set(lp) == {"wq", "wk", "wv", "wo"}
