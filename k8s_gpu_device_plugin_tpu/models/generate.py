@@ -82,12 +82,59 @@ def _cached_attention(q, k_cache, v_cache, length, cfg: LlamaConfig):
     return out.reshape(b, t, hq, hd).astype(q.dtype)
 
 
+_MOE_PREFILL_CHUNK = 128
+
+
+def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
+    """MoE MLP for decode: dense-compute every expert, mix by the top-k
+    renormalized gates (the same ``router_topk`` as training).
+
+    Decode has no capacity competition — each token simply runs its top-k
+    experts — so this matches the training forward exactly whenever
+    training's capacity didn't drop tokens (always true for the ample-
+    capacity serving case). Computing all E experts costs E/k times the
+    sparse FLOPs, which at decode's T=1..few tokens is noise and buys a
+    gather-free static-shape graph. Prefill (large T) is scanned in
+    token chunks so the (B, T, E, F) intermediates never materialize
+    beyond one chunk — routing is per-token, so chunking is exact.
+    """
+    from k8s_gpu_device_plugin_tpu.models.moe import router_topk
+
+    b, t, d = h.shape
+    if t > _MOE_PREFILL_CHUNK:
+        c = _MOE_PREFILL_CHUNK
+        n = -(-t // c)
+        hp = jnp.pad(h, ((0, 0), (0, n * c - t), (0, 0)))
+        chunks = hp.reshape(b, n, c, d).transpose(1, 0, 2, 3)  # (n,B,c,D)
+
+        def body(_, hc):
+            return None, _decode_moe_mlp(hc, layer, cfg)
+
+        _, out = jax.lax.scan(body, None, chunks)
+        return out.transpose(1, 0, 2, 3).reshape(b, n * c, d)[:, :t]
+
+    logits = h.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    gates, idx, _ = router_topk(logits, cfg.n_experts_per_token)  # (B,T,k)
+    mix = jnp.sum(
+        jax.nn.one_hot(idx, cfg.n_experts, dtype=jnp.float32)
+        * gates[..., None],
+        axis=2,
+    )                                                            # (B,T,E)
+    gate = jax.nn.silu(
+        jnp.einsum("btd,edf->btef", h, layer["moe_w1"]).astype(jnp.float32)
+    ).astype(h.dtype)
+    up = jnp.einsum("btd,edf->btef", h, layer["moe_w3"])
+    y = jnp.einsum("btef,efd->bted", gate * up, layer["moe_w2"])
+    return jnp.einsum("bte,bted->btd", mix.astype(h.dtype), y)
+
+
 def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
     """One transformer block over T new tokens with cache read+write.
 
     Returns (x_out, k_cache, v_cache) with the new tokens' K/V written at
     ``length + arange(T)``. Same algebra as the training ``_block``
-    (models/llama.py) minus sharding annotations and MoE (dense decode)."""
+    (models/llama.py) minus sharding annotations; MoE MLPs run the
+    dense-mix decode path (``_decode_moe_mlp``)."""
     b, t, d = x.shape
     hd = cfg.head_dim
 
@@ -109,9 +156,12 @@ def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
     x = x + (attn.reshape(b, t, cfg.n_heads * hd) @ layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-    up = h @ layer["w3"]
-    x = x + ((gate * up) @ layer["w2"])
+    if cfg.is_moe:
+        x = x + _decode_moe_mlp(h, layer, cfg)
+    else:
+        gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+        up = h @ layer["w3"]
+        x = x + ((gate * up) @ layer["w2"])
     return x, k_cache, v_cache
 
 
@@ -173,8 +223,6 @@ def generate(
     ``sampler`` (models/sampling.py) gives top-k/top-p control; the plain
     ``temperature`` arg is shorthand for ``Sampler(temperature=...)``.
     """
-    if cfg.is_moe:
-        raise NotImplementedError("decode path is dense-only for now")
     if cfg.quant != "none":
         # _decode_block runs plain bf16 matmuls; silently accepting an int8
         # config would decode with different numerics than the training
