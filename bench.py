@@ -116,14 +116,23 @@ def main() -> int:
         else None
     )
 
-    # Secondary diagnostic: int8-matmul train throughput, only with budget
-    # left after the primary workloads (never risks the main metric).
+    # Secondary diagnostics, only with budget left after the primary
+    # workloads (never risk the main metric): int8-matmul train throughput,
+    # then serving-side decode throughput.
     remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
     train_int8 = (
         run_workload(
             "train_int8", timeout=min(480, remaining - 20), platforms=tpu_platforms
         )
         if train and remaining > 200
+        else None
+    )
+    remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
+    decode = (
+        run_workload(
+            "decode", timeout=min(420, remaining - 20), platforms=tpu_platforms
+        )
+        if train and remaining > 180
         else None
     )
 
@@ -144,6 +153,11 @@ def main() -> int:
         # standard accounting: bf16 6N model FLOPs vs bf16 peak ("bf16-
         # equivalent throughput"); the int8 path can exceed 100 in principle
         extra["train_int8_accounting"] = "bf16_model_flops_vs_bf16_peak"
+    if decode:
+        extra["decode_tokens_per_second"] = decode["decode_tokens_per_second"]
+        extra["decode_prefill_ms"] = decode["prefill_ms"]
+        extra["decode_hbm_util_pct"] = decode["hbm_util_pct"]
+        extra["decode_shape"] = decode["decode_shape"]
     if allocated:
         extra["allocated_matmul_mfu_pct"] = allocated["mfu_pct"]
         extra["allocated_matmul_n"] = allocated.get("n")

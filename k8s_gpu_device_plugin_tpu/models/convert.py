@@ -89,10 +89,10 @@ def params_from_hf(
 
     def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
         ws = [take(fmt.format(i), transpose) for i in range(cfg.n_layers)]
-        return jnp.asarray(np.stack(ws), cfg.dtype)
+        return jnp.asarray(np.stack(ws), cfg.p_dtype)
 
     params = {
-        "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.dtype),
+        "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.p_dtype),
         "layers": {
             "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
             "mlp_norm": stack(
@@ -106,8 +106,8 @@ def params_from_hf(
             "w3": stack("model.layers.{}.mlp.up_proj.weight", True),
             "w2": stack("model.layers.{}.mlp.down_proj.weight", True),
         },
-        "final_norm": jnp.asarray(take("model.norm.weight"), cfg.dtype),
-        "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.dtype),
+        "final_norm": jnp.asarray(take("model.norm.weight"), cfg.p_dtype),
+        "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.p_dtype),
     }
 
     expected = {

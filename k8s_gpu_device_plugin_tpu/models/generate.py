@@ -173,6 +173,12 @@ def _forward_cached(
     layers with cache update. Returns (logits (B, T, V) f32, new cache);
     ``last_only`` projects only the final position (prefill wants one
     next-token distribution, not a (B, P, V) logits tensor)."""
+    from k8s_gpu_device_plugin_tpu.models.llama import cast_params_for_compute
+
+    # master-weight checkpoints (param_dtype=f32) decode in compute dtype —
+    # without this, every matmul would promote to f32 and the bf16 cache
+    # contract in _cached_attention would silently upcast
+    params = cast_params_for_compute(params, cfg)
     b, t = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = length + jnp.arange(t, dtype=jnp.int32)

@@ -58,6 +58,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq: int = 8192
     dtype: Any = jnp.bfloat16
+    # Storage dtype for parameters (None = same as ``dtype``). Set
+    # jnp.float32 for mixed-precision master weights: optimizer updates
+    # smaller than a bf16 ulp are retained, while every matmul still runs
+    # in ``dtype`` on the MXU (weights cast once per step). Costs 2x the
+    # param/grad/moment HBM.
+    param_dtype: Any = None
     remat: bool = True
     attn_impl: str = "auto"  # auto | full | ring | ulysses
     # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
@@ -92,6 +98,11 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def p_dtype(self) -> Any:
+        """Parameter storage dtype (master weights when f32)."""
+        return self.param_dtype if self.param_dtype is not None else self.dtype
 
     @property
     def is_moe(self) -> bool:
@@ -164,12 +175,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
 
     def norm_init(key, shape, scale):
         return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32) * scale
-                ).astype(cfg.dtype)
+                ).astype(cfg.p_dtype)
 
     ks = jax.random.split(k_layers, 7)
     layers = {
-        "attn_norm": jnp.ones((L, d), cfg.dtype),
-        "mlp_norm": jnp.ones((L, d), cfg.dtype),
+        "attn_norm": jnp.ones((L, d), cfg.p_dtype),
+        "mlp_norm": jnp.ones((L, d), cfg.p_dtype),
         "wq": norm_init(ks[0], (L, d, cfg.n_heads * hd), std),
         "wk": norm_init(ks[1], (L, d, cfg.n_kv_heads * hd), std),
         "wv": norm_init(ks[2], (L, d, cfg.n_kv_heads * hd), std),
@@ -188,7 +199,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     return {
         "embed": norm_init(k_embed, (cfg.vocab_size, d), std),
         "layers": layers,
-        "final_norm": jnp.ones((d,), cfg.dtype),
+        "final_norm": jnp.ones((d,), cfg.p_dtype),
         "lm_head": norm_init(k_head, (d, cfg.vocab_size), std),
     }
 
@@ -233,6 +244,26 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
         param_specs(cfg, pp=pp),
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def cast_params_for_compute(params: dict, cfg: LlamaConfig) -> dict:
+    """Master-weight cast: layer stacks -> compute dtype, once.
+
+    The MoE router is exempt: routing is precision-sensitive and moe.py
+    consumes it in f32 — a bf16 round-trip would perturb top-k. No-op
+    (returns ``params`` unchanged) when storage == compute dtype; callers
+    that scan over microbatches (train.py grad accumulation) invoke this
+    BEFORE their scan so the full-weight cast is not loop-body work.
+    """
+    if cfg.p_dtype == cfg.dtype:
+        return params
+    return {
+        **params,
+        "layers": {
+            k: (v if k == "router" else v.astype(cfg.dtype))
+            for k, v in params["layers"].items()
+        },
+    }
 
 
 # --- model pieces ---------------------------------------------------------
@@ -360,6 +391,10 @@ def forward_with_aux(
     ``return_hidden`` stops before the lm_head and returns the final normed
     hidden states (B, S, D) instead — the seam fused-CE training uses."""
     b, s = tokens.shape
+    # master-weight path (no-op otherwise); idempotent, so callers that
+    # already cast (train.py hoists this out of the grad-accum scan) pay
+    # nothing extra
+    params = cast_params_for_compute(params, cfg)
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, P(BATCH, AXIS_SP, None))
     positions = jnp.arange(s, dtype=jnp.int32)

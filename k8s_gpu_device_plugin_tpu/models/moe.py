@@ -204,7 +204,7 @@ def moe_param_init(key: jax.Array, cfg: "LlamaConfig") -> dict:
     def init(key, shape, scale):
         return (
             jax.random.truncated_normal(key, -3, 3, shape, jnp.float32) * scale
-        ).astype(cfg.dtype)
+        ).astype(cfg.p_dtype)
 
     return {
         # router stays f32: tiny, and routing decisions are precision-sensitive

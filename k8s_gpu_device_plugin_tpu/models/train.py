@@ -174,9 +174,20 @@ def make_train_step(
             (_, metrics), grads = grad_fn(state["params"], batch)
         else:
             micro = _microbatch(batch, grad_accum, mesh, "grad_accum")
+            # Master-weight cast hoisted OUT of the scan: inside the body it
+            # would re-read/convert the full weight stacks every microbatch
+            # (LICM does not hoist large materializing converts). The cast's
+            # Jacobian is identity, so accumulating the bf16-tree grads and
+            # casting back to the storage dtype at the end is the exact
+            # chain rule through it.
+            from k8s_gpu_device_plugin_tpu.models.llama import (
+                cast_params_for_compute,
+            )
+
+            compute_params = cast_params_for_compute(state["params"], cfg)
 
             def accum_body(acc, mb):
-                (_, m), g = grad_fn(state["params"], mb)
+                (_, m), g = grad_fn(compute_params, mb)
                 acc = jax.tree.map(
                     lambda a, gi: a + gi.astype(jnp.float32), acc, g
                 )

@@ -312,6 +312,10 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--quant", default="none", choices=["none", "int8"],
                         help="int8 runs block matmuls on the MXU double-rate "
                         "path (quantized fwd, bf16 bwd)")
+    parser.add_argument("--masterWeights", action="store_true",
+                        help="store params/grads/optimizer moments in f32 "
+                        "(bf16 compute stays on the MXU); retains updates "
+                        "smaller than a bf16 ulp at 2x param memory")
     parser.add_argument("--fusedCE", action="store_true",
                         help="fused lm_head+cross-entropy (no materialized "
                         "logits; tp==1 only, accuracy reported as -1)")
@@ -325,10 +329,14 @@ def _main(argv: list[str] | None = None) -> int:
 
     initialize()  # multi-host rendezvous BEFORE jax.devices()
     model = getattr(LlamaConfig, args.preset)()
-    if args.quant != "none" or args.fusedCE:
+    if args.quant != "none" or args.fusedCE or args.masterWeights:
+        import jax.numpy as jnp
         from dataclasses import replace as _replace
 
-        model = _replace(model, quant=args.quant, fused_ce=args.fusedCE)
+        model = _replace(
+            model, quant=args.quant, fused_ce=args.fusedCE,
+            param_dtype=jnp.float32 if args.masterWeights else None,
+        )
     spec = MeshSpec.for_devices(
         len(jax.devices()), tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
         fsdp=args.fsdp,

@@ -160,3 +160,17 @@ def test_moe_prefill_chunked_matches_unchunked():
     np.testing.assert_allclose(
         np.asarray(last), np.asarray(full), atol=1e-4, rtol=1e-4
     )
+
+
+def test_master_weight_params_decode_in_compute_dtype():
+    """param_dtype=f32 checkpoints must decode identically to the same
+    weights stored in bf16 — the decode path casts to compute dtype
+    instead of silently running f32 matmuls against the bf16 cache."""
+    cfg32 = LlamaConfig.tiny(n_layers=2, param_dtype=jnp.float32)
+    cfg16 = LlamaConfig.tiny(n_layers=2)
+    p32 = init_params(jax.random.key(0), cfg32)
+    p16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), p32)
+    prompt = jnp.arange(1, 9, dtype=jnp.int32)[None, :]
+    a = generate(p32, prompt, cfg32, max_new=6)
+    b = generate(p16, prompt, cfg16, max_new=6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
