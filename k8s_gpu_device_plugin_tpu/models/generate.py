@@ -41,31 +41,76 @@ from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
 
 @dataclass(frozen=True)
 class KVCache:
-    """Per-layer stacked K/V at native kv heads: (L, B, max_len, Hkv, hd)."""
+    """Per-layer stacked K/V at native kv heads: (L, B, max_len, Hkv, hd).
+
+    With ``cfg.cache_quant == "int8"`` the K/V arrays are int8 and
+    ``k_scale``/``v_scale`` hold per-(position, head) f32 scales
+    (L, B, max_len, Hkv, 1): half the cache HBM traffic and twice the
+    context capacity, dequantized on read (the dequant fuses into the
+    attention einsums). Scales are None on the bf16 path."""
 
     k: jax.Array
     v: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @staticmethod
     def init(cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
         shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.cache_quant == "int8":
+            sshape = shape[:-1] + (1,)
+            return KVCache(
+                k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(sshape, jnp.float32),
+                v_scale=jnp.zeros(sshape, jnp.float32),
+            )
         return KVCache(
             k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
         )
 
 
-jax.tree_util.register_dataclass(KVCache, ("k", "v"), ())
+jax.tree_util.register_dataclass(KVCache, ("k", "v", "k_scale", "v_scale"), ())
 
 
-def _cached_attention(q, k_cache, v_cache, length, cfg: LlamaConfig):
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(B, T, H, hd) -> (int8 values, f32 per-(token, head) scales).
+
+    Same symmetric recipe as the weight/activation path (ops/quant.py) —
+    one implementation so cache-quant and weight-quant numerics can never
+    drift apart."""
+    from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
+
+    return quantize_int8(x, axis=-1)
+
+
+def _cache_write(cache, scale, x, length):
+    """Write T new tokens' K or V at ``length``; quantizing when the cache
+    is int8 (scale is the matching scale plane, else None)."""
+    if scale is None:
+        cache = jax.lax.dynamic_update_slice(
+            cache, x.astype(cache.dtype), (0, length, 0, 0)
+        )
+        return cache, None
+    q, s = _quantize_kv(x)
+    cache = jax.lax.dynamic_update_slice(cache, q, (0, length, 0, 0))
+    scale = jax.lax.dynamic_update_slice(scale, s, (0, length, 0, 0))
+    return cache, scale
+
+
+def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
+                      cfg: LlamaConfig):
     """q: (B, T, Hq, hd) attends over cache[:, :max_len] masked to
     positions < length + T (rows are the T new tokens at absolute
     positions length..length+T-1). All-f32 softmax."""
     b, t, hq, hd = q.shape
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
-    # bf16 operands + f32 accumulation (MXU native rate); the bf16 cache is
-    # never upcast in HBM — decode is bandwidth-bound.
+    # bf16 operands + f32 accumulation (MXU native rate); the cache is
+    # never upcast in HBM — decode is bandwidth-bound. int8 caches
+    # dequantize on read; XLA fuses the scale multiply into the einsums.
+    if k_scale is not None:
+        k_cache = k_cache.astype(q.dtype) * k_scale.astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype) * v_scale.astype(q.dtype)
     qg = q.reshape(b, t, cfg.n_kv_heads, group, hd)
     scores = jnp.einsum(
         "btkgd,bskd->btkgs", qg, k_cache,
@@ -128,13 +173,14 @@ def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
     return jnp.einsum("bte,bted->btd", mix.astype(h.dtype), y)
 
 
-def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
+def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
+                  positions, cfg):
     """One transformer block over T new tokens with cache read+write.
 
-    Returns (x_out, k_cache, v_cache) with the new tokens' K/V written at
-    ``length + arange(T)``. Same algebra as the training ``_block``
-    (models/llama.py) minus sharding annotations; MoE MLPs run the
-    dense-mix decode path (``_decode_moe_mlp``)."""
+    Returns (x_out, k_cache, v_cache, k_scale, v_scale) with the new
+    tokens' K/V written at ``length + arange(T)``. Same algebra as the
+    training ``_block`` (models/llama.py) minus sharding annotations; MoE
+    MLPs run the dense-mix decode path (``_decode_moe_mlp``)."""
     b, t, d = x.shape
     hd = cfg.head_dim
 
@@ -145,14 +191,10 @@ def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, length, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, length, 0, 0)
-    )
+    k_cache, k_scale = _cache_write(k_cache, k_scale, k, length)
+    v_cache, v_scale = _cache_write(v_cache, v_scale, v, length)
 
-    attn = _cached_attention(q, k_cache, v_cache, length, cfg)
+    attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length, cfg)
     x = x + (attn.reshape(b, t, cfg.n_heads * hd) @ layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -162,7 +204,7 @@ def _decode_block(x, layer, k_cache, v_cache, length, positions, cfg):
         gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
         up = h @ layer["w3"]
         x = x + ((gate * up) @ layer["w2"])
-    return x, k_cache, v_cache
+    return x, k_cache, v_cache, k_scale, v_scale
 
 
 def _forward_cached(
@@ -183,16 +225,19 @@ def _forward_cached(
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = length + jnp.arange(t, dtype=jnp.int32)
 
+    # None scale planes are empty pytree leaves — lax.scan carries them
+    # through untouched, so the bf16 and int8 paths share one structure
     def body(carry, layer_and_cache):
         x = carry
-        layer, k_c, v_c = layer_and_cache
-        x, k_c, v_c = _decode_block(
-            x, layer, k_c, v_c, length, positions, cfg
+        layer, k_c, v_c, k_s, v_s = layer_and_cache
+        x, k_c, v_c, k_s, v_s = _decode_block(
+            x, layer, k_c, v_c, k_s, v_s, length, positions, cfg
         )
-        return x, (k_c, v_c)
+        return x, (k_c, v_c, k_s, v_s)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        body, x,
+        (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
@@ -201,7 +246,9 @@ def _forward_cached(
         x, params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, KVCache(k=k_new, v=v_new)
+    return logits, KVCache(
+        k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
+    )
 
 
 def prefill(params, prompt, cache: KVCache, cfg: LlamaConfig):

@@ -69,6 +69,10 @@ class LlamaConfig:
     # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
     # int8 path (ops/quant.py: quantized fwd, bf16 bwd); "none" = pure bf16.
     quant: str = "none"
+    # KV-cache storage for decode (models/generate.py): "int8" quantizes
+    # cached K/V per (position, head) with f32 scales — half the cache HBM
+    # traffic and twice the context capacity of bf16, dequantized on read.
+    cache_quant: str = "none"
     # Fused lm_head+cross-entropy (ops/fused_ce.py): never materializes the
     # (B,S,V) logits. Training-loss only (no logits output, no accuracy);
     # requires the vocab axis unsharded (tp == 1) — loss_fn falls back
@@ -93,6 +97,12 @@ class LlamaConfig:
             raise ValueError(
                 f"quant must be 'none' or 'int8', got {self.quant!r} — "
                 "an unknown value would silently run pure bf16"
+            )
+        if self.cache_quant not in ("none", "int8"):
+            raise ValueError(
+                f"cache_quant must be 'none' or 'int8', got "
+                f"{self.cache_quant!r} — an unknown value would silently "
+                "run a bf16 cache"
             )
 
     @property
