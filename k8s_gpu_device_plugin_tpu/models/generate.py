@@ -106,22 +106,30 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
     # bf16 operands + f32 accumulation (MXU native rate); the cache is
-    # never upcast in HBM — decode is bandwidth-bound. int8 caches
-    # dequantize on read; XLA fuses the scale multiply into the einsums.
-    if k_scale is not None:
-        k_cache = k_cache.astype(q.dtype) * k_scale.astype(q.dtype)
-        v_cache = v_cache.astype(q.dtype) * v_scale.astype(q.dtype)
+    # never upcast in HBM — decode is bandwidth-bound. int8 caches keep
+    # the int8 arrays as the dot operands (a bare convert fuses into the
+    # dot; an elementwise scale-multiply producer may not, which would
+    # materialize a full bf16 cache copy and invert the HBM saving); the
+    # per-(position, head) scales commute through the s-contractions, so
+    # they apply to scores after the K dot and to probs before the V dot.
     qg = q.reshape(b, t, cfg.n_kv_heads, group, hd)
     scores = jnp.einsum(
-        "btkgd,bskd->btkgs", qg, k_cache,
+        "btkgd,bskd->btkgs", qg, k_cache.astype(q.dtype),
         preferred_element_type=jnp.float32,
     ) * (hd ** -0.5)
+    if k_scale is not None:
+        # (B, S, Hkv, 1) -> (B, Hkv, S) -> broadcast over (b, t, k, g, s)
+        ks = k_scale[..., 0].transpose(0, 2, 1)
+        scores = scores * ks[:, None, :, None, :]
     q_pos = length + jnp.arange(t)[None, :, None, None, None]
     k_pos = jnp.arange(max_len)[None, None, None, None, :]
     scores = jnp.where(k_pos <= q_pos, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)  # f32
+    if v_scale is not None:
+        vs = v_scale[..., 0].transpose(0, 2, 1)
+        probs = probs * vs[:, None, :, None, :]
     out = jnp.einsum(
-        "btkgs,bskd->btkgd", probs.astype(q.dtype), v_cache,
+        "btkgs,bskd->btkgd", probs.astype(q.dtype), v_cache.astype(q.dtype),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, t, hq, hd).astype(q.dtype)

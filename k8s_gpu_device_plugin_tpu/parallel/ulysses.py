@@ -70,9 +70,12 @@ def _ulysses_local(q, k, v, *, causal, axis, scale):
     def heads_to_seq(x):
         return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
-    from k8s_gpu_device_plugin_tpu.ops.attention import mha_reference
+    # the dispatcher, not mha_reference: on TPU the per-shard full-sequence
+    # attention is exactly the long-S case the Pallas flash kernel exists
+    # for (the reference materializes (B, H, S, S) f32 scores per shard)
+    from k8s_gpu_device_plugin_tpu.ops.attention import attention
 
-    out = mha_reference(
+    out = attention(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal, scale=scale
     )
     return heads_to_seq(out)

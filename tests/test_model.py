@@ -366,3 +366,27 @@ def test_master_weights_moe_router_stays_f32_and_trains():
         if first is None:
             first = float(m["loss"])
     assert float(m["loss"]) < first
+
+
+def test_ulysses_routes_through_dispatcher(sp_mesh, monkeypatch):
+    """Ulysses must call the dispatching attention entry point (flash on
+    TPU), not the score-materializing reference directly."""
+    import importlib
+
+    attn_mod = importlib.import_module("k8s_gpu_device_plugin_tpu.ops.attention")
+
+    calls = []
+    orig = attn_mod.attention
+
+    def spy(*args, **kw):
+        calls.append(1)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(attn_mod, "attention", spy)
+    q, k, v = make_qkv(jax.random.key(4))
+    out = ulysses_attention(q, k, v, sp_mesh, causal=True)
+    assert calls, "ulysses bypassed the attention dispatcher"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(mha_reference(q, k, v, causal=True)),
+        atol=2e-5,
+    )
