@@ -65,6 +65,21 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     )
 
 
+# per-layer tensor mapping, shared by BOTH directions so the round-trip
+# can never drift: ours -> (HF name suffix, transpose?)
+_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "w1": ("mlp.gate_proj.weight", True),
+    "w3": ("mlp.up_proj.weight", True),
+    "w2": ("mlp.down_proj.weight", True),
+}
+
+
 def _to_np(t: Any) -> np.ndarray:
     """torch tensor / np array -> f32 numpy (torch never imported here)."""
     if hasattr(t, "detach"):  # torch tensor
@@ -94,17 +109,8 @@ def params_from_hf(
     params = {
         "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.p_dtype),
         "layers": {
-            "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
-            "mlp_norm": stack(
-                "model.layers.{}.post_attention_layernorm.weight"
-            ),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
-            "w1": stack("model.layers.{}.mlp.gate_proj.weight", True),
-            "w3": stack("model.layers.{}.mlp.up_proj.weight", True),
-            "w2": stack("model.layers.{}.mlp.down_proj.weight", True),
+            ours: stack("model.layers.{}." + suffix, transpose)
+            for ours, (suffix, transpose) in _LAYER_MAP.items()
         },
         "final_norm": jnp.asarray(take("model.norm.weight"), cfg.p_dtype),
         "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.p_dtype),
@@ -133,3 +139,38 @@ def params_from_hf(
     if leftover:
         raise ValueError(f"unconsumed checkpoint tensors: {leftover[:5]}")
     return params
+
+
+def params_to_hf(params: dict, cfg: LlamaConfig) -> dict:
+    """This framework's pytree -> an HF ``LlamaForCausalLM`` state dict of
+    f32 numpy arrays (load with ``model.load_state_dict`` after wrapping in
+    torch tensors, or write to safetensors). Inverse of
+    :func:`params_from_hf`; the round-trip is test-pinned.
+    """
+    if "router" in params["layers"]:
+        raise NotImplementedError(
+            "MoE pytrees have no LlamaForCausalLM equivalent"
+        )
+
+    def np32(x) -> np.ndarray:
+        # contiguous: transposes are views, and torch/safetensors refuse to
+        # serialize non-contiguous tensors
+        return np.ascontiguousarray(np.asarray(x, np.float32))
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np32(params["embed"]),
+        "model.norm.weight": np32(params["final_norm"]),
+        "lm_head.weight": np32(np.asarray(params["lm_head"]).T),
+    }
+    for ours, (theirs, transpose) in _LAYER_MAP.items():
+        stacked = np.asarray(params["layers"][ours], np.float32)
+        if stacked.shape[0] != cfg.n_layers:
+            raise ValueError(
+                f"{ours}: pytree has {stacked.shape[0]} stacked layers but "
+                f"config says n_layers={cfg.n_layers} — a mismatched config "
+                "would silently truncate the exported checkpoint"
+            )
+        for i in range(cfg.n_layers):
+            w = stacked[i]
+            sd[f"model.layers.{i}.{theirs}"] = np32(w.T if transpose else w)
+    return sd
