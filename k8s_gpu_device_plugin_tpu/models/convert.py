@@ -61,6 +61,10 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         rope_theta=float(hf_config.rope_theta),
         norm_eps=float(hf_config.rms_norm_eps),
         max_seq=int(getattr(hf_config, "max_position_embeddings", 8192)),
+        # Mistral-style checkpoints are layout-identical to Llama but were
+        # trained with windowed attention — dropping the window would
+        # silently attend beyond what the model ever saw
+        sliding_window=int(getattr(hf_config, "sliding_window", None) or 0),
         dtype=dtype,
     )
 

@@ -123,7 +123,10 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
         scores = scores * ks[:, None, :, None, :]
     q_pos = length + jnp.arange(t)[None, :, None, None, None]
     k_pos = jnp.arange(max_len)[None, None, None, None, :]
-    scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+    keep = k_pos <= q_pos
+    if cfg.sliding_window > 0:
+        keep &= q_pos - k_pos < cfg.sliding_window
+    scores = jnp.where(keep, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)  # f32
     if v_scale is not None:
         vs = v_scale[..., 0].transpose(0, 2, 1)

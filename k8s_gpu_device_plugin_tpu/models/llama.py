@@ -57,6 +57,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     max_seq: int = 8192
+    # Mistral-style sliding-window attention (0 = full causal): query i
+    # attends keys in (i - window, i]. Full/flash and decode paths only;
+    # ring/Ulysses sequence parallelism reject it (the ring rotation
+    # assumes full causal structure).
+    sliding_window: int = 0
     dtype: Any = jnp.bfloat16
     # Storage dtype for parameters (None = same as ``dtype``). Set
     # jnp.float32 for mixed-precision master weights: optimizer updates
@@ -135,6 +140,14 @@ class LlamaConfig:
         return LlamaConfig(
             vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
             n_kv_heads=8, d_ff=28672, rope_theta=500000.0, max_seq=8192,
+        )
+
+    @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, rope_theta=10000.0, max_seq=32768,
+            sliding_window=4096,
         )
 
     @staticmethod
@@ -333,12 +346,17 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh: Mesh | None) -> jax.Array:
     if impl == "auto":
         impl = "ring" if sp > 1 else "full"
     if impl in ("ring", "ulysses") and sp > 1:
+        if cfg.sliding_window > 0:
+            raise NotImplementedError(
+                "sliding_window is not supported with sequence parallelism "
+                "(ring/Ulysses); use sp=1 or full attention"
+            )
         fn = ring_attention if impl == "ring" else ulysses_attention
         return fn(q, k, v, mesh, causal=True)
     # single-shard path: full causal attention (f32 softmax)
     from k8s_gpu_device_plugin_tpu.ops.attention import attention
 
-    return attention(q, k, v, causal=True)
+    return attention(q, k, v, causal=True, window=cfg.sliding_window)
 
 
 def _block(x, layer, cfg: LlamaConfig, positions, mesh):

@@ -25,8 +25,14 @@ def mha_reference(
     v: jax.Array,
     causal: bool = True,
     scale: float | None = None,
+    window: int = 0,
 ) -> jax.Array:
-    """(B, S, H, D) attention with f32 softmax; K/V may be grouped."""
+    """(B, S, H, D) attention with f32 softmax; K/V may be grouped.
+
+    ``window > 0`` adds Mistral-style sliding-window masking: query i
+    attends keys in (i - window, i] (requires ``causal``)."""
+    if window > 0 and not causal:
+        raise ValueError("sliding window requires causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     k = _expand_kv(k, q.shape[2])
     v = _expand_kv(v, q.shape[2])
@@ -38,9 +44,11 @@ def mha_reference(
     )
     if causal:
         s_q, s_k = scores.shape[-2:]
-        mask = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0) >= (
-            jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        )
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        mask = q_pos >= k_pos
+        if window > 0:
+            mask = mask & (q_pos - k_pos < window)
         scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
@@ -53,6 +61,7 @@ def attention(
     v: jax.Array,
     causal: bool = True,
     scale: float | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatching attention entry point used by the models."""
     if jax.default_backend() == "tpu":
@@ -62,8 +71,10 @@ def attention(
                 supports,
             )
 
-            if supports(q, k, v):
-                return flash_attention(q, k, v, causal=causal, scale=scale)
+            if supports(q, k, v) and (window == 0 or causal):
+                return flash_attention(
+                    q, k, v, causal=causal, scale=scale, window=window
+                )
         except ImportError:
             pass
-    return mha_reference(q, k, v, causal=causal, scale=scale)
+    return mha_reference(q, k, v, causal=causal, scale=scale, window=window)

@@ -77,7 +77,7 @@ def supports(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_q, block_k):
+                scale, causal, window, block_q, block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -103,7 +103,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+            keep = q_pos >= k_pos
+            if window > 0:
+                keep &= q_pos - k_pos < window
+            scores = jnp.where(keep, scores, _NEG_BIG)
 
         m_prev = m_scr[:, 0]                    # (bq,)
         m_new = jnp.maximum(m_prev, scores.max(axis=-1))
@@ -118,8 +121,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = l_new[:, None]
 
     if causal:
-        # skip kv blocks strictly above the diagonal
-        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        # skip kv blocks strictly above the diagonal, and (with a sliding
+        # window) blocks entirely below every query row's window
+        pred = ki * block_k <= qi * block_q + (block_q - 1)
+        if window > 0:
+            pred &= ki * block_k + (block_k - 1) >= qi * block_q - (window - 1)
+
+        @pl.when(pred)
         def _():
             _compute()
     else:
@@ -146,8 +154,8 @@ def _kv_row(b, hq: int, hkv: int):
     return (b // hq) * hkv + (b % hq) // group
 
 
-def _flash_fwd_bhsd(q, k, v, *, hq, hkv, scale, causal, block_q, block_k,
-                    interpret):
+def _flash_fwd_bhsd(q, k, v, *, hq, hkv, scale, causal, window, block_q,
+                    block_k, interpret):
     """q: (B*Hq, S, D); k/v: (B*Hkv, S, D) — GQA-native, no expansion.
 
     Returns (o (B*Hq, S, D), lse (B*Hq, S, 1) f32)."""
@@ -157,7 +165,7 @@ def _flash_fwd_bhsd(q, k, v, *, hq, hkv, scale, causal, block_q, block_k,
     grid = (bh, nq, nk)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k,
     )
     scratch = [
@@ -202,26 +210,33 @@ def _from_bhsd(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret):
-    o, _ = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, scale, causal, window, block_q, block_k, bq_bwd, bk_bwd,
+           interpret):
+    o, _ = _flash_fwd_with_lse(
+        q, k, v, scale, causal, window, block_q, block_k, interpret
+    )
     return o
 
 
-def _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_with_lse(q, k, v, scale, causal, window, block_q, block_k,
+                        interpret):
     b, s, h, d = q.shape
     o, lse = _flash_fwd_bhsd(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
         hq=h, hkv=k.shape[2],
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     return _from_bhsd(o, b, h), lse  # lse stays (BH, S, 1)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
-               interpret):
-    o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k, bq_bwd,
+               bk_bwd, interpret):
+    o, lse = _flash_fwd_with_lse(
+        q, k, v, scale, causal, window, block_q, block_k, interpret
+    )
     return o, (q, k, v, o, lse)
 
 
@@ -232,30 +247,35 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
 # delta := rowsum(do*o) - dlse.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash_lse(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
-               interpret):
-    o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, scale, causal, window, block_q, block_k, bq_bwd,
+               bk_bwd, interpret):
+    o, lse = _flash_fwd_with_lse(
+        q, k, v, scale, causal, window, block_q, block_k, interpret
+    )
     b, s, h, d = q.shape
     return o, lse.reshape(b, h, s)
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
-                   interpret):
-    o, lse = _flash_fwd_with_lse(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_lse_fwd(q, k, v, scale, causal, window, block_q, block_k,
+                   bq_bwd, bk_bwd, interpret):
+    o, lse = _flash_fwd_with_lse(
+        q, k, v, scale, causal, window, block_q, block_k, interpret
+    )
     b, s, h, d = q.shape
     return (o, lse.reshape(b, h, s)), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd(scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret,
-                   residuals, cts):
+def _flash_lse_bwd(scale, causal, window, block_q, block_k, bq_bwd, bk_bwd,
+                   interpret, residuals, cts):
     do, dlse = cts
     q, k, v, o, lse = residuals
     b, s, h, d = q.shape
     dlse_col = dlse.astype(jnp.float32).reshape(b * h, s, 1)
     return _flash_bwd_impl(
         q, k, v, o, lse, do, dlse_col,
-        scale=scale, causal=causal, block_q=bq_bwd, block_k=bk_bwd,
+        scale=scale, causal=causal, window=window,
+        block_q=bq_bwd, block_k=bk_bwd,
         interpret=interpret,
     )
 
@@ -274,7 +294,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
-               scale, causal, block_q, block_k, qi, ki):
+               scale, causal, window, block_q, block_k, qi, ki):
     q = q_ref[0].astype(jnp.float32)            # (bq, d)
     k = k_ref[0].astype(jnp.float32)            # (bk, d)
     v = v_ref[0].astype(jnp.float32)            # (bk, d)
@@ -292,7 +312,10 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+        keep = q_pos >= k_pos
+        if window > 0:
+            keep &= q_pos - k_pos < window
+        scores = jnp.where(keep, scores, _NEG_BIG)
     p = jnp.exp(scores - lse)                   # (bq, bk)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
@@ -303,7 +326,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, causal, block_q, block_k, nq):
+                    scale, causal, window, block_q, block_k, nq):
     """Grid (B*Hkv, nk, nq*group): the inner axis walks every (q head of
     this kv head's group) x (q block); dk/dv accumulate across BOTH in one
     VMEM scratch, so GQA grads come out at native Hkv heads with no
@@ -321,7 +344,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         p, ds, q, _, do = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k,
             qi=qi, ki=ki,
         )
         dv_scr[:] += jax.lax.dot_general(          # p^T do -> (bk, d)
@@ -334,8 +358,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
 
     if causal:
-        # q blocks strictly above the diagonal contribute nothing to this kv
-        @pl.when(qi * block_q + (block_q - 1) >= ki * block_k)
+        # q blocks strictly above the diagonal contribute nothing to this
+        # kv block; with a sliding window, neither do q blocks entirely
+        # past the window's reach
+        pred = qi * block_q + (block_q - 1) >= ki * block_k
+        if window > 0:
+            pred &= qi * block_q <= ki * block_k + (block_k - 1) + (window - 1)
+
+        @pl.when(pred)
         def _():
             _compute()
     else:
@@ -348,7 +378,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+                   dq_ref, dq_scr, *, scale, causal, window, block_q,
+                   block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -360,7 +391,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         _, ds, _, k, _ = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k,
             qi=qi, ki=ki,
         )
         dq_scr[:] += jax.lax.dot_general(          # ds k -> (bq, d)
@@ -369,7 +401,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
+        pred = ki * block_k <= qi * block_q + (block_q - 1)
+        if window > 0:
+            pred &= ki * block_k + (block_k - 1) >= qi * block_q - (window - 1)
+
+        @pl.when(pred)
         def _():
             _compute()
     else:
@@ -381,7 +417,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, hq, hkv, scale, causal,
-                    block_q, block_k, interpret):
+                    window, block_q, block_k, interpret):
     """q/do (B*Hq, S, D); k/v (B*Hkv, S, D); lse/delta (B*Hq, S, 1) f32.
 
     Returns dq at (B*Hq, S, D) and dk/dv at native (B*Hkv, S, D)."""
@@ -413,7 +449,7 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, hq, hkv, scale, causal,
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, nq=nq,
         ),
         grid=(bhkv, nk, nq * group),
@@ -436,7 +472,7 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, hq, hkv, scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal,
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k,
         ),
         grid=(bh, nq, nk),
@@ -450,7 +486,7 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, *, hq, hkv, scale, causal,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
-                    block_q, block_k, interpret):
+                    window, block_q, block_k, interpret):
     """Shared backward: dlse_col is (BH, S, 1) f32 or None. GQA-native:
     k/v stay at Hkv heads; the dkv kernel folds the group sum in VMEM."""
     b, s, h, d = q.shape
@@ -471,7 +507,8 @@ def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
     dq, dk, dv = _flash_bwd_bhsd(
         q_b, k_b, v_b, do_b, lse, delta,
         hq=h, hkv=n_kv,
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
     dq = _from_bhsd(dq, b, h)
@@ -480,12 +517,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, dlse_col, *, scale, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret,
-               residuals, do):
+def _flash_bwd(scale, causal, window, block_q, block_k, bq_bwd, bk_bwd,
+               interpret, residuals, do):
     q, k, v, o, lse = residuals
     return _flash_bwd_impl(
         q, k, v, o, lse, do, None,
-        scale=scale, causal=causal, block_q=bq_bwd, block_k=bk_bwd,
+        scale=scale, causal=causal, window=window,
+        block_q=bq_bwd, block_k=bk_bwd,
         interpret=interpret,
     )
 
@@ -508,6 +546,7 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: float | None = None,
+    window: int = 0,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     block_q_bwd: int | None = None,
@@ -516,6 +555,11 @@ def flash_attention(
     return_lse: bool = False,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """(B, S, H, D) flash attention; K/V may have grouped heads.
+
+    ``window > 0`` adds Mistral-style sliding-window masking (query i sees
+    keys in (i - window, i]; requires ``causal``); kv blocks entirely
+    outside the window are skipped, so long-sequence work scales with
+    ``window`` rather than S.
 
     With ``return_lse`` also returns the per-row logsumexp (B, H, S) f32 —
     differentiable, for blockwise softmax merging (ring attention).
@@ -529,6 +573,8 @@ def flash_attention(
     ``ops.attention.attention`` for automatic XLA fallback.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if window > 0 and not causal:
+        raise ValueError("sliding window requires causal attention")
     s = q.shape[1]
     block_q = _fit_block(block_q, s)
     block_k = _fit_block(block_k, s)
@@ -541,7 +587,8 @@ def flash_attention(
         )
     if return_lse:
         return _flash_lse(
-            q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd, interpret
+            q, k, v, scale, causal, window, block_q, block_k, bq_bwd, bk_bwd,
+            interpret
         )
-    return _flash(q, k, v, scale, causal, block_q, block_k, bq_bwd, bk_bwd,
-                  interpret)
+    return _flash(q, k, v, scale, causal, window, block_q, block_k, bq_bwd,
+                  bk_bwd, interpret)

@@ -170,3 +170,26 @@ def test_params_to_hf_contiguous_and_layer_check():
     assert all(w.flags["C_CONTIGUOUS"] for w in sd.values())
     with pytest.raises(ValueError, match="stacked layers"):
         params_to_hf(params, replace(cfg, n_layers=1))
+
+
+def test_mistral_sliding_window_mapped():
+    """Mistral-style checkpoints (layout-identical to Llama, trained with
+    windowed attention) must carry their window through conversion, and
+    our windowed forward must match transformers' MistralForCausalLM."""
+    cfg_m = transformers.MistralConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False,
+        max_position_embeddings=128, sliding_window=8,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(cfg_m).eval()
+    cfg = config_from_hf(cfg_m, dtype=jnp.float32)
+    assert cfg.sliding_window == 8
+    params = params_from_hf(hf.state_dict(), cfg)
+    # 16 tokens > window 8, so the windowed mask is load-bearing here
+    tokens = np.arange(1, 17, dtype=np.int64)[None, :]
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
