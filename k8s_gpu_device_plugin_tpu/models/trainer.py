@@ -290,7 +290,8 @@ def _main(argv: list[str] | None = None) -> int:
 
     parser = argparse.ArgumentParser(prog="tpu-trainer")
     parser.add_argument("--preset", default="tiny",
-                        choices=["tiny", "llama3_8b", "llama3_70b", "mixtral_8x7b"])
+                        choices=["tiny", "llama3_8b", "llama3_70b",
+                                 "mistral_7b", "mixtral_8x7b"])
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batchSize", type=int, default=8)
     parser.add_argument("--seqLen", type=int, default=128)
