@@ -151,20 +151,35 @@ def _run_breakdown_attn() -> dict:
     }
 
 
-def _run_flash_tune() -> dict:
-    """Flash-kernel block-size sweep at the bench attention shape."""
+def _flash_tune_result(workload: str, **kw) -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.flash_tune import flash_tune
 
     _require_accelerator()
-    r = flash_tune()
+    r = flash_tune(**kw)
     return {
-        "workload": "flash_tune",
+        "workload": workload,
         "shape": list(r.shape),
         "fwd_ms": {k: round(v, 2) for k, v in r.fwd_ms.items()},
         "bwd_ms": {k: round(v, 2) for k, v in r.bwd_ms.items()},
         "best_fwd": r.best_fwd,
         "best_bwd": r.best_bwd,
     }
+
+
+def _run_flash_tune() -> dict:
+    """Flash-kernel block-size sweep at the bench attention shape."""
+    return _flash_tune_result("flash_tune")
+
+
+def _run_flash_tune_long() -> dict:
+    """Same sweep at the long-context shape (S=8192, smaller batch): the
+    tiling optimum shifts with sequence length, and this is the regime the
+    ring/sp path cares about."""
+    return _flash_tune_result(
+        "flash_tune_long", batch=2, seq=8192, iters=4,
+        blocks=((2048, 1024), (1024, 2048), (1024, 1024), (1024, 512),
+                (512, 1024), (512, 512)),
+    )
 
 
 def _run_decode() -> dict:
@@ -236,6 +251,7 @@ WORKLOADS = {
     "breakdown": _run_breakdown,
     "breakdown_attn": _run_breakdown_attn,
     "flash_tune": _run_flash_tune,
+    "flash_tune_long": _run_flash_tune_long,
     "decode": _run_decode,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
