@@ -1,0 +1,103 @@
+"""Beam search over the KV-cache decode path.
+
+Fixed-shape TPU construction: the cache is allocated at ``beam`` batch
+rows up front; every step is one T=1 cached forward over all beams, a
+(beam * V) top-k, and a batch-axis gather that reorders the cache and
+token buffer by each survivor's parent beam — no dynamic shapes, one
+``lax.scan``, one compile. Scores are exact cumulative log-probabilities
+(log-softmax in f32); with a fixed ``max_new`` every hypothesis has the
+same length, so no length normalization is applied.
+
+Guarantees pinned by tests: ``beam=1`` emits exactly the greedy decode;
+each returned score equals the sequence's recomputed log-probability
+under the full-context forward; and for ``max_new=2`` with
+``beam == vocab`` the search is exhaustive, matching brute force.
+
+The reference daemon has no serving stack (SURVEY §2); this completes the
+decode modes (greedy / sampled / speculative / beam).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.generate import KVCache, _forward_cached, prefill
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "beam"))
+def beam_search(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new: int,
+    beam: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """prompt (1, P) -> (sequences (beam, max_new), scores (beam,)).
+
+    Sequences are sorted by score descending (row 0 is the best
+    hypothesis); scores are cumulative token log-probabilities.
+    """
+    if cfg.quant != "none":
+        raise NotImplementedError("decode path is bf16-only (quant='none')")
+    b, p = prompt.shape
+    if b != 1:
+        raise NotImplementedError("beam search decodes one prompt at a time")
+    if beam < 1:
+        raise ValueError(f"beam must be >= 1, got {beam}")
+    if beam > cfg.vocab_size:
+        raise ValueError(
+            f"beam ({beam}) cannot exceed vocab_size ({cfg.vocab_size}): "
+            "there are only vocab_size distinct continuations per step"
+        )
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
+    v = cfg.vocab_size
+
+    # prefill ONCE at batch 1 (all beams share the prompt — a beam-row
+    # prefill would pay beam x the prompt FLOPs for identical results),
+    # then replicate the filled K/V rows (and scale planes) across beams
+    cache = KVCache.init(cfg, 1, p + max_new)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    cache = jax.tree.map(
+        lambda x: None if x is None else jnp.repeat(x, beam, axis=1),
+        cache,
+        is_leaf=lambda x: x is None,
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # first token: top-beam continuations of the single real hypothesis
+    scores, first = jax.lax.top_k(logp[0], beam)        # (beam,)
+    buf = jnp.zeros((beam, max_new), jnp.int32)
+    buf = buf.at[:, 0].set(first)
+
+    def gather_beams(tree, parent):
+        # cache arrays are (L, beam, S, H, hd): reorder the beam axis
+        return jax.tree.map(
+            lambda x: None if x is None else jnp.take(x, parent, axis=1),
+            tree,
+            is_leaf=lambda x: x is None,
+        )
+
+    def step(carry, i):
+        buf, scores, last, cache = carry
+        logits, cache = _forward_cached(params, last[:, None], cache, i, cfg)
+        lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        cand = scores[:, None] + lp                     # (beam, V)
+        scores, flat_idx = jax.lax.top_k(cand.reshape(-1), beam)
+        parent = flat_idx // v
+        tok = (flat_idx % v).astype(jnp.int32)
+        buf = jnp.take(buf, parent, axis=0).at[:, i + 1 - p].set(tok)
+        cache = gather_beams(cache, parent)
+        return (buf, scores, tok, cache), None
+
+    if max_new > 1:
+        (buf, scores, _, _), _ = jax.lax.scan(
+            step,
+            (buf, scores, first, cache),
+            p + jnp.arange(max_new - 1, dtype=jnp.int32),
+        )
+    return buf, scores

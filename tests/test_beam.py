@@ -1,0 +1,110 @@
+"""Beam search: greedy equivalence, score exactness, exhaustive oracle."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.beam import beam_search
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+)
+
+
+def _setup(vocab=16):
+    cfg = LlamaConfig.tiny(
+        n_layers=2, vocab_size=vocab, dtype=jnp.float32
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+    return cfg, params, prompt
+
+
+def _seq_logprob(params, prompt, cfg, seq):
+    """Exact cumulative log-probability of ``seq`` after ``prompt`` via the
+    full-context forward (the oracle for beam scores)."""
+    tokens = jnp.concatenate([prompt, seq[None, :]], axis=1)
+    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = prompt.shape[1]
+    total = 0.0
+    for j in range(seq.shape[0]):
+        total += float(logp[0, p - 1 + j, int(seq[j])])
+    return total
+
+
+def test_beam_one_is_greedy():
+    cfg, params, prompt = _setup()
+    seqs, scores = beam_search(params, prompt, cfg, max_new=6, beam=1)
+    ref = generate(params, prompt, cfg, max_new=6)
+    np.testing.assert_array_equal(np.asarray(seqs), np.asarray(ref))
+
+
+def test_beam_scores_are_exact_logprobs():
+    cfg, params, prompt = _setup()
+    seqs, scores = beam_search(params, prompt, cfg, max_new=4, beam=3)
+    for r in range(3):
+        expected = _seq_logprob(params, prompt, cfg, seqs[r])
+        np.testing.assert_allclose(float(scores[r]), expected, atol=1e-4)
+    # sorted descending
+    s = np.asarray(scores)
+    assert (s[:-1] >= s[1:] - 1e-7).all()
+
+
+def test_beam_at_vocab_width_is_exhaustive_for_two_steps():
+    """beam == vocab keeps every length-1 prefix, so for max_new=2 the
+    search is exact: its best sequence must match brute-force enumeration
+    of all vocab^2 continuations."""
+    cfg, params, prompt = _setup(vocab=12)
+    seqs, scores = beam_search(params, prompt, cfg, max_new=2, beam=12)
+    # brute force, one batched forward over all 144 continuations
+    pairs = jnp.asarray(
+        list(itertools.product(range(12), range(12))), jnp.int32
+    )                                                        # (144, 2)
+    p = prompt.shape[1]
+    tokens = jnp.concatenate(
+        [jnp.broadcast_to(prompt, (144, p)), pairs], axis=1
+    )
+    logp = jax.nn.log_softmax(
+        forward(params, tokens, cfg).astype(jnp.float32), axis=-1
+    )
+    lps = np.asarray(
+        jnp.take_along_axis(
+            logp[:, p - 1], pairs[:, 0:1], axis=1
+        )[:, 0]
+        + jnp.take_along_axis(logp[:, p], pairs[:, 1:2], axis=1)[:, 0]
+    )
+    best = int(np.argmax(lps))
+    assert tuple(np.asarray(seqs[0]).tolist()) == tuple(
+        np.asarray(pairs[best]).tolist()
+    )
+    np.testing.assert_allclose(float(scores[0]), lps[best], atol=1e-4)
+
+
+def test_beam_beats_or_matches_greedy():
+    cfg, params, prompt = _setup()
+    _, scores = beam_search(params, prompt, cfg, max_new=5, beam=4)
+    greedy = generate(params, prompt, cfg, max_new=5)
+    greedy_lp = _seq_logprob(params, prompt, cfg, greedy[0])
+    assert float(scores[0]) >= greedy_lp - 1e-5
+
+
+def test_beam_validation():
+    cfg, params, prompt = _setup()
+    with pytest.raises(ValueError, match="beam"):
+        beam_search(params, prompt, cfg, max_new=2, beam=0)
+    with pytest.raises(NotImplementedError, match="one prompt"):
+        beam_search(
+            params, jnp.zeros((2, 4), jnp.int32), cfg, max_new=2, beam=2
+        )
+
+
+def test_beam_exceeding_vocab_rejected():
+    cfg, params, prompt = _setup(vocab=16)
+    with pytest.raises(ValueError, match="vocab_size"):
+        beam_search(params, prompt, cfg, max_new=2, beam=17)
