@@ -108,3 +108,32 @@ def test_beam_exceeding_vocab_rejected():
     cfg, params, prompt = _setup(vocab=16)
     with pytest.raises(ValueError, match="vocab_size"):
         beam_search(params, prompt, cfg, max_new=2, beam=17)
+
+
+def test_beam_one_is_greedy_moe():
+    """Beam rides _forward_cached, so MoE configs work unchanged."""
+    cfg = LlamaConfig.tiny(
+        n_layers=1, n_experts=4, capacity_factor=8.0, dtype=jnp.float32
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+    seqs, _ = beam_search(params, prompt, cfg, max_new=4, beam=1)
+    ref = generate(params, prompt, cfg, max_new=4)
+    np.testing.assert_array_equal(np.asarray(seqs), np.asarray(ref))
+
+
+def test_beam_with_tp_sharded_params():
+    from k8s_gpu_device_plugin_tpu.models.llama import param_shardings
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg, params, prompt = _setup()
+    ref_seqs, ref_scores = beam_search(params, prompt, cfg, max_new=4, beam=3)
+    mesh = make_mesh(MeshSpec(dp=1, tp=4), jax.devices()[:4])
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    seqs, scores = beam_search(sharded, prompt, cfg, max_new=4, beam=3)
+    np.testing.assert_array_equal(np.asarray(seqs), np.asarray(ref_seqs))
+    np.testing.assert_allclose(
+        np.asarray(scores), np.asarray(ref_scores), atol=1e-4
+    )

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from k8s_gpu_device_plugin_tpu.models.generate import KVCache, generate, prefill
 from k8s_gpu_device_plugin_tpu.models.llama import (
@@ -70,8 +71,6 @@ def test_generate_rejects_quantized_config():
     """int8 configs must be refused: the decode block is bf16-only and
     silently decoding with different numerics than training would let
     greedy tokens drift from the full-context oracle."""
-    import pytest
-
     from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
 
     cfg = LlamaConfig.tiny(n_layers=1, quant="int8")
@@ -213,3 +212,24 @@ def test_int8_cache_quantize_roundtrip_error_bound():
     amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     # symmetric int8: |x - deq| <= scale/2 = amax/254 per row
     assert float(jnp.max(jnp.abs(x - deq) / amax)) <= (1 / 254) + 1e-6
+
+
+def test_generate_with_tp_sharded_params():
+    """Multi-chip serving: tp-sharded params flow through the jitted decode
+    via GSPMD (no code path changes) and emit the same tokens as a
+    single-device run."""
+    from k8s_gpu_device_plugin_tpu.models.llama import param_shardings
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(1), (2, 8), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = generate(params, prompt, cfg, max_new=5)
+    mesh = make_mesh(MeshSpec(dp=1, tp=4), jax.devices()[:4])
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    out = generate(sharded, prompt, cfg, max_new=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

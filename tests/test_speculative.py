@@ -186,3 +186,24 @@ def test_accept_round_gamma2_marginals():
     sig1 = np.sqrt(exp1 * (1 - np.asarray(p[1])))
     assert len(sel) > 1200  # enough mass for the bound to mean something
     assert (np.abs(counts1 - exp1) < 4 * sig1 + 1).all(), (counts1, exp1)
+
+
+def test_speculative_with_tp_sharded_target():
+    """Multi-chip serving composes with speculation: a tp-sharded target
+    verifies a single-device draft's proposals, still lossless."""
+    from k8s_gpu_device_plugin_tpu.models.llama import param_shardings
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg_t = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    cfg_d = LlamaConfig.tiny(n_layers=1, dtype=jnp.float32)
+    params_t = init_params(jax.random.key(0), cfg_t)
+    params_d = init_params(jax.random.key(7), cfg_d)
+    mesh = make_mesh(MeshSpec(dp=1, tp=4), jax.devices()[:4])
+    sharded_t = jax.device_put(params_t, param_shardings(cfg_t, mesh))
+    toks, _ = speculative_generate(
+        sharded_t, cfg_t, params_d, cfg_d, _prompt(), max_new=8, gamma=3
+    )
+    ref = generate(params_t, _prompt(), cfg_t, max_new=8)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
