@@ -184,6 +184,29 @@ def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
     return jnp.einsum("bte,bted->btd", mix.astype(h.dtype), y)
 
 
+def _project_qkv(x, layer, positions, cfg):
+    """Shared decode-side QKV projection + rope (used by the linear cache
+    here and the ring cache in models/rolling.py — one implementation so
+    the rolling oracle's token-exactness can never drift)."""
+    b, t, d = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
+
+
+def _mlp_out(x, layer, cfg):
+    """Shared decode-side MLP residual branch (dense silu or MoE mix)."""
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    if cfg.is_moe:
+        return _decode_moe_mlp(h, layer, cfg)
+    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+    up = h @ layer["w3"]
+    return (gate * up) @ layer["w2"]
+
+
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
                   positions, cfg):
     """One transformer block over T new tokens with cache read+write.
@@ -193,29 +216,14 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
     training ``_block`` (models/llama.py) minus sharding annotations; MoE
     MLPs run the dense-mix decode path (``_decode_moe_mlp``)."""
     b, t, d = x.shape
-    hd = cfg.head_dim
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
-    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
-
+    q, k, v = _project_qkv(x, layer, positions, cfg)
     k_cache, k_scale = _cache_write(k_cache, k_scale, k, length)
     v_cache, v_scale = _cache_write(v_cache, v_scale, v, length)
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length, cfg)
-    x = x + (attn.reshape(b, t, cfg.n_heads * hd) @ layer["wo"])
-
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    if cfg.is_moe:
-        x = x + _decode_moe_mlp(h, layer, cfg)
-    else:
-        gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-        up = h @ layer["w3"]
-        x = x + ((gate * up) @ layer["w2"])
-    return x, k_cache, v_cache, k_scale, v_scale
+    x = x + (attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ layer["wo"])
+    return x + _mlp_out(x, layer, cfg), k_cache, v_cache, k_scale, v_scale
 
 
 def _forward_cached(

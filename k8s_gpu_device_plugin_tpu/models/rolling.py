@@ -1,0 +1,192 @@
+"""Bounded-memory decode for sliding-window models: a ring KV cache.
+
+With window W, position p only ever serves queries in [p, p + W), so the
+cache needs exactly W slots: token p lives at slot ``p % W`` and is
+overwritten the step it leaves every future query's window. Decode memory
+is O(W) regardless of how many tokens are generated — the practical
+Mistral serving property (a 32k-token generation holds a 4k cache).
+
+TPU-first shape: slot positions are a pure function of (length, slot)
+(``p_s = L - 1 - ((L - 1 - s) mod W)``), so nothing tracks them — the
+attention mask recomputes them from the traced length each step, and all
+writes are single ``dynamic_update_slice`` calls at ``p % W``. Prefill
+runs through the ordinary cache at prompt size (prompt activations are
+O(P) anyway), then the last ``min(P, W)`` roped K/V rows roll into the
+ring; the decode loop is one ``lax.scan``.
+
+The oracle test pins ``rolling_generate`` token-exact (f32) against the
+unbounded windowed ``generate`` across p < W, p > W, and generations that
+wrap the ring several times.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.generate import (
+    KVCache,
+    _forward_cached,
+    _mlp_out,
+    _project_qkv,
+    rms_norm,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import (
+    LlamaConfig,
+    cast_params_for_compute,
+)
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
+
+
+def _ring_from_prefill(cache_kv: jax.Array, p: int, w: int) -> jax.Array:
+    """(L, B, P, H, hd) prefill rows -> (L, B, W, H, hd) ring.
+
+    Keeps the last m = min(P, W) positions; position q lands at slot
+    q % W. For P < W the tail slots stay zero (masked by position math);
+    for P >= W the W consecutive positions are a rotation of the slots."""
+    if p < w:
+        pad = [(0, 0)] * cache_kv.ndim
+        pad[2] = (0, w - p)
+        return jnp.pad(cache_kv, pad)
+    last = cache_kv[:, :, p - w:p]
+    return jnp.roll(last, shift=(p - w) % w, axis=2)
+
+
+def _ring_attention_step(q, ring_k, ring_v, length, cfg: LlamaConfig):
+    """T=1 attention over the ring AFTER the current token's K/V landed.
+
+    q: (B, 1, Hq, hd); ring: (B, W, Hkv, hd). ``length`` counts tokens
+    written so far INCLUDING the current one (the query sits at position
+    length - 1). Slot s holds position L-1 - ((L-1-s) mod W); negatives
+    are unwritten slots. The window mask is implied: every live slot is
+    within W of the query by construction."""
+    b, t, hq, hd = q.shape
+    w = ring_k.shape[1]
+    group = hq // cfg.n_kv_heads
+    qg = q.reshape(b, 1, cfg.n_kv_heads, group, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->btkgs", qg, ring_k,
+        preferred_element_type=jnp.float32,
+    ) * (hd ** -0.5)
+    last = length - 1
+    s_idx = jnp.arange(w)
+    slot_pos = last - ((last - s_idx) % w)              # (W,)
+    keep = slot_pos >= 0
+    scores = jnp.where(keep[None, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "btkgs,bskd->btkgd", probs.astype(q.dtype), ring_v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, 1, hq, hd).astype(q.dtype)
+
+
+def _ring_decode_block(x, layer, ring_k, ring_v, pos, cfg: LlamaConfig):
+    """One block over ONE new token at absolute position ``pos``; writes
+    its K/V at slot pos % W, then attends the ring. Projection/rope and
+    the MLP branch are the SAME helpers the linear-cache block uses
+    (generate._project_qkv/_mlp_out), so the two paths cannot drift."""
+    b, t, d = x.shape
+    w = ring_k.shape[1]
+
+    positions = pos + jnp.arange(t, dtype=jnp.int32)
+    q, k, v = _project_qkv(x, layer, positions, cfg)
+
+    slot = (pos % w).astype(jnp.int32)
+    ring_k = jax.lax.dynamic_update_slice(
+        ring_k, k.astype(ring_k.dtype), (0, slot, 0, 0)
+    )
+    ring_v = jax.lax.dynamic_update_slice(
+        ring_v, v.astype(ring_v.dtype), (0, slot, 0, 0)
+    )
+
+    attn = _ring_attention_step(q, ring_k, ring_v, pos + 1, cfg)
+    x = x + (attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ layer["wo"])
+    return x + _mlp_out(x, layer, cfg), ring_k, ring_v
+
+
+def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
+    """One token through all layers against the ring; returns
+    ((B, V) f32 logits, updated ring)."""
+    params = cast_params_for_compute(params, cfg)
+    x = params["embed"].astype(cfg.dtype)[tok[:, None]]
+
+    def body(carry, layer_and_ring):
+        x = carry
+        layer, rk, rv = layer_and_ring
+        x, rk, rv = _ring_decode_block(x, layer, rk, rv, pos, cfg)
+        return x, (rk, rv)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], ring.k, ring.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.dot(
+        x[:, -1], params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "sampler"))
+def rolling_generate(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new: int,
+    key: jax.Array | None = None,
+    sampler: "Sampler | None" = None,
+) -> jax.Array:
+    """Windowed generation with an O(window) ring cache.
+
+    Same contract as ``generate`` (greedy by default, ``Sampler`` for
+    sampling) for configs with ``sliding_window > 0``; the cache never
+    grows past the window no matter how long the generation runs.
+    """
+    if cfg.sliding_window <= 0:
+        raise ValueError(
+            "rolling_generate needs cfg.sliding_window > 0 (full-causal "
+            "models need every past position: use generate)"
+        )
+    if cfg.quant != "none":
+        raise NotImplementedError("decode path is bf16-only (quant='none')")
+    if cfg.cache_quant != "none":
+        raise NotImplementedError(
+            "rolling cache does not compose with cache_quant yet"
+        )
+    b, p = prompt.shape
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
+    w = cfg.sliding_window
+    sampler = sampler if sampler is not None else Sampler()
+    key = key if key is not None else jax.random.key(0)
+
+    # prefill at prompt size (activations are O(P) regardless), then keep
+    # only the live window in the ring
+    pre_cache = KVCache.init(cfg, b, p)
+    logits, pre_cache = _forward_cached(
+        params, prompt, pre_cache, 0, cfg, last_only=True
+    )
+    ring = KVCache(
+        k=_ring_from_prefill(pre_cache.k, p, w),
+        v=_ring_from_prefill(pre_cache.v, p, w),
+    )
+
+    key, sub = jax.random.split(key)
+    first = sample_logits(logits[:, -1], sub, sampler)
+
+    def step(carry, i):
+        last, ring, key = carry
+        logits, ring = _ring_forward(params, last, ring, p + i, cfg)
+        key, sub = jax.random.split(key)
+        tok = sample_logits(logits, sub, sampler)
+        return (tok, ring, key), tok
+
+    if max_new == 1:
+        return first[:, None]
+    _, toks = jax.lax.scan(
+        step, (first, ring, key), jnp.arange(max_new - 1, dtype=jnp.int32)
+    )
+    return jnp.concatenate([first[:, None], toks.T], axis=1)

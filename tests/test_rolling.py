@@ -1,0 +1,92 @@
+"""Rolling (bounded) KV cache vs the unbounded windowed decode oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.rolling import (
+    _ring_from_prefill,
+    rolling_generate,
+)
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+
+def _cfg(window=8, **kw):
+    return LlamaConfig.tiny(
+        n_layers=2, sliding_window=window, dtype=jnp.float32, **kw
+    )
+
+
+@pytest.mark.parametrize(
+    "prompt_len,max_new,window",
+    [
+        (4, 6, 8),    # prompt < window
+        (12, 6, 8),   # prompt > window
+        (6, 20, 8),   # generation wraps the ring twice
+    ],
+)
+def test_rolling_matches_unbounded_windowed_decode(prompt_len, max_new, window):
+    cfg = _cfg(window)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(1), (2, prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = generate(params, prompt, cfg, max_new=max_new)
+    got = rolling_generate(params, prompt, cfg, max_new=max_new)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_rolling_moe_matches_unbounded():
+    cfg = _cfg(8, n_experts=4, capacity_factor=8.0)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(2), (1, 10), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = generate(params, prompt, cfg, max_new=10)
+    got = rolling_generate(params, prompt, cfg, max_new=10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_ring_from_prefill_layout():
+    """Slot s must hold the key whose position is congruent to s (mod W),
+    for both the short-prompt (pad) and wrapped layouts."""
+    L, B, H, hd = 1, 1, 1, 1
+    w = 4
+    # P = 6 > W: positions 2..5 live; slot s holds position with pos%4==s
+    kv = jnp.arange(6, dtype=jnp.float32).reshape(L, B, 6, H, hd)
+    ring = _ring_from_prefill(kv, 6, w)
+    np.testing.assert_array_equal(
+        np.asarray(ring).ravel(), [4.0, 5.0, 2.0, 3.0]
+    )
+    # P = 3 < W: slots 0..2 hold 0..2, slot 3 zero
+    kv = jnp.arange(3, dtype=jnp.float32).reshape(L, B, 3, H, hd)
+    ring = _ring_from_prefill(kv, 3, w)
+    np.testing.assert_array_equal(np.asarray(ring).ravel(), [0.0, 1.0, 2.0, 0.0])
+
+
+def test_rolling_sampled_runs_and_stays_in_vocab():
+    cfg = _cfg(8)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+    toks = rolling_generate(
+        params, prompt, cfg, max_new=10, key=jax.random.key(3),
+        sampler=Sampler(temperature=0.8, top_k=20),
+    )
+    a = np.asarray(toks)
+    assert a.shape == (1, 10)
+    assert (a >= 0).all() and (a < cfg.vocab_size).all()
+
+
+def test_rolling_validation():
+    cfg_full = LlamaConfig.tiny(n_layers=1, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg_full)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        rolling_generate(params, prompt, cfg_full, max_new=2)
+    cfg_q = _cfg(8, cache_quant="int8")
+    params_q = init_params(jax.random.key(0), cfg_q)
+    with pytest.raises(NotImplementedError, match="cache_quant"):
+        rolling_generate(params_q, prompt, cfg_q, max_new=2)
