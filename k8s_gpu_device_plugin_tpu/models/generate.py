@@ -276,7 +276,6 @@ def prefill(params, prompt, cache: KVCache, cfg: LlamaConfig):
     return logits[:, -1], cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "sampler"))
 def generate(
     params,
     prompt: jax.Array,
@@ -285,6 +284,8 @@ def generate(
     key: jax.Array | None = None,
     temperature: float = 0.0,
     sampler: "Sampler | None" = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled generation.
 
@@ -294,7 +295,35 @@ def generate(
 
     ``sampler`` (models/sampling.py) gives top-k/top-p control; the plain
     ``temperature`` arg is shorthand for ``Sampler(temperature=...)``.
+
+    ``eos_id`` stops each row at its first EOS: positions after it come
+    back as ``pad_id``. Shapes stay static (the loop always runs
+    ``max_new`` steps — the fixed-shape TPU trade; rows that finished
+    early just decode ignored tokens), and the masking is a thin
+    elementwise postprocess OUTSIDE the jitted core, so different
+    eos/pad ids never recompile the decode loop.
     """
+    toks = _generate_jit(params, prompt, cfg, max_new, key, temperature,
+                         sampler)
+    if eos_id is not None:
+        # pad everything strictly after each row's first EOS (the EOS
+        # itself is kept): exclusive cumulative count of EOS occurrences
+        is_eos = (toks == eos_id).astype(jnp.int32)
+        after_eos = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+        toks = jnp.where(after_eos, pad_id, toks)
+    return toks
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "sampler"))
+def _generate_jit(
+    params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new: int,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    sampler: "Sampler | None" = None,
+) -> jax.Array:
     if cfg.quant != "none":
         # _decode_block runs plain bf16 matmuls; silently accepting an int8
         # config would decode with different numerics than the training
@@ -333,5 +362,4 @@ def generate(
     )
     key, sub = jax.random.split(key)
     last = pick(logits, sub)[None]                    # (1, B)
-    toks = jnp.concatenate([toks, last], axis=0)
-    return toks.T                                     # (B, max_new)
+    return jnp.concatenate([toks, last], axis=0).T    # (B, max_new)

@@ -233,3 +233,28 @@ def test_generate_with_tp_sharded_params():
     sharded = jax.device_put(params, param_shardings(cfg, mesh))
     out = generate(sharded, prompt, cfg, max_new=5)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_eos_stops_each_row_independently():
+    """Rows pad everything strictly after their first EOS; the EOS itself
+    is kept, rows without EOS are untouched."""
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size,
+                                jnp.int32)
+    base = np.asarray(generate(params, prompt, cfg, max_new=6))
+    # choose the token row 0 emits at step 2 as the EOS id
+    eos = int(base[0, 2])
+    got = np.asarray(
+        generate(params, prompt, cfg, max_new=6, eos_id=eos, pad_id=-1)
+    )
+    for r in range(2):
+        hits = np.where(base[r] == eos)[0]
+        if hits.size:
+            cut = hits[0]
+            np.testing.assert_array_equal(got[r, :cut + 1], base[r, :cut + 1])
+            assert (got[r, cut + 1:] == -1).all()
+        else:
+            np.testing.assert_array_equal(got[r], base[r])
+    # row 0 definitely has one
+    assert (got[0, 3:] == -1).all()
