@@ -182,19 +182,19 @@ def _run_flash_tune_long() -> dict:
     )
 
 
-def _run_decode() -> dict:
-    """KV-cache decode throughput on the bench proxy model (serving-side
-    companion to the train bench; reports prefill latency, tokens/s and
-    achieved HBM bandwidth vs peak)."""
+def _decode_result(workload: str, int8_weights: bool = False) -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
         decode_bench,
     )
 
     _require_accelerator()
     cfg = _bench_model_cfg()
-    r = decode_bench(cfg, batch=8, prompt_len=512, new_tokens=64)
+    r = decode_bench(
+        cfg, batch=8, prompt_len=512, new_tokens=64,
+        int8_weights=int8_weights,
+    )
     return {
-        "workload": "decode",
+        "workload": workload,
         "prefill_ms": round(r.prefill_ms, 1),
         "decode_tokens_per_second": round(r.decode_tokens_per_second, 1),
         "decode_step_ms": round(r.decode_step_ms, 2),
@@ -206,6 +206,19 @@ def _run_decode() -> dict:
             "new_tokens": r.new_tokens,
         },
     }
+
+
+def _run_decode() -> dict:
+    """KV-cache decode throughput on the bench proxy model (serving-side
+    companion to the train bench; reports prefill latency, tokens/s and
+    achieved HBM bandwidth vs peak)."""
+    return _decode_result("decode")
+
+
+def _run_decode_int8w() -> dict:
+    """Decode with weight-only int8 serving quantization: the bandwidth-
+    bound regime should approach 2x the bf16 decode tokens/s."""
+    return _decode_result("decode_int8w", int8_weights=True)
 
 
 def _run_roundtrip() -> dict:
@@ -253,6 +266,7 @@ WORKLOADS = {
     "flash_tune": _run_flash_tune,
     "flash_tune_long": _run_flash_tune_long,
     "decode": _run_decode,
+    "decode_int8w": _run_decode_int8w,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
 }

@@ -37,18 +37,19 @@ class DecodeBenchResult:
     new_tokens: int
 
 
-def _param_bytes(cfg: LlamaConfig, batch: int) -> int:
+def _param_bytes(cfg: LlamaConfig, batch: int, int8_weights: bool) -> int:
     """Bytes actually streamed per decode step: every weight matmul reads
     its full operand, but the embed table is a B-row GATHER (llama.py's
     FLOPs accounting makes the same distinction) — only lm_head reads the
-    full (d, vocab)."""
+    full (d, vocab). With int8 weight-only serving, the matmul weights
+    stream 1 byte/element instead of 2 (norms/embed stay float)."""
     d, f, L, hd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.head_dim
     attn = 2 * d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
     mlp = 3 * d * f
-    norms = 2 * d
-    per_layer = attn + mlp + norms
-    total = L * per_layer + cfg.vocab_size * d + d + batch * d
-    return total * 2  # bf16
+    wbytes = 1 if int8_weights else 2
+    matmul = (L * (attn + mlp) + cfg.vocab_size * d) * wbytes
+    other = (L * 2 * d + d + batch * d) * 2
+    return matmul + other
 
 
 def decode_bench(
@@ -58,9 +59,16 @@ def decode_bench(
     new_tokens: int = 64,
     repeats: int = 3,
     devices: list | None = None,
+    int8_weights: bool = False,
 ) -> DecodeBenchResult:
     devices = devices or jax.devices()
     params = init_params(jax.random.key(0), cfg)
+    if int8_weights:
+        from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+            quantize_weights_int8,
+        )
+
+        params = quantize_weights_int8(params, cfg)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
@@ -102,7 +110,9 @@ def decode_bench(
         2 * cfg.n_layers * batch * (prompt_len + new_tokens)
         * cfg.n_kv_heads * cfg.head_dim * 2
     )
-    gbps = (_param_bytes(cfg, batch) + cache_bytes) / step_seconds / 1e9
+    gbps = (
+        _param_bytes(cfg, batch, int8_weights) + cache_bytes
+    ) / step_seconds / 1e9
     gen = GENERATIONS[detect_generation(devices[0])]
     peak_gbps = gen.hbm_bandwidth_gbps
     return DecodeBenchResult(

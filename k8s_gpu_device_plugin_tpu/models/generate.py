@@ -36,6 +36,10 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     rms_norm,
     rope,
 )
+from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+    qhead_matmul,
+    qmatmul,
+)
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
 
 
@@ -187,13 +191,15 @@ def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
 def _project_qkv(x, layer, positions, cfg):
     """Shared decode-side QKV projection + rope (used by the linear cache
     here and the ring cache in models/rolling.py — one implementation so
-    the rolling oracle's token-exactness can never drift)."""
+    the rolling oracle's token-exactness can never drift). Weight leaves
+    may be int8 {"q", "s"} serving leaves (models/quantized_serving.py);
+    qmatmul dispatches."""
     b, t, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
-    k = (h @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-    v = (h @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = qmatmul(h, layer["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = qmatmul(h, layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = qmatmul(h, layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
     return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
 
 
@@ -202,9 +208,9 @@ def _mlp_out(x, layer, cfg):
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.is_moe:
         return _decode_moe_mlp(h, layer, cfg)
-    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-    up = h @ layer["w3"]
-    return (gate * up) @ layer["w2"]
+    gate = jax.nn.silu(qmatmul(h, layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+    up = qmatmul(h, layer["w3"])
+    return qmatmul(gate * up, layer["w2"])
 
 
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
@@ -222,7 +228,7 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
     v_cache, v_scale = _cache_write(v_cache, v_scale, v, length)
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length, cfg)
-    x = x + (attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ layer["wo"])
+    x = x + qmatmul(attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer["wo"])
     return x + _mlp_out(x, layer, cfg), k_cache, v_cache, k_scale, v_scale
 
 
@@ -261,10 +267,7 @@ def _forward_cached(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
         x = x[:, -1:]
-    logits = jnp.dot(
-        x, params["lm_head"].astype(cfg.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    logits = qhead_matmul(x, params["lm_head"], cfg.dtype)
     return logits, KVCache(
         k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
     )

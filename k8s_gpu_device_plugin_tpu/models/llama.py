@@ -283,7 +283,11 @@ def cast_params_for_compute(params: dict, cfg: LlamaConfig) -> dict:
     return {
         **params,
         "layers": {
-            k: (v if k == "router" else v.astype(cfg.dtype))
+            # router exempt (precision-sensitive); int8 serving leaves
+            # ({"q","s"} dicts, models/quantized_serving.py) pass through
+            # untouched — casting them would destroy the quantization
+            k: (v if k == "router" or isinstance(v, dict)
+                else v.astype(cfg.dtype))
             for k, v in params["layers"].items()
         },
     }

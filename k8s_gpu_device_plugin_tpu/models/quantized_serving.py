@@ -1,0 +1,85 @@
+"""Weight-only int8 quantization for serving (decode-path memory lever).
+
+Decode is HBM-bandwidth-bound: every generated token streams all weights
+once, so int8 storage is ~2x decode throughput and half the serving
+footprint. The recipe is per-OUTPUT-channel symmetric int8: for
+``y = x @ W``, ``y[n] = s[n] * sum_k x[k] q[k, n]`` — the scale applies
+AFTER the dot, so the int8 array itself is the matmul operand (a bare
+convert fuses into the dot; no dequantized weight copy ever materializes
+in HBM — the same rule as the int8 KV cache). Norms and the embedding
+table stay in the float dtype (tiny, and the embed read is a gather).
+
+This is serving-side only and orthogonal to training quantization
+(``cfg.quant`` — the AQT-style quantized-forward training recipe in
+ops/quant.py): quantize an already-trained checkpoint, then decode with
+``generate``/``beam_search``/``rolling_generate`` as usual — the decode
+matmul helper dispatches on the quantized-leaf structure.
+
+Accuracy: per-channel int8 on weights is the standard near-lossless
+serving quantization (~0.4% per-element error); tests pin prefill logits
+within that band and high greedy-token agreement on random models.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
+
+# weight leaves quantized per layer (contraction axis is axis -2 for all)
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def quantize_weights_int8(params: dict, cfg: LlamaConfig) -> dict:
+    """Float pytree -> serving pytree with int8 projection/MLP weights.
+
+    Each targeted (L, in, out) stack becomes ``{"q": int8, "s": f32}``
+    with per-(layer, output-channel) scales, shape (L, 1, out). The
+    lm_head (d, vocab) is quantized the same way; embed and norms keep
+    their float dtype. MoE expert stacks are not supported yet.
+    """
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "weight-only int8 serving does not cover MoE expert stacks yet"
+        )
+    layers = {}
+    for name, w in params["layers"].items():
+        if name in _QUANT_LEAVES:
+            q, s = quantize_int8(w, axis=-2)     # contract over 'in'
+            layers[name] = {"q": q, "s": s}
+        else:
+            layers[name] = w
+    q, s = quantize_int8(params["lm_head"], axis=0)
+    return {
+        **params,
+        "layers": layers,
+        "lm_head": {"q": q, "s": s},
+    }
+
+
+def is_quantized_leaf(w) -> bool:
+    return isinstance(w, dict) and set(w) == {"q", "s"}
+
+
+def qmatmul(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where ``w`` is a float array OR an int8 {"q", "s"} leaf.
+
+    The int8 array stays the dot operand; the per-output-channel scale
+    multiplies the (much smaller) result."""
+    if is_quantized_leaf(w):
+        y = jnp.matmul(x, w["q"].astype(x.dtype))
+        return y * jnp.squeeze(w["s"], axis=-2).astype(x.dtype)
+    return jnp.matmul(x, w)
+
+
+def qhead_matmul(x: jax.Array, head, dtype) -> jax.Array:
+    """lm_head projection with f32 accumulation for float OR int8 heads —
+    the one implementation both decode paths (generate._forward_cached,
+    rolling._ring_forward) share so the scale layout cannot drift."""
+    if is_quantized_leaf(head):
+        return jnp.dot(
+            x, head["q"].astype(dtype), preferred_element_type=jnp.float32
+        ) * jnp.squeeze(head["s"], axis=-2)
+    return jnp.dot(x, head.astype(dtype), preferred_element_type=jnp.float32)

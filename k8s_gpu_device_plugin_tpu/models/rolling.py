@@ -37,6 +37,10 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     LlamaConfig,
     cast_params_for_compute,
 )
+from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+    qhead_matmul,
+    qmatmul,
+)
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
 
 
@@ -103,7 +107,7 @@ def _ring_decode_block(x, layer, ring_k, ring_v, pos, cfg: LlamaConfig):
     )
 
     attn = _ring_attention_step(q, ring_k, ring_v, pos + 1, cfg)
-    x = x + (attn.reshape(b, t, cfg.n_heads * cfg.head_dim) @ layer["wo"])
+    x = x + qmatmul(attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer["wo"])
     return x + _mlp_out(x, layer, cfg), ring_k, ring_v
 
 
@@ -123,10 +127,7 @@ def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
         body, x, (params["layers"], ring.k, ring.v)
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.dot(
-        x[:, -1], params["lm_head"].astype(cfg.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    logits = qhead_matmul(x[:, -1], params["lm_head"], cfg.dtype)
     return logits, KVCache(k=k_new, v=v_new)
 
 
