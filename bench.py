@@ -118,23 +118,18 @@ def main() -> int:
 
     # Secondary diagnostics, only with budget left after the primary
     # workloads (never risk the main metric): int8-matmul train throughput,
-    # then serving-side decode throughput.
-    remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
-    train_int8 = (
-        run_workload(
-            "train_int8", timeout=min(480, remaining - 20), platforms=tpu_platforms
+    # then serving-side decode throughput (bf16 and int8-weight variants).
+    def secondary(workload: str, cap: float, gate, min_remaining: float):
+        remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
+        if not gate or remaining <= min_remaining:
+            return None
+        return run_workload(
+            workload, timeout=min(cap, remaining - 20), platforms=tpu_platforms
         )
-        if train and remaining > 200
-        else None
-    )
-    remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
-    decode = (
-        run_workload(
-            "decode", timeout=min(420, remaining - 20), platforms=tpu_platforms
-        )
-        if train and remaining > 180
-        else None
-    )
+
+    train_int8 = secondary("train_int8", 480, train, 200)
+    decode = secondary("decode", 420, train, 180)
+    decode_int8w = secondary("decode_int8w", 420, decode, 180)
 
     extra: dict = {}
     if matmul:
@@ -158,6 +153,11 @@ def main() -> int:
         extra["decode_prefill_ms"] = decode["prefill_ms"]
         extra["decode_hbm_util_pct"] = decode["hbm_util_pct"]
         extra["decode_shape"] = decode["decode_shape"]
+    if decode_int8w:
+        extra["decode_int8w_tokens_per_second"] = decode_int8w[
+            "decode_tokens_per_second"
+        ]
+        extra["decode_int8w_hbm_util_pct"] = decode_int8w["hbm_util_pct"]
     if allocated:
         extra["allocated_matmul_mfu_pct"] = allocated["mfu_pct"]
         extra["allocated_matmul_n"] = allocated.get("n")

@@ -16,8 +16,9 @@ ops/quant.py): quantize an already-trained checkpoint, then decode with
 matmul helper dispatches on the quantized-leaf structure.
 
 Accuracy: per-channel int8 on weights is the standard near-lossless
-serving quantization (~0.4% per-element error); tests pin prefill logits
-within that band and high greedy-token agreement on random models.
+serving quantization (~0.4% per-element weight error, accumulating to
+roughly a 1% logit band on the test model — pinned at 2e-2 abs by the
+tests, with greedy-token agreement checked alongside).
 """
 
 from __future__ import annotations
@@ -70,7 +71,12 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
     multiplies the (much smaller) result."""
     if is_quantized_leaf(w):
         y = jnp.matmul(x, w["q"].astype(x.dtype))
-        return y * jnp.squeeze(w["s"], axis=-2).astype(x.dtype)
+        # scale stays f32 through the multiply (rounding it to bf16 first
+        # would add a systematic ~0.2% per-channel bias on top of the int8
+        # band); the product casts back after
+        return (
+            y.astype(jnp.float32) * jnp.squeeze(w["s"], axis=-2)
+        ).astype(x.dtype)
     return jnp.matmul(x, w)
 
 

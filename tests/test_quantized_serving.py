@@ -60,11 +60,10 @@ def test_quantized_prefill_logits_close_and_decode_runs():
     )
     ref, _ = prefill(params, prompt, KVCache.init(cfg, 2, 16), cfg)
     got, _ = prefill(qp, prompt, KVCache.init(cfg, 2, 16), cfg)
-    # logits within the per-channel int8 band (random tiny model: logits
-    # O(1), band ~1e-2)
-    np.testing.assert_allclose(
-        np.asarray(got), np.asarray(ref), atol=0.1, rtol=0.1
-    )
+    # logits within the per-channel int8 band: measured 0.0068 max abs on
+    # this model's O(1) logits (~1%); 0.02 leaves 3x headroom while still
+    # failing loudly on an order-of-magnitude accuracy regression
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.02)
     toks = generate(qp, prompt, cfg, max_new=6)
     base = generate(params, prompt, cfg, max_new=6)
     agree = float(np.mean(np.asarray(toks) == np.asarray(base)))
