@@ -346,23 +346,30 @@ def _generate_jit(
     logits, cache = prefill(params, prompt, cache, cfg)
     key = key if key is not None else jax.random.key(0)
 
-    def pick(logits, key):
-        return sample_logits(logits, key, sampler)
+    # presence mask of every context token (prompt + generated) for the
+    # repetition penalty; a (B, V) bool is negligible, so it is carried
+    # unconditionally and simply ignored when the penalty is off
+    rows = jnp.arange(b)[:, None]
+    presence = jnp.zeros((b, cfg.vocab_size), bool).at[rows, prompt].set(True)
+
+    def pick(logits, key, presence):
+        tok = sample_logits(logits, key, sampler, presence=presence)
+        return tok, presence.at[jnp.arange(b), tok].set(True)
 
     def step(carry, i):
-        logits, cache, key = carry
+        logits, cache, key, presence = carry
         key, sub = jax.random.split(key)
-        tok = pick(logits, sub)                       # (B,)
+        tok, presence = pick(logits, sub, presence)   # (B,)
         logits, cache = _forward_cached(
             params, tok[:, None], cache, p + i, cfg
         )
-        return (logits[:, -1], cache, key), tok
+        return (logits[:, -1], cache, key, presence), tok
 
     # max_new - 1 cached forwards; the final token needs only a pick from
     # the last carried logits (no wasted trailing forward).
-    (logits, _, key), toks = jax.lax.scan(
-        step, (logits, cache, key), jnp.arange(max_new - 1)
+    (logits, _, key, presence), toks = jax.lax.scan(
+        step, (logits, cache, key, presence), jnp.arange(max_new - 1)
     )
     key, sub = jax.random.split(key)
-    last = pick(logits, sub)[None]                    # (1, B)
-    return jnp.concatenate([toks, last], axis=0).T    # (B, max_new)
+    last, _ = pick(logits, sub, presence)
+    return jnp.concatenate([toks, last[None]], axis=0).T  # (B, max_new)

@@ -157,6 +157,11 @@ def rolling_generate(
         raise NotImplementedError(
             "rolling cache does not compose with cache_quant yet"
         )
+    if sampler is not None and sampler.repetition_penalty > 1.0:
+        raise NotImplementedError(
+            "repetition_penalty is not wired into rolling_generate yet "
+            "(use generate)"
+        )
     b, p = prompt.shape
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")

@@ -33,6 +33,10 @@ class Sampler:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # CTRL-style repetition penalty (1.0 = off): logits of already-seen
+    # tokens are divided by it when positive, multiplied when negative —
+    # applied BEFORE temperature/filters, and also under greedy decoding.
+    repetition_penalty: float = 1.0
 
     def __post_init__(self) -> None:
         if self.temperature < 0.0:
@@ -41,6 +45,11 @@ class Sampler:
             raise ValueError(f"top_k must be >= 0, got {self.top_k}")
         if not 0.0 < self.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.repetition_penalty < 1.0:
+            raise ValueError(
+                f"repetition_penalty must be >= 1, got "
+                f"{self.repetition_penalty}"
+            )
 
     @property
     def is_greedy(self) -> bool:
@@ -90,8 +99,36 @@ def filtered_probs(logits: jax.Array, sampler: Sampler) -> jax.Array:
     return jax.nn.softmax(filtered_logits(logits, sampler), axis=-1)
 
 
-def sample_logits(logits: jax.Array, key: jax.Array, sampler: Sampler) -> jax.Array:
-    """(B, V) f32 logits -> (B,) int32 token ids."""
+def apply_repetition_penalty(
+    logits: jax.Array, presence: jax.Array, penalty: float
+) -> jax.Array:
+    """CTRL rule on already-seen tokens (presence (B, V) bool): positive
+    logits divide by the penalty, negative multiply — both push the
+    probability down regardless of sign."""
+    logits = logits.astype(jnp.float32)
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(presence, penalized, logits)
+
+
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    sampler: Sampler,
+    presence: jax.Array | None = None,
+) -> jax.Array:
+    """(B, V) f32 logits -> (B,) int32 token ids.
+
+    ``presence`` (B, V) bool marks tokens already in the context; it is
+    required when ``sampler.repetition_penalty > 1`` (the penalty applies
+    before temperature/filters and also affects greedy argmax)."""
+    if sampler.repetition_penalty > 1.0:
+        if presence is None:
+            raise ValueError(
+                "repetition_penalty needs the presence mask of prior tokens"
+            )
+        logits = apply_repetition_penalty(
+            logits, presence, sampler.repetition_penalty
+        )
     if sampler.is_greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(

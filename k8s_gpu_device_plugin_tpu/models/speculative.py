@@ -121,6 +121,13 @@ def speculative_generate(
     """
     if cfg_t.quant != "none" or cfg_d.quant != "none":
         raise NotImplementedError("speculative decode is bf16-only")
+    if sampler is not None and sampler.repetition_penalty > 1.0:
+        # the acceptance theorem assumes fixed per-position distributions;
+        # a context-dependent penalty changes p and q mid-round — refuse
+        # rather than silently dropping the knob on the greedy path
+        raise NotImplementedError(
+            "repetition_penalty is not supported in speculative decoding"
+        )
     if cfg_t.vocab_size != cfg_d.vocab_size:
         raise ValueError(
             f"draft/target vocab mismatch: {cfg_d.vocab_size} vs "

@@ -102,3 +102,69 @@ def test_generate_rejects_temperature_and_sampler():
             params, prompt, cfg, max_new=2,
             temperature=0.8, sampler=Sampler(top_k=50),
         )
+
+
+def test_repetition_penalty_rule():
+    from k8s_gpu_device_plugin_tpu.models.sampling import (
+        apply_repetition_penalty,
+    )
+
+    logits = jnp.array([[2.0, -1.0, 0.5, 3.0]])
+    presence = jnp.array([[True, True, False, False]])
+    out = np.asarray(apply_repetition_penalty(logits, presence, 2.0))
+    np.testing.assert_allclose(out, [[1.0, -2.0, 0.5, 3.0]])
+
+
+def test_repetition_penalty_needs_presence():
+    logits = jnp.zeros((1, 8))
+    with pytest.raises(ValueError, match="presence"):
+        sample_logits(
+            logits, jax.random.key(0), Sampler(repetition_penalty=1.5)
+        )
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        Sampler(repetition_penalty=0.5)
+
+
+def test_repetition_penalty_breaks_greedy_loops():
+    """A model stuck repeating one token under greedy decoding must break
+    the loop under a strong penalty; without the penalty the loop persists
+    (this random tiny model happens to cycle quickly)."""
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(n_layers=1, vocab_size=32, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    base = np.asarray(generate(params, prompt, cfg, max_new=16))
+    pen = np.asarray(
+        generate(
+            params, prompt, cfg, max_new=16,
+            sampler=Sampler(repetition_penalty=4.0),
+        )
+    )
+    # the penalized run must produce strictly more distinct tokens
+    assert len(set(pen[0].tolist())) > len(set(base[0].tolist()))
+    # and every token still in vocab
+    assert (pen >= 0).all() and (pen < 32).all()
+
+
+def test_repetition_penalty_rejected_where_unsupported():
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+    from k8s_gpu_device_plugin_tpu.models.rolling import rolling_generate
+    from k8s_gpu_device_plugin_tpu.models.speculative import (
+        speculative_generate,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=1, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    s = Sampler(repetition_penalty=1.5)
+    with pytest.raises(NotImplementedError, match="repetition_penalty"):
+        speculative_generate(
+            params, cfg, params, cfg, prompt, max_new=2, sampler=s
+        )
+    from dataclasses import replace
+
+    cfg_w = replace(cfg, sliding_window=8)
+    with pytest.raises(NotImplementedError, match="repetition_penalty"):
+        rolling_generate(params, prompt, cfg_w, max_new=2, sampler=s)
