@@ -40,7 +40,12 @@ from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
     qhead_matmul,
     qmatmul,
 )
-from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    init_presence,
+    sample_and_mark,
+    sample_logits,
+)
 
 
 @dataclass(frozen=True)
@@ -349,12 +354,10 @@ def _generate_jit(
     # presence mask of every context token (prompt + generated) for the
     # repetition penalty; a (B, V) bool is negligible, so it is carried
     # unconditionally and simply ignored when the penalty is off
-    rows = jnp.arange(b)[:, None]
-    presence = jnp.zeros((b, cfg.vocab_size), bool).at[rows, prompt].set(True)
+    presence = init_presence(prompt, cfg.vocab_size)
 
     def pick(logits, key, presence):
-        tok = sample_logits(logits, key, sampler, presence=presence)
-        return tok, presence.at[jnp.arange(b), tok].set(True)
+        return sample_and_mark(logits, key, sampler, presence)
 
     def step(carry, i):
         logits, cache, key, presence = carry

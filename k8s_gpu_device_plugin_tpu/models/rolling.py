@@ -41,7 +41,11 @@ from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
     qhead_matmul,
     qmatmul,
 )
-from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_logits
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    init_presence,
+    sample_and_mark,
+)
 
 
 def _ring_from_prefill(cache_kv: jax.Array, p: int, w: int) -> jax.Array:
@@ -157,11 +161,6 @@ def rolling_generate(
         raise NotImplementedError(
             "rolling cache does not compose with cache_quant yet"
         )
-    if sampler is not None and sampler.repetition_penalty > 1.0:
-        raise NotImplementedError(
-            "repetition_penalty is not wired into rolling_generate yet "
-            "(use generate)"
-        )
     b, p = prompt.shape
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
@@ -180,19 +179,27 @@ def rolling_generate(
         v=_ring_from_prefill(pre_cache.v, p, w),
     )
 
+    # presence mask for the repetition penalty (same shared helpers as
+    # generate._generate_jit; carried unconditionally, ignored when off)
+    presence = init_presence(prompt, cfg.vocab_size)
+
+    def pick(logits, key, presence):
+        return sample_and_mark(logits, key, sampler, presence)
+
     key, sub = jax.random.split(key)
-    first = sample_logits(logits[:, -1], sub, sampler)
+    first, presence = pick(logits[:, -1], sub, presence)
 
     def step(carry, i):
-        last, ring, key = carry
+        last, ring, key, presence = carry
         logits, ring = _ring_forward(params, last, ring, p + i, cfg)
         key, sub = jax.random.split(key)
-        tok = sample_logits(logits, sub, sampler)
-        return (tok, ring, key), tok
+        tok, presence = pick(logits, sub, presence)
+        return (tok, ring, key, presence), tok
 
     if max_new == 1:
         return first[:, None]
     _, toks = jax.lax.scan(
-        step, (first, ring, key), jnp.arange(max_new - 1, dtype=jnp.int32)
+        step, (first, ring, key, presence),
+        jnp.arange(max_new - 1, dtype=jnp.int32),
     )
     return jnp.concatenate([first[:, None], toks.T], axis=1)

@@ -110,6 +110,25 @@ def apply_repetition_penalty(
     return jnp.where(presence, penalized, logits)
 
 
+def init_presence(prompt: jax.Array, vocab_size: int) -> jax.Array:
+    """(B, P) prompt -> (B, V) bool mask of tokens already in context —
+    the repetition-penalty state every decode loop threads (shared by
+    generate and rolling_generate so the two cannot drift)."""
+    b = prompt.shape[0]
+    rows = jnp.arange(b)[:, None]
+    return jnp.zeros((b, vocab_size), bool).at[rows, prompt].set(True)
+
+
+def sample_and_mark(
+    logits: jax.Array, key: jax.Array, sampler: "Sampler",
+    presence: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Sample one token per row and record it in the presence mask."""
+    tok = sample_logits(logits, key, sampler, presence=presence)
+    b = presence.shape[0]
+    return tok, presence.at[jnp.arange(b), tok].set(True)
+
+
 def sample_logits(
     logits: jax.Array,
     key: jax.Array,

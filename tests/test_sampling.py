@@ -148,9 +148,8 @@ def test_repetition_penalty_breaks_greedy_loops():
     assert (pen >= 0).all() and (pen < 32).all()
 
 
-def test_repetition_penalty_rejected_where_unsupported():
+def test_repetition_penalty_rejected_in_speculative():
     from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
-    from k8s_gpu_device_plugin_tpu.models.rolling import rolling_generate
     from k8s_gpu_device_plugin_tpu.models.speculative import (
         speculative_generate,
     )
@@ -163,8 +162,24 @@ def test_repetition_penalty_rejected_where_unsupported():
         speculative_generate(
             params, cfg, params, cfg, prompt, max_new=2, sampler=s
         )
+
+
+def test_repetition_penalty_in_rolling_matches_generate():
+    """Greedy + penalty is deterministic, and rolling's windowed decode
+    with a penalty must equal the unbounded windowed generate with the
+    same penalty (presence threading is identical)."""
     from dataclasses import replace
 
-    cfg_w = replace(cfg, sliding_window=8)
-    with pytest.raises(NotImplementedError, match="repetition_penalty"):
-        rolling_generate(params, prompt, cfg_w, max_new=2, sampler=s)
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+    from k8s_gpu_device_plugin_tpu.models.rolling import rolling_generate
+
+    cfg = LlamaConfig.tiny(
+        n_layers=2, vocab_size=32, sliding_window=8, dtype=jnp.float32
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+    s = Sampler(repetition_penalty=3.0)
+    ref = generate(params, prompt, cfg, max_new=12, sampler=s)
+    got = rolling_generate(params, prompt, cfg, max_new=12, sampler=s)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
