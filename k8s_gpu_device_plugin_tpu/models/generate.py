@@ -284,6 +284,15 @@ def prefill(params, prompt, cache: KVCache, cfg: LlamaConfig):
     return logits[:, -1], cache
 
 
+def _mask_after_eos(toks, eos_id, pad_id):
+    """Pad everything strictly after each row's first EOS (the EOS itself
+    is kept): exclusive cumulative count of EOS occurrences. One
+    implementation for every decode entry point."""
+    is_eos = (toks == eos_id).astype(jnp.int32)
+    after_eos = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+    return jnp.where(after_eos, pad_id, toks)
+
+
 def generate(
     params,
     prompt: jax.Array,
@@ -314,11 +323,7 @@ def generate(
     toks = _generate_jit(params, prompt, cfg, max_new, key, temperature,
                          sampler)
     if eos_id is not None:
-        # pad everything strictly after each row's first EOS (the EOS
-        # itself is kept): exclusive cumulative count of EOS occurrences
-        is_eos = (toks == eos_id).astype(jnp.int32)
-        after_eos = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
-        toks = jnp.where(after_eos, pad_id, toks)
+        toks = _mask_after_eos(toks, eos_id, pad_id)
     return toks
 
 
@@ -349,6 +354,17 @@ def _generate_jit(
     b, p = prompt.shape
     cache = KVCache.init(cfg, b, p + max_new)
     logits, cache = prefill(params, prompt, cache, cfg)
+    return _decode_loop(
+        params, prompt, cache, logits, p, cfg, max_new, sampler, key
+    )
+
+
+def _decode_loop(params, prompt, cache, logits, length, cfg, max_new,
+                 sampler, key):
+    """The scanned decode loop shared by ``generate`` and prefix-cached
+    continuation (``generate_from``): ``logits`` is the next-token
+    distribution at position ``length``; the cache holds everything
+    before it and has >= max_new free rows."""
     key = key if key is not None else jax.random.key(0)
 
     # presence mask of every context token (prompt + generated) for the
@@ -364,7 +380,7 @@ def _generate_jit(
         key, sub = jax.random.split(key)
         tok, presence = pick(logits, sub, presence)   # (B,)
         logits, cache = _forward_cached(
-            params, tok[:, None], cache, p + i, cfg
+            params, tok[:, None], cache, length + i, cfg
         )
         return (logits[:, -1], cache, key, presence), tok
 
@@ -376,3 +392,70 @@ def _generate_jit(
     key, sub = jax.random.split(key)
     last, _ = pick(logits, sub, presence)
     return jnp.concatenate([toks, last[None]], axis=0).T  # (B, max_new)
+
+
+_prefill_jit = jax.jit(prefill, static_argnames=("cfg",))
+
+
+def prefill_prompt(
+    params, prompt: jax.Array, cfg: LlamaConfig, max_new_capacity: int
+) -> tuple[KVCache, jax.Array]:
+    """Prefill once for prefix-cached serving: returns (cache with
+    ``max_new_capacity`` free rows, next-token logits (B, V)).
+
+    JAX arrays are immutable, so the returned state can seed ANY number of
+    divergent continuations via :func:`generate_from` — the classic
+    system-prompt reuse pattern costs one prefill total, not one per
+    continuation."""
+    if cfg.quant != "none":
+        # fail BEFORE the expensive prefill: generate_from would reject
+        # the continuation anyway
+        raise NotImplementedError("decode path is bf16-only (quant='none')")
+    b, p = prompt.shape
+    cache = KVCache.init(cfg, b, p + max_new_capacity)
+    logits, cache = _prefill_jit(params, prompt, cache, cfg=cfg)
+    return cache, logits
+
+
+def generate_from(
+    params,
+    prompt: jax.Array,
+    cache: KVCache,
+    logits: jax.Array,
+    cfg: LlamaConfig,
+    max_new: int,
+    key: jax.Array | None = None,
+    sampler: "Sampler | None" = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Continue from a :func:`prefill_prompt` state — the same decode loop
+    ``generate`` runs, so a continuation is TOKEN-IDENTICAL to a fresh
+    ``generate`` with the same prompt/key/sampler (test-pinned). ``prompt``
+    is the prefilled prompt (needed for the repetition-penalty presence
+    mask); the state is never mutated, so call this repeatedly with
+    different keys/samplers to branch."""
+    if cfg.quant != "none":
+        raise NotImplementedError("decode path is bf16-only (quant='none')")
+    sampler = sampler if sampler is not None else Sampler()
+    p = prompt.shape[1]
+    if cache.k.shape[2] < p + max_new:
+        raise ValueError(
+            f"cache has {cache.k.shape[2] - p} free rows but max_new="
+            f"{max_new}; prefill with a larger max_new_capacity"
+        )
+    toks = _generate_from_jit(
+        params, prompt, cache, logits, cfg, max_new, key, sampler
+    )
+    if eos_id is not None:
+        toks = _mask_after_eos(toks, eos_id, pad_id)
+    return toks
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "sampler"))
+def _generate_from_jit(params, prompt, cache, logits, cfg, max_new, key,
+                       sampler):
+    return _decode_loop(
+        params, prompt, cache, logits, prompt.shape[1], cfg, max_new,
+        sampler, key
+    )

@@ -258,3 +258,42 @@ def test_eos_stops_each_row_independently():
             np.testing.assert_array_equal(got[r], base[r])
     # row 0 definitely has one
     assert (got[0, 3:] == -1).all()
+
+
+def test_prefix_cached_continuation_matches_fresh_generate():
+    """One prefill, many branches: each generate_from continuation must be
+    token-identical to a fresh generate with the same prompt/key/sampler
+    (same decode loop, same key schedule), and the shared state is never
+    mutated between branches."""
+    from k8s_gpu_device_plugin_tpu.models.generate import (
+        generate_from,
+        prefill_prompt,
+    )
+    from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 9), 0, cfg.vocab_size,
+                                jnp.int32)
+    cache, logits = prefill_prompt(params, prompt, cfg, max_new_capacity=8)
+
+    # greedy branch
+    a = generate_from(params, prompt, cache, logits, cfg, max_new=6)
+    np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(generate(params, prompt, cfg, max_new=6))
+    )
+    # two sampled branches from the SAME state with different keys
+    s = Sampler(temperature=0.9, top_k=30)
+    b1 = generate_from(params, prompt, cache, logits, cfg, max_new=6,
+                       key=jax.random.key(7), sampler=s)
+    b2 = generate_from(params, prompt, cache, logits, cfg, max_new=6,
+                       key=jax.random.key(8), sampler=s)
+    ref1 = generate(params, prompt, cfg, max_new=6, key=jax.random.key(7),
+                    sampler=s)
+    np.testing.assert_array_equal(np.asarray(b1), np.asarray(ref1))
+    assert not np.array_equal(np.asarray(b1), np.asarray(b2))
+    # capacity guard
+    import pytest
+
+    with pytest.raises(ValueError, match="free rows"):
+        generate_from(params, prompt, cache, logits, cfg, max_new=9)
