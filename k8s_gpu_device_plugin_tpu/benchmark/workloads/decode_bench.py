@@ -68,7 +68,7 @@ def decode_bench(
             quantize_weights_int8,
         )
 
-        params = quantize_weights_int8(params, cfg)
+        params = quantize_weights_int8(params)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )

@@ -37,6 +37,7 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     rope,
 )
 from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+    qexpert_einsum,
     qhead_matmul,
     qmatmul,
 )
@@ -186,10 +187,10 @@ def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
         axis=2,
     )                                                            # (B,T,E)
     gate = jax.nn.silu(
-        jnp.einsum("btd,edf->btef", h, layer["moe_w1"]).astype(jnp.float32)
+        qexpert_einsum("btd,edf->btef", h, layer["moe_w1"]).astype(jnp.float32)
     ).astype(h.dtype)
-    up = jnp.einsum("btd,edf->btef", h, layer["moe_w3"])
-    y = jnp.einsum("btef,efd->bted", gate * up, layer["moe_w2"])
+    up = qexpert_einsum("btd,edf->btef", h, layer["moe_w3"])
+    y = qexpert_einsum("btef,efd->bted", gate * up, layer["moe_w2"])
     return jnp.einsum("bte,bted->btd", mix.astype(h.dtype), y)
 
 

@@ -26,28 +26,29 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
 
 # weight leaves quantized per layer (contraction axis is axis -2 for all)
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
-def quantize_weights_int8(params: dict, cfg: LlamaConfig) -> dict:
+# MoE expert stacks (L, E, in, out): quantized per (layer, expert,
+# output-channel) — the contraction axis is still -2
+_MOE_QUANT_LEAVES = ("moe_w1", "moe_w3", "moe_w2")
+
+
+def quantize_weights_int8(params: dict) -> dict:
     """Float pytree -> serving pytree with int8 projection/MLP weights.
 
     Each targeted (L, in, out) stack becomes ``{"q": int8, "s": f32}``
-    with per-(layer, output-channel) scales, shape (L, 1, out). The
-    lm_head (d, vocab) is quantized the same way; embed and norms keep
-    their float dtype. MoE expert stacks are not supported yet.
+    with per-(layer, output-channel) scales, shape (L, 1, out); MoE expert
+    stacks (L, E, in, out) quantize per (layer, expert, output-channel).
+    The lm_head (d, vocab) is quantized the same way; embed, norms, and
+    the MoE router keep their float dtype.
     """
-    if cfg.is_moe:
-        raise NotImplementedError(
-            "weight-only int8 serving does not cover MoE expert stacks yet"
-        )
     layers = {}
     for name, w in params["layers"].items():
-        if name in _QUANT_LEAVES:
+        if name in _QUANT_LEAVES or name in _MOE_QUANT_LEAVES:
             q, s = quantize_int8(w, axis=-2)     # contract over 'in'
             layers[name] = {"q": q, "s": s}
         else:
@@ -78,6 +79,23 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
             y.astype(jnp.float32) * jnp.squeeze(w["s"], axis=-2)
         ).astype(x.dtype)
     return jnp.matmul(x, w)
+
+
+def qexpert_einsum(pattern: str, x: jax.Array, w) -> jax.Array:
+    """Per-expert einsum (``btd,edf->btef`` or ``btef,efd->bted``) where
+    ``w`` may be a float stack or an int8 {"q", "s"} leaf with
+    per-(expert, output-channel) scales (E, 1, N).
+
+    The scale commutes through the contraction (it varies only over the
+    kept expert/output axes), so it multiplies the result and the int8
+    stack stays the einsum operand."""
+    if not is_quantized_leaf(w):
+        return jnp.einsum(pattern, x, w)
+    y = jnp.einsum(pattern, x, w["q"].astype(x.dtype))
+    s = jnp.squeeze(w["s"], axis=-2)            # (E, N)
+    # output is (..., E, N) for btd,edf->btef and (..., E, N) for
+    # btef,efd->bted alike: broadcast scales over the leading axes
+    return (y.astype(jnp.float32) * s).astype(x.dtype)
 
 
 def qhead_matmul(x: jax.Array, head, dtype) -> jax.Array:

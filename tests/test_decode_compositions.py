@@ -47,7 +47,7 @@ def test_decode_knobs_compose(window, cache_quant, int8_weights, sampler,
                               base_params):
     cfg = replace(BASE, sliding_window=window, cache_quant=cache_quant)
     params = (
-        quantize_weights_int8(base_params, cfg) if int8_weights else base_params
+        quantize_weights_int8(base_params) if int8_weights else base_params
     )
     prompt = jnp.arange(1, 13, dtype=jnp.int32)[None, :]
     toks = generate(
