@@ -159,8 +159,10 @@ def _flash_tune_result(workload: str, **kw) -> dict:
     return {
         "workload": workload,
         "shape": list(r.shape),
-        "fwd_ms": {k: round(v, 2) for k, v in r.fwd_ms.items()},
-        "bwd_ms": {k: round(v, 2) for k, v in r.bwd_ms.items()},
+        "fwd_ms": {k: round(v, 2) if isinstance(v, float) else v
+                   for k, v in r.fwd_ms.items()},
+        "bwd_ms": {k: round(v, 2) if isinstance(v, float) else v
+                   for k, v in r.bwd_ms.items()},
         "best_fwd": r.best_fwd,
         "best_bwd": r.best_bwd,
     }
@@ -221,6 +223,23 @@ def _run_decode_int8w() -> dict:
     return _decode_result("decode_int8w", int8_weights=True)
 
 
+def _run_opt_tune() -> dict:
+    """Optimizer-update micro-bench: production optax chain vs a hand-fused
+    two-pass AdamW over the bench param tree, donated, vs the HBM floor.
+    Decides whether the step breakdown's optimizer attribution is real
+    update cost or undonated copy-out noise."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.opt_tune import opt_tune
+
+    _require_accelerator()
+    r = opt_tune()
+    return {
+        "workload": "opt_tune",
+        "variants_ms": {k: round(v, 2) for k, v in r.variants_ms.items()},
+        "param_count": r.param_count,
+        "param_bytes": r.param_bytes,
+    }
+
+
 def _run_roundtrip() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
         control_plane_roundtrip,
@@ -265,6 +284,7 @@ WORKLOADS = {
     "breakdown_attn": _run_breakdown_attn,
     "flash_tune": _run_flash_tune,
     "flash_tune_long": _run_flash_tune_long,
+    "opt_tune": _run_opt_tune,
     "decode": _run_decode,
     "decode_int8w": _run_decode_int8w,
     "roundtrip": _run_roundtrip,

@@ -12,6 +12,7 @@ Run: python -m k8s_gpu_device_plugin_tpu.benchmark.runner flash_tune
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass
 
 import jax
@@ -26,8 +27,10 @@ from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
 @dataclass(frozen=True)
 class FlashTuneResult:
     shape: tuple          # (B, S, Hq, Hkv, D)
-    fwd_ms: dict          # "bq x bk" -> best-of-N ms
-    bwd_ms: dict          # "bq x bk" (backward tiling) -> best-of-N ms
+    # "bqxbk" -> best-of-N ms (float), or "error: <ExcName>" (str) for a
+    # tiling the backend rejected — one bad config must not void the sweep
+    fwd_ms: dict
+    bwd_ms: dict
     best_fwd: str
     best_bwd: str
 
@@ -57,8 +60,8 @@ def flash_tune(
     v = jax.random.normal(kv, (batch, seq, n_kv_heads, head_dim), jnp.bfloat16)
     do = jax.random.normal(kd, q.shape, jnp.bfloat16)
 
-    fwd_ms: dict[str, float] = {}
-    bwd_ms: dict[str, float] = {}
+    fwd_ms: dict[str, float | str] = {}
+    bwd_ms: dict[str, float | str] = {}
     for bq, bk in blocks:
         if seq % bq or seq % bk:
             continue
@@ -76,9 +79,16 @@ def flash_tune(
             c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
             return c
 
-        fwd_ms[label] = _time_scalar(
-            fwd_scalar, (q, k, v), repeats
-        ) / iters * 1000
+        # One tiling that the compiler rejects (VMEM blow-up surfaces as a
+        # failed remote compile on the relayed backend) must not kill the
+        # whole sweep — record the failure and keep measuring.
+        try:
+            fwd_ms[label] = _time_scalar(
+                fwd_scalar, (q, k, v), repeats
+            ) / iters * 1000
+        except Exception as e:  # noqa: BLE001 - sweep robustness
+            fwd_ms[label] = f"error: {type(e).__name__}"
+            print(f"flash_tune: fwd {label} failed: {e}", file=sys.stderr)
 
         # fwd+bwd with FIXED (default) fwd tiling: isolates the backward
         # tiling's effect. Grads wrt ALL of q/k/v — dq and dk/dv are two
@@ -101,14 +111,22 @@ def flash_tune(
             c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
             return c
 
-        bwd_ms[label] = _time_scalar(
-            bwd_scalar, (q, k, v, do), repeats
-        ) / iters * 1000
+        try:
+            bwd_ms[label] = _time_scalar(
+                bwd_scalar, (q, k, v, do), repeats
+            ) / iters * 1000
+        except Exception as e:  # noqa: BLE001 - sweep robustness
+            bwd_ms[label] = f"error: {type(e).__name__}"
+            print(f"flash_tune: bwd {label} failed: {e}", file=sys.stderr)
+
+    def _best(d: dict) -> str:
+        timed = {k: v for k, v in d.items() if isinstance(v, float)}
+        return min(timed, key=timed.get) if timed else "none"
 
     return FlashTuneResult(
         shape=(batch, seq, n_heads, n_kv_heads, head_dim),
         fwd_ms=fwd_ms,
         bwd_ms=bwd_ms,
-        best_fwd=min(fwd_ms, key=fwd_ms.get),
-        best_bwd=min(bwd_ms, key=bwd_ms.get),
+        best_fwd=_best(fwd_ms),
+        best_bwd=_best(bwd_ms),
     )
