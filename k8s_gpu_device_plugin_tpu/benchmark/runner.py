@@ -12,7 +12,21 @@ Usage: python -m k8s_gpu_device_plugin_tpu.benchmark.runner {matmul|train|roundt
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+# Persistent compilation cache, set before any jax import: bench workloads
+# run as fresh subprocesses, and the tunneled backend's usable windows can
+# be minutes long — a recompiled-from-scratch step must never eat a window
+# a cached executable could have used. (Env-var form so it binds whether
+# jax is imported here or inside a workload module.)
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def _require_accelerator():
@@ -75,13 +89,16 @@ def _model_dims(cfg) -> dict:
     }
 
 
-def _train_result(workload: str, quant: str, fused_ce: bool = False) -> dict:
+def _train_result(
+    workload: str, quant: str, fused_ce: bool = False, opt_impl: str = "optax",
+) -> dict:
     """Shared train-bench runner so all variants stay like-for-like."""
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import train_mfu
 
     _require_accelerator()
     cfg = _bench_model_cfg(quant=quant, fused_ce=fused_ce)
-    r = train_mfu(cfg, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ, steps=5, warmup=2)
+    r = train_mfu(cfg, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ, steps=5,
+                  warmup=2, opt_impl=opt_impl)
     return {
         "workload": workload,
         "mfu_pct": round(r.mfu * 100, 2),
@@ -108,6 +125,13 @@ def _run_train_fused() -> dict:
     """Train bench with the fused lm_head+CE (bf16 math, same objective —
     ops/fused_ce.py); a pure-perf candidate for the primary metric."""
     return _train_result("train_fused", quant="none", fused_ce=True)
+
+
+def _run_train_fusedopt() -> dict:
+    """Train bench with the fused single-pass AdamW (ops/fused_optim.py):
+    same numerics as the optax chain; measures what the optimizer fusion
+    is worth inside the full step."""
+    return _train_result("train_fusedopt", quant="none", opt_impl="fused")
 
 
 def _run_breakdown() -> dict:
@@ -280,6 +304,7 @@ WORKLOADS = {
     "train": _run_train,
     "train_int8": _run_train_int8,
     "train_fused": _run_train_fused,
+    "train_fusedopt": _run_train_fusedopt,
     "breakdown": _run_breakdown,
     "breakdown_attn": _run_breakdown_attn,
     "flash_tune": _run_flash_tune,

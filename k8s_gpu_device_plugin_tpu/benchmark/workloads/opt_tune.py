@@ -31,11 +31,9 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
-    _time_scalar_fn,
-)
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
 from k8s_gpu_device_plugin_tpu.models.train import make_optimizer
+from k8s_gpu_device_plugin_tpu.ops.fused_optim import fused_adamw_update
 
 
 @dataclass(frozen=True)
@@ -44,38 +42,6 @@ class OptTuneResult:
     param_count: int
     param_bytes: int
     hbm_floor_ms: float     # minimum-traffic estimate at peak HBM bandwidth
-
-
-def _fused_adamw_update(
-    params, grads, mu, nu, count,
-    *, lr: float, b1: float, b2: float, eps: float,
-    weight_decay: float, clip: float,
-):
-    """One AdamW step with global-norm clipping in two HBM passes: a
-    norm-reduction read over the grads, then a single fused elementwise
-    pass per leaf. Matches optax.chain(clip_by_global_norm, adamw)
-    numerics (same moment dtype as the params, f32 math per element)."""
-    gnorm = optax.global_norm(grads)
-    scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-16)).astype(jnp.float32)
-    count = count + 1
-    c = count.astype(jnp.float32)
-    bc1 = 1.0 - b1**c
-    bc2 = 1.0 - b2**c
-
-    def leaf(p, g, m, v):
-        g32 = g.astype(jnp.float32) * scale
-        m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
-        v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * g32 * g32
-        upd = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
-        p32 = p.astype(jnp.float32)
-        new_p = p32 - lr * (upd + weight_decay * p32)
-        return new_p.astype(p.dtype), m32.astype(m.dtype), v32.astype(v.dtype)
-
-    out = jax.tree.map(leaf, params, grads, mu, nu)
-    new_params = jax.tree.map(lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
-    new_mu = jax.tree.map(lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
-    new_nu = jax.tree.map(lambda t: t[2], out, is_leaf=lambda t: isinstance(t, tuple))
-    return new_params, new_mu, new_nu, count
 
 
 def opt_tune(
@@ -139,7 +105,7 @@ def opt_tune(
     def fused_scan(params, mu, nu, grads):
         def body(carry, _):
             p, m, v, c = carry
-            p, m, v, c = _fused_adamw_update(
+            p, m, v, c = fused_adamw_update(
                 p, grads, m, v, c,
                 lr=lr, b1=0.9, b2=0.95, eps=1e-8,
                 weight_decay=0.1, clip=1.0,

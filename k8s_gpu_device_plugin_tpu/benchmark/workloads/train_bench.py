@@ -44,13 +44,14 @@ def train_mfu(
     steps: int = 10,
     warmup: int = 2,
     devices: list | None = None,
+    opt_impl: str = "optax",
 ) -> TrainBenchResult:
     devices = devices or jax.devices()
     spec = mesh_spec or MeshSpec.for_devices(len(devices))
     mesh = make_mesh(spec, devices)
     n = spec.num_devices
 
-    optimizer = make_optimizer(total_steps=steps + warmup + 1)
+    optimizer = make_optimizer(total_steps=steps + warmup + 1, impl=opt_impl)
     state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
     batch = synthetic_batch(jax.random.key(1), cfg, batch_size, seq_len, mesh)
     # throughput bench: skip the accuracy argmax (an extra full pass over

@@ -1,0 +1,319 @@
+"""Continuous batching for serving: slot-based prefill/insert/decode.
+
+TPU-first design (the JetStream/static-shape idiom, NOT GPU paged
+attention): a serving engine must keep the chip busy while requests
+arrive and finish at different times. GPUs solve the resulting memory
+fragmentation with paged KV caches and block tables; on TPU the winning
+shape is simpler — XLA wants static shapes, and the HBM for a fixed
+number of concurrent sequences can be preallocated outright. So:
+
+- The KV cache is dense ``(L, n_slots, max_len, Hkv, hd)``; a *slot* is
+  one concurrent sequence's reserved cache rows.
+- Every slot decodes at its OWN absolute position: ``lengths`` is a
+  (B,) vector, attention masks per row, rope takes per-row positions,
+  and the cache write is a vmapped per-row dynamic_update_slice
+  (generate.py's ``_cache_write``/``_cached_attention`` generalize over
+  scalar-vs-vector ``length``; this module is why).
+- **Prefill-then-insert**: a new request prefills against a fresh
+  single-row cache sized to its padded bucket, and the filled rows are
+  inserted into its slot. Prompt lengths are bucketed to powers of two
+  so the prefill jit compiles once per bucket, not once per length.
+- **The decode step never changes shape**: finished/empty slots keep
+  computing (their outputs are masked) — the fixed-shape trade every
+  TPU decode loop makes, now applied across requests instead of within
+  one batch.
+
+The host-side :class:`ContinuousBatcher` owns the request queue, slot
+assignment and per-request budgets; the device state is a plain pytree
+(:class:`BatchState`) so the jitted step stays purely functional.
+
+Capability parity note: the reference repo (a device plugin) has no
+serving engine; this extends the workload stack the same way the
+allocator extends its scheduling (SURVEY §2 'Parallelism substrate').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.generate import (
+    KVCache,
+    _forward_cached,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_and_mark
+
+
+@dataclass(frozen=True)
+class BatchState:
+    """Device-side state of the serving batch (a pytree; jit-carried)."""
+
+    cache: KVCache
+    lengths: jax.Array     # (B,) int32: valid cache rows per slot
+    last_token: jax.Array  # (B,) int32: input to the next decode step
+    active: jax.Array      # (B,) bool: slot is mid-generation
+    presence: jax.Array    # (B, V) bool: repetition-penalty context mask
+    key: jax.Array         # PRNG key (split per step, folded per slot)
+
+
+jax.tree_util.register_dataclass(
+    BatchState,
+    ("cache", "lengths", "last_token", "active", "presence", "key"),
+    (),
+)
+
+
+def init_batch_state(
+    cfg: LlamaConfig, n_slots: int, max_len: int, seed: int = 0
+) -> BatchState:
+    return BatchState(
+        cache=KVCache.init(cfg, n_slots, max_len),
+        lengths=jnp.zeros((n_slots,), jnp.int32),
+        last_token=jnp.zeros((n_slots,), jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+        presence=jnp.zeros((n_slots, cfg.vocab_size), bool),
+        key=jax.random.key(seed),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+def prefill_insert(
+    params,
+    state: BatchState,
+    prompt: jax.Array,       # (P,) int32, padded to a bucket size
+    prompt_len: jax.Array,   # scalar int32: real length (<= P)
+    slot: jax.Array,         # scalar int32
+    cfg: LlamaConfig,
+    sampler: Sampler,
+) -> tuple[BatchState, jax.Array]:
+    """Prefill one request and insert it into ``slot``.
+
+    Runs the prompt through a fresh single-row cache of capacity P (the
+    padded bucket — P is ``prompt.shape[0]``, so each bucket compiles
+    once), writes rows [0, P) into the slot's cache (rows past
+    ``prompt_len`` are garbage but provably never attended: every later
+    read masks to ``lengths[slot]``), seeds the slot's sampling state,
+    and returns (state, first generated token).
+    """
+    p = prompt.shape[0]
+    scratch = KVCache.init(cfg, 1, p)
+    # project ONLY the last real prompt position (select_pos): the padded
+    # bucket's other rows never reach the lm_head matmul or logits HBM
+    logits, scratch = _forward_cached(
+        params, prompt[None, :], scratch, jnp.int32(0), cfg,
+        select_pos=prompt_len - 1,
+    )
+    first_logits = logits[0, 0]  # (V,)
+
+    # presence mask over the real prompt only (padding must not count as
+    # seen context for the repetition penalty); .max = scatter-OR, so a
+    # token appearing both in the prompt and the padding stays True
+    seen = jnp.zeros((cfg.vocab_size,), bool).at[prompt].max(
+        jnp.arange(p) < prompt_len
+    )
+
+    key, sub = jax.random.split(state.key)
+    tok, seen = sample_and_mark(
+        first_logits[None, :], sub, sampler, seen[None, :]
+    )
+    tok = tok[0]
+
+    def insert_rows(full, rows):
+        if full is None:  # bf16 cache: no scale planes
+            return None
+        # (L, B, S, H, d) <- (L, 1, P, H, d) at (0, slot, 0, 0, 0)
+        return jax.lax.dynamic_update_slice(
+            full, rows, (0, slot, 0, 0, 0)
+        )
+
+    cache = jax.tree.map(
+        insert_rows, state.cache, scratch,
+        is_leaf=lambda x: x is None,
+    )
+
+    write = jnp.int32(slot)
+    return BatchState(
+        cache=cache,
+        lengths=state.lengths.at[write].set(prompt_len),
+        last_token=state.last_token.at[write].set(tok),
+        active=state.active.at[write].set(True),
+        presence=state.presence.at[write].set(seen[0]),
+        key=key,
+    ), tok
+
+
+@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+def decode_step(
+    params,
+    state: BatchState,
+    allowed: jax.Array,  # (B,) bool: host-side budget gate per slot
+    eos_id: jax.Array,   # scalar int32 (-1 disables EOS stopping)
+    cfg: LlamaConfig,
+    sampler: Sampler,
+) -> tuple[BatchState, jax.Array]:
+    """One token for every slot (inactive slots compute-and-discard).
+
+    Returns (state, emitted (B,) int32) where emitted[i] is -1 for slots
+    that were not active this step. EOS tokens ARE emitted (matching
+    ``generate``'s keep-the-EOS semantics) and deactivate the slot after.
+    """
+    logits, cache = _forward_cached(
+        params, state.last_token[:, None], state.cache, state.lengths, cfg
+    )
+    key, sub = jax.random.split(state.key)
+    tok, presence = sample_and_mark(
+        logits[:, -1], sub, sampler, state.presence
+    )
+
+    was_active = state.active & allowed
+    hit_eos = (tok == eos_id) & (eos_id >= 0)
+    full = state.lengths + 1 >= state.cache.k.shape[2]
+    emitted = jnp.where(was_active, tok, -1)
+    return BatchState(
+        cache=cache,
+        lengths=jnp.where(was_active, state.lengths + 1, state.lengths),
+        last_token=jnp.where(was_active, tok, state.last_token),
+        active=was_active & ~hit_eos & ~full,
+        presence=jnp.where(was_active[:, None], presence, state.presence),
+        key=key,
+    ), emitted
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: list[int]
+    max_new: int
+    out: list[int] = field(default_factory=list)
+    slot: int = -1
+
+
+class ContinuousBatcher:
+    """Host-side orchestrator: request queue -> slots -> token streams.
+
+    Usage::
+
+        cb = ContinuousBatcher(params, cfg, n_slots=4, max_len=256)
+        rid = cb.submit([1, 5, 7], max_new=32)
+        results = cb.run()          # {rid: [tok, ...], ...}
+
+    ``run`` drains the queue: admits pending requests whenever slots are
+    free (one bucketed prefill each), then steps the whole batch one
+    token at a time, finishing requests on EOS or their ``max_new``
+    budget. Submitting more requests than slots is the point — slot
+    reuse IS continuous batching.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        n_slots: int,
+        max_len: int,
+        sampler: Sampler | None = None,
+        eos_id: int | None = None,
+        prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+        seed: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.sampler = sampler or Sampler()
+        self.eos_id = -1 if eos_id is None else eos_id
+        self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_len={max_len} "
+                f"(buckets={prompt_buckets})"
+            )
+        self.state = init_batch_state(cfg, n_slots, max_len, seed)
+        self.pending: list[_Request] = []
+        self.running: dict[int, _Request] = {}   # slot -> request
+        self.done: dict[int, list[int]] = {}
+        self._next_rid = 0
+
+    def submit(self, prompt: list[int], max_new: int) -> int:
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"slot capacity {self.max_len}"
+            )
+        # reject here, not in _admit: a mid-run() bucket failure would
+        # strand every in-flight neighbor
+        _bucket(len(prompt), self.buckets)
+        rid = self._next_rid
+        self._next_rid += 1
+        self.pending.append(_Request(rid, list(prompt), max_new))
+        return rid
+
+    # --- internals ---
+
+    def _admit(self) -> None:
+        free = [s for s in range(self.n_slots) if s not in self.running]
+        while free and self.pending:
+            req = self.pending.pop(0)
+            slot = free.pop(0)
+            bucket = _bucket(len(req.prompt), self.buckets)
+            padded = jnp.asarray(
+                req.prompt + [0] * (bucket - len(req.prompt)), jnp.int32
+            )
+            self.state, tok = prefill_insert(
+                self.params, self.state, padded,
+                jnp.int32(len(req.prompt)), jnp.int32(slot),
+                self.cfg, self.sampler,
+            )
+            req.slot = slot
+            req.out.append(int(tok))
+            self.running[slot] = req
+            self._finish_if_done(req)
+
+    def _finish_if_done(self, req: _Request) -> None:
+        """EOS or budget exhaustion retires the request and frees its slot."""
+        hit_eos = self.eos_id >= 0 and req.out and req.out[-1] == self.eos_id
+        if hit_eos or len(req.out) >= req.max_new:
+            self.done[req.rid] = req.out
+            if req.slot in self.running:
+                del self.running[req.slot]
+
+    def step(self) -> None:
+        """Admit what fits, then one decode step for the whole batch."""
+        self._admit()
+        if not self.running:
+            return
+        # host-built mask: one array transfer, not one scatter per slot
+        allowed_np = np.zeros((self.n_slots,), bool)
+        allowed_np[list(self.running)] = True
+        allowed = jnp.asarray(allowed_np)
+        self.state, emitted = decode_step(
+            self.params, self.state, allowed, jnp.int32(self.eos_id),
+            self.cfg, self.sampler,
+        )
+        emitted = jax.device_get(emitted)
+        for slot, req in list(self.running.items()):
+            tok = int(emitted[slot])
+            if tok >= 0:
+                req.out.append(tok)
+                self._finish_if_done(req)
+
+    def run(self, max_steps: int | None = None) -> dict[int, list[int]]:
+        """Drive until every submitted request finished (or max_steps)."""
+        steps = 0
+        while self.pending or self.running:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return dict(self.done)

@@ -95,16 +95,22 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 def _cache_write(cache, scale, x, length):
     """Write T new tokens' K or V at ``length``; quantizing when the cache
-    is int8 (scale is the matching scale plane, else None)."""
+    is int8 (scale is the matching scale plane, else None).
+
+    ``length`` may be a scalar (uniform batch — the classic decode) or a
+    (B,) vector (continuous batching: every slot writes at its own
+    position; a vmapped dynamic_update_slice is one per-row scatter)."""
+    def write(c, val, l):
+        if jnp.ndim(l) == 0:
+            return jax.lax.dynamic_update_slice(c, val, (0, l, 0, 0))
+        return jax.vmap(
+            lambda cr, vr, lr: jax.lax.dynamic_update_slice(cr, vr, (lr, 0, 0))
+        )(c, val, l)
+
     if scale is None:
-        cache = jax.lax.dynamic_update_slice(
-            cache, x.astype(cache.dtype), (0, length, 0, 0)
-        )
-        return cache, None
+        return write(cache, x.astype(cache.dtype), length), None
     q, s = _quantize_kv(x)
-    cache = jax.lax.dynamic_update_slice(cache, q, (0, length, 0, 0))
-    scale = jax.lax.dynamic_update_slice(scale, s, (0, length, 0, 0))
-    return cache, scale
+    return write(cache, q, length), write(scale, s, length)
 
 
 def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
@@ -131,7 +137,10 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
         # (B, S, Hkv, 1) -> (B, Hkv, S) -> broadcast over (b, t, k, g, s)
         ks = k_scale[..., 0].transpose(0, 2, 1)
         scores = scores * ks[:, None, :, None, :]
-    q_pos = length + jnp.arange(t)[None, :, None, None, None]
+    # scalar length broadcasts; a (B,) vector gives every slot its own
+    # causal horizon (continuous batching)
+    base = length if jnp.ndim(length) == 0 else length[:, None, None, None, None]
+    q_pos = base + jnp.arange(t)[None, :, None, None, None]
     k_pos = jnp.arange(max_len)[None, None, None, None, :]
     keep = k_pos <= q_pos
     if cfg.sliding_window > 0:
@@ -241,11 +250,16 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
 def _forward_cached(
     params, tokens, cache: KVCache, length, cfg: LlamaConfig,
     last_only: bool = False,
+    select_pos: jax.Array | None = None,
 ):
     """Run T tokens (starting at absolute position ``length``) through all
     layers with cache update. Returns (logits (B, T, V) f32, new cache);
     ``last_only`` projects only the final position (prefill wants one
-    next-token distribution, not a (B, P, V) logits tensor)."""
+    next-token distribution, not a (B, P, V) logits tensor), and
+    ``select_pos`` (traced scalar) projects only that position — for
+    bucket-padded prefills where the last REAL token is not the last row
+    (continuous batching), keeping the lm_head matmul and its logits at
+    1/T the cost."""
     from k8s_gpu_device_plugin_tpu.models.llama import cast_params_for_compute
 
     # master-weight checkpoints (param_dtype=f32) decode in compute dtype —
@@ -254,7 +268,10 @@ def _forward_cached(
     params = cast_params_for_compute(params, cfg)
     b, t = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
-    positions = length + jnp.arange(t, dtype=jnp.int32)
+    if jnp.ndim(length) == 0:
+        positions = length + jnp.arange(t, dtype=jnp.int32)
+    else:  # per-slot positions (B, T) — rope handles 2D
+        positions = length[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
 
     # None scale planes are empty pytree leaves — lax.scan carries them
     # through untouched, so the bf16 and int8 paths share one structure
@@ -273,6 +290,8 @@ def _forward_cached(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
         x = x[:, -1:]
+    elif select_pos is not None:
+        x = jax.lax.dynamic_slice_in_dim(x, select_pos, 1, axis=1)
     logits = qhead_matmul(x, params["lm_head"], cfg.dtype)
     return logits, KVCache(
         k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new

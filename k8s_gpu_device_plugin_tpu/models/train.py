@@ -73,10 +73,26 @@ def make_optimizer(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: int = 10000,
-) -> optax.GradientTransformation:
+    impl: str = "optax",
+) -> optax.GradientTransformation | "FusedAdamW":
+    """AdamW with warmup-cosine schedule and global-norm clipping.
+
+    ``impl="optax"`` is the staged optax chain; ``impl="fused"`` is
+    ops/fused_optim.py's single-elementwise-pass variant (same numerics,
+    fewer HBM passes — the opt_tune workload measures the difference on
+    hardware). Both produce checkpointable pytree state."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
+    if impl == "fused":
+        from k8s_gpu_device_plugin_tpu.ops.fused_optim import FusedAdamW
+
+        return FusedAdamW(
+            lr_fn=schedule, b1=b1, b2=b2,
+            weight_decay=weight_decay, clip=grad_clip,
+        )
+    if impl != "optax":
+        raise ValueError(f"unknown optimizer impl {impl!r}")
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
@@ -164,6 +180,9 @@ def make_train_step(
     PER MICROBATCH and averaged — the same semantics the pipelined path
     uses (llama.py pipeline note), not the full-batch value."""
 
+    from k8s_gpu_device_plugin_tpu.ops.fused_optim import FusedAdamW
+
+    is_fused_opt = isinstance(optimizer, FusedAdamW)
     grad_fn = jax.value_and_grad(
         partial(loss_fn, cfg=cfg, mesh=mesh, with_accuracy=with_accuracy),
         has_aux=True,
@@ -202,10 +221,19 @@ def make_train_step(
                 acc, state["params"],
             )
             metrics = jax.tree.map(jnp.mean, metrics_stacked)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = optax.apply_updates(state["params"], updates)
+        if is_fused_opt:
+            from k8s_gpu_device_plugin_tpu.ops.fused_optim import (
+                fused_adamw_step,
+            )
+
+            params, opt_state = fused_adamw_step(
+                optimizer, state["params"], grads, state["opt_state"]
+            )
+        else:
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
         metrics["grad_norm"] = optax.global_norm(grads)
         return (
             {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
@@ -273,14 +301,22 @@ def init_train_state(
     # the mesh, so a checkpoint restore reproduces mesh-wide placements
     # instead of committed single-device ones (which jit rejects when mixed).
     replicated = NamedSharding(mesh, P())
-    abstract_opt = jax.eval_shape(optimizer.init, params)
-    opt_out_shardings = optax.tree_map_params(
-        optimizer,
-        lambda _, s: s,
-        abstract_opt,
-        shardings,
-        transform_non_params=lambda _: replicated,
-    )
+    from k8s_gpu_device_plugin_tpu.ops.fused_optim import FusedAdamW
+
+    if isinstance(optimizer, FusedAdamW):
+        # fused state mirrors the param tree twice plus a replicated count
+        opt_out_shardings = {
+            "mu": shardings, "nu": shardings, "count": replicated,
+        }
+    else:
+        abstract_opt = jax.eval_shape(optimizer.init, params)
+        opt_out_shardings = optax.tree_map_params(
+            optimizer,
+            lambda _, s: s,
+            abstract_opt,
+            shardings,
+            transform_non_params=lambda _: replicated,
+        )
     opt_state = jax.jit(optimizer.init, out_shardings=opt_out_shardings)(params)
     step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     return {"params": params, "opt_state": opt_state, "step": step}

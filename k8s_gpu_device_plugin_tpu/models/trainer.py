@@ -67,6 +67,9 @@ class TrainerConfig:
     trace_start: int = 3
     trace_stop: int = 6
     log_every: int = 10
+    # optimizer implementation: "optax" (staged chain) or "fused"
+    # (ops/fused_optim.py single-pass AdamW; same numerics)
+    opt_impl: str = "optax"
 
 
 @dataclass
@@ -106,6 +109,7 @@ class Trainer:
             learning_rate=cfg.learning_rate,
             warmup_steps=cfg.warmup_steps,
             total_steps=cfg.total_steps,
+            impl=cfg.opt_impl,
         )
         # fused CE has no logits to argmax, so accuracy is off on that path
         self.step_fn = make_train_step(
@@ -317,6 +321,11 @@ def _main(argv: list[str] | None = None) -> int:
                         help="store params/grads/optimizer moments in f32 "
                         "(bf16 compute stays on the MXU); retains updates "
                         "smaller than a bf16 ulp at 2x param memory")
+    parser.add_argument("--optImpl", default="optax",
+                        choices=["optax", "fused"],
+                        help="optimizer implementation: optax chain or the "
+                        "fused single-pass AdamW (same numerics, fewer HBM "
+                        "passes)")
     parser.add_argument("--fusedCE", action="store_true",
                         help="fused lm_head+cross-entropy (no materialized "
                         "logits; tp==1 only, accuracy reported as -1)")
@@ -355,6 +364,7 @@ def _main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpointDir,
         checkpoint_interval=args.checkpointInterval,
         trace_dir=args.traceDir,
+        opt_impl=args.optImpl,
     )
     result = Trainer(cfg).run()
     eval_str = (

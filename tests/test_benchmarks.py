@@ -118,9 +118,7 @@ def test_fused_adamw_matches_optax_chain():
     import jax.numpy as jnp
     import optax
 
-    from k8s_gpu_device_plugin_tpu.benchmark.workloads.opt_tune import (
-        _fused_adamw_update,
-    )
+    from k8s_gpu_device_plugin_tpu.ops.fused_optim import fused_adamw_update
 
     key = jax.random.key(7)
     k1, k2, k3 = jax.random.split(key, 3)
@@ -147,7 +145,7 @@ def test_fused_adamw_matches_optax_chain():
         updates, ref_state = ref_opt.update(grads, ref_state, ref_params)
         ref_params = optax.apply_updates(ref_params, updates)
         # sin-shaped grads keep the clip scale engaged on every step
-        fused_params, mu, nu, count = _fused_adamw_update(
+        fused_params, mu, nu, count = fused_adamw_update(
             fused_params, grads, mu, nu, count,
             lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=wd, clip=clip,
         )
@@ -161,9 +159,7 @@ def test_fused_adamw_clip_engages():
     import jax.numpy as jnp
     import optax
 
-    from k8s_gpu_device_plugin_tpu.benchmark.workloads.opt_tune import (
-        _fused_adamw_update,
-    )
+    from k8s_gpu_device_plugin_tpu.ops.fused_optim import fused_adamw_update
 
     params = {"w": jnp.ones((32, 4), jnp.float32)}
     grads = {"w": jnp.full((32, 4), 100.0, jnp.float32)}  # norm >> clip
@@ -175,7 +171,7 @@ def test_fused_adamw_clip_engages():
     state = ref_opt.init(params)
     updates, _ = ref_opt.update(grads, state, params)
     ref_params = optax.apply_updates(params, updates)
-    fused_params, _, _, _ = _fused_adamw_update(
+    fused_params, _, _, _ = fused_adamw_update(
         params, grads,
         jax.tree.map(jnp.zeros_like, params),
         jax.tree.map(jnp.zeros_like, params),
