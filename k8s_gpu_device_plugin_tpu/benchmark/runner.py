@@ -247,6 +247,27 @@ def _run_decode_int8w() -> dict:
     return _decode_result("decode_int8w", int8_weights=True)
 
 
+def _run_serve() -> dict:
+    """Request-level serving throughput through the continuous batcher
+    (mixed prompt lengths, slot reuse, admission prefills included)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    _require_accelerator()
+    cfg = _bench_model_cfg()
+    r = serve_bench(cfg)
+    return {
+        "workload": "serve",
+        "tokens_per_second": round(r.tokens_per_second, 1),
+        "requests_per_second": round(r.requests_per_second, 2),
+        "decode_step_ms": round(r.decode_step_ms, 2),
+        "n_requests": r.n_requests,
+        "n_slots": r.n_slots,
+        "model": _model_dims(cfg),
+    }
+
+
 def _run_opt_tune() -> dict:
     """Optimizer-update micro-bench: production optax chain vs a hand-fused
     two-pass AdamW over the bench param tree, donated, vs the HBM floor.
@@ -310,6 +331,7 @@ WORKLOADS = {
     "flash_tune": _run_flash_tune,
     "flash_tune_long": _run_flash_tune_long,
     "opt_tune": _run_opt_tune,
+    "serve": _run_serve,
     "decode": _run_decode,
     "decode_int8w": _run_decode_int8w,
     "roundtrip": _run_roundtrip,
