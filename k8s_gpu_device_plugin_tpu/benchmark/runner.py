@@ -208,7 +208,7 @@ def _run_flash_tune_long() -> dict:
     )
 
 
-def _decode_result(workload: str, int8_weights: bool = False) -> dict:
+def _decode_result(workload: str, weight_quant: str = "none") -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
         decode_bench,
     )
@@ -217,7 +217,7 @@ def _decode_result(workload: str, int8_weights: bool = False) -> dict:
     cfg = _bench_model_cfg()
     r = decode_bench(
         cfg, batch=8, prompt_len=512, new_tokens=64,
-        int8_weights=int8_weights,
+        weight_quant=weight_quant,
     )
     return {
         "workload": workload,
@@ -244,7 +244,16 @@ def _run_decode() -> dict:
 def _run_decode_int8w() -> dict:
     """Decode with weight-only int8 serving quantization: the bandwidth-
     bound regime should approach 2x the bf16 decode tokens/s."""
-    return _decode_result("decode_int8w", int8_weights=True)
+    return _decode_result("decode_int8w", weight_quant="int8")
+
+
+def _run_decode_int4w() -> dict:
+    """Decode with group-wise int4 weight-only quantization (g128): int4
+    is packed 2-per-byte on TPU, so the weight stream halves again vs
+    int8 — also the empirical check that the axon/libtpu backend stores
+    jnp.int4 packed (if tokens/s lands at int8 parity instead of above
+    it, it does not)."""
+    return _decode_result("decode_int4w", weight_quant="int4")
 
 
 def _run_serve() -> dict:
@@ -334,6 +343,7 @@ WORKLOADS = {
     "serve": _run_serve,
     "decode": _run_decode,
     "decode_int8w": _run_decode_int8w,
+    "decode_int4w": _run_decode_int4w,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
 }

@@ -37,17 +37,29 @@ class DecodeBenchResult:
     new_tokens: int
 
 
-def _param_bytes(cfg: LlamaConfig, batch: int, int8_weights: bool) -> int:
+def _param_bytes(cfg: LlamaConfig, batch: int, weight_quant: str) -> int:
     """Bytes actually streamed per decode step: every weight matmul reads
     its full operand, but the embed table is a B-row GATHER (llama.py's
     FLOPs accounting makes the same distinction) — only lm_head reads the
-    full (d, vocab). With int8 weight-only serving, the matmul weights
-    stream 1 byte/element instead of 2 (norms/embed stay float)."""
+    full (d, vocab). Weight-only serving quantization changes the matmul
+    stream to 1 byte/element (int8) or 0.5 (int4, packed 2-per-byte on
+    TPU backends; group scales add f32/group, counted) — norms/embed stay
+    float."""
     d, f, L, hd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.head_dim
     attn = 2 * d * cfg.n_heads * hd + 2 * d * cfg.n_kv_heads * hd
     mlp = 3 * d * f
-    wbytes = 1 if int8_weights else 2
-    matmul = (L * (attn + mlp) + cfg.vocab_size * d) * wbytes
+    n_mat = L * (attn + mlp) + cfg.vocab_size * d
+    if weight_quant == "int8":
+        matmul = n_mat  # 1 byte/elem; (1, out) scales are noise
+    elif weight_quant == "int4":
+        from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+            INT4_GROUP,
+        )
+
+        # packed 2-per-byte + one f32 scale per group
+        matmul = n_mat // 2 + (n_mat // INT4_GROUP) * 4
+    else:
+        matmul = n_mat * 2
     other = (L * 2 * d + d + batch * d) * 2
     return matmul + other
 
@@ -59,16 +71,26 @@ def decode_bench(
     new_tokens: int = 64,
     repeats: int = 3,
     devices: list | None = None,
-    int8_weights: bool = False,
+    weight_quant: str = "none",
 ) -> DecodeBenchResult:
+    if weight_quant not in ("none", "int8", "int4"):
+        # an unrecognized value must not silently benchmark bf16 weights
+        # under a quantized label
+        raise ValueError(f"unknown weight_quant {weight_quant!r}")
     devices = devices or jax.devices()
     params = init_params(jax.random.key(0), cfg)
-    if int8_weights:
+    if weight_quant == "int8":
         from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
             quantize_weights_int8,
         )
 
         params = quantize_weights_int8(params)
+    elif weight_quant == "int4":
+        from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+            quantize_weights_int4,
+        )
+
+        params = quantize_weights_int4(params)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
@@ -111,7 +133,7 @@ def decode_bench(
         * cfg.n_kv_heads * cfg.head_dim * 2
     )
     gbps = (
-        _param_bytes(cfg, batch, int8_weights) + cache_bytes
+        _param_bytes(cfg, batch, weight_quant) + cache_bytes
     ) / step_seconds / 1e9
     gen = GENERATIONS[detect_generation(devices[0])]
     peak_gbps = gen.hbm_bandwidth_gbps

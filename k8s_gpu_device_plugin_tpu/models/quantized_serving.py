@@ -66,10 +66,13 @@ def is_quantized_leaf(w) -> bool:
 
 
 def qmatmul(x: jax.Array, w) -> jax.Array:
-    """``x @ w`` where ``w`` is a float array OR an int8 {"q", "s"} leaf.
+    """``x @ w`` where ``w`` is a float array, an int8 {"q", "s"} leaf, or
+    an int4 {"q4", "s"} group-scaled leaf.
 
-    The int8 array stays the dot operand; the per-output-channel scale
-    multiplies the (much smaller) result."""
+    The quantized array stays the dot operand; scales multiply the (much
+    smaller) result — per output channel for int8, per group for int4."""
+    if is_quantized4_leaf(w):
+        return _q4_matmul(x, w)
     if is_quantized_leaf(w):
         y = jnp.matmul(x, w["q"].astype(x.dtype))
         # scale stays f32 through the multiply (rounding it to bf16 first
@@ -88,7 +91,10 @@ def qexpert_einsum(pattern: str, x: jax.Array, w) -> jax.Array:
 
     The scale commutes through the contraction (it varies only over the
     kept expert/output axes), so it multiplies the result and the int8
-    stack stays the einsum operand."""
+    stack stays the einsum operand. int4 {"q4", "s"} leaves contract per
+    group instead (scales don't commute past a grouped contraction)."""
+    if is_quantized4_leaf(w):
+        return _q4_expert_einsum(pattern, x, w)
     if not is_quantized_leaf(w):
         return jnp.einsum(pattern, x, w)
     y = jnp.einsum(pattern, x, w["q"].astype(x.dtype))
@@ -102,8 +108,98 @@ def qhead_matmul(x: jax.Array, head, dtype) -> jax.Array:
     """lm_head projection with f32 accumulation for float OR int8 heads —
     the one implementation both decode paths (generate._forward_cached,
     rolling._ring_forward) share so the scale layout cannot drift."""
+    if is_quantized4_leaf(head):
+        return _q4_matmul(x, head, out_f32=True)
     if is_quantized_leaf(head):
         return jnp.dot(
             x, head["q"].astype(dtype), preferred_element_type=jnp.float32
         ) * jnp.squeeze(head["s"], axis=-2)
     return jnp.dot(x, head.astype(dtype), preferred_element_type=jnp.float32)
+
+
+# ---------------- int4 (group-wise) serving quantization ----------------
+#
+# Same leaf targeting as int8, half the weight HBM again: {"q4": int4,
+# "s": f32 group scales}. Decode HBM traffic per token drops ~4x vs bf16
+# on the projection/MLP/lm_head weights (int4 is packed 2-per-byte on TPU
+# backends). The group-wise scale (quantize_int4_grouped) means consumers
+# contract per group, scale, then reduce groups — each partial dot still
+# has contraction depth `group` (>= one MXU pass at the default 128).
+
+
+# default group size for int4 serving quantization — decode_bench's HBM
+# accounting reads this, so the two can never drift
+INT4_GROUP = 128
+
+
+def quantize_weights_int4(params: dict, group: int = INT4_GROUP) -> dict:
+    """Float pytree -> serving pytree with int4 projection/MLP weights.
+
+    Layer stacks (L, in, out) become ``{"q4": int4 (L, in, out),
+    "s": f32 (L, in//group, out)}``; MoE stacks (L, E, in, out) get
+    (L, E, in//group, out) scales; the lm_head (d, vocab) gets
+    (d//group, vocab). Embed, norms and the MoE router stay float.
+    """
+    from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int4_grouped
+
+    layers = {}
+    for name, w in params["layers"].items():
+        if name in _QUANT_LEAVES or name in _MOE_QUANT_LEAVES:
+            q, s = quantize_int4_grouped(w, group=group)
+            layers[name] = {"q4": q, "s": s}
+        else:
+            layers[name] = w
+    q, s = quantize_int4_grouped(params["lm_head"], group=group)
+    return {
+        **params,
+        "layers": layers,
+        "lm_head": {"q4": q, "s": s},
+    }
+
+
+def is_quantized4_leaf(w) -> bool:
+    return isinstance(w, dict) and set(w) == {"q4", "s"}
+
+
+def _q4_matmul(x: jax.Array, w: dict, out_f32: bool = False) -> jax.Array:
+    """``x @ W`` against an int4 leaf: per-group partial dots (int4 array
+    is the operand; the convert fuses), f32 group-scale contraction."""
+    k = x.shape[-1]
+    g = w["s"].shape[-2]
+    group = k // g
+    n = w["q4"].shape[-1]
+    xg = x.reshape(*x.shape[:-1], g, group)
+    qg = w["q4"].reshape(g, group, n)
+    # dot in the operand dtype (the int4 convert fuses; the TPU MXU
+    # accumulates f32 internally either way — and the CPU test backend
+    # cannot execute a bf16xbf16=f32 dot), then f32 group contraction
+    part = jnp.einsum("...gk,gkn->...gn", xg, qg.astype(x.dtype))
+    y = jnp.einsum("...gn,gn->...n", part.astype(jnp.float32), w["s"])
+    return y if out_f32 else y.astype(x.dtype)
+
+
+def _q4_expert_einsum(pattern: str, x: jax.Array, w: dict) -> jax.Array:
+    """Grouped-contraction expert einsums for int4 MoE stacks.
+
+    Only the two decode patterns exist (see qexpert_einsum); each reshapes
+    its contraction axis into (groups, group), contracts per group with
+    the int4 operand, then folds the f32 (E, G, N) scales in."""
+    q4, s = w["q4"], w["s"]
+    g = s.shape[-2]
+    if pattern == "btd,edf->btef":
+        e, d, f = q4.shape
+        xg = x.reshape(*x.shape[:-1], g, d // g)
+        qg = q4.reshape(e, g, d // g, f)
+        part = jnp.einsum("btgk,egkf->btegf", xg, qg.astype(x.dtype))
+        return jnp.einsum(
+            "btegf,egf->btef", part.astype(jnp.float32), s
+        ).astype(x.dtype)
+    if pattern == "btef,efd->bted":
+        e, f, d = q4.shape
+        xg = x.reshape(*x.shape[:-1], g, f // g)
+        qg = q4.reshape(e, g, f // g, d)
+        part = jnp.einsum("btegk,egkd->btegd", xg, qg.astype(x.dtype))
+        return jnp.einsum(
+            "btegd,egd->bted", part.astype(jnp.float32), s
+        ).astype(x.dtype)
+    raise NotImplementedError(f"int4 expert pattern {pattern!r}")

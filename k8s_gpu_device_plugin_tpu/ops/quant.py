@@ -127,3 +127,26 @@ def _int8_expert_bwd(res, g):
 
 
 int8_expert_matmul.defvjp(_int8_expert_fwd, _int8_expert_bwd)
+
+
+def quantize_int4_grouped(
+    x: jax.Array, group: int = 128
+) -> tuple[jax.Array, jax.Array]:
+    """Group-wise symmetric int4 along the CONTRACTION axis (-2).
+
+    ``x`` (..., K, N) -> (q int4 (..., K, N), scales f32 (..., K//group, N)).
+    Per-output-channel scales (the int8 recipe) are too coarse at 4 bits;
+    the standard int4 fix is one scale per ``group`` input channels per
+    output channel (RTN-g<group>, the GPTQ/AWQ storage layout). The scale
+    no longer commutes past the whole dot — consumers contract per group,
+    scale, then sum groups (ops stay MXU-shaped: each partial dot has
+    contraction depth ``group``).
+    """
+    *lead, k, n = x.shape
+    if k % group:
+        raise ValueError(f"contraction dim {k} not divisible by group {group}")
+    xg = x.reshape(*lead, k // group, group, n).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xg), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, _EPS) / 7.0
+    q = jnp.clip(jnp.round(xg / scale), -8, 7).astype(jnp.int4)
+    return q.reshape(*lead, k, n), jnp.squeeze(scale, axis=-2)

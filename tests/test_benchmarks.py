@@ -212,3 +212,15 @@ def test_flash_tune_survives_failing_configs():
     for v in list(r.fwd_ms.values()) + list(r.bwd_ms.values()):
         assert isinstance(v, (float, str))
     assert r.best_fwd in ("128x128", "256x128", "none")
+
+
+def test_decode_bench_int4_smoke():
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
+        decode_bench,
+    )
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    r = decode_bench(cfg, batch=2, prompt_len=16, new_tokens=4, repeats=1,
+                     weight_quant="int4")
+    assert r.decode_tokens_per_second > 0
