@@ -162,17 +162,25 @@ def decode_step(
     that were not active this step. EOS tokens ARE emitted (matching
     ``generate``'s keep-the-EOS semantics) and deactivate the slot after.
     """
+    was_active = state.active & allowed
+    # Inactive slots still compute (fixed shapes) but must not WRITE at
+    # their stale lengths: a mid-chunked-prefill neighbor's freshly
+    # prefilled rows live there (reviewed failure: fresh slot at length 0
+    # gets its prompt row 0 clobbered by the garbage K/V write). Redirect
+    # inactive slots' writes to the last cache row — provably harmless:
+    # any sequence only attends that row at q_pos >= max_len-1, and the
+    # decode step that reaches it overwrites it first.
+    cache_len = state.cache.k.shape[2]
+    write_pos = jnp.where(was_active, state.lengths, cache_len - 1)
     logits, cache = _forward_cached(
-        params, state.last_token[:, None], state.cache, state.lengths, cfg
+        params, state.last_token[:, None], state.cache, write_pos, cfg
     )
     key, sub = jax.random.split(state.key)
     tok, presence = sample_and_mark(
         logits[:, -1], sub, sampler, state.presence
     )
-
-    was_active = state.active & allowed
     hit_eos = (tok == eos_id) & (eos_id >= 0)
-    full = state.lengths + 1 >= state.cache.k.shape[2]
+    full = state.lengths + 1 >= cache_len
     emitted = jnp.where(was_active, tok, -1)
     return BatchState(
         cache=cache,
@@ -225,6 +233,7 @@ class ContinuousBatcher:
         sampler: Sampler | None = None,
         eos_id: int | None = None,
         prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+        chunked_prefill: int = 0,
         seed: int = 0,
     ):
         self.params = params
@@ -233,15 +242,27 @@ class ContinuousBatcher:
         self.max_len = max_len
         self.sampler = sampler or Sampler()
         self.eos_id = -1 if eos_id is None else eos_id
+        # chunked_prefill=C > 0: admission runs in C-token chunks
+        # interleaved with decode steps (one chunk per step) instead of
+        # one bucketed prefill dispatch — running slots' per-token latency
+        # is bounded by a chunk, and the bucket ladder disappears (two
+        # compiles total: chunk + finish)
+        self.chunk = int(chunked_prefill)
+        if self.chunk > max_len:
+            raise ValueError(
+                f"chunked_prefill={self.chunk} exceeds max_len={max_len}"
+            )
         self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
-        if not self.buckets:
+        if not self.chunk and not self.buckets:
             raise ValueError(
                 f"no prompt bucket fits max_len={max_len} "
                 f"(buckets={prompt_buckets})"
             )
         self.state = init_batch_state(cfg, n_slots, max_len, seed)
         self.pending: list[_Request] = []
-        self.running: dict[int, _Request] = {}   # slot -> request
+        self.running: dict[int, _Request] = {}    # slot -> decoding request
+        self.prefilling: dict[int, _Request] = {}  # slot -> mid-prefill req
+        self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start
         self.done: dict[int, list[int]] = {}
         self._next_rid = 0
 
@@ -251,9 +272,10 @@ class ContinuousBatcher:
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"slot capacity {self.max_len}"
             )
-        # reject here, not in _admit: a mid-run() bucket failure would
-        # strand every in-flight neighbor
-        _bucket(len(prompt), self.buckets)
+        if not self.chunk:
+            # reject here, not in _admit: a mid-run() bucket failure would
+            # strand every in-flight neighbor
+            _bucket(len(prompt), self.buckets)
         rid = self._next_rid
         self._next_rid += 1
         self.pending.append(_Request(rid, list(prompt), max_new))
@@ -262,10 +284,18 @@ class ContinuousBatcher:
     # --- internals ---
 
     def _admit(self) -> None:
-        free = [s for s in range(self.n_slots) if s not in self.running]
+        free = [
+            s for s in range(self.n_slots)
+            if s not in self.running and s not in self.prefilling
+        ]
         while free and self.pending:
             req = self.pending.pop(0)
             slot = free.pop(0)
+            req.slot = slot
+            if self.chunk:
+                self.prefilling[slot] = req
+                self._prefill_pos[slot] = 0
+                continue
             bucket = _bucket(len(req.prompt), self.buckets)
             padded = jnp.asarray(
                 req.prompt + [0] * (bucket - len(req.prompt)), jnp.int32
@@ -275,10 +305,45 @@ class ContinuousBatcher:
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 self.cfg, self.sampler,
             )
-            req.slot = slot
             req.out.append(int(tok))
             self.running[slot] = req
             self._finish_if_done(req)
+
+    def _prefill_one_chunk(self) -> None:
+        """Advance the oldest mid-prefill request by one chunk; on its
+        final chunk, sample the first token and move it to running."""
+        if not self.prefilling:
+            return
+        slot = next(iter(self.prefilling))
+        req = self.prefilling[slot]
+        start = self._prefill_pos[slot]
+        c = self.chunk
+        plen = len(req.prompt)
+        if start + c < plen:  # intermediate chunk, all real tokens
+            chunk = jnp.asarray(req.prompt[start:start + c], jnp.int32)
+            self.state = prefill_chunk(
+                self.params, self.state, chunk,
+                jnp.int32(start), jnp.int32(slot), self.cfg,
+            )
+            self._prefill_pos[slot] = start + c
+            return
+        # finish chunk: scheduled at plen - C (all real tokens; the
+        # overlap with the last intermediate chunk rewrites identical
+        # K/V) so its write window always fits max_len — forward padding
+        # could straddle it and dynamic_update_slice would silently
+        # clamp-shift the rows. Prompts < C pad at the tail instead.
+        fstart = max(0, plen - c)
+        rest = req.prompt[fstart:]
+        chunk = jnp.asarray(rest + [0] * (c - len(rest)), jnp.int32)
+        self.state, tok = prefill_finish(
+            self.params, self.state, chunk, jnp.int32(fstart),
+            jnp.int32(plen), jnp.int32(slot),
+            self.cfg, self.sampler,
+        )
+        del self.prefilling[slot], self._prefill_pos[slot]
+        req.out.append(int(tok))
+        self.running[slot] = req
+        self._finish_if_done(req)
 
     def _finish_if_done(self, req: _Request) -> None:
         """EOS or budget exhaustion retires the request and frees its slot."""
@@ -289,8 +354,10 @@ class ContinuousBatcher:
                 del self.running[req.slot]
 
     def step(self) -> None:
-        """Admit what fits, then one decode step for the whole batch."""
+        """Admit what fits, advance at most one prefill chunk, then one
+        decode step for the whole batch."""
         self._admit()
+        self._prefill_one_chunk()
         if not self.running:
             return
         # host-built mask: one array transfer, not one scatter per slot
@@ -311,9 +378,116 @@ class ContinuousBatcher:
     def run(self, max_steps: int | None = None) -> dict[int, list[int]]:
         """Drive until every submitted request finished (or max_steps)."""
         steps = 0
-        while self.pending or self.running:
+        while self.pending or self.running or self.prefilling:
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
         return dict(self.done)
+
+
+# ---------------- chunked prefill ----------------
+#
+# A long admission prefill stalls every running slot for its full
+# duration (one big dispatch). Chunked prefill (the Sarathi-style
+# schedule) splits the prompt into fixed C-token chunks and interleaves
+# them with decode steps: per-token decode latency for running requests
+# is bounded by ONE chunk's compute instead of the whole prompt. Fixed C
+# also means exactly two prefill compiles total (chunk, finish) — no
+# bucket ladder.
+
+
+def _slot_cache(cache: KVCache, slot) -> KVCache:
+    f = lambda c: (  # noqa: E731
+        None if c is None else jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
+    )
+    return KVCache(k=f(cache.k), v=f(cache.v),
+                   k_scale=f(cache.k_scale), v_scale=f(cache.v_scale))
+
+
+def _merge_slot(cache: KVCache, part: KVCache, slot) -> KVCache:
+    g = lambda full, p: (  # noqa: E731
+        None if full is None
+        else jax.lax.dynamic_update_slice_in_dim(full, p, slot, axis=1)
+    )
+    return KVCache(k=g(cache.k, part.k), v=g(cache.v, part.v),
+                   k_scale=g(cache.k_scale, part.k_scale),
+                   v_scale=g(cache.v_scale, part.v_scale))
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def prefill_chunk(
+    params,
+    state: BatchState,
+    chunk: jax.Array,        # (C,) int32 — all real tokens
+    chunk_start: jax.Array,  # scalar int32: absolute position of chunk[0]
+    slot: jax.Array,
+    cfg: LlamaConfig,
+) -> BatchState:
+    """One intermediate prefill chunk into ``slot`` (no sampling; the
+    slot stays inactive until the finish chunk). Runs against the slot's
+    OWN cache rows, so the chunk attends everything the slot prefilled
+    so far and nothing of its neighbors."""
+    sl = _slot_cache(state.cache, slot)
+    _, sl = _forward_cached(
+        params, chunk[None, :], sl, chunk_start, cfg,
+        select_pos=jnp.int32(0),  # logits unused; keep the lm_head at 1 row
+    )
+    # chunk_start == 0 is the request's FIRST chunk: start the presence
+    # row from zeros, or a reused slot leaks its previous occupant's
+    # seen-token set into this request's repetition penalty
+    base = jnp.where(chunk_start == 0, False, state.presence[slot])
+    presence = state.presence.at[slot].set(
+        base.at[chunk].set(True)
+    )
+    return BatchState(
+        cache=_merge_slot(state.cache, sl, slot),
+        lengths=state.lengths, last_token=state.last_token,
+        active=state.active, presence=presence, key=state.key,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+def prefill_finish(
+    params,
+    state: BatchState,
+    chunk: jax.Array,        # (C,) int32, padded past the real tail
+    chunk_start: jax.Array,
+    prompt_len: jax.Array,   # absolute total prompt length
+    slot: jax.Array,
+    cfg: LlamaConfig,
+    sampler: Sampler,
+) -> tuple[BatchState, jax.Array]:
+    """Final chunk: run it, sample the first generated token, activate
+    the slot.
+
+    For prompts >= C the host schedules this chunk at ``prompt_len - C``
+    — all real tokens, possibly overlapping rows earlier chunks already
+    wrote (the overlap recomputes IDENTICAL K/V at identical positions,
+    so the rewrite is a no-op; and the window always fits inside max_len,
+    where a forward-padded chunk could straddle it and silently clamp).
+    Only prompts < C pad, and their padded rows land at positions >=
+    prompt_len, never attended (decode masks to ``lengths`` and the first
+    decode token overwrites row ``prompt_len`` before attending it)."""
+    c = chunk.shape[0]
+    sl = _slot_cache(state.cache, slot)
+    logits, sl = _forward_cached(
+        params, chunk[None, :], sl, chunk_start, cfg,
+        select_pos=prompt_len - 1 - chunk_start,
+    )
+    base = jnp.where(chunk_start == 0, False, state.presence[slot])
+    seen = base.at[chunk].max(
+        chunk_start + jnp.arange(c) < prompt_len
+    )
+    key, sub = jax.random.split(state.key)
+    tok, seen = sample_and_mark(logits[:, 0], sub, sampler, seen[None, :])
+    tok = tok[0]
+    write = jnp.int32(slot)
+    return BatchState(
+        cache=_merge_slot(state.cache, sl, slot),
+        lengths=state.lengths.at[write].set(prompt_len),
+        last_token=state.last_token.at[write].set(tok),
+        active=state.active.at[write].set(True),
+        presence=state.presence.at[write].set(seen[0]),
+        key=key,
+    ), tok

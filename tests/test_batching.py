@@ -214,3 +214,105 @@ def test_tp_sharded_batching_matches_unsharded():
         return [res[r] for r in rids]
 
     assert run(sharded) == run(params)
+
+
+def test_chunked_prefill_matches_generate(setup):
+    """chunked_prefill=C must change scheduling only: every request's
+    stream still equals its dedicated-generate tokens (intermediate
+    chunks attend exactly the slot's own earlier rows)."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+    )
+    specs = [(70, 11, 5), (71, 3, 6), (72, 9, 4)]  # (key, plen, new)
+    prompts = {}
+    for key, plen, max_new in specs:
+        p = _prompt(key, plen, cfg)
+        rid = cb.submit(p, max_new=max_new)
+        prompts[rid] = (p, max_new)
+    results = cb.run()
+    for rid, (p, max_new) in prompts.items():
+        assert results[rid] == _oracle(params, p, cfg, max_new), rid
+
+
+def test_chunked_prefill_interleaves_with_decode(setup):
+    """While a long prompt prefills chunk-by-chunk, an already-running
+    request keeps emitting tokens — the whole point of chunking."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+    )
+    pa = _prompt(80, 4, cfg)
+    ra = cb.submit(pa, max_new=12)
+    cb.step()  # admits A; finish-chunk prefill (prompt 4 <= C) -> running
+    assert cb.running and not cb.prefilling
+    a_tokens_before = len(cb.running[0].out)
+    pb = _prompt(81, 16, cfg)  # 16 tokens = 4 chunks of 4
+    rb = cb.submit(pb, max_new=4)
+    cb.step()  # B chunk 1 + A decodes
+    cb.step()  # B chunk 2 + A decodes
+    assert cb.prefilling, "B should still be mid-prefill"
+    a_tokens_during = len(cb.running[0].out)
+    assert a_tokens_during > a_tokens_before, "A stalled behind B's prefill"
+    results = cb.run()
+    assert results[ra] == _oracle(params, pa, cfg, 12)
+    # the stream the interleaving can corrupt is B's: decode steps ran
+    # WHILE B was mid-prefill (regression: inactive-slot decode writes
+    # used to clobber freshly prefilled rows at the stale length)
+    assert results[rb] == _oracle(params, pb, cfg, 4)
+
+
+def test_chunked_prefill_only_state_terminates(setup):
+    """run() must drive requests that are mid-prefill even when nothing
+    is pending or running (regression: the drain condition)."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=4,
+    )
+    p = _prompt(82, 10, cfg)
+    rid = cb.submit(p, max_new=3)
+    cb._admit()  # move to prefilling without stepping
+    assert cb.prefilling and not cb.pending and not cb.running
+    results = cb.run()
+    assert results[rid] == _oracle(params, p, cfg, 3)
+
+
+def test_chunked_prefill_unaligned_near_capacity(setup):
+    """Finish-chunk scheduling: a prompt whose forward-padded final chunk
+    would straddle max_len (61 tokens, C=10, max_len=64) must still
+    decode exactly — the finish chunk runs at plen-C with identical-K/V
+    overlap instead of clamp-shifting rows."""
+    cfg, params = setup
+    p = _prompt(90, 61, cfg)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=10,
+    )
+    rid = cb.submit(p, max_new=3)
+    results = cb.run()
+    assert results[rid] == _oracle(params, p, cfg, 3)
+
+
+def test_chunked_slot_reuse_resets_presence(setup):
+    """Repetition penalty must not leak the previous occupant's seen-token
+    set into a reused slot (chunked path rebuilds presence from zeros on
+    the first chunk). Pin: chunked slot-reuse == dedicated generate with
+    the same penalized sampler, greedy-ized via temperature 0."""
+    cfg, params = setup
+    sampler = Sampler(repetition_penalty=1.5)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=4,
+        sampler=sampler,
+    )
+    p1 = _prompt(91, 9, cfg)
+    p2 = _prompt(92, 6, cfg)
+    r1 = cb.submit(p1, max_new=5)
+    r2 = cb.submit(p2, max_new=5)
+    results = cb.run()
+
+    def oracle(p, n):
+        out = generate(params, jnp.asarray([p], jnp.int32), cfg,
+                       max_new=n, sampler=sampler)
+        return np.asarray(out)[0].tolist()
+
+    assert results[r1] == oracle(p1, 5)
+    assert results[r2] == oracle(p2, 5)  # fails if r1's tokens leak in
