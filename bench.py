@@ -127,9 +127,13 @@ def main() -> int:
             workload, timeout=min(cap, remaining - 20), platforms=tpu_platforms
         )
 
+    # fused single-pass AdamW: numerics-identical to the optax chain, so
+    # if it wins it can honestly carry the primary train metric
+    train_fusedopt = secondary("train_fusedopt", 480, train, 220)
     train_int8 = secondary("train_int8", 480, train, 200)
     decode = secondary("decode", 420, train, 180)
     decode_int8w = secondary("decode_int8w", 420, decode, 180)
+    decode_int4w = secondary("decode_int4w", 420, decode_int8w, 160)
 
     extra: dict = {}
     if matmul:
@@ -140,6 +144,24 @@ def main() -> int:
         extra["train_tokens_per_second"] = train["tokens_per_second"]
         extra["train_step_ms"] = train["step_ms"]
         extra["train_model_dims"] = train.get("model")
+        extra["train_opt_impl"] = "optax"
+    if train_fusedopt:
+        extra["train_fusedopt_mfu_pct"] = train_fusedopt["mfu_pct"]
+        extra["train_fusedopt_step_ms"] = train_fusedopt["step_ms"]
+        # Same model/objective/trajectory (test-pinned), so the fused
+        # implementation may carry the primary — but only past a 2%
+        # relative margin (two single measurements; a bare max() would
+        # ratchet the headline upward on noise alone), and with the
+        # optax run's numbers preserved alongside for the comparison.
+        if train and train_fusedopt["mfu_pct"] > train["mfu_pct"] * 1.02:
+            extra["train_optax_mfu_pct"] = train["mfu_pct"]
+            extra["train_optax_step_ms"] = train["step_ms"]
+            train = {**train, "mfu_pct": train_fusedopt["mfu_pct"],
+                     "tokens_per_second": train_fusedopt["tokens_per_second"],
+                     "step_ms": train_fusedopt["step_ms"]}
+            extra["train_tokens_per_second"] = train["tokens_per_second"]
+            extra["train_step_ms"] = train["step_ms"]
+            extra["train_opt_impl"] = "fused"
     if roundtrip:
         extra["control_plane_allocs_per_second"] = roundtrip["allocs_per_second"]
     if train_int8:
@@ -158,6 +180,11 @@ def main() -> int:
             "decode_tokens_per_second"
         ]
         extra["decode_int8w_hbm_util_pct"] = decode_int8w["hbm_util_pct"]
+    if decode_int4w:
+        extra["decode_int4w_tokens_per_second"] = decode_int4w[
+            "decode_tokens_per_second"
+        ]
+        extra["decode_int4w_hbm_util_pct"] = decode_int4w["hbm_util_pct"]
     if allocated:
         extra["allocated_matmul_mfu_pct"] = allocated["mfu_pct"]
         extra["allocated_matmul_n"] = allocated.get("n")

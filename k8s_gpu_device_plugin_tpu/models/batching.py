@@ -202,10 +202,12 @@ def _bucket(n: int, buckets: tuple[int, ...]) -> int:
 @dataclass
 class _Request:
     rid: int
-    prompt: list[int]
+    prompt: list[int]          # FULL prompt (shared prefix + suffix)
     max_new: int
     out: list[int] = field(default_factory=list)
     slot: int = -1
+    prefix: "PrefixState | None" = None  # rows already prefilled once
+
 
 
 class ContinuousBatcher:
@@ -266,10 +268,24 @@ class ContinuousBatcher:
         self.done: dict[int, list[int]] = {}
         self._next_rid = 0
 
-    def submit(self, prompt: list[int], max_new: int) -> int:
-        if len(prompt) + max_new > self.max_len:
+    def submit(
+        self,
+        prompt: list[int],
+        max_new: int,
+        prefix: "PrefixState | None" = None,
+    ) -> int:
+        """Queue a request. ``prefix`` (precompute_prefix) prepends a
+        SHARED prefilled prefix: its rows are copied into the slot at
+        admission and only ``prompt`` (the suffix) runs through prefill
+        — N requests sharing a P-token system prompt pay one P-token
+        prefill total. Requires chunked_prefill (the chunk scheduler is
+        what continues from an arbitrary offset)."""
+        if prefix is not None and not self.chunk:
+            raise ValueError("prefix sharing requires chunked_prefill=C")
+        total = len(prompt) + (len(prefix.tokens) if prefix else 0)
+        if total + max_new > self.max_len:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"prompt {total} + max_new {max_new} exceeds "
                 f"slot capacity {self.max_len}"
             )
         if not self.chunk:
@@ -278,7 +294,10 @@ class ContinuousBatcher:
             _bucket(len(prompt), self.buckets)
         rid = self._next_rid
         self._next_rid += 1
-        self.pending.append(_Request(rid, list(prompt), max_new))
+        full = (list(prefix.tokens) if prefix else []) + list(prompt)
+        self.pending.append(
+            _Request(rid, full, max_new, prefix=prefix)
+        )
         return rid
 
     # --- internals ---
@@ -293,8 +312,17 @@ class ContinuousBatcher:
             slot = free.pop(0)
             req.slot = slot
             if self.chunk:
+                start = 0
+                if req.prefix is not None:
+                    # copy the shared rows + presence; suffix chunks
+                    # continue from the prefix boundary
+                    self.state = _insert_prefix(
+                        self.state, req.prefix.rows, req.prefix.presence,
+                        jnp.int32(slot),
+                    )
+                    start = len(req.prefix.tokens)
                 self.prefilling[slot] = req
-                self._prefill_pos[slot] = 0
+                self._prefill_pos[slot] = start
                 continue
             bucket = _bucket(len(req.prompt), self.buckets)
             padded = jnp.asarray(
@@ -491,3 +519,71 @@ def prefill_finish(
         presence=state.presence.at[write].set(seen[0]),
         key=key,
     ), tok
+
+
+# ---------------- shared-prefix admission ----------------
+#
+# The serving killer-feature of prefix caching (generate.py's
+# prefill_prompt/generate_from) at request granularity: a system prompt
+# is prefilled ONCE into a PrefixState; every admission that names it
+# starts by copying those rows into its slot and chunk-prefills only its
+# own suffix. N requests sharing a P-token system prompt cost one
+# P-token prefill total instead of N.
+
+
+@dataclass(frozen=True)
+class PrefixState:
+    """Immutable prefilled prefix: cache rows + presence + the tokens
+    (the tokens ride along so finish-chunk overlap can recompute across
+    the prefix boundary). Deliberately NOT a pytree: only its arrays
+    enter jit (as plain args), so the insert compiles per prefix SHAPE —
+    registering the token tuple as treedef metadata would recompile per
+    distinct system prompt."""
+
+    rows: KVCache          # (L, 1, P_pad, Hkv, hd)
+    tokens: tuple          # the real prefix token ids (length P)
+    presence: jax.Array    # (V,) bool over the real tokens
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _precompute_prefix(params, prefix: jax.Array, cfg: LlamaConfig):
+    scratch = KVCache.init(cfg, 1, prefix.shape[0])
+    _, scratch = _forward_cached(
+        params, prefix[None, :], scratch, jnp.int32(0), cfg,
+        select_pos=jnp.int32(0),  # logits unused
+    )
+    seen = jnp.zeros((cfg.vocab_size,), bool).at[prefix].set(True)
+    return scratch, seen
+
+
+def precompute_prefix(params, tokens: list[int], cfg: LlamaConfig) -> PrefixState:
+    """Prefill a shared prefix once (one compile per prefix length)."""
+    arr = jnp.asarray(tokens, jnp.int32)
+    rows, seen = _precompute_prefix(params, arr, cfg)
+    return PrefixState(rows=rows, tokens=tuple(tokens), presence=seen)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_prefix(
+    state: BatchState, rows: KVCache, presence: jax.Array, slot
+) -> BatchState:
+    """Copy prefilled prefix rows + presence into ``slot`` (suffix chunks
+    and activation follow via the normal chunked-prefill path)."""
+    def ins(full, part):
+        if full is None:
+            return None
+        return jax.lax.dynamic_update_slice(
+            full, part, (0, slot, 0, 0, 0)
+        )
+
+    cache = jax.tree.map(
+        ins, state.cache, rows, is_leaf=lambda x: x is None
+    )
+    return BatchState(
+        cache=cache,
+        lengths=state.lengths,
+        last_token=state.last_token,
+        active=state.active,
+        presence=state.presence.at[jnp.int32(slot)].set(presence),
+        key=state.key,
+    )

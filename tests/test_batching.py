@@ -316,3 +316,61 @@ def test_chunked_slot_reuse_resets_presence(setup):
 
     assert results[r1] == oracle(p1, 5)
     assert results[r2] == oracle(p2, 5)  # fails if r1's tokens leak in
+
+
+def test_shared_prefix_matches_generate(setup):
+    """Two requests sharing a precomputed prefix must each match
+    dedicated generate over (prefix + suffix) — one prefix prefill total,
+    slot reuse included (1 slot)."""
+    from k8s_gpu_device_plugin_tpu.models.batching import precompute_prefix
+
+    cfg, params = setup
+    prefix_toks = _prompt(100, 13, cfg)
+    prefix = precompute_prefix(params, prefix_toks, cfg)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=4,
+    )
+    s1 = _prompt(101, 6, cfg)
+    s2 = _prompt(102, 2, cfg)  # suffix < chunk: finish recomputes across
+    r1 = cb.submit(s1, max_new=4, prefix=prefix)
+    r2 = cb.submit(s2, max_new=5, prefix=prefix)
+    results = cb.run()
+    assert results[r1] == _oracle(params, prefix_toks + s1, cfg, 4)
+    assert results[r2] == _oracle(params, prefix_toks + s2, cfg, 5)
+
+
+def test_shared_prefix_presence_feeds_penalty(setup):
+    """The prefix's tokens must count as 'seen' for the repetition
+    penalty in every request that uses it (pin vs dedicated generate
+    with the same sampler over the full prompt)."""
+    from k8s_gpu_device_plugin_tpu.models.batching import precompute_prefix
+
+    cfg, params = setup
+    sampler = Sampler(repetition_penalty=1.5)
+    prefix_toks = _prompt(110, 9, cfg)
+    prefix = precompute_prefix(params, prefix_toks, cfg)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        sampler=sampler,
+    )
+    s = _prompt(111, 5, cfg)
+    rid = cb.submit(s, max_new=5, prefix=prefix)
+    results = cb.run()
+    out = generate(params, jnp.asarray([prefix_toks + s], jnp.int32), cfg,
+                   max_new=5, sampler=sampler)
+    assert results[rid] == np.asarray(out)[0].tolist()
+
+
+def test_prefix_requires_chunked_and_fits(setup):
+    from k8s_gpu_device_plugin_tpu.models.batching import precompute_prefix
+
+    cfg, params = setup
+    prefix = precompute_prefix(params, _prompt(120, 8, cfg), cfg)
+    cb_unchunked = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                                     prompt_buckets=(16,))
+    with pytest.raises(ValueError):
+        cb_unchunked.submit([1, 2], max_new=2, prefix=prefix)
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=16,
+                           chunked_prefill=4)
+    with pytest.raises(ValueError):
+        cb.submit([1] * 6, max_new=4, prefix=prefix)  # 8+6+4 > 16
