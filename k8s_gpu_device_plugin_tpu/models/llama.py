@@ -58,9 +58,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq: int = 8192
     # Mistral-style sliding-window attention (0 = full causal): query i
-    # attends keys in (i - window, i]. Full/flash and decode paths only;
-    # ring/Ulysses sequence parallelism reject it (the ring rotation
-    # assumes full causal structure).
+    # attends keys in (i - window, i]. Supported by the full/flash,
+    # decode, AND ring sequence-parallel paths (windowed ring classifies
+    # kv blocks by position offset; parallel/ring_attention.py). Ulysses
+    # still rejects it.
     sliding_window: int = 0
     dtype: Any = jnp.bfloat16
     # Storage dtype for parameters (None = same as ``dtype``). Set
@@ -356,13 +357,19 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh: Mesh | None) -> jax.Array:
     if impl == "auto":
         impl = "ring" if sp > 1 else "full"
     if impl in ("ring", "ulysses") and sp > 1:
+        if impl == "ring":
+            # windowed ring: per-step window classification (blocks fully
+            # outside the window are skipped, so long-context windowed
+            # work scales with W, not S — parallel/ring_attention.py)
+            return ring_attention(
+                q, k, v, mesh, causal=True, window=cfg.sliding_window
+            )
         if cfg.sliding_window > 0:
             raise NotImplementedError(
-                "sliding_window is not supported with sequence parallelism "
-                "(ring/Ulysses); use sp=1 or full attention"
+                "sliding_window is not supported with Ulysses sequence "
+                "parallelism; use attn_impl='ring'"
             )
-        fn = ring_attention if impl == "ring" else ulysses_attention
-        return fn(q, k, v, mesh, causal=True)
+        return ulysses_attention(q, k, v, mesh, causal=True)
     # single-shard path: full causal attention (f32 softmax)
     from k8s_gpu_device_plugin_tpu.ops.attention import attention
 

@@ -61,19 +61,33 @@ def ring_attention(
     batch_axes: tuple[str, ...] = (AXIS_DP, AXIS_FSDP),
     head_axis: str | None = "tp",
     scale: float | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v of shape (B, S, H, D).
 
     K/V may have fewer (grouped) heads: the flash path keeps them grouped
     end-to-end (smaller ring hops); the einsum fallback expands locally.
     Returns (B, S, Hq, D) in q's dtype, sharded like q.
+
+    ``window > 0`` adds Mistral-style sliding-window masking (query i
+    sees keys in (i - W, i], GLOBAL positions; requires ``causal``). On
+    the flash path each ring step classifies its kv block by position
+    offset: the diagonal runs the windowed flash kernel, blocks fully
+    inside the window run plain flash, blocks fully outside contribute
+    zero (and at W << S, most are — windowed ring work scales with W),
+    and straddling blocks (up to two: the straddle interval for the
+    block offset spans 2*lq - 1 positions) run a masked einsum merged by
+    logsumexp. Each case is exact, so the composition is too.
     """
+    if window > 0 and not causal:
+        raise ValueError("sliding window requires causal attention")
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     spec = P(batch_axes, axis, head_axis, None)
 
     local = functools.partial(
-        _ring_attention_local, sp=sp, causal=causal, axis=axis, scale=scale
+        _ring_attention_local, sp=sp, causal=causal, axis=axis, scale=scale,
+        window=window,
     )
     return shard_map(
         local,
@@ -98,7 +112,34 @@ def _flash_ok(lq, lk, d) -> bool:
     )
 
 
-def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
+def _masked_block_softmax(q, k_blk, v_blk, *, scale, dist, window, hq):
+    """Exact softmax attention of one (q-shard, kv-shard) pair under the
+    window mask, returning (o normalized f32, lse) for logsumexp merging.
+    Used only for ring steps whose block STRADDLES the window boundary
+    (at most two per device); grouped KV expands locally here."""
+    b, lq, _, d = q.shape
+    kb = _expand_kv(k_blk, hq).astype(jnp.float32)
+    vb = _expand_kv(v_blk, hq).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kb) * scale
+    qi = jax.lax.broadcasted_iota(jnp.int32, (lq, k_blk.shape[1]), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (lq, k_blk.shape[1]), 1)
+    offset = dist + qi - ki        # global q_pos - k_pos for this pair
+    mask = (offset >= 1) & (offset < window)
+    scores = jnp.where(mask, scores, _NEG_BIG)
+    # rows with NO in-window key in this block: max would be _NEG_BIG and
+    # exp(scores - max) would be 1 everywhere — derive emptiness from the
+    # mask and pin those rows to (o=0, lse=-inf) so the merge ignores them
+    empty = ~jnp.any(mask, axis=-1)[None, None, :]   # (1, 1, lq)
+    m = jnp.where(empty, 0.0, scores.max(axis=-1))   # (b, h, lq)
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vb)
+    o = o / jnp.where(empty, 1.0, l).transpose(0, 2, 1)[..., None]
+    lse = jnp.where(empty, _NEG_BIG, m + jnp.log(jnp.where(empty, 1.0, l)))
+    return o, lse
+
+
+def _ring_attention_local(q, k, v, *, sp, causal, axis, scale, window=0):
     """Per-device body: rotate K/V blocks around the ring, accumulate.
 
     The hot path computes each (q-shard, kv-shard) pair with the Pallas
@@ -121,12 +162,19 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
 
         interpret = jax.default_backend() != "tpu"
 
-        def fa(causal_step):
+        def fa(causal_step, win=0):
             o_t, lse_t = flash_attention(
                 q, k_blk_ref[0], v_blk_ref[0], causal=causal_step,
                 scale=scale, interpret=interpret, return_lse=True,
+                window=win,
             )
             return o_t.astype(jnp.float32), lse_t
+
+        def zero():
+            return (
+                jnp.zeros((b, lq, h, d), jnp.float32),
+                jnp.full((b, h, lq), _NEG_BIG, jnp.float32),
+            )
 
         # captured via a mutable cell so both cond branches see the carry
         k_blk_ref = [k]
@@ -137,17 +185,39 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
             k_blk_ref[0] = k_blk
             v_blk_ref[0] = v_blk
             kv_idx = (my_idx - t) % sp
-            if causal:
+            if causal and window > 0:
+                # classify the held block by its global position offset
+                # dist = q_block_start - kv_block_start (lq == lk here)
+                dist = (my_idx - kv_idx) * lq
+                o_t, lse_t = jax.lax.cond(
+                    kv_idx == my_idx,
+                    lambda: fa(True, win=window),       # diagonal: windowed
+                    lambda: jax.lax.cond(
+                        kv_idx > my_idx,
+                        zero,                           # future block
+                        lambda: jax.lax.cond(
+                            dist - lq + 1 >= window,
+                            zero,                       # fully OUTSIDE window
+                            lambda: jax.lax.cond(
+                                dist + lq - 1 < window,
+                                lambda: fa(False),      # fully INSIDE window
+                                lambda: _masked_block_softmax(
+                                    q, k_blk_ref[0], v_blk_ref[0],
+                                    scale=scale, dist=dist, window=window,
+                                    hq=h,
+                                ),                      # straddling block
+                            ),
+                        ),
+                    ),
+                )
+            elif causal:
                 o_t, lse_t = jax.lax.cond(
                     kv_idx == my_idx,
                     lambda: fa(True),
                     lambda: jax.lax.cond(
                         kv_idx < my_idx,
                         lambda: fa(False),
-                        lambda: (
-                            jnp.zeros((b, lq, h, d), jnp.float32),
-                            jnp.full((b, h, lq), _NEG_BIG, jnp.float32),
-                        ),
+                        zero,
                     ),
                 )
             else:
@@ -193,7 +263,10 @@ def _ring_attention_local(q, k, v, *, sp, causal, axis, scale):
             * scale
         )
         if causal:
-            mask = q_pos >= (kv_idx * lk + k_local_pos)  # global causal mask
+            k_pos = kv_idx * lk + k_local_pos
+            mask = q_pos >= k_pos                        # global causal mask
+            if window > 0:
+                mask &= q_pos - k_pos < window           # global window
         else:
             mask = jnp.ones((lq, lk), bool)
         m, l, o = _block_attn_update((m, l, o), scores, v_blk, mask)
