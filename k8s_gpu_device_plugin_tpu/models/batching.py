@@ -237,6 +237,7 @@ class ContinuousBatcher:
         prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
         chunked_prefill: int = 0,
         seed: int = 0,
+        metrics=None,
     ):
         self.params = params
         self.cfg = cfg
@@ -267,6 +268,9 @@ class ContinuousBatcher:
         self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start
         self.done: dict[int, list[int]] = {}
         self._next_rid = 0
+        # optional metrics.ServingMetrics (or anything with its hooks);
+        # None = zero overhead, no prometheus dependency on this path
+        self.metrics = metrics
 
     def submit(
         self,
@@ -298,6 +302,8 @@ class ContinuousBatcher:
         self.pending.append(
             _Request(rid, full, max_new, prefix=prefix)
         )
+        if self.metrics:
+            self.metrics.on_submit()
         return rid
 
     # --- internals ---
@@ -334,6 +340,8 @@ class ContinuousBatcher:
                 self.cfg, self.sampler,
             )
             req.out.append(int(tok))
+            if self.metrics:
+                self.metrics.on_first_token()
             self.running[slot] = req
             self._finish_if_done(req)
 
@@ -354,6 +362,8 @@ class ContinuousBatcher:
                 jnp.int32(start), jnp.int32(slot), self.cfg,
             )
             self._prefill_pos[slot] = start + c
+            if self.metrics:
+                self.metrics.on_prefill_chunk()
             return
         # finish chunk: scheduled at plen - C (all real tokens; the
         # overlap with the last intermediate chunk rewrites identical
@@ -370,6 +380,8 @@ class ContinuousBatcher:
         )
         del self.prefilling[slot], self._prefill_pos[slot]
         req.out.append(int(tok))
+        if self.metrics:
+            self.metrics.on_first_token()
         self.running[slot] = req
         self._finish_if_done(req)
 
@@ -380,6 +392,8 @@ class ContinuousBatcher:
             self.done[req.rid] = req.out
             if req.slot in self.running:
                 del self.running[req.slot]
+            if self.metrics:
+                self.metrics.on_finish("eos" if hit_eos else "budget")
 
     def step(self) -> None:
         """Admit what fits, advance at most one prefill chunk, then one
@@ -397,11 +411,18 @@ class ContinuousBatcher:
             self.cfg, self.sampler,
         )
         emitted = jax.device_get(emitted)
+        n_emitted = 0
         for slot, req in list(self.running.items()):
             tok = int(emitted[slot])
             if tok >= 0:
+                n_emitted += 1
                 req.out.append(tok)
                 self._finish_if_done(req)
+        if self.metrics:
+            self.metrics.on_step(
+                n_emitted, len(self.pending), len(self.running),
+                len(self.prefilling),
+            )
 
     def run(self, max_steps: int | None = None) -> dict[int, list[int]]:
         """Drive until every submitted request finished (or max_steps)."""

@@ -374,3 +374,35 @@ def test_prefix_requires_chunked_and_fits(setup):
                            chunked_prefill=4)
     with pytest.raises(ValueError):
         cb.submit([1] * 6, max_new=4, prefix=prefix)  # 8+6+4 > 16
+
+
+def test_serving_metrics_track_lifecycle(setup):
+    """ServingMetrics wired into the batcher: counters/gauges reflect the
+    run (tokens emitted, retirement reasons, chunks, final idle gauges)."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    reg = CollectorRegistry()
+    metrics = ServingMetrics(registry=reg)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        metrics=metrics,
+    )
+    rids = [cb.submit(_prompt(130 + i, 9, cfg), max_new=4) for i in range(3)]
+    cb.run()
+
+    def val(name, **labels):
+        return reg.get_sample_value(name, labels or None)
+
+    assert val("tpu_serving_requests_submitted_total") == 3
+    assert val("tpu_serving_requests_finished_total", reason="budget") == 3
+    # every generated token counts, including each request's first
+    # (sampled at prefill-finish via on_first_token)
+    assert val("tpu_serving_generated_tokens_total") == 3 * 4
+    assert val("tpu_serving_prefill_chunks_total") >= 3  # 9 tokens = 2 chunks
+    assert val("tpu_serving_queue_depth") == 0
+    assert val("tpu_serving_slots_active") == 0
