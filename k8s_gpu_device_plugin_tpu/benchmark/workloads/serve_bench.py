@@ -11,6 +11,9 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
 - ``requests_per_second``: completed requests / wall time
 - ``decode_step_ms``: mean decode-step latency once the pipe is full
 
+Admission runs through chunked prefill by default (the production
+scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
+
 Timing: the batcher's host loop synchronizes every step by design
 (emitted tokens come back to the host), so wall-clock timing is already
 serialization-safe on a relayed chip.
@@ -47,6 +50,7 @@ def serve_bench(
     max_new: int = 64,
     params=None,
     prompt_buckets: tuple[int, ...] = (64, 128, 256, 512),
+    chunked_prefill: int = 256,
 ) -> ServeBenchResult:
     from k8s_gpu_device_plugin_tpu.models.llama import init_params
 
@@ -69,7 +73,7 @@ def serve_bench(
     def run_once() -> tuple[float, float]:
         cb = ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
-            prompt_buckets=prompt_buckets,
+            prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
         )
         for p in prompts:
             cb.submit(p, max_new=max_new)
@@ -81,11 +85,19 @@ def serve_bench(
         # admission prefills don't pollute it
         cb2 = ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
-            prompt_buckets=prompt_buckets,
+            prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
         )
         for p in prompts[:n_slots]:
             cb2.submit(p, max_new=max_new)
-        cb2.step()  # admits everything (prefills), one decode
+        # prime until every slot is DECODING: chunked admission advances
+        # one prefill chunk per step, so a single step would leave most
+        # slots mid-prefill and the "steady-state" figure would include
+        # prefill chunks (the very pollution this split avoids)
+        guard = 0
+        while cb2.pending or cb2.prefilling:
+            cb2.step()
+            guard += 1
+            assert guard < 10_000, "priming never converged"
         t1 = time.perf_counter()
         steps = 16
         for _ in range(steps):

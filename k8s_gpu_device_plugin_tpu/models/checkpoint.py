@@ -129,6 +129,22 @@ class TrainCheckpointer:
         self.log.info("checkpoint restored", extra={"fields": {"step": step}})
         return state
 
+    def restore_unstructured(self, step: int | None = None) -> PyTree:
+        """Restore WITHOUT a target skeleton: arrays come back with their
+        saved shapes/dtypes on default devices. For consumers that only
+        want a sub-tree (e.g. the inference server taking ``params`` out
+        of a train state) and don't know the rest of the structure."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        state = self._mngr.restore(step)
+        self.log.info(
+            "checkpoint restored (unstructured)",
+            extra={"fields": {"step": step}},
+        )
+        return state
+
     def restore_or_pass(self, state: PyTree) -> tuple[PyTree, bool]:
         """Resume from the latest checkpoint if one exists, else keep the
         freshly-initialized ``state``. Returns (state, resumed)."""

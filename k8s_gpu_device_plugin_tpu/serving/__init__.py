@@ -1,0 +1,1 @@
+"""In-pod inference service: the continuous batcher behind an HTTP API."""

@@ -1,0 +1,391 @@
+"""Inference HTTP server: the continuous batcher as a deployable service.
+
+The control-plane daemon (server/server.py) hands pods their chips; this
+is what runs INSIDE such a pod to serve a model — the serving analogue
+of the trainer CLI. One background thread drives the ContinuousBatcher
+step loop (device work never blocks the event loop); asyncio handlers
+submit requests and read per-request token queues bridged with
+``loop.call_soon_threadsafe``.
+
+API (JSON over HTTP, SSE for streaming):
+
+- ``POST /v1/generate``  {"prompt": [ids...], "max_new": N,
+  "stream": false} -> {"id", "tokens"} — or with ``"stream": true``, an
+  ``text/event-stream`` of ``data: {"token": t}`` lines, closing with
+  ``data: {"done": true}``.
+- ``GET /v1/health``     {"slots", "active", "prefilling", "queued"}
+- ``GET /metrics``       Prometheus text (ServingMetrics +
+  whatever else lives on the registry)
+
+Design notes: the batcher is synchronous by construction (a jitted step
+per token); the engine thread is its sole owner, and handlers never wait
+on device work — submissions ride a small locked queue the engine drains
+between steps. Shutdown drains nothing — serving pods are stateless,
+kubelet restarts re-register via the plugin, matching the daemon's
+stateless stance (SURVEY §5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+from aiohttp import web
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    _bucket,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+log = get_logger()
+
+
+class InferenceEngine:
+    """Background thread around a ContinuousBatcher with per-request
+    token streams. Thread-safe submit; asyncio-friendly consumption."""
+
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        n_slots: int = 8,
+        max_len: int = 2048,
+        sampler: Sampler | None = None,
+        eos_id: int | None = None,
+        chunked_prefill: int = 256,
+        metrics=None,
+    ):
+        self.cb = ContinuousBatcher(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            sampler=sampler, eos_id=eos_id,
+            chunked_prefill=min(chunked_prefill, max_len),
+            metrics=metrics,
+        )
+        # The engine thread is the ONLY toucher of self.cb — a device
+        # step can take long, and a shared lock would let a submit
+        # handler block the event loop behind it. Submissions go through
+        # a small locked queue the engine drains between steps; request-
+        # side validation reuses the batcher's own rules pre-admission.
+        self._lock = threading.Lock()       # guards _subq/_streams maps
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._dead = threading.Event()
+        self._subq: list[tuple[int, list[int], int]] = []
+        self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
+        self._published: dict[int, int] = {}   # eid -> tokens already pushed
+        self._rid_to_eid: dict[int, int] = {}
+        self._next_eid = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="inference-engine", daemon=True
+        )
+        self._thread.start()
+
+    # --- request side (event loop thread) ---
+
+    def submit(self, prompt: list[int], max_new: int) -> tuple[int, asyncio.Queue]:
+        """Register a request; returns (eid, queue of tokens then None).
+
+        Validates EVERYTHING the batcher would (capacity and, in
+        bucketed mode, bucket fit) so admission on the engine thread can
+        never raise — an admission error there would otherwise kill the
+        loop and hang every stream."""
+        if self._dead.is_set():
+            raise RuntimeError("inference engine is dead (see logs)")
+        if len(prompt) + max_new > self.cb.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"slot capacity {self.cb.max_len}"
+            )
+        if not self.cb.chunk:
+            _bucket(len(prompt), self.cb.buckets)  # raises on misfit
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        with self._lock:
+            eid = self._next_eid
+            self._next_eid += 1
+            self._subq.append((eid, list(prompt), max_new))
+            self._streams[eid] = (loop, q)
+            self._published[eid] = 0
+        self._work.set()
+        return eid, q
+
+    def stats(self) -> dict:
+        # approximate cross-thread reads (GIL-consistent lengths)
+        with self._lock:
+            queued_local = len(self._subq)
+        return {
+            "slots": self.cb.n_slots,
+            "active": len(self.cb.running),
+            "prefilling": len(self.cb.prefilling),
+            "queued": len(self.cb.pending) + queued_local,
+            "alive": not self._dead.is_set(),
+        }
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._work.set()
+        self._thread.join(timeout)
+
+    # --- engine side (worker thread) ---
+
+    def _admit_submissions(self) -> None:
+        with self._lock:
+            batch, self._subq = self._subq, []
+        for eid, prompt, max_new in batch:
+            rid = self.cb.submit(prompt, max_new=max_new)
+            self._rid_to_eid[rid] = eid
+
+    def _publish(self) -> None:
+        """Push newly generated tokens to their asyncio queues."""
+        live = (
+            list(self.cb.running.values())
+            + list(self.cb.prefilling.values())
+            + list(self.cb.pending)
+        )
+        for req in live:
+            self._push(req.rid, req.out)
+        for rid, eid in list(self._rid_to_eid.items()):
+            if rid in self.cb.done:
+                # pop (not read): a long-running server must not retain
+                # every request's token list forever
+                self._push(rid, self.cb.done.pop(rid))
+                with self._lock:
+                    loop, q = self._streams.pop(eid)
+                    self._published.pop(eid)
+                del self._rid_to_eid[rid]
+                loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
+
+    def _push(self, rid: int, out: list[int]) -> None:
+        eid = self._rid_to_eid.get(rid)
+        if eid is None:
+            return
+        with self._lock:
+            stream = self._streams.get(eid)
+            seen = self._published.get(eid, 0)
+        if stream is None:
+            return
+        loop, q = stream
+        for tok in out[seen:]:
+            loop.call_soon_threadsafe(q.put_nowait, int(tok))
+        with self._lock:
+            self._published[eid] = len(out)
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._admit_submissions()
+                busy = bool(
+                    self.cb.pending or self.cb.running or self.cb.prefilling
+                )
+                if busy:
+                    self.cb.step()
+                    self._publish()
+                else:
+                    self._work.wait(timeout=0.05)
+                    self._work.clear()
+        except Exception:  # noqa: BLE001 - a dead loop must not hang clients
+            log.exception("inference engine loop died")
+            self._dead.set()
+            with self._lock:
+                streams, self._streams = self._streams, {}
+                self._published.clear()
+            for loop, q in streams.values():
+                loop.call_soon_threadsafe(q.put_nowait, None)
+
+
+class InferenceServer:
+    """aiohttp app over an InferenceEngine (port 0 = ephemeral)."""
+
+    def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
+                 port: int = 8000, registry=None):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.bound_port: int | None = None
+        self.registry = registry
+        self.app = web.Application()
+        self.app.router.add_post("/v1/generate", self._generate)
+        self.app.router.add_get("/v1/health", self._health)
+        if registry is not None:
+            self.app.router.add_get("/metrics", self._metrics)
+
+    async def _health(self, request: web.Request) -> web.Response:
+        stats = self.engine.stats()
+        # a dead engine must fail the readiness probe, not smile at it
+        return web.json_response(stats, status=200 if stats["alive"] else 503)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        from prometheus_client import generate_latest
+
+        return web.Response(
+            body=generate_latest(self.registry),
+            content_type="text/plain",
+        )
+
+    async def _generate(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            prompt = body["prompt"]
+            max_new = int(body.get("max_new", 64))
+            stream = bool(body.get("stream", False))
+            if (
+                not isinstance(prompt, list)
+                or not prompt
+                or not all(isinstance(t, int) for t in prompt)
+            ):
+                raise ValueError("prompt must be a non-empty list of ids")
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        try:
+            rid, q = self.engine.submit(prompt, max_new)
+        except ValueError as e:  # capacity/bucket validation
+            return web.json_response({"error": str(e)}, status=422)
+        except RuntimeError as e:  # engine dead
+            return web.json_response({"error": str(e)}, status=503)
+
+        if not stream:
+            tokens: list[int] = []
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                tokens.append(tok)
+            return web.json_response({"id": rid, "tokens": tokens})
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await resp.prepare(request)
+        while True:
+            tok = await q.get()
+            if tok is None:
+                await resp.write(b'data: {"done": true}\n\n')
+                break
+            await resp.write(
+                f'data: {{"token": {tok}}}\n\n'.encode()
+            )
+        await resp.write_eof()
+        return resp
+
+    async def run(self, stop: asyncio.Event) -> None:
+        runner = web.AppRunner(self.app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self.bound_port = runner.addresses[0][1] if runner.addresses else None
+        log.info(
+            "inference server listening",
+            extra={"fields": {"addr": f"{self.host}:{self.bound_port}"}},
+        )
+        try:
+            await stop.wait()
+        finally:
+            await runner.cleanup()
+            self.engine.shutdown()
+
+
+def load_params(cfg: LlamaConfig, checkpoint_dir: str = ""):
+    """Model weights for serving: the latest orbax train checkpoint's
+    ``params`` sub-tree, or (loudly) random init for smoke/load tests."""
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+
+    if not checkpoint_dir:
+        log.warning("serving RANDOM weights (no --checkpointDir): smoke mode")
+        return jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+
+    from k8s_gpu_device_plugin_tpu.models.checkpoint import TrainCheckpointer
+
+    ckpt = TrainCheckpointer(checkpoint_dir, async_save=False)
+    try:
+        state = ckpt.restore_unstructured()
+        params = state["params"]
+    finally:
+        ckpt.close()
+    log.info(
+        "restored params for serving",
+        extra={"fields": {"dir": checkpoint_dir}},
+    )
+    return params
+
+
+def _main(argv: list[str] | None = None) -> int:
+    """CLI: serve a model preset over HTTP.
+
+    ``--checkpointDir`` restores the params from the framework's own
+    orbax train checkpoints (latest step); without it the server runs
+    RANDOM weights — useful only for smoke/load testing, and loudly
+    logged as such.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="tpu-inference-server")
+    parser.add_argument("--preset", default="tiny",
+                        choices=["tiny", "llama3_8b", "llama3_70b",
+                                 "mistral_7b", "mixtral_8x7b"])
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--maxLen", type=int, default=2048)
+    parser.add_argument("--chunkedPrefill", type=int, default=256)
+    parser.add_argument("--eosId", type=int, default=-1)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--topK", type=int, default=0)
+    parser.add_argument("--topP", type=float, default=1.0)
+    parser.add_argument("--weightQuant", default="none",
+                        choices=["none", "int8", "int4"])
+    parser.add_argument("--checkpointDir", default="")
+    args = parser.parse_args(argv)
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
+
+    cfg = getattr(LlamaConfig, args.preset)()
+    params = load_params(cfg, args.checkpointDir)
+
+    sampler = Sampler(temperature=args.temperature, top_k=args.topK,
+                      top_p=args.topP)
+    if args.weightQuant == "int8":
+        from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+            quantize_weights_int8,
+        )
+
+        params = quantize_weights_int8(params)
+    elif args.weightQuant == "int4":
+        from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
+            quantize_weights_int4,
+        )
+
+        params = quantize_weights_int4(params)
+
+    metrics = ServingMetrics()
+    engine = InferenceEngine(
+        params, cfg, n_slots=args.slots, max_len=args.maxLen,
+        sampler=sampler, eos_id=None if args.eosId < 0 else args.eosId,
+        chunked_prefill=args.chunkedPrefill, metrics=metrics,
+    )
+    from prometheus_client import REGISTRY
+
+    server = InferenceServer(engine, host=args.host, port=args.port,
+                             registry=REGISTRY)
+
+    async def serve():
+        stop = asyncio.Event()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await server.run(stop)
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -180,7 +180,7 @@ def test_serve_bench_machinery(setup):
     r = serve_bench(
         cfg, n_slots=2, n_requests=4, max_len=32,
         prompt_lens=(4, 7), max_new=4, params=params,
-        prompt_buckets=(8, 16),
+        prompt_buckets=(8, 16), chunked_prefill=8,
     )
     assert r.tokens_per_second > 0
     assert r.requests_per_second > 0
