@@ -207,6 +207,9 @@ class _Request:
     out: list[int] = field(default_factory=list)
     slot: int = -1
     prefix: "PrefixState | None" = None  # rows already prefilled once
+    # multi-token stop sequences (host-side suffix match; the matched
+    # tokens are KEPT in the output, like the EOS-keep semantics)
+    stop: tuple[tuple[int, ...], ...] = ()
 
 
 
@@ -277,6 +280,7 @@ class ContinuousBatcher:
         prompt: list[int],
         max_new: int,
         prefix: "PrefixState | None" = None,
+        stop: list[list[int]] | None = None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -300,7 +304,10 @@ class ContinuousBatcher:
         self._next_rid += 1
         full = (list(prefix.tokens) if prefix else []) + list(prompt)
         self.pending.append(
-            _Request(rid, full, max_new, prefix=prefix)
+            _Request(
+                rid, full, max_new, prefix=prefix,
+                stop=tuple(tuple(s) for s in (stop or ()) if s),
+            )
         )
         if self.metrics:
             self.metrics.on_submit()
@@ -386,14 +393,22 @@ class ContinuousBatcher:
         self._finish_if_done(req)
 
     def _finish_if_done(self, req: _Request) -> None:
-        """EOS or budget exhaustion retires the request and frees its slot."""
+        """EOS, a stop sequence, or budget exhaustion retires the request
+        and frees its slot. Stop sequences are host-side suffix matches
+        (device shapes unchanged); matched tokens stay in the output."""
         hit_eos = self.eos_id >= 0 and req.out and req.out[-1] == self.eos_id
-        if hit_eos or len(req.out) >= req.max_new:
+        hit_stop = any(
+            len(req.out) >= len(st) and tuple(req.out[-len(st):]) == st
+            for st in req.stop
+        )
+        if hit_eos or hit_stop or len(req.out) >= req.max_new:
             self.done[req.rid] = req.out
             if req.slot in self.running:
                 del self.running[req.slot]
             if self.metrics:
-                self.metrics.on_finish("eos" if hit_eos else "budget")
+                self.metrics.on_finish(
+                    "eos" if hit_eos else ("stop" if hit_stop else "budget")
+                )
 
     def step(self) -> None:
         """Admit what fits, advance at most one prefill chunk, then one

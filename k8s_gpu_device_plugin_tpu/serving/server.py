@@ -10,9 +10,12 @@ submit requests and read per-request token queues bridged with
 API (JSON over HTTP, SSE for streaming):
 
 - ``POST /v1/generate``  {"prompt": [ids...], "max_new": N,
-  "stream": false} -> {"id", "tokens"} — or with ``"stream": true``, an
+  "stream": false, "n": 1, "stop": [[ids...], ...]} -> {"id", "tokens"}
+  (plus "completions" when n > 1: independent samples decoded in
+  parallel slots) — or with ``"stream": true`` (n=1 only), a
   ``text/event-stream`` of ``data: {"token": t}`` lines, closing with
-  ``data: {"done": true}``.
+  ``data: {"done": true}``. Stop sequences retire a request when its
+  output ends with any of them (tokens kept, like EOS).
 - ``GET /v1/health``     {"slots", "active", "prefilling", "queued"}
 - ``GET /metrics``       Prometheus text (ServingMetrics +
   whatever else lives on the registry)
@@ -74,7 +77,7 @@ class InferenceEngine:
         self._work = threading.Event()
         self._stop = threading.Event()
         self._dead = threading.Event()
-        self._subq: list[tuple[int, list[int], int]] = []
+        self._subq: list[tuple[int, list[int], int, tuple]] = []
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
         self._rid_to_eid: dict[int, int] = {}
@@ -86,7 +89,10 @@ class InferenceEngine:
 
     # --- request side (event loop thread) ---
 
-    def submit(self, prompt: list[int], max_new: int) -> tuple[int, asyncio.Queue]:
+    def submit(
+        self, prompt: list[int], max_new: int,
+        stop: list[list[int]] | None = None,
+    ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
         Validates EVERYTHING the batcher would (capacity and, in
@@ -107,7 +113,9 @@ class InferenceEngine:
         with self._lock:
             eid = self._next_eid
             self._next_eid += 1
-            self._subq.append((eid, list(prompt), max_new))
+            self._subq.append(
+                (eid, list(prompt), max_new, tuple(stop or ()))
+            )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
         self._work.set()
@@ -135,8 +143,10 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new in batch:
-            rid = self.cb.submit(prompt, max_new=max_new)
+        for eid, prompt, max_new, stop in batch:
+            rid = self.cb.submit(
+                prompt, max_new=max_new, stop=[list(st) for st in stop]
+            )
             self._rid_to_eid[rid] = eid
 
     def _publish(self) -> None:
@@ -232,29 +242,51 @@ class InferenceServer:
             prompt = body["prompt"]
             max_new = int(body.get("max_new", 64))
             stream = bool(body.get("stream", False))
+            n = int(body.get("n", 1))
+            stop = body.get("stop", [])
             if (
                 not isinstance(prompt, list)
                 or not prompt
                 or not all(isinstance(t, int) for t in prompt)
             ):
                 raise ValueError("prompt must be a non-empty list of ids")
+            if not (1 <= n <= 8):
+                raise ValueError("n must be in [1, 8]")
+            if n > 1 and stream:
+                raise ValueError("streaming supports n=1 only")
+            if not isinstance(stop, list) or not all(
+                isinstance(st, list) and st
+                and all(isinstance(t, int) for t in st)
+                for st in stop
+            ):
+                raise ValueError("stop must be a list of token-id lists")
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
-            rid, q = self.engine.submit(prompt, max_new)
+            subs = [
+                self.engine.submit(prompt, max_new, stop=stop)
+                for _ in range(n)
+            ]
         except ValueError as e:  # capacity/bucket validation
             return web.json_response({"error": str(e)}, status=422)
         except RuntimeError as e:  # engine dead
             return web.json_response({"error": str(e)}, status=503)
+        rid, q = subs[0]
 
         if not stream:
-            tokens: list[int] = []
-            while True:
-                tok = await q.get()
-                if tok is None:
-                    break
-                tokens.append(tok)
-            return web.json_response({"id": rid, "tokens": tokens})
+            async def drain(queue):
+                toks: list[int] = []
+                while True:
+                    tok = await queue.get()
+                    if tok is None:
+                        return toks
+                    toks.append(tok)
+
+            completions = await asyncio.gather(*(drain(q_) for _, q_ in subs))
+            payload = {"id": rid, "tokens": completions[0]}
+            if n > 1:
+                payload["completions"] = completions
+            return web.json_response(payload)
 
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",

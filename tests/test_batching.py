@@ -406,3 +406,33 @@ def test_serving_metrics_track_lifecycle(setup):
     assert val("tpu_serving_prefill_chunks_total") >= 3  # 9 tokens = 2 chunks
     assert val("tpu_serving_queue_depth") == 0
     assert val("tpu_serving_slots_active") == 0
+
+
+def test_stop_sequences_retire_requests(setup):
+    """A request stops when its output ends with a stop sequence (tokens
+    kept); unrelated requests run to budget. Metrics record the reason."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    p = _prompt(300, 5, cfg)
+    oracle = _oracle(params, p, cfg, 6)
+    stop = [oracle[1], oracle[2]]  # the model WILL emit this bigram
+
+    reg = CollectorRegistry()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        metrics=ServingMetrics(registry=reg),
+    )
+    r1 = cb.submit(p, max_new=6, stop=[stop])
+    p2 = _prompt(301, 4, cfg)
+    r2 = cb.submit(p2, max_new=5)
+    results = cb.run()
+    assert results[r1] == oracle[:3]  # stopped right after the bigram
+    assert results[r2] == _oracle(params, p2, cfg, 5)
+    assert reg.get_sample_value(
+        "tpu_serving_requests_finished_total", {"reason": "stop"}
+    ) == 1

@@ -278,3 +278,36 @@ def test_done_map_does_not_leak(setup):
             engine.shutdown()
 
     run(body())
+
+
+def test_n_completions_and_stop_api(setup):
+    """n>1 returns that many independently decoded completions (greedy =>
+    identical; the API contract is shape + parity), and a stop list is
+    honored; n>1 with stream is rejected."""
+    cfg, params = setup
+    p = _prompt(260, 5, cfg)
+    oracle = _oracle(params, p, cfg, 4)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "n": 2,
+        }) as r:
+            assert r.status == 200
+            d = await r.json()
+            assert d["completions"] == [oracle, oracle]  # greedy
+            assert d["tokens"] == oracle
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "stop": [oracle[:2]],
+        }) as r:
+            d = await r.json()
+            assert d["tokens"] == oracle[:2]
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "n": 2, "stream": True,
+        }) as r:
+            assert r.status == 400
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "stop": [["x"]],
+        }) as r:
+            assert r.status == 400
+
+    run(_with_server(setup, body))
