@@ -46,7 +46,11 @@ from k8s_gpu_device_plugin_tpu.models.generate import (
     _forward_cached,
 )
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
-from k8s_gpu_device_plugin_tpu.models.sampling import Sampler, sample_and_mark
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    sample_and_mark,
+    token_logprob,
+)
 
 
 @dataclass(frozen=True)
@@ -121,6 +125,7 @@ def prefill_insert(
     tok, seen = sample_and_mark(
         first_logits[None, :], sub, sampler, seen[None, :]
     )
+    logp = token_logprob(first_logits[None, :], tok)[0]
     tok = tok[0]
 
     def insert_rows(full, rows):
@@ -144,7 +149,7 @@ def prefill_insert(
         active=state.active.at[write].set(True),
         presence=state.presence.at[write].set(seen[0]),
         key=key,
-    ), tok
+    ), tok, logp
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
@@ -179,6 +184,7 @@ def decode_step(
     tok, presence = sample_and_mark(
         logits[:, -1], sub, sampler, state.presence
     )
+    logps = token_logprob(logits[:, -1], tok)
     hit_eos = (tok == eos_id) & (eos_id >= 0)
     full = state.lengths + 1 >= cache_len
     emitted = jnp.where(was_active, tok, -1)
@@ -189,7 +195,7 @@ def decode_step(
         active=was_active & ~hit_eos & ~full,
         presence=jnp.where(was_active[:, None], presence, state.presence),
         key=key,
-    ), emitted
+    ), emitted, logps
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -205,6 +211,8 @@ class _Request:
     prompt: list[int]          # FULL prompt (shared prefix + suffix)
     max_new: int
     out: list[int] = field(default_factory=list)
+    # log P(out[i]) under the raw model distribution, parallel to out
+    out_logp: list[float] = field(default_factory=list)
     slot: int = -1
     prefix: "PrefixState | None" = None  # rows already prefilled once
     # multi-token stop sequences (host-side suffix match; the matched
@@ -270,6 +278,9 @@ class ContinuousBatcher:
         self.prefilling: dict[int, _Request] = {}  # slot -> mid-prefill req
         self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start
         self.done: dict[int, list[int]] = {}
+        # full retired _Request objects (tokens + logprobs); the serving
+        # engine pops from BOTH maps per request to keep memory bounded
+        self.done_requests: dict[int, "_Request"] = {}
         self._next_rid = 0
         # optional metrics.ServingMetrics (or anything with its hooks);
         # None = zero overhead, no prometheus dependency on this path
@@ -341,12 +352,13 @@ class ContinuousBatcher:
             padded = jnp.asarray(
                 req.prompt + [0] * (bucket - len(req.prompt)), jnp.int32
             )
-            self.state, tok = prefill_insert(
+            self.state, tok, logp = prefill_insert(
                 self.params, self.state, padded,
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 self.cfg, self.sampler,
             )
             req.out.append(int(tok))
+            req.out_logp.append(float(logp))
             if self.metrics:
                 self.metrics.on_first_token()
             self.running[slot] = req
@@ -380,13 +392,14 @@ class ContinuousBatcher:
         fstart = max(0, plen - c)
         rest = req.prompt[fstart:]
         chunk = jnp.asarray(rest + [0] * (c - len(rest)), jnp.int32)
-        self.state, tok = prefill_finish(
+        self.state, tok, logp = prefill_finish(
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
             self.cfg, self.sampler,
         )
         del self.prefilling[slot], self._prefill_pos[slot]
         req.out.append(int(tok))
+        req.out_logp.append(float(logp))
         if self.metrics:
             self.metrics.on_first_token()
         self.running[slot] = req
@@ -403,6 +416,7 @@ class ContinuousBatcher:
         )
         if hit_eos or hit_stop or len(req.out) >= req.max_new:
             self.done[req.rid] = req.out
+            self.done_requests[req.rid] = req
             if req.slot in self.running:
                 del self.running[req.slot]
             if self.metrics:
@@ -421,17 +435,19 @@ class ContinuousBatcher:
         allowed_np = np.zeros((self.n_slots,), bool)
         allowed_np[list(self.running)] = True
         allowed = jnp.asarray(allowed_np)
-        self.state, emitted = decode_step(
+        self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
             self.cfg, self.sampler,
         )
         emitted = jax.device_get(emitted)
+        logps = jax.device_get(logps)
         n_emitted = 0
         for slot, req in list(self.running.items()):
             tok = int(emitted[slot])
             if tok >= 0:
                 n_emitted += 1
                 req.out.append(tok)
+                req.out_logp.append(float(logps[slot]))
                 self._finish_if_done(req)
         if self.metrics:
             self.metrics.on_step(
@@ -545,6 +561,7 @@ def prefill_finish(
     )
     key, sub = jax.random.split(state.key)
     tok, seen = sample_and_mark(logits[:, 0], sub, sampler, seen[None, :])
+    logp = token_logprob(logits[:, 0], tok)[0]
     tok = tok[0]
     write = jnp.int32(slot)
     return BatchState(
@@ -554,7 +571,7 @@ def prefill_finish(
         active=state.active.at[write].set(True),
         presence=state.presence.at[write].set(seen[0]),
         key=key,
-    ), tok
+    ), tok, logp
 
 
 # ---------------- shared-prefix admission ----------------

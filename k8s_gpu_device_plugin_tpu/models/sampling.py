@@ -153,3 +153,12 @@ def sample_logits(
     return jax.random.categorical(
         key, filtered_logits(logits, sampler), axis=-1
     ).astype(jnp.int32)
+
+
+def token_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
+    """log P(tok) under the RAW model distribution (f32 log-softmax of the
+    unfiltered logits) — the "model confidence" number serving APIs
+    report, deliberately independent of temperature/top-k/top-p/penalty
+    so it stays comparable across sampler settings."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tok[..., None], axis=-1)[..., 0]

@@ -150,26 +150,28 @@ class InferenceEngine:
             self._rid_to_eid[rid] = eid
 
     def _publish(self) -> None:
-        """Push newly generated tokens to their asyncio queues."""
+        """Push newly generated (token, logprob) pairs to their queues."""
         live = (
             list(self.cb.running.values())
             + list(self.cb.prefilling.values())
             + list(self.cb.pending)
         )
         for req in live:
-            self._push(req.rid, req.out)
+            self._push(req.rid, req.out, req.out_logp)
         for rid, eid in list(self._rid_to_eid.items()):
-            if rid in self.cb.done:
-                # pop (not read): a long-running server must not retain
+            req = self.cb.done_requests.pop(rid, None)
+            if req is not None:
+                self._push(rid, req.out, req.out_logp)
+                # pop done too: a long-running server must not retain
                 # every request's token list forever
-                self._push(rid, self.cb.done.pop(rid))
+                self.cb.done.pop(rid, None)
                 with self._lock:
                     loop, q = self._streams.pop(eid)
                     self._published.pop(eid)
                 del self._rid_to_eid[rid]
                 loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
 
-    def _push(self, rid: int, out: list[int]) -> None:
+    def _push(self, rid: int, out: list[int], logp: list[float]) -> None:
         eid = self._rid_to_eid.get(rid)
         if eid is None:
             return
@@ -179,8 +181,8 @@ class InferenceEngine:
         if stream is None:
             return
         loop, q = stream
-        for tok in out[seen:]:
-            loop.call_soon_threadsafe(q.put_nowait, int(tok))
+        for tok, lp in zip(out[seen:], logp[seen:]):
+            loop.call_soon_threadsafe(q.put_nowait, (int(tok), float(lp)))
         with self._lock:
             self._published[eid] = len(out)
 
@@ -244,6 +246,7 @@ class InferenceServer:
             stream = bool(body.get("stream", False))
             n = int(body.get("n", 1))
             stop = body.get("stop", [])
+            want_logprobs = bool(body.get("logprobs", False))
             if (
                 not isinstance(prompt, list)
                 or not prompt
@@ -276,16 +279,22 @@ class InferenceServer:
         if not stream:
             async def drain(queue):
                 toks: list[int] = []
+                lps: list[float] = []
                 while True:
-                    tok = await queue.get()
-                    if tok is None:
-                        return toks
-                    toks.append(tok)
+                    item = await queue.get()
+                    if item is None:
+                        return toks, lps
+                    toks.append(item[0])
+                    lps.append(item[1])
 
-            completions = await asyncio.gather(*(drain(q_) for _, q_ in subs))
-            payload = {"id": rid, "tokens": completions[0]}
+            drained = await asyncio.gather(*(drain(q_) for _, q_ in subs))
+            payload = {"id": rid, "tokens": drained[0][0]}
+            if want_logprobs:
+                payload["logprobs"] = drained[0][1]
             if n > 1:
-                payload["completions"] = completions
+                payload["completions"] = [d[0] for d in drained]
+                if want_logprobs:
+                    payload["completions_logprobs"] = [d[1] for d in drained]
             return web.json_response(payload)
 
         resp = web.StreamResponse(
@@ -294,13 +303,15 @@ class InferenceServer:
         )
         await resp.prepare(request)
         while True:
-            tok = await q.get()
-            if tok is None:
+            item = await q.get()
+            if item is None:
                 await resp.write(b'data: {"done": true}\n\n')
                 break
-            await resp.write(
-                f'data: {{"token": {tok}}}\n\n'.encode()
-            )
+            tok, lp = item
+            evt = {"token": tok}
+            if want_logprobs:
+                evt["logprob"] = lp
+            await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
         await resp.write_eof()
         return resp
 

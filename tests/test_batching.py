@@ -436,3 +436,30 @@ def test_stop_sequences_retire_requests(setup):
     assert reg.get_sample_value(
         "tpu_serving_requests_finished_total", {"reason": "stop"}
     ) == 1
+
+
+def test_logprobs_match_full_context_forward(setup):
+    """Per-token logprobs from the batcher equal log-softmax of the
+    full-context forward at each emitted position (raw model
+    distribution, independent of sampler settings)."""
+    from k8s_gpu_device_plugin_tpu.models.llama import forward
+
+    cfg, params = setup
+    p = _prompt(310, 6, cfg)
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           chunked_prefill=4)
+    rid = cb.submit(p, max_new=4)
+    cb.run()
+    req = cb.done_requests[rid]
+    assert len(req.out_logp) == len(req.out) == 4
+
+    tokens = jnp.asarray([p], jnp.int32)
+    for i, (tok, lp) in enumerate(zip(req.out, req.out_logp)):
+        logits = forward(params, tokens, cfg)[:, -1]
+        expected = float(
+            jax.nn.log_softmax(logits.astype(jnp.float32))[0, tok]
+        )
+        assert abs(lp - expected) < 5e-2, (i, lp, expected)
+        tokens = jnp.concatenate(
+            [tokens, jnp.asarray([[tok]], jnp.int32)], axis=1
+        )

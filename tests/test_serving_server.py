@@ -228,7 +228,7 @@ def test_load_params_from_train_checkpoint(tmp_path, setup):
                 t = await asyncio.wait_for(q.get(), 120)
                 if t is None:
                     break
-                toks.append(t)
+                toks.append(t[0])
             assert toks == oracle
         finally:
             engine.shutdown()
@@ -309,5 +309,32 @@ def test_n_completions_and_stop_api(setup):
             "prompt": p, "max_new": 4, "stop": [["x"]],
         }) as r:
             assert r.status == 400
+
+    run(_with_server(setup, body))
+
+
+def test_logprobs_in_api_responses(setup):
+    """'logprobs': true returns finite per-token logprobs aligned with
+    tokens, in both JSON and SSE modes."""
+    cfg, params = setup
+    p = _prompt(270, 5, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "logprobs": True,
+        }) as r:
+            d = await r.json()
+            assert len(d["logprobs"]) == len(d["tokens"]) == 4
+            assert all(isinstance(x, float) and x <= 0.0 for x in d["logprobs"])
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 3, "stream": True, "logprobs": True,
+        }) as r:
+            events = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+            assert events[-1] == {"done": True}
+            assert all("logprob" in e for e in events[:-1])
 
     run(_with_server(setup, body))
