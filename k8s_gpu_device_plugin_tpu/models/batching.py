@@ -94,7 +94,7 @@ def prefill_insert(
     slot: jax.Array,         # scalar int32
     cfg: LlamaConfig,
     sampler: Sampler,
-) -> tuple[BatchState, jax.Array]:
+) -> tuple[BatchState, jax.Array, jax.Array]:
     """Prefill one request and insert it into ``slot``.
 
     Runs the prompt through a fresh single-row cache of capacity P (the
@@ -102,7 +102,7 @@ def prefill_insert(
     once), writes rows [0, P) into the slot's cache (rows past
     ``prompt_len`` are garbage but provably never attended: every later
     read masks to ``lengths[slot]``), seeds the slot's sampling state,
-    and returns (state, first generated token).
+    and returns (state, first generated token, its logprob).
     """
     p = prompt.shape[0]
     scratch = KVCache.init(cfg, 1, p)
@@ -160,12 +160,13 @@ def decode_step(
     eos_id: jax.Array,   # scalar int32 (-1 disables EOS stopping)
     cfg: LlamaConfig,
     sampler: Sampler,
-) -> tuple[BatchState, jax.Array]:
+) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
-    Returns (state, emitted (B,) int32) where emitted[i] is -1 for slots
-    that were not active this step. EOS tokens ARE emitted (matching
-    ``generate``'s keep-the-EOS semantics) and deactivate the slot after.
+    Returns (state, emitted (B,) int32, logps (B,) f32) where emitted[i]
+    is -1 for slots that were not active this step. EOS tokens ARE
+    emitted (matching ``generate``'s keep-the-EOS semantics) and
+    deactivate the slot after.
     """
     was_active = state.active & allowed
     # Inactive slots still compute (fixed shapes) but must not WRITE at
@@ -376,10 +377,7 @@ class ContinuousBatcher:
         plen = len(req.prompt)
         if start + c < plen:  # intermediate chunk, all real tokens
             chunk = jnp.asarray(req.prompt[start:start + c], jnp.int32)
-            self.state = prefill_chunk(
-                self.params, self.state, chunk,
-                jnp.int32(start), jnp.int32(slot), self.cfg,
-            )
+            self._apply_prefill_chunk(chunk, start, slot)
             self._prefill_pos[slot] = start + c
             if self.metrics:
                 self.metrics.on_prefill_chunk()
@@ -392,11 +390,7 @@ class ContinuousBatcher:
         fstart = max(0, plen - c)
         rest = req.prompt[fstart:]
         chunk = jnp.asarray(rest + [0] * (c - len(rest)), jnp.int32)
-        self.state, tok, logp = prefill_finish(
-            self.params, self.state, chunk, jnp.int32(fstart),
-            jnp.int32(plen), jnp.int32(slot),
-            self.cfg, self.sampler,
-        )
+        tok, logp = self._apply_prefill_finish(chunk, fstart, plen, slot)
         del self.prefilling[slot], self._prefill_pos[slot]
         req.out.append(int(tok))
         req.out_logp.append(float(logp))
@@ -404,6 +398,24 @@ class ContinuousBatcher:
             self.metrics.on_first_token()
         self.running[slot] = req
         self._finish_if_done(req)
+
+    # overridable seams (the speculative batcher mirrors these onto a
+    # second, draft-model state)
+
+    def _apply_prefill_chunk(self, chunk, start: int, slot: int) -> None:
+        self.state = prefill_chunk(
+            self.params, self.state, chunk,
+            jnp.int32(start), jnp.int32(slot), self.cfg,
+        )
+
+    def _apply_prefill_finish(self, chunk, fstart: int, plen: int,
+                              slot: int) -> tuple[int, float]:
+        self.state, tok, logp = prefill_finish(
+            self.params, self.state, chunk, jnp.int32(fstart),
+            jnp.int32(plen), jnp.int32(slot),
+            self.cfg, self.sampler,
+        )
+        return int(tok), float(logp)
 
     def _finish_if_done(self, req: _Request) -> None:
         """EOS, a stop sequence, or budget exhaustion retires the request
@@ -434,13 +446,22 @@ class ContinuousBatcher:
         # host-built mask: one array transfer, not one scatter per slot
         allowed_np = np.zeros((self.n_slots,), bool)
         allowed_np[list(self.running)] = True
-        allowed = jnp.asarray(allowed_np)
+        n_emitted = self._decode_once(jnp.asarray(allowed_np))
+        if self.metrics:
+            self.metrics.on_step(
+                n_emitted, len(self.pending), len(self.running),
+                len(self.prefilling),
+            )
+
+    def _decode_once(self, allowed) -> int:
+        """One decode dispatch for the whole batch; returns tokens emitted
+        (the speculative batcher overrides this with a draft+verify round
+        that can emit up to gamma tokens per slot)."""
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
             self.cfg, self.sampler,
         )
-        emitted = jax.device_get(emitted)
-        logps = jax.device_get(logps)
+        emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
         for slot, req in list(self.running.items()):
             tok = int(emitted[slot])
@@ -449,11 +470,7 @@ class ContinuousBatcher:
                 req.out.append(tok)
                 req.out_logp.append(float(logps[slot]))
                 self._finish_if_done(req)
-        if self.metrics:
-            self.metrics.on_step(
-                n_emitted, len(self.pending), len(self.running),
-                len(self.prefilling),
-            )
+        return n_emitted
 
     def run(self, max_steps: int | None = None) -> dict[int, list[int]]:
         """Drive until every submitted request finished (or max_steps)."""
@@ -537,9 +554,9 @@ def prefill_finish(
     slot: jax.Array,
     cfg: LlamaConfig,
     sampler: Sampler,
-) -> tuple[BatchState, jax.Array]:
-    """Final chunk: run it, sample the first generated token, activate
-    the slot.
+) -> tuple[BatchState, jax.Array, jax.Array]:
+    """Final chunk: run it, sample the first generated token (returned
+    with its logprob), activate the slot.
 
     For prompts >= C the host schedules this chunk at ``prompt_len - C``
     — all real tokens, possibly overlapping rows earlier chunks already

@@ -1,0 +1,238 @@
+"""Speculative decoding inside continuous batching (greedy mode).
+
+The two serving levers compose: the slot engine keeps the chip busy
+across requests (models/batching.py); speculative decoding cuts each
+request's latency by verifying ``gamma`` cheap draft proposals in ONE
+target forward (models/speculative.py). The vector-length slot design is
+what makes the combination natural — per-slot variable acceptance is
+just ``lengths += count`` per row, and rejected rows become
+garbage-beyond-length, which the engine already proves safe everywhere
+(prefill padding, stale-slot writes).
+
+Per round, for every decoding slot simultaneously:
+
+1. gamma draft steps (B,1) against the draft cache at this slot's own
+   positions -> proposals (B, gamma);
+2. ONE target forward over [last, d_1..d_{gamma-1}] (B, gamma) — the
+   speculative payoff: gamma tokens' K/V written and scored in a single
+   HBM pass over the target weights;
+3. greedy acceptance: longest proposal prefix matching the target's own
+   argmax, plus the target's bonus token at the cut — per slot;
+4. ``lengths += count`` per slot; both caches' rejected rows are hidden
+   by the position mask and overwritten by later writes.
+
+Greedy only: emitted tokens are IDENTICAL to the plain batcher's (and
+therefore to dedicated ``generate``) up to float determinism — the
+T=gamma verify and T=1 decode are different XLA programs, so bf16
+near-tie argmaxes can flip; at f32 parity is token-exact (the same
+caveat models/speculative.py documents, test-pinned here too).
+
+Capacity: each round may write gamma rows beyond the accepted length, so
+``submit`` reserves ``gamma`` extra rows (prompt + max_new + gamma <=
+max_len) and the inactive-slot write redirect targets the top gamma rows
+(provably outside every live prompt window under that reservation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    BatchState,
+    ContinuousBatcher,
+    init_batch_state,
+    prefill_chunk,
+    prefill_finish,
+)
+from k8s_gpu_device_plugin_tpu.models.generate import _forward_cached
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.sampling import token_logprob
+
+
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma"),
+         donate_argnums=(2, 3))
+def spec_decode_step(
+    params_t,
+    params_d,
+    state: BatchState,        # target-side state (lengths are THE truth)
+    draft_state: BatchState,  # only its cache participates
+    allowed: jax.Array,       # (B,) bool host gate (room + budget)
+    cfg_t: LlamaConfig,
+    cfg_d: LlamaConfig,
+    gamma: int,
+) -> tuple[BatchState, BatchState, jax.Array, jax.Array, jax.Array]:
+    """One speculative round for every slot.
+
+    Returns (state, draft_state, emitted (B, gamma) int32 with -1 beyond
+    each row's count, counts (B,) int32, logps (B, gamma) f32).
+    """
+    was_active = state.active & allowed
+    cache_len = state.cache.k.shape[2]
+    # inactive slots write into the top gamma rows — outside every live
+    # prompt/generation window thanks to the submit-side gamma reservation
+    base = jnp.where(was_active, state.lengths, cache_len - gamma)
+
+    # --- 1. gamma draft proposals, each a T=1 cached forward ---
+    def draft_body(carry, j):
+        tok, d_cache = carry
+        logits, d_cache = _forward_cached(
+            params_d, tok[:, None], d_cache, base + j, cfg_d
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (nxt, d_cache), nxt
+
+    (_, d_cache), d_toks = jax.lax.scan(
+        draft_body, (state.last_token, draft_state.cache),
+        jnp.arange(gamma, dtype=jnp.int32),
+    )
+    d_toks = d_toks.T  # (B, gamma)
+
+    # --- 2. one target verify forward over [last, d_1..d_{g-1}] ---
+    verify_in = jnp.concatenate(
+        [state.last_token[:, None], d_toks[:, :-1]], axis=1
+    )
+    v_logits, t_cache = _forward_cached(
+        params_t, verify_in, state.cache, base, cfg_t
+    )
+
+    # --- 3. greedy acceptance per slot ---
+    pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)   # (B, gamma)
+    eq = (d_toks == pred).astype(jnp.int32)
+    n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)             # (B,)
+    counts = jnp.minimum(n + 1, gamma)
+    idx = jnp.arange(gamma, dtype=jnp.int32)[None, :]
+    emit = jnp.where(idx < n[:, None], d_toks, pred)         # slot n = bonus
+    logps = token_logprob(v_logits, emit)                    # (B, gamma)
+
+    counts = jnp.where(was_active, counts, 0)
+    emitted = jnp.where(
+        was_active[:, None] & (idx < counts[:, None]), emit, -1
+    )
+    new_len = state.lengths + counts
+    last = jnp.take_along_axis(
+        emit, jnp.maximum(counts - 1, 0)[:, None], axis=1
+    )[:, 0]
+
+    new_state = BatchState(
+        cache=t_cache,
+        lengths=new_len,
+        last_token=jnp.where(was_active, last, state.last_token),
+        active=state.active,
+        presence=state.presence,
+        key=state.key,
+    )
+    new_draft = BatchState(
+        cache=d_cache,
+        lengths=new_len,
+        last_token=draft_state.last_token,
+        active=draft_state.active,
+        presence=draft_state.presence,
+        key=draft_state.key,
+    )
+    return new_state, new_draft, emitted, counts, logps
+
+
+class SpeculativeBatcher(ContinuousBatcher):
+    """Continuous batching with a draft model accelerating every slot.
+
+    Greedy-only (temperature 0, no repetition penalty): speculative
+    acceptance is defined against the target's own argmax. Requires
+    chunked prefill (both models' caches prefill through the same chunk
+    schedule)."""
+
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        draft_params,
+        draft_cfg: LlamaConfig,
+        n_slots: int,
+        max_len: int,
+        gamma: int = 4,
+        **kw,
+    ):
+        sampler = kw.get("sampler")
+        if sampler is not None and (
+            sampler.temperature != 0.0 or sampler.repetition_penalty != 1.0
+        ):
+            raise ValueError(
+                "SpeculativeBatcher is greedy-only (temperature 0, "
+                "no repetition penalty)"
+            )
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        super().__init__(params, cfg, n_slots, max_len, **kw)
+        if not self.chunk:
+            raise ValueError("SpeculativeBatcher requires chunked_prefill")
+        self.gamma = int(gamma)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.draft_state = init_batch_state(draft_cfg, n_slots, max_len)
+
+    def submit(self, prompt, max_new, prefix=None, stop=None):
+        if prefix is not None:
+            raise NotImplementedError(
+                "shared prefixes are not supported with speculative "
+                "batching yet (the draft cache has no prefix rows)"
+            )
+        # reserve gamma rows: each round may write that far past the
+        # accepted length
+        if len(prompt) + max_new + self.gamma > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} + gamma "
+                f"{self.gamma} exceeds slot capacity {self.max_len}"
+            )
+        return super().submit(prompt, max_new, stop=stop)
+
+    # mirror every prefill onto the draft cache
+
+    def _apply_prefill_chunk(self, chunk, start, slot):
+        super()._apply_prefill_chunk(chunk, start, slot)
+        self.draft_state = prefill_chunk(
+            self.draft_params, self.draft_state, chunk,
+            jnp.int32(start), jnp.int32(slot), self.draft_cfg,
+        )
+
+    def _apply_prefill_finish(self, chunk, fstart, plen, slot):
+        tok, logp = super()._apply_prefill_finish(chunk, fstart, plen, slot)
+        # same chunk through the draft (its sampled token is unused; the
+        # call exists to write the draft K/V rows and set its lengths)
+        self.draft_state, _tok, _logp = prefill_finish(
+            self.draft_params, self.draft_state, chunk, jnp.int32(fstart),
+            jnp.int32(plen), jnp.int32(slot),
+            self.draft_cfg, self.sampler,
+        )
+        return tok, logp
+
+    def _decode_once(self, allowed) -> int:
+        # The submit-side gamma reservation guarantees room: a running
+        # slot has len(out) < max_new, so length + gamma <= max_len.
+        for slot, req in self.running.items():
+            assert (
+                len(req.prompt) + len(req.out) + self.gamma <= self.max_len
+            ), "gamma reservation violated"
+        (
+            self.state, self.draft_state, emitted, counts, logps,
+        ) = spec_decode_step(
+            self.params, self.draft_params, self.state, self.draft_state,
+            allowed, self.cfg, self.draft_cfg, self.gamma,
+        )
+        emitted, counts, logps = jax.device_get(
+            (emitted, counts, logps)
+        )  # one host sync per round
+        n_emitted = 0
+        for slot, req in list(self.running.items()):
+            for j in range(int(counts[slot])):
+                tok = int(emitted[slot, j])
+                if tok < 0:
+                    break
+                n_emitted += 1
+                req.out.append(tok)
+                req.out_logp.append(float(logps[slot, j]))
+                self._finish_if_done(req)
+                if req.rid in self.done:
+                    break  # EOS/stop/budget mid-round: drop the tail
+        return n_emitted

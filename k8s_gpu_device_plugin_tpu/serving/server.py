@@ -10,12 +10,16 @@ submit requests and read per-request token queues bridged with
 API (JSON over HTTP, SSE for streaming):
 
 - ``POST /v1/generate``  {"prompt": [ids...], "max_new": N,
-  "stream": false, "n": 1, "stop": [[ids...], ...]} -> {"id", "tokens"}
-  (plus "completions" when n > 1: independent samples decoded in
-  parallel slots) — or with ``"stream": true`` (n=1 only), a
-  ``text/event-stream`` of ``data: {"token": t}`` lines, closing with
-  ``data: {"done": true}``. Stop sequences retire a request when its
-  output ends with any of them (tokens kept, like EOS).
+  "stream": false, "n": 1, "stop": [[ids...], ...], "logprobs": false}
+  -> {"id", "tokens"} (plus "completions" when n > 1: independent
+  samples decoded in parallel slots; plus "logprobs" — and
+  "completions_logprobs" with n > 1 — when requested: raw-distribution
+  log-probabilities aligned with the tokens) — or with
+  ``"stream": true`` (n=1 only), a ``text/event-stream`` of
+  ``data: {"token": t}`` lines (each also carrying "logprob" when
+  requested), closing with ``data: {"done": true}``. Stop sequences
+  retire a request when its output ends with any of them (tokens kept,
+  like EOS).
 - ``GET /v1/health``     {"slots", "active", "prefilling", "queued"}
 - ``GET /metrics``       Prometheus text (ServingMetrics +
   whatever else lives on the registry)

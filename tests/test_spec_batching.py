@@ -1,0 +1,126 @@
+"""Speculative continuous batching vs the plain batcher / generate oracle.
+
+f32 models: the T=gamma verify and T=1 decode are different XLA programs,
+so bf16 near-tie argmaxes could flip; at f32 greedy parity is token-exact
+(same caveat as models/speculative.py, pinned there and here).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.models.spec_batching import SpeculativeBatcher
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    draft_cfg = LlamaConfig.tiny(n_layers=1, d_model=64, n_heads=4,
+                                 n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    draft_params = init_params(jax.random.key(1), draft_cfg)
+    return cfg, params, draft_cfg, draft_params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def test_spec_batching_matches_generate(setup):
+    """3 requests over 2 slots with an unrelated draft: every stream must
+    equal dedicated generate (acceptance only reorders WORK, never
+    output), including slot reuse."""
+    cfg, params, draft_cfg, draft_params = setup
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=3, chunked_prefill=4,
+    )
+    specs = [(400, 5, 6), (401, 9, 4), (402, 3, 7)]
+    prompts = {}
+    for key, plen, max_new in specs:
+        p = _prompt(key, plen, cfg)
+        rid = sb.submit(p, max_new=max_new)
+        prompts[rid] = (p, max_new)
+    results = sb.run()
+    for rid, (p, max_new) in prompts.items():
+        assert results[rid] == _oracle(params, p, cfg, max_new), rid
+
+
+def test_spec_batching_selfdraft_accepts_everything(setup):
+    """draft == target: every proposal verifies, so rounds emit gamma
+    tokens and the step count collapses (~max_new/gamma decode rounds).
+    Output parity must still hold."""
+    cfg, params, _, _ = setup
+    sb = SpeculativeBatcher(
+        params, cfg, params, cfg,
+        n_slots=1, max_len=64, gamma=4, chunked_prefill=8,
+    )
+    p = _prompt(410, 6, cfg)
+    rid = sb.submit(p, max_new=8)
+    steps = 0
+    while sb.pending or sb.running or sb.prefilling:
+        sb.step()
+        steps += 1
+    assert sb.done[rid] == _oracle(params, p, cfg, 8)
+    # 1 admit/prefill step + ceil((8-1)/4)=2 spec rounds (+1 slack)
+    assert steps <= 5, steps
+
+
+def test_spec_batching_eos_and_logprobs(setup):
+    """EOS retirement mid-round drops the tail exactly like the plain
+    batcher; logprobs align with tokens."""
+    cfg, params, draft_cfg, draft_params = setup
+    p = _prompt(420, 5, cfg)
+    oracle = _oracle(params, p, cfg, 6)
+    eos = oracle[2]
+    if eos in oracle[:2]:
+        pytest.skip("random oracle collision")
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=1, max_len=64, gamma=3, chunked_prefill=4, eos_id=eos,
+    )
+    rid = sb.submit(p, max_new=6)
+    sb.run()
+    req = sb.done_requests[rid]
+    assert req.out == oracle[:3]          # stopped AT the eos
+    assert len(req.out_logp) == len(req.out)
+    assert all(lp <= 0.0 for lp in req.out_logp)
+
+
+def test_spec_batching_guards(setup):
+    cfg, params, draft_cfg, draft_params = setup
+    with pytest.raises(ValueError, match="greedy-only"):
+        SpeculativeBatcher(
+            params, cfg, draft_params, draft_cfg, n_slots=1, max_len=64,
+            gamma=3, chunked_prefill=4, sampler=Sampler(temperature=0.7),
+        )
+    with pytest.raises(ValueError, match="chunked_prefill"):
+        SpeculativeBatcher(
+            params, cfg, draft_params, draft_cfg, n_slots=1, max_len=64,
+            gamma=3,
+        )
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=1, max_len=32, gamma=4, chunked_prefill=4,
+    )
+    with pytest.raises(ValueError, match="gamma"):
+        sb.submit(list(range(1, 21)), max_new=10)  # 20+10+4 > 32
+    with pytest.raises(NotImplementedError):
+        from k8s_gpu_device_plugin_tpu.models.batching import (
+            precompute_prefix,
+        )
+
+        prefix = precompute_prefix(params, [1, 2, 3], cfg)
+        sb.submit([4, 5], max_new=2, prefix=prefix)
