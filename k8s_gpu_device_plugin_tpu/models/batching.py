@@ -287,6 +287,21 @@ class ContinuousBatcher:
         # None = zero overhead, no prometheus dependency on this path
         self.metrics = metrics
 
+    def validate(self, prompt_len: int, max_new: int) -> None:
+        """Raise ValueError iff submit(prompt of this length) would.
+
+        The ONE admission rule, shared by submit and by the serving
+        engine's request thread (which must reject before handing work
+        to the engine thread — an admission error THERE would kill the
+        step loop)."""
+        if prompt_len + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new {max_new} exceeds "
+                f"slot capacity {self.max_len}"
+            )
+        if not self.chunk:
+            _bucket(prompt_len, self.buckets)
+
     def submit(
         self,
         prompt: list[int],
@@ -303,15 +318,9 @@ class ContinuousBatcher:
         if prefix is not None and not self.chunk:
             raise ValueError("prefix sharing requires chunked_prefill=C")
         total = len(prompt) + (len(prefix.tokens) if prefix else 0)
-        if total + max_new > self.max_len:
-            raise ValueError(
-                f"prompt {total} + max_new {max_new} exceeds "
-                f"slot capacity {self.max_len}"
-            )
-        if not self.chunk:
-            # reject here, not in _admit: a mid-run() bucket failure would
-            # strand every in-flight neighbor
-            _bucket(len(prompt), self.buckets)
+        # reject here, not in _admit: a mid-run() failure would strand
+        # every in-flight neighbor
+        self.validate(total, max_new)
         rid = self._next_rid
         self._next_rid += 1
         full = (list(prefix.tokens) if prefix else []) + list(prompt)

@@ -172,18 +172,21 @@ class SpeculativeBatcher(ContinuousBatcher):
         self.draft_cfg = draft_cfg
         self.draft_state = init_batch_state(draft_cfg, n_slots, max_len)
 
+    def validate(self, prompt_len: int, max_new: int) -> None:
+        # reserve gamma rows: each round may write that far past the
+        # accepted length
+        if prompt_len + max_new + self.gamma > self.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new {max_new} + gamma "
+                f"{self.gamma} exceeds slot capacity {self.max_len}"
+            )
+        super().validate(prompt_len, max_new)
+
     def submit(self, prompt, max_new, prefix=None, stop=None):
         if prefix is not None:
             raise NotImplementedError(
                 "shared prefixes are not supported with speculative "
                 "batching yet (the draft cache has no prefix rows)"
-            )
-        # reserve gamma rows: each round may write that far past the
-        # accepted length
-        if len(prompt) + max_new + self.gamma > self.max_len:
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} + gamma "
-                f"{self.gamma} exceeds slot capacity {self.max_len}"
             )
         return super().submit(prompt, max_new, stop=stop)
 

@@ -40,10 +40,7 @@ import threading
 
 from aiohttp import web
 
-from k8s_gpu_device_plugin_tpu.models.batching import (
-    ContinuousBatcher,
-    _bucket,
-)
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
@@ -65,8 +62,11 @@ class InferenceEngine:
         eos_id: int | None = None,
         chunked_prefill: int = 256,
         metrics=None,
+        batcher: ContinuousBatcher | None = None,
     ):
-        self.cb = ContinuousBatcher(
+        # ``batcher`` injects a pre-built engine (e.g. a
+        # SpeculativeBatcher); the scheduling/stream logic is identical
+        self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(chunked_prefill, max_len),
@@ -105,13 +105,7 @@ class InferenceEngine:
         loop and hang every stream."""
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
-        if len(prompt) + max_new > self.cb.max_len:
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} exceeds "
-                f"slot capacity {self.cb.max_len}"
-            )
-        if not self.cb.chunk:
-            _bucket(len(prompt), self.cb.buckets)  # raises on misfit
+        self.cb.validate(len(prompt), max_new)  # the batcher's own rule
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
@@ -388,6 +382,12 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--weightQuant", default="none",
                         choices=["none", "int8", "int4"])
     parser.add_argument("--checkpointDir", default="")
+    parser.add_argument("--draftPreset", default="",
+                        help="enable speculative decoding with this draft "
+                        "model preset (greedy serving only)")
+    parser.add_argument("--draftCheckpointDir", default="")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="draft proposals verified per round")
     args = parser.parse_args(argv)
 
     from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
@@ -411,10 +411,26 @@ def _main(argv: list[str] | None = None) -> int:
         params = quantize_weights_int4(params)
 
     metrics = ServingMetrics()
+    batcher = None
+    if args.draftPreset:
+        from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+            SpeculativeBatcher,
+        )
+
+        draft_cfg = getattr(LlamaConfig, args.draftPreset)()
+        draft_params = load_params(draft_cfg, args.draftCheckpointDir)
+        batcher = SpeculativeBatcher(
+            params, cfg, draft_params, draft_cfg,
+            n_slots=args.slots, max_len=args.maxLen, gamma=args.gamma,
+            sampler=sampler, eos_id=None if args.eosId < 0 else args.eosId,
+            chunked_prefill=min(args.chunkedPrefill, args.maxLen),
+            metrics=metrics,
+        )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
         sampler=sampler, eos_id=None if args.eosId < 0 else args.eosId,
         chunked_prefill=args.chunkedPrefill, metrics=metrics,
+        batcher=batcher,
     )
     from prometheus_client import REGISTRY
 

@@ -124,3 +124,53 @@ def test_spec_batching_guards(setup):
 
         prefix = precompute_prefix(params, [1, 2, 3], cfg)
         sb.submit([4, 5], max_new=2, prefix=prefix)
+
+
+def test_speculative_engine_serves_over_http(setup):
+    """A SpeculativeBatcher injected into the inference engine serves
+    token streams identical to dedicated generate."""
+    import asyncio
+
+    import aiohttp
+
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params, draft_cfg, draft_params = setup
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=3, chunked_prefill=8,
+    )
+    p = _prompt(430, 5, cfg)
+    oracle = _oracle(params, p, cfg, 5)
+
+    async def body():
+        engine = InferenceEngine(params, cfg, batcher=sb)
+        server = InferenceServer(engine, host="127.0.0.1", port=0)
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://127.0.0.1:{server.bound_port}/v1/generate",
+                    json={"prompt": p, "max_new": 5},
+                ) as r:
+                    assert r.status == 200
+                    assert (await r.json())["tokens"] == oracle
+                # gamma reservation propagates through validate()
+                async with session.post(
+                    f"http://127.0.0.1:{server.bound_port}/v1/generate",
+                    json={"prompt": list(range(1, 56)), "max_new": 8},
+                ) as r:
+                    assert r.status == 422  # 55+8+3 > 64
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=300))
