@@ -1,4 +1,4 @@
-"""Speculative decoding inside continuous batching (greedy mode).
+"""Speculative decoding inside continuous batching.
 
 The two serving levers compose: the slot engine keeps the chip busy
 across requests (models/batching.py); speculative decoding cuts each
@@ -16,16 +16,23 @@ Per round, for every decoding slot simultaneously:
 2. ONE target forward over [last, d_1..d_{gamma-1}] (B, gamma) — the
    speculative payoff: gamma tokens' K/V written and scored in a single
    HBM pass over the target weights;
-3. greedy acceptance: longest proposal prefix matching the target's own
-   argmax, plus the target's bonus token at the cut — per slot;
+3. acceptance per slot: greedy samplers keep the longest proposal
+   prefix matching the target's own argmax (plus the target's bonus
+   token at the cut); sampled ones run rejection sampling
+   (vmapped _accept_round) so every emitted token is exactly
+   target-distributed under the filtered distribution;
 4. ``lengths += count`` per slot; both caches' rejected rows are hidden
    by the position mask and overwritten by later writes.
 
-Greedy only: emitted tokens are IDENTICAL to the plain batcher's (and
-therefore to dedicated ``generate``) up to float determinism — the
-T=gamma verify and T=1 decode are different XLA programs, so bf16
-near-tie argmaxes can flip; at f32 parity is token-exact (the same
-caveat models/speculative.py documents, test-pinned here too).
+Output contract: under a GREEDY sampler, emitted tokens are IDENTICAL
+to the plain batcher's (and therefore to dedicated ``generate``) up to
+float determinism — the T=gamma verify and T=1 decode are different XLA
+programs, so bf16 near-tie argmaxes can flip; at f32 parity is
+token-exact (the same caveat models/speculative.py documents,
+test-pinned here too). Under a SAMPLED sampler the guarantee is
+distributional, not token-wise: each token is exactly target-
+distributed (the speculative sampling theorem; the _accept_round core
+is statistically pinned in tests/test_speculative.py).
 
 Capacity: each round may write gamma rows beyond the accepted length, so
 ``submit`` reserves ``gamma`` extra rows (prompt + max_new + gamma <=
@@ -49,10 +56,16 @@ from k8s_gpu_device_plugin_tpu.models.batching import (
 )
 from k8s_gpu_device_plugin_tpu.models.generate import _forward_cached
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
-from k8s_gpu_device_plugin_tpu.models.sampling import token_logprob
+from k8s_gpu_device_plugin_tpu.models.sampling import (
+    Sampler,
+    filtered_logits,
+    filtered_probs,
+    token_logprob,
+)
+from k8s_gpu_device_plugin_tpu.models.speculative import _accept_round
 
 
-@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma"),
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma", "sampler"),
          donate_argnums=(2, 3))
 def spec_decode_step(
     params_t,
@@ -63,17 +76,26 @@ def spec_decode_step(
     cfg_t: LlamaConfig,
     cfg_d: LlamaConfig,
     gamma: int,
+    sampler: Sampler,
 ) -> tuple[BatchState, BatchState, jax.Array, jax.Array, jax.Array]:
     """One speculative round for every slot.
+
+    Greedy sampler: longest prefix matching the target argmax + bonus.
+    Sampled: per-slot rejection sampling (vmapped _accept_round) — every
+    emitted token is exactly target-distributed under the filtered
+    distribution (the speculative sampling theorem, per slot).
 
     Returns (state, draft_state, emitted (B, gamma) int32 with -1 beyond
     each row's count, counts (B,) int32, logps (B, gamma) f32).
     """
+    greedy = sampler.is_greedy
     was_active = state.active & allowed
+    b = state.lengths.shape[0]
     cache_len = state.cache.k.shape[2]
     # inactive slots write into the top gamma rows — outside every live
     # prompt/generation window thanks to the submit-side gamma reservation
     base = jnp.where(was_active, state.lengths, cache_len - gamma)
+    key, kdraft, kaccept = jax.random.split(state.key, 3)
 
     # --- 1. gamma draft proposals, each a T=1 cached forward ---
     def draft_body(carry, j):
@@ -81,14 +103,23 @@ def spec_decode_step(
         logits, d_cache = _forward_cached(
             params_d, tok[:, None], d_cache, base + j, cfg_d
         )
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return (nxt, d_cache), nxt
+        if greedy:
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            q = jnp.zeros_like(logits[:, -1], jnp.float32)  # unused
+        else:
+            fl = filtered_logits(logits[:, -1], sampler)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(kdraft, j), fl
+            ).astype(jnp.int32)
+            q = jax.nn.softmax(fl, axis=-1)
+        return (nxt, d_cache), (nxt, q)
 
-    (_, d_cache), d_toks = jax.lax.scan(
+    (_, d_cache), (d_toks, q_probs) = jax.lax.scan(
         draft_body, (state.last_token, draft_state.cache),
         jnp.arange(gamma, dtype=jnp.int32),
     )
-    d_toks = d_toks.T  # (B, gamma)
+    d_toks = d_toks.T                        # (B, gamma)
+    q_probs = q_probs.transpose(1, 0, 2)     # (B, gamma, V)
 
     # --- 2. one target verify forward over [last, d_1..d_{g-1}] ---
     verify_in = jnp.concatenate(
@@ -98,14 +129,25 @@ def spec_decode_step(
         params_t, verify_in, state.cache, base, cfg_t
     )
 
-    # --- 3. greedy acceptance per slot ---
-    pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)   # (B, gamma)
-    eq = (d_toks == pred).astype(jnp.int32)
-    n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)             # (B,)
-    counts = jnp.minimum(n + 1, gamma)
     idx = jnp.arange(gamma, dtype=jnp.int32)[None, :]
-    emit = jnp.where(idx < n[:, None], d_toks, pred)         # slot n = bonus
-    logps = token_logprob(v_logits, emit)                    # (B, gamma)
+    if greedy:
+        # --- 3a. greedy acceptance per slot ---
+        pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # (B, gamma)
+        eq = (d_toks == pred).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)            # (B,)
+        counts = jnp.minimum(n + 1, gamma)
+        emit = jnp.where(idx < n[:, None], d_toks, pred)  # slot n = bonus
+    else:
+        # --- 3b. per-slot rejection sampling ---
+        p_probs = filtered_probs(v_logits, sampler)             # (B, g, V)
+        keys = jax.vmap(lambda i: jax.random.fold_in(kaccept, i))(
+            jnp.arange(b)
+        )
+        n, bonus, counts = jax.vmap(_accept_round)(
+            keys, d_toks, q_probs, p_probs
+        )
+        emit = jnp.where(idx < n[:, None], d_toks, bonus[:, None])
+    logps = token_logprob(v_logits, emit)                       # (B, gamma)
 
     counts = jnp.where(was_active, counts, 0)
     emitted = jnp.where(
@@ -122,7 +164,7 @@ def spec_decode_step(
         last_token=jnp.where(was_active, last, state.last_token),
         active=state.active,
         presence=state.presence,
-        key=state.key,
+        key=key,
     )
     new_draft = BatchState(
         cache=d_cache,
@@ -138,10 +180,12 @@ def spec_decode_step(
 class SpeculativeBatcher(ContinuousBatcher):
     """Continuous batching with a draft model accelerating every slot.
 
-    Greedy-only (temperature 0, no repetition penalty): speculative
-    acceptance is defined against the target's own argmax. Requires
-    chunked prefill (both models' caches prefill through the same chunk
-    schedule)."""
+    Greedy samplers verify against the target argmax; sampled ones
+    (temperature/top-k/top-p) run per-slot rejection sampling — exactly
+    target-distributed either way. Repetition penalty is unsupported
+    (the filtered distributions would need per-slot presence threading).
+    Requires chunked prefill (both models' caches prefill through the
+    same chunk schedule)."""
 
     def __init__(
         self,
@@ -155,12 +199,9 @@ class SpeculativeBatcher(ContinuousBatcher):
         **kw,
     ):
         sampler = kw.get("sampler")
-        if sampler is not None and (
-            sampler.temperature != 0.0 or sampler.repetition_penalty != 1.0
-        ):
+        if sampler is not None and sampler.repetition_penalty != 1.0:
             raise ValueError(
-                "SpeculativeBatcher is greedy-only (temperature 0, "
-                "no repetition penalty)"
+                "SpeculativeBatcher does not support repetition_penalty"
             )
         if draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError("draft and target must share a vocabulary")
@@ -221,7 +262,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             self.state, self.draft_state, emitted, counts, logps,
         ) = spec_decode_step(
             self.params, self.draft_params, self.state, self.draft_state,
-            allowed, self.cfg, self.draft_cfg, self.gamma,
+            allowed, self.cfg, self.draft_cfg, self.gamma, self.sampler,
         )
         emitted, counts, logps = jax.device_get(
             (emitted, counts, logps)

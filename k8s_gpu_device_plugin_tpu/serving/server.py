@@ -384,7 +384,8 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpointDir", default="")
     parser.add_argument("--draftPreset", default="",
                         help="enable speculative decoding with this draft "
-                        "model preset (greedy serving only)")
+                        "model preset (greedy or sampled; repetition "
+                        "penalty unsupported)")
     parser.add_argument("--draftCheckpointDir", default="")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft proposals verified per round")

@@ -101,10 +101,11 @@ def test_spec_batching_eos_and_logprobs(setup):
 
 def test_spec_batching_guards(setup):
     cfg, params, draft_cfg, draft_params = setup
-    with pytest.raises(ValueError, match="greedy-only"):
+    with pytest.raises(ValueError, match="repetition_penalty"):
         SpeculativeBatcher(
             params, cfg, draft_params, draft_cfg, n_slots=1, max_len=64,
-            gamma=3, chunked_prefill=4, sampler=Sampler(temperature=0.7),
+            gamma=3, chunked_prefill=4,
+            sampler=Sampler(temperature=0.7, repetition_penalty=1.2),
         )
     with pytest.raises(ValueError, match="chunked_prefill"):
         SpeculativeBatcher(
@@ -174,3 +175,48 @@ def test_speculative_engine_serves_over_http(setup):
             await asyncio.wait_for(task, 30)
 
     asyncio.run(asyncio.wait_for(body(), timeout=300))
+
+
+def test_sampled_spec_selfdraft_full_acceptance(setup):
+    """Sampled mode, draft == target: q == p at every position, so
+    min(1, p/q) = 1 accepts every proposal and rounds emit gamma tokens
+    — a deterministic property of the rejection rule (the distributional
+    exactness of _accept_round is statistically pinned in
+    tests/test_speculative.py)."""
+    cfg, params, _, _ = setup
+    sb = SpeculativeBatcher(
+        params, cfg, params, cfg,
+        n_slots=1, max_len=64, gamma=4, chunked_prefill=8,
+        sampler=Sampler(temperature=0.8, top_k=50),
+    )
+    p = _prompt(440, 6, cfg)
+    rid = sb.submit(p, max_new=9)
+    steps = 0
+    while sb.pending or sb.running or sb.prefilling:
+        sb.step()
+        steps += 1
+    out = sb.done[rid]
+    assert len(out) == 9
+    assert all(0 <= t < cfg.vocab_size for t in out)
+    # 1 prefill step + 2 full-acceptance rounds (8 tokens) covers the
+    # budget; slack for the retirement step
+    assert steps <= 5, steps
+
+
+def test_sampled_spec_streams_complete_with_small_draft(setup):
+    """Sampled mode with a genuinely different draft: all requests finish
+    with full budgets, tokens in range, logprobs aligned."""
+    cfg, params, draft_cfg, draft_params = setup
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=3, chunked_prefill=4,
+        sampler=Sampler(temperature=0.9, top_p=0.9),
+    )
+    rids = [sb.submit(_prompt(450 + i, 4 + i, cfg), max_new=6)
+            for i in range(3)]
+    results = sb.run()
+    for rid in rids:
+        assert len(results[rid]) == 6
+        assert all(0 <= t < cfg.vocab_size for t in results[rid])
+        req = sb.done_requests[rid]
+        assert len(req.out_logp) == 6
