@@ -1,0 +1,122 @@
+"""ctypes binding to the native data loader (native/dataload.cc).
+
+``NativeMemmapSource`` is a drop-in for ``pipeline.MemmapSource``: the
+deterministic window sampling (numpy RNG keyed by (seed, step)) stays in
+Python — ONE recipe, so the two sources are bit-identical — while the
+gather itself (page faults + uint16/32 -> int32 widening for B windows)
+runs in the C++ worker pool. On a cold TB-scale corpus the Python
+memmap loop faults pages serially on the main thread; the native gather
+overlaps faults across threads and returns one contiguous int32 array.
+
+Falls back loudly: constructing without the built library raises (run
+``make -C k8s_gpu_device_plugin_tpu/native``), it never silently
+degrades to the Python path — callers choose their source explicitly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "native", "build"),
+    os.path.join(os.path.dirname(__file__), "..", "native"),
+    "/usr/local/lib",
+)
+
+
+def _load_library() -> ctypes.CDLL | None:
+    for d in _LIB_DIRS:
+        path = os.path.join(d, "libdataload.so")
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            lib.dataload_open.restype = ctypes.c_void_p
+            lib.dataload_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.dataload_len.restype = ctypes.c_int64
+            lib.dataload_len.argtypes = [ctypes.c_void_p]
+            lib.dataload_gather.restype = ctypes.c_int32
+            lib.dataload_gather.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+            lib.dataload_close.restype = None
+            lib.dataload_close.argtypes = [ctypes.c_void_p]
+            return lib
+    return None
+
+
+_DTYPE_CODES = {"uint16": 2, "uint32": 4}
+
+
+class NativeMemmapSource:
+    """pipeline.TokenSource over the C++ gather (see module docstring)."""
+
+    def __init__(self, path: str, dtype: str = "uint16", seed: int = 0,
+                 threads: int = 0) -> None:
+        if dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype!r} (uint16/uint32)")
+        self._lib = _load_library()
+        if self._lib is None:
+            raise RuntimeError(
+                "libdataload.so not built; run "
+                "`make -C k8s_gpu_device_plugin_tpu/native`"
+            )
+        self._handle = self._lib.dataload_open(
+            path.encode(), _DTYPE_CODES[dtype]
+        )
+        if not self._handle:
+            raise FileNotFoundError(f"cannot open token file {path}")
+        self.n_tokens = int(self._lib.dataload_len(self._handle))
+        if self.n_tokens < 2:
+            self.close()
+            raise ValueError(f"token file {path} too small ({self.n_tokens})")
+        self.seed = seed
+        self.threads = threads
+
+    def windows(self, step, rows, batch_rows, seq_len):
+        n = self.n_tokens - (seq_len + 1)
+        if n < 1:
+            raise ValueError(
+                f"corpus of {self.n_tokens} tokens shorter than seq "
+                f"{seq_len}+1"
+            )
+        # SAME sampling recipe as pipeline.MemmapSource — bit-identical
+        # batches, so swapping sources never changes a training run
+        rng = np.random.default_rng((self.seed, step))
+        starts = np.ascontiguousarray(
+            rng.integers(0, n + 1, size=batch_rows)[rows], dtype=np.int64
+        )
+        out = np.empty((len(starts), seq_len + 1), dtype=np.int32)
+        got = self._lib.dataload_gather(
+            self._handle,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(starts),
+            seq_len + 1,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self.threads,
+        )
+        if got != len(starts):
+            raise RuntimeError(
+                f"native gather failed ({got}/{len(starts)} rows)"
+            )
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dataload_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
