@@ -11,6 +11,18 @@ Each workload runs in a child process with a hard timeout — a wedged
 tunneled backend is killed and retried with bounded backoff, and on final
 failure the JSON line still appears with ``value: null`` and an ``error``.
 All diagnostics go to stderr; stdout is exactly one parseable line.
+
+Wedge budgeting (the round-3 postmortem: 963s spent learning "wedged"):
+- A fast chip PROBE runs first (tiny matmul, short timeout). A confirmed
+  dead probe skips every TPU workload — the run finishes in minutes with
+  the chip-free control-plane metric still recorded.
+- Every completed workload's JSON is appended to ``bench_partials.jsonl``
+  immediately, so a mid-run wedge loses nothing already measured.
+- Two consecutive all-attempts-timed-out workloads trigger a re-probe;
+  if the chip is gone, remaining TPU workloads are skipped.
+
+Test knobs (env): ``BENCH_PROBE_TIMEOUT`` overrides the probe timeout;
+``BENCH_TEST_FORCE_WEDGE=1`` makes the probe child hang (simulated wedge).
 """
 
 from __future__ import annotations
@@ -27,12 +39,29 @@ NORTH_STAR_TRAIN_MFU_PCT = 45.0  # BASELINE.md: >=45% train MFU north star
 ATTEMPTS = 3
 BACKOFF_SECONDS = 30.0
 DEADLINE_SECONDS = 1500.0  # global budget; retries stop when exceeded
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+PARTIALS_PATH = os.path.join(REPO_ROOT, "bench_partials.jsonl")
 
 _T0 = time.monotonic()
+_consecutive_timeouts = 0  # workloads whose every attempt timed out
 
 
 def _log(msg: str) -> None:
     print(f"bench [{time.monotonic() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _persist(workload: str, result: dict | None, note: str = "") -> None:
+    """Append one workload outcome to the partials file as it completes —
+    a mid-run wedge must not erase what was already measured."""
+    rec = {"workload": workload, "t": round(time.monotonic() - _T0, 1)}
+    if note:
+        rec["note"] = note
+    rec["result"] = result
+    try:
+        with open(PARTIALS_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:  # diagnostics must never kill the run
+        _log(f"partials write failed: {e}")
 
 
 def _run_child(workload: str, timeout: float, platforms: str | None) -> dict:
@@ -66,23 +95,33 @@ def _run_child(workload: str, timeout: float, platforms: str | None) -> dict:
 
 
 def run_workload(
-    workload: str, timeout: float, platforms: tuple[str | None, ...] = (None,)
+    workload: str,
+    timeout: float,
+    platforms: tuple[str | None, ...] = (None,),
+    attempts: int = ATTEMPTS,
+    backoff: float = BACKOFF_SECONDS,
 ) -> dict | None:
-    """Up to ATTEMPTS tries with backoff, all inside the global deadline.
+    """Up to ``attempts`` tries with backoff, all inside the global deadline.
 
     ``platforms`` cycles JAX_PLATFORMS values across attempts (None =
     inherit): the tunneled chip has been seen failing as the pinned backend
     name while still reachable under another ('axon' vs 'tpu' vs auto)."""
-    for attempt in range(1, ATTEMPTS + 1):
+    global _consecutive_timeouts
+    all_timed_out = True
+    attempts_made = 0
+    deadline_hit = False
+    for attempt in range(1, attempts + 1):
         remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
         if remaining <= 5:
             _log(f"{workload}: global deadline exhausted before attempt {attempt}")
-            return None
+            deadline_hit = True
+            break
         plat = platforms[(attempt - 1) % len(platforms)]
         _log(
-            f"{workload}: attempt {attempt}/{ATTEMPTS} "
+            f"{workload}: attempt {attempt}/{attempts} "
             f"(timeout {timeout:.0f}s, JAX_PLATFORMS={'inherit' if plat is None else plat!r})"
         )
+        attempts_made += 1
         try:
             result = _run_child(workload, timeout=min(timeout, remaining), platforms=plat)
         except subprocess.TimeoutExpired:
@@ -91,19 +130,60 @@ def run_workload(
         except Exception as e:  # noqa: BLE001 - diagnostics must not kill the line
             _log(f"{workload}: attempt {attempt} failed: {type(e).__name__}: {e}")
             result = None
+            all_timed_out = False
         if result is not None and "error" not in result:
+            _consecutive_timeouts = 0
+            _persist(workload, result)
             return result
         if result is not None:
             _log(f"{workload}: runner error: {result['error']}")
-        if attempt < ATTEMPTS:
-            _log(f"{workload}: backing off {BACKOFF_SECONDS:.0f}s")
-            time.sleep(BACKOFF_SECONDS)
+            all_timed_out = False
+        if attempt < attempts:
+            _log(f"{workload}: backing off {backoff:.0f}s")
+            time.sleep(backoff)
+    # zero attempts (pure deadline exhaustion) is NOT evidence of a wedge —
+    # don't let budget running out masquerade as a chip failure
+    if attempts_made > 0 and all_timed_out:
+        _consecutive_timeouts += 1
+    note = (
+        "deadline exhausted before any attempt"
+        if attempts_made == 0 and deadline_hit
+        else "all attempts failed"
+    )
+    _persist(workload, None, note=note)
     return None
 
 
+def probe_chip(platforms: tuple[str | None, ...]) -> bool:
+    """Fast up-front liveness check: a tiny matmul child with a short
+    timeout. Round 3 spent 963s of a scarce hardware window discovering a
+    wedge; this discovers it in ~PROBE_TIMEOUT seconds."""
+    # attempts == len(platforms): the probe gates the whole run, so it must
+    # try every JAX_PLATFORMS fallback the real workloads would have tried
+    result = run_workload(
+        "probe", timeout=PROBE_TIMEOUT, platforms=platforms,
+        attempts=max(2, len(platforms)), backoff=5.0,
+    )
+    return result is not None
+
+
 def main() -> int:
+    # fresh partials file per run (the file is this run's journal)
+    try:
+        open(PARTIALS_PATH, "w").close()
+    except OSError:
+        pass
+
     tpu_platforms = (None, "tpu", "")  # pinned name -> libtpu name -> auto
-    matmul = run_workload("matmul", timeout=300, platforms=tpu_platforms)
+    chip_live = probe_chip(tpu_platforms)
+    if not chip_live:
+        _log("probe: chip unreachable — skipping all TPU workloads (wedge mode)")
+
+    matmul = (
+        run_workload("matmul", timeout=300, platforms=tpu_platforms)
+        if chip_live
+        else None
+    )
     train = (
         run_workload("train", timeout=480, platforms=tpu_platforms) if matmul else None
     )
@@ -112,7 +192,7 @@ def main() -> int:
     # subprocess workload); diagnostic unless the direct path also worked
     allocated = (
         run_workload("allocated", timeout=480, platforms=tpu_platforms)
-        if matmul
+        if matmul and _chip_still_live(tpu_platforms)
         else None
     )
 
@@ -122,6 +202,9 @@ def main() -> int:
     def secondary(workload: str, cap: float, gate, min_remaining: float):
         remaining = DEADLINE_SECONDS - (time.monotonic() - _T0)
         if not gate or remaining <= min_remaining:
+            return None
+        if not _chip_still_live(tpu_platforms):
+            _log(f"{workload}: skipped — chip wedged mid-run")
             return None
         return run_workload(
             workload, timeout=min(cap, remaining - 20), platforms=tpu_platforms
@@ -215,17 +298,45 @@ def main() -> int:
             **extra,
         }
     else:
+        reason = (
+            "TPU chip unreachable (fast probe failed; wedge mode, TPU "
+            "workloads skipped)"
+            if not chip_live
+            else "TPU workloads failed after retries (see stderr diagnostics)"
+        )
         payload = {
             "metric": "llama_train_bf16_mfu",
             "value": None,
             "unit": "% of peak",
             "vs_baseline": None,
-            "error": "TPU workloads failed after retries (see stderr diagnostics)",
+            "error": reason,
             **extra,
         }
 
     print(json.dumps(payload))
     return 0
+
+
+def _chip_still_live(tpu_platforms: tuple[str | None, ...]) -> bool:
+    """Mid-run wedge detector: after two consecutive all-timeout workloads,
+    re-probe once; a dead probe stops us burning the rest of the window."""
+    global _consecutive_timeouts
+    if _consecutive_timeouts < 2:
+        return True
+    _log("two consecutive workload timeouts — re-probing chip")
+    # cycle every platform fallback: a name-specific transient must not
+    # condemn the rest of the run (cheap next to the N-minute workload
+    # timeouts this re-probe replaces)
+    live = run_workload(
+        "probe", timeout=PROBE_TIMEOUT, platforms=tpu_platforms,
+        attempts=len(tpu_platforms), backoff=5.0,
+    )
+    if live is not None:
+        _consecutive_timeouts = 0
+        return True
+    # leave the counter >= 2: every later _chip_still_live re-probes once,
+    # cheap relative to the N-minute workload timeouts it replaces
+    return False
 
 
 if __name__ == "__main__":

@@ -45,6 +45,25 @@ def _require_accelerator():
     return device
 
 
+def _run_probe() -> dict:
+    """Fast chip-liveness probe (bench.py wedge budgeting): one tiny
+    matmul, seconds when the chip is healthy, killed from outside when it
+    is wedged. BENCH_TEST_FORCE_WEDGE=1 simulates the wedge by hanging
+    exactly where a wedged tunnel hangs (before any device answer)."""
+    import time as _time
+
+    if os.environ.get("BENCH_TEST_FORCE_WEDGE") == "1":
+        _time.sleep(3600)  # parent's timeout kills us; same shape as a wedge
+    import jax
+    import jax.numpy as jnp
+
+    device = _require_accelerator()
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    return {"workload": "probe", "device_kind": device.device_kind}
+
+
 def _run_matmul() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
 
@@ -330,6 +349,7 @@ def _run_allocated() -> dict:
 
 
 WORKLOADS = {
+    "probe": _run_probe,
     "matmul": _run_matmul,
     "train": _run_train,
     "train_int8": _run_train_int8,

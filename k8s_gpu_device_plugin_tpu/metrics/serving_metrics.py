@@ -18,9 +18,15 @@ from prometheus_client import Counter, Gauge, REGISTRY
 
 
 class ServingMetrics:
-    """Registers once against ``registry``; updated by ContinuousBatcher."""
+    """Registers once against ``registry``; updated by ContinuousBatcher.
+
+    Collector names are fixed, so two live instances on the SAME registry
+    would collide — call :meth:`close` when retiring an instance (tests,
+    engine restarts) to unregister its collectors first.
+    """
 
     def __init__(self, registry=REGISTRY, prefix: str = "tpu_serving"):
+        self._registry = registry
         self.tokens_total = Counter(
             f"{prefix}_generated_tokens_total",
             "Tokens emitted across all requests",
@@ -34,7 +40,7 @@ class ServingMetrics:
         self.requests_finished = Counter(
             f"{prefix}_requests_finished_total",
             "Requests retired, by reason",
-            ["reason"],  # eos | budget
+            ["reason"],  # eos | budget | stop (stop-sequence hit)
             registry=registry,
         )
         self.prefill_chunks = Counter(
@@ -65,6 +71,24 @@ class ServingMetrics:
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
 
+    def close(self) -> None:
+        """Unregister this instance's collectors so a replacement can
+        register the same names on the same registry."""
+        for c in (
+            self.tokens_total,
+            self.requests_submitted,
+            self.requests_finished,
+            self.prefill_chunks,
+            self.queue_depth,
+            self.slots_active,
+            self.slots_prefilling,
+            self.tokens_per_second,
+        ):
+            try:
+                self._registry.unregister(c)
+            except KeyError:
+                pass  # already unregistered
+
     # --- batcher hooks ---
 
     def on_submit(self) -> None:
@@ -91,6 +115,13 @@ class ServingMetrics:
             self.tokens_per_second.set(self._win_tokens / dt)
             self._win_t0 = time.monotonic()
             self._win_tokens = 0
+
+    def on_idle(self) -> None:
+        """No traffic: zero the throughput gauge instead of freezing it
+        at the last busy window's value, and restart the window."""
+        self.tokens_per_second.set(0.0)
+        self._win_t0 = time.monotonic()
+        self._win_tokens = 0
 
     def on_finish(self, reason: str) -> None:
         self.requests_finished.labels(reason=reason).inc()

@@ -59,9 +59,11 @@ void* dataload_open(const char* path, int dtype_code) {
     ::close(fd);
     return nullptr;
   }
-  // the kernel should read ahead aggressively: gathers are random-start
-  // but each window is a contiguous run
-  ::madvise(map, static_cast<size_t>(st.st_size), MADV_WILLNEED);
+  // Access is random-start windows: telling the kernel WILLNEED over the
+  // whole mapping would queue readahead of the entire (possibly TB-scale)
+  // file and thrash page cache. Disable whole-file readahead; each gather
+  // schedules WILLNEED for exactly the windows it is about to touch.
+  ::madvise(map, static_cast<size_t>(st.st_size), MADV_RANDOM);
   auto* c = new Corpus();
   c->base = static_cast<const uint8_t*>(map);
   c->bytes = static_cast<size_t>(st.st_size);
@@ -92,6 +94,20 @@ int32_t dataload_gather(void* handle, const int64_t* starts, int32_t n_rows,
   const int64_t n_tokens = dataload_len(handle);
   for (int32_t i = 0; i < n_rows; ++i) {
     if (starts[i] < 0 || starts[i] + row_len > n_tokens) return 0;
+  }
+  // Schedule readahead for exactly the windows this gather touches (the
+  // mapping itself is MADV_RANDOM, so the kernel won't read ahead on its
+  // own). madvise wants page-aligned starts; lengths may be unaligned.
+  {
+    const long page = ::sysconf(_SC_PAGESIZE);
+    const size_t pmask = page > 0 ? static_cast<size_t>(page) - 1 : 4095;
+    const size_t width = elem_width(c->dtype_code);
+    for (int32_t i = 0; i < n_rows; ++i) {
+      const size_t lo = static_cast<size_t>(starts[i]) * width;
+      const size_t hi = lo + static_cast<size_t>(row_len) * width;
+      const size_t alo = lo & ~pmask;
+      ::madvise(const_cast<uint8_t*>(c->base) + alo, hi - alo, MADV_WILLNEED);
+    }
   }
   int nthreads = threads > 0 ? threads
                              : static_cast<int>(

@@ -109,6 +109,12 @@ class InferenceEngine:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
+            # Re-check death while holding the lock: the dead-path stream
+            # flush also runs under it after setting _dead, so either the
+            # flush already ran (we see _dead and bail before anyone waits
+            # on q) or it runs after us and sees this stream.
+            if self._dead.is_set():
+                raise RuntimeError("inference engine is dead (see logs)")
             eid = self._next_eid
             self._next_eid += 1
             self._subq.append(
@@ -185,6 +191,7 @@ class InferenceEngine:
             self._published[eid] = len(out)
 
     def _loop(self) -> None:
+        was_busy = False
         try:
             while not self._stop.is_set():
                 self._admit_submissions()
@@ -195,8 +202,19 @@ class InferenceEngine:
                     self.cb.step()
                     self._publish()
                 else:
+                    if was_busy:
+                        # busy->idle transition: throughput gauge reads 0
+                        # while idle, not the last busy window's value.
+                        # getattr: metrics is duck-typed to the batcher
+                        # hooks only; on_idle is optional.
+                        on_idle = getattr(
+                            getattr(self.cb, "metrics", None), "on_idle", None
+                        )
+                        if on_idle is not None:
+                            on_idle()
                     self._work.wait(timeout=0.05)
                     self._work.clear()
+                was_busy = busy
         except Exception:  # noqa: BLE001 - a dead loop must not hang clients
             log.exception("inference engine loop died")
             self._dead.set()

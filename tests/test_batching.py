@@ -408,6 +408,31 @@ def test_serving_metrics_track_lifecycle(setup):
     assert val("tpu_serving_slots_active") == 0
 
 
+def test_serving_metrics_close_and_idle():
+    """close() unregisters the fixed-name collectors (a second instance on
+    the same registry no longer raises); on_idle() zeroes the throughput
+    gauge instead of freezing it at the last busy window's value."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m1 = ServingMetrics(registry=reg)
+    with pytest.raises(ValueError):
+        ServingMetrics(registry=reg)  # duplicate names on one registry
+    m1.close()
+    m2 = ServingMetrics(registry=reg)  # fine after close()
+
+    m2._win_t0 -= 2.0  # age the window so on_step closes it
+    m2.on_step(emitted=10, queue=0, active=1, prefilling=0)
+    assert reg.get_sample_value("tpu_serving_tokens_per_second") > 0
+    m2.on_idle()
+    assert reg.get_sample_value("tpu_serving_tokens_per_second") == 0.0
+    m2.close()
+
+
 def test_stop_sequences_retire_requests(setup):
     """A request stops when its output ends with a stop sequence (tokens
     kept); unrelated requests run to budget. Metrics record the reason."""
