@@ -158,8 +158,10 @@ def probe_chip(platforms: tuple[str | None, ...]) -> bool:
     """Fast up-front liveness check: a tiny matmul child with a short
     timeout. Round 3 spent 963s of a scarce hardware window discovering a
     wedge; this discovers it in ~PROBE_TIMEOUT seconds."""
-    # attempts == len(platforms): the probe gates the whole run, so it must
-    # try every JAX_PLATFORMS fallback the real workloads would have tried
+    # max(2, len(platforms)) attempts (3 with the default tuple): the probe
+    # gates the whole run, so it must try every JAX_PLATFORMS fallback the
+    # real workloads would have tried. Worst-case wedge-mode budget:
+    # attempts x PROBE_TIMEOUT + (attempts-1) x 5s backoff.
     result = run_workload(
         "probe", timeout=PROBE_TIMEOUT, platforms=platforms,
         attempts=max(2, len(platforms)), backoff=5.0,

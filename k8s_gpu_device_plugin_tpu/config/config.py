@@ -60,6 +60,17 @@ class Config:
     # gauges ("" = TPU_RUNTIME_METRICS_PORTS env or default 8431; "off"
     # disables scraping entirely).
     runtime_metrics_ports: str = ""
+    # Wedged-but-present health detection (device/health.py): gauges for a
+    # chip older than this, with the workload endpoint still reachable,
+    # mark the chip "Unknown" (withdrawn from kubelet).
+    health_stale_after: float = 30.0
+    # Opt-in bounded idle probe ("on"/"off"): when NO workload holds the
+    # chips, a short-lived child opens the runtime and runs one tiny op;
+    # a hung child marks chips "Unknown". Off by default — it briefly
+    # takes the single-client runtime lock, an operator decision.
+    health_idle_probe: str = "off"
+    health_idle_probe_interval: float = 600.0
+    health_idle_probe_timeout: float = 45.0
 
     # Multi-host slice membership (SURVEY §7 hard parts; BASELINE config #5).
     # Empty sliceTopology = single-host operation (the reference's only mode).
@@ -108,6 +119,35 @@ class Config:
                 raise ValueError(
                     "workerHostnames is required when numSlices > 1"
                 )
+        if self.health_idle_probe not in ("on", "off"):
+            raise ValueError(
+                f"healthIdleProbe must be 'on' or 'off', "
+                f"got {self.health_idle_probe!r}"
+            )
+        if self.health_idle_probe == "on" and (
+            self.runtime_metrics_ports.strip().lower() == "off"
+        ):
+            # Gauge absence is the probe's only idleness signal; without
+            # scraping, a metrics-less workload would look idle and the
+            # probe child would contend for its single-client runtime lock.
+            raise ValueError(
+                "healthIdleProbe: on requires runtimeMetricsPorts != off"
+            )
+        if self.health_stale_after <= 0:
+            raise ValueError(
+                f"healthStaleAfterSeconds must be > 0, "
+                f"got {self.health_stale_after}"
+            )
+        if self.health_idle_probe_interval <= 0:
+            raise ValueError(
+                f"healthIdleProbeIntervalSeconds must be > 0, "
+                f"got {self.health_idle_probe_interval}"
+            )
+        if self.health_idle_probe_timeout <= 0:
+            raise ValueError(
+                f"healthIdleProbeTimeoutSeconds must be > 0, "
+                f"got {self.health_idle_probe_timeout}"
+            )
         if self.shared_replicas > 0 and (self.slice_topology or self.num_slices > 1):
             # Time-sliced sharing hands the same chips to several pods; a
             # distributed job would then see duplicate worker ranks on one
@@ -150,6 +190,10 @@ _KEY_MAP = {
     "sliceId": "slice_id",
     "megascaleCoordinator": "megascale_coordinator",
     "runtimeMetricsPorts": "runtime_metrics_ports",
+    "healthStaleAfterSeconds": "health_stale_after",
+    "healthIdleProbe": "health_idle_probe",
+    "healthIdleProbeIntervalSeconds": "health_idle_probe_interval",
+    "healthIdleProbeTimeoutSeconds": "health_idle_probe_timeout",
 }
 
 

@@ -20,6 +20,11 @@ from typing import Iterable, Iterator
 
 HEALTHY = "Healthy"      # pluginapi.Healthy
 UNHEALTHY = "Unhealthy"  # pluginapi.Unhealthy
+# Liveness evidence lost (runtime gauges went stale / idle probe hung)
+# without a confirmed fault. kubelet treats any non-"Healthy" string as
+# unschedulable, so this withdraws the chip while staying honest about
+# what is actually known. No pluginapi constant — deliberate extension.
+UNKNOWN = "Unknown"
 
 ANNOTATION_SEP = "::"
 

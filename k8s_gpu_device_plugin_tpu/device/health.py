@@ -1,0 +1,236 @@
+"""Tri-state chip health: the wedged-but-present detector.
+
+``backend.check_health()`` sees only device-node presence — precisely the
+signal a wedged chip still satisfies (the observed tunnel outage:
+``/dev/accel0`` present and readable while ``jax.devices()`` hangs
+forever). This module upgrades that boolean into a tri-state verdict with
+two non-intrusive liveness sources, respecting the single-client libtpu
+rule (the daemon must never hold the runtime lock workload pods need):
+
+1. **Runtime-metrics staleness.** A workload holding the chips serves
+   per-chip usage gauges (metrics/runtime_metrics.py, the tpu-info
+   service, port 8431). A chip whose gauges were flowing and then went
+   silent — while its device node still looks fine — is suspect:
+   verdict ``Unknown``.
+2. **Bounded idle probe (opt-in).** When no workload holds the chips —
+   as witnessed by the *absence of any runtime-metrics endpoint*, which
+   is why the probe requires gauge scraping to be on (Config.validate
+   enforces it): a workload that served no gauges would look idle and
+   the probe would contend for its runtime lock — a short-lived child
+   process opens the runtime, runs one tiny op, and exits, releasing
+   the runtime immediately. A hung child is killed at the timeout and
+   every node-present chip is marked ``Unknown``.
+
+``Unknown`` rather than ``Unhealthy``: kubelet withdraws the chip either
+way (any health string other than "Healthy" makes it unschedulable), but
+the daemon stays honest that this is lost liveness *evidence*, not a
+confirmed hardware fault. This is the deeper version of the reference's
+dead health channel (/root/reference/plugin/plugin.go:40 — declared,
+never written).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Callable
+
+from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY, UNHEALTHY, UNKNOWN
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+#: Gauges older than this mark their chip Unknown (a healthy workload
+#: publishes continuously; the scrape itself runs every health interval).
+DEFAULT_STALE_AFTER_SECONDS = 30.0
+#: Idle-probe cadence: how often an idle host may spend a probe child.
+DEFAULT_PROBE_INTERVAL_SECONDS = 600.0
+#: Hard kill for the probe child — a wedged runtime hangs forever.
+DEFAULT_PROBE_TIMEOUT_SECONDS = 45.0
+
+
+def run_idle_probe(timeout_seconds: float = DEFAULT_PROBE_TIMEOUT_SECONDS) -> bool:
+    """Open the TPU runtime in a child, run one tiny op, exit.
+
+    Returns True iff the child completed in time. The child (not this
+    process) takes the runtime lock and releases it on exit; on timeout
+    ``subprocess.run`` kills it, so the lock cannot leak. Callers must
+    only invoke this when no workload holds the chips.
+    """
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "x = jnp.ones((8, 8), jnp.bfloat16); "
+        "(x @ x).block_until_ready()"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_seconds,
+        )
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+class HealthAssessor:
+    """Combine node-presence booleans with liveness evidence.
+
+    ``assess`` maps each chip index to "Healthy" / "Unhealthy" /
+    "Unknown". Gauge device-ids are taken to be chip indices (the
+    runtime serves them per-chip the way the enumerator numbers them).
+    All liveness state is per-assessor: the manager owns one instance
+    for the daemon's lifetime.
+    """
+
+    def __init__(
+        self,
+        reader=None,
+        stale_after: float = DEFAULT_STALE_AFTER_SECONDS,
+        probe: Callable[[], bool] | None = None,
+        probe_interval: float = DEFAULT_PROBE_INTERVAL_SECONDS,
+        clock: Callable[[], float] = time.monotonic,
+        logger=None,
+    ) -> None:
+        self._reader = reader
+        self._stale_after = stale_after
+        self._probe = probe
+        self._probe_interval = probe_interval
+        self._clock = clock
+        self._log = logger or get_logger()
+        self._last_seen: dict[int, float] = {}
+        self._last_probe_t: float | None = None
+        self._last_probe_ok = True
+
+    def _scrape(self, now: float) -> set[int]:
+        """Refresh gauge liveness; returns the devices seen this scrape.
+
+        Endpoint status disambiguates "gauges stopped": ``absent`` (no
+        process listens) means the workload exited and released the chips
+        — liveness history is CLEARED so a clean exit never reads as a
+        wedge. ``silent`` (endpoint reachable, no gauges / RPCs timing
+        out) keeps history: that is the wedged-but-present signature, and
+        previously-seen chips will go stale against it.
+        """
+        if self._reader is None:
+            return set()
+        try:
+            read_status = getattr(self._reader, "read_status", None)
+            if read_status is not None:
+                usages, status = read_status()
+            else:
+                usages = self._reader.read()
+                status = "data" if usages else "absent"
+        except Exception as e:  # noqa: BLE001 - liveness is best-effort
+            self._log.warning(
+                "usage scrape failed during health assessment",
+                extra={"fields": {"error": str(e)}},
+            )
+            return set()
+        if status == "absent":
+            self._last_seen.clear()
+            return set()
+        live = set(usages)
+        for dev in live:
+            self._last_seen[dev] = now
+        return live
+
+    def assess(
+        self, node_health: dict[int, bool], allow_probe: bool = True
+    ) -> dict[int, str]:
+        """``allow_probe=False`` skips the idle-probe branch (startup /
+        restart paths, which must not block on a child process)."""
+        now = self._clock()
+        live = self._scrape(now)
+
+        verdicts: dict[int, str] = {}
+        for idx, ok in node_health.items():
+            if not ok:
+                verdicts[idx] = UNHEALTHY
+                continue
+            seen = self._last_seen.get(idx)
+            if seen is not None and idx not in live and now - seen > self._stale_after:
+                # a workload was publishing this chip's gauges and went
+                # silent while the node still looks fine: the
+                # wedged-but-present signature
+                verdicts[idx] = UNKNOWN
+                continue
+            verdicts[idx] = HEALTHY
+
+        if live:
+            # gauges flowing = chips demonstrably alive; retire any stale
+            # idle-probe failure so it can't outlive the evidence against it
+            self._last_probe_ok = True
+        elif (
+            allow_probe
+            and self._probe is not None
+            and all(v == HEALTHY for v in verdicts.values())
+        ):
+            # idle host (no gauges at all, nothing already suspect): spend
+            # a bounded probe child at most every probe_interval
+            if (
+                self._last_probe_t is None
+                or now - self._last_probe_t >= self._probe_interval
+            ):
+                self._last_probe_t = now
+                self._last_probe_ok = bool(self._probe())
+                if not self._last_probe_ok:
+                    self._log.warning(
+                        "idle runtime probe failed; marking chips Unknown"
+                    )
+            if not self._last_probe_ok:
+                for idx, v in verdicts.items():
+                    if v == HEALTHY:
+                        verdicts[idx] = UNKNOWN
+        return verdicts
+
+
+def assessor_from_config(cfg, logger=None, reader=None) -> HealthAssessor | None:
+    """Build the assessor the config asks for, or None (plain node-presence
+    health) when both liveness sources are disabled.
+
+    ``reader`` shares an existing usage reader (main.py passes the one the
+    metrics endpoint already owns — one gRPC channel set, one scrape
+    timeout budget); None builds from config.
+    """
+    from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import (
+        usage_reader_from_config,
+    )
+    from k8s_gpu_device_plugin_tpu.metrics.device_metrics import NullUsageReader
+
+    if reader is None:
+        reader = usage_reader_from_config(cfg)
+    if isinstance(reader, NullUsageReader):
+        reader = None
+    probe = None
+    if getattr(cfg, "health_idle_probe", "off") == "on":
+        if reader is None:
+            # Without gauges there is NO idleness signal, and probing
+            # blind would contend with a healthy workload for the
+            # single-client runtime lock (Config.validate also refuses
+            # this combination; this guard covers hand-built configs).
+            (logger or get_logger()).warning(
+                "healthIdleProbe=on requires runtime-metrics scraping; "
+                "probe disabled"
+            )
+        else:
+            timeout = float(
+                getattr(
+                    cfg, "health_idle_probe_timeout", DEFAULT_PROBE_TIMEOUT_SECONDS
+                )
+            )
+            probe = lambda: run_idle_probe(timeout)  # noqa: E731
+    if reader is None and probe is None:
+        return None
+    return HealthAssessor(
+        reader=reader,
+        stale_after=float(
+            getattr(cfg, "health_stale_after", DEFAULT_STALE_AFTER_SECONDS)
+        ),
+        probe=probe,
+        probe_interval=float(
+            getattr(
+                cfg, "health_idle_probe_interval", DEFAULT_PROBE_INTERVAL_SECONDS
+            )
+        ),
+        logger=logger,
+    )

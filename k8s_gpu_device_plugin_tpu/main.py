@@ -18,6 +18,10 @@ import sys
 
 from k8s_gpu_device_plugin_tpu.benchmark.profiler import Profiler
 from k8s_gpu_device_plugin_tpu.config import Config, load_config
+from k8s_gpu_device_plugin_tpu.device.health import assessor_from_config
+from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import (
+    usage_reader_from_config,
+)
 from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
 from k8s_gpu_device_plugin_tpu.server.server import Server
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
@@ -49,8 +53,18 @@ async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> No
         profiler.run()
 
     ready = Latch()
-    manager = PluginManager(cfg, ready, logger=logger)
-    server = Server(cfg, manager, ready, logger=logger)
+    # ONE usage reader shared by the metrics endpoint and the health
+    # assessor: one gRPC channel set, one scrape-timeout budget per tick.
+    usage_reader = usage_reader_from_config(cfg)
+    manager = PluginManager(
+        cfg,
+        ready,
+        logger=logger,
+        health_assessor=assessor_from_config(
+            cfg, logger=logger, reader=usage_reader
+        ),
+    )
+    server = Server(cfg, manager, ready, logger=logger, usage_reader=usage_reader)
 
     manager_task = asyncio.create_task(manager.start(), name="plugin-manager")
     server_task = asyncio.create_task(server.run(stop), name="http-server")

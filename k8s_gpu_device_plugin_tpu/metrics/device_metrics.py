@@ -26,7 +26,7 @@ from typing import Protocol
 
 from prometheus_client import Gauge, Info, REGISTRY
 
-from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY
+from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY, UNKNOWN
 from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap
 from k8s_gpu_device_plugin_tpu.utils.version import VERSION
 
@@ -102,9 +102,11 @@ class DeviceMetrics:
         seen_chips: dict[int, tuple[str, int]] = {}
         for resource, chips in chip_map.items():
             healthy = sum(1 for c in chips.values() if c.health == HEALTHY)
+            unknown = sum(1 for c in chips.values() if c.health == UNKNOWN)
             self.chips.labels(resource=resource, state="healthy").set(healthy)
+            self.chips.labels(resource=resource, state="unknown").set(unknown)
             self.chips.labels(resource=resource, state="unhealthy").set(
-                len(chips) - healthy
+                len(chips) - healthy - unknown
             )
             for chip in chips.values():
                 per_chip_mem = chip.total_memory // max(1, chip.num_chips)

@@ -142,22 +142,39 @@ class LibtpuUsageReader:
             channel.close()
         self._channels.clear()
 
-    def _scrape(self, stub: RuntimeMetricStub, name: str) -> dict[int, float]:
+    def _scrape(self, stub: RuntimeMetricStub, name: str) -> tuple[dict[int, float], bool]:
+        """(per-device values, endpoint reachable). UNAVAILABLE means no
+        process listens (workload exited — chips released); any other RPC
+        failure means a process holds the port but the runtime is not
+        answering (the wedged-but-present signature health cares about)."""
         try:
             resp = stub.GetRuntimeMetric(
                 pb.MetricRequest(metric_name=name), timeout=self._timeout
             )
-        except grpc.RpcError:
-            return {}
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            return {}, code is not grpc.StatusCode.UNAVAILABLE
         out: dict[int, float] = {}
         for metric in resp.metric.metrics:
             dev = _device_id(metric)
             if dev is not None:
                 out[dev] = _gauge_value(metric)
-        return out
+        return out, True
 
     def read(self) -> dict[int, Usage]:
+        return self.read_status()[0]
+
+    def read_status(self) -> tuple[dict[int, Usage], str]:
+        """Usages plus an endpoint status for health assessment:
+
+        - ``"data"``    — gauges flowed from at least one endpoint
+        - ``"silent"``  — an endpoint is reachable but served no gauges
+          (or its RPCs time out): a workload process exists but its
+          runtime is not publishing
+        - ``"absent"``  — no endpoint anywhere: no workload holds the chips
+        """
         usages: dict[int, Usage] = {}
+        any_reachable = False
 
         def merge(values: dict[int, float], field: str) -> None:
             for dev, val in values.items():
@@ -166,13 +183,20 @@ class LibtpuUsageReader:
 
         for port in self._ports:
             stub = self._stub(port)
-            hbm = self._scrape(stub, HBM_USAGE)
+            hbm, reachable = self._scrape(stub, HBM_USAGE)
+            any_reachable = any_reachable or reachable
             if not hbm and port != self._ports[0]:
                 continue  # secondary port with nothing to say
             merge({d: int(v) for d, v in hbm.items()}, "hbm_used_bytes")
-            merge(self._scrape(stub, DUTY_CYCLE), "duty_cycle_percent")
-            merge(self._scrape(stub, TENSORCORE_UTIL), "tensorcore_utilization")
-        return usages
+            duty, reachable = self._scrape(stub, DUTY_CYCLE)
+            any_reachable = any_reachable or reachable
+            merge(duty, "duty_cycle_percent")
+            util, reachable = self._scrape(stub, TENSORCORE_UTIL)
+            any_reachable = any_reachable or reachable
+            merge(util, "tensorcore_utilization")
+        if usages:
+            return usages, "data"
+        return usages, "silent" if any_reachable else "absent"
 
 
 def usage_reader_from_config(cfg):

@@ -25,7 +25,16 @@ import time
 
 from k8s_gpu_device_plugin_tpu.config import Config
 from k8s_gpu_device_plugin_tpu.device.backend import ChipBackend
-from k8s_gpu_device_plugin_tpu.device.chip import HEALTHY, UNHEALTHY, Chips
+from k8s_gpu_device_plugin_tpu.device.chip import (
+    HEALTHY,
+    UNHEALTHY,
+    UNKNOWN,
+    Chips,
+)
+from k8s_gpu_device_plugin_tpu.device.health import (
+    HealthAssessor,
+    assessor_from_config,
+)
 from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap, new_chip_map
 from k8s_gpu_device_plugin_tpu.device.factory import make_backend
 from k8s_gpu_device_plugin_tpu.device.topology import as_slice_member
@@ -35,6 +44,10 @@ from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 from k8s_gpu_device_plugin_tpu.utils.watch import FileWatcher
+
+#: Sentinel for "build the assessor from config" (distinct from an explicit
+#: None, which means "no assessor — plain node-presence health").
+_FROM_CONFIG: object = object()
 
 RETRY_INTERVAL_SECONDS = 30.0   # failed-start retry (manager.go:137)
 WATCH_POLL_SECONDS = 0.5        # fsnotify-equivalent poll cadence
@@ -54,6 +67,7 @@ class PluginManager:
         logger: logging.Logger | None = None,
         health_interval: float | None = None,
         retry_interval: float | None = None,
+        health_assessor: HealthAssessor | None | object = _FROM_CONFIG,
     ) -> None:
         self.cfg = cfg
         self.ready = ready
@@ -72,7 +86,19 @@ class PluginManager:
         self._restart_event = asyncio.Event()
         self._stop_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
-        self._chip_health: dict[int, bool] = {}
+        # Per-chip tri-state verdicts (HEALTHY/UNHEALTHY/UNKNOWN). The
+        # assessor upgrades the backend's node-presence booleans with
+        # runtime-gauge staleness + an opt-in idle probe (device/health.py,
+        # the wedged-but-present detector). Explicit arg wins — including
+        # an explicit None (main.py passes the config-built assessor, which
+        # is None when both liveness sources are off; rebuilding here would
+        # recreate the duplicate reader that sharing exists to avoid).
+        self._assessor = (
+            assessor_from_config(cfg, logger=self.log)
+            if health_assessor is _FROM_CONFIG
+            else health_assessor
+        )
+        self._chip_health: dict[int, str] = {}
         # Crash-loop guard state: rolling start timestamps per resource name.
         # Lives here (not in the plugin) so kubelet flaps, which rebuild
         # plugin objects, cannot reset the budget (cf. plugin.go:111-127).
@@ -186,7 +212,7 @@ class PluginManager:
             slice_plan=self.cfg.slice_plan,
             shared_replicas=self.cfg.shared_replicas,
         )
-        self._chip_health = self.backend.check_health()
+        self._chip_health = self._verdicts(self.backend.check_health())
         self.plugins = [
             TpuDevicePlugin(
                 resource_name=name,
@@ -200,18 +226,49 @@ class PluginManager:
             for name, chips in sorted(self.chip_map.items())
         ]
 
-    def _with_health(self, chips: Chips) -> Chips:
-        """Apply current per-chip health; a slice is unhealthy if any member is.
+    def _verdicts(
+        self, node_health: dict[int, bool], allow_probe: bool = False
+    ) -> dict[int, str]:
+        """Backend booleans -> tri-state verdicts (through the assessor
+        when one is configured).
 
-        A chip absent from the health map (no longer enumerated by the
+        ``allow_probe`` stays False on the synchronous load/restart paths:
+        the idle probe spawns a child bounded by its own timeout, which
+        must only happen from the health loop's executor thread, never
+        while the event loop waits on a load.
+        """
+        if self._assessor is not None:
+            try:
+                return self._assessor.assess(node_health, allow_probe=allow_probe)
+            except Exception as e:  # noqa: BLE001 - assessor is best-effort
+                self.log.warning(
+                    "health assessor failed; using node-presence health",
+                    extra={"fields": {"error": str(e)}},
+                )
+        return {
+            i: HEALTHY if ok else UNHEALTHY for i, ok in node_health.items()
+        }
+
+    def _with_health(self, chips: Chips) -> Chips:
+        """Apply current per-chip verdicts; the worst member state wins
+        (Unhealthy > Unknown > Healthy — a slice is only as good as its
+        weakest chip).
+
+        A chip absent from the verdict map (no longer enumerated by the
         backend, e.g. its device node vanished) counts as unhealthy.
         """
         out = Chips()
         for cid, chip in chips.items():
-            ok = all(
-                self._chip_health.get(i, False) for i in chip.chip_indices
-            )
-            out[cid] = chip.with_health(HEALTHY if ok else UNHEALTHY)
+            states = [
+                self._chip_health.get(i, UNHEALTHY) for i in chip.chip_indices
+            ]
+            if any(s == UNHEALTHY for s in states):
+                health = UNHEALTHY
+            elif any(s == UNKNOWN for s in states):
+                health = UNKNOWN
+            else:
+                health = HEALTHY
+            out[cid] = chip.with_health(health)
         return out
 
     async def _load_and_start(self) -> None:
@@ -311,7 +368,16 @@ class PluginManager:
         while True:
             await asyncio.sleep(self._health_interval)
             try:
-                health = self.backend.check_health()
+                # Off the event loop: the backend check touches the
+                # filesystem and the assessor's scrape burns gRPC timeouts
+                # (plus, opt-in, a bounded probe child) — none of which may
+                # freeze the HTTP plane or the kubelet gRPC servers,
+                # least of all during the outage this exists to report.
+                health = await asyncio.to_thread(
+                    lambda: self._verdicts(
+                        self.backend.check_health(), allow_probe=True
+                    )
+                )
             except Exception as e:  # noqa: BLE001
                 self.log.warning(
                     "health check failed", extra={"fields": {"error": str(e)}}
@@ -322,7 +388,12 @@ class PluginManager:
             self.log.warning(
                 "chip health changed",
                 extra={"fields": {
-                    "unhealthy": sorted(i for i, ok in health.items() if not ok)
+                    "unhealthy": sorted(
+                        i for i, s in health.items() if s == UNHEALTHY
+                    ),
+                    "unknown": sorted(
+                        i for i, s in health.items() if s == UNKNOWN
+                    ),
                 }},
             )
             self._chip_health = health

@@ -49,6 +49,7 @@ class Server:
         ready: Latch,
         logger: logging.Logger | None = None,
         registry=REGISTRY,
+        usage_reader=None,
     ) -> None:
         self.cfg = cfg
         self.manager = manager
@@ -56,8 +57,13 @@ class Server:
         self.log = logger or get_logger()
         self.registry = registry
         self.http_metrics = HttpMetrics(registry=registry)
+        # ``usage_reader`` lets main.py share ONE reader (one gRPC channel
+        # set) between these gauges and the manager's health assessor —
+        # two independent readers would double-scrape every endpoint and
+        # serially burn two RPC timeouts during a wedge.
         self.device_metrics = DeviceMetrics(
-            usage_reader=usage_reader_from_config(cfg), registry=registry
+            usage_reader=usage_reader or usage_reader_from_config(cfg),
+            registry=registry,
         )
         self.routes = {"/", "/health", "/metrics", "/restart"}
         self.app = self._build_app()

@@ -51,8 +51,9 @@ def test_bench_wedge_mode_fast_exit_with_partials():
     # the chip-free control-plane metric still made it into the line
     assert payload["control_plane_allocs_per_second"] > 0
 
-    # outage mode is minutes, not 963s: probe (2 x 3s + 5s backoff) +
-    # roundtrip; generous CI headroom but far below the old failure mode
+    # outage mode is minutes, not 963s: probe (3 attempts x 3s timeout +
+    # 2 x 5s backoff = ~19s) + roundtrip; generous CI headroom but far
+    # below the old failure mode
     assert wall < 240, f"wedge mode took {wall:.0f}s"
 
     # partials journal: probe recorded as failed, roundtrip with a result
