@@ -60,6 +60,10 @@ class Config:
     # gauges ("" = TPU_RUNTIME_METRICS_PORTS env or default 8431; "off"
     # disables scraping entirely).
     runtime_metrics_ports: str = ""
+    # Scrape-result cache: the /metrics endpoint and the health loop share
+    # one reader; near-simultaneous reads within this window share one RPC
+    # round instead of double-scraping the workload endpoint. 0 = uncached.
+    runtime_metrics_cache_ttl: float = 2.0
     # Wedged-but-present health detection (device/health.py): gauges for a
     # chip older than this, with the workload endpoint still reachable,
     # mark the chip "Unknown" (withdrawn from kubelet).
@@ -133,6 +137,11 @@ class Config:
             raise ValueError(
                 "healthIdleProbe: on requires runtimeMetricsPorts != off"
             )
+        if self.runtime_metrics_cache_ttl < 0:
+            raise ValueError(
+                f"runtimeMetricsCacheTtlSeconds must be >= 0, "
+                f"got {self.runtime_metrics_cache_ttl}"
+            )
         if self.health_stale_after <= 0:
             raise ValueError(
                 f"healthStaleAfterSeconds must be > 0, "
@@ -190,6 +199,7 @@ _KEY_MAP = {
     "sliceId": "slice_id",
     "megascaleCoordinator": "megascale_coordinator",
     "runtimeMetricsPorts": "runtime_metrics_ports",
+    "runtimeMetricsCacheTtlSeconds": "runtime_metrics_cache_ttl",
     "healthStaleAfterSeconds": "health_stale_after",
     "healthIdleProbe": "health_idle_probe",
     "healthIdleProbeIntervalSeconds": "health_idle_probe_interval",

@@ -101,18 +101,20 @@ class HealthAssessor:
         self._last_probe_t: float | None = None
         self._last_probe_ok = True
 
-    def _scrape(self, now: float) -> set[int]:
-        """Refresh gauge liveness; returns the devices seen this scrape.
+    def _scrape(self, now: float) -> tuple[set[int], bool]:
+        """Refresh gauge liveness; returns (devices seen, endpoint absent).
 
         Endpoint status disambiguates "gauges stopped": ``absent`` (no
         process listens) means the workload exited and released the chips
         — liveness history is CLEARED so a clean exit never reads as a
         wedge. ``silent`` (endpoint reachable, no gauges / RPCs timing
         out) keeps history: that is the wedged-but-present signature, and
-        previously-seen chips will go stale against it.
+        previously-seen chips will go stale against it. The absent flag
+        is the ONLY state that may unlock the idle probe — a silent
+        endpoint is still a process that may hold the runtime lock.
         """
         if self._reader is None:
-            return set()
+            return set(), False
         try:
             read_status = getattr(self._reader, "read_status", None)
             if read_status is not None:
@@ -125,22 +127,31 @@ class HealthAssessor:
                 "usage scrape failed during health assessment",
                 extra={"fields": {"error": str(e)}},
             )
-            return set()
+            return set(), False
         if status == "absent":
             self._last_seen.clear()
-            return set()
+            return set(), True
         live = set(usages)
         for dev in live:
             self._last_seen[dev] = now
-        return live
+        return live, False
 
     def assess(
-        self, node_health: dict[int, bool], allow_probe: bool = True
+        self,
+        node_health: dict[int, bool],
+        allow_probe: bool = True,
+        scrape: bool = True,
     ) -> dict[int, str]:
         """``allow_probe=False`` skips the idle-probe branch (startup /
-        restart paths, which must not block on a child process)."""
+        restart paths, which must not block on a child process).
+        ``scrape=False`` additionally skips the gauge scrape and judges
+        from cached liveness state only — zero blocking calls, for
+        callers on the event loop (the health loop scrapes from a worker
+        thread soon after anyway)."""
         now = self._clock()
-        live = self._scrape(now)
+        live, endpoint_absent = (
+            self._scrape(now) if scrape else (set(), False)
+        )
 
         verdicts: dict[int, str] = {}
         for idx, ok in node_health.items():
@@ -162,11 +173,15 @@ class HealthAssessor:
             self._last_probe_ok = True
         elif (
             allow_probe
+            and endpoint_absent
             and self._probe is not None
             and all(v == HEALTHY for v in verdicts.values())
         ):
-            # idle host (no gauges at all, nothing already suspect): spend
-            # a bounded probe child at most every probe_interval
+            # Truly idle host: NO metrics endpoint exists at all (a merely
+            # silent endpoint is still a live process that may hold the
+            # single-client runtime lock — e.g. a workload mid-init — and
+            # must never be raced by a probe child). Spend a bounded probe
+            # child at most every probe_interval.
             if (
                 self._last_probe_t is None
                 or now - self._last_probe_t >= self._probe_interval

@@ -18,6 +18,8 @@ Service stubs are hand-written against the checked-in
 from __future__ import annotations
 
 import os
+import threading
+import time
 from dataclasses import dataclass
 
 import grpc
@@ -124,13 +126,24 @@ class LibtpuUsageReader:
         host: str = "localhost",
         ports: list[int] | None = None,
         timeout_seconds: float = 1.0,
+        cache_ttl_seconds: float = 0.0,
     ) -> None:
         self._host = host
         self._ports = ports if ports else ports_from_env()
         self._timeout = timeout_seconds
         self._channels: dict[int, grpc.Channel] = {}
+        # One reader may serve two threads (the /metrics executor and the
+        # health loop's worker): the lock makes the channel cache safe and
+        # serializes scrapes; cache_ttl > 0 lets near-simultaneous callers
+        # share one RPC round instead of double-scraping the endpoint
+        # (daemon wiring passes a small TTL; the raw default is uncached
+        # so tests and one-shot readers always see fresh state).
+        self._lock = threading.Lock()
+        self._ttl = cache_ttl_seconds
+        self._cache: tuple[float, dict[int, Usage], str] | None = None
 
     def _stub(self, port: int) -> RuntimeMetricStub:
+        # callers hold self._lock
         channel = self._channels.get(port)
         if channel is None:
             channel = grpc.insecure_channel(f"{self._host}:{port}")
@@ -138,9 +151,11 @@ class LibtpuUsageReader:
         return RuntimeMetricStub(channel)
 
     def close(self) -> None:
-        for channel in self._channels.values():
-            channel.close()
-        self._channels.clear()
+        with self._lock:
+            for channel in self._channels.values():
+                channel.close()
+            self._channels.clear()
+            self._cache = None
 
     def _scrape(self, stub: RuntimeMetricStub, name: str) -> tuple[dict[int, float], bool]:
         """(per-device values, endpoint reachable). UNAVAILABLE means no
@@ -173,6 +188,17 @@ class LibtpuUsageReader:
           runtime is not publishing
         - ``"absent"``  — no endpoint anywhere: no workload holds the chips
         """
+        with self._lock:
+            now = time.monotonic()
+            if self._cache is not None and now - self._cache[0] < self._ttl:
+                _, usages, status = self._cache
+                return dict(usages), status
+            usages, status = self._read_uncached()
+            if self._ttl > 0:
+                self._cache = (time.monotonic(), usages, status)
+            return dict(usages), status
+
+    def _read_uncached(self) -> tuple[dict[int, Usage], str]:
         usages: dict[int, Usage] = {}
         any_reachable = False
 
@@ -202,13 +228,23 @@ class LibtpuUsageReader:
 def usage_reader_from_config(cfg):
     """Reader per the ``runtimeMetricsPorts`` knob: "off" -> null reader,
     "" -> TPU_RUNTIME_METRICS_PORTS env / default 8431, else the listed
-    ports."""
+    ports.
+
+    The daemon path enables a short scrape cache: the /metrics executor
+    and the health loop's worker thread share this reader, and the TTL
+    collapses their near-simultaneous scrapes into one RPC round.
+    """
     from k8s_gpu_device_plugin_tpu.metrics.device_metrics import NullUsageReader
 
     raw = getattr(cfg, "runtime_metrics_ports", "").strip()
     if raw.lower() == "off":
         return NullUsageReader()
-    return LibtpuUsageReader(ports=parse_ports(raw) or None)
+    return LibtpuUsageReader(
+        ports=parse_ports(raw) or None,
+        cache_ttl_seconds=float(
+            getattr(cfg, "runtime_metrics_cache_ttl", 2.0)
+        ),
+    )
 
 
 class FakeRuntimeMetricsServer(RuntimeMetricServicer):

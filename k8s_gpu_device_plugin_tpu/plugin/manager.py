@@ -227,19 +227,22 @@ class PluginManager:
         ]
 
     def _verdicts(
-        self, node_health: dict[int, bool], allow_probe: bool = False
+        self, node_health: dict[int, bool], blocking: bool = False
     ) -> dict[int, str]:
         """Backend booleans -> tri-state verdicts (through the assessor
         when one is configured).
 
-        ``allow_probe`` stays False on the synchronous load/restart paths:
-        the idle probe spawns a child bounded by its own timeout, which
-        must only happen from the health loop's executor thread, never
-        while the event loop waits on a load.
+        ``blocking=False`` (the load/restart paths, which run ON the event
+        loop) judges from the assessor's cached liveness state only — no
+        gauge scrape, no probe child, zero blocking calls. The health
+        loop passes True from its worker thread, where scrape timeouts
+        and the bounded probe child are allowed to burn real time.
         """
         if self._assessor is not None:
             try:
-                return self._assessor.assess(node_health, allow_probe=allow_probe)
+                return self._assessor.assess(
+                    node_health, allow_probe=blocking, scrape=blocking
+                )
             except Exception as e:  # noqa: BLE001 - assessor is best-effort
                 self.log.warning(
                     "health assessor failed; using node-presence health",
@@ -375,7 +378,7 @@ class PluginManager:
                 # least of all during the outage this exists to report.
                 health = await asyncio.to_thread(
                     lambda: self._verdicts(
-                        self.backend.check_health(), allow_probe=True
+                        self.backend.check_health(), blocking=True
                     )
                 )
             except Exception as e:  # noqa: BLE001

@@ -135,6 +135,55 @@ def test_idle_probe_failure_marks_unknown_with_bounded_cadence():
     assert calls == [0.0, 700.0]
 
 
+def test_probe_never_fires_on_silent_endpoint():
+    """A reachable-but-silent endpoint is a live process that may hold the
+    single-client runtime lock (e.g. a workload mid-init): the idle probe
+    must not race it. Only a fully absent endpoint unlocks the probe."""
+    clock = _Clock()
+    calls = []
+    reader = _FakeReader([({}, "silent")])
+    a = HealthAssessor(
+        reader=reader, stale_after=30.0,
+        probe=lambda: calls.append(clock.t) or False,
+        probe_interval=1.0, clock=clock,
+    )
+    for t in (0.0, 5.0, 10.0):
+        clock.t = t
+        assert a.assess({0: True}) == {0: HEALTHY}
+    assert calls == []  # never probed across three due intervals
+
+    # no-scrape mode (event-loop callers) must also never probe
+    reader2 = _FakeReader([({}, "absent")])
+    a2 = HealthAssessor(
+        reader=reader2, stale_after=30.0,
+        probe=lambda: calls.append(clock.t) or False,
+        probe_interval=1.0, clock=clock,
+    )
+    assert a2.assess({0: True}, allow_probe=False, scrape=False) == {0: HEALTHY}
+    assert calls == []
+
+
+def test_reader_cache_ttl_coalesces_scrapes():
+    """With cache_ttl_seconds set (the daemon wiring), back-to-back reads
+    share one RPC round; the raw default stays uncached."""
+    server = FakeRuntimeMetricsServer({HBM_USAGE: {0: 1024}})
+    port = server.start()
+    cached = LibtpuUsageReader(
+        ports=[port], timeout_seconds=2.0, cache_ttl_seconds=60.0
+    )
+    fresh = LibtpuUsageReader(ports=[port], timeout_seconds=2.0)
+    try:
+        assert cached.read_status()[1] == "data"
+        assert fresh.read_status()[1] == "data"
+        server.values.clear()
+        assert cached.read_status()[1] == "data"  # served from cache
+        assert fresh.read_status()[1] == "silent"  # uncached sees reality
+    finally:
+        server.stop()
+        cached.close()
+        fresh.close()
+
+
 def test_gauges_flowing_retire_probe_failure():
     """A failed idle probe must not outlive direct evidence of liveness:
     once gauges flow, chips are Healthy again immediately."""

@@ -145,7 +145,9 @@ def test_usage_gauges_scrape_runtime_metrics(tmp_path):
 
     async def body():
         base, _, teardown = await start_http_stack(
-            tmp_path, runtime_metrics_ports=str(port)
+            tmp_path,
+            runtime_metrics_ports=str(port),
+            runtime_metrics_cache_ttl=0,  # back-to-back scrapes must be fresh
         )
         try:
             async with aiohttp.ClientSession() as session:
