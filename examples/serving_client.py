@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Example client for the inference HTTP server (serving/server.py).
+
+Start a smoke server (random weights, byte tokenizer — lossless text
+round-trip against the tiny preset's 512-token vocab):
+
+    python -m k8s_gpu_device_plugin_tpu.serving.server \
+        --preset tiny --tokenizer byte --port 8000
+
+then:
+
+    python examples/serving_client.py --port 8000 "Hello TPU"
+
+Shows all three request shapes: text in/out (needs --tokenizer on the
+server), raw token ids, and SSE streaming. Standard library only — a
+client needs nothing from this repo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def stream(url: str, payload: dict):
+    """Yield decoded SSE events (dicts) from a streaming generate."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps({**payload, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                yield json.loads(line[len("data: "):])
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prompt", nargs="?", default="Hello TPU")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-new", type=int, default=16)
+    args = parser.parse_args()
+    base = f"http://{args.host}:{args.port}"
+    gen = f"{base}/v1/generate"
+
+    print("health:", post_health(base))
+
+    # 1. text in/out (server must be started with --tokenizer)
+    r = post(gen, {"text": args.prompt, "max_new": args.max_new})
+    print("text request ->", json.dumps(r.get("text", r), ensure_ascii=False))
+
+    # 2. raw token ids (always available)
+    r = post(gen, {"prompt": [1, 2, 3, 4], "max_new": args.max_new,
+                   "logprobs": True})
+    print("id request   ->", r["tokens"])
+
+    # 3. streaming with text on the closing event
+    toks = []
+    for evt in stream(gen, {"text": args.prompt, "max_new": args.max_new}):
+        if evt.get("done"):
+            print("stream done  ->", json.dumps(evt.get("text", ""),
+                                                ensure_ascii=False))
+        else:
+            toks.append(evt["token"])
+    print("streamed ids ->", toks)
+    return 0
+
+
+def post_health(base: str) -> dict:
+    with urllib.request.urlopen(f"{base}/v1/health", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

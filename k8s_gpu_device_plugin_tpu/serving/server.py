@@ -20,6 +20,12 @@ API (JSON over HTTP, SSE for streaming):
   requested), closing with ``data: {"done": true}``. Stop sequences
   retire a request when its output ends with any of them (tokens kept,
   like EOS).
+  With a tokenizer configured (serving/tokenizer.py; CLI --tokenizer):
+  ``"text"`` (a string) may replace ``"prompt"``; responses gain
+  ``"text"`` (and ``"completions_text"`` with n > 1); the stream's
+  closing event carries the full decoded ``"text"``; ``"stop_text"``
+  (list of strings) adds encoded stop sequences (exact for byte-level
+  tokenizers, best-effort across subword merge boundaries).
 - ``GET /v1/health``     {"slots", "active", "prefilling", "queued"}
 - ``GET /metrics``       Prometheus text (ServingMetrics +
   whatever else lives on the registry)
@@ -229,12 +235,16 @@ class InferenceServer:
     """aiohttp app over an InferenceEngine (port 0 = ephemeral)."""
 
     def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
-                 port: int = 8000, registry=None):
+                 port: int = 8000, registry=None, tokenizer=None):
         self.engine = engine
         self.host = host
         self.port = port
         self.bound_port: int | None = None
         self.registry = registry
+        # Optional text seam (serving/tokenizer.py): anything with
+        # encode(str)->ids / decode(ids)->str. The engine itself stays
+        # token-ids only; text is translated at the HTTP boundary.
+        self.tokenizer = tokenizer
         self.app = web.Application()
         self.app.router.add_post("/v1/generate", self._generate)
         self.app.router.add_get("/v1/health", self._health)
@@ -257,11 +267,25 @@ class InferenceServer:
     async def _generate(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
-            prompt = body["prompt"]
+            text = body.get("text")
+            if text is not None:
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "no tokenizer configured on this server; "
+                        "send token ids via 'prompt'"
+                    )
+                if not isinstance(text, str) or not text:
+                    raise ValueError("text must be a non-empty string")
+                if "prompt" in body:
+                    raise ValueError("send either 'text' or 'prompt', not both")
+                prompt = self.tokenizer.encode(text)
+            else:
+                prompt = body["prompt"]
             max_new = int(body.get("max_new", 64))
             stream = bool(body.get("stream", False))
             n = int(body.get("n", 1))
             stop = body.get("stop", [])
+            stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
             if (
                 not isinstance(prompt, list)
@@ -279,6 +303,29 @@ class InferenceServer:
                 for st in stop
             ):
                 raise ValueError("stop must be a list of token-id lists")
+            if stop_text:
+                # Caveat: standalone encoding can differ from in-context
+                # BPE merges; exact for byte-level tokenizers, best-effort
+                # for subword ones (same trade-off every text-stop API
+                # with token-level matching makes).
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "stop_text requires a tokenizer on this server"
+                    )
+                if not isinstance(stop_text, list) or not all(
+                    isinstance(s, str) and s for s in stop_text
+                ):
+                    raise ValueError(
+                        "stop_text must be a list of non-empty strings"
+                    )
+                # encode_plain (no BOS/special tokens): stop sequences
+                # must match a run of generated output
+                enc_stop = getattr(
+                    self.tokenizer, "encode_plain", self.tokenizer.encode
+                )
+                stop = list(stop) + [
+                    enc for enc in (enc_stop(s) for s in stop_text) if enc
+                ]
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
@@ -311,6 +358,12 @@ class InferenceServer:
                 payload["completions"] = [d[0] for d in drained]
                 if want_logprobs:
                     payload["completions_logprobs"] = [d[1] for d in drained]
+            if self.tokenizer is not None:
+                payload["text"] = self.tokenizer.decode(drained[0][0])
+                if n > 1:
+                    payload["completions_text"] = [
+                        self.tokenizer.decode(d[0]) for d in drained
+                    ]
             return web.json_response(payload)
 
         resp = web.StreamResponse(
@@ -318,12 +371,21 @@ class InferenceServer:
                      "Cache-Control": "no-cache"}
         )
         await resp.prepare(request)
+        streamed: list[int] = []
         while True:
             item = await q.get()
             if item is None:
-                await resp.write(b'data: {"done": true}\n\n')
+                # closing event carries the full decoded text (incremental
+                # per-token decode is wrong across multi-token characters;
+                # clients wanting text-as-you-go can decode the token
+                # prefix themselves with the same caveat)
+                done: dict = {"done": True}
+                if self.tokenizer is not None:
+                    done["text"] = self.tokenizer.decode(streamed)
+                await resp.write(f"data: {json.dumps(done)}\n\n".encode())
                 break
             tok, lp = item
+            streamed.append(tok)
             evt = {"token": tok}
             if want_logprobs:
                 evt["logprob"] = lp
@@ -393,13 +455,20 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--maxLen", type=int, default=2048)
     parser.add_argument("--chunkedPrefill", type=int, default=256)
-    parser.add_argument("--eosId", type=int, default=-1)
+    parser.add_argument("--eosId", default=None,
+                        help="EOS token id; unset adopts the tokenizer's "
+                        "eos when --tokenizer is given; 'none' (or -1) "
+                        "explicitly disables EOS stopping")
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--topK", type=int, default=0)
     parser.add_argument("--topP", type=float, default=1.0)
     parser.add_argument("--weightQuant", default="none",
                         choices=["none", "int8", "int4"])
     parser.add_argument("--checkpointDir", default="")
+    parser.add_argument("--tokenizer", default="",
+                        help="text seam: 'byte' (UTF-8 bytes, lossless) or "
+                        "a local HF tokenizer directory; empty = token-id "
+                        "API only")
     parser.add_argument("--draftPreset", default="",
                         help="enable speculative decoding with this draft "
                         "model preset (greedy or sampled; repetition "
@@ -429,6 +498,19 @@ def _main(argv: list[str] | None = None) -> int:
 
         params = quantize_weights_int4(params)
 
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import load_tokenizer
+
+    tokenizer = load_tokenizer(args.tokenizer)
+    # Three states, all expressible: unset -> adopt the tokenizer's EOS
+    # (or no EOS without one); explicit 'none'/-1 -> EOS stopping OFF even
+    # with a tokenizer; explicit id -> that id.
+    if args.eosId is None:
+        eos_id = getattr(tokenizer, "eos_id", None)
+    elif str(args.eosId).lower() == "none" or int(args.eosId) < 0:
+        eos_id = None
+    else:
+        eos_id = int(args.eosId)
+
     metrics = ServingMetrics()
     batcher = None
     if args.draftPreset:
@@ -441,20 +523,20 @@ def _main(argv: list[str] | None = None) -> int:
         batcher = SpeculativeBatcher(
             params, cfg, draft_params, draft_cfg,
             n_slots=args.slots, max_len=args.maxLen, gamma=args.gamma,
-            sampler=sampler, eos_id=None if args.eosId < 0 else args.eosId,
+            sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(args.chunkedPrefill, args.maxLen),
             metrics=metrics,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
-        sampler=sampler, eos_id=None if args.eosId < 0 else args.eosId,
+        sampler=sampler, eos_id=eos_id,
         chunked_prefill=args.chunkedPrefill, metrics=metrics,
         batcher=batcher,
     )
     from prometheus_client import REGISTRY
 
     server = InferenceServer(engine, host=args.host, port=args.port,
-                             registry=REGISTRY)
+                             registry=REGISTRY, tokenizer=tokenizer)
 
     async def serve():
         stop = asyncio.Event()

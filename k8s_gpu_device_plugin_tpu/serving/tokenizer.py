@@ -1,0 +1,94 @@
+"""Tokenizer seam for the inference server: text in/out.
+
+The serving API is token-ids at its core (the engine never sees text);
+this seam makes the service deployable to clients that speak text. Any
+object with ``encode(str) -> list[int]`` and ``decode(list[int]) -> str``
+plugs in:
+
+- :class:`HFTokenizer` wraps a HuggingFace tokenizer loaded from a LOCAL
+  directory (a serving pod must not download tokenizers at startup; this
+  environment has no egress either). Optional dependency — imported only
+  when used.
+- :class:`ByteTokenizer` is the dependency-free fallback: UTF-8 bytes as
+  ids. Exact round-trip for any text, works with any model whose vocab
+  is >= 256 — the smoke/load-testing companion to the random-weights
+  server mode.
+
+No reference analogue: the reference is a device-plugin daemon
+(/root/reference/README.md:1-6); tokenization belongs to the serving
+workload surface this framework adds on top.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class TokenizerSeam(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids (vocab 256). Lossless round-trip for ids
+    the tokenizer produced itself; ids >= 256 (a model sampling outside
+    the byte range — random-weights smoke mode does this constantly)
+    decode as U+FFFD REPLACEMENT CHARACTER, one per id, rather than being
+    silently clamped onto a real byte."""
+
+    vocab_size = 256
+    eos_id: int | None = None
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    # byte-level: no special tokens, so stop-string encoding is identical
+    encode_plain = encode
+
+    def decode(self, ids: list[int]) -> str:
+        out: list[str] = []
+        run: list[int] = []  # contiguous valid bytes, decoded together
+        for i in ids:
+            if 0 <= int(i) < 256:
+                run.append(int(i))
+                continue
+            out.append(bytes(run).decode("utf-8", errors="replace"))
+            run = []
+            out.append("�")
+        out.append(bytes(run).decode("utf-8", errors="replace"))
+        return "".join(out)
+
+
+class HFTokenizer:
+    """HuggingFace tokenizer from a local path (transformers is baked in;
+    the path must already contain tokenizer files — no hub download)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.eos_id: int | None = self._tok.eos_token_id
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._tok.encode(text, add_special_tokens=True))
+
+    def encode_plain(self, text: str) -> list[int]:
+        """No special tokens: for stop strings, which must match a run of
+        GENERATED output — a prepended BOS would make the stop sequence
+        unmatchable and silently never fire."""
+        return list(self._tok.encode(text, add_special_tokens=False))
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(spec: str) -> TokenizerSeam | None:
+    """CLI knob: "" -> None (token-id API only), "byte" -> ByteTokenizer,
+    anything else -> local HF tokenizer directory."""
+    if not spec:
+        return None
+    if spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

@@ -45,12 +45,14 @@ def _oracle(params, prompt, cfg, max_new):
     return np.asarray(out)[0].tolist()
 
 
-async def _with_server(setup, body, **engine_kw):
+async def _with_server(setup, body, tokenizer=None, **engine_kw):
     cfg, params = setup
     engine = InferenceEngine(
         params, cfg, n_slots=2, max_len=64, chunked_prefill=8, **engine_kw
     )
-    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    server = InferenceServer(
+        engine, host="127.0.0.1", port=0, tokenizer=tokenizer
+    )
     stop = asyncio.Event()
     task = asyncio.create_task(server.run(stop))
     for _ in range(100):
@@ -338,3 +340,130 @@ def test_logprobs_in_api_responses(setup):
             assert all("logprob" in e for e in events[:-1])
 
     run(_with_server(setup, body))
+
+
+def test_text_api_end_to_end(setup):
+    """Tokenizer seam: text in -> encoded prompt -> decoded text out, with
+    token-level parity against the id path; streaming closes with the
+    decoded text; stop_text retires like encoded stop; text without a
+    tokenizer is a clean 400."""
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import ByteTokenizer
+
+    cfg, params = setup
+    tok = ByteTokenizer()
+    text = "Hello TPU"
+    ids = tok.encode(text)
+    oracle = _oracle(params, ids, cfg, 5)
+    want_text = tok.decode(oracle)
+
+    async def body(session, base):
+        # text request == id request, decoded
+        async with session.post(f"{base}/v1/generate", json={
+            "text": text, "max_new": 5,
+        }) as r:
+            assert r.status == 200
+            d = await r.json()
+            assert d["tokens"] == oracle
+            assert d["text"] == want_text
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": ids, "max_new": 5,
+        }) as r:
+            assert (await r.json())["tokens"] == oracle
+
+        # streaming: per-token events, decoded text on the closing event
+        async with session.post(f"{base}/v1/generate", json={
+            "text": text, "max_new": 5, "stream": True,
+        }) as r:
+            events = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+            assert [e["token"] for e in events[:-1]] == oracle
+            assert events[-1]["done"] is True
+            assert events[-1]["text"] == want_text
+
+        # stop_text: the first generated token as a stop string retires
+        # the request right after emitting it (tokens kept, like EOS)
+        stop_str = tok.decode([oracle[0]])
+        if tok.encode(stop_str) == [oracle[0]]:  # decodable byte only
+            async with session.post(f"{base}/v1/generate", json={
+                "text": text, "max_new": 5, "stop_text": [stop_str],
+            }) as r:
+                d = await r.json()
+                assert d["tokens"] == oracle[:1]
+
+        # n > 1 greedy: identical completions, all decoded
+        async with session.post(f"{base}/v1/generate", json={
+            "text": text, "max_new": 4, "n": 2,
+        }) as r:
+            d = await r.json()
+            assert d["completions_text"] == [d["text"]] * 2
+
+        # both text and prompt is an error
+        async with session.post(f"{base}/v1/generate", json={
+            "text": text, "prompt": ids, "max_new": 2,
+        }) as r:
+            assert r.status == 400
+
+    run(_with_server(setup, body, tokenizer=tok))
+
+
+def test_text_request_without_tokenizer_is_400(setup):
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "text": "hi", "max_new": 2,
+        }) as r:
+            assert r.status == 400
+            assert "tokenizer" in (await r.json())["error"]
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": [1, 2], "max_new": 2, "stop_text": ["x"],
+        }) as r:
+            assert r.status == 400
+
+    run(_with_server(setup, body))
+
+
+def test_byte_tokenizer_roundtrip():
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import (
+        ByteTokenizer,
+        load_tokenizer,
+    )
+
+    tok = ByteTokenizer()
+    for s in ("hello", "héllo ✓", ""):
+        assert tok.decode(tok.encode(s)) == s
+    assert all(0 <= i < 256 for i in tok.encode("héllo ✓"))
+    assert load_tokenizer("") is None
+    assert isinstance(load_tokenizer("byte"), ByteTokenizer)
+
+
+def test_byte_tokenizer_out_of_range_ids_become_replacement_chars():
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    # valid bytes round-trip even when interleaved with invalid ids; each
+    # out-of-range id is one U+FFFD, never clamped onto a real byte
+    assert tok.decode([104, 105, 300, 104]) == "hi�h"
+    assert tok.decode([500, 501]) == "��"
+    assert tok.decode(list("hé".encode())) == "hé"  # multi-byte run intact
+
+
+def test_hf_stop_encoding_uses_no_special_tokens():
+    """encode_plain (the stop-string path) must not prepend BOS — a BOS'd
+    stop sequence can never match generated output. Verified against the
+    seam contract with a fake that mimics HF add_special_tokens."""
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import ByteTokenizer
+
+    class BosTokenizer(ByteTokenizer):
+        BOS = 999
+
+        def encode(self, text):
+            return [self.BOS] + super().encode(text)
+
+        def encode_plain(self, text):
+            return ByteTokenizer.encode(self, text)
+
+    tok = BosTokenizer()
+    assert tok.encode("ab")[0] == tok.BOS
+    assert tok.encode_plain("ab") == [97, 98]
