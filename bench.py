@@ -90,7 +90,12 @@ def _run_child(workload: str, timeout: float, platforms: str | None) -> dict:
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
-            return json.loads(line)
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                # child killed mid-print (wedge/OOM): a truncated line is
+                # a failed attempt, not a reason to abort the whole run
+                break
     raise RuntimeError(f"no JSON line from {workload} runner (rc={proc.returncode})")
 
 

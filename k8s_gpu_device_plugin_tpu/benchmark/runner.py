@@ -64,6 +64,64 @@ def _run_probe() -> dict:
     return {"workload": "probe", "device_kind": device.device_kind}
 
 
+def _run_usage_live() -> dict:
+    """Validate LibtpuUsageReader against a REAL runtime (the monitoring
+    promise the reference leaves empty, /root/reference/metrics/metrics.go:1):
+    this process IS the workload — it burns the MXU in a thread while
+    scraping the libtpu runtime-metrics service (port 8431 / env) from the
+    same host, exactly the way the daemon's health assessor and /metrics
+    gauges would. Records gauge samples, or their absence, honestly."""
+    import threading
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import (
+        LibtpuUsageReader,
+    )
+
+    device = _require_accelerator()
+    stop = threading.Event()
+
+    def burn() -> None:
+        x = jnp.ones((2048, 2048), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        f(x).block_until_ready()  # compile before the loop
+        while not stop.is_set():
+            f(x).block_until_ready()
+
+    thread = threading.Thread(target=burn, daemon=True)
+    thread.start()
+    reader = LibtpuUsageReader()
+    samples: list[dict] = []
+    status = "absent"
+    try:
+        for _ in range(10):
+            _time.sleep(1.0)
+            usages, status = reader.read_status()
+            if usages:
+                samples.append({
+                    str(dev): {
+                        "hbm_used_bytes": u.hbm_used_bytes,
+                        "duty_cycle_percent": u.duty_cycle_percent,
+                        "tensorcore_utilization": u.tensorcore_utilization,
+                    }
+                    for dev, u in usages.items()
+                })
+    finally:
+        stop.set()
+        thread.join(10)
+        reader.close()
+    return {
+        "workload": "usage_live",
+        "device_kind": device.device_kind,
+        "endpoint_status": status,
+        "scrapes_with_data": len(samples),
+        "samples": samples[-3:],
+    }
+
+
 def _run_matmul() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import matmul_mfu
 
@@ -350,6 +408,7 @@ def _run_allocated() -> dict:
 
 WORKLOADS = {
     "probe": _run_probe,
+    "usage_live": _run_usage_live,
     "matmul": _run_matmul,
     "train": _run_train,
     "train_int8": _run_train_int8,

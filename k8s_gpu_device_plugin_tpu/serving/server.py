@@ -323,9 +323,16 @@ class InferenceServer:
                 enc_stop = getattr(
                     self.tokenizer, "encode_plain", self.tokenizer.encode
                 )
-                stop = list(stop) + [
-                    enc for enc in (enc_stop(s) for s in stop_text) if enc
-                ]
+                stop = list(stop)
+                for s in stop_text:
+                    enc = enc_stop(s)
+                    if not enc:
+                        # silently dropping it would leave the client
+                        # believing the stop is armed
+                        raise ValueError(
+                            f"stop_text entry {s!r} encodes to no tokens"
+                        )
+                    stop.append(enc)
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
@@ -455,7 +462,19 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--maxLen", type=int, default=2048)
     parser.add_argument("--chunkedPrefill", type=int, default=256)
-    parser.add_argument("--eosId", default=None,
+    def _eos_arg(value: str):
+        """'none' or a negative int -> EOS stopping OFF; an id -> that id.
+        Keeps argparse's clean usage error for garbage like '1.5'."""
+        if value.lower() == "none":
+            return "none"
+        try:
+            return int(value)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an integer or 'none', got {value!r}"
+            ) from None
+
+    parser.add_argument("--eosId", type=_eos_arg, default=None,
                         help="EOS token id; unset adopts the tokenizer's "
                         "eos when --tokenizer is given; 'none' (or -1) "
                         "explicitly disables EOS stopping")

@@ -467,3 +467,22 @@ def test_hf_stop_encoding_uses_no_special_tokens():
     tok = BosTokenizer()
     assert tok.encode("ab")[0] == tok.BOS
     assert tok.encode_plain("ab") == [97, 98]
+
+
+def test_stop_text_encoding_to_nothing_is_400(setup):
+    """A stop_text entry the tokenizer normalizes away must be a 400, not
+    a silently-disarmed stop."""
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import ByteTokenizer
+
+    class StrippingTokenizer(ByteTokenizer):
+        def encode_plain(self, text):
+            return ByteTokenizer.encode(self, text.strip())
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "text": "hi", "max_new": 2, "stop_text": ["   "],
+        }) as r:
+            assert r.status == 400
+            assert "encodes to no tokens" in (await r.json())["error"]
+
+    run(_with_server(setup, body, tokenizer=StrippingTokenizer()))
