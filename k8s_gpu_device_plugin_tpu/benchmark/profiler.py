@@ -5,8 +5,15 @@ self-profiler: ``Run`` (54-89) started a CPU profile and set memory/block/
 mutex sample rates, ``Stop`` (92-124) flushed ``cpu.prof``/``mem.prof``/
 ``block.prof``/``mutex.prof`` to a temp dir. Zero device interaction.
 
-Python equivalents: cProfile for CPU, tracemalloc for allocations. Real
-device benchmarks live in benchmark/workloads (the north-star rewrite).
+Python equivalents: cProfile for CPU, tracemalloc for allocations, and a
+``block.prof`` analogue fit for an asyncio daemon (benchmark.go:74-85
+metered goroutine blocking; here the scarce resource is the EVENT LOOP and
+the shared-thread locks): a sampler thread periodically records
+(1) event-loop scheduling lag — how late a zero-delay callback fires, the
+asyncio equivalent of "blocked" time — and (2) stacks of threads parked in
+lock acquisition (``Lock.acquire``/``Condition.wait`` frames), tallied per
+call site like a mutex profile. Real device benchmarks live in
+benchmark/workloads (the north-star rewrite).
 """
 
 from __future__ import annotations
@@ -14,23 +21,163 @@ from __future__ import annotations
 import cProfile
 import logging
 import os
+import sys
 import tempfile
+import threading
+import time
 import tracemalloc
+from collections import Counter, deque
 
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
 # ≙ MemProfileRate 64KiB (benchmark.go:71): sample every N bytes.
 TRACEMALLOC_FRAMES = 16
+# ≙ SetBlockProfileRate(20)/SetMutexProfileFraction(20) (benchmark.go:78,85):
+# sampling cadence for loop-lag and lock-wait stacks.
+BLOCK_SAMPLE_SECONDS = 0.05
+# Functions whose presence at the top of a stack marks a blocked thread.
+# Only pure-Python wait paths are observable (Event.wait, Condition.wait,
+# Queue.get, Thread.join — the synchronization this codebase actually
+# uses): a raw C-level Lock.acquire blocks inside the interpreter with no
+# Python frame to sample, the CPython analogue of pprof's own caveat that
+# mutex profiling needs runtime cooperation.
+_WAIT_FUNCTIONS = frozenset({"acquire", "wait", "_wait_for_tstate_lock", "get"})
+_WAIT_FILES = ("threading.py", "queue.py")
+
+
+class BlockSampler:
+    """The ``block.prof``/``mutex.prof`` analogue (benchmark.go:74-85).
+
+    A daemon thread samples every ``interval`` seconds:
+
+    - **loop lag**: an asyncio loop (registered via :meth:`watch_loop`)
+      gets a zero-delay ``call_soon_threadsafe`` timestamp probe; the gap
+      between scheduling and execution is how long the loop was blocked —
+      the single scarcest resource in this daemon.
+    - **lock waits**: ``sys._current_frames()`` stacks whose top frame is
+      a lock/condition wait are tallied by call site, giving the same
+      "where do threads contend" answer a mutex profile gives.
+    """
+
+    #: lag-probe history cap: a deque window (~17 min at 20 Hz) keeps a
+    #: days-long benchmark-mode daemon at constant memory; count and max
+    #: survive across the whole run regardless.
+    LAG_WINDOW = 20_000
+
+    def __init__(self, interval: float = BLOCK_SAMPLE_SECONDS) -> None:
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._loop = None
+        self._pending_probe_t: float | None = None
+        self.samples = 0
+        self.lock_waits: Counter[str] = Counter()
+        self.loop_lags: deque[float] = deque(maxlen=self.LAG_WINDOW)
+        self.lag_count = 0       # probes landed over the whole run
+        self.lag_max = 0.0       # worst lag ever, window or not
+
+    def watch_loop(self, loop) -> None:
+        """Register the asyncio loop whose scheduling lag to measure."""
+        self._loop = loop
+
+    def start(self) -> None:
+        # restartable: Profiler.run()/stop() may cycle more than once
+        self._stop.clear()
+        self._pending_probe_t = None
+        self._thread = threading.Thread(
+            target=self._run, name="block-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+
+    def _probe_loop_lag(self) -> None:
+        loop = self._loop
+        if loop is None or loop.is_closed() or self._pending_probe_t is not None:
+            return
+        sent = time.monotonic()
+        self._pending_probe_t = sent
+
+        def landed() -> None:
+            lag = time.monotonic() - sent
+            self.loop_lags.append(lag)
+            self.lag_count += 1
+            if lag > self.lag_max:
+                self.lag_max = lag
+            self._pending_probe_t = None
+
+        try:
+            loop.call_soon_threadsafe(landed)
+        except RuntimeError:  # loop shut down between checks
+            self._pending_probe_t = None
+
+    def _sample_lock_waits(self) -> None:
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            if frame.f_code.co_name not in _WAIT_FUNCTIONS:
+                continue
+            if not frame.f_code.co_filename.endswith(_WAIT_FILES):
+                continue
+            # attribute the wait to the first caller OUTSIDE the stdlib
+            # synchronization modules (Event.wait -> Condition.wait ->
+            # acquire is three library frames deep)
+            caller = frame
+            while caller.f_back is not None and caller.f_code.co_filename.endswith(
+                _WAIT_FILES
+            ):
+                caller = caller.f_back
+            site = (
+                f"{caller.f_code.co_filename}:{caller.f_lineno} "
+                f"({caller.f_code.co_name})"
+            )
+            self.lock_waits[site] += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.samples += 1
+            self._probe_loop_lag()
+            self._sample_lock_waits()
+
+    def report(self) -> str:
+        lags = sorted(self.loop_lags)
+
+        def pct(p: float) -> float:
+            return lags[min(len(lags) - 1, int(p * len(lags)))] if lags else 0.0
+
+        lines = [
+            f"samples: {self.samples} (every {self._interval * 1000:.0f}ms)",
+            f"loop lag: n={self.lag_count} "
+            f"(percentiles over last {len(lags)}) "
+            f"p50={pct(0.5) * 1e3:.2f}ms p99={pct(0.99) * 1e3:.2f}ms "
+            f"max={self.lag_max * 1e3:.2f}ms",
+            "lock waits by site (samples observed blocked):",
+        ]
+        for site, count in self.lock_waits.most_common(50):
+            lines.append(f"  {count:6d}  {site}")
+        if not self.lock_waits:
+            lines.append("  (none observed)")
+        return "\n".join(lines) + "\n"
 
 
 class Profiler:
-    """Start/stop CPU + allocation profiling, writing into a profile dir."""
+    """Start/stop CPU + allocation + blocking profiling (profile dir)."""
 
     def __init__(self, logger: logging.Logger | None = None, out_dir: str | None = None) -> None:
         self.log = logger or get_logger()
         self.out_dir = out_dir or tempfile.mkdtemp(prefix="tpu-plugin-prof-")
         self._cpu = cProfile.Profile()
+        self._block = BlockSampler()
         self._running = False
+
+    def watch_loop(self, loop) -> None:
+        """Measure this asyncio loop's scheduling lag while profiling."""
+        self._block.watch_loop(loop)
 
     def run(self) -> None:
         """Begin profiling (≙ Benchmark.Run, benchmark.go:54-89)."""
@@ -39,6 +186,7 @@ class Profiler:
         os.makedirs(self.out_dir, exist_ok=True)
         self._cpu.enable()
         tracemalloc.start(TRACEMALLOC_FRAMES)
+        self._block.start()
         self._running = True
         self.log.info(
             "profiling started", extra={"fields": {"out_dir": self.out_dir}}
@@ -49,6 +197,7 @@ class Profiler:
         if not self._running:
             return {}
         self._cpu.disable()
+        self._block.stop()
         cpu_path = os.path.join(self.out_dir, "cpu.prof")
         self._cpu.dump_stats(cpu_path)
 
@@ -58,9 +207,15 @@ class Profiler:
         with open(mem_path, "w") as f:
             for stat in snapshot.statistics("lineno")[:200]:
                 f.write(f"{stat}\n")
+
+        block_path = os.path.join(self.out_dir, "block.prof")
+        with open(block_path, "w") as f:
+            f.write(self._block.report())
         self._running = False
         self.log.info(
             "profiling stopped",
-            extra={"fields": {"cpu": cpu_path, "mem": mem_path}},
+            extra={"fields": {
+                "cpu": cpu_path, "mem": mem_path, "block": block_path,
+            }},
         )
-        return {"cpu": cpu_path, "mem": mem_path}
+        return {"cpu": cpu_path, "mem": mem_path, "block": block_path}

@@ -50,6 +50,8 @@ async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> No
     profiler: Profiler | None = None
     if cfg.benchmark:  # ≙ main.go:141-154
         profiler = Profiler(logger)
+        # block.prof analogue: meter THIS loop's scheduling lag
+        profiler.watch_loop(loop)
         profiler.run()
 
     ready = Latch()

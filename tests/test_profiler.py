@@ -1,0 +1,102 @@
+"""Daemon self-profiler: the block.prof/mutex.prof analogue
+(≙ /root/reference/benchmark/benchmark.go:74-85) plus the cpu/mem flush.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+
+from k8s_gpu_device_plugin_tpu.benchmark.profiler import BlockSampler, Profiler
+
+
+def test_block_sampler_measures_loop_lag():
+    """A deliberately blocked event loop shows up as scheduling lag."""
+    sampler = BlockSampler(interval=0.02)
+
+    async def body():
+        sampler.watch_loop(asyncio.get_running_loop())
+        sampler.start()
+        await asyncio.sleep(0.1)   # healthy: probes land fast
+        time.sleep(0.3)            # block the loop (the sin being metered)
+        await asyncio.sleep(0.1)
+        sampler.stop()
+
+    asyncio.run(body())
+    assert sampler.samples > 0
+    assert sampler.loop_lags, "no probes landed"
+    assert max(sampler.loop_lags) >= 0.15, sampler.loop_lags
+    assert min(sampler.loop_lags) < 0.05, sampler.loop_lags
+    assert "loop lag" in sampler.report()
+
+
+def test_block_sampler_tallies_lock_waits():
+    """A thread parked in a pure-Python wait (Event.wait — the
+    synchronization the daemon's threads actually use) is attributed to
+    ITS call site, not to threading.py internals. Raw C-level
+    Lock.acquire is unobservable by design (no Python frame exists while
+    it blocks), mirroring pprof's need for runtime cooperation."""
+    sampler = BlockSampler(interval=0.02)
+    gate = threading.Event()
+    done = threading.Event()
+
+    def contender():
+        gate.wait()  # blocks until the main thread sets it
+        done.set()
+
+    thread = threading.Thread(target=contender, daemon=True)
+    sampler.start()
+    thread.start()
+    time.sleep(0.3)  # let the sampler observe the blocked thread
+    gate.set()
+    assert done.wait(5)
+    sampler.stop()
+    thread.join(5)
+
+    assert sampler.lock_waits, "no lock waits observed"
+    assert any("contender" in site for site in sampler.lock_waits), (
+        dict(sampler.lock_waits)
+    )
+    assert "contender" in sampler.report()
+
+
+def test_profiler_flushes_all_three_profiles(tmp_path):
+    profiler = Profiler(out_dir=str(tmp_path))
+
+    async def body():
+        profiler.watch_loop(asyncio.get_running_loop())
+        profiler.run()
+        await asyncio.sleep(0.15)
+        paths = profiler.stop()
+        return paths
+
+    paths = asyncio.run(body())
+    assert set(paths) == {"cpu", "mem", "block"}
+    for p in paths.values():
+        assert os.path.exists(p), p
+    with open(paths["block"]) as f:
+        text = f.read()
+    assert "loop lag" in text and "samples:" in text
+    # idempotent stop
+    assert profiler.stop() == {}
+
+
+def test_block_sampler_restartable():
+    """A second run()/stop() cycle must actually sample again (the stop
+    event is cleared on start), and the lag window stays bounded."""
+    sampler = BlockSampler(interval=0.01)
+
+    async def burst():
+        sampler.watch_loop(asyncio.get_running_loop())
+        sampler.start()
+        await asyncio.sleep(0.1)
+        sampler.stop()
+
+    asyncio.run(burst())
+    first = sampler.samples
+    assert first > 0
+    asyncio.run(burst())
+    assert sampler.samples > first, "second start() never sampled"
+    assert sampler.loop_lags.maxlen == BlockSampler.LAG_WINDOW

@@ -127,7 +127,9 @@ def main() -> int:
             log(f"{workload}: OK {json.dumps(result)[:300]}")
             continue
         # failure: one retry if the chip still answers, else stop the run
-        if not probe():
+        # (cycle every platform fallback, same as the startup gate — a
+        # pinned-name flake must not abandon the rest of the window)
+        if not (probe(0) or probe(1) or probe(2)):
             log("chip wedged mid-harvest — stopping (results are journaled)")
             break
         log(f"{workload}: chip still live, one retry")
