@@ -110,15 +110,18 @@ def main() -> int:
     queue = [(w, t) for w, t in QUEUE if not only or w in only]
 
     log(f"probing chip (queue: {[w for w, _ in queue]})")
-    if not (probe(0) or probe(1) or probe(2)):  # cycle platform fallbacks
+    # remember WHICH platform fallback answered: workloads and retries run
+    # on the platform the chip actually speaks, not a fixed guess
+    live_attempt = next((i for i in range(3) if probe(i)), None)
+    if live_attempt is None:
         log("chip is NOT live — aborting before the queue")
         return 1
-    log("chip live; harvesting")
+    log(f"chip live (platform fallback #{live_attempt}); harvesting")
 
     done = 0
     for workload, timeout in queue:
         log(f"=== {workload} (timeout {timeout:.0f}s) ===")
-        result = run_child(workload, timeout)
+        result = run_child(workload, timeout, attempt=live_attempt)
         if result is not None and "error" in result:
             log(f"{workload}: runner error: {result['error']}")
         persist(workload, result)
@@ -126,14 +129,17 @@ def main() -> int:
             done += 1
             log(f"{workload}: OK {json.dumps(result)[:300]}")
             continue
-        # failure: one retry if the chip still answers, else stop the run
-        # (cycle every platform fallback, same as the startup gate — a
-        # pinned-name flake must not abandon the rest of the window)
-        if not (probe(0) or probe(1) or probe(2)):
+        # failure: one retry if the chip still answers, else stop the run.
+        # The re-probe cycles every platform fallback and the retry uses
+        # whichever one answered — a pinned-name flake must not abandon
+        # (or silently mis-retry) the rest of the window.
+        found = next((i for i in range(3) if probe(i)), None)
+        if found is None:
             log("chip wedged mid-harvest — stopping (results are journaled)")
             break
-        log(f"{workload}: chip still live, one retry")
-        result = run_child(workload, timeout, attempt=1)
+        live_attempt = found
+        log(f"{workload}: chip still live (fallback #{found}), one retry")
+        result = run_child(workload, timeout, attempt=live_attempt)
         persist(workload, result)
         if result is not None and "error" not in result:
             done += 1
