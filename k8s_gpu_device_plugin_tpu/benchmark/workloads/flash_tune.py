@@ -21,7 +21,11 @@ import jax.numpy as jnp
 from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
     _time_scalar_fn,
 )
-from k8s_gpu_device_plugin_tpu.ops.flash_attention import flash_attention
+from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    flash_attention,
+)
 
 
 @dataclass(frozen=True)
@@ -90,14 +94,17 @@ def flash_tune(
             fwd_ms[label] = f"error: {type(e).__name__}"
             print(f"flash_tune: fwd {label} failed: {e}", file=sys.stderr)
 
-        # fwd+bwd with FIXED (default) fwd tiling: isolates the backward
-        # tiling's effect. Grads wrt ALL of q/k/v — dq and dk/dv are two
-        # separate Pallas kernels; grad-wrt-q-only would let XLA DCE the
-        # dkv kernel, the very one the sweep exists to tune.
+        # fwd+bwd with FIXED (default-constant) fwd tiling: isolates the
+        # backward tiling's effect. Pinned EXPLICITLY — a None fwd block
+        # would resolve from the tilings file, making bwd numbers depend
+        # on whatever a previous sweep persisted. Grads wrt ALL of q/k/v —
+        # dq and dk/dv are two separate Pallas kernels; grad-wrt-q-only
+        # would let XLA DCE the dkv kernel, the very one the sweep tunes.
         def bwd_scalar(q, k, v, do, _bq=bq, _bk=bk):
             def one(q, k, v):
                 o = flash_attention(
                     q, k, v, causal=True,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     block_q_bwd=_bq, block_k_bwd=_bk,
                 )
                 return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32))

@@ -28,6 +28,8 @@ on CPU against the reference oracle.
 from __future__ import annotations
 
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +54,83 @@ DEFAULT_BLOCK_K = 1024
 DEFAULT_BLOCK_Q_BWD = 1024
 DEFAULT_BLOCK_K_BWD = 1024
 _NEG_BIG = -1e30
+
+#: Measured-tilings file: flash_tune WRITES the winning (block_q, block_k)
+#: per direction+seq here so every later run in the same hardware window —
+#: train bench included — picks them up automatically instead of waiting
+#: for a human to copy sweep output into the constants above. JSON:
+#: {"fwd:2048": [bq, bk], "bwd:2048": [bq, bk], ...}. Override the path
+#: with FLASH_TUNING_FILE; explicit block args always win over the file.
+TUNING_FILE_ENV = "FLASH_TUNING_FILE"
+_DEFAULT_TUNING_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".flash_tilings.json",
+)
+
+
+def tuning_file_path() -> str:
+    return os.environ.get(TUNING_FILE_ENV) or _DEFAULT_TUNING_FILE
+
+
+@functools.lru_cache(maxsize=1)
+def _tuned_blocks() -> dict:
+    """Measured tilings, loaded once per process ({} when absent/bad)."""
+    try:
+        with open(tuning_file_path()) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out = {}
+    for key, val in data.items():
+        if (
+            isinstance(val, (list, tuple)) and len(val) == 2
+            and all(isinstance(b, int) and b > 0 for b in val)
+        ):
+            out[key] = (int(val[0]), int(val[1]))
+    return out
+
+
+def _resolve_blocks(direction: str, s: int) -> tuple[int, int] | None:
+    """(bq, bk) measured for this direction at this exact seq len, else
+    the nearest measured seq <= s (tilings grow with S; a shorter-seq
+    winner is a safe under-estimate), else None."""
+    tuned = _tuned_blocks()
+    exact = tuned.get(f"{direction}:{s}")
+    if exact is not None:
+        return exact
+    best_s = -1
+    best = None
+    for key, val in tuned.items():
+        d, _, ks = key.partition(":")
+        if d != direction or not ks.isdigit():
+            continue
+        ks_i = int(ks)
+        if best_s < ks_i <= s:
+            best_s, best = ks_i, val
+    return best
+
+
+def record_tuned_blocks(entries: dict) -> str:
+    """Merge ``{"fwd:2048": (1024, 512), ...}`` into the tilings file
+    (flash_tune calls this after a sweep); returns the path written, or
+    "" when the write failed — a failed persist must not void the
+    ~15-minute sweep whose results it is recording."""
+    path = tuning_file_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    data.update({k: list(v) for k, v in entries.items()})
+    try:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+    except OSError:
+        return ""
+    _tuned_blocks.cache_clear()
+    return path
 
 
 def supports(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
@@ -547,8 +626,8 @@ def flash_attention(
     causal: bool = True,
     scale: float | None = None,
     window: int = 0,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     block_q_bwd: int | None = None,
     block_k_bwd: int | None = None,
     interpret: bool = False,
@@ -568,6 +647,11 @@ def flash_attention(
     of the forward (None = tuned defaults); the backward holds more VMEM
     operands per cell, so its optimum differs.
 
+    Block resolution when an argument is None: measured tilings from the
+    flash_tune sweep file (see ``tuning_file_path``) at this seq length —
+    the sweep's winners apply to every later run in the same hardware
+    window automatically — else the module DEFAULT_* constants.
+
     Raises on shapes the kernel cannot tile (the grid drops tail rows, so a
     silent fallthrough would return uninitialized output): use
     ``ops.attention.attention`` for automatic XLA fallback.
@@ -576,10 +660,18 @@ def flash_attention(
     if window > 0 and not causal:
         raise ValueError("sliding window requires causal attention")
     s = q.shape[1]
-    block_q = _fit_block(block_q, s)
-    block_k = _fit_block(block_k, s)
-    bq_bwd = _fit_block(block_q_bwd or DEFAULT_BLOCK_Q_BWD, s)
-    bk_bwd = _fit_block(block_k_bwd or DEFAULT_BLOCK_K_BWD, s)
+    fwd_tuned = _resolve_blocks("fwd", s) or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    bwd_tuned = _resolve_blocks("bwd", s) or (
+        DEFAULT_BLOCK_Q_BWD, DEFAULT_BLOCK_K_BWD
+    )
+    block_q = _fit_block(block_q if block_q is not None else fwd_tuned[0], s)
+    block_k = _fit_block(block_k if block_k is not None else fwd_tuned[1], s)
+    bq_bwd = _fit_block(
+        block_q_bwd if block_q_bwd is not None else bwd_tuned[0], s
+    )
+    bk_bwd = _fit_block(
+        block_k_bwd if block_k_bwd is not None else bwd_tuned[1], s
+    )
     if s % block_q != 0 or s % block_k != 0:
         raise ValueError(
             f"flash_attention: seq_len {s} not divisible by blocks "

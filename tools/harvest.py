@@ -47,6 +47,10 @@ QUEUE: list[tuple[str, float]] = [
     ("train", 480),           # the headline: train MFU vs 54.65 record
     ("allocated", 600),       # n=4096 parity through Allocate (verdict #2)
     ("flash_tune", 900),      # backward flash tilings (the 55->83 lever)
+    # train again AFTER the sweep: flash_tune persists its winners to the
+    # tilings file and flash_attention resolves them automatically, so
+    # this row measures the tuned payoff against the baseline train row
+    ("train", 480),
     ("breakdown", 600),       # step-time attribution orders the levers
     ("breakdown_attn", 600),
     ("train_fusedopt", 480),  # fused AdamW: may carry the primary
@@ -107,7 +111,34 @@ def main() -> int:
         print(f"unknown workload(s) {unknown}; queue: {sorted(known)}",
               file=sys.stderr)
         return 2
-    queue = [(w, t) for w, t in QUEUE if not only or w in only]
+    if only:
+        # dedupe by name: QUEUE's repeated train row only means something
+        # with flash_tune in the same invocation; a name filter must not
+        # burn 2x480s on two indistinguishable rows
+        seen: set[str] = set()
+        queue = [
+            (w, t) for w, t in QUEUE
+            if w in only and (w not in seen and not seen.add(w))
+        ]
+    else:
+        queue = list(QUEUE)
+
+    if any(w == "flash_tune" for w, _ in queue):
+        # A sweep will re-measure tilings: archive any stale file so the
+        # BASELINE train row runs on defaults (otherwise the tuned-vs-
+        # baseline comparison silently measures tuned-vs-tuned), while the
+        # .bak preserves the previous window's winners.
+        from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
+            tuning_file_path,
+        )
+
+        tf = tuning_file_path()
+        if os.path.exists(tf):
+            try:
+                os.replace(tf, tf + ".bak")
+                log(f"archived stale tilings {tf} -> .bak (fresh sweep queued)")
+            except OSError as e:
+                log(f"could not archive {tf}: {e}")
 
     log(f"probing chip (queue: {[w for w, _ in queue]})")
     # remember WHICH platform fallback answered: workloads and retries run
