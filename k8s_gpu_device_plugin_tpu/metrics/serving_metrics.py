@@ -40,7 +40,7 @@ class ServingMetrics:
         self.requests_finished = Counter(
             f"{prefix}_requests_finished_total",
             "Requests retired, by reason",
-            ["reason"],  # eos | budget | stop (stop-sequence hit)
+            ["reason"],  # eos | budget | stop (sequence hit) | cancelled
             registry=registry,
         )
         self.prefill_chunks = Counter(

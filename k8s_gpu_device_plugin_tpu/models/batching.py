@@ -426,6 +426,35 @@ class ContinuousBatcher:
         )
         return int(tok), float(logp)
 
+    def cancel(self, rid: int) -> bool:
+        """Retire ``rid`` wherever it lives — pending, mid-prefill, or
+        decoding — freeing its slot for the next admission; tokens
+        generated so far are recorded under ``done``. Returns False for
+        unknown or already-finished rids (idempotent): a serving client
+        that disconnects must not keep its slot decoding to the token
+        budget, and a double-cancel must be harmless."""
+        for i, req in enumerate(self.pending):
+            if req.rid == rid:
+                self.pending.pop(i)
+                self._retire_cancelled(req)
+                return True
+        for mapping in (self.prefilling, self.running):
+            for slot, req in list(mapping.items()):
+                if req.rid == rid:
+                    del mapping[slot]
+                    self._prefill_pos.pop(slot, None)
+                    self._retire_cancelled(req)
+                    return True
+        return False
+
+    def _retire_cancelled(self, req: _Request) -> None:
+        # device state needs no touch: the decode mask is built from
+        # `running` each step, and admission overwrites the slot's rows
+        self.done[req.rid] = req.out
+        self.done_requests[req.rid] = req
+        if self.metrics:
+            self.metrics.on_finish("cancelled")
+
     def _finish_if_done(self, req: _Request) -> None:
         """EOS, a stop sequence, or budget exhaustion retires the request
         and frees its slot. Stop sequences are host-side suffix matches

@@ -88,6 +88,7 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._dead = threading.Event()
         self._subq: list[tuple[int, list[int], int, tuple]] = []
+        self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
         self._rid_to_eid: dict[int, int] = {}
@@ -131,6 +132,14 @@ class InferenceEngine:
         self._work.set()
         return eid, q
 
+    def cancel(self, eid: int) -> None:
+        """Thread-safe: queue a cancellation; the engine thread applies it
+        between steps (a disconnected client must free its slot instead of
+        decoding to the token budget). Unknown/finished eids are no-ops."""
+        with self._lock:
+            self._cancelq.append(eid)
+        self._work.set()
+
     def stats(self) -> dict:
         # approximate cross-thread reads (GIL-consistent lengths)
         with self._lock:
@@ -158,6 +167,36 @@ class InferenceEngine:
                 prompt, max_new=max_new, stop=[list(st) for st in stop]
             )
             self._rid_to_eid[rid] = eid
+
+    def _apply_cancellations(self) -> None:
+        """Runs after admission: a cancel targeting an eid still in the
+        submit queue is removed there; an admitted one goes through
+        ``cb.cancel`` and the normal done-request publish (which closes
+        its stream). Never-admitted streams are closed here."""
+        with self._lock:
+            cancels, self._cancelq = self._cancelq, []
+        if not cancels:
+            return
+        for eid in cancels:
+            with self._lock:
+                before = len(self._subq)
+                self._subq = [s for s in self._subq if s[0] != eid]
+                dropped = len(self._subq) < before
+                stream = self._streams.pop(eid, None) if dropped else None
+                if dropped:
+                    self._published.pop(eid, None)
+            if dropped:
+                if stream is not None:
+                    loop, q = stream
+                    loop.call_soon_threadsafe(q.put_nowait, None)
+                continue
+            rid = next(
+                (r for r, e in self._rid_to_eid.items() if e == eid), None
+            )
+            if rid is not None and self.cb.cancel(rid):
+                # flush now: the batcher may have just gone idle, in which
+                # case the step-loop publish would never run again
+                self._publish()
 
     def _publish(self) -> None:
         """Push newly generated (token, logprob) pairs to their queues."""
@@ -201,6 +240,7 @@ class InferenceEngine:
         try:
             while not self._stop.is_set():
                 self._admit_submissions()
+                self._apply_cancellations()
                 busy = bool(
                     self.cb.pending or self.cb.running or self.cb.prefilling
                 )
@@ -357,7 +397,14 @@ class InferenceServer:
                     toks.append(item[0])
                     lps.append(item[1])
 
-            drained = await asyncio.gather(*(drain(q_) for _, q_ in subs))
+            try:
+                drained = await asyncio.gather(*(drain(q_) for _, q_ in subs))
+            except asyncio.CancelledError:
+                # client gone mid-generation: free the slots instead of
+                # decoding to the token budget
+                for eid_, _ in subs:
+                    self.engine.cancel(eid_)
+                raise
             payload = {"id": rid, "tokens": drained[0][0]}
             if want_logprobs:
                 payload["logprobs"] = drained[0][1]
@@ -379,24 +426,30 @@ class InferenceServer:
         )
         await resp.prepare(request)
         streamed: list[int] = []
-        while True:
-            item = await q.get()
-            if item is None:
-                # closing event carries the full decoded text (incremental
-                # per-token decode is wrong across multi-token characters;
-                # clients wanting text-as-you-go can decode the token
-                # prefix themselves with the same caveat)
-                done: dict = {"done": True}
-                if self.tokenizer is not None:
-                    done["text"] = self.tokenizer.decode(streamed)
-                await resp.write(f"data: {json.dumps(done)}\n\n".encode())
-                break
-            tok, lp = item
-            streamed.append(tok)
-            evt = {"token": tok}
-            if want_logprobs:
-                evt["logprob"] = lp
-            await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    # closing event carries the full decoded text
+                    # (incremental per-token decode is wrong across
+                    # multi-token characters; clients wanting
+                    # text-as-you-go can decode the token prefix
+                    # themselves with the same caveat)
+                    done: dict = {"done": True}
+                    if self.tokenizer is not None:
+                        done["text"] = self.tokenizer.decode(streamed)
+                    await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+                    break
+                tok, lp = item
+                streamed.append(tok)
+                evt = {"token": tok}
+                if want_logprobs:
+                    evt["logprob"] = lp
+                await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+        except (asyncio.CancelledError, ConnectionResetError):
+            # disconnected SSE consumer: free the slot
+            self.engine.cancel(rid)
+            raise
         await resp.write_eof()
         return resp
 

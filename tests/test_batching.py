@@ -488,3 +488,64 @@ def test_logprobs_match_full_context_forward(setup):
         tokens = jnp.concatenate(
             [tokens, jnp.asarray([[tok]], jnp.int32)], axis=1
         )
+
+
+def test_cancel_in_every_state_frees_slot_and_records(setup):
+    """cancel() retires a request from pending, mid-prefill, and decoding;
+    the slot is reusable, neighbors are untouched (token parity with the
+    oracle), tokens-so-far land in done, and metrics count 'cancelled'."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    reg = CollectorRegistry()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=4,
+        metrics=ServingMetrics(registry=reg),
+    )
+
+    # pending: the single slot is busy, second submit queues
+    p1, p2 = _prompt(400, 5, cfg), _prompt(401, 6, cfg)
+    r1 = cb.submit(p1, max_new=4)
+    r2 = cb.submit(p2, max_new=4)
+    assert cb.cancel(r2) is True
+    assert cb.done[r2] == []
+    results = cb.run()
+    assert results[r1] == _oracle(params, p1, cfg, 4)
+
+    # mid-prefill: step until the request is prefilling, then cancel
+    p3 = _prompt(402, 9, cfg)  # 9 tokens = 3 chunks of 4
+    r3 = cb.submit(p3, max_new=4)
+    cb.step()  # admits + first chunk
+    assert cb.prefilling
+    assert cb.cancel(r3) is True
+    assert not cb.prefilling and r3 in cb.done
+
+    # decoding: cancel after a couple of emitted tokens
+    p4 = _prompt(403, 5, cfg)
+    r4 = cb.submit(p4, max_new=8)
+    for _ in range(6):
+        cb.step()
+        if cb.running and cb.done.get(r4) is None and len(
+            next(iter(cb.running.values())).out
+        ) >= 2:
+            break
+    assert cb.cancel(r4) is True
+    got = cb.done[r4]
+    assert 1 <= len(got) < 8
+    assert got == _oracle(params, p4, cfg, 8)[:len(got)]  # prefix parity
+
+    # the slot is reusable after each cancel
+    p5 = _prompt(404, 5, cfg)
+    r5 = cb.submit(p5, max_new=3)
+    assert cb.run()[r5] == _oracle(params, p5, cfg, 3)
+
+    # idempotent: unknown / already-finished rids
+    assert cb.cancel(r4) is False
+    assert cb.cancel(9999) is False
+    assert reg.get_sample_value(
+        "tpu_serving_requests_finished_total", {"reason": "cancelled"}
+    ) == 3

@@ -486,3 +486,73 @@ def test_stop_text_encoding_to_nothing_is_400(setup):
             assert "encodes to no tokens" in (await r.json())["error"]
 
     run(_with_server(setup, body, tokenizer=StrippingTokenizer()))
+
+
+def test_client_disconnect_cancels_request(setup):
+    """An SSE consumer that disconnects mid-stream must free its slot:
+    the engine cancels the request (metrics reason 'cancelled') instead
+    of decoding to the token budget."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    reg = CollectorRegistry()
+    metrics = ServingMetrics(registry=reg)
+    p = _prompt(500, 5, cfg)
+
+    async def body():
+        engine = InferenceEngine(
+            params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+            metrics=metrics,
+        )
+        server = InferenceServer(engine, host="127.0.0.1", port=0)
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{server.bound_port}"
+        try:
+            session = aiohttp.ClientSession()
+            resp = await session.post(f"{base}/v1/generate", json={
+                "prompt": p, "max_new": 50, "stream": True,
+            })
+            got = 0
+            async for line in resp.content:
+                if line.decode().strip().startswith("data: "):
+                    got += 1
+                    if got >= 2:
+                        break
+            await session.close()  # disconnect mid-stream
+
+            def cancelled():
+                return reg.get_sample_value(
+                    "tpu_serving_requests_finished_total",
+                    {"reason": "cancelled"},
+                )
+
+            for _ in range(100):
+                if cancelled() == 1 and not engine.cb.running:
+                    break
+                await asyncio.sleep(0.05)
+            assert cancelled() == 1
+            assert not engine.cb.running and not engine.cb.pending
+
+            # the engine stays fully serviceable afterwards
+            async with aiohttp.ClientSession() as s2:
+                async with s2.post(f"{base}/v1/generate", json={
+                    "prompt": p, "max_new": 3,
+                }) as r:
+                    assert (await r.json())["tokens"] == _oracle(
+                        params, p, cfg, 3
+                    )
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    run(body())
+    metrics.close()

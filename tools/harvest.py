@@ -38,30 +38,31 @@ RESULTS_PATH = os.path.join(REPO_ROOT, "harvest_results.jsonl")
 PROBE_TIMEOUT = 60.0
 TPU_PLATFORMS = (None, "tpu", "")  # same fallback cycle as bench.py
 
-# (workload, timeout_seconds) in harvest-priority order: headline metrics
-# first (train MFU is the driver-recorded number), then the Allocate-path
-# parity proof, the tuning sweeps that order the next optimization, the
-# serving-side economics, and the live-runtime metrics validation.
-QUEUE: list[tuple[str, float]] = [
-    ("matmul", 300),          # 83% ceiling confirmation (BASELINE #2)
-    ("train", 480),           # the headline: train MFU vs 54.65 record
-    ("allocated", 600),       # n=4096 parity through Allocate (verdict #2)
-    ("flash_tune", 900),      # backward flash tilings (the 55->83 lever)
-    # train again AFTER the sweep: flash_tune persists its winners to the
-    # tilings file and flash_attention resolves them automatically, so
-    # this row measures the tuned payoff against the baseline train row
-    ("train", 480),
-    ("breakdown", 600),       # step-time attribution orders the levers
-    ("breakdown_attn", 600),
-    ("train_fusedopt", 480),  # fused AdamW: may carry the primary
-    ("train_int8", 480),      # MXU double-rate path
-    ("opt_tune", 600),
-    ("decode", 420),          # serving economics, never hardware-measured
-    ("decode_int8w", 420),
-    ("decode_int4w", 420),
-    ("serve", 600),
-    ("usage_live", 120),      # LibtpuUsageReader vs the real runtime
-    ("flash_tune_long", 1200),  # S=8192 tilings, most expendable
+# (row name, runner workload, timeout_seconds) in harvest-priority order:
+# headline metrics first (train MFU is the driver-recorded number), then
+# the Allocate-path parity proof, the tuning sweeps that order the next
+# optimization, the serving-side economics, and the live-runtime metrics
+# validation. Row names are what the CLI filter and the journal use; the
+# distinct "train_tuned" row re-times the SAME train workload after
+# flash_tune persisted its winners, measuring the tuned payoff against
+# the baseline row.
+QUEUE: list[tuple[str, str, float]] = [
+    ("matmul", "matmul", 300),        # 83% ceiling check (BASELINE #2)
+    ("train", "train", 480),          # headline: train MFU vs 54.65 record
+    ("allocated", "allocated", 600),  # n=4096 parity through Allocate
+    ("flash_tune", "flash_tune", 900),  # backward tilings (55->83 lever)
+    ("train_tuned", "train", 480),    # tuned payoff vs the baseline row
+    ("breakdown", "breakdown", 600),  # step-time attribution
+    ("breakdown_attn", "breakdown_attn", 600),
+    ("train_fusedopt", "train_fusedopt", 480),  # fused AdamW
+    ("train_int8", "train_int8", 480),          # MXU double-rate path
+    ("opt_tune", "opt_tune", 600),
+    ("decode", "decode", 420),        # serving economics, never on hw
+    ("decode_int8w", "decode_int8w", 420),
+    ("decode_int4w", "decode_int4w", 420),
+    ("serve", "serve", 600),
+    ("usage_live", "usage_live", 120),  # reader vs the real runtime
+    ("flash_tune_long", "flash_tune_long", 1200),  # S=8192, expendable
 ]
 
 _T0 = time.monotonic()
@@ -95,6 +96,20 @@ def persist(workload: str, result: dict | None) -> None:
         log(f"persist failed: {e}")
 
 
+def _archive_tilings() -> None:
+    from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
+        tuning_file_path,
+    )
+
+    tf = tuning_file_path()
+    if os.path.exists(tf):
+        try:
+            os.replace(tf, tf + ".bak")
+            log(f"archived stale tilings {tf} -> .bak (sweep will remeasure)")
+        except OSError as e:
+            log(f"could not archive {tf}: {e}")
+
+
 def probe(attempt: int = 0) -> bool:
     result = run_child("probe", PROBE_TIMEOUT, attempt)
     # a runner child reports failures as {"error": ...} with rc!=0 — a
@@ -104,43 +119,16 @@ def probe(attempt: int = 0) -> bool:
 
 def main() -> int:
     only = sys.argv[1:]
-    known = {w for w, _ in QUEUE}
+    known = {name for name, _, _ in QUEUE}
     unknown = [w for w in only if w not in known]
     if unknown:
         # a typo must not silently skip the queue's headline measurements
-        print(f"unknown workload(s) {unknown}; queue: {sorted(known)}",
+        print(f"unknown row(s) {unknown}; queue: {sorted(known)}",
               file=sys.stderr)
         return 2
-    if only:
-        # dedupe by name: QUEUE's repeated train row only means something
-        # with flash_tune in the same invocation; a name filter must not
-        # burn 2x480s on two indistinguishable rows
-        seen: set[str] = set()
-        queue = [
-            (w, t) for w, t in QUEUE
-            if w in only and (w not in seen and not seen.add(w))
-        ]
-    else:
-        queue = list(QUEUE)
+    queue = [row for row in QUEUE if not only or row[0] in only]
 
-    if any(w == "flash_tune" for w, _ in queue):
-        # A sweep will re-measure tilings: archive any stale file so the
-        # BASELINE train row runs on defaults (otherwise the tuned-vs-
-        # baseline comparison silently measures tuned-vs-tuned), while the
-        # .bak preserves the previous window's winners.
-        from k8s_gpu_device_plugin_tpu.ops.flash_attention import (
-            tuning_file_path,
-        )
-
-        tf = tuning_file_path()
-        if os.path.exists(tf):
-            try:
-                os.replace(tf, tf + ".bak")
-                log(f"archived stale tilings {tf} -> .bak (fresh sweep queued)")
-            except OSError as e:
-                log(f"could not archive {tf}: {e}")
-
-    log(f"probing chip (queue: {[w for w, _ in queue]})")
+    log(f"probing chip (queue: {[name for name, _, _ in queue]})")
     # remember WHICH platform fallback answered: workloads and retries run
     # on the platform the chip actually speaks, not a fixed guess
     live_attempt = next((i for i in range(3) if probe(i)), None)
@@ -150,15 +138,25 @@ def main() -> int:
     log(f"chip live (platform fallback #{live_attempt}); harvesting")
 
     done = 0
-    for workload, timeout in queue:
-        log(f"=== {workload} (timeout {timeout:.0f}s) ===")
+    archived = False
+    for name, workload, timeout in queue:
+        if workload == "flash_tune" and not archived:
+            # Archive stale tilings RIGHT BEFORE the sweep replaces them
+            # (not at startup — a dead probe or an earlier-row wedge must
+            # not strand the previous window's winners in the .bak). The
+            # baseline train row still precedes this in queue order, so
+            # tuned-vs-baseline stays honest; flash_tune_long later only
+            # MERGES its seq entries and must not wipe the fresh winners.
+            archived = True
+            _archive_tilings()
+        log(f"=== {name} (timeout {timeout:.0f}s) ===")
         result = run_child(workload, timeout, attempt=live_attempt)
         if result is not None and "error" in result:
-            log(f"{workload}: runner error: {result['error']}")
-        persist(workload, result)
+            log(f"{name}: runner error: {result['error']}")
+        persist(name, result)
         if result is not None and "error" not in result:
             done += 1
-            log(f"{workload}: OK {json.dumps(result)[:300]}")
+            log(f"{name}: OK {json.dumps(result)[:300]}")
             continue
         # failure: one retry if the chip still answers, else stop the run.
         # The re-probe cycles every platform fallback and the retry uses
@@ -169,14 +167,14 @@ def main() -> int:
             log("chip wedged mid-harvest — stopping (results are journaled)")
             break
         live_attempt = found
-        log(f"{workload}: chip still live (fallback #{found}), one retry")
+        log(f"{name}: chip still live (fallback #{found}), one retry")
         result = run_child(workload, timeout, attempt=live_attempt)
-        persist(workload, result)
+        persist(name, result)
         if result is not None and "error" not in result:
             done += 1
-            log(f"{workload}: OK on retry")
+            log(f"{name}: OK on retry")
         else:
-            log(f"{workload}: failed twice with a live chip; moving on")
+            log(f"{name}: failed twice with a live chip; moving on")
 
     log(f"harvest complete: {done}/{len(queue)} workloads -> {RESULTS_PATH}")
     return 0
