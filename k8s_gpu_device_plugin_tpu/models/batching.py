@@ -48,7 +48,8 @@ from k8s_gpu_device_plugin_tpu.models.generate import (
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import (
     Sampler,
-    sample_and_mark,
+    sample_and_mark_dyn,
+    sampler_knobs,
     token_logprob,
 )
 
@@ -85,7 +86,7 @@ def init_batch_state(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def prefill_insert(
     params,
     state: BatchState,
@@ -93,7 +94,7 @@ def prefill_insert(
     prompt_len: jax.Array,   # scalar int32: real length (<= P)
     slot: jax.Array,         # scalar int32
     cfg: LlamaConfig,
-    sampler: Sampler,
+    knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Prefill one request and insert it into ``slot``.
 
@@ -122,8 +123,8 @@ def prefill_insert(
     )
 
     key, sub = jax.random.split(state.key)
-    tok, seen = sample_and_mark(
-        first_logits[None, :], sub, sampler, seen[None, :]
+    tok, seen = sample_and_mark_dyn(
+        first_logits[None, :], sub, knobs[None, :], seen[None, :]
     )
     logp = token_logprob(first_logits[None, :], tok)[0]
     tok = tok[0]
@@ -152,14 +153,14 @@ def prefill_insert(
     ), tok, logp
 
 
-@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def decode_step(
     params,
     state: BatchState,
     allowed: jax.Array,  # (B,) bool: host-side budget gate per slot
     eos_id: jax.Array,   # scalar int32 (-1 disables EOS stopping)
     cfg: LlamaConfig,
-    sampler: Sampler,
+    knobs: jax.Array,    # (B, 4) f32 per-slot sampler knobs
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
@@ -182,8 +183,8 @@ def decode_step(
         params, state.last_token[:, None], state.cache, write_pos, cfg
     )
     key, sub = jax.random.split(state.key)
-    tok, presence = sample_and_mark(
-        logits[:, -1], sub, sampler, state.presence
+    tok, presence = sample_and_mark_dyn(
+        logits[:, -1], sub, knobs, state.presence
     )
     logps = token_logprob(logits[:, -1], tok)
     hit_eos = (tok == eos_id) & (eos_id >= 0)
@@ -219,6 +220,10 @@ class _Request:
     # multi-token stop sequences (host-side suffix match; the matched
     # tokens are KEPT in the output, like the EOS-keep semantics)
     stop: tuple[tuple[int, ...], ...] = ()
+    # per-request sampler override (None = the batcher's default); rides
+    # the decode step as traced per-slot knobs, so mixed settings share
+    # one compile
+    sampler: "Sampler | None" = None
 
 
 
@@ -237,6 +242,11 @@ class ContinuousBatcher:
     budget. Submitting more requests than slots is the point — slot
     reuse IS continuous batching.
     """
+
+    #: requests may carry their own Sampler (the speculative subclass
+    #: turns this off: its draft/verify distributions are built from ONE
+    #: static sampler)
+    per_request_sampler = True
 
     def __init__(
         self,
@@ -286,6 +296,10 @@ class ContinuousBatcher:
         # optional metrics.ServingMetrics (or anything with its hooks);
         # None = zero overhead, no prometheus dependency on this path
         self.metrics = metrics
+        # cached (n_slots, 4) device array for the decode step; running-
+        # set membership changes (admit/retire/cancel) invalidate it, so
+        # steady-state decode pays no per-token host build + transfer
+        self._knobs_cache: jax.Array | None = None
 
     def validate(self, prompt_len: int, max_new: int) -> None:
         """Raise ValueError iff submit(prompt of this length) would.
@@ -308,6 +322,7 @@ class ContinuousBatcher:
         max_new: int,
         prefix: "PrefixState | None" = None,
         stop: list[list[int]] | None = None,
+        sampler: "Sampler | None" = None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -328,6 +343,7 @@ class ContinuousBatcher:
             _Request(
                 rid, full, max_new, prefix=prefix,
                 stop=tuple(tuple(s) for s in (stop or ()) if s),
+                sampler=sampler,
             )
         )
         if self.metrics:
@@ -335,6 +351,26 @@ class ContinuousBatcher:
         return rid
 
     # --- internals ---
+
+    def _req_knobs(self, req: _Request) -> jax.Array:
+        return jnp.asarray(
+            sampler_knobs(req.sampler or self.sampler), jnp.float32
+        )
+
+    def _batch_knobs(self) -> jax.Array:
+        """(n_slots, 4) per-slot sampler knobs for the decode step (the
+        batcher default everywhere a request didn't override); cached
+        until the running set changes."""
+        if self._knobs_cache is None:
+            arr = np.tile(
+                np.asarray(sampler_knobs(self.sampler), np.float32),
+                (self.n_slots, 1),
+            )
+            for slot, req in self.running.items():
+                if req.sampler is not None:
+                    arr[slot] = sampler_knobs(req.sampler)
+            self._knobs_cache = jnp.asarray(arr)
+        return self._knobs_cache
 
     def _admit(self) -> None:
         free = [
@@ -365,13 +401,14 @@ class ContinuousBatcher:
             self.state, tok, logp = prefill_insert(
                 self.params, self.state, padded,
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
-                self.cfg, self.sampler,
+                self.cfg, self._req_knobs(req),
             )
             req.out.append(int(tok))
             req.out_logp.append(float(logp))
             if self.metrics:
                 self.metrics.on_first_token()
             self.running[slot] = req
+            self._knobs_cache = None
             self._finish_if_done(req)
 
     def _prefill_one_chunk(self) -> None:
@@ -406,6 +443,7 @@ class ContinuousBatcher:
         if self.metrics:
             self.metrics.on_first_token()
         self.running[slot] = req
+        self._knobs_cache = None
         self._finish_if_done(req)
 
     # overridable seams (the speculative batcher mirrors these onto a
@@ -422,7 +460,7 @@ class ContinuousBatcher:
         self.state, tok, logp = prefill_finish(
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
-            self.cfg, self.sampler,
+            self.cfg, self._req_knobs(self.prefilling[slot]),
         )
         return int(tok), float(logp)
 
@@ -443,6 +481,7 @@ class ContinuousBatcher:
                 if req.rid == rid:
                     del mapping[slot]
                     self._prefill_pos.pop(slot, None)
+                    self._knobs_cache = None
                     self._retire_cancelled(req)
                     return True
         return False
@@ -469,6 +508,7 @@ class ContinuousBatcher:
             self.done_requests[req.rid] = req
             if req.slot in self.running:
                 del self.running[req.slot]
+                self._knobs_cache = None
             if self.metrics:
                 self.metrics.on_finish(
                     "eos" if hit_eos else ("stop" if hit_stop else "budget")
@@ -497,7 +537,7 @@ class ContinuousBatcher:
         that can emit up to gamma tokens per slot)."""
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
-            self.cfg, self.sampler,
+            self.cfg, self._batch_knobs(),
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
@@ -582,7 +622,7 @@ def prefill_chunk(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "sampler"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def prefill_finish(
     params,
     state: BatchState,
@@ -591,7 +631,7 @@ def prefill_finish(
     prompt_len: jax.Array,   # absolute total prompt length
     slot: jax.Array,
     cfg: LlamaConfig,
-    sampler: Sampler,
+    knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Final chunk: run it, sample the first generated token (returned
     with its logprob), activate the slot.
@@ -615,7 +655,9 @@ def prefill_finish(
         chunk_start + jnp.arange(c) < prompt_len
     )
     key, sub = jax.random.split(state.key)
-    tok, seen = sample_and_mark(logits[:, 0], sub, sampler, seen[None, :])
+    tok, seen = sample_and_mark_dyn(
+        logits[:, 0], sub, knobs[None, :], seen[None, :]
+    )
     logp = token_logprob(logits[:, 0], tok)[0]
     tok = tok[0]
     write = jnp.int32(slot)

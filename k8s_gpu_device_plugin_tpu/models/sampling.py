@@ -155,6 +155,80 @@ def sample_logits(
     ).astype(jnp.int32)
 
 
+def sampler_knobs(sampler: Sampler) -> tuple[float, float, float, float]:
+    """Sampler -> the (temperature, top_k, top_p, repetition_penalty)
+    row the dynamic per-slot path consumes (top_k rides as f32; exact up
+    to 2^24, far beyond any vocab)."""
+    return (
+        sampler.temperature,
+        float(sampler.top_k),
+        sampler.top_p,
+        sampler.repetition_penalty,
+    )
+
+
+def sample_logits_dyn(
+    logits: jax.Array,
+    key: jax.Array,
+    knobs: jax.Array,     # (B, 4) f32: temp, top_k, top_p, rep_penalty
+    presence: jax.Array,  # (B, V) bool
+) -> jax.Array:
+    """Per-ROW sampler knobs as traced values — continuous batching serves
+    requests with different sampling settings in one compiled step.
+
+    Bit-identical to :func:`sample_logits` at equal knob values: same
+    filter order (penalty -> temperature -> top-k -> top-p), the top-p
+    cut applied to the post-top-k logits exactly as ``filtered_logits``
+    does, same -inf mask value, and per-row categorical draws that only
+    depend on the key and that row's logits. Greedy rows (temperature 0)
+    take the penalized argmax, ignoring the filters, as the static path
+    does. Costs one (B, V) sort per call (the post-top-k ordering is
+    derived by masking the same sorted array) — noise next to the
+    weight-streaming a decode step already does.
+    """
+    logits = logits.astype(jnp.float32)
+    temp, top_k, top_p, rep = (
+        knobs[:, 0], knobs[:, 1], knobs[:, 2], knobs[:, 3]
+    )
+    pen = rep[:, None]
+    penalized = jnp.where(logits > 0, logits / pen, logits * pen)
+    logits = jnp.where(presence, penalized, logits)  # pen 1.0 = identity
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    v = logits.shape[-1]
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    k = jnp.clip(top_k.astype(jnp.int32), 0, v)
+    sorted_k = jnp.sort(scaled, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sorted_k, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1
+    )
+    use_k = (k > 0)[:, None]
+    scaled = jnp.where(use_k & (scaled < kth), _NEG, scaled)
+    # the post-top-k sort is DERIVABLE from sorted_k: the kept values
+    # (>= kth, ties included) are a contiguous descending prefix, so
+    # masking sorted_k in place is the second sort — one (B, V) sort
+    # total on the per-token decode path
+    sorted_p = jnp.where(use_k & (sorted_k < kth), _NEG, sorted_k)
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive (nucleus rule)
+    pth = jnp.min(
+        jnp.where(cum < top_p[:, None], sorted_p, jnp.inf),
+        axis=-1, keepdims=True,
+    )
+    scaled = jnp.where((top_p < 1.0)[:, None] & (scaled < pth), _NEG, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy_tok, sampled)
+
+
+def sample_and_mark_dyn(
+    logits: jax.Array, key: jax.Array, knobs: jax.Array, presence: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Dynamic-knob twin of :func:`sample_and_mark`."""
+    tok = sample_logits_dyn(logits, key, knobs, presence)
+    b = presence.shape[0]
+    return tok, presence.at[jnp.arange(b), tok].set(True)
+
+
 def token_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
     """log P(tok) under the RAW model distribution (f32 log-softmax of the
     unfiltered logits) — the "model confidence" number serving APIs

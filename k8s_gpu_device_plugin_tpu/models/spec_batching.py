@@ -57,6 +57,7 @@ from k8s_gpu_device_plugin_tpu.models.batching import (
 from k8s_gpu_device_plugin_tpu.models.generate import _forward_cached
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import (
+    sampler_knobs,
     Sampler,
     filtered_logits,
     filtered_probs,
@@ -223,11 +224,20 @@ class SpeculativeBatcher(ContinuousBatcher):
             )
         super().validate(prompt_len, max_new)
 
-    def submit(self, prompt, max_new, prefix=None, stop=None):
+    #: draft/verify distributions are built from ONE static sampler; a
+    #: per-request override would desynchronize the rejection sampling
+    per_request_sampler = False
+
+    def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None):
         if prefix is not None:
             raise NotImplementedError(
                 "shared prefixes are not supported with speculative "
                 "batching yet (the draft cache has no prefix rows)"
+            )
+        if sampler is not None:
+            raise ValueError(
+                "per-request samplers are not supported with speculative "
+                "batching (draft and target must share one sampler)"
             )
         return super().submit(prompt, max_new, stop=stop)
 
@@ -247,7 +257,8 @@ class SpeculativeBatcher(ContinuousBatcher):
         self.draft_state, _tok, _logp = prefill_finish(
             self.draft_params, self.draft_state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
-            self.draft_cfg, self.sampler,
+            self.draft_cfg,
+            jnp.asarray(sampler_knobs(self.sampler), jnp.float32),
         )
         return tok, logp
 

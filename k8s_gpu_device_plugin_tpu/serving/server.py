@@ -10,7 +10,12 @@ submit requests and read per-request token queues bridged with
 API (JSON over HTTP, SSE for streaming):
 
 - ``POST /v1/generate``  {"prompt": [ids...], "max_new": N,
-  "stream": false, "n": 1, "stop": [[ids...], ...], "logprobs": false}
+  "stream": false, "n": 1, "stop": [[ids...], ...], "logprobs": false,
+  "temperature": t, "top_k": k, "top_p": p, "repetition_penalty": r}
+  — the four sampling knobs are per-request (any present builds a full
+  Sampler; absent knobs default to greedy/off, not to the server's
+  default sampler); unsupported with --draftPreset (speculative
+  batching shares one sampler: 422).
   -> {"id", "tokens"} (plus "completions" when n > 1: independent
   samples decoded in parallel slots; plus "logprobs" — and
   "completions_logprobs" with n > 1 — when requested: raw-distribution
@@ -87,7 +92,7 @@ class InferenceEngine:
         self._work = threading.Event()
         self._stop = threading.Event()
         self._dead = threading.Event()
-        self._subq: list[tuple[int, list[int], int, tuple]] = []
+        self._subq: list[tuple[int, list[int], int, tuple, "Sampler | None"]] = []
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -103,6 +108,7 @@ class InferenceEngine:
     def submit(
         self, prompt: list[int], max_new: int,
         stop: list[list[int]] | None = None,
+        sampler: Sampler | None = None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
@@ -113,6 +119,13 @@ class InferenceEngine:
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
         self.cb.validate(len(prompt), max_new)  # the batcher's own rule
+        if sampler is not None and not getattr(
+            self.cb, "per_request_sampler", False
+        ):
+            raise ValueError(
+                "per-request sampling is not supported by this engine "
+                "(speculative batching shares one sampler)"
+            )
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
@@ -125,7 +138,7 @@ class InferenceEngine:
             eid = self._next_eid
             self._next_eid += 1
             self._subq.append(
-                (eid, list(prompt), max_new, tuple(stop or ()))
+                (eid, list(prompt), max_new, tuple(stop or ()), sampler)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -162,9 +175,10 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new, stop in batch:
+        for eid, prompt, max_new, stop, sampler in batch:
             rid = self.cb.submit(
-                prompt, max_new=max_new, stop=[list(st) for st in stop]
+                prompt, max_new=max_new, stop=[list(st) for st in stop],
+                sampler=sampler,
             )
             self._rid_to_eid[rid] = eid
 
@@ -327,6 +341,21 @@ class InferenceServer:
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
+            # per-request sampling: any knob present builds a full
+            # Sampler (its own validation applies); absent fields default
+            # to greedy/off, NOT to the server sampler — a request that
+            # sets only temperature gets exactly what it asked for
+            knob_fields = {
+                "temperature": float,
+                "top_k": int,
+                "top_p": float,
+                "repetition_penalty": float,
+            }
+            given = {
+                k: cast(body[k]) for k, cast in knob_fields.items()
+                if k in body
+            }
+            sampler = Sampler(**given) if given else None
             if (
                 not isinstance(prompt, list)
                 or not prompt
@@ -377,10 +406,11 @@ class InferenceServer:
             return web.json_response({"error": str(e)}, status=400)
         try:
             subs = [
-                self.engine.submit(prompt, max_new, stop=stop)
+                self.engine.submit(prompt, max_new, stop=stop,
+                                   sampler=sampler)
                 for _ in range(n)
             ]
-        except ValueError as e:  # capacity/bucket validation
+        except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
         except RuntimeError as e:  # engine dead
             return web.json_response({"error": str(e)}, status=503)

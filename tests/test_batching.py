@@ -549,3 +549,78 @@ def test_cancel_in_every_state_frees_slot_and_records(setup):
     assert reg.get_sample_value(
         "tpu_serving_requests_finished_total", {"reason": "cancelled"}
     ) == 3
+
+
+def test_per_request_samplers_mix_in_one_batch(setup):
+    """Mixed sampling settings decode side by side in one compiled step:
+    a greedy request among sampled neighbors still matches its dedicated-
+    generate oracle exactly, sampled requests emit valid in-range tokens,
+    and a per-request greedy override on a SAMPLED-default batcher is
+    likewise oracle-exact."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=3, max_len=64,
+        sampler=Sampler(),  # greedy default
+        prompt_buckets=(8,),
+    )
+    pg = _prompt(600, 5, cfg)
+    rg = cb.submit(pg, max_new=5)  # default greedy
+    rs1 = cb.submit(
+        _prompt(601, 5, cfg), max_new=5,
+        sampler=Sampler(temperature=0.9, top_k=20),
+    )
+    rs2 = cb.submit(
+        _prompt(602, 6, cfg), max_new=5,
+        sampler=Sampler(temperature=1.2, top_p=0.8,
+                        repetition_penalty=1.3),
+    )
+    results = cb.run()
+    assert results[rg] == _oracle(params, pg, cfg, 5)
+    for rid in (rs1, rs2):
+        assert len(results[rid]) == 5
+        assert all(0 <= t < cfg.vocab_size for t in results[rid])
+
+    # sampled default + greedy override
+    cb2 = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64,
+        sampler=Sampler(temperature=1.0),
+        prompt_buckets=(8,),
+    )
+    p2 = _prompt(603, 5, cfg)
+    r_greedy = cb2.submit(p2, max_new=4, sampler=Sampler())
+    r_sampled = cb2.submit(_prompt(604, 5, cfg), max_new=4)
+    results2 = cb2.run()
+    assert results2[r_greedy] == _oracle(params, p2, cfg, 4)
+    assert len(results2[r_sampled]) == 4
+
+
+def test_per_request_sampler_chunked_prefill_first_token(setup):
+    """The override must govern the FIRST token too (sampled at prefill
+    finish), in both chunked and bucketed admission."""
+    cfg, params = setup
+    for kwargs in ({"chunked_prefill": 4}, {"prompt_buckets": (16,)}):
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=2, max_len=64,
+            sampler=Sampler(temperature=1.5),  # noisy default
+            **kwargs,
+        )
+        p = _prompt(610, 9, cfg)
+        rid = cb.submit(p, max_new=3, sampler=Sampler())  # greedy override
+        assert cb.run()[rid] == _oracle(params, p, cfg, 3)
+
+
+def test_speculative_batcher_rejects_per_request_sampler(setup):
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    cfg, params = setup
+    draft_cfg = LlamaConfig.tiny(n_layers=1)
+    draft_params = init_params(jax.random.key(9), draft_cfg)
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
+    )
+    assert sb.per_request_sampler is False
+    with pytest.raises(ValueError, match="per-request"):
+        sb.submit([1, 2, 3], max_new=4, sampler=Sampler(temperature=0.5))

@@ -556,3 +556,34 @@ def test_client_disconnect_cancels_request(setup):
 
     run(body())
     metrics.close()
+
+
+def test_per_request_sampling_over_http(setup):
+    """Sampling knobs ride the JSON request: an explicit greedy override
+    (temperature 0) matches the oracle even on a sampled-default server;
+    a sampled request returns valid tokens; invalid knobs are a 400."""
+    from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+    cfg, params = setup
+    p = _prompt(700, 5, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "temperature": 0.0,
+        }) as r:
+            assert r.status == 200
+            assert (await r.json())["tokens"] == _oracle(params, p, cfg, 4)
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "temperature": 0.9, "top_k": 10,
+            "repetition_penalty": 1.2,
+        }) as r:
+            assert r.status == 200
+            toks = (await r.json())["tokens"]
+            assert len(toks) == 4
+            assert all(0 <= t < cfg.vocab_size for t in toks)
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 4, "top_p": 1.5,
+        }) as r:
+            assert r.status == 400  # Sampler's own validation
+
+    run(_with_server(setup, body, sampler=Sampler(temperature=1.0)))
