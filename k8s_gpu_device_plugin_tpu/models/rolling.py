@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.models.generate import (
     KVCache,
+    _cache_write,
     _forward_cached,
     _mlp_out,
     _project_qkv,
@@ -62,40 +63,57 @@ def _ring_from_prefill(cache_kv: jax.Array, p: int, w: int) -> jax.Array:
     return jnp.roll(last, shift=(p - w) % w, axis=2)
 
 
-def _ring_attention_step(q, ring_k, ring_v, length, cfg: LlamaConfig):
+def _ring_attention_step(q, ring_k, ring_v, k_scale, v_scale, length,
+                         cfg: LlamaConfig):
     """T=1 attention over the ring AFTER the current token's K/V landed.
 
     q: (B, 1, Hq, hd); ring: (B, W, Hkv, hd). ``length`` counts tokens
     written so far INCLUDING the current one (the query sits at position
     length - 1). Slot s holds position L-1 - ((L-1-s) mod W); negatives
     are unwritten slots. The window mask is implied: every live slot is
-    within W of the query by construction."""
+    within W of the query by construction.
+
+    int8 rings (``k_scale``/``v_scale`` (B, W, Hkv, 1), None on bf16)
+    follow generate._cached_attention exactly: the int8 arrays stay the
+    dot operands (a bare convert fuses into the dot), and the
+    per-(slot, head) scales apply to scores after the K contraction and
+    to probs before the V contraction."""
     b, t, hq, hd = q.shape
     w = ring_k.shape[1]
     group = hq // cfg.n_kv_heads
     qg = q.reshape(b, 1, cfg.n_kv_heads, group, hd)
     scores = jnp.einsum(
-        "btkgd,bskd->btkgs", qg, ring_k,
+        "btkgd,bskd->btkgs", qg, ring_k.astype(q.dtype),
         preferred_element_type=jnp.float32,
     ) * (hd ** -0.5)
+    if k_scale is not None:
+        ks = k_scale[..., 0].transpose(0, 2, 1)         # (B, Hkv, W)
+        scores = scores * ks[:, None, :, None, :]
     last = length - 1
     s_idx = jnp.arange(w)
     slot_pos = last - ((last - s_idx) % w)              # (W,)
     keep = slot_pos >= 0
     scores = jnp.where(keep[None, None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        vs = v_scale[..., 0].transpose(0, 2, 1)         # (B, Hkv, W)
+        probs = probs * vs[:, None, :, None, :]
     out = jnp.einsum(
-        "btkgs,bskd->btkgd", probs.astype(q.dtype), ring_v,
+        "btkgs,bskd->btkgd", probs.astype(q.dtype), ring_v.astype(q.dtype),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, 1, hq, hd).astype(q.dtype)
 
 
-def _ring_decode_block(x, layer, ring_k, ring_v, pos, cfg: LlamaConfig):
+def _ring_decode_block(x, layer, ring_k, ring_v, rk_s, rv_s, pos,
+                       cfg: LlamaConfig):
     """One block over ONE new token at absolute position ``pos``; writes
     its K/V at slot pos % W, then attends the ring. Projection/rope and
     the MLP branch are the SAME helpers the linear-cache block uses
-    (generate._project_qkv/_mlp_out), so the two paths cannot drift."""
+    (generate._project_qkv/_mlp_out), so the two paths cannot drift.
+    int8 rings write through generate's ``_cache_write`` (one recipe for
+    quantize + value/scale placement; the scale planes ``rk_s``/``rv_s``
+    are None on bf16) — the shared-helper rule again."""
     b, t, d = x.shape
     w = ring_k.shape[1]
 
@@ -103,16 +121,12 @@ def _ring_decode_block(x, layer, ring_k, ring_v, pos, cfg: LlamaConfig):
     q, k, v = _project_qkv(x, layer, positions, cfg)
 
     slot = (pos % w).astype(jnp.int32)
-    ring_k = jax.lax.dynamic_update_slice(
-        ring_k, k.astype(ring_k.dtype), (0, slot, 0, 0)
-    )
-    ring_v = jax.lax.dynamic_update_slice(
-        ring_v, v.astype(ring_v.dtype), (0, slot, 0, 0)
-    )
+    ring_k, rk_s = _cache_write(ring_k, rk_s, k, slot)
+    ring_v, rv_s = _cache_write(ring_v, rv_s, v, slot)
 
-    attn = _ring_attention_step(q, ring_k, ring_v, pos + 1, cfg)
+    attn = _ring_attention_step(q, ring_k, ring_v, rk_s, rv_s, pos + 1, cfg)
     x = x + qmatmul(attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer["wo"])
-    return x + _mlp_out(x, layer, cfg), ring_k, ring_v
+    return x + _mlp_out(x, layer, cfg), ring_k, ring_v, rk_s, rv_s
 
 
 def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
@@ -121,18 +135,24 @@ def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
     params = cast_params_for_compute(params, cfg)
     x = params["embed"].astype(cfg.dtype)[tok[:, None]]
 
-    def body(carry, layer_and_ring):
-        x = carry
-        layer, rk, rv = layer_and_ring
-        x, rk, rv = _ring_decode_block(x, layer, rk, rv, pos, cfg)
-        return x, (rk, rv)
+    # None scale planes are empty pytree leaves — lax.scan carries them
+    # through untouched, so the bf16 and int8 rings share one body (the
+    # same structure generate's _forward_cached scan uses)
+    def body(carry, xs):
+        layer, rk, rv, rks, rvs = xs
+        x, rk, rv, rks, rvs = _ring_decode_block(
+            carry, layer, rk, rv, rks, rvs, pos, cfg
+        )
+        return x, (rk, rv, rks, rvs)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], ring.k, ring.v)
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        body, x,
+        (params["layers"], ring.k, ring.v, ring.k_scale, ring.v_scale),
     )
+    new_ring = KVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = qhead_matmul(x[:, -1], params["lm_head"], cfg.dtype)
-    return logits, KVCache(k=k_new, v=v_new)
+    return logits, new_ring
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "sampler"))
@@ -157,10 +177,6 @@ def rolling_generate(
         )
     if cfg.quant != "none":
         raise NotImplementedError("decode path is bf16-only (quant='none')")
-    if cfg.cache_quant != "none":
-        raise NotImplementedError(
-            "rolling cache does not compose with cache_quant yet"
-        )
     b, p = prompt.shape
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
@@ -174,9 +190,19 @@ def rolling_generate(
     logits, pre_cache = _forward_cached(
         params, prompt, pre_cache, 0, cfg, last_only=True
     )
+    # scale planes (int8 cache) ring-roll identically to the K/V arrays:
+    # _ring_from_prefill is shape-generic over the trailing dims
     ring = KVCache(
         k=_ring_from_prefill(pre_cache.k, p, w),
         v=_ring_from_prefill(pre_cache.v, p, w),
+        k_scale=(
+            _ring_from_prefill(pre_cache.k_scale, p, w)
+            if pre_cache.k_scale is not None else None
+        ),
+        v_scale=(
+            _ring_from_prefill(pre_cache.v_scale, p, w)
+            if pre_cache.v_scale is not None else None
+        ),
     )
 
     # presence mask for the repetition penalty (same shared helpers as

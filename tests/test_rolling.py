@@ -86,7 +86,26 @@ def test_rolling_validation():
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="sliding_window"):
         rolling_generate(params, prompt, cfg_full, max_new=2)
-    cfg_q = _cfg(8, cache_quant="int8")
-    params_q = init_params(jax.random.key(0), cfg_q)
-    with pytest.raises(NotImplementedError, match="cache_quant"):
-        rolling_generate(params_q, prompt, cfg_q, max_new=2)
+
+
+@pytest.mark.parametrize(
+    "prompt_len,max_new,window",
+    [
+        (4, 6, 8),    # prompt < window
+        (12, 6, 8),   # prompt > window
+        (6, 20, 8),   # generation wraps the ring twice
+    ],
+)
+def test_rolling_int8_cache_matches_unbounded(prompt_len, max_new, window):
+    """Ring + int8 KV cache: token-exact against the unbounded windowed
+    generate with the same cache_quant (both sides quantize each written
+    row with the one shared _quantize_kv recipe, so in-window rows carry
+    identical int8 values and scales)."""
+    cfg = _cfg(window, cache_quant="int8")
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(3), (2, prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = generate(params, prompt, cfg, max_new=max_new)
+    got = rolling_generate(params, prompt, cfg, max_new=max_new)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
