@@ -57,7 +57,10 @@ class KVCache:
     ``k_scale``/``v_scale`` hold per-(position, head) f32 scales
     (L, B, max_len, Hkv, 1): half the cache HBM traffic and twice the
     context capacity, dequantized on read (the dequant fuses into the
-    attention einsums). Scales are None on the bf16 path."""
+    attention einsums). ``"int4"`` halves it again (XLA bit-packs the
+    native narrow dtype two-per-byte in HBM; same scale planes, coarser
+    codes — an accuracy trade the caller opts into). Scales are None on
+    the bf16 path."""
 
     k: jax.Array
     v: jax.Array
@@ -67,10 +70,11 @@ class KVCache:
     @staticmethod
     def init(cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
         shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
-        if cfg.cache_quant == "int8":
+        if cfg.cache_quant in ("int8", "int4"):
+            qdtype = jnp.int8 if cfg.cache_quant == "int8" else jnp.int4
             sshape = shape[:-1] + (1,)
             return KVCache(
-                k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+                k=jnp.zeros(shape, qdtype), v=jnp.zeros(shape, qdtype),
                 k_scale=jnp.zeros(sshape, jnp.float32),
                 v_scale=jnp.zeros(sshape, jnp.float32),
             )
@@ -82,20 +86,30 @@ class KVCache:
 jax.tree_util.register_dataclass(KVCache, ("k", "v", "k_scale", "v_scale"), ())
 
 
-def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """(B, T, H, hd) -> (int8 values, f32 per-(token, head) scales).
+def _quantize_kv(x: jax.Array, qdtype=None) -> tuple[jax.Array, jax.Array]:
+    """(B, T, H, hd) -> (int4/int8 values, f32 per-(token, head) scales).
 
-    Same symmetric recipe as the weight/activation path (ops/quant.py) —
-    one implementation so cache-quant and weight-quant numerics can never
-    drift apart."""
-    from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
+    One symmetric per-row recipe for both code widths
+    (ops/quant._quantize_symmetric), shared with the int8
+    weight/activation path so those numerics cannot drift. The int4
+    WEIGHT path is deliberately different (grouped scales, GPTQ/AWQ
+    storage — quantized_serving.quantize_weights_int4); this is the
+    cache recipe. ``qdtype`` picks the code width (the cache's own
+    dtype; int8 when unspecified)."""
+    from k8s_gpu_device_plugin_tpu.ops.quant import (
+        quantize_int4_sym,
+        quantize_int8,
+    )
 
+    if qdtype == jnp.int4:
+        return quantize_int4_sym(x, axis=-1)
     return quantize_int8(x, axis=-1)
 
 
 def _cache_write(cache, scale, x, length):
-    """Write T new tokens' K or V at ``length``; quantizing when the cache
-    is int8 (scale is the matching scale plane, else None).
+    """Write T new tokens' K or V at ``length``; quantizing to the
+    cache's own dtype when it is int8/int4 (scale is the matching scale
+    plane, else None).
 
     ``length`` may be a scalar (uniform batch — the classic decode) or a
     (B,) vector (continuous batching: every slot writes at its own
@@ -109,7 +123,7 @@ def _cache_write(cache, scale, x, length):
 
     if scale is None:
         return write(cache, x.astype(cache.dtype), length), None
-    q, s = _quantize_kv(x)
+    q, s = _quantize_kv(x, cache.dtype)
     return write(cache, q, length), write(scale, s, length)
 
 

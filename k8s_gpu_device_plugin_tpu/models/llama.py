@@ -104,9 +104,9 @@ class LlamaConfig:
                 f"quant must be 'none' or 'int8', got {self.quant!r} — "
                 "an unknown value would silently run pure bf16"
             )
-        if self.cache_quant not in ("none", "int8"):
+        if self.cache_quant not in ("none", "int8", "int4"):
             raise ValueError(
-                f"cache_quant must be 'none' or 'int8', got "
+                f"cache_quant must be 'none', 'int8' or 'int4', got "
                 f"{self.cache_quant!r} — an unknown value would silently "
                 "run a bf16 cache"
             )

@@ -73,8 +73,9 @@ def _ring_attention_step(q, ring_k, ring_v, k_scale, v_scale, length,
     are unwritten slots. The window mask is implied: every live slot is
     within W of the query by construction.
 
-    int8 rings (``k_scale``/``v_scale`` (B, W, Hkv, 1), None on bf16)
-    follow generate._cached_attention exactly: the int8 arrays stay the
+    Quantized rings — int8 or int4 (``k_scale``/``v_scale``
+    (B, W, Hkv, 1), None on bf16) — follow generate._cached_attention
+    exactly: the narrow-dtype arrays stay the
     dot operands (a bare convert fuses into the dot), and the
     per-(slot, head) scales apply to scores after the K contraction and
     to probs before the V contraction."""
@@ -111,9 +112,9 @@ def _ring_decode_block(x, layer, ring_k, ring_v, rk_s, rv_s, pos,
     its K/V at slot pos % W, then attends the ring. Projection/rope and
     the MLP branch are the SAME helpers the linear-cache block uses
     (generate._project_qkv/_mlp_out), so the two paths cannot drift.
-    int8 rings write through generate's ``_cache_write`` (one recipe for
-    quantize + value/scale placement; the scale planes ``rk_s``/``rv_s``
-    are None on bf16) — the shared-helper rule again."""
+    Quantized rings (int8/int4) write through generate's ``_cache_write``
+    (one recipe for quantize + value/scale placement; the scale planes
+    ``rk_s``/``rv_s`` are None on bf16) — the shared-helper rule again."""
     b, t, d = x.shape
     w = ring_k.shape[1]
 

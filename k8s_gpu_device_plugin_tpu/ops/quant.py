@@ -26,19 +26,35 @@ import jax.numpy as jnp
 _EPS = 1e-8
 
 
-def _symmetric_scale(x: jax.Array, axis: int) -> jax.Array:
-    """f32 scale mapping |x| onto the int8 range, per slice along ``axis``."""
+def _quantize_symmetric(
+    x: jax.Array, axis: int, qmax: int, dtype
+) -> tuple[jax.Array, jax.Array]:
+    """ONE symmetric recipe for every code width (amax -> _EPS floor ->
+    round -> clip to +-qmax): int8 and int4 numerics cannot drift."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
-    return jnp.maximum(amax, _EPS) / 127.0
+    scale = jnp.maximum(amax, _EPS) / qmax
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -qmax, qmax
+    ).astype(dtype)
+    return q, scale
 
 
 def quantize_int8(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
     """Symmetric int8 quantization along ``axis``; returns (q, scale)."""
-    scale = _symmetric_scale(x, axis)
-    q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale), -127, 127
-    ).astype(jnp.int8)
-    return q, scale
+    return _quantize_symmetric(x, axis, 127, jnp.int8)
+
+
+def quantize_int4_sym(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int4 quantization along ``axis``; returns (q, scale).
+
+    Range [-7, 7] (the -8 code is dropped for symmetry, mirroring int8's
+    +-127). ``jnp.int4`` is a native narrow dtype: XLA bit-packs it
+    two-per-byte in HBM on TPU, so an int4 KV cache streams half an int8
+    one; the convert to bf16 fuses into the consuming dot. Distinct from
+    the int4 WEIGHT path (quantized_serving.quantize_weights_int4:
+    grouped scales, GPTQ/AWQ storage) — this is the per-row cache
+    recipe."""
+    return _quantize_symmetric(x, axis, 7, jnp.int4)
 
 
 @jax.custom_vjp

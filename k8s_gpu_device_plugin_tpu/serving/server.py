@@ -566,6 +566,11 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--topP", type=float, default=1.0)
     parser.add_argument("--weightQuant", default="none",
                         choices=["none", "int8", "int4"])
+    parser.add_argument("--cacheQuant", default="none",
+                        choices=["none", "int8", "int4"],
+                        help="KV-cache quantization: int8 halves decode's "
+                        "cache HBM stream, int4 halves it again (coarser "
+                        "codes; accuracy trade)")
     parser.add_argument("--checkpointDir", default="")
     parser.add_argument("--tokenizer", default="",
                         help="text seam: 'byte' (UTF-8 bytes, lossless) or "
@@ -583,6 +588,10 @@ def _main(argv: list[str] | None = None) -> int:
     from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
 
     cfg = getattr(LlamaConfig, args.preset)()
+    if args.cacheQuant != "none":
+        from dataclasses import replace as _replace
+
+        cfg = _replace(cfg, cache_quant=args.cacheQuant)
     params = load_params(cfg, args.checkpointDir)
 
     sampler = Sampler(temperature=args.temperature, top_k=args.topK,

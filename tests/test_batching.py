@@ -106,21 +106,22 @@ def test_eos_frees_slot_for_queued_request(setup):
     assert len(results[rb]) >= 5              # b ran to (near) budget
 
 
-def test_int8_cache_parity(setup):
-    """The quantized-KV path rides the same per-row machinery: batcher
-    tokens must equal dedicated-generate tokens under cache_quant=int8
-    (both sides quantized — parity is within the int8 cache numerics,
-    which the generate-vs-oracle tests already bound)."""
+@pytest.mark.parametrize("cache_quant", ["int8", "int4"])
+def test_quantized_cache_parity(setup, cache_quant):
+    """The quantized-KV paths ride the same per-row machinery: batcher
+    tokens must equal dedicated-generate tokens under cache_quant
+    (both sides quantized — parity is within the cache numerics, which
+    the generate-vs-oracle tests already bound)."""
     cfg, _ = setup
-    cfg8 = LlamaConfig.tiny(n_layers=2, cache_quant="int8")
-    params = init_params(jax.random.key(0), cfg8)
-    p = _prompt(30, 6, cfg8)
+    cfg_q = LlamaConfig.tiny(n_layers=2, cache_quant=cache_quant)
+    params = init_params(jax.random.key(0), cfg_q)
+    p = _prompt(30, 6, cfg_q)
     cb = ContinuousBatcher(
-        params, cfg8, n_slots=2, max_len=64, prompt_buckets=(8,),
+        params, cfg_q, n_slots=2, max_len=64, prompt_buckets=(8,),
     )
     rid = cb.submit(p, max_new=5)
     results = cb.run()
-    assert results[rid] == _oracle(params, p, cfg8, 5)
+    assert results[rid] == _oracle(params, p, cfg_q, 5)
 
 
 def test_sampled_batching_runs(setup):

@@ -33,7 +33,7 @@ def base_params():
 
 
 @pytest.mark.parametrize("window", [0, 8])
-@pytest.mark.parametrize("cache_quant", ["none", "int8"])
+@pytest.mark.parametrize("cache_quant", ["none", "int8", "int4"])
 @pytest.mark.parametrize("int8_weights", [False, True])
 @pytest.mark.parametrize(
     "sampler",

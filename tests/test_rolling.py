@@ -96,12 +96,14 @@ def test_rolling_validation():
         (6, 20, 8),   # generation wraps the ring twice
     ],
 )
-def test_rolling_int8_cache_matches_unbounded(prompt_len, max_new, window):
-    """Ring + int8 KV cache: token-exact against the unbounded windowed
-    generate with the same cache_quant (both sides quantize each written
-    row with the one shared _quantize_kv recipe, so in-window rows carry
-    identical int8 values and scales)."""
-    cfg = _cfg(window, cache_quant="int8")
+@pytest.mark.parametrize("cache_quant", ["int8", "int4"])
+def test_rolling_quantized_cache_matches_unbounded(prompt_len, max_new,
+                                                   window, cache_quant):
+    """Ring + quantized KV cache: token-exact against the unbounded
+    windowed generate with the same cache_quant (both sides quantize each
+    written row with the one shared _quantize_kv recipe, so in-window
+    rows carry identical codes and scales)."""
+    cfg = _cfg(window, cache_quant=cache_quant)
     params = init_params(jax.random.key(0), cfg)
     prompt = jax.random.randint(
         jax.random.key(3), (2, prompt_len), 0, cfg.vocab_size, jnp.int32
