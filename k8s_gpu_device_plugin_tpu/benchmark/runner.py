@@ -300,13 +300,17 @@ def _run_flash_tune_long() -> dict:
     )
 
 
-def _decode_result(workload: str, weight_quant: str = "none") -> dict:
+def _decode_result(
+    workload: str, weight_quant: str = "none", cache_quant: str = "none"
+) -> dict:
+    from dataclasses import replace
+
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
         decode_bench,
     )
 
     _require_accelerator()
-    cfg = _bench_model_cfg()
+    cfg = replace(_bench_model_cfg(), cache_quant=cache_quant)
     r = decode_bench(
         cfg, batch=8, prompt_len=512, new_tokens=64,
         weight_quant=weight_quant,
@@ -331,6 +335,13 @@ def _run_decode() -> dict:
     companion to the train bench; reports prefill latency, tokens/s and
     achieved HBM bandwidth vs peak)."""
     return _decode_result("decode")
+
+
+def _run_decode_int8kv() -> dict:
+    """Decode with an int8 KV cache (bf16 weights): at long contexts the
+    cache dominates the stream, so this isolates the cache-quant lever
+    the way decode_int8w isolates the weight one."""
+    return _decode_result("decode_int8kv", cache_quant="int8")
 
 
 def _run_decode_int8w() -> dict:
@@ -423,6 +434,7 @@ def _run_allocated() -> dict:
 
 WORKLOADS = {
     "probe": _run_probe,
+    "decode_int8kv": _run_decode_int8kv,
     "usage_live": _run_usage_live,
     "matmul": _run_matmul,
     "train": _run_train,

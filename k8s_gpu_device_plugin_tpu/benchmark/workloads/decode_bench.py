@@ -130,10 +130,16 @@ def decode_bench(
     tokens_per_second = batch * new_tokens / decode_seconds
 
     # per decode step the chip streams all params once (batch rows share
-    # them) + the K/V cache once; activations are negligible at T=1
-    cache_bytes = (
-        2 * cfg.n_layers * batch * (prompt_len + new_tokens)
-        * cfg.n_kv_heads * cfg.head_dim * 2
+    # them) + the K/V cache once; activations are negligible at T=1.
+    # Quantized caches stream narrower elements (plus their f32 scale
+    # planes, one per (position, head) — hd-fold smaller, counted).
+    kv_elem_bytes = {"none": 2, "int8": 1, "int4": 0.5}[cfg.cache_quant]
+    kv_rows = (
+        cfg.n_layers * batch * (prompt_len + new_tokens) * cfg.n_kv_heads
+    )
+    cache_bytes = 2 * kv_rows * (
+        cfg.head_dim * kv_elem_bytes
+        + (4 if cfg.cache_quant != "none" else 0)
     )
     gbps = (
         _param_bytes(cfg, batch, weight_quant) + cache_bytes
