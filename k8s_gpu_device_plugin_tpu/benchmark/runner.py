@@ -301,7 +301,8 @@ def _run_flash_tune_long() -> dict:
 
 
 def _decode_result(
-    workload: str, weight_quant: str = "none", cache_quant: str = "none"
+    workload: str, weight_quant: str = "none", cache_quant: str = "none",
+    decode_attn: str = "auto",
 ) -> dict:
     from dataclasses import replace
 
@@ -310,7 +311,9 @@ def _decode_result(
     )
 
     _require_accelerator()
-    cfg = replace(_bench_model_cfg(), cache_quant=cache_quant)
+    cfg = replace(
+        _bench_model_cfg(), cache_quant=cache_quant, decode_attn=decode_attn
+    )
     r = decode_bench(
         cfg, batch=8, prompt_len=512, new_tokens=64,
         weight_quant=weight_quant,
@@ -335,6 +338,14 @@ def _run_decode() -> dict:
     companion to the train bench; reports prefill latency, tokens/s and
     achieved HBM bandwidth vs peak)."""
     return _decode_result("decode")
+
+
+def _run_decode_ragged() -> dict:
+    """Decode through the Pallas ragged-attention kernel
+    (ops/ragged_decode.py): reads only live cache rows. Compared against
+    the plain `decode` row, this measures whether skipping dead cache
+    blocks beats XLA's fused einsum at the bench shape."""
+    return _decode_result("decode_ragged", decode_attn="ragged")
 
 
 def _run_decode_int8kv() -> dict:
@@ -435,6 +446,7 @@ def _run_allocated() -> dict:
 WORKLOADS = {
     "probe": _run_probe,
     "decode_int8kv": _run_decode_int8kv,
+    "decode_ragged": _run_decode_ragged,
     "usage_live": _run_usage_live,
     "matmul": _run_matmul,
     "train": _run_train,

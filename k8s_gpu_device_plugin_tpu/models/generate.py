@@ -135,6 +135,26 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
     b, t, hq, hd = q.shape
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
+    if t == 1 and k_scale is None and cfg.decode_attn == "ragged":
+        # Pallas ragged decode: stream only each row's live cache prefix
+        # (ops/ragged_decode.py); opt-in until a hardware window confirms
+        # the win. Live rows are positions <= length (the current token's
+        # row was just written at index `length`), hence the +1.
+        from k8s_gpu_device_plugin_tpu.ops import ragged_decode
+
+        interpret = jax.default_backend() != "tpu"
+        # interpret mode relaxes only the TPU-build check; the SHAPE
+        # gates still apply (unsupported shapes fall back to XLA)
+        if ragged_decode.supports(q, k_cache, require_pltpu=not interpret):
+            lens = (
+                jnp.full((b,), length, jnp.int32)
+                if jnp.ndim(length) == 0
+                else length.astype(jnp.int32)
+            ) + 1
+            return ragged_decode.ragged_decode_attention(
+                q, k_cache, v_cache, lens, scale=hd ** -0.5,
+                window=cfg.sliding_window, interpret=interpret,
+            )
     # bf16 operands + f32 accumulation (MXU native rate); the cache is
     # never upcast in HBM — decode is bandwidth-bound. int8 caches keep
     # the int8 arrays as the dot operands (a bare convert fuses into the

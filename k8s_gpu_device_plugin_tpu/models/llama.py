@@ -72,6 +72,11 @@ class LlamaConfig:
     param_dtype: Any = None
     remat: bool = True
     attn_impl: str = "auto"  # auto | full | ring | ulysses
+    # decode-time cached attention: "auto"/"xla" = the fused XLA einsum
+    # path; "ragged" opts into the Pallas kernel that streams only live
+    # cache rows (ops/ragged_decode.py; bf16 caches, T=1) — flip the
+    # default once a hardware window confirms the win
+    decode_attn: str = "auto"
     # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
     # int8 path (ops/quant.py: quantized fwd, bf16 bwd); "none" = pure bf16.
     quant: str = "none"
@@ -103,6 +108,11 @@ class LlamaConfig:
             raise ValueError(
                 f"quant must be 'none' or 'int8', got {self.quant!r} — "
                 "an unknown value would silently run pure bf16"
+            )
+        if self.decode_attn not in ("auto", "xla", "ragged"):
+            raise ValueError(
+                f"decode_attn must be 'auto', 'xla' or 'ragged', got "
+                f"{self.decode_attn!r}"
             )
         if self.cache_quant not in ("none", "int8", "int4"):
             raise ValueError(
