@@ -21,8 +21,18 @@ Wedge budgeting (the round-3 postmortem: 963s spent learning "wedged"):
 - Two consecutive all-attempts-timed-out workloads trigger a re-probe;
   if the chip is gone, remaining TPU workloads are skipped.
 
+Journal fallback (the round-4 reality: the chip comes alive for ~15-minute
+windows hours apart, and the driver's end-of-round bench run may land in a
+wedge). ``tools/harvest.py`` journals every hardware measurement to
+``harvest_results.jsonl`` the moment it lands. When a live workload here
+fails (or the probe says wedge), the slot is filled from the freshest
+journaled SAME-ROUND measurement (bounded age), clearly labeled in the
+payload under ``journal`` with per-workload ages — the value is still a
+real-hardware number from this round, just measured earlier in it.
+
 Test knobs (env): ``BENCH_PROBE_TIMEOUT`` overrides the probe timeout;
-``BENCH_TEST_FORCE_WEDGE=1`` makes the probe child hang (simulated wedge).
+``BENCH_TEST_FORCE_WEDGE=1`` makes the probe child hang (simulated wedge);
+``BENCH_JOURNAL_PATH`` points the fallback at a different journal file.
 """
 
 from __future__ import annotations
@@ -41,6 +51,10 @@ BACKOFF_SECONDS = 30.0
 DEADLINE_SECONDS = 1500.0  # global budget; retries stop when exceeded
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
 PARTIALS_PATH = os.path.join(REPO_ROOT, "bench_partials.jsonl")
+JOURNAL_PATH = os.environ.get(
+    "BENCH_JOURNAL_PATH", os.path.join(REPO_ROOT, "harvest_results.jsonl")
+)
+JOURNAL_MAX_AGE_SECONDS = 48 * 3600.0  # same-round bound for adopted entries
 
 _T0 = time.monotonic()
 _consecutive_timeouts = 0  # workloads whose every attempt timed out
@@ -159,6 +173,46 @@ def run_workload(
     return None
 
 
+def journal_row_ok(rec) -> bool:
+    """One definition of 'this journal row landed': shared with
+    tools/harvest.py's --resume so adoption and resume can never disagree
+    on which rows count."""
+    if not isinstance(rec, dict):
+        return False
+    result = rec.get("result")
+    return isinstance(result, dict) and "error" not in result
+
+
+def _journal_results() -> dict[str, tuple[dict, float]]:
+    """Latest successful hardware measurement per journal row, with its
+    measurement unix time. Rows journaled by ``tools/harvest.py`` carry a
+    ``ts``; older files fall back to the journal's mtime. Entries past
+    JOURNAL_MAX_AGE_SECONDS are dropped — the fallback exists to surface
+    THIS round's scarce-window measurements, not stale history."""
+    out: dict[str, tuple[dict, float]] = {}
+    try:
+        mtime = os.path.getmtime(JOURNAL_PATH)
+        with open(JOURNAL_PATH) as f:
+            lines = f.readlines()
+    except OSError:
+        return out
+    now = time.time()
+    for line in lines:
+        # any single bad line (truncated write, non-dict JSON, junk ts) is
+        # skipped — the one-JSON-line-on-stdout contract outranks it
+        try:
+            rec = json.loads(line.strip())
+            if not journal_row_ok(rec):
+                continue
+            ts = float(rec.get("ts") or mtime)
+            if now - ts > JOURNAL_MAX_AGE_SECONDS:
+                continue
+            out[rec.get("workload", "")] = (rec["result"], ts)  # later wins
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
 def probe_chip(platforms: tuple[str | None, ...]) -> bool:
     """Fast up-front liveness check: a tiny matmul child with a short
     timeout. Round 3 spent 963s of a scarce hardware window discovering a
@@ -225,7 +279,46 @@ def main() -> int:
     decode_int8w = secondary("decode_int8w", 420, decode, 180)
     decode_int4w = secondary("decode_int4w", 420, decode_int8w, 160)
 
+    # Journal fallback: any slot the live run could not fill adopts the
+    # freshest same-round hardware measurement from tools/harvest.py's
+    # journal, labeled below with its age. "train_tuned" is the same train
+    # workload re-timed after flash_tune persisted its winners (same model,
+    # same objective), so it may carry the train slot when both exist.
+    journal = _journal_results()
+    adopted: dict[str, float] = {}
+
+    def _adopt(live: dict | None, *rows: str) -> dict | None:
+        if live is not None:
+            return live
+        for row in rows:
+            hit = journal.get(row)
+            if hit is not None:
+                adopted[row] = hit[1]  # label the row actually matched
+                return hit[0]
+        return None
+
+    matmul = _adopt(matmul, "matmul")
+    train = _adopt(train, "train_tuned", "train")
+    allocated = _adopt(allocated, "allocated")
+    train_fusedopt = _adopt(train_fusedopt, "train_fusedopt")
+    train_int8 = _adopt(train_int8, "train_int8")
+    decode = _adopt(decode, "decode")
+    decode_int8w = _adopt(decode_int8w, "decode_int8w")
+    decode_int4w = _adopt(decode_int4w, "decode_int4w")
+
     extra: dict = {}
+    if adopted:
+        extra["journal"] = {
+            "path": os.path.relpath(JOURNAL_PATH, REPO_ROOT),
+            "adopted_age_seconds": {
+                w: round(time.time() - ts, 1) for w, ts in adopted.items()
+            },
+            "note": (
+                "the live run could not measure these workloads; values are "
+                "this round's live-hardware measurements journaled by "
+                "tools/harvest.py"
+            ),
+        }
     if matmul:
         extra["matmul_bf16_mfu_pct"] = matmul["mfu_pct"]
         extra["matmul_tflops"] = matmul["tflops"]
@@ -319,6 +412,22 @@ def main() -> int:
             "error": reason,
             **extra,
         }
+
+    if adopted:
+        # value is real (journaled same-round hardware); the live failure
+        # is still surfaced, under a name that can't read as a bad value.
+        # Any adoption implies a live miss — probe failure, mid-run wedge,
+        # gating off an earlier failure, or deadline exhaustion.
+        reason = (
+            "TPU chip unreachable at bench time (probe failed)"
+            if not chip_live
+            else f"live run could not measure {sorted(adopted)} "
+            "(mid-run wedge, gating, or deadline)"
+        )
+        payload.setdefault(
+            "live_error",
+            f"{reason}; journaled same-round hardware values adopted",
+        )
 
     print(json.dumps(payload))
     return 0

@@ -92,10 +92,48 @@ def persist(workload: str, result: dict | None) -> None:
             f.write(json.dumps({
                 "workload": workload,
                 "t": round(time.monotonic() - _T0, 1),
+                "ts": round(time.time(), 1),  # bench.py's fallback ages by this
                 "result": result,
             }) + "\n")
     except OSError as e:  # journaling must never kill the run
         log(f"persist failed: {e}")
+
+
+def landed_rows() -> set[str]:
+    """Row names with a successful result already in the journal (the
+    row-validity predicate is bench.journal_row_ok — one definition shared
+    with the driver's adoption fallback)."""
+    done: set[str] = set()
+    try:
+        with open(RESULTS_PATH) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if bench.journal_row_ok(rec):
+                    done.add(rec.get("workload", ""))
+    except OSError:
+        pass
+    return done
+
+
+def bench_running() -> bool:
+    """True if the driver's bench.py is running — libtpu is single-client,
+    and the driver's end-of-round artifact must never lose the chip to a
+    background harvest."""
+    try:
+        out = subprocess.run(
+            ["pgrep", "-f", r"python[0-9.]* .*bench\.py"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+        return any(line.strip().isdigit() and int(line) != os.getpid()
+                   for line in out.splitlines())
+    except Exception:  # noqa: BLE001 - a broken pgrep must not stop harvest
+        return False
 
 
 def _archive_tilings() -> None:
@@ -120,7 +158,9 @@ def probe(attempt: int = 0) -> bool:
 
 
 def main() -> int:
-    only = sys.argv[1:]
+    argv = sys.argv[1:]
+    resume = "--resume" in argv
+    only = [a for a in argv if a != "--resume"]
     known = {name for name, _, _ in QUEUE}
     unknown = [w for w in only if w not in known]
     if unknown:
@@ -129,6 +169,15 @@ def main() -> int:
               file=sys.stderr)
         return 2
     queue = [row for row in QUEUE if not only or row[0] in only]
+    if resume:
+        done_rows = landed_rows()
+        queue = [row for row in queue if row[0] not in done_rows]
+        if not queue:
+            log("--resume: every queued row already landed; nothing to do")
+            return 3  # distinct rc so a watchdog loop knows to stop
+    if bench_running():
+        log("bench.py is running (single-client chip) — refusing to start")
+        return 4
 
     log(f"probing chip (queue: {[name for name, _, _ in queue]})")
     # remember WHICH platform fallback answered: workloads and retries run
@@ -142,6 +191,9 @@ def main() -> int:
     done = 0
     archived = False
     for name, workload, timeout in queue:
+        if bench_running():
+            log("bench.py started mid-harvest — yielding the chip to it")
+            break
         if workload == "flash_tune" and not archived:
             # Archive stale tilings RIGHT BEFORE the sweep replaces them
             # (not at startup — a dead probe or an earlier-row wedge must
