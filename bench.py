@@ -183,15 +183,27 @@ def journal_row_ok(rec) -> bool:
     return isinstance(result, dict) and "error" not in result
 
 
+def journal_row_fresh(rec, now: float | None = None) -> bool:
+    """Row is recent enough to count (adoption AND --resume use this — a
+    row only one of them honors would strand a slot: resume skips it as
+    done while adoption drops it as stale). Requires an explicit ``ts``:
+    a file-mtime fallback would refresh on every append, laundering
+    prior-round rows as fresh."""
+    try:
+        ts = float(rec["ts"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    return (now or time.time()) - ts <= JOURNAL_MAX_AGE_SECONDS
+
+
 def _journal_results() -> dict[str, tuple[dict, float]]:
     """Latest successful hardware measurement per journal row, with its
-    measurement unix time. Rows journaled by ``tools/harvest.py`` carry a
-    ``ts``; older files fall back to the journal's mtime. Entries past
+    measurement unix time (rows journaled by ``tools/harvest.py`` carry a
+    ``ts``; rows without one never qualify). Entries past
     JOURNAL_MAX_AGE_SECONDS are dropped — the fallback exists to surface
     THIS round's scarce-window measurements, not stale history."""
     out: dict[str, tuple[dict, float]] = {}
     try:
-        mtime = os.path.getmtime(JOURNAL_PATH)
         with open(JOURNAL_PATH) as f:
             lines = f.readlines()
     except OSError:
@@ -202,12 +214,9 @@ def _journal_results() -> dict[str, tuple[dict, float]]:
         # skipped — the one-JSON-line-on-stdout contract outranks it
         try:
             rec = json.loads(line.strip())
-            if not journal_row_ok(rec):
+            if not (journal_row_ok(rec) and journal_row_fresh(rec, now)):
                 continue
-            ts = float(rec.get("ts") or mtime)
-            if now - ts > JOURNAL_MAX_AGE_SECONDS:
-                continue
-            out[rec.get("workload", "")] = (rec["result"], ts)  # later wins
+            out[rec.get("workload", "")] = (rec["result"], float(rec["ts"]))
         except (ValueError, TypeError):
             continue
     return out

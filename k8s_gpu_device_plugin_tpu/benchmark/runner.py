@@ -211,6 +211,21 @@ def _run_train_fusedopt() -> dict:
     return _train_result("train_fusedopt", quant="none", opt_impl="fused")
 
 
+def _run_remat_tune() -> dict:
+    """Sweep the remat dial on the bench proxy model: each variant is the
+    SAME train step (identical numerics, tests/test_remat_policies.py) at
+    a different point on the HBM-vs-recompute curve. The winner is a
+    measured answer to 'how much step time does the default policy's
+    recompute cost' (VERDICT r3: one of the 55->83 levers)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.train_bench import remat_tune
+
+    _require_accelerator()
+    base = _bench_model_cfg()
+    r = remat_tune(base, batch_size=BENCH_BATCH, seq_len=BENCH_SEQ,
+                   steps=3, warmup=2)
+    return {"workload": "remat_tune", **r, "model": _model_dims(base)}
+
+
 def _run_breakdown() -> dict:
     """Differential step-time breakdown on the bench proxy model (dev tool;
     not part of the driver's JSON line — run via
@@ -458,6 +473,7 @@ WORKLOADS = {
     "flash_tune": _run_flash_tune,
     "flash_tune_long": _run_flash_tune_long,
     "opt_tune": _run_opt_tune,
+    "remat_tune": _run_remat_tune,
     "serve": _run_serve,
     "decode": _run_decode,
     "decode_int8w": _run_decode_int8w,

@@ -10,7 +10,7 @@ same arithmetic shape is used and the math is identical.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import jax
 
@@ -85,3 +85,46 @@ def train_mfu(
         step_seconds=seconds,
         n_devices=n,
     )
+
+
+REMAT_VARIANTS: tuple[tuple[str, dict], ...] = (
+    ("save_dots_attn", {"remat": True, "remat_policy": "save_dots_attn"}),
+    ("save_dots", {"remat": True, "remat_policy": "save_dots"}),
+    ("save_nothing", {"remat": True, "remat_policy": "save_nothing"}),
+    ("no_remat", {"remat": False}),  # save everything: zero recompute
+)
+
+
+def remat_tune(
+    base: LlamaConfig,
+    batch_size: int,
+    seq_len: int,
+    steps: int = 3,
+    warmup: int = 2,
+    variants: tuple[tuple[str, dict], ...] = REMAT_VARIANTS,
+    **train_kwargs,
+) -> dict:
+    """Time the SAME train step under each remat setting (a pure
+    HBM-vs-recompute dial — numerics pinned identical by
+    tests/test_remat_policies.py). A variant that fails to run (e.g.
+    no_remat's full activation set OOMing next to the optimizer state) is
+    recorded per-variant and the sweep continues, like flash_tune's
+    per-config errors: one infeasible point is data, not a crash."""
+    step_ms: dict[str, float | str] = {}
+    mfu: dict[str, float] = {}
+    for name, overrides in variants:
+        try:
+            cfg = replace(base, **overrides)
+            r = train_mfu(cfg, batch_size=batch_size, seq_len=seq_len,
+                          steps=steps, warmup=warmup, **train_kwargs)
+        except Exception as e:  # noqa: BLE001 - one variant OOMing is data
+            step_ms[name] = f"error: {type(e).__name__}"
+            continue
+        step_ms[name] = round(r.step_seconds * 1000, 2)
+        mfu[name] = round(r.mfu * 100, 2)
+    measured = {k: v for k, v in step_ms.items() if not isinstance(v, str)}
+    return {
+        "step_ms": step_ms,
+        "mfu_pct": mfu,
+        "best": min(measured, key=measured.get) if measured else None,
+    }

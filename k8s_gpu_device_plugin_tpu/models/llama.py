@@ -71,6 +71,16 @@ class LlamaConfig:
     # param/grad/moment HBM.
     param_dtype: Any = None
     remat: bool = True
+    # What the block checkpoint saves (only read when remat=True); numerics
+    # are identical across policies — this is a pure HBM-vs-recompute dial,
+    # sweepable on hardware via the ``remat_tune`` bench workload:
+    #   "save_dots_attn"  projection/MLP dots + the named attention output
+    #                     (default: backward recomputes only VPU elementwise)
+    #   "save_dots"       dots only — the flash forward is re-run in the
+    #                     backward, trading MXU time for activation HBM
+    #   "save_nothing"    full remat: minimum activation HBM, maximum
+    #                     recompute (the long-context / big-model setting)
+    remat_policy: str = "save_dots_attn"
     attn_impl: str = "auto"  # auto | full | ring | ulysses
     # decode-time cached attention: "auto"/"xla" = the fused XLA einsum
     # path; "ragged" opts into the Pallas kernel that streams only live
@@ -113,6 +123,13 @@ class LlamaConfig:
             raise ValueError(
                 f"decode_attn must be 'auto', 'xla' or 'ragged', got "
                 f"{self.decode_attn!r}"
+            )
+        if self.remat_policy not in (
+            "save_dots_attn", "save_dots", "save_nothing"
+        ):
+            raise ValueError(
+                f"remat_policy must be 'save_dots_attn', 'save_dots' or "
+                f"'save_nothing', got {self.remat_policy!r}"
             )
         if self.cache_quant not in ("none", "int8", "int4"):
             raise ValueError(
@@ -456,16 +473,24 @@ def forward_with_aux(
 
     block = partial(_block, cfg=cfg, positions=positions, mesh=mesh)
     if cfg.remat:
-        # Projection/MLP dot outputs are saveable (no batch dims), plus the
-        # named attention output — everything recomputed in the backward is
-        # then cheap VPU elementwise (norms, rope, silu), never the flash
-        # kernel or an MXU matmul.
-        policy = jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names(
-                "attn_out", "quant_dot"
-            ),
-        )
+        # Default ("save_dots_attn"): projection/MLP dot outputs are
+        # saveable (no batch dims), plus the named attention output —
+        # everything recomputed in the backward is then cheap VPU
+        # elementwise (norms, rope, silu), never the flash kernel or an
+        # MXU matmul. The other policies trade along the HBM/recompute
+        # axis; all are numerics-identical (same ops, different schedule).
+        if cfg.remat_policy == "save_nothing":
+            policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            names = (
+                ("attn_out", "quant_dot")
+                if cfg.remat_policy == "save_dots_attn"
+                else ("quant_dot",)
+            )
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(*names),
+            )
         block = jax.checkpoint(block, policy=policy)
 
     pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1

@@ -57,6 +57,7 @@ QUEUE: list[tuple[str, str, float]] = [
     ("train_fusedopt", "train_fusedopt", 480),  # fused AdamW
     ("train_int8", "train_int8", 480),          # MXU double-rate path
     ("opt_tune", "opt_tune", 600),
+    ("remat_tune", "remat_tune", 900),  # HBM-vs-recompute dial, 4 variants
     ("decode", "decode", 420),        # serving economics, never on hw
     ("decode_int8w", "decode_int8w", 420),
     ("decode_int4w", "decode_int4w", 420),
@@ -100,9 +101,10 @@ def persist(workload: str, result: dict | None) -> None:
 
 
 def landed_rows() -> set[str]:
-    """Row names with a successful result already in the journal (the
-    row-validity predicate is bench.journal_row_ok — one definition shared
-    with the driver's adoption fallback)."""
+    """Row names with a successful, still-fresh result in the journal.
+    The validity AND freshness predicates are bench.py's — shared, so
+    --resume and the driver's adoption fallback can never disagree: a row
+    --resume would skip is exactly a row adoption would use."""
     done: set[str] = set()
     try:
         with open(RESULTS_PATH) as f:
@@ -114,26 +116,31 @@ def landed_rows() -> set[str]:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if bench.journal_row_ok(rec):
+                if bench.journal_row_ok(rec) and bench.journal_row_fresh(rec):
                     done.add(rec.get("workload", ""))
     except OSError:
         pass
     return done
 
 
-def bench_running() -> bool:
-    """True if the driver's bench.py is running — libtpu is single-client,
-    and the driver's end-of-round artifact must never lose the chip to a
-    background harvest."""
-    try:
-        out = subprocess.run(
-            ["pgrep", "-f", r"python[0-9.]* .*bench\.py"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout
-        return any(line.strip().isdigit() and int(line) != os.getpid()
-                   for line in out.splitlines())
-    except Exception:  # noqa: BLE001 - a broken pgrep must not stop harvest
-        return False
+def chip_contended() -> bool:
+    """True if another process that takes the single-client libtpu runtime
+    is active: the driver's bench.py (its end-of-round artifact must never
+    lose the chip to a background harvest) or a second harvest.py (the
+    watchdog and a manual run must not race each other into the window)."""
+    me = os.getpid()
+    for pattern in (r"python[0-9.]* .*bench\.py", r"python[0-9.]* .*harvest\.py"):
+        try:
+            out = subprocess.run(
+                ["pgrep", "-f", pattern],
+                capture_output=True, text=True, timeout=10,
+            ).stdout
+            if any(line.strip().isdigit() and int(line) not in (me, os.getppid())
+                   for line in out.splitlines()):
+                return True
+        except Exception:  # noqa: BLE001 - broken pgrep must not stop harvest
+            continue
+    return False
 
 
 def _archive_tilings() -> None:
@@ -175,8 +182,9 @@ def main() -> int:
         if not queue:
             log("--resume: every queued row already landed; nothing to do")
             return 3  # distinct rc so a watchdog loop knows to stop
-    if bench_running():
-        log("bench.py is running (single-client chip) — refusing to start")
+    if chip_contended():
+        log("bench.py or another harvest is running (single-client chip) "
+            "— refusing to start")
         return 4
 
     log(f"probing chip (queue: {[name for name, _, _ in queue]})")
@@ -191,8 +199,9 @@ def main() -> int:
     done = 0
     archived = False
     for name, workload, timeout in queue:
-        if bench_running():
-            log("bench.py started mid-harvest — yielding the chip to it")
+        if chip_contended():
+            log("bench.py or another harvest started mid-run — yielding "
+                "the chip")
             break
         if workload == "flash_tune" and not archived:
             # Archive stale tilings RIGHT BEFORE the sweep replaces them
