@@ -193,7 +193,8 @@ def journal_row_fresh(rec, now: float | None = None) -> bool:
         ts = float(rec["ts"])
     except (KeyError, TypeError, ValueError):
         return False
-    return (now or time.time()) - ts <= JOURNAL_MAX_AGE_SECONDS
+    now = time.time() if now is None else now
+    return now - ts <= JOURNAL_MAX_AGE_SECONDS
 
 
 def _journal_results() -> dict[str, tuple[dict, float]]:

@@ -1,0 +1,436 @@
+"""OpenAI-compatible façade over the inference server.
+
+``/v1/generate`` (serving/server.py) is the native API; these routes make
+the same engine a drop-in backend for the large ecosystem of OpenAI
+clients (SDKs, gateways, eval harnesses) — request/response translation
+only, no second serving path:
+
+- ``POST /v1/completions``: ``prompt`` is a string (tokenizer required)
+  or a token-id list (works on a token-ids-only server). ``n``,
+  ``stream``, ``stop`` (string or list of strings), ``max_tokens``
+  (default 16, as OpenAI's legacy endpoint), ``temperature``/``top_p``,
+  ``logprobs`` (any non-null value incl. 0 returns sampled-token
+  logprobs — the raw-distribution values the engine records; no top-k
+  alternatives).
+- ``POST /v1/chat/completions``: ``messages`` rendered through the HF
+  tokenizer's own chat template when it has one, else a minimal generic
+  template. ``max_tokens`` absent = the slot's remaining budget
+  (OpenAI's chat endpoint has no 16-token default). Streams emit
+  OpenAI-style role and content deltas.
+- ``GET /v1/models``: the single model this pod serves.
+
+OpenAI semantics honored beyond the envelope: a matched stop sequence is
+NEVER part of the returned text (the native API keeps it, like EOS) —
+non-streamed responses trim the matched suffix, and streams hold back
+the last ``max(stop)`` tokens (a suffix match can span exactly that
+many) until they can no longer complete a stop match. Sampling: ``temperature``/``top_p`` present builds a
+per-request Sampler (the absent knob gets OpenAI's 1.0 default); neither
+present runs the server's default sampler, so a speculative engine
+(shared sampler) still serves knob-less requests instead of 422ing all.
+
+Streaming text deltas use a prefix-stable decode: each chunk is the
+newly-stabilized suffix of ``decode(all tokens so far)``, so multi-token
+characters never stream as mojibake (a bare per-token decode would).
+
+No reference analogue: the reference is a device-plugin daemon
+(/root/reference/README.md:1-6); the serving surface is part of the
+workload stack this framework adds on top.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.serving.tokenizer import (
+    encode_stop_strings,
+    trim_stop_suffix,
+)
+
+MODEL_ID = "tpu-serving"  # echoed when requests omit "model"
+
+
+class _TextDiffer:
+    """Incremental token->text streaming without mojibake: emit only the
+    newly-stabilized text (multi-token UTF-8 sequences and subword merges
+    stay buffered until complete).
+
+    Windowed decode (the standard streaming-detokenizer shape): only the
+    tokens since the last stable emission are re-decoded per push, so a
+    long stream costs O(window) per token, not O(all tokens so far)."""
+
+    def __init__(self, tok) -> None:
+        self._tok = tok
+        self._ids: list[int] = []
+        self._prefix = 0  # window start: ids before this are fully emitted
+        self._read = 0    # ids[_prefix:_read] produced the last stable text
+
+    def push(self, token: int) -> str:
+        self._ids.append(int(token))
+        stable = self._tok.decode(self._ids[self._prefix:self._read])
+        full = self._tok.decode(self._ids[self._prefix:])
+        # a trailing replacement char means a partial multi-byte sequence:
+        # hold it back — the next token may complete it
+        if full.endswith("�") or len(full) <= len(stable) \
+                or not full.startswith(stable):
+            return ""
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return full[len(stable):]
+
+    def flush(self) -> str:
+        stable = self._tok.decode(self._ids[self._prefix:self._read])
+        full = self._tok.decode(self._ids[self._prefix:])
+        if full.startswith(stable):
+            return full[len(stable):]
+        return ""  # non-monotonic decode: everything already emitted best-effort
+
+
+def _render_chat(tokenizer, messages: list[dict]) -> list[int]:
+    """Messages -> prompt ids. An HF tokenizer with a chat template uses
+    it (the model was trained on that format); anything else gets a
+    minimal role-tagged template with a final assistant header."""
+    for m in messages:
+        if not isinstance(m, dict) or not isinstance(m.get("role"), str) \
+                or not isinstance(m.get("content"), str):
+            raise ValueError(
+                "each message needs string 'role' and 'content' fields"
+            )
+    hf = getattr(tokenizer, "_tok", None)
+    if hf is not None and getattr(hf, "chat_template", None):
+        return list(hf.apply_chat_template(
+            messages, add_generation_prompt=True, tokenize=True,
+        ))
+    text = "".join(
+        f"<|{m['role']}|>\n{m['content']}\n" for m in messages
+    ) + "<|assistant|>\n"
+    return tokenizer.encode(text)
+
+
+class _OpenAIRoutes:
+    """Handlers bound to an InferenceServer (engine + tokenizer)."""
+
+    def __init__(self, server) -> None:
+        self._server = server
+
+    # --- request parsing -------------------------------------------------
+
+    def _prompt_ids(self, body: dict) -> list[int]:
+        prompt = body.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            if self._server.tokenizer is None:
+                raise ValueError(
+                    "string prompts need a tokenizer on this server; "
+                    "send a token-id list"
+                )
+            return self._server.tokenizer.encode(prompt)
+        if (
+            isinstance(prompt, list) and prompt
+            and all(isinstance(t, int) for t in prompt)
+        ):
+            return list(prompt)
+        raise ValueError(
+            "prompt must be a non-empty string or list of token ids "
+            "(batched prompt lists are not supported)"
+        )
+
+    def _common(self, body: dict) -> dict:
+        """Fields shared by both endpoints, validated. ``max_new`` is None
+        when the request omitted max_tokens — each endpoint applies its
+        own default (16 for legacy completions, the slot budget for
+        chat)."""
+        n = int(body.get("n", 1))
+        if not (1 <= n <= 8):
+            raise ValueError("n must be in [1, 8]")
+        stream = bool(body.get("stream", False))
+        if stream and n > 1:
+            raise ValueError("streaming supports n=1 only")
+        max_new = body.get("max_tokens")
+        if max_new is not None:
+            max_new = int(max_new)
+            if max_new < 1:
+                raise ValueError("max_tokens must be >= 1")
+
+        stop = body.get("stop")
+        stop_lists: list[list[int]] = []
+        if stop is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            if isinstance(stop, list) and len(stop) > 4:
+                raise ValueError("stop supports at most 4 sequences")
+            stop_lists = encode_stop_strings(
+                self._server.tokenizer, stop, field="stop"
+            )
+
+        sampler = None
+        if "temperature" in body or "top_p" in body:
+            sampler = Sampler(
+                temperature=float(body.get("temperature", 1.0)),
+                top_p=float(body.get("top_p", 1.0)),
+            )
+        return {
+            "n": n, "stream": stream, "max_new": max_new,
+            "stop": stop_lists, "sampler": sampler,
+            "model": str(body.get("model") or MODEL_ID),
+        }
+
+    def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
+        """Resolve an absent max_tokens: the endpoint's fixed default, or
+        (chat) the slot's remaining token budget for this prompt."""
+        if c["max_new"] is not None:
+            return
+        if default is not None:
+            c["max_new"] = default
+            return
+        max_len = getattr(self._server.engine.cb, "max_len", 0)
+        c["max_new"] = max(1, max_len - len(prompt))
+
+    # --- engine plumbing -------------------------------------------------
+
+    def _submit(self, prompt: list[int], c: dict) -> list[tuple[int, asyncio.Queue]]:
+        return [
+            self._server.engine.submit(
+                prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"]
+            )
+            for _ in range(c["n"])
+        ]
+
+    @staticmethod
+    def _finish_reason(n_out: int, max_new: int) -> str:
+        # the engine retires on EOS/stop/cancel or budget; budget is the
+        # only case that fills it exactly (a stop match is trimmed before
+        # this is consulted, so a trimmed answer always reads 'stop')
+        return "length" if n_out >= max_new else "stop"
+
+    def _decode(self, ids: list[int]) -> str:
+        if self._server.tokenizer is None:
+            return ""
+        return self._server.tokenizer.decode(ids)
+
+    # --- endpoints -------------------------------------------------------
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": MODEL_ID, "object": "model", "created": 0,
+                "owned_by": "tpu-device-plugin",
+            }],
+        })
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            c = self._common(body)
+            prompt = self._prompt_ids(body)
+            self._budget(c, prompt, default=16)  # OpenAI's legacy default
+            lp = body.get("logprobs")
+            want_logprobs = lp is not None and lp is not False  # 0 counts
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            return _oai_error(str(e), 400)
+        return await self._respond(
+            request, prompt, c, want_logprobs,
+            object_name="text_completion", id_prefix="cmpl", chat=False,
+        )
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            c = self._common(body)
+            if self._server.tokenizer is None:
+                raise ValueError(
+                    "chat completions need a tokenizer on this server"
+                )
+            messages = body.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ValueError("messages must be a non-empty list")
+            prompt = _render_chat(self._server.tokenizer, messages)
+            self._budget(c, prompt, default=None)  # chat: the slot budget
+            want_logprobs = bool(body.get("logprobs", False))
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            return _oai_error(str(e), 400)
+        return await self._respond(
+            request, prompt, c, want_logprobs,
+            object_name="chat.completion", id_prefix="chatcmpl", chat=True,
+        )
+
+    async def _respond(
+        self, request: web.Request, prompt: list[int], c: dict,
+        want_logprobs: bool, object_name: str, id_prefix: str, chat: bool,
+    ) -> web.StreamResponse:
+        try:
+            subs = self._submit(prompt, c)
+        except ValueError as e:  # capacity/bucket/sampler validation
+            return _oai_error(str(e), 422)
+        except RuntimeError as e:  # engine dead
+            return _oai_error(str(e), 503)
+        rid = subs[0][0]
+        oai_id = f"{id_prefix}-{rid}"
+        created = int(time.time())
+
+        if c["stream"]:
+            return await self._stream(
+                request, subs[0][1], oai_id, created, c, chat, rid,
+                want_logprobs, object_name,
+            )
+
+        from k8s_gpu_device_plugin_tpu.serving.server import drain_queue
+
+        try:
+            drained = await asyncio.gather(*(drain_queue(q) for _, q in subs))
+        except asyncio.CancelledError:
+            for eid, _ in subs:
+                self._server.engine.cancel(eid)
+            raise
+        choices = []
+        completion_tokens = 0
+        for i, (toks, lps) in enumerate(drained):
+            # OpenAI: the matched stop sequence is never in the output
+            kept = trim_stop_suffix(toks, c["stop"])
+            lps = lps[:len(kept)]
+            completion_tokens += len(kept)
+            finish = (
+                "stop" if len(kept) < len(toks)
+                else self._finish_reason(len(toks), c["max_new"])
+            )
+            text = self._decode(kept)
+            choice: dict = {"index": i, "finish_reason": finish}
+            if chat:
+                choice["message"] = {"role": "assistant", "content": text}
+                if want_logprobs:
+                    choice["logprobs"] = {"content": [
+                        {"token": self._decode([t]), "logprob": lp}
+                        for t, lp in zip(kept, lps)
+                    ]}
+            else:
+                choice["text"] = text
+                if want_logprobs:
+                    choice["logprobs"] = {
+                        "tokens": [self._decode([t]) for t in kept],
+                        "token_logprobs": lps,
+                    }
+            choices.append(choice)
+        return web.json_response({
+            "id": oai_id,
+            "object": object_name,
+            "created": created,
+            "model": c["model"],
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": len(prompt),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt) + completion_tokens,
+            },
+        })
+
+    async def _stream(
+        self, request: web.Request, q: asyncio.Queue, oai_id: str,
+        created: int, c: dict, chat: bool, rid: int, want_logprobs: bool,
+        object_name: str,
+    ) -> web.StreamResponse:
+        chunk_object = "chat.completion.chunk" if chat else object_name
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        def chunk(text: str, lp: float | None, finish: str | None) -> bytes:
+            choice: dict = {"index": 0, "finish_reason": finish}
+            if chat:
+                choice["delta"] = {"content": text} if finish is None else {}
+                if lp is not None:
+                    choice["logprobs"] = {"content": [
+                        {"token": text, "logprob": lp}
+                    ]}
+            else:
+                choice["text"] = text
+                if lp is not None:
+                    choice["logprobs"] = {
+                        "tokens": [text], "token_logprobs": [lp],
+                    }
+            evt = {
+                "id": oai_id, "object": chunk_object, "created": created,
+                "model": c["model"], "choices": [choice],
+            }
+            return f"data: {json.dumps(evt)}\n\n".encode()
+
+        differ = (
+            _TextDiffer(self._server.tokenizer)
+            if self._server.tokenizer is not None else None
+        )
+        # OpenAI never streams a stop sequence: hold back the last
+        # max(stop) tokens — a suffix match can span exactly that many,
+        # and anything older can no longer be part of one.
+        hold = max((len(s) for s in c["stop"]), default=0)
+        pending: list[tuple[int, float]] = []
+        all_out: list[int] = []
+
+        async def release(tok: int, lp: float) -> None:
+            # token-ids-only server: text is always "" (matching the
+            # non-streamed path — ids belong to the native /v1/generate
+            # API); the stream still carries logprobs when asked
+            text = differ.push(tok) if differ is not None else ""
+            if text or want_logprobs:
+                await resp.write(chunk(
+                    text, lp if want_logprobs else None, None
+                ))
+
+        try:
+            if chat:
+                role = {"index": 0, "finish_reason": None,
+                        "delta": {"role": "assistant"}}
+                await resp.write(f"data: {json.dumps({'id': oai_id, 'object': chunk_object, 'created': created, 'model': c['model'], 'choices': [role]})}\n\n".encode())
+            while True:
+                item = await q.get()
+                if item is None:
+                    kept = trim_stop_suffix(all_out, c["stop"])
+                    stopped = len(kept) < len(all_out)
+                    # flush pending tokens that survive the trim
+                    drop = len(all_out) - len(kept)
+                    for tok, lp in pending[:len(pending) - drop]:
+                        await release(tok, lp)
+                    tail = differ.flush() if differ is not None else ""
+                    if tail:
+                        await resp.write(chunk(tail, None, None))
+                    finish = (
+                        "stop" if stopped
+                        else self._finish_reason(len(all_out), c["max_new"])
+                    )
+                    await resp.write(chunk("", None, finish))
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+                all_out.append(item[0])
+                pending.append(item)
+                while len(pending) > hold:
+                    tok, lp = pending.pop(0)
+                    await release(tok, lp)
+        except (asyncio.CancelledError, ConnectionResetError):
+            self._server.engine.cancel(rid)
+            raise
+        await resp.write_eof()
+        return resp
+
+
+def _oai_error(message: str, status: int) -> web.Response:
+    """OpenAI error envelope (clients pattern-match on error.message)."""
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error",
+                   "code": None}},
+        status=status,
+    )
+
+
+def add_openai_routes(server) -> None:
+    """Register the OpenAI-compatible routes on an InferenceServer."""
+    api = _OpenAIRoutes(server)
+    server.app.router.add_post("/v1/completions", api.completions)
+    server.app.router.add_post("/v1/chat/completions", api.chat_completions)
+    server.app.router.add_get("/v1/models", api.models)

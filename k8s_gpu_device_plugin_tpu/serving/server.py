@@ -34,6 +34,10 @@ API (JSON over HTTP, SSE for streaming):
 - ``GET /v1/health``     {"slots", "active", "prefilling", "queued"}
 - ``GET /metrics``       Prometheus text (ServingMetrics +
   whatever else lives on the registry)
+- ``POST /v1/completions``, ``POST /v1/chat/completions``,
+  ``GET /v1/models`` — OpenAI-compatible façade over the same engine
+  (serving/openai_api.py): existing OpenAI SDKs/clients point at this
+  server unchanged.
 
 Design notes: the batcher is synchronous by construction (a jitted step
 per token); the engine thread is its sole owner, and handlers never wait
@@ -285,6 +289,19 @@ class InferenceEngine:
                 loop.call_soon_threadsafe(q.put_nowait, None)
 
 
+async def drain_queue(queue: asyncio.Queue) -> tuple[list[int], list[float]]:
+    """Collect one request's full (tokens, logprobs) off its stream queue
+    (None = end-of-stream). Shared by the native and OpenAI handlers."""
+    toks: list[int] = []
+    lps: list[float] = []
+    while True:
+        item = await queue.get()
+        if item is None:
+            return toks, lps
+        toks.append(item[0])
+        lps.append(item[1])
+
+
 class InferenceServer:
     """aiohttp app over an InferenceEngine (port 0 = ephemeral)."""
 
@@ -304,6 +321,13 @@ class InferenceServer:
         self.app.router.add_get("/v1/health", self._health)
         if registry is not None:
             self.app.router.add_get("/metrics", self._metrics)
+        # OpenAI-compatible façade (serving/openai_api.py): /v1/completions,
+        # /v1/chat/completions, /v1/models — same engine, translated I/O
+        from k8s_gpu_device_plugin_tpu.serving.openai_api import (
+            add_openai_routes,
+        )
+
+        add_openai_routes(self)
 
     async def _health(self, request: web.Request) -> web.Response:
         stats = self.engine.stats()
@@ -373,35 +397,13 @@ class InferenceServer:
             ):
                 raise ValueError("stop must be a list of token-id lists")
             if stop_text:
-                # Caveat: standalone encoding can differ from in-context
-                # BPE merges; exact for byte-level tokenizers, best-effort
-                # for subword ones (same trade-off every text-stop API
-                # with token-level matching makes).
-                if self.tokenizer is None:
-                    raise ValueError(
-                        "stop_text requires a tokenizer on this server"
-                    )
-                if not isinstance(stop_text, list) or not all(
-                    isinstance(s, str) and s for s in stop_text
-                ):
-                    raise ValueError(
-                        "stop_text must be a list of non-empty strings"
-                    )
-                # encode_plain (no BOS/special tokens): stop sequences
-                # must match a run of generated output
-                enc_stop = getattr(
-                    self.tokenizer, "encode_plain", self.tokenizer.encode
+                from k8s_gpu_device_plugin_tpu.serving.tokenizer import (
+                    encode_stop_strings,
                 )
-                stop = list(stop)
-                for s in stop_text:
-                    enc = enc_stop(s)
-                    if not enc:
-                        # silently dropping it would leave the client
-                        # believing the stop is armed
-                        raise ValueError(
-                            f"stop_text entry {s!r} encodes to no tokens"
-                        )
-                    stop.append(enc)
+
+                stop = list(stop) + encode_stop_strings(
+                    self.tokenizer, stop_text, field="stop_text"
+                )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
@@ -417,18 +419,10 @@ class InferenceServer:
         rid, q = subs[0]
 
         if not stream:
-            async def drain(queue):
-                toks: list[int] = []
-                lps: list[float] = []
-                while True:
-                    item = await queue.get()
-                    if item is None:
-                        return toks, lps
-                    toks.append(item[0])
-                    lps.append(item[1])
-
             try:
-                drained = await asyncio.gather(*(drain(q_) for _, q_ in subs))
+                drained = await asyncio.gather(
+                    *(drain_queue(q_) for _, q_ in subs)
+                )
             except asyncio.CancelledError:
                 # client gone mid-generation: free the slots instead of
                 # decoding to the token budget

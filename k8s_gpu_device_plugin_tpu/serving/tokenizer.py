@@ -92,3 +92,40 @@ def load_tokenizer(spec: str) -> TokenizerSeam | None:
     if spec == "byte":
         return ByteTokenizer()
     return HFTokenizer(spec)
+
+
+def encode_stop_strings(tokenizer, strings, field: str = "stop") -> list:
+    """Stop strings -> token-id lists, shared by the native and OpenAI
+    handlers so the encoding semantics (no special tokens; loud failure
+    when an entry normalizes away) can never drift between them.
+
+    Caveat carried from the native API: standalone encoding can differ
+    from in-context BPE merges — exact for byte-level tokenizers,
+    best-effort across subword merge boundaries.
+    """
+    if tokenizer is None:
+        raise ValueError(f"{field} requires a tokenizer on this server")
+    if not isinstance(strings, list) or not all(
+        isinstance(s, str) and s for s in strings
+    ):
+        raise ValueError(f"{field} must be a list of non-empty strings")
+    enc = getattr(tokenizer, "encode_plain", tokenizer.encode)
+    out: list[list[int]] = []
+    for s in strings:
+        ids = enc(s)
+        if not ids:
+            # silently dropping it would leave the client believing the
+            # stop is armed
+            raise ValueError(f"{field} entry {s!r} encodes to no tokens")
+        out.append(list(ids))
+    return out
+
+
+def trim_stop_suffix(tokens: list, stop: list) -> list:
+    """Drop a matched stop sequence from the end of ``tokens`` (OpenAI
+    semantics: returned text never includes the stop sequence; the native
+    API keeps it, like EOS)."""
+    for st in stop:
+        if len(st) <= len(tokens) and list(tokens[-len(st):]) == list(st):
+            return list(tokens[:-len(st)])
+    return list(tokens)

@@ -58,6 +58,7 @@ QUEUE: list[tuple[str, str, float]] = [
     ("train_int8", "train_int8", 480),          # MXU double-rate path
     ("opt_tune", "opt_tune", 600),
     ("remat_tune", "remat_tune", 900),  # HBM-vs-recompute dial, 4 variants
+    ("train_bs16", "train_bs16", 480),  # double batch: overhead amortization
     ("decode", "decode", 420),        # serving economics, never on hw
     ("decode_int8w", "decode_int8w", 420),
     ("decode_int4w", "decode_int4w", 420),
@@ -123,24 +124,65 @@ def landed_rows() -> set[str]:
     return done
 
 
-def chip_contended() -> bool:
-    """True if another process that takes the single-client libtpu runtime
-    is active: the driver's bench.py (its end-of-round artifact must never
-    lose the chip to a background harvest) or a second harvest.py (the
-    watchdog and a manual run must not race each other into the window)."""
-    me = os.getpid()
-    for pattern in (r"python[0-9.]* .*bench\.py", r"python[0-9.]* .*harvest\.py"):
-        try:
-            out = subprocess.run(
-                ["pgrep", "-f", pattern],
-                capture_output=True, text=True, timeout=10,
-            ).stdout
-            if any(line.strip().isdigit() and int(line) not in (me, os.getppid())
-                   for line in out.splitlines()):
-                return True
-        except Exception:  # noqa: BLE001 - broken pgrep must not stop harvest
+def _script_pids(script: str) -> list[int]:
+    """Pids of live ``python <script>`` processes (argv-exact /proc scan).
+
+    NOT pgrep -f: full-cmdline substring matching false-positives on any
+    process whose arguments merely mention the script — including this
+    session's own driver wrapper, whose embedded prompt text contains
+    both 'python' and 'bench.py' and would make a pgrep-based guard
+    refuse every harvest forever."""
+    me = (os.getpid(), os.getppid())
+    out: list[int] = []
+    for d in os.listdir("/proc"):
+        if not d.isdigit() or int(d) in me:
             continue
-    return False
+        try:
+            with open(f"/proc/{d}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if not argv or b"python" not in os.path.basename(argv[0]):
+            continue
+        # the script must BE an early argument (python [-u/-X ...] script),
+        # not a substring of some -c blob or prompt text
+        for a in argv[1:4]:
+            s = a.decode(errors="replace")
+            if s == script or s.endswith("/" + script):
+                out.append(int(d))
+                break
+    return out
+
+
+def _proc_start_ticks(pid: int) -> int:
+    """Kernel start time (clock ticks since boot; /proc/<pid>/stat field
+    22). Unreadable (gone/raced) reads as newest-possible so a vanished
+    process never outranks a live one."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().rsplit(") ", 1)[1].split()[19])
+    except Exception:  # noqa: BLE001
+        return 1 << 62
+
+
+def bench_running() -> bool:
+    """The driver's bench.py owns the chip unconditionally: its
+    end-of-round artifact must never lose the window to a harvest."""
+    return bool(_script_pids("bench.py"))
+
+
+def harvest_outranked() -> bool:
+    """True if an OLDER harvest.py is already running (start-time
+    tie-break, pid as the tiebreaker of last resort): exactly one of two
+    racing starts proceeds — no mutual refusal livelock — and a running
+    harvest is never evicted by a newcomer (the newcomer is the one that
+    backs off; mid-run checks use bench_running() only)."""
+    me = os.getpid()
+    mine = (_proc_start_ticks(me), me)
+    return any(
+        (_proc_start_ticks(pid), pid) < mine
+        for pid in _script_pids("harvest.py")
+    )
 
 
 def _archive_tilings() -> None:
@@ -182,9 +224,11 @@ def main() -> int:
         if not queue:
             log("--resume: every queued row already landed; nothing to do")
             return 3  # distinct rc so a watchdog loop knows to stop
-    if chip_contended():
-        log("bench.py or another harvest is running (single-client chip) "
-            "— refusing to start")
+    if bench_running():
+        log("bench.py is running (single-client chip) — refusing to start")
+        return 4
+    if harvest_outranked():
+        log("an older harvest.py is already running — refusing to start")
         return 4
 
     log(f"probing chip (queue: {[name for name, _, _ in queue]})")
@@ -199,9 +243,8 @@ def main() -> int:
     done = 0
     archived = False
     for name, workload, timeout in queue:
-        if chip_contended():
-            log("bench.py or another harvest started mid-run — yielding "
-                "the chip")
+        if bench_running():
+            log("bench.py started mid-harvest — yielding the chip to it")
             break
         if workload == "flash_tune" and not archived:
             # Archive stale tilings RIGHT BEFORE the sweep replaces them
