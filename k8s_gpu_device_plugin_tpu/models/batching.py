@@ -95,6 +95,7 @@ def prefill_insert(
     slot: jax.Array,         # scalar int32
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
+    sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Prefill one request and insert it into ``slot``.
 
@@ -111,7 +112,7 @@ def prefill_insert(
     # bucket's other rows never reach the lm_head matmul or logits HBM
     logits, scratch = _forward_cached(
         params, prompt[None, :], scratch, jnp.int32(0), cfg,
-        select_pos=prompt_len - 1,
+        select_pos=prompt_len - 1, lora_sel=sel,
     )
     first_logits = logits[0, 0]  # (V,)
 
@@ -161,6 +162,7 @@ def decode_step(
     eos_id: jax.Array,   # scalar int32 (-1 disables EOS stopping)
     cfg: LlamaConfig,
     knobs: jax.Array,    # (B, 4) f32 per-slot sampler knobs
+    sel: jax.Array | None = None,  # (B, N) per-slot adapter one-hots
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
@@ -180,7 +182,8 @@ def decode_step(
     cache_len = state.cache.k.shape[2]
     write_pos = jnp.where(was_active, state.lengths, cache_len - 1)
     logits, cache = _forward_cached(
-        params, state.last_token[:, None], state.cache, write_pos, cfg
+        params, state.last_token[:, None], state.cache, write_pos, cfg,
+        lora_sel=sel,
     )
     key, sub = jax.random.split(state.key)
     tok, presence = sample_and_mark_dyn(
@@ -224,6 +227,10 @@ class _Request:
     # the decode step as traced per-slot knobs, so mixed settings share
     # one compile
     sampler: "Sampler | None" = None
+    # stacked-LoRA adapter index (models/lora_serving.py); -1 = base
+    # model. Rides the decode step as a per-slot one-hot selection, so a
+    # mixed batch of adapters shares one compile.
+    adapter: int = -1
 
 
 
@@ -260,7 +267,19 @@ class ContinuousBatcher:
         chunked_prefill: int = 0,
         seed: int = 0,
         metrics=None,
+        adapters=None,  # lora_serving.AdapterSet: multi-LoRA serving
     ):
+        if adapters is not None:
+            from k8s_gpu_device_plugin_tpu.models.lora_serving import (
+                attach_adapters,
+            )
+
+            params = attach_adapters(params, adapters)
+            self.adapter_names: tuple[str, ...] = adapters.names
+        else:
+            self.adapter_names = ()
+        self.n_adapters = len(self.adapter_names)
+        self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -316,6 +335,17 @@ class ContinuousBatcher:
         if not self.chunk:
             _bucket(prompt_len, self.buckets)
 
+    def validate_adapter(self, adapter: int) -> None:
+        """The adapter half of the admission rule (shared with the
+        serving engine's request thread, like ``validate``)."""
+        if adapter < 0:
+            return
+        if adapter >= self.n_adapters:
+            raise ValueError(
+                f"adapter index {adapter} out of range: this batcher "
+                f"serves {self.n_adapters} adapter(s)"
+            )
+
     def submit(
         self,
         prompt: list[int],
@@ -323,19 +353,29 @@ class ContinuousBatcher:
         prefix: "PrefixState | None" = None,
         stop: list[list[int]] | None = None,
         sampler: "Sampler | None" = None,
+        adapter: int = -1,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
         admission and only ``prompt`` (the suffix) runs through prefill
         — N requests sharing a P-token system prompt pay one P-token
         prefill total. Requires chunked_prefill (the chunk scheduler is
-        what continues from an arbitrary offset)."""
+        what continues from an arbitrary offset). ``adapter`` selects a
+        stacked LoRA adapter (-1 = base model)."""
         if prefix is not None and not self.chunk:
             raise ValueError("prefix sharing requires chunked_prefill=C")
         total = len(prompt) + (len(prefix.tokens) if prefix else 0)
         # reject here, not in _admit: a mid-run() failure would strand
         # every in-flight neighbor
         self.validate(total, max_new)
+        self.validate_adapter(adapter)
+        if prefix is not None and prefix.adapter != adapter:
+            # the prefix rows were prefilled under ONE set of weights;
+            # reusing them under another would serve wrong K/V silently
+            raise ValueError(
+                f"prefix was prefilled with adapter {prefix.adapter}, "
+                f"request uses {adapter}"
+            )
         rid = self._next_rid
         self._next_rid += 1
         full = (list(prefix.tokens) if prefix else []) + list(prompt)
@@ -343,7 +383,7 @@ class ContinuousBatcher:
             _Request(
                 rid, full, max_new, prefix=prefix,
                 stop=tuple(tuple(s) for s in (stop or ()) if s),
-                sampler=sampler,
+                sampler=sampler, adapter=adapter,
             )
         )
         if self.metrics:
@@ -371,6 +411,33 @@ class ContinuousBatcher:
                     arr[slot] = sampler_knobs(req.sampler)
             self._knobs_cache = jnp.asarray(arr)
         return self._knobs_cache
+
+    def _req_sel(self, req: _Request) -> "jax.Array | None":
+        """(1, N) adapter one-hot for one request's prefill dispatches
+        (None when this batcher serves no adapters)."""
+        if not self.n_adapters:
+            return None
+        from k8s_gpu_device_plugin_tpu.models.lora_serving import one_hot_sel
+
+        return jnp.asarray(one_hot_sel(req.adapter, self.n_adapters))[None, :]
+
+    def _batch_sel(self) -> "jax.Array | None":
+        """(n_slots, N) per-slot adapter one-hots for the decode step;
+        cached until the running set changes (invalidated alongside
+        ``_knobs_cache`` — same sites, same lifecycle). Empty slots read
+        base-model zeros; their outputs are discarded anyway."""
+        if not self.n_adapters:
+            return None
+        if self._sel_cache is None:
+            from k8s_gpu_device_plugin_tpu.models.lora_serving import (
+                one_hot_sel,
+            )
+
+            arr = np.zeros((self.n_slots, self.n_adapters), np.float32)
+            for slot, req in self.running.items():
+                arr[slot] = one_hot_sel(req.adapter, self.n_adapters)
+            self._sel_cache = jnp.asarray(arr)
+        return self._sel_cache
 
     def _admit(self) -> None:
         free = [
@@ -401,7 +468,7 @@ class ContinuousBatcher:
             self.state, tok, logp = prefill_insert(
                 self.params, self.state, padded,
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
-                self.cfg, self._req_knobs(req),
+                self.cfg, self._req_knobs(req), sel=self._req_sel(req),
             )
             req.out.append(int(tok))
             req.out_logp.append(float(logp))
@@ -409,6 +476,7 @@ class ContinuousBatcher:
                 self.metrics.on_first_token()
             self.running[slot] = req
             self._knobs_cache = None
+            self._sel_cache = None
             self._finish_if_done(req)
 
     def _prefill_one_chunk(self) -> None:
@@ -444,6 +512,7 @@ class ContinuousBatcher:
             self.metrics.on_first_token()
         self.running[slot] = req
         self._knobs_cache = None
+        self._sel_cache = None
         self._finish_if_done(req)
 
     # overridable seams (the speculative batcher mirrors these onto a
@@ -453,6 +522,7 @@ class ContinuousBatcher:
         self.state = prefill_chunk(
             self.params, self.state, chunk,
             jnp.int32(start), jnp.int32(slot), self.cfg,
+            sel=self._req_sel(self.prefilling[slot]),
         )
 
     def _apply_prefill_finish(self, chunk, fstart: int, plen: int,
@@ -461,6 +531,7 @@ class ContinuousBatcher:
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
             self.cfg, self._req_knobs(self.prefilling[slot]),
+            sel=self._req_sel(self.prefilling[slot]),
         )
         return int(tok), float(logp)
 
@@ -482,6 +553,7 @@ class ContinuousBatcher:
                     del mapping[slot]
                     self._prefill_pos.pop(slot, None)
                     self._knobs_cache = None
+                    self._sel_cache = None
                     self._retire_cancelled(req)
                     return True
         return False
@@ -509,6 +581,7 @@ class ContinuousBatcher:
             if req.slot in self.running:
                 del self.running[req.slot]
                 self._knobs_cache = None
+                self._sel_cache = None
             if self.metrics:
                 self.metrics.on_finish(
                     "eos" if hit_eos else ("stop" if hit_stop else "budget")
@@ -537,7 +610,7 @@ class ContinuousBatcher:
         that can emit up to gamma tokens per slot)."""
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
-            self.cfg, self._batch_knobs(),
+            self.cfg, self._batch_knobs(), sel=self._batch_sel(),
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
@@ -598,6 +671,7 @@ def prefill_chunk(
     chunk_start: jax.Array,  # scalar int32: absolute position of chunk[0]
     slot: jax.Array,
     cfg: LlamaConfig,
+    sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
 ) -> BatchState:
     """One intermediate prefill chunk into ``slot`` (no sampling; the
     slot stays inactive until the finish chunk). Runs against the slot's
@@ -607,6 +681,7 @@ def prefill_chunk(
     _, sl = _forward_cached(
         params, chunk[None, :], sl, chunk_start, cfg,
         select_pos=jnp.int32(0),  # logits unused; keep the lm_head at 1 row
+        lora_sel=sel,
     )
     # chunk_start == 0 is the request's FIRST chunk: start the presence
     # row from zeros, or a reused slot leaks its previous occupant's
@@ -632,6 +707,7 @@ def prefill_finish(
     slot: jax.Array,
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
+    sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Final chunk: run it, sample the first generated token (returned
     with its logprob), activate the slot.
@@ -648,7 +724,7 @@ def prefill_finish(
     sl = _slot_cache(state.cache, slot)
     logits, sl = _forward_cached(
         params, chunk[None, :], sl, chunk_start, cfg,
-        select_pos=prompt_len - 1 - chunk_start,
+        select_pos=prompt_len - 1 - chunk_start, lora_sel=sel,
     )
     base = jnp.where(chunk_start == 0, False, state.presence[slot])
     seen = base.at[chunk].max(
@@ -693,24 +769,47 @@ class PrefixState:
     rows: KVCache          # (L, 1, P_pad, Hkv, hd)
     tokens: tuple          # the real prefix token ids (length P)
     presence: jax.Array    # (V,) bool over the real tokens
+    # adapter these rows were prefilled under (-1 = base): the K/V depend
+    # on the weights, so submit() only accepts a matching request
+    adapter: int = -1
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _precompute_prefix(params, prefix: jax.Array, cfg: LlamaConfig):
+def _precompute_prefix(params, prefix: jax.Array, cfg: LlamaConfig,
+                       sel: jax.Array | None = None):
     scratch = KVCache.init(cfg, 1, prefix.shape[0])
     _, scratch = _forward_cached(
         params, prefix[None, :], scratch, jnp.int32(0), cfg,
         select_pos=jnp.int32(0),  # logits unused
+        lora_sel=sel,
     )
     seen = jnp.zeros((cfg.vocab_size,), bool).at[prefix].set(True)
     return scratch, seen
 
 
-def precompute_prefix(params, tokens: list[int], cfg: LlamaConfig) -> PrefixState:
-    """Prefill a shared prefix once (one compile per prefix length)."""
+def precompute_prefix(
+    params, tokens: list[int], cfg: LlamaConfig,
+    adapter: int = -1, n_adapters: int = 0,
+) -> PrefixState:
+    """Prefill a shared prefix once (one compile per prefix length).
+    ``params`` must already carry stacked adapters (attach_adapters) when
+    ``adapter`` >= 0 — pass the batcher's own ``.params``."""
     arr = jnp.asarray(tokens, jnp.int32)
-    rows, seen = _precompute_prefix(params, arr, cfg)
-    return PrefixState(rows=rows, tokens=tuple(tokens), presence=seen)
+    sel = None
+    if adapter >= 0 and not n_adapters:
+        # silently prefilling BASE rows while labeling them with the
+        # adapter would defeat submit()'s exact-match check
+        raise ValueError(
+            f"precompute_prefix(adapter={adapter}) needs n_adapters > 0 "
+            "(pass the batcher's adapter count and its .params)"
+        )
+    if n_adapters:
+        from k8s_gpu_device_plugin_tpu.models.lora_serving import one_hot_sel
+
+        sel = jnp.asarray(one_hot_sel(adapter, n_adapters))[None, :]
+    rows, seen = _precompute_prefix(params, arr, cfg, sel)
+    return PrefixState(rows=rows, tokens=tuple(tokens), presence=seen,
+                       adapter=adapter)
 
 
 @partial(jax.jit, donate_argnums=(0,))

@@ -237,33 +237,47 @@ def _decode_moe_mlp(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
     return jnp.einsum("bte,bted->btd", mix.astype(h.dtype), y)
 
 
-def _project_qkv(x, layer, positions, cfg):
+def _qm_lora(h, layer, name, sel):
+    """qmatmul + the per-row stacked-adapter delta when this layer
+    carries factors and a selection is threaded (models/lora_serving.py);
+    the base path (sel None / no factors) compiles exactly as before."""
+    y = qmatmul(h, layer[name])
+    from k8s_gpu_device_plugin_tpu.models.lora_serving import maybe_lora
+
+    d = maybe_lora(h, layer, name, sel)
+    return y if d is None else y + d
+
+
+def _project_qkv(x, layer, positions, cfg, sel=None):
     """Shared decode-side QKV projection + rope (used by the linear cache
     here and the ring cache in models/rolling.py — one implementation so
     the rolling oracle's token-exactness can never drift). Weight leaves
     may be int8 {"q", "s"} serving leaves (models/quantized_serving.py);
-    qmatmul dispatches."""
+    qmatmul dispatches. ``sel`` (B, N) selects per-row stacked LoRA
+    adapters (multi-LoRA serving)."""
     b, t, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = qmatmul(h, layer["wq"]).reshape(b, t, cfg.n_heads, hd)
-    k = qmatmul(h, layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-    v = qmatmul(h, layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = _qm_lora(h, layer, "wq", sel).reshape(b, t, cfg.n_heads, hd)
+    k = _qm_lora(h, layer, "wk", sel).reshape(b, t, cfg.n_kv_heads, hd)
+    v = _qm_lora(h, layer, "wv", sel).reshape(b, t, cfg.n_kv_heads, hd)
     return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
 
 
-def _mlp_out(x, layer, cfg):
+def _mlp_out(x, layer, cfg, sel=None):
     """Shared decode-side MLP residual branch (dense silu or MoE mix)."""
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.is_moe:
         return _decode_moe_mlp(h, layer, cfg)
-    gate = jax.nn.silu(qmatmul(h, layer["w1"]).astype(jnp.float32)).astype(x.dtype)
-    up = qmatmul(h, layer["w3"])
-    return qmatmul(gate * up, layer["w2"])
+    gate = jax.nn.silu(
+        _qm_lora(h, layer, "w1", sel).astype(jnp.float32)
+    ).astype(x.dtype)
+    up = _qm_lora(h, layer, "w3", sel)
+    return _qm_lora(gate * up, layer, "w2", sel)
 
 
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
-                  positions, cfg):
+                  positions, cfg, sel=None):
     """One transformer block over T new tokens with cache read+write.
 
     Returns (x_out, k_cache, v_cache, k_scale, v_scale) with the new
@@ -272,19 +286,22 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
     MLPs run the dense-mix decode path (``_decode_moe_mlp``)."""
     b, t, d = x.shape
 
-    q, k, v = _project_qkv(x, layer, positions, cfg)
+    q, k, v = _project_qkv(x, layer, positions, cfg, sel)
     k_cache, k_scale = _cache_write(k_cache, k_scale, k, length)
     v_cache, v_scale = _cache_write(v_cache, v_scale, v, length)
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length, cfg)
-    x = x + qmatmul(attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer["wo"])
-    return x + _mlp_out(x, layer, cfg), k_cache, v_cache, k_scale, v_scale
+    x = x + _qm_lora(
+        attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer, "wo", sel
+    )
+    return x + _mlp_out(x, layer, cfg, sel), k_cache, v_cache, k_scale, v_scale
 
 
 def _forward_cached(
     params, tokens, cache: KVCache, length, cfg: LlamaConfig,
     last_only: bool = False,
     select_pos: jax.Array | None = None,
+    lora_sel: jax.Array | None = None,
 ):
     """Run T tokens (starting at absolute position ``length``) through all
     layers with cache update. Returns (logits (B, T, V) f32, new cache);
@@ -293,7 +310,9 @@ def _forward_cached(
     ``select_pos`` (traced scalar) projects only that position — for
     bucket-padded prefills where the last REAL token is not the last row
     (continuous batching), keeping the lm_head matmul and its logits at
-    1/T the cost."""
+    1/T the cost. ``lora_sel`` (B, N) selects per-row stacked LoRA
+    adapters when ``params["layers"]`` carries them
+    (models/lora_serving.py)."""
     from k8s_gpu_device_plugin_tpu.models.llama import cast_params_for_compute
 
     # master-weight checkpoints (param_dtype=f32) decode in compute dtype —
@@ -313,7 +332,8 @@ def _forward_cached(
         x = carry
         layer, k_c, v_c, k_s, v_s = layer_and_cache
         x, k_c, v_c, k_s, v_s = _decode_block(
-            x, layer, k_c, v_c, k_s, v_s, length, positions, cfg
+            x, layer, k_c, v_c, k_s, v_s, length, positions, cfg,
+            sel=lora_sel,
         )
         return x, (k_c, v_c, k_s, v_s)
 

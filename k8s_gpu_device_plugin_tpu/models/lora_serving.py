@@ -1,0 +1,172 @@
+"""Multi-LoRA serving: many adapters behind one continuous batcher.
+
+Training-side LoRA (models/lora.py) MERGES the factors into the weights —
+right for fine-tuning, impossible for serving several adapters at once
+(slots sharing one batched matmul need different effective weights). The
+serving-side design keeps the base weights untouched and adds each
+target projection's low-rank delta per row:
+
+    y[b] = x[b] @ W + (x[b] @ A_n) @ B_n        n = adapter of row b
+
+TPU-first shape choices (S-LoRA/Punica solve this with custom gather
+GEMV kernels; XLA wants static shapes and no data-dependent gathers):
+
+- Adapters are STACKED on a new leading axis: ``(L, N, d_in, R)`` /
+  ``(L, N, R, d_out)`` per target, layer-major so ``lax.scan`` slices a
+  layer's ``(N, d_in, R)`` block exactly like every other weight leaf.
+  Mixed ranks zero-pad to the max R (zero A columns x zero B rows add
+  exactly nothing); a target an adapter doesn't carry is a zero block;
+  each adapter's ``alpha / rank`` scale is baked into its B stack.
+- Every row computes ALL N deltas and keeps its own via a one-hot
+  ``sel (B, N)`` — for serving-realistic N (a handful) the skinny
+  matmuls are noise next to the base projection (2·d_in·R·N MACs/token
+  vs d_in·d_out), and there is no gather, no recompile, no dynamic
+  shape. Base-model rows are the all-zeros one-hot.
+- The stacks ride ``params["layers"]`` as extra pytree leaves
+  (``lora_wq_a``, ...), so the cache/attention/quantization machinery of
+  the decode path needs no signature change — only ``sel`` threads
+  through (models/generate.py), exactly like the per-slot sampler knobs.
+
+The reference daemon has no serving stack (SURVEY §2); this extends the
+framework's serving surface (models/batching.py, serving/server.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.lora import LoraConfig
+
+# every stackable target; per-adapter targets may be any subset
+_ALL_TARGETS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+@dataclass(frozen=True)
+class AdapterSet:
+    """Stacked adapters ready to serve: ``names[i]`` is adapter index i
+    (the index requests select by); ``leaves`` merge into
+    ``params["layers"]``."""
+
+    names: tuple[str, ...]
+    leaves: dict
+
+    @property
+    def n(self) -> int:
+        return len(self.names)
+
+    def index_of(self, name: str) -> int:
+        try:
+            return self.names.index(name)
+        except ValueError:
+            raise KeyError(
+                f"unknown adapter {name!r}; loaded: {list(self.names)}"
+            ) from None
+
+
+def stack_adapters(
+    cfg: LlamaConfig,
+    adapters: list[tuple[str, dict, LoraConfig]],
+) -> AdapterSet:
+    """[(name, lora_params, lora_cfg), ...] -> AdapterSet.
+
+    ``lora_params`` is the training-side pytree ({target: {"a", "b"}},
+    models/lora.py shapes); ranks may differ per adapter."""
+    if not adapters:
+        raise ValueError("stack_adapters needs at least one adapter")
+    names = tuple(name for name, _, _ in adapters)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate adapter names: {names}")
+    n = len(adapters)
+    targets = sorted(
+        {t for _, lp, _ in adapters for t in lp},
+        key=_ALL_TARGETS.index,
+    )
+    rmax = max(
+        lp[t]["a"].shape[-1] for _, lp, _ in adapters for t in lp
+    )
+    leaves: dict = {}
+    for t in targets:
+        a_blocks, b_blocks = [], []
+        d_in = d_out = None
+        for _, lp, lcfg in adapters:
+            ab = lp.get(t)
+            if ab is not None:
+                d_in = int(ab["a"].shape[1])
+                d_out = int(ab["b"].shape[2])
+        for _, lp, lcfg in adapters:
+            ab = lp.get(t)
+            if ab is None:  # adapter doesn't carry this target: zero block
+                a_blocks.append(None)
+                b_blocks.append(None)
+                continue
+            r = ab["a"].shape[-1]
+            a = jnp.asarray(ab["a"], cfg.dtype)
+            # the adapter's own alpha/rank scale bakes into ITS B copy
+            b = (jnp.asarray(ab["b"], jnp.float32) * lcfg.scale).astype(
+                cfg.dtype
+            )
+            if r < rmax:  # zero-pad mixed ranks: adds exactly nothing
+                a = jnp.pad(a, ((0, 0), (0, 0), (0, rmax - r)))
+                b = jnp.pad(b, ((0, 0), (0, rmax - r), (0, 0)))
+            a_blocks.append(a)
+            b_blocks.append(b)
+        L = cfg.n_layers
+        zeros_a = jnp.zeros((L, d_in, rmax), cfg.dtype)
+        zeros_b = jnp.zeros((L, rmax, d_out), cfg.dtype)
+        # (L, N, d_in, R) / (L, N, R, d_out): layer-major for lax.scan
+        leaves[f"lora_{t}_a"] = jnp.stack(
+            [a if a is not None else zeros_a for a in a_blocks], axis=1
+        )
+        leaves[f"lora_{t}_b"] = jnp.stack(
+            [b if b is not None else zeros_b for b in b_blocks], axis=1
+        )
+    return AdapterSet(names=names, leaves=leaves)
+
+
+def attach_adapters(params: dict, adapters: AdapterSet) -> dict:
+    """Base params + stacked adapters -> serving params (new layers dict;
+    the base pytree is not mutated)."""
+    return {**params, "layers": {**params["layers"], **adapters.leaves}}
+
+
+def one_hot_sel(adapter: int, n: int) -> np.ndarray:
+    """Row-selection vector: index -> one-hot, -1 (base model) -> zeros."""
+    sel = np.zeros((n,), np.float32)
+    if adapter >= 0:
+        if adapter >= n:
+            raise ValueError(f"adapter index {adapter} >= n_adapters {n}")
+        sel[adapter] = 1.0
+    return sel
+
+
+def lora_delta(h, a, b, sel):
+    """Per-row low-rank delta for one layer's target.
+
+    h (B, T, d_in) · a (N, d_in, R) · b (N, R, d_out), sel (B, N) ->
+    (B, T, d_out). ``sel`` rows must be one-hot or all-zero (what
+    one_hot_sel produces): folding the selection into BOTH factor stacks
+    first is then exact — s_i A_i then s_j B_j composes to A_n B_n for
+    the selected n, 0 for a zeros row — and costs ~N× less than
+    computing every adapter's delta over all T prefill tokens, while
+    staying gather-free and static-shaped (design note up top)."""
+    a_sel = jnp.einsum("bn,ndr->bdr", sel, a)
+    b_sel = jnp.einsum("bn,nro->bro", sel, b)
+    za = jnp.einsum("btd,bdr->btr", h, a_sel)
+    return jnp.einsum("btr,bro->bto", za, b_sel).astype(h.dtype)
+
+
+def maybe_lora(h, layer: dict, target: str, sel):
+    """The decode-path hook: the target's delta when this layer carries
+    stacked factors AND a selection is threaded; None otherwise (the
+    base path compiles exactly as before — no zero-adds)."""
+    if sel is None:
+        return None
+    a = layer.get(f"lora_{target}_a")
+    if a is None:
+        return None
+    return lora_delta(h, a, layer[f"lora_{target}_b"], sel)

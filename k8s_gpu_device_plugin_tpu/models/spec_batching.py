@@ -206,6 +206,14 @@ class SpeculativeBatcher(ContinuousBatcher):
             )
         if draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError("draft and target must share a vocabulary")
+        if kw.get("adapters") is not None:
+            # spec_decode_step doesn't thread lora_sel: admitting adapter
+            # requests would verify base-weight tokens over adapter-tinted
+            # prefill K/V — silently wrong. Reject the stacks outright.
+            raise ValueError(
+                "SpeculativeBatcher does not support LoRA adapters (the "
+                "draft model has no stacks to mirror the target's)"
+            )
         super().__init__(params, cfg, n_slots, max_len, **kw)
         if not self.chunk:
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
@@ -228,7 +236,8 @@ class SpeculativeBatcher(ContinuousBatcher):
     #: per-request override would desynchronize the rejection sampling
     per_request_sampler = False
 
-    def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None):
+    def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
+               adapter=-1):
         if prefix is not None:
             raise NotImplementedError(
                 "shared prefixes are not supported with speculative "
@@ -239,7 +248,9 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "per-request samplers are not supported with speculative "
                 "batching (draft and target must share one sampler)"
             )
-        return super().submit(prompt, max_new, stop=stop)
+        # adapter >= 0 rejected by validate_adapter: __init__ refuses
+        # adapter stacks, so n_adapters is always 0 here
+        return super().submit(prompt, max_new, stop=stop, adapter=adapter)
 
     # mirror every prefill onto the draft cache
 

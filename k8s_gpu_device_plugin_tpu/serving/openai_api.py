@@ -51,7 +51,17 @@ from k8s_gpu_device_plugin_tpu.serving.tokenizer import (
     trim_stop_suffix,
 )
 
-MODEL_ID = "tpu-serving"  # echoed when requests omit "model"
+MODEL_ID = "tpu-serving"  # the base model's id ("model" absent = base)
+
+
+class _ModelNotFound(Exception):
+    """Unknown "model" value: OpenAI answers these with a 404, distinct
+    from the 400 invalid_request_error family."""
+
+    def __init__(self, model: str) -> None:
+        super().__init__(
+            f"The model {model!r} does not exist or is not served here"
+        )
 
 
 class _TextDiffer:
@@ -172,10 +182,20 @@ class _OpenAIRoutes:
                 temperature=float(body.get("temperature", 1.0)),
                 top_p=float(body.get("top_p", 1.0)),
             )
+        # "model" routes: the base model's id (or absent) -> base; a
+        # loaded LoRA adapter's name -> that adapter. Anything else is
+        # OpenAI's model_not_found.
+        model = str(body.get("model") or MODEL_ID)
+        adapter = -1
+        if model != MODEL_ID:
+            try:
+                adapter = self._server.resolve_adapter(model)
+            except ValueError:
+                raise _ModelNotFound(model) from None
         return {
             "n": n, "stream": stream, "max_new": max_new,
             "stop": stop_lists, "sampler": sampler,
-            "model": str(body.get("model") or MODEL_ID),
+            "model": model, "adapter": adapter,
         }
 
     def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
@@ -194,7 +214,8 @@ class _OpenAIRoutes:
     def _submit(self, prompt: list[int], c: dict) -> list[tuple[int, asyncio.Queue]]:
         return [
             self._server.engine.submit(
-                prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"]
+                prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"],
+                adapter=c["adapter"],
             )
             for _ in range(c["n"])
         ]
@@ -214,12 +235,13 @@ class _OpenAIRoutes:
     # --- endpoints -------------------------------------------------------
 
     async def models(self, request: web.Request) -> web.Response:
+        ids = (MODEL_ID,) + self._server.adapter_names
         return web.json_response({
             "object": "list",
             "data": [{
-                "id": MODEL_ID, "object": "model", "created": 0,
+                "id": mid, "object": "model", "created": 0,
                 "owned_by": "tpu-device-plugin",
-            }],
+            } for mid in ids],
         })
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -232,6 +254,8 @@ class _OpenAIRoutes:
             self._budget(c, prompt, default=16)  # OpenAI's legacy default
             lp = body.get("logprobs")
             want_logprobs = lp is not None and lp is not False  # 0 counts
+        except _ModelNotFound as e:
+            return _oai_error(str(e), 404, code="model_not_found")
         except (json.JSONDecodeError, TypeError, ValueError) as e:
             return _oai_error(str(e), 400)
         return await self._respond(
@@ -255,6 +279,8 @@ class _OpenAIRoutes:
             prompt = _render_chat(self._server.tokenizer, messages)
             self._budget(c, prompt, default=None)  # chat: the slot budget
             want_logprobs = bool(body.get("logprobs", False))
+        except _ModelNotFound as e:
+            return _oai_error(str(e), 404, code="model_not_found")
         except (json.JSONDecodeError, TypeError, ValueError) as e:
             return _oai_error(str(e), 400)
         return await self._respond(
@@ -419,11 +445,11 @@ class _OpenAIRoutes:
         return resp
 
 
-def _oai_error(message: str, status: int) -> web.Response:
+def _oai_error(message: str, status: int, code: str | None = None) -> web.Response:
     """OpenAI error envelope (clients pattern-match on error.message)."""
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error",
-                   "code": None}},
+                   "code": code}},
         status=status,
     )
 

@@ -39,6 +39,11 @@ API (JSON over HTTP, SSE for streaming):
   (serving/openai_api.py): existing OpenAI SDKs/clients point at this
   server unchanged.
 
+Multi-LoRA: with ``--loraAdapters name=ckptdir,...`` the server stacks
+the adapters (models/lora_serving.py) and every request picks one —
+``"adapter": "name"`` here, or the OpenAI ``"model"`` field (the base
+model's id or an adapter name; ``/v1/models`` lists all).
+
 Design notes: the batcher is synchronous by construction (a jitted step
 per token); the engine thread is its sole owner, and handlers never wait
 on device work — submissions ride a small locked queue the engine drains
@@ -78,14 +83,20 @@ class InferenceEngine:
         chunked_prefill: int = 256,
         metrics=None,
         batcher: ContinuousBatcher | None = None,
+        adapters=None,  # lora_serving.AdapterSet (multi-LoRA serving)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
+        if batcher is not None and adapters is not None:
+            raise ValueError(
+                "pass adapters to the injected batcher's own constructor; "
+                "silently ignoring them here would 404 every adapter request"
+            )
         self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(chunked_prefill, max_len),
-            metrics=metrics,
+            metrics=metrics, adapters=adapters,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -96,7 +107,9 @@ class InferenceEngine:
         self._work = threading.Event()
         self._stop = threading.Event()
         self._dead = threading.Event()
-        self._subq: list[tuple[int, list[int], int, tuple, "Sampler | None"]] = []
+        self._subq: list[
+            tuple[int, list[int], int, tuple, "Sampler | None", int]
+        ] = []
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -113,16 +126,18 @@ class InferenceEngine:
         self, prompt: list[int], max_new: int,
         stop: list[list[int]] | None = None,
         sampler: Sampler | None = None,
+        adapter: int = -1,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
-        Validates EVERYTHING the batcher would (capacity and, in
-        bucketed mode, bucket fit) so admission on the engine thread can
-        never raise — an admission error there would otherwise kill the
-        loop and hang every stream."""
+        Validates EVERYTHING the batcher would (capacity, bucket fit in
+        bucketed mode, adapter range) so admission on the engine thread
+        can never raise — an admission error there would otherwise kill
+        the loop and hang every stream."""
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
         self.cb.validate(len(prompt), max_new)  # the batcher's own rule
+        self.cb.validate_adapter(adapter)
         if sampler is not None and not getattr(
             self.cb, "per_request_sampler", False
         ):
@@ -142,7 +157,8 @@ class InferenceEngine:
             eid = self._next_eid
             self._next_eid += 1
             self._subq.append(
-                (eid, list(prompt), max_new, tuple(stop or ()), sampler)
+                (eid, list(prompt), max_new, tuple(stop or ()), sampler,
+                 adapter)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -179,10 +195,10 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new, stop, sampler in batch:
+        for eid, prompt, max_new, stop, sampler, adapter in batch:
             rid = self.cb.submit(
                 prompt, max_new=max_new, stop=[list(st) for st in stop],
-                sampler=sampler,
+                sampler=sampler, adapter=adapter,
             )
             self._rid_to_eid[rid] = eid
 
@@ -316,6 +332,11 @@ class InferenceServer:
         # encode(str)->ids / decode(ids)->str. The engine itself stays
         # token-ids only; text is translated at the HTTP boundary.
         self.tokenizer = tokenizer
+        # adapter name -> stacked index (multi-LoRA serving); both APIs
+        # resolve names here and submit indices
+        self.adapter_names: tuple[str, ...] = tuple(
+            getattr(engine.cb, "adapter_names", ())
+        )
         self.app = web.Application()
         self.app.router.add_post("/v1/generate", self._generate)
         self.app.router.add_get("/v1/health", self._health)
@@ -328,6 +349,22 @@ class InferenceServer:
         )
 
         add_openai_routes(self)
+
+    def resolve_adapter(self, name) -> int:
+        """Adapter name -> index; None/empty -> base (-1). Raises
+        ValueError for unknown names (the request is malformed, not a
+        capacity problem)."""
+        if name in (None, ""):
+            return -1
+        if not isinstance(name, str):
+            raise ValueError("adapter must be a string name")
+        try:
+            return self.adapter_names.index(name)
+        except ValueError:
+            raise ValueError(
+                f"unknown adapter {name!r}; serving: "
+                f"{list(self.adapter_names) or '(none)'}"
+            ) from None
 
     async def _health(self, request: web.Request) -> web.Response:
         stats = self.engine.stats()
@@ -362,6 +399,7 @@ class InferenceServer:
             max_new = int(body.get("max_new", 64))
             stream = bool(body.get("stream", False))
             n = int(body.get("n", 1))
+            adapter = self.resolve_adapter(body.get("adapter"))
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
@@ -409,7 +447,7 @@ class InferenceServer:
         try:
             subs = [
                 self.engine.submit(prompt, max_new, stop=stop,
-                                   sampler=sampler)
+                                   sampler=sampler, adapter=adapter)
                 for _ in range(n)
             ]
         except ValueError as e:  # capacity/bucket/sampler validation
@@ -494,6 +532,79 @@ class InferenceServer:
             self.engine.shutdown()
 
 
+def load_adapters(cfg: LlamaConfig, spec: str):
+    """``--loraAdapters`` value -> AdapterSet.
+
+    Syntax: ``name=ckptdir[:alpha=X],name2=dir2`` — each dir is an orbax
+    checkpoint whose tree carries the LoRA factors under ``"lora"`` (the
+    fine-tune state layout, models/lora.py init_lora_state). Rank and
+    targets are inferred from the factor shapes; alpha defaults to the
+    classic 2·rank unless given (it isn't recorded in the factors)."""
+    from k8s_gpu_device_plugin_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_gpu_device_plugin_tpu.models.lora import LoraConfig
+    from k8s_gpu_device_plugin_tpu.models.lora_serving import stack_adapters
+
+    adapters = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"--loraAdapters entry {entry!r}: expected name=ckptdir"
+            )
+        name, rest = entry.split("=", 1)
+        name = name.strip()
+        if not name:
+            raise ValueError(
+                f"--loraAdapters entry {entry!r}: empty adapter name "
+                "(it would be unreachable — '' routes to the base model)"
+            )
+        from k8s_gpu_device_plugin_tpu.serving.openai_api import MODEL_ID
+
+        if name == MODEL_ID:
+            raise ValueError(
+                f"adapter name {name!r} collides with the base model id; "
+                "OpenAI-API requests for it would silently serve the base"
+            )
+        alpha = None
+        if ":alpha=" in rest:
+            rest, alpha_s = rest.split(":alpha=", 1)
+            alpha = float(alpha_s)
+        ckpt = TrainCheckpointer(rest, async_save=False)
+        try:
+            tree = ckpt.restore_unstructured()
+        finally:
+            ckpt.close()
+        lora_params = tree.get("lora", tree)  # fine-tune state or bare factors
+        if (
+            not isinstance(lora_params, dict)
+            or not lora_params
+            or not all(
+                isinstance(ab, dict) and "a" in ab and "b" in ab
+                for ab in lora_params.values()
+            )
+        ):
+            raise ValueError(
+                f"no LoRA factors found in {rest!r} (expected "
+                "{target: {'a', 'b'}} under 'lora' or at the tree root)"
+            )
+        first = next(iter(lora_params.values()))
+        rank = int(first["a"].shape[-1])
+        lcfg = LoraConfig(
+            rank=rank,
+            alpha=alpha if alpha is not None else 2.0 * rank,
+            targets=tuple(lora_params),
+        )
+        adapters.append((name.strip(), lora_params, lcfg))
+        log.info(
+            "loaded LoRA adapter",
+            extra={"fields": {"name": name.strip(), "dir": rest,
+                              "rank": rank, "targets": list(lora_params)}},
+        )
+    return stack_adapters(cfg, adapters)
+
+
 def load_params(cfg: LlamaConfig, checkpoint_dir: str = ""):
     """Model weights for serving: the latest orbax train checkpoint's
     ``params`` sub-tree, or (loudly) random init for smoke/load tests."""
@@ -566,6 +677,10 @@ def _main(argv: list[str] | None = None) -> int:
                         "cache HBM stream, int4 halves it again (coarser "
                         "codes; accuracy trade)")
     parser.add_argument("--checkpointDir", default="")
+    parser.add_argument("--loraAdapters", default="",
+                        help="multi-LoRA serving: name=ckptdir[:alpha=X]"
+                        ",... — requests select by name ('adapter' field "
+                        "on /v1/generate; 'model' on the OpenAI API)")
     parser.add_argument("--tokenizer", default="",
                         help="text seam: 'byte' (UTF-8 bytes, lossless) or "
                         "a local HF tokenizer directory; empty = token-id "
@@ -616,6 +731,15 @@ def _main(argv: list[str] | None = None) -> int:
     else:
         eos_id = int(args.eosId)
 
+    adapters = None
+    if args.loraAdapters:
+        if args.draftPreset:
+            raise SystemExit(
+                "--loraAdapters is unsupported with --draftPreset: the "
+                "draft model has no adapter stacks to mirror the target's"
+            )
+        adapters = load_adapters(cfg, args.loraAdapters)
+
     metrics = ServingMetrics()
     batcher = None
     if args.draftPreset:
@@ -636,7 +760,7 @@ def _main(argv: list[str] | None = None) -> int:
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
         sampler=sampler, eos_id=eos_id,
         chunked_prefill=args.chunkedPrefill, metrics=metrics,
-        batcher=batcher,
+        batcher=batcher, adapters=adapters,
     )
     from prometheus_client import REGISTRY
 

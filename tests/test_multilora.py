@@ -1,0 +1,285 @@
+"""Multi-LoRA serving (models/lora_serving.py): many adapters behind one
+continuous batcher. The oracle is the training-side ``merge_lora`` — for
+every request, serving through the stacked per-row-delta path must match
+dedicated ``generate`` on that adapter's MERGED weights. f32 configs make
+the two computation orders numerically tight."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    precompute_prefix,
+)
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.lora import (
+    LoraConfig,
+    init_lora_params,
+    merge_lora,
+)
+from k8s_gpu_device_plugin_tpu.models.lora_serving import (
+    AdapterSet,
+    attach_adapters,
+    lora_delta,
+    one_hot_sel,
+    stack_adapters,
+)
+
+
+def _rand_b(lp, seed):
+    """Training inits B to zeros (step-0 = base); tests need nonzero
+    deltas, so randomize B."""
+    out = {}
+    for i, (t, ab) in enumerate(sorted(lp.items())):
+        k = jax.random.fold_in(jax.random.key(seed), i)
+        out[t] = {
+            "a": ab["a"],
+            "b": 0.3 * jax.random.normal(k, ab["b"].shape, ab["b"].dtype),
+        }
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    lc1 = LoraConfig(rank=4, alpha=8.0, targets=("wq", "wo", "w2"))
+    lc2 = LoraConfig(rank=8, alpha=16.0)  # attn targets, different rank
+    lp1 = _rand_b(init_lora_params(jax.random.key(1), cfg, lc1), 10)
+    lp2 = _rand_b(init_lora_params(jax.random.key(2), cfg, lc2), 11)
+    aset = stack_adapters(cfg, [("alpha", lp1, lc1), ("beta", lp2, lc2)])
+    merged = {
+        -1: params,
+        0: merge_lora(params, lp1, lc1),
+        1: merge_lora(params, lp2, lc2),
+    }
+    return cfg, params, aset, merged
+
+
+def _oracle(merged, prompt, cfg, max_new):
+    out = generate(merged, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def test_mixed_adapters_one_batch_match_merged_oracles(setup):
+    """Base + two different-rank adapters decoding TOGETHER, each request
+    token-identical to generate() on its own merged weights."""
+    cfg, params, aset, merged = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=3, max_len=64,
+                           chunked_prefill=8, adapters=aset)
+    want = {}
+    rids = {}
+    for adapter, seed in ((-1, 50), (0, 51), (1, 52)):
+        prompt = _prompt(seed, 6, cfg)
+        rids[adapter] = cb.submit(prompt, max_new=8, adapter=adapter)
+        want[adapter] = _oracle(merged[adapter], prompt, cfg, 8)
+    done = cb.run()
+    for adapter, rid in rids.items():
+        assert done[rid] == want[adapter], f"adapter {adapter}"
+
+
+def test_bucketed_prefill_path_and_reuse(setup):
+    """The non-chunked (bucketed prefill_insert) path serves adapters
+    too, and a slot reused across different adapters stays exact."""
+    cfg, params, aset, merged = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           prompt_buckets=(8, 16), adapters=aset)
+    for adapter, seed in ((0, 60), (-1, 61), (1, 62)):  # serial reuse
+        prompt = _prompt(seed, 5, cfg)
+        rid = cb.submit(prompt, max_new=6, adapter=adapter)
+        done = cb.run()
+        assert done[rid] == _oracle(merged[adapter], prompt, cfg, 6), adapter
+
+
+def test_adapter_prefix_compatibility(setup):
+    """Prefix rows depend on the weights that prefilled them: a matching
+    (adapter, prefix) pair serves exactly; a mismatch is rejected."""
+    cfg, params, aset, merged = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           chunked_prefill=8, adapters=aset)
+    sys_prompt = _prompt(70, 9, cfg)
+    suffix = _prompt(71, 4, cfg)
+    # prefix prefilled UNDER adapter 0 (the batcher's params carry stacks)
+    prefix = precompute_prefix(cb.params, sys_prompt, cfg,
+                               adapter=0, n_adapters=aset.n)
+    rid = cb.submit(suffix, max_new=6, prefix=prefix, adapter=0)
+    done = cb.run()
+    assert done[rid] == _oracle(merged[0], sys_prompt + suffix, cfg, 6)
+
+    with pytest.raises(ValueError, match="prefix was prefilled"):
+        cb.submit(suffix, max_new=6, prefix=prefix, adapter=1)
+    with pytest.raises(ValueError, match="prefix was prefilled"):
+        cb.submit(suffix, max_new=6, prefix=prefix)  # base vs adapter-0
+
+
+def test_adapter_validation(setup):
+    cfg, params, aset, _ = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                           chunked_prefill=8, adapters=aset)
+    with pytest.raises(ValueError, match="out of range"):
+        cb.submit([1, 2], max_new=2, adapter=2)
+    plain = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                              chunked_prefill=8)
+    with pytest.raises(ValueError, match="out of range"):
+        plain.submit([1, 2], max_new=2, adapter=0)
+
+
+def test_stack_adapters_validation(setup):
+    cfg, params, aset, _ = setup
+    lc = LoraConfig(rank=2)
+    lp = init_lora_params(jax.random.key(9), cfg, lc)
+    with pytest.raises(ValueError, match="duplicate"):
+        stack_adapters(cfg, [("x", lp, lc), ("x", lp, lc)])
+    with pytest.raises(ValueError, match="at least one"):
+        stack_adapters(cfg, [])
+    assert aset.index_of("beta") == 1
+    with pytest.raises(KeyError, match="unknown adapter"):
+        aset.index_of("nope")
+    with pytest.raises(ValueError, match=">= n_adapters"):
+        one_hot_sel(5, 2)
+
+
+def test_lora_delta_zero_sel_is_zero(setup):
+    """All-zeros selection (a base-model row) contributes exactly 0."""
+    cfg, params, aset, _ = setup
+    h = jax.random.normal(jax.random.key(1), (2, 3, cfg.d_model), jnp.float32)
+    a = aset.leaves["lora_wq_a"][0]
+    b = aset.leaves["lora_wq_b"][0]
+    sel = jnp.zeros((2, aset.n), jnp.float32)
+    assert np.all(np.asarray(lora_delta(h, a, b, sel)) == 0.0)
+
+
+def test_http_both_apis_route_adapters(setup):
+    """End-to-end over HTTP: the native 'adapter' field and the OpenAI
+    'model' field reach the same stacks; each response matches the
+    merged-weights oracle; unknown names answer 400 (native) / 404
+    (OpenAI, model_not_found)."""
+    import asyncio
+
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params, aset, merged = setup
+
+    async def body():
+        engine = InferenceEngine(
+            params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+            adapters=aset,
+        )
+        server = InferenceServer(engine, host="127.0.0.1", port=0)
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            import aiohttp
+
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as s:
+                prompt = _prompt(80, 5, cfg)
+                # native API, adapter by name
+                r = await s.post(f"{base}/v1/generate", json={
+                    "prompt": prompt, "max_new": 6, "adapter": "beta",
+                })
+                assert r.status == 200, await r.text()
+                toks = (await r.json())["tokens"]
+                assert toks == _oracle(merged[1], prompt, cfg, 6)
+
+                # OpenAI API, adapter via the model field
+                r = await s.post(f"{base}/v1/completions", json={
+                    "model": "alpha", "prompt": prompt, "max_tokens": 6,
+                })
+                assert r.status == 200, await r.text()
+                p = await r.json()
+                assert p["model"] == "alpha"
+                assert p["usage"]["completion_tokens"] == 6
+
+                # base model still routes (default + explicit id)
+                r = await s.post(f"{base}/v1/completions", json={
+                    "prompt": prompt, "max_tokens": 4,
+                })
+                assert r.status == 200
+
+                # /v1/models lists base + adapters
+                r = await s.get(f"{base}/v1/models")
+                ids = [m["id"] for m in (await r.json())["data"]]
+                assert ids == ["tpu-serving", "alpha", "beta"]
+
+                # unknown names
+                r = await s.post(f"{base}/v1/generate", json={
+                    "prompt": prompt, "max_new": 4, "adapter": "nope",
+                })
+                assert r.status == 400
+                assert "unknown adapter" in (await r.json())["error"]
+                r = await s.post(f"{base}/v1/completions", json={
+                    "model": "nope", "prompt": prompt, "max_tokens": 4,
+                })
+                assert r.status == 404
+                assert (await r.json())["error"]["code"] == "model_not_found"
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=300))
+
+
+def test_speculative_engine_rejects_adapters(setup):
+    """A batcher serving no adapters (the speculative engine never gets
+    stacks) rejects adapter submits at validation, not mid-loop."""
+    import asyncio
+
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params, _, _ = setup
+
+    async def body():
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8)
+        try:
+            with pytest.raises(ValueError, match="out of range"):
+                engine.submit(_prompt(90, 4, cfg), 4, adapter=0)
+        finally:
+            engine.shutdown()
+
+    asyncio.run(asyncio.wait_for(body(), timeout=120))
+
+
+def test_guards_from_review(setup):
+    """The silent-wrong-output guards: speculative batchers refuse
+    stacks; precompute_prefix refuses an adapter without its count;
+    engine refuses adapters alongside an injected batcher."""
+    import asyncio
+
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params, aset, _ = setup
+    with pytest.raises(ValueError, match="does not support LoRA"):
+        SpeculativeBatcher(params, cfg, params, cfg, n_slots=1, max_len=32,
+                           chunked_prefill=8, adapters=aset)
+    with pytest.raises(ValueError, match="needs n_adapters"):
+        precompute_prefix(params, [1, 2, 3], cfg, adapter=0)
+
+    async def body():
+        cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                               chunked_prefill=8)
+        with pytest.raises(ValueError, match="injected batcher"):
+            InferenceEngine(params, cfg, batcher=cb, adapters=aset)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=60))

@@ -103,13 +103,22 @@ def test_completions_text_roundtrip_and_logprobs(setup):
     tok = ByteTokenizer()
 
     async def body(session, base):
+        # unknown model names are a 404 (model_not_found) — the model
+        # field routes to loaded LoRA adapters, so typos must not
+        # silently serve the base model
         r = await session.post(f"{base}/v1/completions", json={
             "model": "my-model", "prompt": "hi", "max_tokens": 4,
+        })
+        assert r.status == 404
+        assert (await r.json())["error"]["code"] == "model_not_found"
+
+        r = await session.post(f"{base}/v1/completions", json={
+            "model": "tpu-serving", "prompt": "hi", "max_tokens": 4,
             "logprobs": 1,
         })
         assert r.status == 200
         p = await r.json()
-        assert p["model"] == "my-model"
+        assert p["model"] == "tpu-serving"
         ch = p["choices"][0]
         assert isinstance(ch["text"], str)
         assert len(ch["logprobs"]["token_logprobs"]) == 4
