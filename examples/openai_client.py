@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Stdlib-only client for the OpenAI-compatible serving API.
+
+The serving pod (serving/server.py) exposes /v1/completions,
+/v1/chat/completions and /v1/models (serving/openai_api.py); real
+deployments point the official ``openai`` SDK at it (base_url=...), but
+this example needs nothing outside the standard library — the companion
+to examples/serving_client.py (which speaks the native token-id API).
+
+Usage:
+    python examples/openai_client.py --base http://localhost:8000 \
+        --model tpu-serving "tell me a story"
+    python examples/openai_client.py --chat --stream "hello there"
+    python examples/openai_client.py --list-models
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _post(base: str, path: str, payload: dict):
+    req = urllib.request.Request(
+        base.rstrip("/") + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    return urllib.request.urlopen(req)  # noqa: S310 - explicit user URL
+
+
+def _stream_sse(resp) -> None:
+    """Print streamed text deltas as they arrive; stop at [DONE]."""
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        data = line[len("data: "):]
+        if data == "[DONE]":
+            print(flush=True)
+            return
+        evt = json.loads(data)
+        choice = evt["choices"][0]
+        delta = (
+            choice.get("delta", {}).get("content")
+            if "delta" in choice else choice.get("text")
+        )
+        if delta:
+            print(delta, end="", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prompt", nargs="?", default="hello")
+    ap.add_argument("--base", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", default="tpu-serving",
+                    help="the base model id or a loaded LoRA adapter name")
+    ap.add_argument("--chat", action="store_true",
+                    help="use /v1/chat/completions instead of /v1/completions")
+    ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=None)
+    ap.add_argument("--list-models", action="store_true")
+    args = ap.parse_args()
+
+    if args.list_models:
+        with urllib.request.urlopen(args.base.rstrip("/") + "/v1/models") as r:
+            for m in json.load(r)["data"]:
+                print(m["id"])
+        return 0
+
+    payload: dict = {
+        "model": args.model,
+        "max_tokens": args.max_tokens,
+        "stream": args.stream,
+    }
+    if args.temperature is not None:
+        payload["temperature"] = args.temperature
+    if args.chat:
+        payload["messages"] = [{"role": "user", "content": args.prompt}]
+        path = "/v1/chat/completions"
+    else:
+        payload["prompt"] = args.prompt
+        path = "/v1/completions"
+
+    try:
+        resp = _post(args.base, path, payload)
+    except urllib.error.HTTPError as e:
+        err = json.load(e)
+        print(f"error {e.code}: {err['error']['message']}", file=sys.stderr)
+        return 1
+    with resp:
+        if args.stream:
+            _stream_sse(resp)
+        else:
+            body = json.load(resp)
+            choice = body["choices"][0]
+            text = (
+                choice["message"]["content"] if args.chat else choice["text"]
+            )
+            print(text)
+            print(
+                f"[{body['model']} finish={choice['finish_reason']} "
+                f"usage={body['usage']}]", file=sys.stderr,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

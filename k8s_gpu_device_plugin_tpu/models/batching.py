@@ -806,6 +806,16 @@ def precompute_prefix(
     if n_adapters:
         from k8s_gpu_device_plugin_tpu.models.lora_serving import one_hot_sel
 
+        if adapter >= 0 and not any(
+            k.startswith("lora_") for k in params["layers"]
+        ):
+            # a sel over params WITHOUT stacked leaves would prefill BASE
+            # rows and tag them with the adapter — the same silent-wrong-
+            # K/V case as above, via the other argument
+            raise ValueError(
+                "params carry no stacked LoRA leaves; pass the batcher's "
+                "own .params (attach_adapters output), not the base tree"
+            )
         sel = jnp.asarray(one_hot_sel(adapter, n_adapters))[None, :]
     rows, seen = _precompute_prefix(params, arr, cfg, sel)
     return PrefixState(rows=rows, tokens=tuple(tokens), presence=seen,

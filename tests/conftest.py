@@ -38,8 +38,60 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # --- shared fixtures ------------------------------------------------------
 
 import logging  # noqa: E402
+import threading  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+_TESTS_SINCE_CLEAR = 0
+
+
+@pytest.fixture(autouse=True)
+def _bound_compiled_executable_accumulation():
+    """Cap how many compiled executables one pytest process accumulates.
+
+    The full suite compiles many hundreds of XLA:CPU programs in one
+    process; at that accumulation this jaxlib build segfaults
+    intermittently INSIDE a later compile (observed six times across
+    full-suite runs — single-threaded, load-independent, at whichever
+    heavy-compile test came late enough; every standalone/subset run of
+    the same tests passes). Dropping the jit caches every ~20 tests
+    frees the earlier executables (and their JIT code memory) so no
+    compile ever runs on top of the whole suite's accumulation. Costs
+    re-traces after each clear; correctness is unaffected."""
+    global _TESTS_SINCE_CLEAR
+    yield
+    _TESTS_SINCE_CLEAR += 1
+    if _TESTS_SINCE_CLEAR >= 20:
+        _TESTS_SINCE_CLEAR = 0
+        import jax
+
+        jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _drain_inference_engine_threads():
+    """No two tests may ever compile concurrently.
+
+    InferenceEngine.shutdown() joins its worker with a bounded timeout
+    (the production stateless-pod stance: the process exits anyway). In
+    a long-lived pytest process that bound LEAKS the thread when it is
+    mid-compile — stop is already signaled, but the thread outlives the
+    test and its compile overlaps the NEXT test's main-thread compile.
+    Concurrent XLA:CPU compilation in this jaxlib build segfaults
+    intermittently (observed five times across full-suite runs, always
+    inside backend_compile_and_load, at whichever test followed leaked
+    engines). Joining stragglers between tests removes the overlap."""
+    yield
+    for t in threading.enumerate():
+        if t.name == "inference-engine" and t.is_alive():
+            # shutdown() already set _stop: the thread exits as soon as
+            # its in-flight step/compile returns. Just outwait it.
+            t.join(timeout=300)
+            if t.is_alive():
+                raise RuntimeError(
+                    "inference-engine thread leaked past 300s drain"
+                )
 
 
 @pytest.fixture

@@ -283,3 +283,12 @@ def test_guards_from_review(setup):
             InferenceEngine(params, cfg, batcher=cb, adapters=aset)
 
     asyncio.run(asyncio.wait_for(body(), timeout=60))
+
+
+def test_precompute_prefix_requires_stacked_params(setup):
+    """Passing the BASE tree (no stacked leaves) with an adapter would
+    prefill base rows tagged with the adapter — rejected loudly."""
+    cfg, params, aset, _ = setup
+    with pytest.raises(ValueError, match="no stacked LoRA leaves"):
+        precompute_prefix(params, [1, 2, 3], cfg, adapter=0,
+                          n_adapters=aset.n)
