@@ -222,6 +222,30 @@ def _run_train_fusedopt() -> dict:
     return _train_result("train_fusedopt", quant="none", opt_impl="fused")
 
 
+def _run_decode_lora() -> dict:
+    """Multi-LoRA serving decode overhead on the real serving dispatch
+    (decode_step): base weights vs 4 stacked adapters, mixed per-row
+    selection. Validates lora_serving.py's negligible-overhead claim on
+    hardware."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
+        lora_decode_bench,
+    )
+
+    _require_accelerator()
+    r = lora_decode_bench(_bench_model_cfg(), batch=BENCH_BATCH,
+                          ctx_len=512, steps=64, n_adapters=4, rank=16)
+    return {
+        "workload": "decode_lora",
+        "base_step_ms": round(r.base_step_ms, 3),
+        "lora_step_ms": round(r.lora_step_ms, 3),
+        "overhead_pct": round(r.overhead_pct, 2),
+        "n_adapters": r.n_adapters,
+        "rank": r.rank,
+        "ctx_len": r.ctx_len,
+        "model": _model_dims(_bench_model_cfg()),
+    }
+
+
 def _run_remat_tune() -> dict:
     """Sweep the remat dial on the bench proxy model: each variant is the
     SAME train step (identical numerics, tests/test_remat_policies.py) at
@@ -472,6 +496,7 @@ def _run_allocated() -> dict:
 WORKLOADS = {
     "probe": _run_probe,
     "decode_int8kv": _run_decode_int8kv,
+    "decode_lora": _run_decode_lora,
     "decode_ragged": _run_decode_ragged,
     "usage_live": _run_usage_live,
     "matmul": _run_matmul,

@@ -170,3 +170,37 @@ def maybe_lora(h, layer: dict, target: str, sel):
     if a is None:
         return None
     return lora_delta(h, a, layer[f"lora_{target}_b"], sel)
+
+
+def init_random_adapters(
+    key, cfg: LlamaConfig, n: int, rank: int,
+    targets: tuple = ("wq", "wk", "wv", "wo", "w1", "w2", "w3"),
+):
+    """N random adapters for benchmarks/load tests: training-shaped
+    factors with NONZERO B (a zero B is a no-op delta — a bench over it
+    would measure nothing). MoE configs restrict to attention targets
+    (lora.py's own rule)."""
+    from k8s_gpu_device_plugin_tpu.models.lora import (
+        LoraConfig,
+        init_lora_params,
+    )
+
+    if cfg.is_moe:
+        targets = tuple(t for t in targets if t in ("wq", "wk", "wv", "wo"))
+    lcfg = LoraConfig(rank=rank, alpha=2.0 * rank, targets=targets)
+    out = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        lp = init_lora_params(k, cfg, lcfg)
+        lp = {
+            t: {
+                "a": ab["a"],
+                "b": 0.02 * jax.random.normal(
+                    jax.random.fold_in(k, 1000 + j),
+                    ab["b"].shape, ab["b"].dtype,
+                ),
+            }
+            for j, (t, ab) in enumerate(sorted(lp.items()))
+        }
+        out.append((f"adapter{i}", lp, lcfg))
+    return out

@@ -1,0 +1,82 @@
+"""Embeddings for the serving pod: mean-pooled final hidden states.
+
+Rounds out the OpenAI surface (/v1/embeddings) with the model the pod
+already serves: the final RMS-normed hidden states
+(models/llama.py forward_with_aux(return_hidden=True) — the same seam
+fused-CE training uses), mean-pooled over the REAL tokens and
+L2-normalized (the conventional decoder-LM embedding recipe; unit norm
+makes downstream cosine similarity a plain dot product).
+
+TPU shape discipline: inputs pad to the serving prompt buckets so the
+jitted forward compiles once per bucket, not once per length; the pool
+masks padding out of the mean. Single-row dispatches keep latency flat
+and shapes static.
+
+Unsupported with weight-only quantized serving: the quantized leaves are
+decode-path ({"q","s"} consumed by qmatmul); the hidden-state forward is
+the training-path matmul. The CLI gates this at startup.
+
+No reference analogue: the reference is a device-plugin daemon
+(/root/reference/README.md:1-6); serving belongs to the workload stack
+this framework adds.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, forward_with_aux
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _embed_one(params, tokens, length, cfg: LlamaConfig):
+    """(P,) padded ids + real length -> (D,) unit-norm mean-pooled
+    embedding (padding masked out of the mean)."""
+    hidden, _ = forward_with_aux(
+        params, tokens[None, :], cfg, mesh=None, return_hidden=True
+    )  # (1, P, D)
+    mask = (jnp.arange(tokens.shape[0]) < length)[None, :, None]
+    summed = jnp.sum(jnp.where(mask, hidden.astype(jnp.float32), 0.0), axis=1)
+    mean = summed / jnp.maximum(length, 1)
+    return (mean / jnp.linalg.norm(mean, axis=-1, keepdims=True))[0]
+
+
+class Embedder:
+    """Bucketed, thread-safe embedding pool over the serving params.
+
+    ``embed`` is called from aiohttp executor threads; the lock
+    serializes embedding dispatches against each other (they share the
+    chip with the decode loop at the XLA queue level, which is safe)."""
+
+    def __init__(self, params, cfg: LlamaConfig,
+                 buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)):
+        self.params = params
+        self.cfg = cfg
+        self.buckets = tuple(sorted(buckets))
+        self.dim = cfg.d_model
+        self._lock = threading.Lock()
+
+    def embed(self, ids: list[int]) -> np.ndarray:
+        if not ids:
+            raise ValueError("empty input")
+        # the serving prefill's own smallest-fitting-bucket rule — one
+        # implementation, so the two bucket policies can never diverge
+        from k8s_gpu_device_plugin_tpu.models.batching import _bucket
+
+        try:
+            b = _bucket(len(ids), self.buckets)
+        except ValueError:
+            raise ValueError(
+                f"input of {len(ids)} tokens exceeds the embedding "
+                f"bucket cap {self.buckets[-1]}"
+            ) from None
+        padded = jnp.asarray(ids + [0] * (b - len(ids)), jnp.int32)
+        with self._lock:
+            out = _embed_one(self.params, padded, jnp.int32(len(ids)),
+                             self.cfg)
+            return np.asarray(out, np.float32)

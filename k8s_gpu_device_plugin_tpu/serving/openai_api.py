@@ -234,6 +234,90 @@ class _OpenAIRoutes:
 
     # --- endpoints -------------------------------------------------------
 
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: input is a string, a list of strings,
+        a token-id list, or a list of token-id lists. Embeddings serve
+        the BASE model (adapter deltas aren't threaded through the
+        hidden-state forward), so only the base model id routes."""
+        embedder = getattr(self._server, "embedder", None)
+        if embedder is None:
+            return _oai_error(
+                "embeddings are not enabled on this server", 400
+            )
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            model = str(body.get("model") or MODEL_ID)
+            if model != MODEL_ID:
+                raise _ModelNotFound(model)
+            raw = body.get("input")
+            inputs = self._embedding_inputs(raw)
+            if len(inputs) > 64:
+                # one forward per item, sequential: an unbounded list
+                # would monopolize the chip (the n<=8 analogue here)
+                raise ValueError(
+                    f"at most 64 inputs per request (got {len(inputs)})"
+                )
+            cap = embedder.buckets[-1]
+            for i, ids in enumerate(inputs):
+                # reject the whole request BEFORE burning forwards on
+                # the items preceding an over-long one
+                if len(ids) > cap:
+                    raise ValueError(
+                        f"input {i} has {len(ids)} tokens; the embedding "
+                        f"bucket cap is {cap}"
+                    )
+        except _ModelNotFound as e:
+            return _oai_error(str(e), 404, code="model_not_found")
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            return _oai_error(str(e), 400)
+        loop = asyncio.get_running_loop()
+        vecs = [
+            await loop.run_in_executor(None, embedder.embed, ids)
+            for ids in inputs
+        ]
+        n_tokens = sum(len(i) for i in inputs)
+        return web.json_response({
+            "object": "list",
+            "model": model,
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": [float(x) for x in v]}
+                for i, v in enumerate(vecs)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
+
+    def _embedding_inputs(self, raw) -> list[list[int]]:
+        tok = self._server.tokenizer
+
+        def encode(s: str) -> list[int]:
+            if tok is None:
+                raise ValueError(
+                    "string inputs need a tokenizer on this server; "
+                    "send token-id lists"
+                )
+            return tok.encode(s)
+
+        if isinstance(raw, str) and raw:
+            return [encode(raw)]
+        if isinstance(raw, list) and raw:
+            if all(isinstance(x, str) and x for x in raw):
+                return [encode(s) for s in raw]
+            if all(isinstance(x, int) for x in raw):
+                return [list(raw)]
+            if all(
+                isinstance(x, list) and x
+                and all(isinstance(t, int) for t in x)
+                for x in raw
+            ):
+                return [list(x) for x in raw]
+        raise ValueError(
+            "input must be a non-empty string, list of strings, token-id "
+            "list, or list of token-id lists"
+        )
+
     async def models(self, request: web.Request) -> web.Response:
         ids = (MODEL_ID,) + self._server.adapter_names
         return web.json_response({
@@ -459,4 +543,5 @@ def add_openai_routes(server) -> None:
     api = _OpenAIRoutes(server)
     server.app.router.add_post("/v1/completions", api.completions)
     server.app.router.add_post("/v1/chat/completions", api.chat_completions)
+    server.app.router.add_post("/v1/embeddings", api.embeddings)
     server.app.router.add_get("/v1/models", api.models)

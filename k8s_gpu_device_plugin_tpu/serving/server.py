@@ -322,12 +322,15 @@ class InferenceServer:
     """aiohttp app over an InferenceEngine (port 0 = ephemeral)."""
 
     def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
-                 port: int = 8000, registry=None, tokenizer=None):
+                 port: int = 8000, registry=None, tokenizer=None,
+                 embedder=None):
         self.engine = engine
         self.host = host
         self.port = port
         self.bound_port: int | None = None
         self.registry = registry
+        # Optional serving/embeddings.Embedder: enables /v1/embeddings
+        self.embedder = embedder
         # Optional text seam (serving/tokenizer.py): anything with
         # encode(str)->ids / decode(ids)->str. The engine itself stays
         # token-ids only; text is translated at the HTTP boundary.
@@ -677,6 +680,9 @@ def _main(argv: list[str] | None = None) -> int:
                         "cache HBM stream, int4 halves it again (coarser "
                         "codes; accuracy trade)")
     parser.add_argument("--checkpointDir", default="")
+    parser.add_argument("--embeddings", action="store_true",
+                        help="enable /v1/embeddings (mean-pooled final "
+                        "hidden states; base model only, bf16 weights)")
     parser.add_argument("--loraAdapters", default="",
                         help="multi-LoRA serving: name=ckptdir[:alpha=X]"
                         ",... — requests select by name ('adapter' field "
@@ -764,8 +770,22 @@ def _main(argv: list[str] | None = None) -> int:
     )
     from prometheus_client import REGISTRY
 
+    # /v1/embeddings: the hidden-state forward is the training-path
+    # matmul, incompatible with decode-path quantized weight leaves
+    embedder = None
+    if args.embeddings:
+        if args.weightQuant != "none":
+            raise SystemExit(
+                "--embeddings is unsupported with --weightQuant: the "
+                "hidden-state forward cannot consume quantized leaves"
+            )
+        from k8s_gpu_device_plugin_tpu.serving.embeddings import Embedder
+
+        embedder = Embedder(params, cfg)
+
     server = InferenceServer(engine, host=args.host, port=args.port,
-                             registry=REGISTRY, tokenizer=tokenizer)
+                             registry=REGISTRY, tokenizer=tokenizer,
+                             embedder=embedder)
 
     async def serve():
         stop = asyncio.Event()

@@ -1,0 +1,132 @@
+"""/v1/embeddings (serving/embeddings.py + the OpenAI facade): unit-norm
+mean-pooled hidden states, bucket padding invariance, input forms, and
+the HTTP envelope."""
+
+import asyncio
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.serving.embeddings import Embedder
+from k8s_gpu_device_plugin_tpu.serving.server import (
+    InferenceEngine,
+    InferenceServer,
+)
+from k8s_gpu_device_plugin_tpu.serving.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_embedder_basics(setup):
+    cfg, params = setup
+    emb = Embedder(params, cfg, buckets=(8, 16))
+    ids = [3, 9, 4, 1, 7]
+    v = emb.embed(ids)
+    assert v.shape == (cfg.d_model,)
+    assert np.isclose(np.linalg.norm(v), 1.0, atol=1e-5)  # unit norm
+
+    # bucket-padding invariance: the same ids through a bigger bucket
+    # (different padded shape) give the same embedding — padding is
+    # masked out of the mean
+    v16 = Embedder(params, cfg, buckets=(16,)).embed(ids)
+    np.testing.assert_allclose(v, v16, rtol=2e-5, atol=2e-5)
+
+    # deterministic and input-sensitive
+    np.testing.assert_array_equal(v, emb.embed(ids))
+    assert not np.allclose(v, emb.embed([3, 9, 4, 1, 8]))
+
+    with pytest.raises(ValueError, match="exceeds"):
+        emb.embed(list(range(17)))
+    with pytest.raises(ValueError, match="empty"):
+        emb.embed([])
+
+
+def test_embeddings_http(setup):
+    cfg, params = setup
+    tok = ByteTokenizer()
+
+    async def body():
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8)
+        server = InferenceServer(
+            engine, host="127.0.0.1", port=0, tokenizer=tok,
+            embedder=Embedder(params, cfg, buckets=(32,)),
+        )
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as s:
+                # list of strings
+                r = await s.post(f"{base}/v1/embeddings", json={
+                    "input": ["hello", "world"],
+                })
+                assert r.status == 200, await r.text()
+                p = await r.json()
+                assert p["object"] == "list"
+                assert [d["index"] for d in p["data"]] == [0, 1]
+                assert len(p["data"][0]["embedding"]) == cfg.d_model
+                assert p["usage"]["prompt_tokens"] == 10  # 5 bytes each
+
+                # token-id list and list of lists agree
+                r1 = await s.post(f"{base}/v1/embeddings",
+                                  json={"input": [5, 6, 7]})
+                r2 = await s.post(f"{base}/v1/embeddings",
+                                  json={"input": [[5, 6, 7]]})
+                e1 = (await r1.json())["data"][0]["embedding"]
+                e2 = (await r2.json())["data"][0]["embedding"]
+                assert e1 == e2
+
+                # unknown model 404; bad input 400
+                r = await s.post(f"{base}/v1/embeddings", json={
+                    "model": "nope", "input": "x",
+                })
+                assert r.status == 404
+                r = await s.post(f"{base}/v1/embeddings", json={"input": []})
+                assert r.status == 400
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=300))
+
+
+def test_embeddings_disabled_is_400(setup):
+    cfg, params = setup
+
+    async def body():
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8)
+        server = InferenceServer(engine, host="127.0.0.1", port=0)
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{server.bound_port}/v1/embeddings",
+                    json={"input": [1, 2]},
+                )
+                assert r.status == 400
+                assert "not enabled" in (await r.json())["error"]["message"]
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=120))

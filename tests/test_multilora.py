@@ -292,3 +292,18 @@ def test_precompute_prefix_requires_stacked_params(setup):
     with pytest.raises(ValueError, match="no stacked LoRA leaves"):
         precompute_prefix(params, [1, 2, 3], cfg, adapter=0,
                           n_adapters=aset.n)
+
+
+def test_lora_decode_bench_machinery(setup):
+    """The hardware workload's plumbing on CPU with a tiny config: both
+    arms run, report positive step times, and a finite overhead."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.decode_bench import (
+        lora_decode_bench,
+    )
+
+    cfg, _, _, _ = setup
+    r = lora_decode_bench(cfg, batch=2, ctx_len=16, steps=3,
+                          n_adapters=2, rank=4, repeats=1)
+    assert r.base_step_ms > 0 and r.lora_step_ms > 0
+    assert np.isfinite(r.overhead_pct)
+    assert r.n_adapters == 2 and r.batch == 2

@@ -64,6 +64,7 @@ QUEUE: list[tuple[str, str, float]] = [
     ("decode_int4w", "decode_int4w", 420),
     ("decode_int8kv", "decode_int8kv", 420),  # cache-quant lever isolated
     ("decode_ragged", "decode_ragged", 420),  # Pallas ragged decode kernel
+    ("decode_lora", "decode_lora", 420),  # multi-LoRA serving overhead
     ("serve", "serve", 600),
     ("usage_live", "usage_live", 120),  # reader vs the real runtime
     ("flash_tune_long", "flash_tune_long", 1200),  # S=8192, expendable
