@@ -215,7 +215,11 @@ def lora_decode_bench(
 
     allowed = jnp.ones((batch,), bool)
     eos = jnp.int32(-1)
-    knobs = jnp.zeros((batch, 4), jnp.float32)  # greedy
+    # greedy serving knobs: temp 0 / no top-k / top-p 1 / rep-penalty 1
+    # (penalty must be the identity 1.0 — a zero divides logits by 0)
+    knobs = jnp.tile(
+        jnp.asarray([0.0, 0.0, 1.0, 1.0], jnp.float32), (batch, 1)
+    )
     # mixed selection: rows cycle base, a0, a1, ... (the serving case)
     sel = jnp.asarray(np.stack([
         one_hot_sel((i % (n_adapters + 1)) - 1, n_adapters)

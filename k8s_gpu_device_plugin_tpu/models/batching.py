@@ -96,6 +96,7 @@ def prefill_insert(
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
+    bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Prefill one request and insert it into ``slot``.
 
@@ -125,7 +126,7 @@ def prefill_insert(
 
     key, sub = jax.random.split(state.key)
     tok, seen = sample_and_mark_dyn(
-        first_logits[None, :], sub, knobs[None, :], seen[None, :]
+        first_logits[None, :], sub, knobs[None, :], seen[None, :], bias
     )
     logp = token_logprob(first_logits[None, :], tok)[0]
     tok = tok[0]
@@ -163,6 +164,7 @@ def decode_step(
     cfg: LlamaConfig,
     knobs: jax.Array,    # (B, 4) f32 per-slot sampler knobs
     sel: jax.Array | None = None,  # (B, N) per-slot adapter one-hots
+    bias: jax.Array | None = None,  # (B, V) per-slot logit biases
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
@@ -187,7 +189,7 @@ def decode_step(
     )
     key, sub = jax.random.split(state.key)
     tok, presence = sample_and_mark_dyn(
-        logits[:, -1], sub, knobs, state.presence
+        logits[:, -1], sub, knobs, state.presence, bias
     )
     logps = token_logprob(logits[:, -1], tok)
     hit_eos = (tok == eos_id) & (eos_id >= 0)
@@ -231,6 +233,10 @@ class _Request:
     # model. Rides the decode step as a per-slot one-hot selection, so a
     # mixed batch of adapters shares one compile.
     adapter: int = -1
+    # OpenAI-style logit bias: ((token_id, bias), ...) added to the RAW
+    # logits before sampling. Rides the decode step as a per-slot dense
+    # (V,) plane, built host-side like the sampler knobs.
+    bias: tuple = ()
 
 
 
@@ -254,6 +260,9 @@ class ContinuousBatcher:
     #: turns this off: its draft/verify distributions are built from ONE
     #: static sampler)
     per_request_sampler = True
+    #: per-request logit_bias planes (the speculative round doesn't
+    #: thread them; it turns this off)
+    per_request_bias = True
 
     def __init__(
         self,
@@ -280,6 +289,7 @@ class ContinuousBatcher:
             self.adapter_names = ()
         self.n_adapters = len(self.adapter_names)
         self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs
+        self._bias_cache: jax.Array | None = None  # (n_slots, V), like knobs
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -335,6 +345,37 @@ class ContinuousBatcher:
         if not self.chunk:
             _bucket(prompt_len, self.buckets)
 
+    def validate_bias(self, logit_bias) -> tuple:
+        """Normalize/validate a logit_bias mapping (the admission-rule
+        pattern: shared with the serving engine's request thread).
+        Accepts {token_id: bias} or an iterable of pairs; OpenAI bounds:
+        at most 300 entries, bias in [-100, 100], ids in-vocab."""
+        if not logit_bias:
+            return ()
+        items = (
+            logit_bias.items() if isinstance(logit_bias, dict)
+            else list(logit_bias)
+        )
+        out = []
+        for tok, b in items:
+            tok = int(tok)
+            b = float(b)
+            if not (0 <= tok < self.cfg.vocab_size):
+                raise ValueError(
+                    f"logit_bias token {tok} outside vocab "
+                    f"[0, {self.cfg.vocab_size})"
+                )
+            if not (-100.0 <= b <= 100.0):
+                raise ValueError(
+                    f"logit_bias value {b} outside [-100, 100]"
+                )
+            out.append((tok, b))
+        if len(out) > 300:
+            raise ValueError(
+                f"logit_bias supports at most 300 entries (got {len(out)})"
+            )
+        return tuple(out)
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -354,6 +395,7 @@ class ContinuousBatcher:
         stop: list[list[int]] | None = None,
         sampler: "Sampler | None" = None,
         adapter: int = -1,
+        logit_bias=None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -369,6 +411,7 @@ class ContinuousBatcher:
         # every in-flight neighbor
         self.validate(total, max_new)
         self.validate_adapter(adapter)
+        bias = self.validate_bias(logit_bias)
         if prefix is not None and prefix.adapter != adapter:
             # the prefix rows were prefilled under ONE set of weights;
             # reusing them under another would serve wrong K/V silently
@@ -383,7 +426,7 @@ class ContinuousBatcher:
             _Request(
                 rid, full, max_new, prefix=prefix,
                 stop=tuple(tuple(s) for s in (stop or ()) if s),
-                sampler=sampler, adapter=adapter,
+                sampler=sampler, adapter=adapter, bias=bias,
             )
         )
         if self.metrics:
@@ -411,6 +454,32 @@ class ContinuousBatcher:
                     arr[slot] = sampler_knobs(req.sampler)
             self._knobs_cache = jnp.asarray(arr)
         return self._knobs_cache
+
+    def _req_bias(self, req: _Request) -> "jax.Array | None":
+        """(1, V) dense bias plane for one request's prefill sampling
+        (None when the request carries no bias — the common compiled
+        path stays bias-free)."""
+        if not req.bias:
+            return None
+        arr = np.zeros((1, self.cfg.vocab_size), np.float32)
+        for tok, b in req.bias:
+            arr[0, tok] += b
+        return jnp.asarray(arr)
+
+    def _batch_bias(self) -> "jax.Array | None":
+        """(n_slots, V) per-slot bias planes for the decode step; None
+        when NO running request has a bias (the bias-free compile).
+        Cached until the running set changes — same lifecycle as the
+        knobs/sel caches (invalidated together)."""
+        if not any(req.bias for req in self.running.values()):
+            return None
+        if self._bias_cache is None:
+            arr = np.zeros((self.n_slots, self.cfg.vocab_size), np.float32)
+            for slot, req in self.running.items():
+                for tok, b in req.bias:
+                    arr[slot, tok] += b
+            self._bias_cache = jnp.asarray(arr)
+        return self._bias_cache
 
     def _req_sel(self, req: _Request) -> "jax.Array | None":
         """(1, N) adapter one-hot for one request's prefill dispatches
@@ -469,6 +538,7 @@ class ContinuousBatcher:
                 self.params, self.state, padded,
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 self.cfg, self._req_knobs(req), sel=self._req_sel(req),
+                bias=self._req_bias(req),
             )
             req.out.append(int(tok))
             req.out_logp.append(float(logp))
@@ -477,6 +547,7 @@ class ContinuousBatcher:
             self.running[slot] = req
             self._knobs_cache = None
             self._sel_cache = None
+            self._bias_cache = None
             self._finish_if_done(req)
 
     def _prefill_one_chunk(self) -> None:
@@ -513,6 +584,7 @@ class ContinuousBatcher:
         self.running[slot] = req
         self._knobs_cache = None
         self._sel_cache = None
+        self._bias_cache = None
         self._finish_if_done(req)
 
     # overridable seams (the speculative batcher mirrors these onto a
@@ -532,6 +604,7 @@ class ContinuousBatcher:
             jnp.int32(plen), jnp.int32(slot),
             self.cfg, self._req_knobs(self.prefilling[slot]),
             sel=self._req_sel(self.prefilling[slot]),
+            bias=self._req_bias(self.prefilling[slot]),
         )
         return int(tok), float(logp)
 
@@ -554,6 +627,7 @@ class ContinuousBatcher:
                     self._prefill_pos.pop(slot, None)
                     self._knobs_cache = None
                     self._sel_cache = None
+                    self._bias_cache = None
                     self._retire_cancelled(req)
                     return True
         return False
@@ -582,6 +656,7 @@ class ContinuousBatcher:
                 del self.running[req.slot]
                 self._knobs_cache = None
                 self._sel_cache = None
+                self._bias_cache = None
             if self.metrics:
                 self.metrics.on_finish(
                     "eos" if hit_eos else ("stop" if hit_stop else "budget")
@@ -611,6 +686,7 @@ class ContinuousBatcher:
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
             self.cfg, self._batch_knobs(), sel=self._batch_sel(),
+            bias=self._batch_bias(),
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
@@ -708,6 +784,7 @@ def prefill_finish(
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
+    bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Final chunk: run it, sample the first generated token (returned
     with its logprob), activate the slot.
@@ -732,7 +809,7 @@ def prefill_finish(
     )
     key, sub = jax.random.split(state.key)
     tok, seen = sample_and_mark_dyn(
-        logits[:, 0], sub, knobs[None, :], seen[None, :]
+        logits[:, 0], sub, knobs[None, :], seen[None, :], bias
     )
     logp = token_logprob(logits[:, 0], tok)[0]
     tok = tok[0]

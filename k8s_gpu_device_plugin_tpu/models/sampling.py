@@ -172,6 +172,7 @@ def sample_logits_dyn(
     key: jax.Array,
     knobs: jax.Array,     # (B, 4) f32: temp, top_k, top_p, rep_penalty
     presence: jax.Array,  # (B, V) bool
+    bias: jax.Array | None = None,  # (B, V) f32 per-row logit bias
 ) -> jax.Array:
     """Per-ROW sampler knobs as traced values — continuous batching serves
     requests with different sampling settings in one compiled step.
@@ -185,8 +186,16 @@ def sample_logits_dyn(
     does. Costs one (B, V) sort per call (the post-top-k ordering is
     derived by masking the same sorted array) — noise next to the
     weight-streaming a decode step already does.
+
+    ``bias`` adds to the RAW logits before every filter (OpenAI
+    logit_bias semantics: -100 effectively bans a token, +100 forces
+    it); greedy rows argmax the biased logits. token_logprob stays over
+    the unbiased distribution by design (model confidence, not sampler
+    state).
     """
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
     temp, top_k, top_p, rep = (
         knobs[:, 0], knobs[:, 1], knobs[:, 2], knobs[:, 3]
     )
@@ -221,10 +230,11 @@ def sample_logits_dyn(
 
 
 def sample_and_mark_dyn(
-    logits: jax.Array, key: jax.Array, knobs: jax.Array, presence: jax.Array
+    logits: jax.Array, key: jax.Array, knobs: jax.Array, presence: jax.Array,
+    bias: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Dynamic-knob twin of :func:`sample_and_mark`."""
-    tok = sample_logits_dyn(logits, key, knobs, presence)
+    tok = sample_logits_dyn(logits, key, knobs, presence, bias)
     b = presence.shape[0]
     return tok, presence.at[jnp.arange(b), tok].set(True)
 

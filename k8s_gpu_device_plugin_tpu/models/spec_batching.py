@@ -235,9 +235,10 @@ class SpeculativeBatcher(ContinuousBatcher):
     #: draft/verify distributions are built from ONE static sampler; a
     #: per-request override would desynchronize the rejection sampling
     per_request_sampler = False
+    per_request_bias = False  # the draft+verify round threads no planes
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
-               adapter=-1):
+               adapter=-1, logit_bias=None):
         if prefix is not None:
             raise NotImplementedError(
                 "shared prefixes are not supported with speculative "
@@ -247,6 +248,12 @@ class SpeculativeBatcher(ContinuousBatcher):
             raise ValueError(
                 "per-request samplers are not supported with speculative "
                 "batching (draft and target must share one sampler)"
+            )
+        if logit_bias:
+            # the draft+verify round samples through its own path that
+            # doesn't thread bias planes; accepting would silently ignore
+            raise ValueError(
+                "logit_bias is not supported with speculative batching"
             )
         # adapter >= 0 rejected by validate_adapter: __init__ refuses
         # adapter stacks, so n_adapters is always 0 here

@@ -182,6 +182,9 @@ class _OpenAIRoutes:
                 temperature=float(body.get("temperature", 1.0)),
                 top_p=float(body.get("top_p", 1.0)),
             )
+        from k8s_gpu_device_plugin_tpu.serving.server import _parse_logit_bias
+
+        logit_bias = _parse_logit_bias(body.get("logit_bias"))
         # "model" routes: the base model's id (or absent) -> base; a
         # loaded LoRA adapter's name -> that adapter. Anything else is
         # OpenAI's model_not_found.
@@ -195,7 +198,7 @@ class _OpenAIRoutes:
         return {
             "n": n, "stream": stream, "max_new": max_new,
             "stop": stop_lists, "sampler": sampler,
-            "model": model, "adapter": adapter,
+            "model": model, "adapter": adapter, "logit_bias": logit_bias,
         }
 
     def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
@@ -215,7 +218,7 @@ class _OpenAIRoutes:
         return [
             self._server.engine.submit(
                 prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"],
-                adapter=c["adapter"],
+                adapter=c["adapter"], logit_bias=c["logit_bias"],
             )
             for _ in range(c["n"])
         ]
@@ -291,6 +294,7 @@ class _OpenAIRoutes:
 
     def _embedding_inputs(self, raw) -> list[list[int]]:
         tok = self._server.tokenizer
+        vocab = self._server.engine.cb.cfg.vocab_size
 
         def encode(s: str) -> list[int]:
             if tok is None:
@@ -300,19 +304,32 @@ class _OpenAIRoutes:
                 )
             return tok.encode(s)
 
+        def _is_id(t) -> bool:
+            # bool is an int subclass; True/False must not embed as 1/0
+            return type(t) is int
+
+        def check(ids: list[int]) -> list[int]:
+            for t in ids:
+                if not (0 <= t < vocab):
+                    # an out-of-range id would silently clamp/wrap in the
+                    # embedding gather and return a wrong vector
+                    raise ValueError(
+                        f"token id {t} outside vocab [0, {vocab})"
+                    )
+            return list(ids)
+
         if isinstance(raw, str) and raw:
             return [encode(raw)]
         if isinstance(raw, list) and raw:
             if all(isinstance(x, str) and x for x in raw):
                 return [encode(s) for s in raw]
-            if all(isinstance(x, int) for x in raw):
-                return [list(raw)]
+            if all(_is_id(x) for x in raw):
+                return [check(raw)]
             if all(
-                isinstance(x, list) and x
-                and all(isinstance(t, int) for t in x)
+                isinstance(x, list) and x and all(_is_id(t) for t in x)
                 for x in raw
             ):
-                return [list(x) for x in raw]
+                return [check(x) for x in raw]
         raise ValueError(
             "input must be a non-empty string, list of strings, token-id "
             "list, or list of token-id lists"

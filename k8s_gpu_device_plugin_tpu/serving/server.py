@@ -108,7 +108,7 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._dead = threading.Event()
         self._subq: list[
-            tuple[int, list[int], int, tuple, "Sampler | None", int]
+            tuple[int, list[int], int, tuple, "Sampler | None", int, tuple]
         ] = []
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
@@ -127,6 +127,7 @@ class InferenceEngine:
         stop: list[list[int]] | None = None,
         sampler: Sampler | None = None,
         adapter: int = -1,
+        logit_bias=None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
@@ -138,12 +139,18 @@ class InferenceEngine:
             raise RuntimeError("inference engine is dead (see logs)")
         self.cb.validate(len(prompt), max_new)  # the batcher's own rule
         self.cb.validate_adapter(adapter)
+        logit_bias = self.cb.validate_bias(logit_bias)
         if sampler is not None and not getattr(
             self.cb, "per_request_sampler", False
         ):
             raise ValueError(
                 "per-request sampling is not supported by this engine "
                 "(speculative batching shares one sampler)"
+            )
+        if logit_bias and not getattr(self.cb, "per_request_bias", False):
+            raise ValueError(
+                "logit_bias is not supported by this engine "
+                "(speculative batching threads no bias planes)"
             )
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -158,7 +165,7 @@ class InferenceEngine:
             self._next_eid += 1
             self._subq.append(
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
-                 adapter)
+                 adapter, logit_bias)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -195,10 +202,10 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new, stop, sampler, adapter in batch:
+        for eid, prompt, max_new, stop, sampler, adapter, bias in batch:
             rid = self.cb.submit(
                 prompt, max_new=max_new, stop=[list(st) for st in stop],
-                sampler=sampler, adapter=adapter,
+                sampler=sampler, adapter=adapter, logit_bias=bias,
             )
             self._rid_to_eid[rid] = eid
 
@@ -305,6 +312,22 @@ class InferenceEngine:
                 loop.call_soon_threadsafe(q.put_nowait, None)
 
 
+def _parse_logit_bias(raw) -> dict | None:
+    """JSON logit_bias ({"token_id": bias} — keys are strings on the
+    wire, OpenAI-style) -> {int: float}; value bounds are the batcher's
+    validate_bias rule. Shared by the native and OpenAI handlers."""
+    if raw is None:
+        return None
+    if not isinstance(raw, dict):
+        raise ValueError("logit_bias must be an object of token_id: bias")
+    try:
+        return {int(k): float(v) for k, v in raw.items()}
+    except (TypeError, ValueError):
+        raise ValueError(
+            "logit_bias keys must be integer token ids and values numbers"
+        ) from None
+
+
 async def drain_queue(queue: asyncio.Queue) -> tuple[list[int], list[float]]:
     """Collect one request's full (tokens, logprobs) off its stream queue
     (None = end-of-stream). Shared by the native and OpenAI handlers."""
@@ -403,6 +426,7 @@ class InferenceServer:
             stream = bool(body.get("stream", False))
             n = int(body.get("n", 1))
             adapter = self.resolve_adapter(body.get("adapter"))
+            logit_bias = _parse_logit_bias(body.get("logit_bias"))
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
@@ -450,7 +474,8 @@ class InferenceServer:
         try:
             subs = [
                 self.engine.submit(prompt, max_new, stop=stop,
-                                   sampler=sampler, adapter=adapter)
+                                   sampler=sampler, adapter=adapter,
+                                   logit_bias=logit_bias)
                 for _ in range(n)
             ]
         except ValueError as e:  # capacity/bucket/sampler validation

@@ -130,3 +130,35 @@ def test_embeddings_disabled_is_400(setup):
             await asyncio.wait_for(task, 30)
 
     asyncio.run(asyncio.wait_for(body(), timeout=120))
+
+
+def test_embedding_input_id_validation(setup):
+    """Out-of-range / negative / boolean 'ids' are a 400, never a wrong
+    vector from a clamped gather."""
+    cfg, params = setup
+
+    async def body():
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8)
+        server = InferenceServer(
+            engine, host="127.0.0.1", port=0,
+            embedder=Embedder(params, cfg, buckets=(32,)),
+        )
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as s:
+                for bad in ([cfg.vocab_size], [-1], [True, False]):
+                    r = await s.post(f"{base}/v1/embeddings",
+                                     json={"input": bad})
+                    assert r.status == 400, bad
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    asyncio.run(asyncio.wait_for(body(), timeout=120))
