@@ -307,3 +307,41 @@ def test_lora_decode_bench_machinery(setup):
     assert r.base_step_ms > 0 and r.lora_step_ms > 0
     assert np.isfinite(r.overhead_pct)
     assert r.n_adapters == 2 and r.batch == 2
+
+
+def test_all_per_request_features_compose_in_one_batch(setup):
+    """One batch mixing every per-request dial: a base-model row with a
+    +100 forced token, an adapter row greedy (oracle-pinned against its
+    merged weights), and an adapter row with a per-request sampler —
+    all sharing one compiled decode step."""
+    from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+    cfg, params, aset, merged = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=3, max_len=64,
+                           chunked_prefill=8, adapters=aset)
+    p1, p2, p3 = (_prompt(s, 6, cfg) for s in (200, 201, 202))
+    r_forced = cb.submit(p1, max_new=4, logit_bias={42: 100.0})
+    r_adapter = cb.submit(p2, max_new=6, adapter=1)
+    r_both = cb.submit(p3, max_new=5, adapter=0,
+                       sampler=Sampler(temperature=0.8, top_k=20))
+    done = cb.run()
+    assert done[r_forced] == [42] * 4
+    assert done[r_adapter] == _oracle(merged[1], p2, cfg, 6)
+    out = done[r_both]
+    assert len(out) == 5 and all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_adapters_compose_with_quantized_cache(setup):
+    """Multi-LoRA + int8 KV cache: the adapter deltas touch projections,
+    the cache quantization touches storage — a batcher running both
+    matches generate() on merged weights with the same quantized cache."""
+    from dataclasses import replace
+
+    cfg, params, aset, merged = setup
+    qcfg = replace(cfg, cache_quant="int8")
+    cb = ContinuousBatcher(params, qcfg, n_slots=2, max_len=64,
+                           chunked_prefill=8, adapters=aset)
+    prompt = _prompt(210, 6, cfg)
+    rid = cb.submit(prompt, max_new=6, adapter=1)
+    done = cb.run()
+    assert done[rid] == _oracle(merged[1], prompt, qcfg, 6)
