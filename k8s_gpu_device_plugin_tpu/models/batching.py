@@ -97,6 +97,7 @@ def prefill_insert(
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
     bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
+    seed: jax.Array | None = None,  # (1,) i32 per-request seed (draw 0)
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Prefill one request and insert it into ``slot``.
 
@@ -126,7 +127,8 @@ def prefill_insert(
 
     key, sub = jax.random.split(state.key)
     tok, seen = sample_and_mark_dyn(
-        first_logits[None, :], sub, knobs[None, :], seen[None, :], bias
+        first_logits[None, :], sub, knobs[None, :], seen[None, :], bias,
+        seed,  # draw index defaults to 0 (the first draw) in the sampler
     )
     logp = token_logprob(first_logits[None, :], tok)[0]
     tok = tok[0]
@@ -165,6 +167,8 @@ def decode_step(
     knobs: jax.Array,    # (B, 4) f32 per-slot sampler knobs
     sel: jax.Array | None = None,  # (B, N) per-slot adapter one-hots
     bias: jax.Array | None = None,  # (B, V) per-slot logit biases
+    seeds: jax.Array | None = None,  # (B,) i32 seeds (-1 = unseeded)
+    draws: jax.Array | None = None,  # (B,) i32 per-slot draw indices
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
@@ -189,7 +193,7 @@ def decode_step(
     )
     key, sub = jax.random.split(state.key)
     tok, presence = sample_and_mark_dyn(
-        logits[:, -1], sub, knobs, state.presence, bias
+        logits[:, -1], sub, knobs, state.presence, bias, seeds, draws
     )
     logps = token_logprob(logits[:, -1], tok)
     hit_eos = (tok == eos_id) & (eos_id >= 0)
@@ -237,6 +241,10 @@ class _Request:
     # logits before sampling. Rides the decode step as a per-slot dense
     # (V,) plane, built host-side like the sampler knobs.
     bias: tuple = ()
+    # per-request sampling seed (None = shared step key): the i-th draw
+    # uses fold_in(key(seed), i), i = len(out) host-side — the sampled
+    # stream reproduces regardless of batch composition or timing
+    seed: "int | None" = None
 
 
 
@@ -263,6 +271,8 @@ class ContinuousBatcher:
     #: per-request logit_bias planes (the speculative round doesn't
     #: thread them; it turns this off)
     per_request_bias = True
+    #: per-request sampling seeds (same story)
+    per_request_seed = True
 
     def __init__(
         self,
@@ -396,6 +406,7 @@ class ContinuousBatcher:
         sampler: "Sampler | None" = None,
         adapter: int = -1,
         logit_bias=None,
+        seed: "int | None" = None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -412,6 +423,10 @@ class ContinuousBatcher:
         self.validate(total, max_new)
         self.validate_adapter(adapter)
         bias = self.validate_bias(logit_bias)
+        if seed is not None:
+            seed = int(seed)
+            if not (0 <= seed < 2**31):
+                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
         if prefix is not None and prefix.adapter != adapter:
             # the prefix rows were prefilled under ONE set of weights;
             # reusing them under another would serve wrong K/V silently
@@ -426,7 +441,7 @@ class ContinuousBatcher:
             _Request(
                 rid, full, max_new, prefix=prefix,
                 stop=tuple(tuple(s) for s in (stop or ()) if s),
-                sampler=sampler, adapter=adapter, bias=bias,
+                sampler=sampler, adapter=adapter, bias=bias, seed=seed,
             )
         )
         if self.metrics:
@@ -480,6 +495,28 @@ class ContinuousBatcher:
                     arr[slot, tok] += b
             self._bias_cache = jnp.asarray(arr)
         return self._bias_cache
+
+    def _req_seed(self, req: _Request) -> "jax.Array | None":
+        """(1,) seed for one request's prefill sampling (draw 0)."""
+        if req.seed is None:
+            return None
+        return jnp.asarray([req.seed], jnp.int32)
+
+    def _batch_seed_draws(self):
+        """((B,) seeds, (B,) draw indices) for the decode step — or
+        (None, None) when no running request is seeded (the unchanged
+        compile). Draw index = tokens generated so far, known host-side,
+        so no device state tracks it; rebuilt per step (a (B,) transfer,
+        noise next to the step)."""
+        if not any(req.seed is not None for req in self.running.values()):
+            return None, None
+        seeds = np.full((self.n_slots,), -1, np.int32)
+        draws = np.zeros((self.n_slots,), np.int32)
+        for slot, req in self.running.items():
+            if req.seed is not None:
+                seeds[slot] = req.seed
+                draws[slot] = len(req.out)
+        return jnp.asarray(seeds), jnp.asarray(draws)
 
     def _req_sel(self, req: _Request) -> "jax.Array | None":
         """(1, N) adapter one-hot for one request's prefill dispatches
@@ -538,7 +575,7 @@ class ContinuousBatcher:
                 self.params, self.state, padded,
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 self.cfg, self._req_knobs(req), sel=self._req_sel(req),
-                bias=self._req_bias(req),
+                bias=self._req_bias(req), seed=self._req_seed(req),
             )
             req.out.append(int(tok))
             req.out_logp.append(float(logp))
@@ -605,6 +642,7 @@ class ContinuousBatcher:
             self.cfg, self._req_knobs(self.prefilling[slot]),
             sel=self._req_sel(self.prefilling[slot]),
             bias=self._req_bias(self.prefilling[slot]),
+            seed=self._req_seed(self.prefilling[slot]),
         )
         return int(tok), float(logp)
 
@@ -683,10 +721,11 @@ class ContinuousBatcher:
         """One decode dispatch for the whole batch; returns tokens emitted
         (the speculative batcher overrides this with a draft+verify round
         that can emit up to gamma tokens per slot)."""
+        seeds, draws = self._batch_seed_draws()
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, jnp.int32(self.eos_id),
             self.cfg, self._batch_knobs(), sel=self._batch_sel(),
-            bias=self._batch_bias(),
+            bias=self._batch_bias(), seeds=seeds, draws=draws,
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
@@ -785,6 +824,7 @@ def prefill_finish(
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
     bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
+    seed: jax.Array | None = None,  # (1,) i32 per-request seed (draw 0)
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Final chunk: run it, sample the first generated token (returned
     with its logprob), activate the slot.
@@ -809,7 +849,8 @@ def prefill_finish(
     )
     key, sub = jax.random.split(state.key)
     tok, seen = sample_and_mark_dyn(
-        logits[:, 0], sub, knobs[None, :], seen[None, :], bias
+        logits[:, 0], sub, knobs[None, :], seen[None, :], bias,
+        seed,  # draw index defaults to 0 (the first draw) in the sampler
     )
     logp = token_logprob(logits[:, 0], tok)[0]
     tok = tok[0]

@@ -173,6 +173,8 @@ def sample_logits_dyn(
     knobs: jax.Array,     # (B, 4) f32: temp, top_k, top_p, rep_penalty
     presence: jax.Array,  # (B, V) bool
     bias: jax.Array | None = None,  # (B, V) f32 per-row logit bias
+    seeds: jax.Array | None = None,   # (B,) i32 per-row seed (-1 = none)
+    draws: jax.Array | None = None,   # (B,) i32 per-row draw index
 ) -> jax.Array:
     """Per-ROW sampler knobs as traced values — continuous batching serves
     requests with different sampling settings in one compiled step.
@@ -192,6 +194,14 @@ def sample_logits_dyn(
     it); greedy rows argmax the biased logits. token_logprob stays over
     the unbiased distribution by design (model confidence, not sampler
     state).
+
+    ``seeds``/``draws`` (both (B,) int32, -1/any for unseeded rows)
+    give a row its OWN key stream: the i-th draw of a seeded request
+    uses fold_in(key(seed), i) — its sampled tokens depend only on its
+    seed and its own logits, so the stream reproduces exactly
+    regardless of batch composition, admission timing, or neighbors
+    (stronger than OpenAI's best-effort ``seed``). Unseeded rows keep
+    the shared step key, bit-identical to the seedless path.
     """
     logits = logits.astype(jnp.float32)
     if bias is not None:
@@ -226,15 +236,29 @@ def sample_logits_dyn(
     )
     scaled = jnp.where((top_p < 1.0)[:, None] & (scaled < pth), _NEG, scaled)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    if seeds is not None:
+        if draws is None:  # first draw (prefill callers): index 0
+            draws = jnp.zeros(seeds.shape, jnp.int32)
+
+        def draw_one(s, d, row):
+            k = jax.random.fold_in(
+                jax.random.key(jnp.maximum(s, 0).astype(jnp.uint32)), d
+            )
+            return jax.random.categorical(k, row).astype(jnp.int32)
+
+        seeded = jax.vmap(draw_one)(seeds, draws, scaled)
+        sampled = jnp.where(seeds >= 0, seeded, sampled)
     return jnp.where(temp == 0.0, greedy_tok, sampled)
 
 
 def sample_and_mark_dyn(
     logits: jax.Array, key: jax.Array, knobs: jax.Array, presence: jax.Array,
     bias: jax.Array | None = None,
+    seeds: jax.Array | None = None,
+    draws: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Dynamic-knob twin of :func:`sample_and_mark`."""
-    tok = sample_logits_dyn(logits, key, knobs, presence, bias)
+    tok = sample_logits_dyn(logits, key, knobs, presence, bias, seeds, draws)
     b = presence.shape[0]
     return tok, presence.at[jnp.arange(b), tok].set(True)
 

@@ -236,9 +236,10 @@ class SpeculativeBatcher(ContinuousBatcher):
     #: per-request override would desynchronize the rejection sampling
     per_request_sampler = False
     per_request_bias = False  # the draft+verify round threads no planes
+    per_request_seed = False  # same: no per-row key streams in the round
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
-               adapter=-1, logit_bias=None):
+               adapter=-1, logit_bias=None, seed=None):
         if prefix is not None:
             raise NotImplementedError(
                 "shared prefixes are not supported with speculative "
@@ -254,6 +255,11 @@ class SpeculativeBatcher(ContinuousBatcher):
             # doesn't thread bias planes; accepting would silently ignore
             raise ValueError(
                 "logit_bias is not supported with speculative batching"
+            )
+        if seed is not None:
+            raise ValueError(
+                "per-request seeds are not supported with speculative "
+                "batching (the round threads no per-row key streams)"
             )
         # adapter >= 0 rejected by validate_adapter: __init__ refuses
         # adapter stacks, so n_adapters is always 0 here

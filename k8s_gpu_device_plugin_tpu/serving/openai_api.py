@@ -185,6 +185,13 @@ class _OpenAIRoutes:
         from k8s_gpu_device_plugin_tpu.serving.server import _parse_logit_bias
 
         logit_bias = _parse_logit_bias(body.get("logit_bias"))
+        seed = body.get("seed")
+        if seed is not None:
+            seed = int(seed)
+            # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
+            # the modulo would wrap an invalid seed into range silently
+            if not (0 <= seed < 2**31):
+                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
         # "model" routes: the base model's id (or absent) -> base; a
         # loaded LoRA adapter's name -> that adapter. Anything else is
         # OpenAI's model_not_found.
@@ -199,6 +206,7 @@ class _OpenAIRoutes:
             "n": n, "stream": stream, "max_new": max_new,
             "stop": stop_lists, "sampler": sampler,
             "model": model, "adapter": adapter, "logit_bias": logit_bias,
+            "seed": seed,
         }
 
     def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
@@ -215,12 +223,16 @@ class _OpenAIRoutes:
     # --- engine plumbing -------------------------------------------------
 
     def _submit(self, prompt: list[int], c: dict) -> list[tuple[int, asyncio.Queue]]:
+        # n>1 with a seed derives a per-choice seed (seed+i): the whole
+        # response stays reproducible while the n samples stay distinct —
+        # the same seed for every choice would return n identical copies
         return [
             self._server.engine.submit(
                 prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"],
                 adapter=c["adapter"], logit_bias=c["logit_bias"],
+                seed=None if c["seed"] is None else (c["seed"] + i) % 2**31,
             )
-            for _ in range(c["n"])
+            for i in range(c["n"])
         ]
 
     @staticmethod

@@ -128,6 +128,7 @@ class InferenceEngine:
         sampler: Sampler | None = None,
         adapter: int = -1,
         logit_bias=None,
+        seed: int | None = None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
@@ -152,6 +153,14 @@ class InferenceEngine:
                 "logit_bias is not supported by this engine "
                 "(speculative batching threads no bias planes)"
             )
+        if seed is not None:
+            seed = int(seed)
+            if not (0 <= seed < 2**31):
+                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
+            if not getattr(self.cb, "per_request_seed", False):
+                raise ValueError(
+                    "per-request seeds are not supported by this engine"
+                )
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
@@ -165,7 +174,7 @@ class InferenceEngine:
             self._next_eid += 1
             self._subq.append(
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
-                 adapter, logit_bias)
+                 adapter, logit_bias, seed)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -202,10 +211,10 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new, stop, sampler, adapter, bias in batch:
+        for eid, prompt, max_new, stop, sampler, adapter, bias, seed in batch:
             rid = self.cb.submit(
                 prompt, max_new=max_new, stop=[list(st) for st in stop],
-                sampler=sampler, adapter=adapter, logit_bias=bias,
+                sampler=sampler, adapter=adapter, logit_bias=bias, seed=seed,
             )
             self._rid_to_eid[rid] = eid
 
@@ -427,6 +436,14 @@ class InferenceServer:
             n = int(body.get("n", 1))
             adapter = self.resolve_adapter(body.get("adapter"))
             logit_bias = _parse_logit_bias(body.get("logit_bias"))
+            seed = body.get("seed")
+            if seed is not None:
+                seed = int(seed)
+                # validate BEFORE the per-choice (seed+i) % 2^31
+                # derivation — the modulo would wrap an invalid seed
+                # into range and silently accept it
+                if not (0 <= seed < 2**31):
+                    raise ValueError(f"seed must be in [0, 2^31), got {seed}")
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
@@ -472,11 +489,15 @@ class InferenceServer:
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
+            # n>1 with a seed: per-choice seeds (seed+i), reproducible AND
+            # distinct — one seed for all n would return identical copies
             subs = [
-                self.engine.submit(prompt, max_new, stop=stop,
-                                   sampler=sampler, adapter=adapter,
-                                   logit_bias=logit_bias)
-                for _ in range(n)
+                self.engine.submit(
+                    prompt, max_new, stop=stop, sampler=sampler,
+                    adapter=adapter, logit_bias=logit_bias,
+                    seed=None if seed is None else (seed + i) % 2**31,
+                )
+                for i in range(n)
             ]
         except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
