@@ -386,6 +386,19 @@ class ContinuousBatcher:
             )
         return tuple(out)
 
+    @staticmethod
+    def validate_seed(seed) -> "int | None":
+        """The seed half of the admission rule (static: the bound is a
+        property of the key scheme, not of any batcher instance). Shared
+        by submit, the engine's request thread, and both HTTP parsers —
+        one definition of a valid seed."""
+        if seed is None:
+            return None
+        seed = int(seed)
+        if not (0 <= seed < 2**31):
+            raise ValueError(f"seed must be in [0, 2^31), got {seed}")
+        return seed
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -423,10 +436,7 @@ class ContinuousBatcher:
         self.validate(total, max_new)
         self.validate_adapter(adapter)
         bias = self.validate_bias(logit_bias)
-        if seed is not None:
-            seed = int(seed)
-            if not (0 <= seed < 2**31):
-                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
+        seed = self.validate_seed(seed)
         if prefix is not None and prefix.adapter != adapter:
             # the prefix rows were prefilled under ONE set of weights;
             # reusing them under another would serve wrong K/V silently

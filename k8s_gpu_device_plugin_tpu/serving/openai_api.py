@@ -185,13 +185,13 @@ class _OpenAIRoutes:
         from k8s_gpu_device_plugin_tpu.serving.server import _parse_logit_bias
 
         logit_bias = _parse_logit_bias(body.get("logit_bias"))
-        seed = body.get("seed")
-        if seed is not None:
-            seed = int(seed)
-            # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
-            # the modulo would wrap an invalid seed into range silently
-            if not (0 <= seed < 2**31):
-                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
+        from k8s_gpu_device_plugin_tpu.models.batching import (
+            ContinuousBatcher,
+        )
+
+        # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
+        # the modulo would wrap an invalid seed into range silently
+        seed = ContinuousBatcher.validate_seed(body.get("seed"))
         # "model" routes: the base model's id (or absent) -> base; a
         # loaded LoRA adapter's name -> that adapter. Anything else is
         # OpenAI's model_not_found.
@@ -386,6 +386,15 @@ class _OpenAIRoutes:
                 raise ValueError(
                     "chat completions need a tokenizer on this server"
                 )
+            # chat-only: the newer field name wins over max_tokens when
+            # both are sent (OpenAI deprecates max_tokens here); an
+            # explicit null means absent, as OpenAI treats it
+            mct = body.get("max_completion_tokens")
+            if mct is not None:
+                mct = int(mct)
+                if mct < 1:
+                    raise ValueError("max_completion_tokens must be >= 1")
+                c["max_new"] = mct
             messages = body.get("messages")
             if not isinstance(messages, list) or not messages:
                 raise ValueError("messages must be a non-empty list")

@@ -153,14 +153,13 @@ class InferenceEngine:
                 "logit_bias is not supported by this engine "
                 "(speculative batching threads no bias planes)"
             )
-        if seed is not None:
-            seed = int(seed)
-            if not (0 <= seed < 2**31):
-                raise ValueError(f"seed must be in [0, 2^31), got {seed}")
-            if not getattr(self.cb, "per_request_seed", False):
-                raise ValueError(
-                    "per-request seeds are not supported by this engine"
-                )
+        seed = self.cb.validate_seed(seed)
+        if seed is not None and not getattr(
+            self.cb, "per_request_seed", False
+        ):
+            raise ValueError(
+                "per-request seeds are not supported by this engine"
+            )
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
@@ -436,14 +435,9 @@ class InferenceServer:
             n = int(body.get("n", 1))
             adapter = self.resolve_adapter(body.get("adapter"))
             logit_bias = _parse_logit_bias(body.get("logit_bias"))
-            seed = body.get("seed")
-            if seed is not None:
-                seed = int(seed)
-                # validate BEFORE the per-choice (seed+i) % 2^31
-                # derivation — the modulo would wrap an invalid seed
-                # into range and silently accept it
-                if not (0 <= seed < 2**31):
-                    raise ValueError(f"seed must be in [0, 2^31), got {seed}")
+            # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
+            # the modulo would wrap an invalid seed into range silently
+            seed = ContinuousBatcher.validate_seed(body.get("seed"))
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))

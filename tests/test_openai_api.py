@@ -395,3 +395,19 @@ def test_chat_bad_messages_rejected(setup):
         assert r.status == 400
 
     run(_with_server(setup, body, tokenizer=tok))
+
+
+def test_max_completion_tokens_field(setup):
+    """Chat accepts OpenAI's newer max_completion_tokens name (it wins
+    over a stale max_tokens when both are sent)."""
+    tok = ByteTokenizer()
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "x"}],
+            "max_completion_tokens": 3, "max_tokens": 7,
+        })
+        assert r.status == 200, await r.text()
+        assert (await r.json())["usage"]["completion_tokens"] == 3
+
+    run(_with_server(setup, body, tokenizer=tok))
