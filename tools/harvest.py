@@ -38,37 +38,83 @@ RESULTS_PATH = os.path.join(REPO_ROOT, "harvest_results.jsonl")
 PROBE_TIMEOUT = 60.0
 TPU_PLATFORMS = (None, "tpu", "")  # same fallback cycle as bench.py
 
-# (row name, runner workload, timeout_seconds) in harvest-priority order:
-# headline metrics first (train MFU is the driver-recorded number), then
-# the Allocate-path parity proof, the tuning sweeps that order the next
-# optimization, the serving-side economics, and the live-runtime metrics
-# validation. Row names are what the CLI filter and the journal use; the
-# distinct "train_tuned" row re-times the SAME train workload after
-# flash_tune persisted its winners, measuring the tuned payoff against
-# the baseline row.
+# (row name, runner workload, timeout_seconds) in harvest-priority order.
+# Round-5 ordering (VERDICT r4 #1): the train headline is BANKED in the
+# journal (55.13% MFU, 03:46Z window) while the entire serving stack has
+# zero hardware numbers after two rounds — so never-measured rows lead and
+# banked-metric refreshes trail. The observed window length is ~12-15
+# minutes; the first ~4 rows are what a short window actually buys.
+# Row names are what the CLI filter and the journal use; the distinct
+# "train_tuned" row re-times the SAME train workload with flash_tune's
+# persisted winners (.flash_tilings.json from the last sweep) resolved,
+# measuring the tuned payoff against the banked baseline row.
 QUEUE: list[tuple[str, str, float]] = [
-    ("matmul", "matmul", 300),        # 83% ceiling check (BASELINE #2)
-    ("train", "train", 480),          # headline: train MFU vs 54.65 record
-    ("allocated", "allocated", 600),  # n=4096 parity through Allocate
-    ("flash_tune", "flash_tune", 900),  # backward tilings (55->83 lever)
-    ("train_tuned", "train", 480),    # tuned payoff vs the baseline row
-    ("breakdown", "breakdown", 600),  # step-time attribution
-    ("breakdown_attn", "breakdown_attn", 600),
-    ("train_fusedopt", "train_fusedopt", 480),  # fused AdamW
-    ("train_int8", "train_int8", 480),          # MXU double-rate path
-    ("opt_tune", "opt_tune", 600),
-    ("remat_tune", "remat_tune", 900),  # HBM-vs-recompute dial, 4 variants
-    ("train_bs16", "train_bs16", 480),  # double batch: overhead amortization
-    ("decode", "decode", 420),        # serving economics, never on hw
-    ("decode_int8w", "decode_int8w", 420),
-    ("decode_int4w", "decode_int4w", 420),
-    ("decode_int8kv", "decode_int8kv", 420),  # cache-quant lever isolated
+    ("decode", "decode", 420),        # serving economics headline, never on hw
+    ("usage_live", "usage_live", 120),  # reader vs the real runtime (cheap)
+    ("serve", "serve", 600),          # continuous-batching request throughput
+    ("train_tuned", "train", 480),    # flash_tune winners' payoff (55->83 lever)
+    ("decode_int8w", "decode_int8w", 420),  # weight-quant HBM lever
     ("decode_ragged", "decode_ragged", 420),  # Pallas ragged decode kernel
+    ("decode_int8kv", "decode_int8kv", 420),  # cache-quant lever isolated
+    ("decode_int4w", "decode_int4w", 420),
     ("decode_lora", "decode_lora", 420),  # multi-LoRA serving overhead
-    ("serve", "serve", 600),
-    ("usage_live", "usage_live", 120),  # reader vs the real runtime
+    ("breakdown", "breakdown", 600),  # step-time attribution (55->83 map)
+    ("breakdown_attn", "breakdown_attn", 600),
+    ("remat_tune", "remat_tune", 900),  # HBM-vs-recompute dial, 4 variants
+    ("train_int8", "train_int8", 480),          # MXU double-rate path
+    ("train_fusedopt", "train_fusedopt", 480),  # fused AdamW
+    ("opt_tune", "opt_tune", 600),
+    ("train_bs16", "train_bs16", 480),  # double batch: overhead amortization
+    # Banked-metric refreshes: fresh journal rows make --resume skip these;
+    # they re-measure only once the never-measured rows above have landed
+    # or the banked values have aged out (48h bound shared with bench.py).
+    ("matmul", "matmul", 300),        # 83% ceiling check (BASELINE #2)
+    ("train", "train", 480),          # headline: train MFU vs 55.13 record
+    ("allocated", "allocated", 600),  # n=4096 parity through Allocate
+    ("flash_tune", "flash_tune", 900),  # backward tilings sweep
     ("flash_tune_long", "flash_tune_long", 1200),  # S=8192, expendable
 ]
+
+# Repeat/variance discipline (VERDICT r4 weak #2: single best-of-N rows
+# made the 83.06->80.72 matmul drift uninterpretable). A row repeats its
+# workload inside its OWN timeout budget — never costing the queue more
+# than the single-run design did — and journals every repeat plus the
+# spread; ``result`` stays the median repeat so bench.py's adoption picks
+# a central value with no format change. Sweeps and one-shot validations
+# are excluded (a sweep's own grid is its variance story).
+MAX_REPEATS = 3
+REPEAT_MARGIN = 20.0  # seconds of slack a repeat must leave in the budget
+NO_REPEAT = {"flash_tune", "flash_tune_long", "remat_tune", "opt_tune",
+             "usage_live", "breakdown", "breakdown_attn"}
+# Primary metric per workload family, used to order repeats for the median
+# and to express the spread; first key present in the result wins.
+PRIMARY_KEYS = ("mfu_pct", "decode_tokens_per_second", "requests_per_second",
+                "tokens_per_second", "scrapes_with_data")
+
+
+def primary_key(result: dict) -> str | None:
+    for k in PRIMARY_KEYS:
+        if isinstance(result.get(k), (int, float)):
+            return k
+    return None
+
+
+def median_of(repeats: list[dict]) -> tuple[dict, dict | None]:
+    """(median repeat, spread summary) — lower-middle for even n so the
+    reported dict is always a really-measured run, never an interpolation."""
+    key = primary_key(repeats[0])
+    if key is None or len(repeats) == 1:
+        return repeats[0], None
+    ordered = sorted(repeats, key=lambda r: r[key])
+    med = ordered[(len(ordered) - 1) // 2]
+    vals = [r[key] for r in repeats]
+    lo, hi = min(vals), max(vals)
+    center = med[key] if med[key] else 1.0
+    return med, {
+        "metric": key,
+        "values": vals,
+        "rel_spread_pct": round(100.0 * (hi - lo) / abs(center), 2),
+    }
 
 _T0 = time.monotonic()
 
@@ -89,17 +135,32 @@ def run_child(workload: str, timeout: float, attempt: int = 0) -> dict | None:
     return None
 
 
-def persist(workload: str, result: dict | None) -> None:
+def persist(workload: str, result: dict | None,
+            repeats: list[dict] | None = None) -> dict:
+    """Append one journal row; returns the record so callers can log the
+    spread that was actually written (computed exactly once, here)."""
+    rec: dict = {
+        "workload": workload,
+        "t": round(time.monotonic() - _T0, 1),
+        "ts": round(time.time(), 1),  # bench.py's fallback ages by this
+    }
+    if repeats and len(repeats) > 1:
+        med, spread = median_of(repeats)
+        rec["result"] = med  # adoption (bench.py) reads this: the median
+        rec["n_repeats"] = len(repeats)
+        rec["repeats"] = repeats
+        if spread is not None:
+            rec["spread"] = spread
+    else:
+        rec["result"] = result
+        if repeats:
+            rec["n_repeats"] = 1
     try:
         with open(RESULTS_PATH, "a") as f:
-            f.write(json.dumps({
-                "workload": workload,
-                "t": round(time.monotonic() - _T0, 1),
-                "ts": round(time.time(), 1),  # bench.py's fallback ages by this
-                "result": result,
-            }) + "\n")
+            f.write(json.dumps(rec) + "\n")
     except OSError as e:  # journaling must never kill the run
         log(f"persist failed: {e}")
+    return rec
 
 
 def landed_rows() -> set[str]:
@@ -243,28 +304,81 @@ def main() -> int:
 
     done = 0
     archived = False
+    wedged = False
     for name, workload, timeout in queue:
         if bench_running():
             log("bench.py started mid-harvest — yielding the chip to it")
+            wedged = True  # not literally wedged, but same rc: back off
             break
         if workload == "flash_tune" and not archived:
             # Archive stale tilings RIGHT BEFORE the sweep replaces them
             # (not at startup — a dead probe or an earlier-row wedge must
-            # not strand the previous window's winners in the .bak). The
-            # baseline train row still precedes this in queue order, so
-            # tuned-vs-baseline stays honest; flash_tune_long later only
+            # not strand the previous window's winners in the .bak).
+            # train_tuned runs EARLIER in the queue against the persisted
+            # winners of the LAST sweep; the banked baseline train row is
+            # the honest comparison point. flash_tune_long later only
             # MERGES its seq entries and must not wipe the fresh winners.
             archived = True
             _archive_tilings()
         log(f"=== {name} (timeout {timeout:.0f}s) ===")
+        t_row = time.monotonic()
         result = run_child(workload, timeout, attempt=live_attempt)
         if result is not None and "error" in result:
             log(f"{name}: runner error: {result['error']}")
-        persist(name, result)
         if result is not None and "error" not in result:
+            # journal the first landing IMMEDIATELY — a kill/wedge during a
+            # repeat must not lose an already-measured scarce-window result
+            persist(name, result, repeats=[result])
+            repeats = [result]
+            first_elapsed = time.monotonic() - t_row
+            k0 = primary_key(result)
+            repeat_timed_out = False
+            # Repeats ride the SAME row budget: a repeat only launches if
+            # the budget can still cover a run the size of the first one
+            # (later runs are cheaper — the XLA compile cache is warm), so
+            # variance never costs a later row its window share.
+            while (workload not in NO_REPEAT
+                   and len(repeats) < MAX_REPEATS
+                   and k0 is not None):
+                remaining = timeout - (time.monotonic() - t_row)
+                if remaining < first_elapsed + REPEAT_MARGIN:
+                    break
+                r = run_child(workload, remaining, attempt=live_attempt)
+                if r is None or "error" in r:
+                    # a TIMED-OUT repeat smells like a wedge; re-probe below
+                    repeat_timed_out = r is None
+                    log(f"{name}: repeat {len(repeats) + 1} failed; "
+                        "keeping the measured ones")
+                    break
+                if not isinstance(r.get(k0), (int, float)):
+                    # a repeat missing the first run's primary metric can't
+                    # be ordered for the median — drop it, keep the rest
+                    log(f"{name}: repeat {len(repeats) + 1} lacks {k0!r}; "
+                        "dropped")
+                    break
+                repeats.append(r)
+            if len(repeats) > 1:
+                # the first run was journaled the moment it landed; this
+                # consolidated row comes LATER in the file, so readers that
+                # take the last row per workload adopt the median
+                rec = persist(name, result, repeats=repeats)
+                log(f"{name}: OK x{len(repeats)} spread="
+                    f"{json.dumps(rec.get('spread'))}")
+            else:
+                log(f"{name}: OK {json.dumps(result)[:300]}")
             done += 1
-            log(f"{name}: OK {json.dumps(result)[:300]}")
+            if repeat_timed_out:
+                # mirror the first-run failure path: a dead chip must stop
+                # the queue here, not after the NEXT row burns its timeout
+                found = next((i for i in range(3) if probe(i)), None)
+                if found is None:
+                    log("chip wedged during a repeat — stopping "
+                        "(results are journaled)")
+                    wedged = True
+                    break
+                live_attempt = found
             continue
+        persist(name, result)
         # failure: one retry if the chip still answers, else stop the run.
         # The re-probe cycles every platform fallback and the retry uses
         # whichever one answered — a pinned-name flake must not abandon
@@ -272,6 +386,7 @@ def main() -> int:
         found = next((i for i in range(3) if probe(i)), None)
         if found is None:
             log("chip wedged mid-harvest — stopping (results are journaled)")
+            wedged = True
             break
         live_attempt = found
         log(f"{name}: chip still live (fallback #{found}), one retry")
@@ -284,7 +399,10 @@ def main() -> int:
             log(f"{name}: failed twice with a live chip; moving on")
 
     log(f"harvest complete: {done}/{len(queue)} workloads -> {RESULTS_PATH}")
-    return 0
+    # rc 0 is a "window may still be open, rows landed" signal a watchdog
+    # re-enters on immediately; a wedge-break or a zero-progress pass must
+    # read as rc 1 (back off and probe later) instead.
+    return 0 if done > 0 and not wedged else 1
 
 
 if __name__ == "__main__":
