@@ -54,12 +54,31 @@ class Embedder:
     chip with the decode loop at the XLA queue level, which is safe)."""
 
     def __init__(self, params, cfg: LlamaConfig,
-                 buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)):
+                 buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+                 warmup: bool = True):
         self.params = params
         self.cfg = cfg
         self.buckets = tuple(sorted(buckets))
         self.dim = cfg.d_model
         self._lock = threading.Lock()
+        if warmup:
+            self.warmup()
+
+    def warmup(self) -> None:
+        """Compile every bucket's forward NOW, on the constructing thread.
+
+        ``embed`` runs on aiohttp executor threads while the engine thread
+        compiles decode steps; a first-request-per-bucket compile would
+        race those (concurrent XLA:CPU compilation segfaults intermittently
+        in this jaxlib build — see tests/conftest.py). After warmup every
+        embed() dispatch is a cache hit, so the executor threads never
+        compile. The server constructs the Embedder BEFORE the engine
+        starts its thread, making startup single-compiler."""
+        for b in self.buckets:
+            _embed_one(
+                self.params, jnp.zeros((b,), jnp.int32), jnp.int32(1),
+                self.cfg,
+            ).block_until_ready()
 
     def embed(self, ids: list[int]) -> np.ndarray:
         if not ids:

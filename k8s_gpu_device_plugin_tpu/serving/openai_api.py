@@ -568,10 +568,16 @@ class _OpenAIRoutes:
 
 
 def _oai_error(message: str, status: int, code: str | None = None) -> web.Response:
-    """OpenAI error envelope (clients pattern-match on error.message)."""
+    """OpenAI error envelope (clients pattern-match on error.message).
+
+    ``error.type`` keys SDK retry logic: 5xx (engine dead — a restart may
+    fix it) must read as retryable ``server_error``. Everything 4xx stays
+    ``invalid_request_error``: the only 422 path here is permanent request
+    validation (prompt exceeding slot capacity, bucket overflow, unknown
+    adapter), which a retry can never fix."""
+    err_type = "server_error" if status >= 500 else "invalid_request_error"
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error",
-                   "code": code}},
+        {"error": {"message": message, "type": err_type, "code": code}},
         status=status,
     )
 

@@ -108,8 +108,9 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._dead = threading.Event()
         self._subq: list[
-            tuple[int, list[int], int, tuple, "Sampler | None", int, tuple]
-        ] = []
+            tuple[int, list[int], int, tuple, "Sampler | None", int, tuple,
+                  int | None]
+        ] = []  # (eid, prompt, max_new, stop, sampler, adapter, bias, seed)
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -632,6 +633,15 @@ def load_adapters(cfg: LlamaConfig, spec: str):
                 f"no LoRA factors found in {rest!r} (expected "
                 "{target: {'a', 'b'}} under 'lora' or at the tree root)"
             )
+        if cfg.is_moe and any(t in ("w1", "w2", "w3") for t in lora_params):
+            # the same restriction init_random_adapters and training-side
+            # lora.py enforce: the MoE decode path never reads mlp adapter
+            # leaves, so accepting them would silently serve a
+            # partially-applied adapter
+            raise ValueError(
+                f"adapter {name.strip()!r} targets MoE expert MLPs "
+                "(w1/w2/w3), which are not LoRA-servable on an MoE config"
+            )
         first = next(iter(lora_params.values()))
         rank = int(first["a"].shape[-1])
         lcfg = LoraConfig(
@@ -786,6 +796,23 @@ def _main(argv: list[str] | None = None) -> int:
             )
         adapters = load_adapters(cfg, args.loraAdapters)
 
+    # /v1/embeddings: the hidden-state forward is the training-path
+    # matmul, incompatible with decode-path quantized weight leaves.
+    # Constructed (and bucket-warmed) BEFORE the engine so all embedding
+    # compiles happen while this thread is the only compiler — executor-
+    # thread compiles racing the engine thread's decode compiles have
+    # segfaulted XLA:CPU (see tests/conftest.py).
+    embedder = None
+    if args.embeddings:
+        if args.weightQuant != "none":
+            raise SystemExit(
+                "--embeddings is unsupported with --weightQuant: the "
+                "hidden-state forward cannot consume quantized leaves"
+            )
+        from k8s_gpu_device_plugin_tpu.serving.embeddings import Embedder
+
+        embedder = Embedder(params, cfg)
+
     metrics = ServingMetrics()
     batcher = None
     if args.draftPreset:
@@ -809,19 +836,6 @@ def _main(argv: list[str] | None = None) -> int:
         batcher=batcher, adapters=adapters,
     )
     from prometheus_client import REGISTRY
-
-    # /v1/embeddings: the hidden-state forward is the training-path
-    # matmul, incompatible with decode-path quantized weight leaves
-    embedder = None
-    if args.embeddings:
-        if args.weightQuant != "none":
-            raise SystemExit(
-                "--embeddings is unsupported with --weightQuant: the "
-                "hidden-state forward cannot consume quantized leaves"
-            )
-        from k8s_gpu_device_plugin_tpu.serving.embeddings import Embedder
-
-        embedder = Embedder(params, cfg)
 
     server = InferenceServer(engine, host=args.host, port=args.port,
                              registry=REGISTRY, tokenizer=tokenizer,

@@ -124,8 +124,15 @@ def encode_stop_strings(tokenizer, strings, field: str = "stop") -> list:
 def trim_stop_suffix(tokens: list, stop: list) -> list:
     """Drop a matched stop sequence from the end of ``tokens`` (OpenAI
     semantics: returned text never includes the stop sequence; the native
-    API keeps it, like EOS)."""
+    API keeps it, like EOS).
+
+    The SHORTEST matching suffix wins, not the client's list order: the
+    engine halts on the first suffix that completes, so with
+    stop=["ab", "b"] and output "...a b" the engine fired on "b" — a
+    client-order trim would also drop the legitimately generated "a"."""
+    best: int | None = None
     for st in stop:
         if len(st) <= len(tokens) and list(tokens[-len(st):]) == list(st):
-            return list(tokens[:-len(st)])
-    return list(tokens)
+            if best is None or len(st) < best:
+                best = len(st)
+    return list(tokens[:-best]) if best is not None else list(tokens)

@@ -345,3 +345,32 @@ def test_adapters_compose_with_quantized_cache(setup):
     rid = cb.submit(prompt, max_new=6, adapter=1)
     done = cb.run()
     assert done[rid] == _oracle(merged[1], prompt, qcfg, 6)
+
+
+def test_load_adapters_rejects_moe_mlp_targets(tmp_path):
+    """An externally-produced adapter carrying w1/w2/w3 factors must be
+    REJECTED on an MoE config at load time — the MoE decode path never
+    reads mlp adapter leaves, so accepting it would silently serve a
+    partially-applied adapter (advisor r4)."""
+    from k8s_gpu_device_plugin_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_gpu_device_plugin_tpu.serving.server import load_adapters
+
+    dense = LlamaConfig.tiny(dtype=jnp.float32)
+    lc = LoraConfig(rank=2, targets=("wq", "w1"))
+    lp = init_lora_params(jax.random.key(3), dense, lc)
+    d = str(tmp_path / "adapter")
+    ckpt = TrainCheckpointer(d, async_save=False, save_interval=1)
+    try:
+        ckpt.save({"lora": lp}, step=0, force=True)
+    finally:
+        ckpt.close()
+
+    moe = LlamaConfig.tiny(
+        dtype=jnp.float32, n_experts=4, n_experts_per_token=2,
+        capacity_factor=4.0,
+    )
+    with pytest.raises(ValueError, match="MoE expert MLPs"):
+        load_adapters(moe, f"bad={d}")
+    # the same checkpoint loads fine on the dense config it was made for
+    aset = load_adapters(dense, f"good={d}")
+    assert aset.index_of("good") == 0

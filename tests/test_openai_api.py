@@ -411,3 +411,24 @@ def test_max_completion_tokens_field(setup):
         assert (await r.json())["usage"]["completion_tokens"] == 3
 
     run(_with_server(setup, body, tokenizer=tok))
+
+
+def test_oai_error_types_key_sdk_retries():
+    """OpenAI SDKs key retry logic off error.type: 5xx (engine dead) must
+    read as retryable server_error, never as a non-retryable client
+    invalid_request_error (advisor r4). 422 stays a client error — its
+    only producer is permanent request validation (slot capacity, bucket
+    overflow), which a retry can never fix."""
+    from k8s_gpu_device_plugin_tpu.serving.openai_api import _oai_error
+
+    for status, expected in [
+        (400, "invalid_request_error"),
+        (404, "invalid_request_error"),
+        (422, "invalid_request_error"),
+        (503, "server_error"),
+        (500, "server_error"),
+    ]:
+        resp = _oai_error("boom", status)
+        assert resp.status == status
+        payload = json.loads(resp.body)
+        assert payload["error"]["type"] == expected

@@ -587,3 +587,21 @@ def test_per_request_sampling_over_http(setup):
             assert r.status == 400  # Sampler's own validation
 
     run(_with_server(setup, body, sampler=Sampler(temperature=1.0)))
+
+
+def test_trim_stop_suffix_shortest_match():
+    """The engine halts on the FIRST stop suffix that completes, so the
+    trim must remove the shortest matching suffix — client list order
+    (stop=["ab","b"] on output "...a b") must not eat a legitimately
+    generated token (advisor r4)."""
+    from k8s_gpu_device_plugin_tpu.serving.tokenizer import trim_stop_suffix
+
+    a, b = 97, 98
+    # output ends [a, b]; stops: "ab"=[a,b] listed BEFORE "b"=[b]
+    assert trim_stop_suffix([1, 2, a, b], [[a, b], [b]]) == [1, 2, a]
+    # order-independent: reversed list gives the same answer
+    assert trim_stop_suffix([1, 2, a, b], [[b], [a, b]]) == [1, 2, a]
+    # only the long one matches -> it trims
+    assert trim_stop_suffix([1, 2, a, b], [[a, b], [3]]) == [1, 2]
+    # no match -> untouched
+    assert trim_stop_suffix([1, 2], [[9]]) == [1, 2]

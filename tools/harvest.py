@@ -233,18 +233,23 @@ def bench_running() -> bool:
     return bool(_script_pids("bench.py"))
 
 
-def harvest_outranked() -> bool:
-    """True if an OLDER harvest.py is already running (start-time
-    tie-break, pid as the tiebreaker of last resort): exactly one of two
-    racing starts proceeds — no mutual refusal livelock — and a running
-    harvest is never evicted by a newcomer (the newcomer is the one that
-    backs off; mid-run checks use bench_running() only)."""
+def script_outranked(script: str) -> bool:
+    """True if an OLDER instance of ``script`` is already running
+    (start-time tie-break, pid as the tiebreaker of last resort): exactly
+    one of two racing starts proceeds — no mutual refusal livelock — and
+    a running instance is never evicted by a newcomer (the newcomer is
+    the one that backs off). Shared by harvest.py and watchdog.py so the
+    priority rule can never diverge between them."""
     me = os.getpid()
     mine = (_proc_start_ticks(me), me)
     return any(
         (_proc_start_ticks(pid), pid) < mine
-        for pid in _script_pids("harvest.py")
+        for pid in _script_pids(script)
     )
+
+
+def harvest_outranked() -> bool:
+    return script_outranked("harvest.py")
 
 
 def _archive_tilings() -> None:

@@ -53,15 +53,11 @@ def log(msg: str) -> None:
 
 
 def outranked() -> bool:
-    """True if an OLDER watchdog.py is already running — same start-tick
-    priority rule as harvest_outranked(): of two racing starts exactly one
-    proceeds, and a running watchdog is never evicted by a newcomer."""
-    me = os.getpid()
-    mine = (harvest._proc_start_ticks(me), me)
-    return any(
-        (harvest._proc_start_ticks(pid), pid) < mine
-        for pid in harvest._script_pids("watchdog.py")
-    )
+    """True if an OLDER watchdog.py is already running — the start-tick
+    priority rule shared with harvest (harvest.script_outranked): of two
+    racing starts exactly one proceeds, and a running watchdog is never
+    evicted by a newcomer."""
+    return harvest.script_outranked("watchdog.py")
 
 
 def main() -> int:
@@ -72,18 +68,34 @@ def main() -> int:
                     help="sleep between wedge probes, seconds")
     args = ap.parse_args()
 
+    # Startup vs .harvest_stop and an older instance, without races:
+    # - elder alive, no stop file: the elder owns the job; exit.
+    # - elder alive + stop file: the file is a LIVE stop request aimed at
+    #   the elder — leave it for the elder to honor, wait for the elder
+    #   to exit, then take over (the touch-stop-then-relaunch sequence
+    #   must end with exactly this new watchdog running).
+    # - no elder + stop file: stale leftover; remove it and run —
+    #   launching a watchdog IS the statement that it should run.
+    waited = False
+    while outranked():
+        if not os.path.exists(STOP_PATH):
+            if waited:
+                # the elder survived the stop request (raced its own
+                # removal at startup); it owns the job after all
+                log("older watchdog survived the stop file; exiting")
+            else:
+                log("an older watchdog.py is already running — exiting")
+            return 4
+        if not waited:
+            log("older watchdog has a pending stop request; waiting to "
+                "take over")
+            waited = True
+        time.sleep(5)
     if os.path.exists(STOP_PATH):
-        # a leftover stop file must not make a freshly launched watchdog
-        # exit silently on its first loop — launching one IS the statement
-        # that it should run. Removal happens BEFORE the outranked check:
-        # in the touch-stop-then-relaunch sequence, whichever instance
-        # survives the priority race must not be stopped by the stale file
-        # (net guarantee: at least one watchdog keeps running).
         os.remove(STOP_PATH)
         log("removed stale .harvest_stop from a previous run")
-    if outranked():
-        log("an older watchdog.py is already running — exiting")
-        return 4
+    if waited:
+        log("older watchdog exited; taking over")
     deadline = time.time() + args.deadline_hours * 3600.0
     log(f"started (deadline {args.deadline_hours:.1f}h, "
         f"interval {args.interval:.0f}s, queue head "
