@@ -1,0 +1,151 @@
+"""Multi-host TRAIN-STEP preflight: rendezvous + sharded steps across
+processes.
+
+``rendezvous_check`` proves the Allocate env contract can form a world and
+psum; this goes the rest of the way: each worker initializes
+``jax.distributed`` from the plugin-injected envs (TPU_WORKER_ID /
+TPU_WORKER_HOSTNAMES / MEGASCALE_*, plugin/plugin.py:_container_allocate),
+builds ONE GLOBAL MESH spanning every process's devices, and jits the
+framework's real training step over it — dp crossing the process boundary
+(gradient psum over the inter-host link), tp/sp inside each process. Two
+steps run; every rank must report the identical global loss or the exit
+code is nonzero.
+
+This is the preflight a multi-host training job actually needs: the
+rendezvous can succeed while the SHARDED step still deadlocks or diverges
+(wrong mesh axis order, a collective crossing the wrong link, per-process
+batch skew). The reference has no analogue — its cross-process story ends
+at injecting NVIDIA_VISIBLE_DEVICES per container; here the worker side of
+the contract is exercised end to end.
+
+Usage (one process per worker, wearing the Allocate envs):
+    python -m k8s_gpu_device_plugin_tpu.parallel.multihost_step \
+        [--port N] [--steps K] [--batch B] [--seq S]
+
+Prints ONE JSON line {rank, nprocs, ndev, mesh, losses, ok}; exit 0 iff
+the distributed steps ran and produced finite losses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def run_step_check(
+    port: int | None = None,
+    init_timeout: int = 60,
+    steps: int = 2,
+    batch_size: int = 4,
+    seq_len: int = 32,
+) -> dict:
+    """Initialize from envs, run ``steps`` sharded train steps, report."""
+    import jax
+
+    # Same platform/collectives recipe as rendezvous_check: re-assert the
+    # handed-down platform (a sitecustomize may pin another) and pick the
+    # in-tree CPU collectives implementation for cross-process psums.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from k8s_gpu_device_plugin_tpu.parallel import multihost
+
+    env = multihost.initialize(
+        port=port or multihost.DEFAULT_COORDINATOR_PORT,
+        initialization_timeout=init_timeout,
+    )
+    if env is None or env.num_workers <= 1:
+        raise RuntimeError(
+            "no multi-host env contract found (TPU_WORKER_HOSTNAMES / "
+            "MEGASCALE_* unset) — this preflight needs >= 2 workers"
+        )
+    if jax.process_count() != env.num_workers:
+        raise RuntimeError(
+            f"world size mismatch: envs promise {env.num_workers}, "
+            f"jax.distributed sees {jax.process_count()}"
+        )
+
+    import jax.numpy as jnp
+
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+    from k8s_gpu_device_plugin_tpu.models.train import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+        synthetic_batch,
+    )
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec
+
+    devices = jax.devices()  # global: spans every process after initialize
+    ndev = len(devices)
+    # dp is OUTERMOST in AXIS_ORDER and jax.devices() lists process 0's
+    # devices first, so the row-major mesh reshape puts dp across the
+    # process boundary: the gradient psum rides the inter-host link while
+    # tp (and sp when it fits) stay process-local — the DCN-outer /
+    # ICI-inner recipe of parallel/multihost.make_global_mesh.
+    local = ndev // jax.process_count()
+    spec = MeshSpec.for_devices(
+        ndev,
+        tp=2 if local % 2 == 0 else 1,
+        sp=2 if local % 4 == 0 else 1,
+    )
+    mesh = multihost.make_global_mesh(spec, num_slices=max(env.num_slices, 1))
+
+    cfg = LlamaConfig.tiny(n_layers=2, attn_impl="ring" if spec.sp > 1 else "xla")
+    optimizer = make_optimizer(total_steps=max(steps, 2))
+    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+    # identical key on every process -> identical host batch, which
+    # device_put may assert when shards live on non-addressable devices
+    batch = synthetic_batch(
+        jax.random.key(1), cfg, batch_size=batch_size, seq_len=seq_len,
+        mesh=mesh,
+    )
+    train_step = make_train_step(cfg, mesh, optimizer)
+
+    losses: list[float] = []
+    grad_norms: list[float] = []
+    for _ in range(steps):
+        state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+        grad_norms.append(float(metrics["grad_norm"]))
+    if not all(jnp.isfinite(jnp.asarray(losses))):
+        raise RuntimeError(f"non-finite losses across steps: {losses}")
+
+    return {
+        "rank": jax.process_index(),
+        "nprocs": jax.process_count(),
+        "ndev": ndev,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "losses": [round(v, 6) for v in losses],
+        "grad_norms": [round(v, 6) for v in grad_norms],
+        "distributed": True,
+        "ok": True,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--init-timeout", type=int, default=60)
+    parser.add_argument("--steps", type=int, default=2)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--seq", type=int, default=32)
+    args = parser.parse_args(argv)
+    try:
+        report = run_step_check(
+            port=args.port, init_timeout=args.init_timeout,
+            steps=args.steps, batch_size=args.batch, seq_len=args.seq,
+        )
+    except Exception as e:  # noqa: BLE001 - the contract is one JSON line
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -60,3 +60,100 @@ class FakeKubelet(api.RegistrationServicer):
         return grpc.aio.insecure_channel(
             f"unix://{os.path.join(self.socket_dir, endpoint)}"
         )
+
+
+def free_port() -> int:
+    """An OS-assigned localhost port (rendezvous coordinators in tests)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def start_stack(socket_dir, topology: str = "v5e-4", **cfg_kwargs):
+    """Boot fake kubelet + manager; returns (kubelet, manager, task, backend).
+
+    The one stack-boot implementation: integration tests, the rendezvous
+    tests, and the multi-host dryrun all go through here so a fix to the
+    handshake ordering reaches every consumer."""
+    from k8s_gpu_device_plugin_tpu.config import Config
+    from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+    from k8s_gpu_device_plugin_tpu.plugin import PluginManager
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    health_interval = cfg_kwargs.pop("health_interval", 0.1)
+    os.makedirs(str(socket_dir), exist_ok=True)
+    kubelet = FakeKubelet(str(socket_dir))
+    await kubelet.start()
+    cfg = Config(
+        kubelet_socket_dir=str(socket_dir), libtpu_path="", **cfg_kwargs
+    )
+    backend = FakeBackend(topology)
+    ready = Latch()
+    manager = PluginManager(
+        cfg, ready, backend=backend, health_interval=health_interval
+    )
+    task = asyncio.create_task(manager.start())
+    await asyncio.wait_for(ready.wait_async(), 10)
+    return kubelet, manager, task, backend
+
+
+async def stop_stack(kubelet, manager, task) -> None:
+    await manager.stop()
+    await asyncio.wait_for(task, 10)
+    await kubelet.stop()
+
+
+async def allocate_whole_host(socket_dir, **cfg_kwargs) -> dict[str, str]:
+    """Boot one host's daemon, Allocate every chip it owns, return the env
+    contract ``_container_allocate`` emitted (TPU_WORKER_ID / bounds /
+    MEGASCALE_*)."""
+    kubelet, manager, task, _ = await start_stack(socket_dir, **cfg_kwargs)
+    try:
+        await kubelet.wait_for_registrations(1)
+        reg = kubelet.registrations[0]
+        chips = manager.plugins[0].chips
+        async with kubelet.plugin_channel(reg.endpoint) as channel:
+            stub = api.DevicePluginStub(channel)
+            resp = await stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devicesIDs=chips.ids())
+                    ]
+                )
+            )
+        return dict(resp.container_responses[0].envs)
+    finally:
+        await stop_stack(kubelet, manager, task)
+
+
+def join_json_workers(procs: list, timeout: float) -> list[dict]:
+    """communicate() with every worker subprocess, parse the last JSON
+    stdout line of each; on any failure kill the rest so a hung rendezvous
+    never leaks jax.distributed processes past the caller."""
+    import json as _json
+
+    reports = []
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=timeout)
+            line = next(
+                (l for l in reversed(out.strip().splitlines())
+                 if l.startswith("{")),
+                None,
+            )
+            if proc.returncode != 0 or line is None:
+                raise RuntimeError(
+                    f"worker failed rc={proc.returncode}\n"
+                    f"stdout: {out[-1000:]}\nstderr: {err[-2000:]}"
+                )
+            reports.append(_json.loads(line))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate(timeout=30)
+    return reports

@@ -1,0 +1,37 @@
+"""Cross-process sharded TRAIN STEP through the Allocate contract (r4
+verdict #5: the multi-host story was rendezvous-tested but no sharded
+step had ever crossed a process boundary).
+
+``dryrun_multihost`` allocates the exact env contract two daemon stacks
+emit for a 2-host v5e-8 slice, spawns one subprocess per worker (4
+virtual CPU devices each), rendezvouses via jax.distributed (gloo), and
+runs the framework's real train step over ONE GLOBAL dp2(x-process) x
+sp2 x tp2 mesh. The decisive assertion lives in the orchestrator: every
+rank must report the identical finite loss trajectory.
+"""
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.parallel.multihost_dryrun import dryrun_multihost
+
+
+def test_two_process_global_train_step():
+    # bounded internally: dryrun_multihost kills its workers at 420s
+    report = dryrun_multihost(n_processes=2, devices_per_process=4, steps=2)
+    assert report["ok"]
+    assert report["global_devices"] == 8
+    assert report["mesh"]["dp"] == 2  # dp crosses the process boundary
+    assert report["mesh"]["tp"] == 2 and report["mesh"]["sp"] == 2
+    assert "TPU_WORKER_ID" in report["env_contract_keys"]
+    assert "TPU_PROCESS_BOUNDS" in report["env_contract_keys"]
+
+
+def test_worker_refuses_single_process_env(monkeypatch):
+    """The step preflight must fail loudly without a worker contract, not
+    silently run a local-only 'success'."""
+    for k in ("TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "MEGASCALE_NUM_SLICES"):
+        monkeypatch.delenv(k, raising=False)
+    from k8s_gpu_device_plugin_tpu.parallel.multihost_step import run_step_check
+
+    with pytest.raises(RuntimeError, match="multi-host env contract"):
+        run_step_check()

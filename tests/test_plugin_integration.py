@@ -10,36 +10,19 @@ import asyncio
 import grpc
 import pytest
 
-from k8s_gpu_device_plugin_tpu.config import Config
-from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
-from k8s_gpu_device_plugin_tpu.plugin import PluginManager, api
+from k8s_gpu_device_plugin_tpu.plugin import api
 from k8s_gpu_device_plugin_tpu.plugin.api import pb
-from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+from k8s_gpu_device_plugin_tpu.plugin.testing import (
+    FakeKubelet,
+    start_stack,
+    stop_stack,
+)
 
-from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
+assert FakeKubelet is not None  # re-exported for the other test modules
 
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=60))
-
-
-async def start_stack(tmp_path, topology="v5e-4", **cfg_kwargs):
-    """Boot fake kubelet + manager; returns (kubelet, manager, task, backend)."""
-    kubelet = FakeKubelet(str(tmp_path))
-    await kubelet.start()
-    cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="", **cfg_kwargs)
-    backend = FakeBackend(topology)
-    ready = Latch()
-    manager = PluginManager(cfg, ready, backend=backend, health_interval=0.1)
-    task = asyncio.create_task(manager.start())
-    await asyncio.wait_for(ready.wait_async(), 10)
-    return kubelet, manager, task, backend
-
-
-async def stop_stack(kubelet, manager, task):
-    await manager.stop()
-    await asyncio.wait_for(task, 10)
-    await kubelet.stop()
 
 
 def test_register_and_list_and_watch(tmp_path):
