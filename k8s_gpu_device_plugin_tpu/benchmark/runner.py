@@ -458,6 +458,16 @@ def _run_opt_tune() -> dict:
     }
 
 
+def _run_dataload() -> dict:
+    """Host-side gather throughput (native C++ vs Python memmap) — needs
+    no accelerator; runnable during a chip wedge."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.dataload_bench import (
+        dataload_bench,
+    )
+
+    return dataload_bench()
+
+
 def _run_roundtrip() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
         control_plane_roundtrip,
@@ -517,6 +527,7 @@ WORKLOADS = {
     "decode_int4w": _run_decode_int4w,
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
+    "dataload": _run_dataload,
 }
 
 

@@ -3,6 +3,13 @@ from k8s_gpu_device_plugin_tpu.data.pipeline import (
     MemmapSource,
     SyntheticSource,
     TokenSource,
+    make_token_source,
 )
 
-__all__ = ["DataLoader", "MemmapSource", "SyntheticSource", "TokenSource"]
+__all__ = [
+    "DataLoader",
+    "MemmapSource",
+    "SyntheticSource",
+    "TokenSource",
+    "make_token_source",
+]

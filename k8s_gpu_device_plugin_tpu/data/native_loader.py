@@ -120,3 +120,10 @@ class NativeMemmapSource:
             self.close()
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
+
+
+def native_available() -> bool:
+    """True when libdataload.so is built and loadable — the factory
+    (pipeline.make_token_source) gate for defaulting corpus reads onto
+    the C++ gather."""
+    return _load_library() is not None

@@ -187,3 +187,51 @@ class DataLoader:
                     q.get_nowait()
                 except queue.Empty:
                     break
+
+
+def make_token_source(
+    path: str,
+    vocab_size: int,
+    dtype: str = "uint16",
+    seed: int = 0,
+) -> tuple[TokenSource, str]:
+    """The default source factory: ``(source, label)``.
+
+    - no ``path``: deterministic synthetic tokens (benchmarks, smoke runs)
+    - ``path`` + built ``libdataload.so``: the native C++ gather
+      (data/native_loader.py) — the production default, threads overlap
+      the page faults a cold memmap serializes
+    - ``path`` without the library: the Python memmap source
+
+    The two file-backed sources share one sampling recipe keyed by
+    (seed, step), so which one served a run never changes its batches
+    (bit-identity pinned in tests/test_data_trainer.py). The label is for
+    run logs/artifacts: an IO-bound run should say which gather fed it.
+
+    A probe window is vocab-checked up front: out-of-vocab corpus ids
+    (wrong ``dtype``, a corpus tokenized for a bigger vocab) would
+    otherwise train silently wrong — JAX's out-of-bounds gather CLAMPS,
+    so the embedding lookup never errors. A spot check, not a full scan;
+    it reliably catches dtype garbage and grossly mismatched vocabs.
+    """
+    if not path:
+        return SyntheticSource(vocab_size, seed=seed), "synthetic"
+    from k8s_gpu_device_plugin_tpu.data.native_loader import (
+        NativeMemmapSource,
+        native_available,
+    )
+
+    if native_available():
+        source: TokenSource = NativeMemmapSource(path, dtype=dtype, seed=seed)
+        label = "native-memmap"
+    else:
+        source, label = MemmapSource(path, dtype=dtype, seed=seed), "python-memmap"
+    probe = source.windows(0, slice(0, 2), 2, 127)
+    if int(probe.max()) >= vocab_size:
+        raise ValueError(
+            f"corpus {path} contains token id {int(probe.max())} >= "
+            f"vocab_size {vocab_size} (wrong --dataDtype, or a corpus "
+            "tokenized for a larger vocabulary) — the embedding gather "
+            "would clamp it and train on garbage"
+        )
+    return source, label

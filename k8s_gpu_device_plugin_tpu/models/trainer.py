@@ -23,7 +23,11 @@ from typing import Any, Callable
 
 import jax
 
-from k8s_gpu_device_plugin_tpu.data.pipeline import DataLoader, SyntheticSource
+from k8s_gpu_device_plugin_tpu.data.pipeline import (
+    DataLoader,
+    SyntheticSource,
+    make_token_source,
+)
 from k8s_gpu_device_plugin_tpu.models.checkpoint import TrainCheckpointer
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.train import (
@@ -70,6 +74,12 @@ class TrainerConfig:
     # optimizer implementation: "optax" (staged chain) or "fused"
     # (ops/fused_optim.py single-pass AdamW; same numerics)
     opt_impl: str = "optax"
+    # token corpus ("" = synthetic): a flat binary token file served
+    # through data/pipeline.make_token_source — the native C++ gather by
+    # default when libdataload.so is built, the Python memmap otherwise
+    # (bit-identical batches either way)
+    data_file: str = ""
+    data_dtype: str = "uint16"
 
 
 @dataclass
@@ -80,6 +90,7 @@ class TrainResult:
     resumed_from: int | None
     metrics_history: list[dict]
     final_eval: dict | None = None  # {"loss", "perplexity", "accuracy"}
+    data_source: str = "synthetic"  # which gather fed the run (factory label)
 
 
 class Trainer:
@@ -117,12 +128,22 @@ class Trainer:
             with_accuracy=not cfg.model.fused_ce,
             grad_accum=cfg.grad_accum,
         )
-        self.loader = loader or DataLoader(
-            SyntheticSource(cfg.model.vocab_size),
-            cfg.batch_size,
-            cfg.seq_len,
-            self.mesh,
-        )
+        if loader is not None:
+            self.loader = loader
+            self.data_source_label = "caller-provided"
+        else:
+            source, self.data_source_label = make_token_source(
+                cfg.data_file, cfg.model.vocab_size, dtype=cfg.data_dtype
+            )
+            self.loader = DataLoader(
+                source, cfg.batch_size, cfg.seq_len, self.mesh
+            )
+            if cfg.data_file:
+                self.log.info(
+                    "token source",
+                    extra={"fields": {"source": self.data_source_label,
+                                      "file": cfg.data_file}},
+                )
         self.eval_loader: DataLoader | None = None
         self.eval_step_fn = None
         if eval_loader is not None and cfg.eval_every <= 0:
@@ -138,14 +159,26 @@ class Trainer:
                 )
             # held-out stream: a different seed than the train default, no
             # prefetch thread (eval passes are short and restart at step 0
-            # every time so the SAME validation batches score every pass)
-            self.eval_loader = eval_loader or DataLoader(
-                SyntheticSource(cfg.model.vocab_size, seed=1),
-                cfg.batch_size,
-                cfg.seq_len,
-                self.mesh,
-                prefetch=0,
-            )
+            # every time so the SAME validation batches score every pass).
+            # With a corpus file, eval reads the SAME corpus (seed-1
+            # windows) — not synthetic tokens unrelated to what the run
+            # trains on. Different-seed windows of one corpus can overlap
+            # the training stream; for a strictly held-out set, pass an
+            # eval_loader over a separate file.
+            if eval_loader is not None:
+                self.eval_loader = eval_loader
+            else:
+                eval_source, _ = make_token_source(
+                    cfg.data_file, cfg.model.vocab_size,
+                    dtype=cfg.data_dtype, seed=1,
+                )
+                self.eval_loader = DataLoader(
+                    eval_source,
+                    cfg.batch_size,
+                    cfg.seq_len,
+                    self.mesh,
+                    prefetch=0,
+                )
             self.eval_step_fn = make_eval_step(
                 cfg.model, self.mesh,
                 micro=cfg.eval_micro or cfg.grad_accum,
@@ -280,6 +313,7 @@ class Trainer:
             resumed_from=resumed_from,
             metrics_history=history,
             final_eval=final_eval,
+            data_source=self.data_source_label,
         )
 
 
@@ -326,6 +360,13 @@ def _main(argv: list[str] | None = None) -> int:
                         help="optimizer implementation: optax chain or the "
                         "fused single-pass AdamW (same numerics, fewer HBM "
                         "passes)")
+    parser.add_argument("--dataFile", default="",
+                        help="flat binary token corpus; served by the "
+                        "native C++ gather when libdataload.so is built, "
+                        "the Python memmap otherwise (empty = synthetic)")
+    parser.add_argument("--dataDtype", default="uint16",
+                        choices=["uint16", "uint32"],
+                        help="corpus token dtype")
     parser.add_argument("--fusedCE", action="store_true",
                         help="fused lm_head+cross-entropy (no materialized "
                         "logits; tp==1 only, accuracy reported as -1)")
@@ -365,6 +406,8 @@ def _main(argv: list[str] | None = None) -> int:
         checkpoint_interval=args.checkpointInterval,
         trace_dir=args.traceDir,
         opt_impl=args.optImpl,
+        data_file=args.dataFile,
+        data_dtype=args.dataDtype,
     )
     result = Trainer(cfg).run()
     eval_str = (
@@ -376,7 +419,8 @@ def _main(argv: list[str] | None = None) -> int:
     print(
         f"trainer: steps={result.steps_run} loss={result.final_loss:.4f} "
         f"tokens/s={result.tokens_per_second:.0f} "
-        f"resumed_from={result.resumed_from}{eval_str}"
+        f"resumed_from={result.resumed_from} data={result.data_source}"
+        f"{eval_str}"
     )
     return 0
 

@@ -171,14 +171,17 @@ def memory_plan(
     # weight run (f32 params, bf16 compute) produces f32 grads (the
     # astype in cast_params_for_compute upcasts the cotangent)
     grads_b = params_b
-    # ...and additionally keeps a compute-dtype working copy of the
-    # weights through the step (cast_params_for_compute)
+    # ...and additionally keeps a compute-dtype working copy of the LAYER
+    # STACKS through the step — cast_params_for_compute casts only
+    # params["layers"]; embed/lm_head/norms stay in p_dtype
     itemsize_c = np.dtype(cfg.dtype).itemsize
     itemsize_p = np.dtype(cfg.p_dtype).itemsize
-    cast_b = (
-        params_b * itemsize_c / itemsize_p
-        if cfg.p_dtype != cfg.dtype else 0.0
-    )
+    cast_b = 0.0
+    if cfg.p_dtype != cfg.dtype:
+        layers_b = _tree_shard_bytes(
+            abstract["layers"], specs["layers"], sizes
+        )
+        cast_b = layers_b * itemsize_c / itemsize_p
 
     # batch/seq sharding (models/train.py:batch_shardings): batch over
     # (dp, fsdp), seq over sp

@@ -87,7 +87,10 @@ def test_70b_master_weights_variant_fits():
     )
     assert plan.params == pytest.approx(2 * base.params, rel=0.01)
     assert plan.grads == pytest.approx(2 * base.grads, rel=0.01)  # f32 grads
-    assert plan.compute_cast == pytest.approx(base.params, rel=0.01)
+    # the bf16 working copy covers the LAYER stacks only
+    # (cast_params_for_compute leaves embed/lm_head/norms in f32), so it
+    # is slightly under one full bf16 param set
+    assert 0.9 * base.params < plan.compute_cast < base.params
     assert plan.fits(HBM_GIB["v5p"]), plan
 
 

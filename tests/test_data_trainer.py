@@ -341,3 +341,57 @@ def test_native_loader_rejects_bad_input(tmp_path):
             NativeMemmapSource(str(tmp_path / "missing.bin"))
     except RuntimeError:
         pytest.skip("libdataload.so not built in this environment")
+
+
+def test_make_token_source_factory(tmp_path, monkeypatch):
+    """The default-path factory (r4 verdict #7): no path -> synthetic;
+    a corpus + built libdataload.so -> the native gather; without the
+    library -> the Python memmap. Labels travel with the choice so runs
+    can surface which gather fed them."""
+    from k8s_gpu_device_plugin_tpu.data import native_loader
+    from k8s_gpu_device_plugin_tpu.data.pipeline import (
+        MemmapSource,
+        SyntheticSource,
+        make_token_source,
+    )
+    from k8s_gpu_device_plugin_tpu.data.native_loader import NativeMemmapSource
+
+    src, label = make_token_source("", vocab_size=100)
+    assert isinstance(src, SyntheticSource) and label == "synthetic"
+
+    path = str(tmp_path / "corpus.bin")
+    np.random.default_rng(0).integers(
+        0, 100, 4096, dtype=np.uint16
+    ).tofile(path)
+
+    if native_loader.native_available():
+        src, label = make_token_source(path, vocab_size=100)
+        assert isinstance(src, NativeMemmapSource) and label == "native-memmap"
+        src.close()
+
+    monkeypatch.setattr(native_loader, "native_available", lambda: False)
+    src, label = make_token_source(path, vocab_size=100)
+    assert isinstance(src, MemmapSource) and label == "python-memmap"
+
+
+def test_trainer_uses_factory_and_reports_source(tmp_path):
+    """A --dataFile trainer run reports which gather served it, and the
+    batches came from the corpus (bit-identity between the two gathers is
+    pinned by test_native_loader_bit_identical_to_python_source)."""
+    from k8s_gpu_device_plugin_tpu.data import native_loader
+
+    path = str(tmp_path / "corpus.bin")
+    np.random.default_rng(1).integers(
+        0, 512, 1 << 16, dtype=np.uint16
+    ).tofile(path)
+    cfg = _trainer_cfg(total_steps=2, data_file=path, log_every=100)
+    result = Trainer(cfg).run()
+    expected = (
+        "native-memmap" if native_loader.native_available()
+        else "python-memmap"
+    )
+    assert result.data_source == expected
+    assert result.steps_run == 2 and np.isfinite(result.final_loss)
+
+    synth = Trainer(_trainer_cfg(total_steps=1, log_every=100)).run()
+    assert synth.data_source == "synthetic"
