@@ -289,6 +289,11 @@ def main() -> int:
     decode_int8w = secondary("decode_int8w", 420, decode, 180)
     decode_int4w = secondary("decode_int4w", 420, decode_int8w, 160)
 
+    # host-side native-gather throughput: no chip involved, so it lands
+    # even in wedge mode — but AFTER every chip-gated row, so a slow host
+    # never spends live-window deadline budget while the chip idles
+    dataload = run_workload("dataload", timeout=240, attempts=1)
+
     # Journal fallback: any slot the live run could not fill adopts the
     # freshest same-round hardware measurement from tools/harvest.py's
     # journal, labeled below with its age. "train_tuned" is the same train
@@ -357,6 +362,12 @@ def main() -> int:
             extra["train_opt_impl"] = "fused"
     if roundtrip:
         extra["control_plane_allocs_per_second"] = roundtrip["allocs_per_second"]
+    if dataload:
+        extra["dataload_native_speedup"] = dataload["native_speedup"]
+        extra["dataload_native_tokens_per_second"] = dataload[
+            "native_tokens_per_second"
+        ]
+        extra["dataload_cache_state"] = dataload["cache_state"]
     if train_int8:
         extra["train_int8_mfu_pct"] = train_int8["mfu_pct"]
         extra["train_int8_tokens_per_second"] = train_int8["tokens_per_second"]

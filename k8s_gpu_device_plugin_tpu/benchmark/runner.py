@@ -468,6 +468,16 @@ def _run_dataload() -> dict:
     return dataload_bench()
 
 
+def _run_dataload_cold() -> dict:
+    """The cold-page-cache regime: every timed gather faults its windows
+    in from disk — the case the native thread pool exists for."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.dataload_bench import (
+        dataload_bench,
+    )
+
+    return dataload_bench(cold=True, iters=8)
+
+
 def _run_roundtrip() -> dict:
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.roundtrip import (
         control_plane_roundtrip,
@@ -528,6 +538,7 @@ WORKLOADS = {
     "roundtrip": _run_roundtrip,
     "allocated": _run_allocated,
     "dataload": _run_dataload,
+    "dataload_cold": _run_dataload_cold,
 }
 
 

@@ -226,12 +226,18 @@ def make_token_source(
         label = "native-memmap"
     else:
         source, label = MemmapSource(path, dtype=dtype, seed=seed), "python-memmap"
-    probe = source.windows(0, slice(0, 2), 2, 127)
-    if int(probe.max()) >= vocab_size:
-        raise ValueError(
-            f"corpus {path} contains token id {int(probe.max())} >= "
-            f"vocab_size {vocab_size} (wrong --dataDtype, or a corpus "
-            "tokenized for a larger vocabulary) — the embedding gather "
-            "would clamp it and train on garbage"
-        )
+    try:
+        probe = source.windows(0, slice(0, 2), 2, 127)
+        if int(probe.max()) >= vocab_size:
+            raise ValueError(
+                f"corpus {path} contains token id {int(probe.max())} >= "
+                f"vocab_size {vocab_size} (wrong --dataDtype, or a corpus "
+                "tokenized for a larger vocabulary) — the embedding gather "
+                "would clamp it and train on garbage"
+            )
+    except BaseException:
+        # don't leak the native handle/mmap on a refused corpus
+        if hasattr(source, "close"):
+            source.close()
+        raise
     return source, label

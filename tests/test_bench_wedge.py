@@ -66,6 +66,15 @@ def test_bench_wedge_mode_fast_exit_with_partials(tmp_path):
     assert by_workload["probe"]["result"] is None
     assert by_workload["probe"]["note"] == "all attempts failed"
     assert by_workload["roundtrip"]["result"]["allocs_per_second"] > 0
+    # the host-side native-gather row is chip-free and lands even here —
+    # when the native library is built (this test is about wedge budgets,
+    # not the native build)
+    from k8s_gpu_device_plugin_tpu.data.native_loader import native_available
+
+    if native_available():
+        assert payload["dataload_native_speedup"] > 0
+        assert by_workload["dataload"]["result"][
+            "native_tokens_per_second"] > 0
 
 
 def test_bench_wedge_adopts_journaled_hardware_values(tmp_path):
