@@ -460,12 +460,14 @@ def _run_opt_tune() -> dict:
 
 def _run_dataload() -> dict:
     """Host-side gather throughput (native C++ vs Python memmap) — needs
-    no accelerator; runnable during a chip wedge."""
+    no accelerator; runnable during a chip wedge. BENCH_DATALOAD_TOKENS
+    shrinks the corpus (tests bound the bench's wedge-mode wall time)."""
     from k8s_gpu_device_plugin_tpu.benchmark.workloads.dataload_bench import (
         dataload_bench,
     )
 
-    return dataload_bench()
+    n = int(os.environ.get("BENCH_DATALOAD_TOKENS", 64 * 1024 * 1024))
+    return dataload_bench(n_tokens=n)
 
 
 def _run_dataload_cold() -> dict:

@@ -257,3 +257,94 @@ def _array_strides(arr: np.ndarray) -> dict[str, int]:
         assert strides.size == 1  # row-major reshape: constant by design
         out[a] = int(strides[0])
     return out
+
+
+def _main(argv=None) -> int:
+    """CLI: ``python -m k8s_gpu_device_plugin_tpu.parallel.plan --preset
+    llama3_70b --fsdp 8 --tp 4 --batch 8 --seq 8192 --hbm v5p`` prints the
+    per-chip plan and exits 1 when it does not fit (CI-able gate for a
+    planned run)."""
+    import argparse
+    import json
+    import os
+
+    # a plan check never needs an accelerator — force CPU before any
+    # array exists (module imports build no arrays; the first one is
+    # eval_shape's concrete key argument), or a pinned wedged TPU
+    # backend hangs the CLI
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+    parser = argparse.ArgumentParser(description=_main.__doc__)
+    parser.add_argument("--preset", default="llama3_70b")
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=8192)
+    parser.add_argument("--rematPolicy", default=None,
+                        choices=[None, "save_dots_attn", "save_dots",
+                                 "save_nothing"])
+    parser.add_argument("--fusedCE", action="store_true")
+    parser.add_argument("--masterWeights", action="store_true")
+    parser.add_argument("--hbm", default="v5p",
+                        help=f"chip generation ({sorted(HBM_GIB)}) or GiB")
+    parser.add_argument("--headroom", type=float, default=0.10)
+    args = parser.parse_args(argv)
+
+    from dataclasses import replace
+
+    cfg = getattr(LlamaConfig, args.preset)()
+    overrides = {}
+    if args.rematPolicy:
+        overrides["remat_policy"] = args.rematPolicy
+    if args.fusedCE:
+        overrides["fused_ce"] = True
+    if args.masterWeights:
+        import jax.numpy as jnp
+
+        overrides["param_dtype"] = jnp.float32
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    spec = MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
+                    ep=args.ep, pp=args.pp)
+    hbm = HBM_GIB[args.hbm] if args.hbm in HBM_GIB else float(args.hbm)
+    plan = memory_plan(cfg, spec, args.batch, args.seq)
+    fits = plan.fits(hbm, headroom=args.headroom)
+    print(json.dumps({
+        "preset": args.preset,
+        "mesh": {k: v for k, v in spec.sizes().items() if v > 1},
+        "devices": spec.num_devices,
+        "batch": args.batch,
+        "seq": args.seq,
+        "remat_policy": cfg.remat_policy,
+        "per_chip_gib": {
+            "params": round(plan.params, 2),
+            "grads": round(plan.grads, 2),
+            "opt_state": round(plan.opt_state, 2),
+            "compute_cast": round(plan.compute_cast, 2),
+            "activations": round(plan.activations, 2),
+            "logits_transient": round(plan.logits_transient, 2),
+            "total": round(plan.total, 2),
+        },
+        "hbm_gib": hbm,
+        "headroom": args.headroom,
+        "fits": fits,
+        "axis_strides": axis_strides(spec),
+    }, indent=1))
+    return 0 if fits else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -26,6 +26,8 @@ def test_bench_wedge_mode_fast_exit_with_partials(tmp_path):
     env = {
         **os.environ,
         "BENCH_TEST_FORCE_WEDGE": "1",
+        # small corpus: the dataload row must not eat the wedge wall bound
+        "BENCH_DATALOAD_TOKENS": str(4 * 1024 * 1024),
         "BENCH_PROBE_TIMEOUT": "3",
         # roundtrip is chip-free; keep the child off any real backend
         "JAX_PLATFORMS": "cpu",
@@ -116,6 +118,8 @@ def test_bench_wedge_adopts_journaled_hardware_values(tmp_path):
     env = {
         **os.environ,
         "BENCH_TEST_FORCE_WEDGE": "1",
+        # small corpus: the dataload row must not eat the wedge wall bound
+        "BENCH_DATALOAD_TOKENS": str(4 * 1024 * 1024),
         "BENCH_PROBE_TIMEOUT": "3",
         "JAX_PLATFORMS": "cpu",
         "BENCH_JOURNAL_PATH": str(journal),
