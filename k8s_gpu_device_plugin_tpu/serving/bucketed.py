@@ -1,0 +1,70 @@
+"""Shared bucketed single-row dispatch for auxiliary serving forwards.
+
+Embeddings (serving/embeddings.py) and prompt scoring
+(serving/scoring.py) are the same machine with different jitted
+functions: pad a token list to the smallest fitting prompt bucket, run a
+per-bucket-compiled forward, serialize dispatches behind one lock, and
+compile every bucket at CONSTRUCTION — before the engine thread exists —
+so aiohttp executor threads only ever dispatch cached executables
+(concurrent XLA:CPU compilation segfaults intermittently in this jaxlib
+build; see tests/conftest.py). One implementation here so the bucket
+policy, warmup discipline, and over-cap error can never diverge between
+the two.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+
+class BucketedForward:
+    """Pad-to-bucket dispatcher over ``fn(params, padded, length, cfg)``.
+
+    ``kind`` names the consumer in the over-cap error ("embedding",
+    "scoring"); ``buckets`` are the compiled pad lengths.
+    """
+
+    def __init__(self, fn, params, cfg,
+                 buckets: tuple[int, ...], kind: str,
+                 warmup: bool = True):
+        self._fn = fn
+        self.params = params
+        self.cfg = cfg
+        self.buckets = tuple(sorted(buckets))
+        self.kind = kind
+        self._lock = threading.Lock()
+        if warmup:
+            self.warmup()
+
+    def warmup(self) -> None:
+        """Compile every bucket NOW, on the constructing thread (see
+        module docstring)."""
+        for b in self.buckets:
+            self._fn(
+                self.params, jnp.zeros((b,), jnp.int32), jnp.int32(1),
+                self.cfg,
+            ).block_until_ready()
+
+    def dispatch(self, ids: list[int]):
+        """Pad ``ids`` to its bucket and run the forward (lock-serialized);
+        returns the device array."""
+        if not ids:
+            raise ValueError("empty input")
+        # the serving prefill's own smallest-fitting-bucket rule — one
+        # implementation, so the bucket policies can never diverge
+        from k8s_gpu_device_plugin_tpu.models.batching import _bucket
+
+        try:
+            b = _bucket(len(ids), self.buckets)
+        except ValueError:
+            raise ValueError(
+                f"input of {len(ids)} tokens exceeds the {self.kind} "
+                f"bucket cap {self.buckets[-1]}"
+            ) from None
+        padded = jnp.asarray(list(ids) + [0] * (b - len(ids)), jnp.int32)
+        with self._lock:
+            return self._fn(
+                self.params, padded, jnp.int32(len(ids)), self.cfg
+            )

@@ -23,7 +23,6 @@ this framework adds.
 
 from __future__ import annotations
 
-import threading
 from functools import partial
 
 import jax
@@ -31,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, forward_with_aux
+from k8s_gpu_device_plugin_tpu.serving.bucketed import BucketedForward
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -46,56 +46,21 @@ def _embed_one(params, tokens, length, cfg: LlamaConfig):
     return (mean / jnp.linalg.norm(mean, axis=-1, keepdims=True))[0]
 
 
-class Embedder:
+class Embedder(BucketedForward):
     """Bucketed, thread-safe embedding pool over the serving params.
 
-    ``embed`` is called from aiohttp executor threads; the lock
-    serializes embedding dispatches against each other (they share the
-    chip with the decode loop at the XLA queue level, which is safe)."""
+    ``embed`` is called from aiohttp executor threads; the shared
+    bucket/warmup/lock discipline (serving/bucketed.py) serializes
+    dispatches and pre-compiles every bucket BEFORE the engine thread
+    exists, so executor threads never compile (the XLA:CPU concurrent-
+    compile segfault; see tests/conftest.py)."""
 
     def __init__(self, params, cfg: LlamaConfig,
                  buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
                  warmup: bool = True):
-        self.params = params
-        self.cfg = cfg
-        self.buckets = tuple(sorted(buckets))
+        super().__init__(_embed_one, params, cfg, buckets,
+                         kind="embedding", warmup=warmup)
         self.dim = cfg.d_model
-        self._lock = threading.Lock()
-        if warmup:
-            self.warmup()
-
-    def warmup(self) -> None:
-        """Compile every bucket's forward NOW, on the constructing thread.
-
-        ``embed`` runs on aiohttp executor threads while the engine thread
-        compiles decode steps; a first-request-per-bucket compile would
-        race those (concurrent XLA:CPU compilation segfaults intermittently
-        in this jaxlib build — see tests/conftest.py). After warmup every
-        embed() dispatch is a cache hit, so the executor threads never
-        compile. The server constructs the Embedder BEFORE the engine
-        starts its thread, making startup single-compiler."""
-        for b in self.buckets:
-            _embed_one(
-                self.params, jnp.zeros((b,), jnp.int32), jnp.int32(1),
-                self.cfg,
-            ).block_until_ready()
 
     def embed(self, ids: list[int]) -> np.ndarray:
-        if not ids:
-            raise ValueError("empty input")
-        # the serving prefill's own smallest-fitting-bucket rule — one
-        # implementation, so the two bucket policies can never diverge
-        from k8s_gpu_device_plugin_tpu.models.batching import _bucket
-
-        try:
-            b = _bucket(len(ids), self.buckets)
-        except ValueError:
-            raise ValueError(
-                f"input of {len(ids)} tokens exceeds the embedding "
-                f"bucket cap {self.buckets[-1]}"
-            ) from None
-        padded = jnp.asarray(ids + [0] * (b - len(ids)), jnp.int32)
-        with self._lock:
-            out = _embed_one(self.params, padded, jnp.int32(len(ids)),
-                             self.cfg)
-            return np.asarray(out, np.float32)
+        return np.asarray(self.dispatch(ids), np.float32)

@@ -148,11 +148,12 @@ class _OpenAIRoutes:
             "(batched prompt lists are not supported)"
         )
 
-    def _common(self, body: dict) -> dict:
+    def _common(self, body: dict, allow_zero_max_tokens: bool = False) -> dict:
         """Fields shared by both endpoints, validated. ``max_new`` is None
         when the request omitted max_tokens — each endpoint applies its
         own default (16 for legacy completions, the slot budget for
-        chat)."""
+        chat). ``allow_zero_max_tokens`` admits max_tokens=0 for the
+        echo prompt-scoring path, which generates nothing."""
         n = int(body.get("n", 1))
         if not (1 <= n <= 8):
             raise ValueError("n must be in [1, 8]")
@@ -162,8 +163,9 @@ class _OpenAIRoutes:
         max_new = body.get("max_tokens")
         if max_new is not None:
             max_new = int(max_new)
-            if max_new < 1:
-                raise ValueError("max_tokens must be >= 1")
+            floor = 0 if allow_zero_max_tokens else 1
+            if max_new < floor:
+                raise ValueError(f"max_tokens must be >= {floor}")
 
         stop = body.get("stop")
         stop_lists: list[list[int]] = []
@@ -362,19 +364,119 @@ class _OpenAIRoutes:
             body = await request.json()
             if not isinstance(body, dict):
                 raise ValueError("body must be a JSON object")
-            c = self._common(body)
+            echo = bool(body.get("echo", False))
+            c = self._common(body, allow_zero_max_tokens=echo)
             prompt = self._prompt_ids(body)
-            self._budget(c, prompt, default=16)  # OpenAI's legacy default
             lp = body.get("logprobs")
             want_logprobs = lp is not None and lp is not False  # 0 counts
+            if echo:
+                # the lm-eval loglikelihood contract: echo back the prompt
+                # with its own teacher-forced logprobs, generate nothing
+                if getattr(self._server, "scorer", None) is None:
+                    raise ValueError(
+                        "echo requires prompt scoring; start the server "
+                        "with --scoring"
+                    )
+                if c["max_new"] not in (None, 0):
+                    raise ValueError(
+                        "echo is supported only with max_tokens 0 "
+                        "(prompt scoring)"
+                    )
+                if c["n"] != 1:
+                    raise ValueError("echo supports n == 1 only")
+                if c["stream"]:
+                    raise ValueError("echo does not support streaming")
+                if c["adapter"] != -1:
+                    raise ValueError("echo scores the base model only")
+                # the scorer's bucket cap bounds EVERY echo request, with
+                # or without logprobs — echo must not be the one API path
+                # with no prompt-size validation at all
+                cap = self._server.scorer.buckets[-1]
+                if len(prompt) > cap:
+                    raise ValueError(
+                        f"prompt of {len(prompt)} tokens exceeds the "
+                        f"scoring bucket cap {cap}"
+                    )
+            else:
+                self._budget(c, prompt, default=16)  # OpenAI legacy default
         except _ModelNotFound as e:
             return _oai_error(str(e), 404, code="model_not_found")
         except (json.JSONDecodeError, TypeError, ValueError) as e:
             return _oai_error(str(e), 400)
+        if echo:
+            return await self._echo_score(prompt, want_logprobs)
         return await self._respond(
             request, prompt, c, want_logprobs,
             object_name="text_completion", id_prefix="cmpl", chat=False,
         )
+
+    async def _echo_score(
+        self, prompt: list[int], want_logprobs: bool
+    ) -> web.Response:
+        tok = self._server.tokenizer
+        lp_payload = None
+        if want_logprobs:
+            loop = asyncio.get_running_loop()
+            try:
+                lps = await loop.run_in_executor(
+                    None, self._server.scorer.score, prompt
+                )
+            except ValueError as e:  # bucket cap: a client-size mistake
+                return _oai_error(str(e), 400)
+            # per-token strings via the streaming detokenizer (_TextDiffer):
+            # naive per-token or prefix-diff decode mangles multi-byte
+            # characters spanning tokens (U+FFFD) and SentencePiece space
+            # markers; with holdback, an incomplete token contributes ""
+            # and the completing one carries the resolved characters, so
+            # ''.join(tokens) always equals the returned text and offsets
+            # stay monotone
+            tokens, offsets = [], []
+            if tok is not None:
+                differ = _TextDiffer(tok)
+                pos = 0
+                for t in prompt:
+                    piece = differ.push(t)
+                    offsets.append(pos)
+                    tokens.append(piece)
+                    pos += len(piece)
+                tail = differ.flush()
+                if tail and tokens:
+                    tokens[-1] += tail
+            else:
+                pos = 0
+                for t in prompt:
+                    tokens.append(str(t))
+                    offsets.append(pos)
+                    pos += len(str(t))
+            lp_payload = {
+                "tokens": tokens,
+                "token_logprobs": lps,  # index 0 is null: no context
+                "top_logprobs": None,
+                "text_offset": offsets,
+            }
+        if tok is None:
+            text = ""  # token-ids-only server, matching the generate path
+        elif lp_payload is not None:
+            text = "".join(lp_payload["tokens"])  # exact by construction
+        else:
+            text = tok.decode(prompt)
+        return web.json_response({
+            "id": f"cmpl-echo-{int(time.time() * 1000)}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": MODEL_ID,
+            "choices": [{
+                "index": 0,
+                "text": text,
+                "finish_reason": "length",
+                "logprobs": lp_payload,
+            }],
+            "usage": {
+                "prompt_tokens": len(prompt),
+                "completion_tokens": 0,
+                "total_tokens": len(prompt),
+            },
+        })
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:

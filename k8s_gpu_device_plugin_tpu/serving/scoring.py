@@ -1,0 +1,67 @@
+"""Prompt scoring for the serving pod: teacher-forced token logprobs.
+
+The OpenAI completions contract eval harnesses rely on (lm-eval's
+``loglikelihood``): ``echo=true, max_tokens=0, logprobs=N`` returns the
+PROMPT's own per-token logprobs — one teacher-forced forward, no
+sampling. The decode engine can't serve this (its prefill keeps only the
+next-token logits); this is the training-path forward scored at every
+position.
+
+TPU shape discipline mirrors serving/embeddings.py: inputs pad to the
+prompt buckets so the jitted forward compiles once per bucket, padding
+is masked out, and every bucket is compiled at construction — BEFORE the
+engine thread exists — so aiohttp executor threads only dispatch cached
+executables (concurrent XLA:CPU compilation segfaults intermittently in
+this jaxlib build; see tests/conftest.py).
+
+Unsupported with weight-only quantized serving for the same reason as
+embeddings: the quantized leaves are decode-path, the scoring forward is
+the training-path matmul. The CLI gates this at startup.
+
+No reference analogue: the reference is a device-plugin daemon; scoring
+belongs to the workload stack this framework adds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, forward
+from k8s_gpu_device_plugin_tpu.serving.bucketed import BucketedForward
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _score_one(params, tokens, length, cfg: LlamaConfig):
+    """(P,) padded ids + real length -> (P,) f32 logprob of each token
+    given its prefix; position 0 and padding positions read 0.0 (callers
+    mask them — position 0 has no context to be scored under)."""
+    logits = forward(params, tokens[None, :], cfg)  # (1, P, V) f32
+    logprobs = jax.nn.log_softmax(logits[0], axis=-1)  # (P, V)
+    # token t's score lives at the logits of its PREDECESSOR position
+    scores = jnp.take_along_axis(
+        logprobs[:-1], tokens[1:, None], axis=-1
+    )[:, 0]  # (P-1,)
+    scores = jnp.concatenate([jnp.zeros((1,), scores.dtype), scores])
+    mask = jnp.arange(tokens.shape[0]) < length
+    return jnp.where(mask, scores, 0.0)
+
+
+class Scorer(BucketedForward):
+    """Bucketed, thread-safe prompt scorer over the serving params
+    (bucket/warmup/lock discipline shared with Embedder via
+    serving/bucketed.py)."""
+
+    def __init__(self, params, cfg: LlamaConfig,
+                 buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+                 warmup: bool = True):
+        super().__init__(_score_one, params, cfg, buckets,
+                         kind="scoring", warmup=warmup)
+
+    def score(self, ids: list[int]) -> list[float | None]:
+        """Per-token logprobs for ``ids``; index 0 is None (no context)."""
+        out = np.asarray(self.dispatch(ids), np.float32)
+        return [None] + [float(v) for v in out[1:len(ids)]]

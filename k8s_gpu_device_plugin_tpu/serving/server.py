@@ -355,7 +355,7 @@ class InferenceServer:
 
     def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
                  port: int = 8000, registry=None, tokenizer=None,
-                 embedder=None):
+                 embedder=None, scorer=None):
         self.engine = engine
         self.host = host
         self.port = port
@@ -363,6 +363,9 @@ class InferenceServer:
         self.registry = registry
         # Optional serving/embeddings.Embedder: enables /v1/embeddings
         self.embedder = embedder
+        # Optional serving/scoring.Scorer: enables completions
+        # echo=true + max_tokens=0 prompt scoring (lm-eval loglikelihood)
+        self.scorer = scorer
         # Optional text seam (serving/tokenizer.py): anything with
         # encode(str)->ids / decode(ids)->str. The engine itself stays
         # token-ids only; text is translated at the HTTP boundary.
@@ -733,6 +736,10 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--embeddings", action="store_true",
                         help="enable /v1/embeddings (mean-pooled final "
                         "hidden states; base model only, bf16 weights)")
+    parser.add_argument("--scoring", action="store_true",
+                        help="enable completions echo=true + max_tokens=0 "
+                        "prompt scoring (teacher-forced logprobs; base "
+                        "model only, bf16 weights)")
     parser.add_argument("--loraAdapters", default="",
                         help="multi-LoRA serving: name=ckptdir[:alpha=X]"
                         ",... — requests select by name ('adapter' field "
@@ -813,6 +820,19 @@ def _main(argv: list[str] | None = None) -> int:
 
         embedder = Embedder(params, cfg)
 
+    # echo=true prompt scoring: same training-path forward, same
+    # warm-before-engine compile discipline as the embedder
+    scorer = None
+    if args.scoring:
+        if args.weightQuant != "none":
+            raise SystemExit(
+                "--scoring is unsupported with --weightQuant: the "
+                "teacher-forced forward cannot consume quantized leaves"
+            )
+        from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
+
+        scorer = Scorer(params, cfg)
+
     metrics = ServingMetrics()
     batcher = None
     if args.draftPreset:
@@ -839,7 +859,7 @@ def _main(argv: list[str] | None = None) -> int:
 
     server = InferenceServer(engine, host=args.host, port=args.port,
                              registry=REGISTRY, tokenizer=tokenizer,
-                             embedder=embedder)
+                             embedder=embedder, scorer=scorer)
 
     async def serve():
         stop = asyncio.Event()

@@ -47,13 +47,13 @@ def _oracle(params, prompt, cfg, max_new):
     return np.asarray(out)[0].tolist()
 
 
-async def _with_server(setup, body, tokenizer=None, **engine_kw):
+async def _with_server(setup, body, tokenizer=None, scorer=None, **engine_kw):
     cfg, params = setup
     engine = InferenceEngine(
         params, cfg, n_slots=2, max_len=64, chunked_prefill=8, **engine_kw
     )
     server = InferenceServer(
-        engine, host="127.0.0.1", port=0, tokenizer=tokenizer
+        engine, host="127.0.0.1", port=0, tokenizer=tokenizer, scorer=scorer
     )
     stop = asyncio.Event()
     task = asyncio.create_task(server.run(stop))
@@ -432,3 +432,115 @@ def test_oai_error_types_key_sdk_retries():
         assert resp.status == status
         payload = json.loads(resp.body)
         assert payload["error"]["type"] == expected
+
+
+def test_echo_prompt_scoring_matches_forward_oracle(setup):
+    """echo=true + max_tokens=0 + logprobs returns the prompt's own
+    teacher-forced logprobs (the lm-eval loglikelihood contract), equal
+    to forward + log_softmax computed directly, independent of the
+    padding bucket."""
+    cfg, params = setup
+    from k8s_gpu_device_plugin_tpu.models.llama import forward
+    from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
+
+    prompt = _prompt(5, 10, cfg)
+    logits = forward(params, jnp.asarray([prompt], jnp.int32), cfg)[0]
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    expect = [float(lp[i - 1, prompt[i]]) for i in range(1, len(prompt))]
+
+    scorer = Scorer(params, cfg, buckets=(16, 32))
+    got = scorer.score(prompt)
+    assert got[0] is None and len(got) == len(prompt)
+    np.testing.assert_allclose(got[1:], expect, rtol=2e-5, atol=2e-5)
+    # bucket invariance: a wider pad bucket scores identically
+    got_wide = Scorer(params, cfg, buckets=(32,)).score(prompt)
+    np.testing.assert_allclose(got[1:], got_wide[1:], rtol=1e-6)
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "echo": True, "max_tokens": 0, "logprobs": 0,
+        })
+        assert r.status == 200, await r.text()
+        p = await r.json()
+        ch = p["choices"][0]
+        assert ch["finish_reason"] == "length"
+        assert p["usage"] == {"prompt_tokens": len(prompt),
+                              "completion_tokens": 0,
+                              "total_tokens": len(prompt)}
+        assert ch["logprobs"]["token_logprobs"][0] is None
+        np.testing.assert_allclose(
+            ch["logprobs"]["token_logprobs"][1:], got[1:], rtol=1e-5
+        )
+        assert len(ch["logprobs"]["tokens"]) == len(prompt)
+        assert ch["logprobs"]["text_offset"][0] == 0
+
+        # echo WITHOUT logprobs: no scoring forward, just the prompt back
+        r2 = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "echo": True, "max_tokens": 0,
+        })
+        p2 = await r2.json()
+        assert p2["choices"][0]["logprobs"] is None
+
+        # validations: generation, n>1, and streaming are not scoring
+        for bad, needle in [
+            ({"max_tokens": 3}, "max_tokens 0"),
+            ({"n": 2}, "n == 1"),
+            ({"stream": True}, "stream"),
+        ]:
+            r3 = await session.post(f"{base}/v1/completions", json={
+                "prompt": prompt, "echo": True, "max_tokens": 0,
+                "logprobs": 0, **bad,
+            })
+            assert r3.status == 400, await r3.text()
+            assert needle in (await r3.json())["error"]["message"]
+
+    run(_with_server(setup, body, scorer=scorer))
+
+
+def test_echo_requires_scoring_enabled(setup):
+    """echo against a server without --scoring is a clear 400, not a
+    silent empty answer."""
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": [1, 2, 3], "echo": True, "max_tokens": 0,
+        })
+        assert r.status == 400
+        assert "--scoring" in (await r.json())["error"]["message"]
+
+    run(_with_server(setup, body))
+
+
+def test_echo_text_tokens_concatenate_and_cap(setup):
+    """With a tokenizer, echo's token strings must concatenate EXACTLY to
+    the returned text even when a multi-byte character spans tokens
+    (prefix-stable decode, not per-token decode -> U+FFFD), and the
+    scoring bucket cap bounds echo requests with or without logprobs."""
+    cfg, params = setup
+    from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
+
+    tok = ByteTokenizer()
+    scorer = Scorer(params, cfg, buckets=(16,))
+    text_in = "héllo"  # é = 2 bytes = 2 byte-level tokens
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": text_in, "echo": True, "max_tokens": 0,
+            "logprobs": 0,
+        })
+        assert r.status == 200, await r.text()
+        p = await r.json()
+        ch = p["choices"][0]
+        assert ch["text"] == text_in
+        lp = ch["logprobs"]
+        assert "".join(lp["tokens"]) == text_in
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+        assert len(lp["tokens"]) == len(lp["token_logprobs"])
+
+        # over-cap echo WITHOUT logprobs is still a 400, not a free pass
+        r2 = await session.post(f"{base}/v1/completions", json={
+            "prompt": "x" * 17, "echo": True, "max_tokens": 0,
+        })
+        assert r2.status == 400
+        assert "bucket cap" in (await r2.json())["error"]["message"]
+
+    run(_with_server(setup, body, tokenizer=tok, scorer=scorer))
