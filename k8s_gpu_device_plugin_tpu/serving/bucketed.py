@@ -41,11 +41,13 @@ class BucketedForward:
     def warmup(self) -> None:
         """Compile every bucket NOW, on the constructing thread (see
         module docstring)."""
+        import jax
+
         for b in self.buckets:
-            self._fn(
+            jax.block_until_ready(self._fn(
                 self.params, jnp.zeros((b,), jnp.int32), jnp.int32(1),
                 self.cfg,
-            ).block_until_ready()
+            ))
 
     def dispatch(self, ids: list[int]):
         """Pad ``ids`` to its bucket and run the forward (lock-serialized);

@@ -42,6 +42,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+import uuid
 
 from aiohttp import web
 
@@ -397,6 +398,10 @@ class _OpenAIRoutes:
                         f"prompt of {len(prompt)} tokens exceeds the "
                         f"scoring bucket cap {cap}"
                     )
+                # OpenAI completions contract: 0 <= logprobs <= 5
+                # (scoring.TOP_K compiles exactly 5 alternatives)
+                if want_logprobs and not (0 <= int(lp) <= 5):
+                    raise ValueError("logprobs must be between 0 and 5")
             else:
                 self._budget(c, prompt, default=16)  # OpenAI legacy default
         except _ModelNotFound as e:
@@ -404,22 +409,23 @@ class _OpenAIRoutes:
         except (json.JSONDecodeError, TypeError, ValueError) as e:
             return _oai_error(str(e), 400)
         if echo:
-            return await self._echo_score(prompt, want_logprobs)
+            top_n = int(lp) if want_logprobs else 0
+            return await self._echo_score(prompt, want_logprobs, top_n)
         return await self._respond(
             request, prompt, c, want_logprobs,
             object_name="text_completion", id_prefix="cmpl", chat=False,
         )
 
     async def _echo_score(
-        self, prompt: list[int], want_logprobs: bool
+        self, prompt: list[int], want_logprobs: bool, top_n: int = 0
     ) -> web.Response:
         tok = self._server.tokenizer
         lp_payload = None
         if want_logprobs:
             loop = asyncio.get_running_loop()
             try:
-                lps = await loop.run_in_executor(
-                    None, self._server.scorer.score, prompt
+                lps, top_lps, top_ids = await loop.run_in_executor(
+                    None, self._server.scorer.score_full, prompt
                 )
             except ValueError as e:  # bucket cap: a client-size mistake
                 return _oai_error(str(e), 400)
@@ -448,10 +454,30 @@ class _OpenAIRoutes:
                     tokens.append(str(t))
                     offsets.append(pos)
                     pos += len(str(t))
+            top_payload = None
+            if top_n > 0:
+                def tstr(tid: int) -> str:
+                    return tok.decode([tid]) if tok is not None else str(tid)
+
+                # per-position top-N alternatives (the model's preference —
+                # lm-eval's is_greedy compares entry 0 to the actual token);
+                # index 0 is null like token_logprobs. The legacy dict
+                # format keys by token STRING, so ids that decode
+                # identically (e.g. several byte ids -> U+FFFD) merge;
+                # iterating best-first with setdefault keeps the most
+                # probable of any colliding pair.
+                top_payload: list = [None]
+                for i in range(1, len(prompt)):
+                    entry: dict[str, float] = {}
+                    for j in range(top_n):
+                        entry.setdefault(
+                            tstr(int(top_ids[i, j])), float(top_lps[i, j])
+                        )
+                    top_payload.append(entry)
             lp_payload = {
                 "tokens": tokens,
                 "token_logprobs": lps,  # index 0 is null: no context
-                "top_logprobs": None,
+                "top_logprobs": top_payload,
                 "text_offset": offsets,
             }
         if tok is None:
@@ -461,7 +487,9 @@ class _OpenAIRoutes:
         else:
             text = tok.decode(prompt)
         return web.json_response({
-            "id": f"cmpl-echo-{int(time.time() * 1000)}",
+            # unique like the generate path's rid-based ids — a timestamp
+            # collides across concurrent echo requests
+            "id": f"cmpl-echo-{uuid.uuid4().hex[:16]}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": MODEL_ID,

@@ -34,11 +34,21 @@ from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, forward
 from k8s_gpu_device_plugin_tpu.serving.bucketed import BucketedForward
 
 
+TOP_K = 5  # OpenAI caps completions logprobs at 5 alternatives
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def _score_one(params, tokens, length, cfg: LlamaConfig):
-    """(P,) padded ids + real length -> (P,) f32 logprob of each token
-    given its prefix; position 0 and padding positions read 0.0 (callers
-    mask them — position 0 has no context to be scored under)."""
+    """(P,) padded ids + real length -> per-token scoring triple.
+
+    Returns (scores (P,), top_lps (P, TOP_K), top_ids (P, TOP_K)):
+    token t's logprob given its prefix, plus the TOP_K most likely
+    alternatives AT t's position (what the model would have preferred —
+    the lm-eval ``is_greedy`` signal is top_ids[t, 0] == tokens[t]).
+    Position 0 and padding read 0.0/0 (callers mask them — position 0
+    has no context to be scored under). Always computing TOP_K keeps the
+    compiled shape independent of the per-request logprobs value, so
+    warmup's cache covers every request (single-compiler discipline)."""
     logits = forward(params, tokens[None, :], cfg)  # (1, P, V) f32
     logprobs = jax.nn.log_softmax(logits[0], axis=-1)  # (P, V)
     # token t's score lives at the logits of its PREDECESSOR position
@@ -46,8 +56,17 @@ def _score_one(params, tokens, length, cfg: LlamaConfig):
         logprobs[:-1], tokens[1:, None], axis=-1
     )[:, 0]  # (P-1,)
     scores = jnp.concatenate([jnp.zeros((1,), scores.dtype), scores])
+    top_lps, top_ids = jax.lax.top_k(logprobs[:-1], TOP_K)  # (P-1, K)
+    pad_lp = jnp.zeros((1, TOP_K), top_lps.dtype)
+    pad_id = jnp.zeros((1, TOP_K), top_ids.dtype)
+    top_lps = jnp.concatenate([pad_lp, top_lps])
+    top_ids = jnp.concatenate([pad_id, top_ids])
     mask = jnp.arange(tokens.shape[0]) < length
-    return jnp.where(mask, scores, 0.0)
+    return (
+        jnp.where(mask, scores, 0.0),
+        jnp.where(mask[:, None], top_lps, 0.0),
+        jnp.where(mask[:, None], top_ids, 0),
+    )
 
 
 class Scorer(BucketedForward):
@@ -63,5 +82,21 @@ class Scorer(BucketedForward):
 
     def score(self, ids: list[int]) -> list[float | None]:
         """Per-token logprobs for ``ids``; index 0 is None (no context)."""
-        out = np.asarray(self.dispatch(ids), np.float32)
-        return [None] + [float(v) for v in out[1:len(ids)]]
+        return self.score_full(ids)[0]
+
+    def score_full(
+        self, ids: list[int]
+    ) -> tuple[list[float | None], np.ndarray, np.ndarray]:
+        """(per-token logprobs, top-K alternative logprobs (n, K),
+        top-K alternative ids (n, K)); row 0 of the top arrays is
+        meaningless (no context) — callers emit null there."""
+        scores, top_lps, top_ids = self.dispatch(ids)
+        n = len(ids)
+        lps = [None] + [
+            float(v) for v in np.asarray(scores, np.float32)[1:n]
+        ]
+        return (
+            lps,
+            np.asarray(top_lps, np.float32)[:n],
+            np.asarray(top_ids, np.int32)[:n],
+        )

@@ -544,3 +544,54 @@ def test_echo_text_tokens_concatenate_and_cap(setup):
         assert "bucket cap" in (await r2.json())["error"]["message"]
 
     run(_with_server(setup, body, tokenizer=tok, scorer=scorer))
+
+
+def test_echo_top_logprobs_alternatives(setup):
+    """logprobs=K (1..5) on the echo path returns K alternatives per
+    position; entry 0 is the argmax, and when the actual token IS the
+    argmax its logprob equals token_logprobs (the is_greedy signal)."""
+    cfg, params = setup
+    from k8s_gpu_device_plugin_tpu.models.llama import forward
+    from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
+
+    prompt = _prompt(9, 8, cfg)
+    scorer = Scorer(params, cfg, buckets=(16,))
+    lps, top_lps, top_ids = scorer.score_full(prompt)
+    # oracle argmax at each scored position
+    logits = forward(params, jnp.asarray([prompt], jnp.int32), cfg)[0]
+    lp_oracle = jax.nn.log_softmax(logits, axis=-1)
+    for i in range(1, len(prompt)):
+        assert int(top_ids[i, 0]) == int(jnp.argmax(lp_oracle[i - 1]))
+        # alternatives sorted descending
+        assert list(top_lps[i][:3]) == sorted(top_lps[i][:3], reverse=True)
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "echo": True, "max_tokens": 0, "logprobs": 2,
+        })
+        assert r.status == 200, await r.text()
+        ch = (await r.json())["choices"][0]
+        tops = ch["logprobs"]["top_logprobs"]
+        assert tops[0] is None and len(tops) == len(prompt)
+        # token-ids-only server: keys are unique id strings -> exactly K
+        assert all(len(t) == 2 for t in tops[1:])
+        assert all(
+            all(k.isdigit() for k in t) for t in tops[1:]
+        )
+        # logprobs=0: no alternatives, top_logprobs null
+        r2 = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "echo": True, "max_tokens": 0, "logprobs": 0,
+        })
+        assert (await r2.json())["choices"][0]["logprobs"][
+            "top_logprobs"] is None
+        # logprobs > 5 is OpenAI's own cap
+        for bad_k in (9, -1):
+            r3 = await session.post(f"{base}/v1/completions", json={
+                "prompt": prompt, "echo": True, "max_tokens": 0,
+                "logprobs": bad_k,
+            })
+            assert r3.status == 400
+            assert "between 0 and 5" in (
+                await r3.json())["error"]["message"]
+
+    run(_with_server(setup, body, scorer=scorer))
