@@ -51,6 +51,14 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         raise NotImplementedError(
             f"hidden_act {act!r} not supported: the MLP hardcodes silu"
         )
+    model_type = getattr(hf_config, "model_type", "llama")
+    # Qwen2 is Llama-layout plus q/k/v projection biases (no o bias).
+    # HF Llama's own attention_bias puts a bias on o_proj TOO — converting
+    # that would half-apply it, so it is refused below via the
+    # unconsumed-tensor check (o_proj.bias is never taken).
+    attn_bias = model_type == "qwen2" or bool(
+        getattr(hf_config, "attention_bias", False)
+    )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -64,9 +72,32 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # Mistral-style checkpoints are layout-identical to Llama but were
         # trained with windowed attention — dropping the window would
         # silently attend beyond what the model ever saw
-        sliding_window=int(getattr(hf_config, "sliding_window", None) or 0),
+        sliding_window=_window_from_hf(hf_config),
+        attn_bias=attn_bias,
         dtype=dtype,
     )
+
+
+def _window_from_hf(hf_config: Any) -> int:
+    """Sliding window with Qwen2's gating honored.
+
+    Qwen2 checkpoints SHIP sliding_window=4096 but apply it only when
+    ``use_sliding_window`` — and then only to the layers above
+    ``max_window_layers`` (the rest attend fully). A global window here
+    would silently change logits either way: applied-though-disabled for
+    default Qwen2, or applied-to-every-layer for the partial case, which
+    this stack cannot express and must refuse."""
+    window = int(getattr(hf_config, "sliding_window", None) or 0)
+    if not getattr(hf_config, "use_sliding_window", True):
+        return 0
+    mwl = getattr(hf_config, "max_window_layers", None)
+    if window and mwl is not None and mwl < hf_config.num_hidden_layers:
+        raise NotImplementedError(
+            f"layer-partial sliding window (max_window_layers={mwl} < "
+            f"num_hidden_layers={hf_config.num_hidden_layers}) not "
+            "supported: this stack applies one window to every layer"
+        )
+    return window
 
 
 # per-layer tensor mapping, shared by BOTH directions so the round-trip
@@ -82,6 +113,19 @@ _LAYER_MAP = {
     "w3": ("mlp.up_proj.weight", True),
     "w2": ("mlp.down_proj.weight", True),
 }
+
+# Qwen2 extension: q/k/v biases (1-D, no transpose), only consumed when
+# cfg.attn_bias — a Llama checkpoint never has them and a Qwen2 convert
+# without the flag fails loudly on unconsumed tensors.
+_BIAS_MAP = {
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+}
+
+
+def _layer_map(cfg: LlamaConfig) -> dict:
+    return {**_LAYER_MAP, **_BIAS_MAP} if cfg.attn_bias else _LAYER_MAP
 
 
 def _to_np(t: Any) -> np.ndarray:
@@ -114,7 +158,7 @@ def params_from_hf(
         "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.p_dtype),
         "layers": {
             ours: stack("model.layers.{}." + suffix, transpose)
-            for ours, (suffix, transpose) in _LAYER_MAP.items()
+            for ours, (suffix, transpose) in _layer_map(cfg).items()
         },
         "final_norm": jnp.asarray(take("model.norm.weight"), cfg.p_dtype),
         "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.p_dtype),
@@ -166,7 +210,7 @@ def params_to_hf(params: dict, cfg: LlamaConfig) -> dict:
         "model.norm.weight": np32(params["final_norm"]),
         "lm_head.weight": np32(np.asarray(params["lm_head"]).T),
     }
-    for ours, (theirs, transpose) in _LAYER_MAP.items():
+    for ours, (theirs, transpose) in _layer_map(cfg).items():
         stacked = np.asarray(params["layers"][ours], np.float32)
         if stacked.shape[0] != cfg.n_layers:
             raise ValueError(

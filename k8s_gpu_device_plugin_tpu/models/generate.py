@@ -258,9 +258,16 @@ def _project_qkv(x, layer, positions, cfg, sel=None):
     b, t, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = _qm_lora(h, layer, "wq", sel).reshape(b, t, cfg.n_heads, hd)
-    k = _qm_lora(h, layer, "wk", sel).reshape(b, t, cfg.n_kv_heads, hd)
-    v = _qm_lora(h, layer, "wv", sel).reshape(b, t, cfg.n_kv_heads, hd)
+    q = _qm_lora(h, layer, "wq", sel)
+    k = _qm_lora(h, layer, "wk", sel)
+    v = _qm_lora(h, layer, "wv", sel)
+    if cfg.attn_bias:
+        # Qwen2 layout: biases are base-model leaves (adapters and int8
+        # weight quantization never touch them), added after any LoRA delta
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(b, t, cfg.n_heads, hd)
+    k = k.reshape(b, t, cfg.n_kv_heads, hd)
+    v = v.reshape(b, t, cfg.n_kv_heads, hd)
     return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
 
 

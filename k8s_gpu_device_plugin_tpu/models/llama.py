@@ -63,6 +63,10 @@ class LlamaConfig:
     # kv blocks by position offset; parallel/ring_attention.py). Ulysses
     # still rejects it.
     sliding_window: int = 0
+    # Qwen2-style biases on the q/k/v projections (o/MLP stay bias-free —
+    # that is the Qwen2 layout; HF Llama's all-four attention_bias is
+    # refused at conversion rather than half-applied)
+    attn_bias: bool = False
     dtype: Any = jnp.bfloat16
     # Storage dtype for parameters (None = same as ``dtype``). Set
     # jnp.float32 for mixed-precision master weights: optimizer updates
@@ -171,6 +175,14 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def qwen2_7b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=152064, d_model=3584, n_layers=28, n_heads=28,
+            n_kv_heads=4, d_ff=18944, rope_theta=1e6, max_seq=32768,
+            attn_bias=True,
+        )
+
+    @staticmethod
     def mistral_7b() -> "LlamaConfig":
         return LlamaConfig(
             vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
@@ -237,6 +249,14 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         "wv": norm_init(ks[2], (L, d, cfg.n_kv_heads * hd), std),
         "wo": norm_init(ks[3], (L, cfg.n_heads * hd, d), out_std),
     }
+    if cfg.attn_bias:
+        # zeros: bias-free behavior at init; real values come from HF
+        # checkpoints (models/convert.py)
+        layers.update({
+            "bq": jnp.zeros((L, cfg.n_heads * hd), cfg.p_dtype),
+            "bk": jnp.zeros((L, cfg.n_kv_heads * hd), cfg.p_dtype),
+            "bv": jnp.zeros((L, cfg.n_kv_heads * hd), cfg.p_dtype),
+        })
     if cfg.is_moe:
         from k8s_gpu_device_plugin_tpu.models.moe import moe_param_init
 
@@ -268,6 +288,13 @@ def param_specs(cfg: LlamaConfig, pp: int = 1) -> dict:
         "wv": P(None, AXIS_FSDP, AXIS_TP),
         "wo": P(None, AXIS_TP, AXIS_FSDP),
     }
+    if cfg.attn_bias:
+        # biases shard with their output dim (tp), like the mats' columns
+        layers.update({
+            "bq": P(None, AXIS_TP),
+            "bk": P(None, AXIS_TP),
+            "bv": P(None, AXIS_TP),
+        })
     if cfg.is_moe:
         from k8s_gpu_device_plugin_tpu.models.moe import moe_param_specs
 
@@ -421,9 +448,14 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
         mm = jnp.matmul
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = mm(h, layer["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = mm(h, layer["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = mm(h, layer["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q, k, v = mm(h, layer["wq"]), mm(h, layer["wk"]), mm(h, layer["wv"])
+    if cfg.attn_bias:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     qkv_spec = P(BATCH, AXIS_SP, AXIS_TP, None)

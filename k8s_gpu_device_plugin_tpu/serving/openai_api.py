@@ -370,6 +370,10 @@ class _OpenAIRoutes:
             prompt = self._prompt_ids(body)
             lp = body.get("logprobs")
             want_logprobs = lp is not None and lp is not False  # 0 counts
+            # OpenAI completions contract on BOTH paths: 0 <= logprobs <= 5
+            # (scoring.TOP_K compiles exactly 5 alternatives)
+            if want_logprobs and not (0 <= int(lp) <= 5):
+                raise ValueError("logprobs must be between 0 and 5")
             if echo:
                 # the lm-eval loglikelihood contract: echo back the prompt
                 # with its own teacher-forced logprobs, generate nothing
@@ -398,10 +402,6 @@ class _OpenAIRoutes:
                         f"prompt of {len(prompt)} tokens exceeds the "
                         f"scoring bucket cap {cap}"
                     )
-                # OpenAI completions contract: 0 <= logprobs <= 5
-                # (scoring.TOP_K compiles exactly 5 alternatives)
-                if want_logprobs and not (0 <= int(lp) <= 5):
-                    raise ValueError("logprobs must be between 0 and 5")
             else:
                 self._budget(c, prompt, default=16)  # OpenAI legacy default
         except _ModelNotFound as e:

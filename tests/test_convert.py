@@ -193,3 +193,118 @@ def test_mistral_sliding_window_mapped():
         ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
     got = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def _tiny_qwen2(vocab=64):
+    cfg = transformers.Qwen2Config(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False,
+        max_position_embeddings=128,
+    )
+    torch.manual_seed(1)
+    m = transformers.Qwen2ForCausalLM(cfg).eval()
+    # random biases: zeros would make the bias path vacuously pass
+    with torch.no_grad():
+        for layer in m.model.layers:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                getattr(layer.self_attn, proj).bias.normal_(0.0, 0.5)
+    return m, cfg
+
+
+def test_qwen2_forward_matches_transformers():
+    """Qwen2 family: the Llama layout + q/k/v biases. Logits parity with
+    transformers' Qwen2ForCausalLM pins the bias wiring (biases are
+    randomized — zeros would hide a dropped bias)."""
+    hf, hf_cfg = _tiny_qwen2()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.attn_bias
+    params = params_from_hf(hf.state_dict(), cfg)
+    assert params["layers"]["bq"].shape == (2, 64)
+
+    tokens = np.array([[3, 17, 42, 7, 23, 11, 60, 2]], np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_qwen2_generate_matches_transformers_greedy():
+    """Decode path carries the biases too (generate's cached-attention
+    projections are a separate code path from the training forward)."""
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+
+    hf, hf_cfg = _tiny_qwen2()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = params_from_hf(hf.state_dict(), cfg)
+
+    prompt = np.array([[5, 9, 33, 12]], np.int64)
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, prompt.shape[1]:]
+    got = np.asarray(
+        generate(params, jnp.asarray(prompt, jnp.int32), cfg, max_new=8)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_qwen2_round_trip():
+    """params -> HF state dict -> params is exact, biases included."""
+    from k8s_gpu_device_plugin_tpu.models.convert import params_to_hf
+
+    hf, hf_cfg = _tiny_qwen2()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = params_from_hf(hf.state_dict(), cfg)
+    sd = params_to_hf(params, cfg)
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    again = params_from_hf(sd, cfg)
+    for k in ("bq", "bk", "bv", "wq"):
+        np.testing.assert_array_equal(
+            np.asarray(params["layers"][k]), np.asarray(again["layers"][k])
+        )
+
+
+def test_llama_attention_bias_o_proj_refused():
+    """HF Llama's attention_bias also biases o_proj; converting it would
+    half-apply the checkpoint — the unconsumed-tensor check refuses."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False, attention_bias=True,
+    )
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg = config_from_hf(cfg_hf, dtype=jnp.float32)
+    assert cfg.attn_bias  # qkv biases ARE consumed...
+    with pytest.raises(ValueError, match="unconsumed"):
+        params_from_hf(hf.state_dict(), cfg)  # ...o_proj.bias is not
+
+
+def test_qwen2_sliding_window_gating():
+    """Qwen2 ships sliding_window=4096 but DISABLED by default
+    (use_sliding_window=False): the conversion must not window a model
+    trained with full attention. Layer-partial windows (max_window_layers
+    below n_layers) cannot be expressed here and are refused."""
+    base = dict(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    off = transformers.Qwen2Config(**base, use_sliding_window=False,
+                                   sliding_window=4096)
+    assert config_from_hf(off).sliding_window == 0
+
+    partial = transformers.Qwen2Config(
+        **base, use_sliding_window=True, sliding_window=4096,
+        max_window_layers=2,
+    )
+    with pytest.raises(NotImplementedError, match="layer-partial"):
+        config_from_hf(partial)
+
+    full = transformers.Qwen2Config(
+        **base, use_sliding_window=True, sliding_window=4096,
+        max_window_layers=4,
+    )
+    assert config_from_hf(full).sliding_window == 4096

@@ -593,5 +593,11 @@ def test_echo_top_logprobs_alternatives(setup):
             assert r3.status == 400
             assert "between 0 and 5" in (
                 await r3.json())["error"]["message"]
+        # the range applies on the GENERATION path too, not just echo
+        r4 = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "max_tokens": 2, "logprobs": 9,
+        })
+        assert r4.status == 400
+        assert "between 0 and 5" in (await r4.json())["error"]["message"]
 
     run(_with_server(setup, body, scorer=scorer))
