@@ -32,11 +32,11 @@ from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 
 def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     """Map a ``transformers.LlamaConfig`` onto :class:`LlamaConfig`."""
-    if getattr(hf_config, "tie_word_embeddings", False):
-        raise NotImplementedError(
-            "tied embeddings not supported: this stack keeps a separate "
-            "lm_head (untie the checkpoint before converting)"
-        )
+    model_type = getattr(hf_config, "model_type", "llama")
+    # tied embeddings are family-agnostic here (head_weights serves
+    # embed.T); params_from_hf verifies the materialized head really
+    # equals the embedding table
+    tied = bool(getattr(hf_config, "tie_word_embeddings", False))
     scaling = getattr(hf_config, "rope_scaling", None)
     if scaling:
         # Llama-3.1+ ships rope_scaling (rope_type "llama3" frequency
@@ -46,12 +46,18 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             f"rope_scaling {scaling!r} not supported: this stack computes "
             "plain rotary frequencies from rope_theta"
         )
-    act = getattr(hf_config, "hidden_act", "silu")
-    if act not in ("silu", "swish"):
+    act = (
+        getattr(hf_config, "hidden_activation", None)
+        or getattr(hf_config, "hidden_act", "silu")
+    )
+    if act in ("silu", "swish"):
+        our_act = "silu"
+    elif act in ("gelu_pytorch_tanh", "gelu_tanh"):
+        our_act = "gelu_tanh"
+    else:
         raise NotImplementedError(
-            f"hidden_act {act!r} not supported: the MLP hardcodes silu"
+            f"hidden_act {act!r} not supported (silu or tanh-gelu only)"
         )
-    model_type = getattr(hf_config, "model_type", "llama")
     # Qwen2 is Llama-layout plus q/k/v projection biases (no o bias).
     # HF Llama's own attention_bias puts a bias on o_proj TOO — converting
     # that would half-apply it, so it is refused below via the
@@ -59,6 +65,9 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     attn_bias = model_type == "qwen2" or bool(
         getattr(hf_config, "attention_bias", False)
     )
+    gemma = model_type == "gemma"
+    hd = int(getattr(hf_config, "head_dim", 0) or 0)
+    default_hd = hf_config.hidden_size // hf_config.num_attention_heads
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -74,6 +83,13 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # silently attend beyond what the model ever saw
         sliding_window=_window_from_hf(hf_config),
         attn_bias=attn_bias,
+        # Gemma family: GeGLU, zero-centered norm weights, tied lm_head,
+        # sqrt(d)-scaled embeddings, and an explicit head_dim
+        act=our_act,
+        norm_offset=gemma,
+        tied_embeddings=tied,
+        scale_embed=gemma,
+        head_dim_override=hd if hd and hd != default_hd else 0,
         dtype=dtype,
     )
 
@@ -82,21 +98,27 @@ def _window_from_hf(hf_config: Any) -> int:
     """Sliding window with Qwen2's gating honored.
 
     Qwen2 checkpoints SHIP sliding_window=4096 but apply it only when
-    ``use_sliding_window`` — and then only to the layers above
-    ``max_window_layers`` (the rest attend fully). A global window here
-    would silently change logits either way: applied-though-disabled for
-    default Qwen2, or applied-to-every-layer for the partial case, which
-    this stack cannot express and must refuse."""
+    ``use_sliding_window`` — and then only to layers with index >=
+    ``max_window_layers`` (the FIRST mwl layers attend fully; verified
+    against transformers' configuration_qwen2.py layer_types). So:
+    mwl >= n_layers means ZERO layers windowed (Qwen2-7B's own default),
+    mwl == 0 means every layer windowed (expressible here), and anything
+    between is layer-partial, which this stack cannot express and must
+    refuse rather than silently change logits."""
     window = int(getattr(hf_config, "sliding_window", None) or 0)
     if not getattr(hf_config, "use_sliding_window", True):
         return 0
     mwl = getattr(hf_config, "max_window_layers", None)
-    if window and mwl is not None and mwl < hf_config.num_hidden_layers:
-        raise NotImplementedError(
-            f"layer-partial sliding window (max_window_layers={mwl} < "
-            f"num_hidden_layers={hf_config.num_hidden_layers}) not "
-            "supported: this stack applies one window to every layer"
-        )
+    if window and mwl is not None:
+        if mwl >= hf_config.num_hidden_layers:
+            return 0  # no layer actually windows
+        if mwl > 0:
+            raise NotImplementedError(
+                f"layer-partial sliding window (layers >= "
+                f"max_window_layers={mwl} of "
+                f"{hf_config.num_hidden_layers} windowed) not supported: "
+                "this stack applies one window to every layer"
+            )
     return window
 
 
@@ -154,20 +176,33 @@ def params_from_hf(
         ws = [take(fmt.format(i), transpose) for i in range(cfg.n_layers)]
         return jnp.asarray(np.stack(ws), cfg.p_dtype)
 
+    embed_raw = take("model.embed_tokens.weight")
     params = {
-        "embed": jnp.asarray(take("model.embed_tokens.weight"), cfg.p_dtype),
+        "embed": jnp.asarray(embed_raw, cfg.p_dtype),
         "layers": {
             ours: stack("model.layers.{}." + suffix, transpose)
             for ours, (suffix, transpose) in _layer_map(cfg).items()
         },
         "final_norm": jnp.asarray(take("model.norm.weight"), cfg.p_dtype),
-        "lm_head": jnp.asarray(take("lm_head.weight", True), cfg.p_dtype),
     }
+    if cfg.tied_embeddings:
+        # HF state_dicts materialize the tied head as a duplicate tensor;
+        # consume it, but refuse a checkpoint whose "tied" head actually
+        # diverged from the embedding (an untied fine-tune mislabeled)
+        head = sd.pop("lm_head.weight", None)
+        if head is not None and not np.array_equal(_to_np(head), embed_raw):
+            raise ValueError(
+                "config claims tied embeddings but lm_head.weight differs "
+                "from embed_tokens.weight — convert as untied instead"
+            )
+    else:
+        params["lm_head"] = jnp.asarray(
+            take("lm_head.weight", True), cfg.p_dtype
+        )
 
-    expected = {
-        "embed": (cfg.vocab_size, cfg.d_model),
-        "lm_head": (cfg.d_model, cfg.vocab_size),
-    }
+    expected = {"embed": (cfg.vocab_size, cfg.d_model)}
+    if not cfg.tied_embeddings:
+        expected["lm_head"] = (cfg.d_model, cfg.vocab_size)
     for name, shape in expected.items():
         if params[name].shape != shape:
             raise ValueError(
@@ -208,8 +243,9 @@ def params_to_hf(params: dict, cfg: LlamaConfig) -> dict:
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np32(params["embed"]),
         "model.norm.weight": np32(params["final_norm"]),
-        "lm_head.weight": np32(np.asarray(params["lm_head"]).T),
     }
+    if "lm_head" in params:
+        sd["lm_head.weight"] = np32(np.asarray(params["lm_head"]).T)
     for ours, (theirs, transpose) in _layer_map(cfg).items():
         stacked = np.asarray(params["layers"][ours], np.float32)
         if stacked.shape[0] != cfg.n_layers:

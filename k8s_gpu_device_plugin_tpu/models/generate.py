@@ -28,11 +28,15 @@ from __future__ import annotations
 from dataclasses import dataclass
 from functools import partial
 
+import math
+
 import jax
 import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.models.llama import (
     LlamaConfig,
+    head_weights,
+    mlp_act,
     rms_norm,
     rope,
 )
@@ -257,7 +261,7 @@ def _project_qkv(x, layer, positions, cfg, sel=None):
     adapters (multi-LoRA serving)."""
     b, t, d = x.shape
     hd = cfg.head_dim
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, cfg.norm_offset)
     q = _qm_lora(h, layer, "wq", sel)
     k = _qm_lora(h, layer, "wk", sel)
     v = _qm_lora(h, layer, "wv", sel)
@@ -273,11 +277,11 @@ def _project_qkv(x, layer, positions, cfg, sel=None):
 
 def _mlp_out(x, layer, cfg, sel=None):
     """Shared decode-side MLP residual branch (dense silu or MoE mix)."""
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, cfg.norm_offset)
     if cfg.is_moe:
         return _decode_moe_mlp(h, layer, cfg)
-    gate = jax.nn.silu(
-        _qm_lora(h, layer, "w1", sel).astype(jnp.float32)
+    gate = mlp_act(
+        _qm_lora(h, layer, "w1", sel).astype(jnp.float32), cfg
     ).astype(x.dtype)
     up = _qm_lora(h, layer, "w3", sel)
     return _qm_lora(gate * up, layer, "w2", sel)
@@ -328,6 +332,8 @@ def _forward_cached(
     params = cast_params_for_compute(params, cfg)
     b, t = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.scale_embed:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
     if jnp.ndim(length) == 0:
         positions = length + jnp.arange(t, dtype=jnp.int32)
     else:  # per-slot positions (B, T) — rope handles 2D
@@ -348,12 +354,12 @@ def _forward_cached(
         body, x,
         (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
     if last_only:
         x = x[:, -1:]
     elif select_pos is not None:
         x = jax.lax.dynamic_slice_in_dim(x, select_pos, 1, axis=1)
-    logits = qhead_matmul(x, params["lm_head"], cfg.dtype)
+    logits = qhead_matmul(x, head_weights(params, cfg), cfg.dtype)
     return logits, KVCache(
         k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
     )

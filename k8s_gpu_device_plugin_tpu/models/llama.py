@@ -67,6 +67,14 @@ class LlamaConfig:
     # that is the Qwen2 layout; HF Llama's all-four attention_bias is
     # refused at conversion rather than half-applied)
     attn_bias: bool = False
+    # Gemma-family dials (all defaults = Llama behavior):
+    act: str = "silu"          # MLP gate activation: "silu" | "gelu_tanh"
+    norm_offset: bool = False  # RMSNorm scales by (1 + w), Gemma storage
+    # lm_head = embed.T: ONE leaf, so training gradients accumulate into
+    # the single tied tensor (XLA fuses the transpose; no copy)
+    tied_embeddings: bool = False
+    scale_embed: bool = False  # embeddings scaled by sqrt(d_model)
+    head_dim_override: int = 0  # 0 = d_model // n_heads (Gemma-7B: 256)
     dtype: Any = jnp.bfloat16
     # Storage dtype for parameters (None = same as ``dtype``). Set
     # jnp.float32 for mixed-precision master weights: optimizer updates
@@ -141,10 +149,18 @@ class LlamaConfig:
                 f"{self.cache_quant!r} — an unknown value would silently "
                 "run a bf16 cache"
             )
+        if self.act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"act must be 'silu' or 'gelu_tanh', got {self.act!r}"
+            )
+        if self.act != "silu" and self.n_experts > 0:
+            raise NotImplementedError(
+                "MoE expert MLPs hardcode silu (no Gemma-style MoE here)"
+            )
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def p_dtype(self) -> Any:
@@ -172,6 +188,15 @@ class LlamaConfig:
         return LlamaConfig(
             vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
             n_kv_heads=8, d_ff=28672, rope_theta=500000.0, max_seq=8192,
+        )
+
+    @staticmethod
+    def gemma_2b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=256000, d_model=2048, n_layers=18, n_heads=8,
+            n_kv_heads=1, d_ff=16384, rope_theta=10000.0, max_seq=8192,
+            norm_eps=1e-6, act="gelu_tanh", norm_offset=True,
+            tied_embeddings=True, scale_embed=True, head_dim_override=256,
         )
 
     @staticmethod
@@ -267,12 +292,21 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
             "w3": norm_init(ks[5], (L, d, cfg.d_ff), std),
             "w2": norm_init(ks[6], (L, cfg.d_ff, d), out_std),
         })
-    return {
+    out = {
         "embed": norm_init(k_embed, (cfg.vocab_size, d), std),
         "layers": layers,
-        "final_norm": jnp.ones((d,), cfg.p_dtype),
-        "lm_head": norm_init(k_head, (d, cfg.vocab_size), std),
+        "final_norm": (jnp.zeros if cfg.norm_offset else jnp.ones)(
+            (d,), cfg.p_dtype
+        ),
     }
+    if cfg.norm_offset:
+        # zero-centered storage: (1 + w) = identity at init, like ones
+        # in the plain convention
+        layers["attn_norm"] = jnp.zeros((L, d), cfg.p_dtype)
+        layers["mlp_norm"] = jnp.zeros((L, d), cfg.p_dtype)
+    if not cfg.tied_embeddings:
+        out["lm_head"] = norm_init(k_head, (d, cfg.vocab_size), std)
+    return out
 
 
 def param_specs(cfg: LlamaConfig, pp: int = 1) -> dict:
@@ -307,12 +341,14 @@ def param_specs(cfg: LlamaConfig, pp: int = 1) -> dict:
         })
     if pp > 1:
         layers = {k: P(AXIS_PP, *spec) for k, spec in layers.items()}
-    return {
+    out = {
         "embed": P(AXIS_TP, AXIS_FSDP),
         "layers": layers,
         "final_norm": P(None),
-        "lm_head": P(AXIS_FSDP, AXIS_TP),
     }
+    if not cfg.tied_embeddings:
+        out["lm_head"] = P(AXIS_FSDP, AXIS_TP)
+    return out
 
 
 def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
@@ -380,11 +416,38 @@ def _lm_head_bwd(res, g):
 _lm_head_matmul.defvjp(_lm_head_fwd, _lm_head_bwd)
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
+    """RMSNorm; ``offset`` scales by (1 + w) — Gemma checkpoints store
+    the weight zero-centered."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (normed * w).astype(x.dtype)
+
+
+def mlp_act(x: jax.Array, cfg: "LlamaConfig") -> jax.Array:
+    """The gated-MLP activation: Llama silu or Gemma tanh-approx gelu."""
+    if cfg.act == "silu":
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def head_weights(params: dict, cfg: "LlamaConfig"):
+    """The lm_head operand: the dedicated leaf when present (incl. the
+    int8/int4 dict leaves quantized serving installs), else the
+    transposed embedding table for tied-embedding configs — ONE leaf, so
+    training gradients flow into the single tied tensor and XLA fuses
+    the transpose into the matmul."""
+    if "lm_head" in params:
+        return params["lm_head"]
+    if cfg.tied_embeddings:
+        return params["embed"].T
+    raise KeyError("params has no lm_head and cfg is not tied_embeddings")
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -447,7 +510,7 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
     else:
         mm = jnp.matmul
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, cfg.norm_offset)
     q, k, v = mm(h, layer["wq"]), mm(h, layer["wk"]), mm(h, layer["wv"])
     if cfg.attn_bias:
         q = q + layer["bq"]
@@ -468,13 +531,13 @@ def _block(x, layer, cfg: LlamaConfig, positions, mesh):
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + constrain(mm(attn, layer["wo"]), P(BATCH, AXIS_SP, None))
 
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, cfg.norm_offset)
     if cfg.is_moe:
         from k8s_gpu_device_plugin_tpu.models.moe import moe_mlp
 
         ff_out, aux = moe_mlp(h, layer, cfg)
     else:
-        gate = jax.nn.silu(mm(h, layer["w1"]).astype(jnp.float32)).astype(x.dtype)
+        gate = mlp_act(mm(h, layer["w1"]).astype(jnp.float32), cfg).astype(x.dtype)
         up = mm(h, layer["w3"])
         ff = constrain(gate * up, P(BATCH, AXIS_SP, AXIS_TP))
         ff_out = constrain(mm(ff, layer["w2"]), P(BATCH, AXIS_SP, None))
@@ -500,6 +563,8 @@ def forward_with_aux(
     # nothing extra
     params = cast_params_for_compute(params, cfg)
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.scale_embed:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
     x = constrain(x, P(BATCH, AXIS_SP, None))
     positions = jnp.arange(s, dtype=jnp.int32)
 
@@ -556,10 +621,10 @@ def forward_with_aux(
 
         x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
         aux = {k: jnp.sum(v) for k, v in aux_stacked.items()}
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
     if return_hidden:
         return constrain(x, P(BATCH, AXIS_SP, None)), aux
-    logits = _lm_head_matmul(x, params["lm_head"].astype(cfg.dtype))
+    logits = _lm_head_matmul(x, head_weights(params, cfg).astype(cfg.dtype))
     return constrain(logits, P(BATCH, AXIS_SP, AXIS_TP)), aux
 
 

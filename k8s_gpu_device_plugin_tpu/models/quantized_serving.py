@@ -37,6 +37,15 @@ _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 _MOE_QUANT_LEAVES = ("moe_w1", "moe_w3", "moe_w2")
 
 
+def _head_operand(params: dict):
+    """The float head to quantize: the dedicated leaf, or embed.T for
+    tied-embedding pytrees (serving-only materialization — the embedding
+    gather keeps the float table; no gradient tying to preserve). A
+    pytree without lm_head is by definition tied here; untied configs
+    fail loudly at the first forward (llama.head_weights raises)."""
+    return params["lm_head"] if "lm_head" in params else params["embed"].T
+
+
 def quantize_weights_int8(params: dict) -> dict:
     """Float pytree -> serving pytree with int8 projection/MLP weights.
 
@@ -53,7 +62,7 @@ def quantize_weights_int8(params: dict) -> dict:
             layers[name] = {"q": q, "s": s}
         else:
             layers[name] = w
-    q, s = quantize_int8(params["lm_head"], axis=0)
+    q, s = quantize_int8(_head_operand(params), axis=0)
     return {
         **params,
         "layers": layers,
@@ -149,7 +158,7 @@ def quantize_weights_int4(params: dict, group: int = INT4_GROUP) -> dict:
             layers[name] = {"q4": q, "s": s}
         else:
             layers[name] = w
-    q, s = quantize_int4_grouped(params["lm_head"], group=group)
+    q, s = quantize_int4_grouped(_head_operand(params), group=group)
     return {
         **params,
         "layers": layers,

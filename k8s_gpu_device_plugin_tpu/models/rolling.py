@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -37,6 +39,7 @@ from k8s_gpu_device_plugin_tpu.models.generate import (
 from k8s_gpu_device_plugin_tpu.models.llama import (
     LlamaConfig,
     cast_params_for_compute,
+    head_weights,
 )
 from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
     qhead_matmul,
@@ -135,6 +138,8 @@ def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
     ((B, V) f32 logits, updated ring)."""
     params = cast_params_for_compute(params, cfg)
     x = params["embed"].astype(cfg.dtype)[tok[:, None]]
+    if cfg.scale_embed:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
 
     # None scale planes are empty pytree leaves — lax.scan carries them
     # through untouched, so the bf16 and int8 rings share one body (the
@@ -151,8 +156,8 @@ def _ring_forward(params, tok, ring: KVCache, pos, cfg: LlamaConfig):
         (params["layers"], ring.k, ring.v, ring.k_scale, ring.v_scale),
     )
     new_ring = KVCache(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = qhead_matmul(x[:, -1], params["lm_head"], cfg.dtype)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
+    logits = qhead_matmul(x[:, -1], head_weights(params, cfg), cfg.dtype)
     return logits, new_ring
 
 

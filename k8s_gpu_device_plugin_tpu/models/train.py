@@ -19,6 +19,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_gpu_device_plugin_tpu.models.llama import (
+    head_weights,
     LlamaConfig,
     forward_with_aux,
     init_params,
@@ -116,7 +117,8 @@ def loss_fn(
             params, batch["inputs"], cfg, mesh, return_hidden=True
         )
         loss = fused_linear_cross_entropy(
-            hidden, params["lm_head"].astype(cfg.dtype), batch["targets"],
+            hidden, head_weights(params, cfg).astype(cfg.dtype),
+            batch["targets"],
             z_loss_weight=Z_LOSS_WEIGHT,
         )
         accuracy = jnp.float32(-1.0)

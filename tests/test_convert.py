@@ -75,10 +75,20 @@ def test_config_mapping():
     assert cfg.rope_theta == 10000.0 and cfg.norm_eps == 1e-5
 
 
-def test_tied_embeddings_rejected():
-    _, hf_cfg = _tiny_hf(tie=True)
-    with pytest.raises(NotImplementedError, match="tied"):
-        config_from_hf(hf_cfg)
+def test_tied_embeddings_accepted_and_verified():
+    """Tied checkpoints convert to ONE leaf (family-agnostic since the
+    Gemma work); a checkpoint whose 'tied' head actually diverged from
+    the embedding is refused instead of silently served wrong."""
+    hf, hf_cfg = _tiny_hf(tie=True)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.tied_embeddings
+    params = params_from_hf(hf.state_dict(), cfg)
+    assert "lm_head" not in params
+
+    sd = {k: v.clone() for k, v in hf.state_dict().items()}
+    sd["lm_head.weight"] = sd["lm_head.weight"] + 1.0  # untied fine-tune
+    with pytest.raises(ValueError, match="differs"):
+        params_from_hf(sd, cfg)
 
 
 def test_missing_weight_raises():
@@ -303,8 +313,115 @@ def test_qwen2_sliding_window_gating():
     with pytest.raises(NotImplementedError, match="layer-partial"):
         config_from_hf(partial)
 
-    full = transformers.Qwen2Config(
+    # mwl == n_layers: HF windows layers with idx >= mwl, i.e. NONE —
+    # Qwen2-7B's own default shape even with the flag on
+    none_windowed = transformers.Qwen2Config(
         **base, use_sliding_window=True, sliding_window=4096,
         max_window_layers=4,
     )
-    assert config_from_hf(full).sliding_window == 4096
+    assert config_from_hf(none_windowed).sliding_window == 0
+
+    # mwl == 0: every layer windowed — expressible here
+    all_windowed = transformers.Qwen2Config(
+        **base, use_sliding_window=True, sliding_window=4096,
+        max_window_layers=0,
+    )
+    assert config_from_hf(all_windowed).sliding_window == 4096
+
+
+def _tiny_gemma(vocab=64):
+    cfg = transformers.GemmaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-6,
+        max_position_embeddings=128,
+    )
+    torch.manual_seed(3)
+    return transformers.GemmaForCausalLM(cfg).eval(), cfg
+
+
+def test_gemma_forward_matches_transformers():
+    """Gemma family: GeGLU + (1+w) RMSNorm + sqrt(d)-scaled embeddings +
+    tied lm_head. Logits parity against transformers pins all four at
+    once — any one dropped shifts every logit."""
+    hf, hf_cfg = _tiny_gemma()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.tied_embeddings and cfg.norm_offset and cfg.scale_embed
+    assert cfg.act == "gelu_tanh"
+    params = params_from_hf(hf.state_dict(), cfg)
+    assert "lm_head" not in params  # ONE tied leaf
+
+    tokens = np.array([[3, 17, 42, 7, 23, 11, 60, 2]], np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=2e-3)
+
+
+def test_gemma_generate_matches_transformers_greedy():
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+
+    hf, hf_cfg = _tiny_gemma()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = params_from_hf(hf.state_dict(), cfg)
+
+    prompt = np.array([[5, 9, 33, 12]], np.int64)
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, prompt.shape[1]:]
+    got = np.asarray(
+        generate(params, jnp.asarray(prompt, jnp.int32), cfg, max_new=8)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_gemma_tied_training_grads_flow_to_one_leaf(tmp_path):
+    """The tied head is the SAME tensor as the embedding: a train step
+    must move `embed` with gradient contributions from both roles, and
+    there is no separate lm_head leaf to drift."""
+    from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+    from k8s_gpu_device_plugin_tpu.models.train import (
+        init_train_state, make_optimizer, make_train_step, synthetic_batch,
+    )
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = LlamaConfig.tiny(
+        n_layers=2, dtype=jnp.float32, tied_embeddings=True,
+        scale_embed=True, norm_offset=True, act="gelu_tanh",
+    )
+    mesh = make_mesh(MeshSpec(tp=2), jax.devices()[:2])
+    opt = make_optimizer(total_steps=2, warmup_steps=0)
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    assert "lm_head" not in state["params"]
+    before = np.asarray(state["params"]["embed"], np.float32).copy()
+    step = make_train_step(cfg, mesh, opt)
+    batch = synthetic_batch(jax.random.key(1), cfg, 4, 16, mesh)
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    after = np.asarray(state["params"]["embed"], np.float32)
+    assert not np.allclose(before, after)  # tied grads actually flow
+
+
+
+def test_tied_embeddings_generic_families():
+    """Tied embeddings are family-agnostic: a tied Qwen2 (the 0.5B/1.5B
+    ship this) converts with ONE tied leaf and matches transformers."""
+    cfg_hf = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=True, max_position_embeddings=128,
+    )
+    torch.manual_seed(5)
+    hf = transformers.Qwen2ForCausalLM(cfg_hf).eval()
+    cfg = config_from_hf(cfg_hf, dtype=jnp.float32)
+    assert cfg.tied_embeddings and cfg.attn_bias
+    params = params_from_hf(hf.state_dict(), cfg)
+    assert "lm_head" not in params
+
+    tokens = np.array([[3, 17, 42, 7]], np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
