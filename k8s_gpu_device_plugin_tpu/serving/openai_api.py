@@ -393,14 +393,15 @@ class _OpenAIRoutes:
                     raise ValueError("echo does not support streaming")
                 if c["adapter"] != -1:
                     raise ValueError("echo scores the base model only")
-                # the scorer's bucket cap bounds EVERY echo request, with
-                # or without logprobs — echo must not be the one API path
-                # with no prompt-size validation at all
-                cap = self._server.scorer.buckets[-1]
+                # the scorer's cap bounds EVERY echo request, with or
+                # without logprobs — echo must not be the one API path
+                # with no prompt-size validation at all (long prompts
+                # past the bucket cap take the scorer's chunked path)
+                cap = self._server.scorer.max_len
                 if len(prompt) > cap:
                     raise ValueError(
                         f"prompt of {len(prompt)} tokens exceeds the "
-                        f"scoring bucket cap {cap}"
+                        f"scoring cap {cap}"
                     )
             else:
                 self._budget(c, prompt, default=16)  # OpenAI legacy default

@@ -69,16 +69,58 @@ def _score_one(params, tokens, length, cfg: LlamaConfig):
     )
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _score_chunk(params, tokens, targets, cache, length, cfg: LlamaConfig):
+    """One chunk of the long-prompt path: run C tokens at absolute
+    position ``length`` through the cached forward (full per-position
+    logits), score ``targets`` (the chunk shifted by one — the last
+    position's target is the NEXT chunk's first token), return
+    (scores (C,), top_lps (C, K), top_ids (C, K), new cache). Entry i
+    here scores the token at absolute position length + i + 1."""
+    from k8s_gpu_device_plugin_tpu.models.generate import _forward_cached
+
+    logits, cache = _forward_cached(params, tokens, cache, length, cfg)
+    logprobs = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+    scores = jnp.take_along_axis(logprobs, targets[0][:, None], axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(logprobs, TOP_K)
+    return scores, top_lps, top_ids, cache
+
+
 class Scorer(BucketedForward):
     """Bucketed, thread-safe prompt scorer over the serving params
     (bucket/warmup/lock discipline shared with Embedder via
-    serving/bucketed.py)."""
+    serving/bucketed.py).
+
+    Prompts up to ``buckets[-1]`` take the single-forward path; longer
+    ones (to ``max_len``) run the CHUNKED path — fixed-size chunks
+    through the KV-cached forward, one compile total (static chunk and
+    cache shapes), teacher-forced across chunk boundaries. Both compiled
+    at construction, so executor threads never compile."""
 
     def __init__(self, params, cfg: LlamaConfig,
                  buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+                 max_len: int = 4096, chunk: int = 512,
                  warmup: bool = True):
+        self.max_len = max(max_len, max(buckets))
+        self.chunk = chunk
         super().__init__(_score_one, params, cfg, buckets,
                          kind="scoring", warmup=warmup)
+
+    def warmup(self) -> None:
+        super().warmup()
+        if self.max_len > self.buckets[-1]:
+            from k8s_gpu_device_plugin_tpu.models.generate import KVCache
+
+            z = jnp.zeros((1, self.chunk), jnp.int32)
+            cache = KVCache.init(self.cfg, 1, self._cache_len())
+            jax.block_until_ready(_score_chunk(
+                self.params, z, z, cache, jnp.int32(0), self.cfg
+            ))
+
+    def _cache_len(self) -> int:
+        # one static cache shape -> one chunk compile, shared by every
+        # long prompt regardless of its length
+        return -(-self.max_len // self.chunk) * self.chunk
 
     def score(self, ids: list[int]) -> list[float | None]:
         """Per-token logprobs for ``ids``; index 0 is None (no context)."""
@@ -90,8 +132,10 @@ class Scorer(BucketedForward):
         """(per-token logprobs, top-K alternative logprobs (n, K),
         top-K alternative ids (n, K)); row 0 of the top arrays is
         meaningless (no context) — callers emit null there."""
-        scores, top_lps, top_ids = self.dispatch(ids)
         n = len(ids)
+        if n > self.buckets[-1]:
+            return self._score_long(ids)
+        scores, top_lps, top_ids = self.dispatch(ids)
         lps = [None] + [
             float(v) for v in np.asarray(scores, np.float32)[1:n]
         ]
@@ -100,3 +144,42 @@ class Scorer(BucketedForward):
             np.asarray(top_lps, np.float32)[:n],
             np.asarray(top_ids, np.int32)[:n],
         )
+
+    def _score_long(self, ids: list[int]):
+        from k8s_gpu_device_plugin_tpu.models.generate import KVCache
+
+        n = len(ids)
+        if n > self.max_len:
+            raise ValueError(
+                f"input of {n} tokens exceeds the {self.kind} cap "
+                f"{self.max_len}"
+            )
+        C = self.chunk
+        n_chunks = -(-n // C)
+        padded = list(ids) + [0] * (n_chunks * C - n)
+        # targets are the sequence shifted one left: entry i of chunk c
+        # scores absolute position c*C + i + 1
+        shifted = padded[1:] + [0]
+        scores = np.zeros((n_chunks * C,), np.float32)
+        top_lps = np.zeros((n_chunks * C, TOP_K), np.float32)
+        top_ids = np.zeros((n_chunks * C, TOP_K), np.int32)
+        with self._lock:
+            cache = KVCache.init(self.cfg, 1, self._cache_len())
+            for c in range(n_chunks):
+                toks = jnp.asarray([padded[c * C:(c + 1) * C]], jnp.int32)
+                tgts = jnp.asarray([shifted[c * C:(c + 1) * C]], jnp.int32)
+                s, tl, ti, cache = _score_chunk(
+                    self.params, toks, tgts, cache, jnp.int32(c * C),
+                    self.cfg,
+                )
+                scores[c * C:(c + 1) * C] = np.asarray(s, np.float32)
+                top_lps[c * C:(c + 1) * C] = np.asarray(tl, np.float32)
+                top_ids[c * C:(c + 1) * C] = np.asarray(ti, np.int32)
+        # entry i of `scores` holds the score OF token i+1; re-index to
+        # the score_full convention (row i = token i; row 0 = no context)
+        lps = [None] + [float(v) for v in scores[:n - 1]]
+        out_lps = np.zeros((n, TOP_K), np.float32)
+        out_ids = np.zeros((n, TOP_K), np.int32)
+        out_lps[1:] = top_lps[:n - 1]
+        out_ids[1:] = top_ids[:n - 1]
+        return lps, out_lps, out_ids

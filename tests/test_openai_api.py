@@ -519,7 +519,7 @@ def test_echo_text_tokens_concatenate_and_cap(setup):
     from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
 
     tok = ByteTokenizer()
-    scorer = Scorer(params, cfg, buckets=(16,))
+    scorer = Scorer(params, cfg, buckets=(16,), max_len=16)
     text_in = "héllo"  # é = 2 bytes = 2 byte-level tokens
 
     async def body(session, base):
@@ -541,7 +541,7 @@ def test_echo_text_tokens_concatenate_and_cap(setup):
             "prompt": "x" * 17, "echo": True, "max_tokens": 0,
         })
         assert r2.status == 400
-        assert "bucket cap" in (await r2.json())["error"]["message"]
+        assert "cap" in (await r2.json())["error"]["message"]
 
     run(_with_server(setup, body, tokenizer=tok, scorer=scorer))
 
@@ -601,3 +601,44 @@ def test_echo_top_logprobs_alternatives(setup):
         assert "between 0 and 5" in (await r4.json())["error"]["message"]
 
     run(_with_server(setup, body, scorer=scorer))
+
+
+def test_scorer_chunked_long_prompt_matches_bucketed(setup):
+    """Prompts past the bucket cap score through the KV-cached CHUNKED
+    path; the result must equal the single-forward path bit-for-bit in
+    intent (same logprobs to f32 tolerance), including across chunk
+    boundaries and in the top-K alternatives."""
+    # f32: the chunked (cached) and single-forward paths decompose the
+    # attention differently, so bf16 rounding separates them by ~1e-3;
+    # at f32 they agree to float tolerance, which is the real assertion
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(21), cfg)
+    from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
+
+    prompt = _prompt(13, 40, cfg)
+    chunked = Scorer(params, cfg, buckets=(16,), max_len=48, chunk=16)
+    wide = Scorer(params, cfg, buckets=(64,), max_len=64)  # no chunk path
+    lps_c, top_lps_c, top_ids_c = chunked.score_full(prompt)
+    lps_w, top_lps_w, top_ids_w = wide.score_full(prompt)
+    assert lps_c[0] is None and len(lps_c) == len(prompt)
+    np.testing.assert_allclose(lps_c[1:], lps_w[1:], rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(top_ids_c[1:], top_ids_w[1:])
+    np.testing.assert_allclose(
+        top_lps_c[1:], top_lps_w[1:], rtol=2e-5, atol=2e-5
+    )
+    # the cap is max_len on the chunked path
+    with pytest.raises(ValueError, match="cap 48"):
+        chunked.score_full(_prompt(14, 49, cfg))
+
+    async def body(session, base):
+        # an over-bucket (but under-cap) prompt serves through echo
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "echo": True, "max_tokens": 0, "logprobs": 1,
+        })
+        assert r.status == 200, await r.text()
+        ch = (await r.json())["choices"][0]
+        np.testing.assert_allclose(
+            ch["logprobs"]["token_logprobs"][1:], lps_c[1:], rtol=1e-5
+        )
+
+    run(_with_server(setup, body, scorer=chunked))
