@@ -141,9 +141,11 @@ class _OpenAIRoutes:
             return self._server.tokenizer.encode(prompt)
         if (
             isinstance(prompt, list) and prompt
-            and all(isinstance(t, int) for t in prompt)
+            and all(type(t) is int for t in prompt)
         ):
-            return list(prompt)
+            return _check_token_ids(
+                prompt, self._server.engine.cb.cfg.vocab_size
+            )
         raise ValueError(
             "prompt must be a non-empty string or list of token ids "
             "(batched prompt lists are not supported)"
@@ -324,14 +326,7 @@ class _OpenAIRoutes:
             return type(t) is int
 
         def check(ids: list[int]) -> list[int]:
-            for t in ids:
-                if not (0 <= t < vocab):
-                    # an out-of-range id would silently clamp/wrap in the
-                    # embedding gather and return a wrong vector
-                    raise ValueError(
-                        f"token id {t} outside vocab [0, {vocab})"
-                    )
-            return list(ids)
+            return _check_token_ids(ids, vocab)
 
         if isinstance(raw, str) and raw:
             return [encode(raw)]
@@ -696,6 +691,18 @@ class _OpenAIRoutes:
             raise
         await resp.write_eof()
         return resp
+
+
+def _check_token_ids(ids: list, vocab: int) -> list[int]:
+    """The one token-id discipline for both prompt and embedding inputs:
+    bools are int subclasses but must not decode as 1/0, and an
+    out-of-vocab id would silently clamp in the embedding gather."""
+    for t in ids:
+        if type(t) is not int:
+            raise ValueError("token ids must be plain ints")
+        if not (0 <= t < vocab):
+            raise ValueError(f"token id {t} outside vocab [0, {vocab})")
+    return list(ids)
 
 
 def _oai_error(message: str, status: int, code: str | None = None) -> web.Response:

@@ -101,7 +101,14 @@ class Scorer(BucketedForward):
                  buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
                  max_len: int = 4096, chunk: int = 512,
                  warmup: bool = True):
-        self.max_len = max(max_len, max(buckets))
+        # a cap below the largest bucket trims the buckets instead of
+        # being silently raised — "longest scorable prompt" means it
+        buckets = tuple(b for b in sorted(buckets) if b <= max_len)
+        if not buckets:
+            raise ValueError(
+                f"max_len {max_len} is below the smallest scoring bucket"
+            )
+        self.max_len = max_len
         self.chunk = chunk
         super().__init__(_score_one, params, cfg, buckets,
                          kind="scoring", warmup=warmup)

@@ -740,6 +740,10 @@ def _main(argv: list[str] | None = None) -> int:
                         help="enable completions echo=true + max_tokens=0 "
                         "prompt scoring (teacher-forced logprobs; base "
                         "model only, bf16 weights)")
+    parser.add_argument("--scoringMaxLen", type=int, default=4096,
+                        help="longest scorable prompt; past the largest "
+                        "bucket the scorer chunks through the KV-cached "
+                        "forward (one extra compile at startup)")
     parser.add_argument("--loraAdapters", default="",
                         help="multi-LoRA serving: name=ckptdir[:alpha=X]"
                         ",... — requests select by name ('adapter' field "
@@ -831,7 +835,7 @@ def _main(argv: list[str] | None = None) -> int:
             )
         from k8s_gpu_device_plugin_tpu.serving.scoring import Scorer
 
-        scorer = Scorer(params, cfg)
+        scorer = Scorer(params, cfg, max_len=args.scoringMaxLen)
 
     metrics = ServingMetrics()
     batcher = None

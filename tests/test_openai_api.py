@@ -642,3 +642,23 @@ def test_scorer_chunked_long_prompt_matches_bucketed(setup):
         )
 
     run(_with_server(setup, body, scorer=chunked))
+
+
+def test_prompt_ids_validate_vocab_and_bools(setup):
+    """Token-id prompts get the same discipline as /v1/embeddings: ids
+    outside the vocab are a 400 (the embedding gather would silently
+    clamp and generate from a wrong vector), and bools are not ids."""
+    cfg, _ = setup
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": [1, cfg.vocab_size + 7], "max_tokens": 2,
+        })
+        assert r.status == 400
+        assert "outside vocab" in (await r.json())["error"]["message"]
+        r2 = await session.post(f"{base}/v1/completions", json={
+            "prompt": [True, False], "max_tokens": 2,
+        })
+        assert r2.status == 400
+
+    run(_with_server(setup, body))
