@@ -230,14 +230,15 @@ class _OpenAIRoutes:
     def _submit(self, prompt: list[int], c: dict) -> list[tuple[int, asyncio.Queue]]:
         # n>1 with a seed derives a per-choice seed (seed+i): the whole
         # response stays reproducible while the n samples stay distinct —
-        # the same seed for every choice would return n identical copies
+        # the same seed for every choice would return n identical copies.
+        # best_of > n samples the extras; _respond ranks and keeps n.
         return [
             self._server.engine.submit(
                 prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"],
                 adapter=c["adapter"], logit_bias=c["logit_bias"],
                 seed=None if c["seed"] is None else (c["seed"] + i) % 2**31,
             )
-            for i in range(c["n"])
+            for i in range(c.get("best_of") or c["n"])
         ]
 
     @staticmethod
@@ -369,6 +370,19 @@ class _OpenAIRoutes:
             # (scoring.TOP_K compiles exactly 5 alternatives)
             if want_logprobs and not (0 <= int(lp) <= 5):
                 raise ValueError("logprobs must be between 0 and 5")
+            best_of = body.get("best_of")
+            if best_of is not None:
+                best_of = int(best_of)
+                if not (c["n"] <= best_of <= 8):
+                    raise ValueError(
+                        "best_of must be >= n and <= 8 (each candidate "
+                        "occupies a decode slot)"
+                    )
+                if c["stream"] and best_of > c["n"]:
+                    raise ValueError("streaming requires best_of == n")
+                if echo:
+                    raise ValueError("echo does not support best_of")
+                c["best_of"] = best_of
             if echo:
                 # the lm-eval loglikelihood contract: echo back the prompt
                 # with its own teacher-forced logprobs, generate nothing
@@ -564,17 +578,32 @@ class _OpenAIRoutes:
             for eid, _ in subs:
                 self._server.engine.cancel(eid)
             raise
-        choices = []
-        completion_tokens = 0
-        for i, (toks, lps) in enumerate(drained):
+        cands = []
+        completion_tokens = 0  # usage counts EVERYTHING sampled (best_of too)
+        for toks, lps in drained:
             # OpenAI: the matched stop sequence is never in the output
             kept = trim_stop_suffix(toks, c["stop"])
-            lps = lps[:len(kept)]
+            klps = lps[:len(kept)]
             completion_tokens += len(kept)
             finish = (
                 "stop" if len(kept) < len(toks)
                 else self._finish_reason(len(toks), c["max_new"])
             )
+            cands.append((kept, klps, finish))
+        if len(cands) > c["n"]:
+            # best_of ranking: highest mean token logprob (OpenAI's
+            # "highest log probability per token"), stable on ties. A
+            # fully-stop-trimmed candidate has no tokens and no mean —
+            # mean 0.0 would be the MAXIMUM (logprobs are <= 0), so empty
+            # candidates rank last, not first.
+            cands.sort(
+                key=lambda t: (
+                    -(sum(t[1]) / len(t[1])) if t[1] else float("inf")
+                )
+            )
+            cands = cands[:c["n"]]
+        choices = []
+        for i, (kept, lps, finish) in enumerate(cands):
             text = self._decode(kept)
             choice: dict = {"index": i, "finish_reason": finish}
             if chat:

@@ -662,3 +662,43 @@ def test_prompt_ids_validate_vocab_and_bools(setup):
         assert r2.status == 400
 
     run(_with_server(setup, body))
+
+
+def test_best_of_ranks_by_mean_logprob(setup):
+    """best_of samples extra candidates and returns the n with the
+    highest mean token logprob; usage bills every sampled token, and
+    validation rejects best_of < n, > 8, streaming, and echo."""
+    cfg, _ = setup
+    prompt = _prompt(4, 5, cfg)
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "max_tokens": 4, "n": 2, "best_of": 4,
+            "temperature": 1.2, "logprobs": 0, "seed": 11,
+        })
+        assert r.status == 200, await r.text()
+        p = await r.json()
+        assert len(p["choices"]) == 2
+        assert [c["index"] for c in p["choices"]] == [0, 1]
+        # every sampled token billed: 4 candidates x 4 tokens
+        assert p["usage"]["completion_tokens"] == 16
+        # returned pair is ranked: mean logprob of choice 0 >= choice 1
+        means = [
+            sum(c["logprobs"]["token_logprobs"]) /
+            len(c["logprobs"]["token_logprobs"])
+            for c in p["choices"]
+        ]
+        assert means[0] >= means[1]
+
+        for bad in (
+            {"best_of": 1, "n": 2},
+            {"best_of": 9},
+            {"best_of": 2, "stream": True},
+            {"best_of": 2, "echo": True, "max_tokens": 0},
+        ):
+            r2 = await session.post(f"{base}/v1/completions", json={
+                "prompt": prompt, "max_tokens": 4, **bad,
+            })
+            assert r2.status == 400, (bad, await r2.text())
+
+    run(_with_server(setup, body))
