@@ -37,4 +37,11 @@ bench:
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
-.PHONY: all native native-test proto san-test ci test bench clean
+.PHONY: all native native-test proto san-test ci test bench clean watch
+
+# unattended hardware-window capture: probe on a loop, drain the harvest
+# queue the moment the chip answers (tools/watchdog.py; stop with
+# `touch .harvest_stop`)
+watch:
+	nohup python tools/watchdog.py >> .hwwatch.log 2>&1 &
+	@echo "watchdog started; tail -f .hwwatch.log"

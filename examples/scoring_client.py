@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Stdlib-only loglikelihood client for the prompt-scoring endpoint.
+
+Start the pod with scoring enabled::
+
+    python -m k8s_gpu_device_plugin_tpu.serving.server \
+        --preset tiny --tokenizer byte --scoring
+
+then ask for the probability the served model assigns to a continuation
+given a context — the exact lm-eval-harness ``loglikelihood`` recipe:
+one request with ``echo=true, max_tokens=0, logprobs=1``, sum the
+``token_logprobs`` over the continuation's tokens, and read ``is_greedy``
+off whether each continuation token equals the model's argmax
+(``top_logprobs`` entry 0).
+
+Usage:
+    python examples/scoring_client.py --base http://localhost:8000 \
+        --context "The capital of France is" --continuation " Paris"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base", default="http://localhost:8000")
+    ap.add_argument("--context", required=True)
+    ap.add_argument("--continuation", required=True)
+    args = ap.parse_args()
+
+    body = {
+        "prompt": args.context + args.continuation,
+        "echo": True,
+        "max_tokens": 0,
+        "logprobs": 1,
+    }
+    req = urllib.request.Request(
+        f"{args.base}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        payload = json.load(urllib.request.urlopen(req, timeout=300))
+    except urllib.error.HTTPError as e:
+        print(f"HTTP {e.code}: {e.read().decode()[:300]}", file=sys.stderr)
+        return 1
+
+    lp = payload["choices"][0]["logprobs"]
+    # find the continuation's token span via text offsets: the first
+    # token whose offset reaches the context's character length
+    cut = len(args.context)
+    start = next(
+        (i for i, off in enumerate(lp["text_offset"]) if off >= cut),
+        len(lp["text_offset"]),
+    )
+    cont_lps = lp["token_logprobs"][start:]
+    total = sum(v for v in cont_lps if v is not None)
+    greedy = all(
+        tok in top and abs(top[tok] - lp["token_logprobs"][start + i]) < 1e-6
+        for i, (tok, top) in enumerate(
+            zip(lp["tokens"][start:], lp["top_logprobs"][start:])
+        )
+        if top is not None
+    )
+    print(json.dumps({
+        "continuation_tokens": lp["tokens"][start:],
+        "loglikelihood": round(total, 6),
+        "is_greedy": greedy,
+    }, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
