@@ -32,6 +32,9 @@ def main() -> int:
     ap.add_argument("--context", required=True)
     ap.add_argument("--continuation", required=True)
     args = ap.parse_args()
+    if not args.continuation:
+        print("empty --continuation scores nothing", file=sys.stderr)
+        return 2
 
     body = {
         "prompt": args.context + args.continuation,
@@ -40,7 +43,7 @@ def main() -> int:
         "logprobs": 1,
     }
     req = urllib.request.Request(
-        f"{args.base}/v1/completions",
+        f"{args.base.rstrip('/')}/v1/completions",
         data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -52,20 +55,36 @@ def main() -> int:
 
     lp = payload["choices"][0]["logprobs"]
     # find the continuation's token span via text offsets: the first
-    # token whose offset reaches the context's character length
+    # token whose offset reaches the context's character length. lm-eval
+    # proper splits at a TOKEN index (it tokenizes context and
+    # continuation separately); a character split can land inside a
+    # subword token that straddles the boundary — detect and warn so a
+    # silently-short sum never reads as a score.
     cut = len(args.context)
     start = next(
         (i for i, off in enumerate(lp["text_offset"]) if off >= cut),
         len(lp["text_offset"]),
     )
+    if start == len(lp["text_offset"]):
+        print("continuation produced no scored tokens", file=sys.stderr)
+        return 1
+    if lp["text_offset"][start] != cut:
+        print(
+            f"warning: token at offset {lp['text_offset'][start]} "
+            f"straddles the context/continuation boundary ({cut}); "
+            "the straddling token's mass is attributed to the context",
+            file=sys.stderr,
+        )
     cont_lps = lp["token_logprobs"][start:]
     total = sum(v for v in cont_lps if v is not None)
+    # is_greedy by VALUE, not by token-string match: top_logprobs keys
+    # are single-id decodes (U+FFFD for partial UTF-8), while tokens are
+    # streaming-detokenizer pieces — the strings need not agree even
+    # when the token IS the argmax. The argmax check that always works:
+    # the token's own logprob equals the best alternative's.
     greedy = all(
-        tok in top and abs(top[tok] - lp["token_logprobs"][start + i]) < 1e-6
-        for i, (tok, top) in enumerate(
-            zip(lp["tokens"][start:], lp["top_logprobs"][start:])
-        )
-        if top is not None
+        v is not None and top and v >= max(top.values()) - 1e-6
+        for v, top in zip(cont_lps, lp["top_logprobs"][start:])
     )
     print(json.dumps({
         "continuation_tokens": lp["tokens"][start:],
