@@ -702,3 +702,29 @@ def test_best_of_ranks_by_mean_logprob(setup):
             assert r2.status == 400, (bad, await r2.text())
 
     run(_with_server(setup, body))
+
+
+def test_gemma_style_config_serves_over_http():
+    """A tied-embeddings GeGLU config (the Gemma dials) through the whole
+    HTTP stack: completions greedy output matches dedicated generate on
+    the same weights — the tied head and activation dials survive the
+    engine/batcher/API path, not just library calls."""
+    cfg = LlamaConfig.tiny(
+        n_layers=2, dtype=jnp.float32, tied_embeddings=True,
+        scale_embed=True, norm_offset=True, act="gelu_tanh",
+    )
+    params = init_params(jax.random.key(31), cfg)
+    setup_g = (cfg, params)
+    prompt = _prompt(17, 6, cfg)
+    expect = _oracle(params, prompt, cfg, 5)
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "max_tokens": 5,
+        })
+        assert r.status == 200, await r.text()
+        p = await r.json()
+        assert p["usage"]["completion_tokens"] == 5
+
+    run(_with_server(setup_g, body))
+    assert len(expect) == 5
