@@ -715,8 +715,9 @@ def test_gemma_style_config_serves_over_http():
     )
     params = init_params(jax.random.key(31), cfg)
     setup_g = (cfg, params)
+    tok = ByteTokenizer()
     prompt = _prompt(17, 6, cfg)
-    expect = _oracle(params, prompt, cfg, 5)
+    expect_text = tok.decode(_oracle(params, prompt, cfg, 5))
 
     async def body(session, base):
         r = await session.post(f"{base}/v1/completions", json={
@@ -725,6 +726,7 @@ def test_gemma_style_config_serves_over_http():
         assert r.status == 200, await r.text()
         p = await r.json()
         assert p["usage"]["completion_tokens"] == 5
+        # the actual parity claim: the served greedy text IS generate()'s
+        assert p["choices"][0]["text"] == expect_text
 
-    run(_with_server(setup_g, body))
-    assert len(expect) == 5
+    run(_with_server(setup_g, body, tokenizer=tok))
