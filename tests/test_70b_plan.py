@@ -155,3 +155,20 @@ def test_pp_divides_resident_layers():
     # only over (tp, fsdp)=16, so per-chip params grow by ~their half
     assert pp.params == pytest.approx(base.params, rel=0.05)
     assert pp.activations == pytest.approx(base.activations, rel=0.01)
+
+
+def test_8b_fits_v5p16_north_star_shape():
+    """BASELINE #4's shape: Llama-3-8B on v5p-16 (fsdp4 x tp4, tp
+    within-host). Batch 16 x 8192 fits with room (50.7 GiB of 95); 32
+    needs a cheaper remat policy — the plan names the working points
+    before the slice exists."""
+    cfg = LlamaConfig.llama3_8b()
+    spec = MeshSpec(fsdp=4, tp=4)
+    assert memory_plan(cfg, spec, 16, 8192).fits(HBM_GIB["v5p"])
+    assert not memory_plan(cfg, spec, 32, 8192).fits(HBM_GIB["v5p"])
+    lean = memory_plan(
+        replace(cfg, remat_policy="save_nothing"), spec, 32, 8192
+    )
+    assert lean.fits(HBM_GIB["v5p"]), lean
+    strides = axis_strides(spec)
+    assert strides["tp"] == 1 and strides["fsdp"] == 4
