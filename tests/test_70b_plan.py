@@ -158,7 +158,7 @@ def test_pp_divides_resident_layers():
 
 
 def test_8b_fits_v5p16_north_star_shape():
-    """BASELINE #4's shape: Llama-3-8B on v5p-16 (fsdp4 x tp4, tp
+    """The north-star shape: Llama-3-8B on v5p-16 (fsdp4 x tp4, tp
     within-host). Batch 16 x 8192 fits with room (50.7 GiB of 95); 32
     needs a cheaper remat policy — the plan names the working points
     before the slice exists."""
