@@ -22,13 +22,24 @@ proto:
 test: native-test
 	python -m pytest tests/ -q
 
+# Static gate: ruff (when installed — hermetic containers may lack it;
+# compileall still catches syntax/indentation rot everywhere) plus a
+# full bytecode compile of the package, tests, and top-level drivers.
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check k8s_gpu_device_plugin_tpu tests bench.py tools; \
+	else \
+		echo "lint: ruff not installed; running compileall only"; \
+	fi
+	python -m compileall -q k8s_gpu_device_plugin_tpu tests tools bench.py
+
 san-test:
 	$(MAKE) -C $(NATIVE_DIR) san-test
 
-# Full CI gate (SURVEY §5 race-detection/sanitizer row): plain native build
-# + unit test, ASan/UBSan build + test, and the Python suite (which includes
-# the manager concurrency stress in tests/test_manager_stress.py).
-ci: native native-test san-test
+# Full CI gate (SURVEY §5 race-detection/sanitizer row): lint, plain native
+# build + unit test, ASan/UBSan build + test, and the Python suite (which
+# includes the manager concurrency stress in tests/test_manager_stress.py).
+ci: lint native native-test san-test
 	python -m pytest tests/ -q
 
 bench:
@@ -37,7 +48,7 @@ bench:
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
-.PHONY: all native native-test proto san-test ci test bench clean watch
+.PHONY: all native native-test proto lint san-test ci test bench clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

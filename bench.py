@@ -223,6 +223,41 @@ def _journal_results() -> dict[str, tuple[dict, float]]:
     return out
 
 
+def _collect_artifacts(
+    results: dict[str, dict | None],
+) -> dict[str, dict[str, str]]:
+    """Gather each workload's observability artifacts (the runner's
+    Perfetto trace / cProfile paths) into ``bench_traces/`` next to the
+    driver's ``BENCH_*.json`` history, and map workload -> relative
+    paths for the payload. Missing/unreadable files are skipped — the
+    artifacts are diagnostics, never a reason to fail the line."""
+    import shutil
+
+    dest_dir = os.path.join(REPO_ROOT, "bench_traces")
+    out: dict[str, dict[str, str]] = {}
+    for workload, result in results.items():
+        if not isinstance(result, dict):
+            continue
+        entry: dict[str, str] = {}
+        for key in ("trace_path", "profile_path"):
+            src = result.get(key)
+            if not isinstance(src, str) or not os.path.exists(src):
+                continue
+            dest = os.path.join(
+                dest_dir, f"{workload}_{os.path.basename(src)}"
+            )
+            try:
+                os.makedirs(dest_dir, exist_ok=True)
+                if os.path.abspath(src) != os.path.abspath(dest):
+                    shutil.copyfile(src, dest)
+                entry[key] = os.path.relpath(dest, REPO_ROOT)
+            except OSError as e:
+                _log(f"artifact collect failed for {workload}: {e}")
+        if entry:
+            out[workload] = entry
+    return out
+
+
 def probe_chip(platforms: tuple[str | None, ...]) -> bool:
     """Fast up-front liveness check: a tiny matmul child with a short
     timeout. Round 3 spent 963s of a scarce hardware window discovering a
@@ -322,6 +357,15 @@ def main() -> int:
     decode_int4w = _adopt(decode_int4w, "decode_int4w")
 
     extra: dict = {}
+    artifacts = _collect_artifacts({
+        "matmul": matmul, "train": train, "roundtrip": roundtrip,
+        "allocated": allocated, "train_fusedopt": train_fusedopt,
+        "train_int8": train_int8, "decode": decode,
+        "decode_int8w": decode_int8w, "decode_int4w": decode_int4w,
+        "dataload": dataload,
+    })
+    if artifacts:
+        extra["artifacts"] = artifacts
     if adopted:
         extra["journal"] = {
             "path": os.path.relpath(JOURNAL_PATH, REPO_ROOT),

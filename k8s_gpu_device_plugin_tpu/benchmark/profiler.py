@@ -144,6 +144,38 @@ class BlockSampler:
             self._probe_loop_lag()
             self._sample_lock_waits()
 
+    def summary(self, top_sites: int = 20) -> dict:
+        """Machine-readable snapshot of the block profile (what
+        ``GET /debug/profile`` serves): loop-lag percentiles over the
+        window plus the hottest lock-wait sites.
+
+        Called WHILE the sampler thread keeps mutating its state:
+        snapshot both containers first via single C-level copies (atomic
+        under the GIL) — iterating the live Counter/deque would race a
+        concurrent insert/append and raise mid-request."""
+        lags = sorted(list(self.loop_lags))
+        waits = dict(self.lock_waits)
+
+        def pct(p: float) -> float:
+            return lags[min(len(lags) - 1, int(p * len(lags)))] if lags else 0.0
+
+        top = sorted(waits.items(), key=lambda kv: (-kv[1], kv[0]))
+        return {
+            "samples": self.samples,
+            "interval_ms": round(self._interval * 1000, 1),
+            "loop_lag_ms": {
+                "count": self.lag_count,
+                "window": len(lags),
+                "p50": round(pct(0.5) * 1e3, 3),
+                "p99": round(pct(0.99) * 1e3, 3),
+                "max": round(self.lag_max * 1e3, 3),
+            },
+            "lock_waits": [
+                {"site": site, "samples": count}
+                for site, count in top[:top_sites]
+            ],
+        }
+
     def report(self) -> str:
         lags = sorted(self.loop_lags)
 
@@ -178,6 +210,15 @@ class Profiler:
     def watch_loop(self, loop) -> None:
         """Measure this asyncio loop's scheduling lag while profiling."""
         self._block.watch_loop(loop)
+
+    def summary(self) -> dict:
+        """Live block-profile snapshot (``GET /debug/profile``): no
+        flush, no file I/O — readable while profiling keeps running."""
+        return {
+            "running": self._running,
+            "out_dir": self.out_dir,
+            "block": self._block.summary(),
+        }
 
     def run(self) -> None:
         """Begin profiling (≙ Benchmark.Run, benchmark.go:54-89)."""

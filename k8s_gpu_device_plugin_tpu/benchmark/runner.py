@@ -20,13 +20,20 @@ import sys
 # be minutes long — a recompiled-from-scratch step must never eat a window
 # a cached executable could have used. (Env-var form so it binds whether
 # jax is imported here or inside a workload module.)
-_CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    ".jax_cache",
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+# Per-run observability artifacts (Perfetto trace JSON, optional cProfile
+# dump). BENCH_TRACE=0 disables tracing; BENCH_ARTIFACT_DIR relocates the
+# output (bench.py collects the paths from the JSON line either way).
+_ARTIFACT_DIR = os.environ.get(
+    "BENCH_ARTIFACT_DIR", os.path.join(_REPO_ROOT, "bench_artifacts")
+)
 
 
 def _require_accelerator():
@@ -544,6 +551,52 @@ WORKLOADS = {
 }
 
 
+def _run_traced(name: str, fn) -> dict:
+    """Run one workload under a root span; on success attach the
+    Perfetto trace (and optional cProfile) artifact paths to its JSON.
+
+    The root span is the ambient parent for everything the workload
+    does, so a serve bench's per-request trees nest under ``bench:serve``
+    and the exported file shows the whole run end to end."""
+    from k8s_gpu_device_plugin_tpu.obs.trace import configure
+
+    if os.environ.get("BENCH_TRACE", "1") == "0":
+        return fn()
+
+    tracer = configure(enabled=True)
+    profiler = None
+    if os.environ.get("BENCH_PROFILE") == "1":
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+    root = tracer.span(f"bench:{name}", component="benchmark")
+    with root:
+        payload = fn()
+    artifacts: dict[str, str] = {}
+    spans = tracer.get_trace(root.trace_id)
+    if spans:
+        from k8s_gpu_device_plugin_tpu.obs.export import write_trace_file
+
+        try:
+            artifacts["trace_path"] = write_trace_file(
+                spans, os.path.join(_ARTIFACT_DIR, f"trace_{name}.json")
+            )
+        except OSError as e:  # artifacts must never fail the measurement
+            print(f"runner: trace write failed: {e}", file=sys.stderr)
+    if profiler is not None:
+        profiler.disable()
+        prof_path = os.path.join(_ARTIFACT_DIR, f"cpu_{name}.prof")
+        try:
+            os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+            profiler.dump_stats(prof_path)
+            artifacts["profile_path"] = prof_path
+        except OSError as e:
+            print(f"runner: profile write failed: {e}", file=sys.stderr)
+    payload.update(artifacts)
+    return payload
+
+
 def main(argv: list[str]) -> int:
     name = argv[1] if len(argv) > 1 else ""
     fn = WORKLOADS.get(name)
@@ -551,7 +604,7 @@ def main(argv: list[str]) -> int:
         print(json.dumps({"error": f"unknown workload {name!r}"}))
         return 2
     try:
-        payload = fn()
+        payload = _run_traced(name, fn)
     except Exception as e:  # noqa: BLE001 - the contract is one JSON line, always
         print(json.dumps({"workload": name, "error": f"{type(e).__name__}: {e}"}))
         return 1

@@ -48,6 +48,12 @@ class Config:
     benchmark: bool = False                    # reference config.go:20
     log: LogSettings = field(default_factory=LogSettings)
 
+    # Span tracing (obs/): default OFF — every instrumentation site is a
+    # no-op branch until enabled. traceBufferTraces bounds the in-memory
+    # ring of completed traces served by GET /debug/traces.
+    tracing: bool = False
+    trace_buffer_traces: int = 64
+
     # TPU-specific additions (no reference equivalent):
     topology: str = "auto"                     # e.g. "v5p-8" to override discovery
     kubelet_socket_dir: str = "/var/lib/kubelet/device-plugins"
@@ -137,6 +143,10 @@ class Config:
             raise ValueError(
                 "healthIdleProbe: on requires runtimeMetricsPorts != off"
             )
+        if self.trace_buffer_traces < 1:
+            raise ValueError(
+                f"traceBufferTraces must be >= 1, got {self.trace_buffer_traces}"
+            )
         if self.runtime_metrics_cache_ttl < 0:
             raise ValueError(
                 f"runtimeMetricsCacheTtlSeconds must be >= 0, "
@@ -185,6 +195,8 @@ _KEY_MAP = {
     "sliceStrategy": "slice_strategy",
     "migStrategy": "slice_strategy",  # accepted alias for drop-in migration
     "benchmark": "benchmark",
+    "tracing": "tracing",
+    "traceBufferTraces": "trace_buffer_traces",
     "topology": "topology",
     "kubeletSocketDir": "kubelet_socket_dir",
     "libtpuPath": "libtpu_path",
@@ -236,6 +248,7 @@ def load_config(
     parser.add_argument("--sliceStrategy", default=None,
                         choices=list(_VALID_STRATEGIES))
     parser.add_argument("--benchmark", default=None, action="store_const", const=True)
+    parser.add_argument("--tracing", default=None, action="store_const", const=True)
     parser.add_argument("--topology", default=None)
     parser.add_argument("--kubeletSocketDir", default=None)
     parser.add_argument("--libtpuPath", default=None)
@@ -276,6 +289,7 @@ def load_config(
         "webListenAddress": args.webListenAddress,
         "sliceStrategy": args.sliceStrategy,
         "benchmark": args.benchmark,
+        "tracing": args.tracing,
         "topology": args.topology,
         "kubeletSocketDir": args.kubeletSocketDir,
         "libtpuPath": args.libtpuPath,

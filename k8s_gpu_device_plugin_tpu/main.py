@@ -47,6 +47,17 @@ async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> No
         except (NotImplementedError, RuntimeError):
             pass  # non-main thread or non-unix: tests drive stop directly
 
+    if cfg.tracing:
+        # enable BEFORE building the server so its span-duration
+        # histograms see an enabled tracer and register
+        from k8s_gpu_device_plugin_tpu.obs.trace import configure
+
+        configure(enabled=True, max_traces=cfg.trace_buffer_traces)
+        logger.info(
+            "span tracing enabled",
+            extra={"fields": {"buffer_traces": cfg.trace_buffer_traces}},
+        )
+
     profiler: Profiler | None = None
     if cfg.benchmark:  # ≙ main.go:141-154
         profiler = Profiler(logger)
@@ -66,7 +77,10 @@ async def run_daemon(cfg: Config, stop_event: asyncio.Event | None = None) -> No
             cfg, logger=logger, reader=usage_reader
         ),
     )
-    server = Server(cfg, manager, ready, logger=logger, usage_reader=usage_reader)
+    server = Server(
+        cfg, manager, ready, logger=logger, usage_reader=usage_reader,
+        profiler=profiler,
+    )
 
     manager_task = asyncio.create_task(manager.start(), name="plugin-manager")
     server_task = asyncio.create_task(server.run(stop), name="http-server")

@@ -63,7 +63,13 @@ class HttpMetrics:
         @web.middleware
         async def middleware(request, handler):
             start = time.perf_counter()
-            path = request.path if request.path in known_routes else NOT_FOUND_HANDLER
+            path = request.path
+            if path not in known_routes:
+                # parameterized routes (/debug/traces/{trace_id}) label
+                # by their bounded canonical template, not the raw path
+                resource = getattr(request.match_info.route, "resource", None)
+                canonical = getattr(resource, "canonical", None)
+                path = canonical if canonical in known_routes else NOT_FOUND_HANDLER
             status = 500  # anything non-HTTP that escapes, incl. cancellation
             try:
                 response = await handler(request)

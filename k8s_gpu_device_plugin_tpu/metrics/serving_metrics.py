@@ -14,7 +14,15 @@ from __future__ import annotations
 
 import time
 
-from prometheus_client import Counter, Gauge, REGISTRY
+from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+# Serving latency buckets: TTFT spans queue wait + prefill (ms..s);
+# inter-token is the per-step decode cadence (sub-ms..s with chunked
+# prefill interleaving). One ladder covers both with sub-ms resolution.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, float("inf"),
+)
 
 
 class ServingMetrics:
@@ -68,6 +76,23 @@ class ServingMetrics:
             "Decode throughput over the last observation window",
             registry=registry,
         )
+        # Latency DISTRIBUTIONS for the serving hot path (the counters
+        # above say how much; these say how long a user waits): TTFT is
+        # submit -> first sampled token (queue wait + prefill included),
+        # inter-token is the gap between consecutive tokens of ONE
+        # request (what a streaming client perceives between events).
+        self.ttft_seconds = Histogram(
+            f"{prefix}_ttft_seconds",
+            "Time from request submission to its first generated token",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.inter_token_seconds = Histogram(
+            f"{prefix}_inter_token_seconds",
+            "Gap between consecutive generated tokens of one request",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
 
@@ -83,6 +108,8 @@ class ServingMetrics:
             self.slots_active,
             self.slots_prefilling,
             self.tokens_per_second,
+            self.ttft_seconds,
+            self.inter_token_seconds,
         ):
             try:
                 self._registry.unregister(c)
@@ -125,3 +152,9 @@ class ServingMetrics:
 
     def on_finish(self, reason: str) -> None:
         self.requests_finished.labels(reason=reason).inc()
+
+    def observe_ttft(self, seconds: float) -> None:
+        self.ttft_seconds.observe(seconds)
+
+    def observe_inter_token(self, seconds: float) -> None:
+        self.inter_token_seconds.observe(seconds)

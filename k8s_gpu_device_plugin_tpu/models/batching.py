@@ -34,12 +34,16 @@ allocator extends its scheduling (SURVEY §2 'Parallelism substrate').
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from k8s_gpu_device_plugin_tpu.obs.trace import attach, get_tracer
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
 from k8s_gpu_device_plugin_tpu.models.generate import (
     KVCache,
@@ -245,6 +249,13 @@ class _Request:
     # uses fold_in(key(seed), i), i = len(out) host-side — the sampled
     # stream reproduces regardless of batch composition or timing
     seed: "int | None" = None
+    # request-lifecycle observability: submit/last-token perf_counter
+    # marks (TTFT + inter-token histograms) and the request's span tree
+    # (obs/trace.py; None everywhere when tracing is off)
+    t_submit: float = 0.0
+    t_last_tok: float = 0.0
+    span: object = None
+    decode_span: object = None
 
 
 
@@ -339,6 +350,9 @@ class ContinuousBatcher:
         # set membership changes (admit/retire/cancel) invalidate it, so
         # steady-state decode pays no per-token host build + transfer
         self._knobs_cache: jax.Array | None = None
+        # process-global tracer: every site below guards on .enabled, so
+        # the default-off path is one attribute read per potential span
+        self.tracer = get_tracer()
 
     def validate(self, prompt_len: int, max_new: int) -> None:
         """Raise ValueError iff submit(prompt of this length) would.
@@ -447,13 +461,27 @@ class ContinuousBatcher:
         rid = self._next_rid
         self._next_rid += 1
         full = (list(prefix.tokens) if prefix else []) + list(prompt)
-        self.pending.append(
-            _Request(
-                rid, full, max_new, prefix=prefix,
-                stop=tuple(tuple(s) for s in (stop or ()) if s),
-                sampler=sampler, adapter=adapter, bias=bias, seed=seed,
-            )
+        req = _Request(
+            rid, full, max_new, prefix=prefix,
+            stop=tuple(tuple(s) for s in (stop or ()) if s),
+            sampler=sampler, adapter=adapter, bias=bias, seed=seed,
         )
+        req.t_submit = time.perf_counter()
+        if self.tracer.enabled:
+            # root of the request's span tree; parent (if any) is the
+            # ambient context — the HTTP handler's span attached around
+            # this call by the serving engine
+            req.span = self.tracer.span(
+                "request", component="serving", rid=rid,
+                prompt_len=len(full), max_new=max_new,
+            )
+            with attach(req.span):  # the log line carries the trace ids
+                get_logger().debug(
+                    "request submitted",
+                    extra={"fields": {"rid": rid, "prompt_len": len(full),
+                                      "max_new": max_new}},
+                )
+        self.pending.append(req)
         if self.metrics:
             self.metrics.on_submit()
         return rid
@@ -564,6 +592,13 @@ class ContinuousBatcher:
             req = self.pending.pop(0)
             slot = free.pop(0)
             req.slot = slot
+            if req.span is not None:
+                # the admit span COVERS the queue wait: backdated to
+                # submit time, ended at slot assignment
+                self.tracer.span(
+                    "admit", component="serving", parent=req.span,
+                    t0=req.t_submit, slot=slot,
+                ).end()
             if self.chunk:
                 start = 0
                 if req.prefix is not None:
@@ -581,16 +616,25 @@ class ContinuousBatcher:
             padded = jnp.asarray(
                 req.prompt + [0] * (bucket - len(req.prompt)), jnp.int32
             )
-            self.state, tok, logp = prefill_insert(
-                self.params, self.state, padded,
-                jnp.int32(len(req.prompt)), jnp.int32(slot),
-                self.cfg, self._req_knobs(req), sel=self._req_sel(req),
-                bias=self._req_bias(req), seed=self._req_seed(req),
-            )
-            req.out.append(int(tok))
-            req.out_logp.append(float(logp))
-            if self.metrics:
-                self.metrics.on_first_token()
+            prefill_span = None
+            if req.span is not None:
+                prefill_span = self.tracer.span(
+                    "prefill", component="serving", parent=req.span,
+                    bucket=bucket, prompt_len=len(req.prompt),
+                )
+            try:
+                self.state, tok, logp = prefill_insert(
+                    self.params, self.state, padded,
+                    jnp.int32(len(req.prompt)), jnp.int32(slot),
+                    self.cfg, self._req_knobs(req), sel=self._req_sel(req),
+                    bias=self._req_bias(req), seed=self._req_seed(req),
+                )
+                req.out.append(int(tok))  # device sync: prefill really done
+                req.out_logp.append(float(logp))
+            finally:  # a raised dispatch must not pin the trace open
+                if prefill_span is not None:
+                    prefill_span.end()
+            self._on_first_token(req)
             self.running[slot] = req
             self._knobs_cache = None
             self._sel_cache = None
@@ -609,7 +653,17 @@ class ContinuousBatcher:
         plen = len(req.prompt)
         if start + c < plen:  # intermediate chunk, all real tokens
             chunk = jnp.asarray(req.prompt[start:start + c], jnp.int32)
-            self._apply_prefill_chunk(chunk, start, slot)
+            chunk_span = None
+            if req.span is not None:
+                chunk_span = self.tracer.span(
+                    "prefill_chunk", component="serving", parent=req.span,
+                    start=start, tokens=c,
+                )
+            try:
+                self._apply_prefill_chunk(chunk, start, slot)
+            finally:
+                if chunk_span is not None:
+                    chunk_span.end()
             self._prefill_pos[slot] = start + c
             if self.metrics:
                 self.metrics.on_prefill_chunk()
@@ -622,17 +676,61 @@ class ContinuousBatcher:
         fstart = max(0, plen - c)
         rest = req.prompt[fstart:]
         chunk = jnp.asarray(rest + [0] * (c - len(rest)), jnp.int32)
-        tok, logp = self._apply_prefill_finish(chunk, fstart, plen, slot)
+        finish_span = None
+        if req.span is not None:
+            finish_span = self.tracer.span(
+                "prefill_chunk", component="serving", parent=req.span,
+                start=fstart, tokens=c, final=True,
+            )
+        try:
+            tok, logp = self._apply_prefill_finish(chunk, fstart, plen, slot)
+        finally:
+            if finish_span is not None:
+                finish_span.end()
         del self.prefilling[slot], self._prefill_pos[slot]
         req.out.append(int(tok))
         req.out_logp.append(float(logp))
-        if self.metrics:
-            self.metrics.on_first_token()
+        self._on_first_token(req)
         self.running[slot] = req
         self._knobs_cache = None
         self._sel_cache = None
         self._bias_cache = None
         self._finish_if_done(req)
+
+    def _on_first_token(self, req: _Request) -> None:
+        """First generated token (sampled at prefill time): TTFT metric +
+        the request's decode-phase span opens."""
+        now = time.perf_counter()
+        req.t_last_tok = now
+        if self.metrics:
+            self.metrics.on_first_token()
+            observe = getattr(self.metrics, "observe_ttft", None)
+            if observe is not None:  # duck-typed: older/fake metrics lack it
+                observe(now - req.t_submit)
+        if req.span is not None:
+            req.decode_span = self.tracer.span(
+                "decode", component="serving", parent=req.span,
+            )
+
+    def _close_request_spans(self, req: _Request, reason: str) -> None:
+        """Retire the request's span tree: decode phase ends, a retire
+        marker lands, the root closes (completing the trace)."""
+        if req.span is None:
+            return
+        if req.decode_span is not None:
+            req.decode_span.set(tokens=len(req.out)).end()
+            req.decode_span = None
+        self.tracer.span(
+            "retire", component="serving", parent=req.span, reason=reason,
+        ).end()
+        with attach(req.span):  # the log line carries the trace ids
+            get_logger().debug(
+                "request retired",
+                extra={"fields": {"rid": req.rid, "reason": reason,
+                                  "tokens": len(req.out)}},
+            )
+        req.span.set(reason=reason, tokens=len(req.out)).end()
+        req.span = None
 
     # overridable seams (the speculative batcher mirrors these onto a
     # second, draft-model state)
@@ -687,6 +785,7 @@ class ContinuousBatcher:
         self.done_requests[req.rid] = req
         if self.metrics:
             self.metrics.on_finish("cancelled")
+        self._close_request_spans(req, "cancelled")
 
     def _finish_if_done(self, req: _Request) -> None:
         """EOS, a stop sequence, or budget exhaustion retires the request
@@ -698,6 +797,7 @@ class ContinuousBatcher:
             for st in req.stop
         )
         if hit_eos or hit_stop or len(req.out) >= req.max_new:
+            reason = "eos" if hit_eos else ("stop" if hit_stop else "budget")
             self.done[req.rid] = req.out
             self.done_requests[req.rid] = req
             if req.slot in self.running:
@@ -706,9 +806,8 @@ class ContinuousBatcher:
                 self._sel_cache = None
                 self._bias_cache = None
             if self.metrics:
-                self.metrics.on_finish(
-                    "eos" if hit_eos else ("stop" if hit_stop else "budget")
-                )
+                self.metrics.on_finish(reason)
+            self._close_request_spans(req, reason)
 
     def step(self) -> None:
         """Admit what fits, advance at most one prefill chunk, then one
@@ -739,12 +838,21 @@ class ContinuousBatcher:
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
         n_emitted = 0
+        observe_it = (
+            getattr(self.metrics, "observe_inter_token", None)
+            if self.metrics else None
+        )
+        now = time.perf_counter() if observe_it is not None else 0.0
         for slot, req in list(self.running.items()):
             tok = int(emitted[slot])
             if tok >= 0:
                 n_emitted += 1
                 req.out.append(tok)
                 req.out_logp.append(float(logps[slot]))
+                if observe_it is not None:
+                    if req.t_last_tok:
+                        observe_it(now - req.t_last_tok)
+                    req.t_last_tok = now
                 self._finish_if_done(req)
         return n_emitted
 

@@ -36,6 +36,7 @@ from k8s_gpu_device_plugin_tpu.models.train import (
     make_optimizer,
     make_train_step,
 )
+from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
 from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_TP, MeshSpec
 from k8s_gpu_device_plugin_tpu.parallel.multihost import initialize, make_global_mesh
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
@@ -238,53 +239,67 @@ class Trainer:
         steps_timed = 0
         eval_seconds = 0.0
         tracing = False
+        # Step-phase spans (obs/): one trace per step with the host-side
+        # phases — data wait, dispatch, checkpoint, eval. The fused
+        # forward/backward/optimizer split lives in the xplane trace
+        # (trace_dir); spans cover what the HOST spends per step.
+        tr = get_tracer()
         try:
             for step in range(start_step, cfg.total_steps):
                 if cfg.trace_dir and step == cfg.trace_start and not tracing:
                     jax.profiler.start_trace(cfg.trace_dir)
                     tracing = True
-                batch = next(it)
-                state, metrics = self.step_fn(state, batch)
-                if step + 1 == cfg.trace_stop and tracing:
-                    jax.block_until_ready(state["params"])
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    self.log.info(
-                        "trace written", extra={"fields": {"dir": cfg.trace_dir}}
-                    )
-                if t_start is None:
-                    # start the clock after step 0 retires: excludes compile
-                    jax.block_until_ready(metrics["loss"])
-                    t_start = time.perf_counter()
-                else:
-                    steps_timed += 1
-                if self.ckpt is not None:
-                    self.ckpt.save(state, step=step + 1)
-                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.total_steps:
-                    snap = {
-                        "step": step + 1,
-                        "loss": float(metrics["loss"]),
-                        "grad_norm": float(metrics["grad_norm"]),
-                    }
-                    history.append(snap)
-                    self.log.info("train step", extra={"fields": snap})
-                if (
-                    self.eval_loader is not None
-                    and (step + 1) % cfg.eval_every == 0
-                    and step + 1 != cfg.total_steps  # final eval runs below
-                ):
-                    # eval wall time must not deflate the reported train
-                    # tokens/s: finish in-flight work, then pause the clock
-                    jax.block_until_ready(metrics["loss"])
-                    t_eval = time.perf_counter()
-                    ev = self._evaluate(state["params"])
-                    eval_seconds += time.perf_counter() - t_eval
-                    self.log.info(
-                        "eval", extra={"fields": {"step": step + 1, **ev}}
-                    )
-                    history.append({"step": step + 1, "eval": ev})
-                if on_step is not None:
-                    on_step(step + 1, metrics)
+                with tr.span("train_step", component="trainer", step=step):
+                    with tr.span("data_load", component="trainer"):
+                        batch = next(it)
+                    with tr.span("step_dispatch", component="trainer"):
+                        state, metrics = self.step_fn(state, batch)
+                    if step + 1 == cfg.trace_stop and tracing:
+                        jax.block_until_ready(state["params"])
+                        jax.profiler.stop_trace()
+                        tracing = False
+                        self.log.info(
+                            "trace written",
+                            extra={"fields": {"dir": cfg.trace_dir}},
+                        )
+                    if t_start is None:
+                        # start the clock after step 0 retires: excludes
+                        # compile
+                        jax.block_until_ready(metrics["loss"])
+                        t_start = time.perf_counter()
+                    else:
+                        steps_timed += 1
+                    if self.ckpt is not None:
+                        with tr.span("checkpoint", component="trainer"):
+                            self.ckpt.save(state, step=step + 1)
+                    if (step + 1) % cfg.log_every == 0 \
+                            or step + 1 == cfg.total_steps:
+                        snap = {
+                            "step": step + 1,
+                            "loss": float(metrics["loss"]),
+                            "grad_norm": float(metrics["grad_norm"]),
+                        }
+                        history.append(snap)
+                        self.log.info("train step", extra={"fields": snap})
+                    if (
+                        self.eval_loader is not None
+                        and (step + 1) % cfg.eval_every == 0
+                        and step + 1 != cfg.total_steps  # final eval below
+                    ):
+                        # eval wall time must not deflate the reported
+                        # train tokens/s: finish in-flight work, then
+                        # pause the clock
+                        jax.block_until_ready(metrics["loss"])
+                        t_eval = time.perf_counter()
+                        with tr.span("eval", component="trainer"):
+                            ev = self._evaluate(state["params"])
+                        eval_seconds += time.perf_counter() - t_eval
+                        self.log.info(
+                            "eval", extra={"fields": {"step": step + 1, **ev}}
+                        )
+                        history.append({"step": step + 1, "eval": ev})
+                    if on_step is not None:
+                        on_step(step + 1, metrics)
         finally:
             if tracing:
                 jax.profiler.stop_trace()

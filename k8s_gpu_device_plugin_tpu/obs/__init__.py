@@ -1,0 +1,47 @@
+"""Observability: span tracing with trace<->log<->metric correlation.
+
+Three pillars, one correlation key:
+
+- ``trace.py``  — zero-dependency ``Tracer``/``Span`` with contextvars
+  propagation (asyncio tasks AND thread hops), a ring buffer of
+  completed traces, and W3C ``traceparent`` interop;
+- ``export.py`` — Chrome/Perfetto trace-event JSON for any trace in the
+  buffer (``GET /debug/traces`` serves it; ``chrome://tracing`` and
+  https://ui.perfetto.dev open it directly);
+- ``prom.py``   — span-duration Prometheus histograms per
+  (component, operation), driven by the tracer's end-of-span listener.
+
+``utils/log.py`` injects the active ``trace_id``/``span_id`` into every
+JSON record, so one id follows a unit of work across logs, metrics
+exemplars, and the trace tree. Default-OFF: every instrumentation site
+is behind a single ``tracer.enabled`` check and compiles down to an
+attribute read + branch (see tests/test_obs.py's microbenchmark).
+"""
+
+from k8s_gpu_device_plugin_tpu.obs.trace import (
+    NOOP_SPAN,
+    Span,
+    SpanContext,
+    Tracer,
+    attach,
+    configure,
+    current_context,
+    current_trace_ids,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "attach",
+    "configure",
+    "current_context",
+    "current_trace_ids",
+    "format_traceparent",
+    "get_tracer",
+    "parse_traceparent",
+]

@@ -1,0 +1,65 @@
+"""Chrome/Perfetto trace-event JSON exporter.
+
+The span records in the tracer's ring buffer convert 1:1 into the
+trace-event format's complete events (``"ph": "X"``), which both
+``chrome://tracing`` and https://ui.perfetto.dev open directly. Rows
+group by component: each component becomes a named "thread" via
+``thread_name`` metadata events, so a serving request renders as
+admit -> prefill -> decode -> retire nested under its request span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Span records (Tracer.get_trace output) -> trace-event JSON dict."""
+    pid = os.getpid()
+    components: dict[str, int] = {}
+    events: list[dict] = []
+    for span in spans:
+        component = span.get("component") or "default"
+        tid = components.setdefault(component, len(components) + 1)
+        args = {
+            "trace_id": span["trace_id"],
+            "span_id": span["span_id"],
+            "parent_id": span.get("parent_id"),
+            "status": span.get("status", "ok"),
+            "thread": span.get("thread", ""),
+        }
+        attrs = span.get("attrs") or {}
+        for key, value in attrs.items():
+            # keep the payload JSON-serializable whatever landed in attrs
+            args[key] = (
+                value if isinstance(value, (str, int, float, bool, type(None)))
+                else str(value)
+            )
+        events.append({
+            "name": span["name"],
+            "cat": component,
+            "ph": "X",
+            "ts": span["start_us"],
+            "dur": max(span["dur_us"], 1),  # 0-width events vanish in the UI
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    for component, tid in components.items():
+        events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": component},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_trace_file(spans: list[dict], path: str) -> str:
+    """Serialize one trace to ``path`` (Perfetto-openable); returns path."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    return path

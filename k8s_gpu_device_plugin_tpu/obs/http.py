@@ -1,0 +1,44 @@
+"""Shared payload builders for the ``/debug/*`` observability endpoints.
+
+Both HTTP planes (the daemon's control-plane ``server/server.py`` and the
+in-pod ``serving/server.py``) expose the same trace/profile surface;
+the payload shapes live here so the two cannot drift. Envelope and
+status-code policy stay with each server.
+"""
+
+from __future__ import annotations
+
+from k8s_gpu_device_plugin_tpu.obs.export import to_chrome_trace
+from k8s_gpu_device_plugin_tpu.obs.trace import Tracer
+
+
+def route_label(request) -> str:
+    """Bounded span-operation label for an aiohttp request: the matched
+    route's canonical template (``/debug/traces/{trace_id}``), never the
+    raw path — span names feed the (component, operation) histogram
+    labels, and raw paths (scanners, random 404s) would grow the
+    registry without bound. Unmatched requests collapse to one label."""
+    resource = getattr(request.match_info.route, "resource", None)
+    return getattr(resource, "canonical", None) or "unmatched"
+
+
+def traces_payload(tracer: Tracer) -> dict:
+    """``GET /debug/traces``: buffer state + newest-first summaries."""
+    return {"enabled": tracer.enabled, "traces": tracer.traces()}
+
+
+def trace_detail_payload(tracer: Tracer, trace_id: str) -> dict | None:
+    """``GET /debug/traces/{id}``: one trace as Chrome/Perfetto JSON,
+    or None when the id is not in the buffer."""
+    spans = tracer.get_trace(trace_id)
+    if spans is None:
+        return None
+    return to_chrome_trace(spans)
+
+
+def profile_payload(profiler) -> dict | None:
+    """``GET /debug/profile``: the profiler's live summary (None when
+    the daemon runs without ``--benchmark``)."""
+    if profiler is None:
+        return None
+    return profiler.summary()

@@ -1,0 +1,61 @@
+"""Span-duration Prometheus histograms: the metrics leg of correlation.
+
+A tracer end-of-span listener feeding one histogram labeled
+(component, operation) — the same names the trace tree and the log
+records carry, so a latency regression spotted on the histogram pivots
+straight to example traces and log lines. Dependency-inverted like
+ServingMetrics: the tracer itself never imports prometheus; this bridge
+is installed only where a registry exists (control-plane Server, the
+serving CLI).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import REGISTRY, Histogram
+
+# Spans range from sub-ms decode steps to multi-minute train phases.
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, float("inf"),
+)
+
+
+class SpanMetrics:
+    """Registers once against ``registry``; driven by a Tracer listener.
+
+    Same lifecycle contract as ServingMetrics: fixed collector names, so
+    call :meth:`close` before building a replacement on the same
+    registry (tests, daemon restarts)."""
+
+    def __init__(self, registry=REGISTRY, prefix: str = "tpu_obs"):
+        self._registry = registry
+        self._tracer = None
+        self.span_seconds = Histogram(
+            f"{prefix}_span_duration_seconds",
+            "Duration of completed trace spans",
+            ["component", "operation"],
+            buckets=_BUCKETS,
+            registry=registry,
+        )
+
+    def install(self, tracer) -> "SpanMetrics":
+        """Subscribe to ``tracer``'s span-end stream."""
+        self._tracer = tracer
+        tracer.add_listener(self.observe)
+        return self
+
+    def observe(self, record: dict) -> None:
+        self.span_seconds.labels(
+            component=record.get("component") or "default",
+            operation=record.get("name") or "unknown",
+        ).observe(record.get("dur_us", 0) / 1e6)
+
+    def close(self) -> None:
+        """Detach from the tracer and unregister the collector."""
+        if self._tracer is not None:
+            self._tracer.remove_listener(self.observe)
+            self._tracer = None
+        try:
+            self._registry.unregister(self.span_seconds)
+        except KeyError:
+            pass  # already unregistered

@@ -52,12 +52,15 @@ def run_step_check(
         jax.config.update("jax_platforms", plat)
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
+    from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
     from k8s_gpu_device_plugin_tpu.parallel import multihost
 
-    env = multihost.initialize(
-        port=port or multihost.DEFAULT_COORDINATOR_PORT,
-        initialization_timeout=init_timeout,
-    )
+    tr = get_tracer()
+    with tr.span("rendezvous", component="trainer"):
+        env = multihost.initialize(
+            port=port or multihost.DEFAULT_COORDINATOR_PORT,
+            initialization_timeout=init_timeout,
+        )
     if env is None or env.num_workers <= 1:
         raise RuntimeError(
             "no multi-host env contract found (TPU_WORKER_HOSTNAMES / "
@@ -97,21 +100,25 @@ def run_step_check(
 
     cfg = LlamaConfig.tiny(n_layers=2, attn_impl="ring" if spec.sp > 1 else "xla")
     optimizer = make_optimizer(total_steps=max(steps, 2))
-    state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
-    # identical key on every process -> identical host batch, which
-    # device_put may assert when shards live on non-addressable devices
-    batch = synthetic_batch(
-        jax.random.key(1), cfg, batch_size=batch_size, seq_len=seq_len,
-        mesh=mesh,
-    )
-    train_step = make_train_step(cfg, mesh, optimizer)
+    with tr.span("init_state", component="trainer", ndev=ndev):
+        state = init_train_state(jax.random.key(0), cfg, mesh, optimizer)
+        # identical key on every process -> identical host batch, which
+        # device_put may assert when shards live on non-addressable devices
+        batch = synthetic_batch(
+            jax.random.key(1), cfg, batch_size=batch_size, seq_len=seq_len,
+            mesh=mesh,
+        )
+        train_step = make_train_step(cfg, mesh, optimizer)
 
     losses: list[float] = []
     grad_norms: list[float] = []
-    for _ in range(steps):
-        state, metrics = train_step(state, batch)
-        losses.append(float(metrics["loss"]))
-        grad_norms.append(float(metrics["grad_norm"]))
+    for i in range(steps):
+        # each sharded step includes the cross-process gradient psum: the
+        # span IS the collective-inclusive step wall time for this rank
+        with tr.span("sharded_step", component="trainer", step=i):
+            state, metrics = train_step(state, batch)
+            losses.append(float(metrics["loss"]))
+            grad_norms.append(float(metrics["grad_norm"]))
     if not all(jnp.isfinite(jnp.asarray(losses))):
         raise RuntimeError(f"non-finite losses across steps: {losses}")
 

@@ -38,6 +38,7 @@ from k8s_gpu_device_plugin_tpu.device.health import (
 from k8s_gpu_device_plugin_tpu.device.chip_map import ChipMap, new_chip_map
 from k8s_gpu_device_plugin_tpu.device.factory import make_backend
 from k8s_gpu_device_plugin_tpu.device.topology import as_slice_member
+from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
 from k8s_gpu_device_plugin_tpu.plugin import api
 from k8s_gpu_device_plugin_tpu.plugin.plugin import SliceMembership, TpuDevicePlugin
 from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
@@ -275,8 +276,14 @@ class PluginManager:
         return out
 
     async def _load_and_start(self) -> None:
-        self._load_plugins()
-        await self._start_plugins()
+        tracer = get_tracer()
+        with tracer.span("load_and_start", component="plugin"):
+            with tracer.span(
+                "enumerate", component="plugin", backend=self.backend.name,
+            ) as span:
+                self._load_plugins()
+                span.set(resources=len(self.plugins))
+            await self._start_plugins()
 
     def _check_crash_budget(self, resource: str) -> None:
         """≤5 successful starts per rolling hour per resource, then fatal.
@@ -338,10 +345,15 @@ class PluginManager:
 
     async def _restart_plugins(self) -> None:
         """Full teardown + re-enumeration + re-register (manager.go:177-194)."""
-        self.log.info("restarting all plugins")
-        await self._stop_plugins()
-        self.chip_map = ChipMap()
-        await self._load_and_start()
+        # one trace per restart cycle: teardown + enumerate + every
+        # plugin_start nest under it (the log line carries its trace_id)
+        with get_tracer().span("restart", component="plugin") as span:
+            self.log.info("restarting all plugins")
+            with get_tracer().span("stop_plugins", component="plugin"):
+                await self._stop_plugins()
+            self.chip_map = ChipMap()
+            await self._load_and_start()
+            span.set(plugins=len(self.plugins))
 
     # --- background loops ---
 

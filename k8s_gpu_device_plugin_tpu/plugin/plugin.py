@@ -32,6 +32,7 @@ import grpc
 
 from k8s_gpu_device_plugin_tpu.device.chip import Chip, Chips
 from k8s_gpu_device_plugin_tpu.device.topology import HostTopology
+from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
 from k8s_gpu_device_plugin_tpu.plugin import api
 from k8s_gpu_device_plugin_tpu.plugin.allocator import preferred_allocation
 from k8s_gpu_device_plugin_tpu.plugin.api import pb
@@ -96,11 +97,16 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     async def start(self, kubelet_socket: str | None = None) -> None:
         """Serve + self-check + register (≙ plugin.go:68-98)."""
-        await self._serve()
-        await self._self_dial_check()
-        if kubelet_socket is None:
-            kubelet_socket = os.path.join(self.socket_dir, api.KUBELET_SOCKET_NAME)
-        await self._register(kubelet_socket)
+        with get_tracer().span(
+            "plugin_start", component="plugin", resource=self.resource_name,
+        ):
+            await self._serve()
+            await self._self_dial_check()
+            if kubelet_socket is None:
+                kubelet_socket = os.path.join(
+                    self.socket_dir, api.KUBELET_SOCKET_NAME
+                )
+            await self._register(kubelet_socket)
         self._started = True
         self.log.info(
             "plugin started",
@@ -186,14 +192,31 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         return pb.ListAndWatchResponse(devices=devices)
 
     async def ListAndWatch(self, request, context):
-        """Initial full push, then re-push on health changes (plugin.go:173-189)."""
-        yield self._device_list()
+        """Initial full push, then re-push on health changes (plugin.go:173-189).
+
+        The stream outlives any trace, so each PUSH is its own short
+        span rather than one never-ending stream span (which would pin
+        its trace in the live table forever)."""
+        tracer = get_tracer()
+        with tracer.span(
+            "ListAndWatch.push", component="plugin",
+            resource=self.resource_name, initial=True,
+            devices=len(self.chips),
+        ):
+            response = self._device_list()
+        yield response
         queue: asyncio.Queue = asyncio.Queue()
         self._watch_queues.add(queue)
         try:
             while True:
                 await queue.get()
-                yield self._device_list()
+                with tracer.span(
+                    "ListAndWatch.push", component="plugin",
+                    resource=self.resource_name, initial=False,
+                    devices=len(self.chips),
+                ):
+                    response = self._device_list()
+                yield response
         finally:
             self._watch_queues.discard(queue)
 
@@ -331,6 +354,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     async def Allocate(self, request, context):
         """Validate IDs and wire devices/mounts/envs (≙ plugin.go:210-225)."""
+        with get_tracer().span(
+            "Allocate", component="plugin", resource=self.resource_name,
+            containers=len(request.container_requests),
+        ):
+            return await self._allocate(request, context)
+
+    async def _allocate(self, request, context):
         responses = []
         for creq in request.container_requests:
             ids = list(creq.devicesIDs)

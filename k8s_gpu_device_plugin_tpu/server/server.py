@@ -30,6 +30,18 @@ from prometheus_client import REGISTRY, generate_latest, CONTENT_TYPE_LATEST
 from k8s_gpu_device_plugin_tpu.config import Config
 from k8s_gpu_device_plugin_tpu.metrics import DeviceMetrics, HttpMetrics
 from k8s_gpu_device_plugin_tpu.metrics.runtime_metrics import usage_reader_from_config
+from k8s_gpu_device_plugin_tpu.obs.http import (
+    profile_payload,
+    route_label,
+    trace_detail_payload,
+    traces_payload,
+)
+from k8s_gpu_device_plugin_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
 from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
 from k8s_gpu_device_plugin_tpu.utils.envelope import failed, success
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
@@ -50,12 +62,27 @@ class Server:
         logger: logging.Logger | None = None,
         registry=REGISTRY,
         usage_reader=None,
+        profiler=None,
     ) -> None:
         self.cfg = cfg
         self.manager = manager
         self.ready = ready
         self.log = logger or get_logger()
         self.registry = registry
+        # optional benchmark.profiler.Profiler (main.py --benchmark):
+        # /debug/profile serves its live BlockSampler summary
+        self.profiler = profiler
+        self.tracer = get_tracer()
+        # span-duration histograms (obs/prom.py) ride this registry only
+        # when tracing is on at construction — a disabled tracer never
+        # produces spans, so the listener would be dead weight
+        self.span_metrics = None
+        if self.tracer.enabled:
+            from k8s_gpu_device_plugin_tpu.obs.prom import SpanMetrics
+
+            self.span_metrics = SpanMetrics(registry=registry).install(
+                self.tracer
+            )
         self.http_metrics = HttpMetrics(registry=registry)
         # ``usage_reader`` lets main.py share ONE reader (one gRPC channel
         # set) between these gauges and the manager's health assessor —
@@ -65,7 +92,10 @@ class Server:
             usage_reader=usage_reader or usage_reader_from_config(cfg),
             registry=registry,
         )
-        self.routes = {"/", "/health", "/metrics", "/restart"}
+        self.routes = {
+            "/", "/health", "/metrics", "/restart",
+            "/debug/traces", "/debug/traces/{trace_id}", "/debug/profile",
+        }
         self.app = self._build_app()
         self._runner: web.AppRunner | None = None
         self.port: int | None = None  # actual bound port (useful when 0)
@@ -85,6 +115,9 @@ class Server:
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/restart", self._restart)
+        app.router.add_get("/debug/traces", self._debug_traces)
+        app.router.add_get("/debug/traces/{trace_id}", self._debug_trace_one)
+        app.router.add_get("/debug/profile", self._debug_profile)
         return app
 
     # --- handlers (≙ router/api.go) ---
@@ -116,13 +149,53 @@ class Server:
         self.manager.restart()
         return web.json_response(success("restart scheduled"))
 
+    # --- observability debug surface (obs/) ---
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        return web.json_response(success(traces_payload(self.tracer)))
+
+    async def _debug_trace_one(self, request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        payload = trace_detail_payload(self.tracer, trace_id)
+        if payload is None:
+            return web.json_response(
+                failed(f"trace {trace_id!r} not in buffer"), status=404
+            )
+        # raw Chrome/Perfetto trace-event JSON, NOT enveloped: the body
+        # must load in chrome://tracing / ui.perfetto.dev as saved
+        return web.json_response(payload)
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        payload = profile_payload(self.profiler)
+        if payload is None:
+            return web.json_response(
+                failed("profiling not enabled (start with benchmark: true)"),
+                status=404,
+            )
+        return web.json_response(success(payload))
+
     # --- middleware (≙ echo Recover + request logger, server/server.go:40-43) ---
 
     @web.middleware
     async def _recovery_middleware(self, request: web.Request, handler):
         """Structured access log for every request; unexpected handler
         exceptions become an enveloped 500 with a stack trace in the log
-        instead of aiohttp's bare error page."""
+        instead of aiohttp's bare error page. With tracing enabled, each
+        request runs under a span (joining the caller's W3C
+        ``traceparent`` when present), so the access-log record carries
+        the trace/span ids and the response echoes a ``traceparent``."""
+        if not self.tracer.enabled:
+            return await self._handle_logged(request, handler, None)
+        remote = parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        # span name carries the CANONICAL route (bounded — it becomes a
+        # histogram label in obs/prom.py); the raw path rides as an attr
+        with self.tracer.span(
+            f"{request.method} {route_label(request)}", component="http",
+            parent=remote, method=request.method, path=request.path,
+        ) as span:
+            return await self._handle_logged(request, handler, span)
+
+    async def _handle_logged(self, request: web.Request, handler, span):
         start = time.monotonic()
         try:
             response = await handler(request)
@@ -146,6 +219,9 @@ class Server:
                 "duration_ms": round((time.monotonic() - start) * 1000, 2),
             }},
         )
+        if span is not None:
+            span.set(status_code=response.status)
+            response.headers[TRACEPARENT_HEADER] = format_traceparent(span)
         if isinstance(response, web.HTTPException):
             raise response
         return response
@@ -191,3 +267,8 @@ class Server:
         finally:
             await self._runner.cleanup()
             self._runner = None
+            if self.span_metrics is not None:
+                # detach the tracer listener so a later server (tests,
+                # daemon restart) can register the same collector names
+                self.span_metrics.close()
+                self.span_metrics = None

@@ -63,6 +63,14 @@ from aiohttp import web
 from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    attach,
+    current_context,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
 from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
 log = get_logger()
@@ -109,8 +117,9 @@ class InferenceEngine:
         self._dead = threading.Event()
         self._subq: list[
             tuple[int, list[int], int, tuple, "Sampler | None", int, tuple,
-                  int | None]
-        ] = []  # (eid, prompt, max_new, stop, sampler, adapter, bias, seed)
+                  int | None, object]
+        ] = []  # (eid, prompt, max_new, stop, sampler, adapter, bias,
+        #          seed, trace_parent)
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -161,6 +170,11 @@ class InferenceEngine:
             raise ValueError(
                 "per-request seeds are not supported by this engine"
             )
+        # Thread-hop propagation: the batcher admits on the engine thread,
+        # where THIS task's contextvars are invisible — capture the active
+        # span here (the HTTP middleware's) and re-attach it around
+        # cb.submit so the request's span tree parents under it.
+        trace_parent = current_context() if get_tracer().enabled else None
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         with self._lock:
@@ -174,7 +188,7 @@ class InferenceEngine:
             self._next_eid += 1
             self._subq.append(
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
-                 adapter, logit_bias, seed)
+                 adapter, logit_bias, seed, trace_parent)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -211,11 +225,15 @@ class InferenceEngine:
     def _admit_submissions(self) -> None:
         with self._lock:
             batch, self._subq = self._subq, []
-        for eid, prompt, max_new, stop, sampler, adapter, bias, seed in batch:
-            rid = self.cb.submit(
-                prompt, max_new=max_new, stop=[list(st) for st in stop],
-                sampler=sampler, adapter=adapter, logit_bias=bias, seed=seed,
-            )
+        for (eid, prompt, max_new, stop, sampler, adapter, bias, seed,
+             trace_parent) in batch:
+            with attach(trace_parent):
+                rid = self.cb.submit(
+                    prompt, max_new=max_new,
+                    stop=[list(st) for st in stop],
+                    sampler=sampler, adapter=adapter, logit_bias=bias,
+                    seed=seed,
+                )
             self._rid_to_eid[rid] = eid
 
     def _apply_cancellations(self) -> None:
@@ -375,9 +393,14 @@ class InferenceServer:
         self.adapter_names: tuple[str, ...] = tuple(
             getattr(engine.cb, "adapter_names", ())
         )
-        self.app = web.Application()
+        self.tracer = get_tracer()
+        self.app = web.Application(middlewares=[self._trace_middleware])
         self.app.router.add_post("/v1/generate", self._generate)
         self.app.router.add_get("/v1/health", self._health)
+        self.app.router.add_get("/debug/traces", self._debug_traces)
+        self.app.router.add_get(
+            "/debug/traces/{trace_id}", self._debug_trace_one
+        )
         if registry is not None:
             self.app.router.add_get("/metrics", self._metrics)
         # OpenAI-compatible façade (serving/openai_api.py): /v1/completions,
@@ -403,6 +426,52 @@ class InferenceServer:
                 f"unknown adapter {name!r}; serving: "
                 f"{list(self.adapter_names) or '(none)'}"
             ) from None
+
+    @web.middleware
+    async def _trace_middleware(self, request: web.Request, handler):
+        """Per-request span (component ``serving_http``), joining the
+        caller's W3C ``traceparent`` and echoing one back. The span is
+        the ambient parent for everything the handler does on this task
+        — including ``engine.submit``, which carries it across the
+        engine-thread hop to the batcher's request tree."""
+        if not self.tracer.enabled:
+            return await handler(request)
+        from k8s_gpu_device_plugin_tpu.obs.http import route_label
+
+        remote = parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        # canonical route in the span NAME (it becomes a histogram label
+        # — raw paths would be unbounded); raw path as an attribute
+        with self.tracer.span(
+            f"{request.method} {route_label(request)}",
+            component="serving_http",
+            parent=remote, method=request.method, path=request.path,
+        ) as span:
+            try:
+                response = await handler(request)
+            except web.HTTPException as http_err:
+                span.set(status_code=http_err.status)
+                http_err.headers[TRACEPARENT_HEADER] = format_traceparent(span)
+                raise
+            span.set(status_code=response.status)
+            if not response.prepared:  # SSE streams already sent headers
+                response.headers[TRACEPARENT_HEADER] = format_traceparent(span)
+            return response
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        from k8s_gpu_device_plugin_tpu.obs.http import traces_payload
+
+        return web.json_response(traces_payload(self.tracer))
+
+    async def _debug_trace_one(self, request: web.Request) -> web.Response:
+        from k8s_gpu_device_plugin_tpu.obs.http import trace_detail_payload
+
+        payload = trace_detail_payload(
+            self.tracer, request.match_info["trace_id"]
+        )
+        if payload is None:
+            return web.json_response({"error": "trace not in buffer"},
+                                     status=404)
+        return web.json_response(payload)
 
     async def _health(self, request: web.Request) -> web.Response:
         stats = self.engine.stats()
@@ -522,11 +591,18 @@ class InferenceServer:
                 if want_logprobs:
                     payload["completions_logprobs"] = [d[1] for d in drained]
             if self.tokenizer is not None:
-                payload["text"] = self.tokenizer.decode(drained[0][0])
-                if n > 1:
-                    payload["completions_text"] = [
-                        self.tokenizer.decode(d[0]) for d in drained
-                    ]
+                # detokenize phase of the request trace (the batcher owns
+                # admit/prefill/decode/retire; text assembly happens here
+                # at the HTTP boundary)
+                with self.tracer.span(
+                    "detokenize", component="serving",
+                    tokens=len(drained[0][0]),
+                ):
+                    payload["text"] = self.tokenizer.decode(drained[0][0])
+                    if n > 1:
+                        payload["completions_text"] = [
+                            self.tokenizer.decode(d[0]) for d in drained
+                        ]
             return web.json_response(payload)
 
         resp = web.StreamResponse(
@@ -546,7 +622,11 @@ class InferenceServer:
                     # themselves with the same caveat)
                     done: dict = {"done": True}
                     if self.tokenizer is not None:
-                        done["text"] = self.tokenizer.decode(streamed)
+                        with self.tracer.span(
+                            "detokenize", component="serving",
+                            tokens=len(streamed),
+                        ):
+                            done["text"] = self.tokenizer.decode(streamed)
                     await resp.write(f"data: {json.dumps(done)}\n\n".encode())
                     break
                 tok, lp = item
@@ -759,7 +839,18 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--draftCheckpointDir", default="")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft proposals verified per round")
+    parser.add_argument("--tracing", action="store_true",
+                        help="span tracing (obs/): request span trees on "
+                        "GET /debug/traces, trace ids in JSON logs, span-"
+                        "duration histograms on /metrics; default off")
     args = parser.parse_args(argv)
+
+    if args.tracing:
+        from k8s_gpu_device_plugin_tpu.obs.prom import SpanMetrics
+        from k8s_gpu_device_plugin_tpu.obs.trace import configure
+        from prometheus_client import REGISTRY as _REGISTRY
+
+        SpanMetrics(registry=_REGISTRY).install(configure(enabled=True))
 
     from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
 

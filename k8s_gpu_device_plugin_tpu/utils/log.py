@@ -22,6 +22,8 @@ import os
 import sys
 from dataclasses import dataclass, field
 
+from k8s_gpu_device_plugin_tpu.obs.trace import current_trace_ids
+
 _LEVELS = {
     "debug": logging.DEBUG,
     "info": logging.INFO,
@@ -51,6 +53,19 @@ class JsonFormatter(logging.Formatter):
             "caller": f"{record.filename}:{record.lineno}",
             "msg": record.getMessage(),
         }
+        # Trace correlation: prefer the ids TraceContextFilter stamped at
+        # emit time (a handler may format much later — queue handlers,
+        # test captures); fall back to the ambient span for records that
+        # bypassed the project logger's filter chain.
+        trace_id = getattr(record, "trace_id", None)
+        span_id = getattr(record, "span_id", None)
+        if trace_id is None:
+            ids = current_trace_ids()
+            if ids is not None:
+                trace_id, span_id = ids
+        if trace_id is not None:
+            entry["trace_id"] = trace_id
+            entry["span_id"] = span_id
         if record.exc_info and record.exc_info[0] is not None:
             entry["exc"] = self.formatException(record.exc_info)
         extra = getattr(record, "fields", None)
@@ -100,6 +115,22 @@ class ConsoleFormatter(logging.Formatter):
         if record.exc_info and record.exc_info[0] is not None:
             line = f"{line}\n{self.formatException(record.exc_info)}"
         return line
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamp the ambient trace/span ids onto every record at EMIT time.
+
+    Logger filters run in the emitting call stack, where the contextvar
+    still holds the active span; handlers may format later (rotation,
+    queue handlers, test captures) from another context entirely. One
+    ContextVar read per record when tracing is off/idle."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "trace_id"):
+            ids = current_trace_ids()
+            if ids is not None:
+                record.trace_id, record.span_id = ids
+        return True
 
 
 class _ExactLevelFilter(logging.Filter):
@@ -167,6 +198,11 @@ def init_logger(cfg: LogConfig | None = None) -> logging.Logger:
     for h in list(logger.handlers):
         logger.removeHandler(h)
         h.close()
+    # idempotent across re-inits: exactly one trace-context stamper
+    for f in list(logger.filters):
+        if isinstance(f, TraceContextFilter):
+            logger.removeFilter(f)
+    logger.addFilter(TraceContextFilter())
 
     formatter = JsonFormatter()
     if cfg.file_dir:

@@ -1,0 +1,282 @@
+"""Span tracing subsystem: core semantics, propagation across asyncio
+tasks and thread hops, log correlation, Perfetto export, the
+/debug/traces + /debug/profile HTTP surface, serving latency
+histograms, and the disabled-path overhead bound.
+"""
+
+import asyncio
+import json
+import logging
+import time
+
+import pytest
+
+from k8s_gpu_device_plugin_tpu.obs.export import to_chrome_trace, write_trace_file
+from k8s_gpu_device_plugin_tpu.obs.trace import (
+    NOOP_SPAN,
+    Tracer,
+    attach,
+    configure,
+    current_context,
+    current_trace_ids,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+
+
+@pytest.fixture
+def tracer():
+    """The global tracer, enabled for one test and restored after.
+
+    The GLOBAL one on purpose: instrumentation sites bind it at import,
+    so these tests must prove the real wiring, not a lookalike."""
+    tr = configure(enabled=True)
+    tr.clear()
+    try:
+        yield tr
+    finally:
+        tr.enabled = False
+        tr.clear()
+
+
+# --- core semantics -------------------------------------------------------
+
+
+def test_span_tree_and_ring_buffer(tracer):
+    with tracer.span("root", component="test", k="v") as root:
+        with tracer.span("child", component="test") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+        grand = tracer.span("manual", component="test", parent=child)
+        grand.set(extra=1).end()
+        assert grand.parent_id == child.span_id
+
+    summaries = tracer.traces()
+    assert len(summaries) == 1
+    top = summaries[0]
+    assert top["root"] == "root" and top["n_spans"] == 3
+    assert top["status"] == "ok"
+    spans = tracer.get_trace(top["trace_id"])
+    assert {s["name"] for s in spans} == {"root", "child", "manual"}
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "root"
+
+
+def test_trace_completes_when_last_span_ends(tracer):
+    """Completion is structural (open-span count), not root-order: a
+    child ending AFTER its root — the serving thread-hop shape — still
+    finishes the trace."""
+    root = tracer.span("root", component="test")
+    child = tracer.span("child", component="test", parent=root)
+    root.end()
+    assert tracer.traces() == []  # child still open
+    child.end()
+    assert len(tracer.traces()) == 1
+
+
+def test_exception_marks_span_error(tracer):
+    with pytest.raises(ValueError):
+        with tracer.span("boom", component="test"):
+            raise ValueError("nope")
+    top = tracer.traces()[0]
+    assert top["status"] == "error"
+    (span,) = tracer.get_trace(top["trace_id"])
+    assert "ValueError" in span["attrs"]["error"]
+
+
+def test_ring_buffer_bounded():
+    tr = Tracer(max_traces=3)
+    tr.enabled = True
+    for i in range(10):
+        tr.span(f"t{i}", component="test").end()
+    assert len(tr.traces()) == 3
+    assert tr.traces()[0]["root"] == "t9"  # newest first
+
+
+def test_live_table_bounded_by_eviction():
+    """A span leaked open (instrumented code died without ending it)
+    must not pin its trace in memory forever: past max_live_traces the
+    oldest live trace is evicted to the ring marked incomplete."""
+    tr = Tracer(max_traces=4)
+    tr.max_live_traces = 8
+    tr.enabled = True
+    leaked = [tr.span(f"leak{i}", component="test") for i in range(20)]
+    assert len(tr._live) <= 8
+    evicted = [t for t in tr.traces() if t["incomplete"]]
+    # the leaked span never ended, so an evicted trace has no finished
+    # span records — only the incomplete marker
+    assert evicted and all(t["n_spans"] == 0 for t in evicted)
+    for span in leaked:  # ending an evicted span is harmless
+        span.end()
+    assert len(tr._live) == 0
+
+
+def test_span_cap_per_trace():
+    tr = Tracer(max_spans_per_trace=4)
+    tr.enabled = True
+    with tr.span("root", component="test"):
+        for i in range(10):
+            tr.span(f"s{i}", component="test").end()
+    top = tr.traces()[0]
+    assert top["n_spans"] == 4 and top["dropped_spans"] == 7
+
+
+# --- propagation ----------------------------------------------------------
+
+
+def test_propagation_across_create_task(tracer):
+    """contextvars flow into asyncio.create_task automatically: a span
+    started in the child task parents under the caller's span."""
+
+    async def main():
+        with tracer.span("parent", component="test") as parent:
+            async def child():
+                with tracer.span("child", component="test") as span:
+                    return span.trace_id, span.parent_id
+
+            return parent, await asyncio.create_task(child())
+
+    parent, (trace_id, parent_id) = asyncio.run(main())
+    assert trace_id == parent.trace_id
+    assert parent_id == parent.span_id
+
+
+def test_propagation_across_run_in_executor(tracer):
+    """Thread hops do NOT inherit contextvars: prove the capture/attach
+    pattern carries the trace across loop.run_in_executor."""
+
+    async def main():
+        with tracer.span("parent", component="test") as parent:
+            ctx = current_context()
+
+            def worker():
+                # a bare thread sees no ambient span...
+                assert current_context() is None
+                with attach(ctx):
+                    with tracer.span("in_thread", component="test") as span:
+                        return span.trace_id, span.parent_id
+
+            loop = asyncio.get_running_loop()
+            return parent, await loop.run_in_executor(None, worker)
+
+    parent, (trace_id, parent_id) = asyncio.run(main())
+    assert trace_id == parent.trace_id
+    assert parent_id == parent.span_id
+
+
+def test_traceparent_roundtrip_and_validation(tracer):
+    with tracer.span("s", component="test") as span:
+        header = format_traceparent(span)
+    ctx = parse_traceparent(header)
+    assert ctx is not None
+    assert ctx.trace_id == span.trace_id and ctx.span_id == span.span_id
+    # a remote parent re-parents a local span under the caller's trace
+    child = tracer.span("remote_child", component="test", parent=ctx)
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    child.end()
+
+    for bad in (
+        None, "", "garbage", "00-short-span-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # reserved version
+        "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",  # non-hex version
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+# --- log correlation ------------------------------------------------------
+
+
+def _json_record(msg="hello", **fields) -> dict:
+    from k8s_gpu_device_plugin_tpu.utils.log import JsonFormatter, get_logger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = get_logger()
+    handler = Capture()
+    logger.addHandler(handler)
+    try:
+        logger.info(msg, extra={"fields": fields} if fields else None)
+    finally:
+        logger.removeHandler(handler)
+    return json.loads(JsonFormatter().format(records[-1]))
+
+
+def test_log_records_carry_trace_ids_inside_span(tracer):
+    with tracer.span("op", component="test") as span:
+        entry = _json_record("traced line", k="v")
+    assert entry["trace_id"] == span.trace_id
+    assert entry["span_id"] == span.span_id
+    assert entry["k"] == "v"  # structured fields unaffected
+
+
+def test_log_records_clean_outside_span(tracer):
+    entry = _json_record("untraced line")
+    assert "trace_id" not in entry and "span_id" not in entry
+
+
+def test_current_trace_ids_is_none_when_idle(tracer):
+    assert current_trace_ids() is None
+    with tracer.span("op", component="test") as span:
+        assert current_trace_ids() == (span.trace_id, span.span_id)
+    assert current_trace_ids() is None
+
+
+# --- exporter -------------------------------------------------------------
+
+
+def test_chrome_trace_export(tracer, tmp_path):
+    with tracer.span("root", component="serving", rid=7):
+        with tracer.span("child", component="http"):
+            pass
+    trace_id = tracer.traces()[0]["trace_id"]
+    payload = to_chrome_trace(tracer.get_trace(trace_id))
+    events = payload["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert len(complete) == 2
+    assert all(e["dur"] >= 1 and isinstance(e["ts"], int) for e in complete)
+    # components render as named rows
+    assert {m["args"]["name"] for m in meta} == {"serving", "http"}
+    tids = {m["args"]["name"]: m["tid"] for m in meta}
+    by_name = {e["name"]: e for e in complete}
+    assert by_name["root"]["tid"] == tids["serving"]
+    assert by_name["root"]["args"]["rid"] == 7
+
+    path = write_trace_file(
+        tracer.get_trace(trace_id), str(tmp_path / "t.json")
+    )
+    with open(path) as f:
+        assert json.load(f)["traceEvents"]
+
+
+# --- disabled-path overhead -----------------------------------------------
+
+
+def test_disabled_tracer_is_noop_and_cheap():
+    tr = get_tracer()
+    assert tr.enabled is False
+    # no allocation: every disabled span() is the ONE shared no-op
+    assert tr.span("x", component="y") is NOOP_SPAN
+    assert tr.span("z") is tr.span("w")
+
+    # The decode-loop instrumentation shape: one enabled check per
+    # potential span. 200k checks must be noise (<0.25s even on a busy
+    # CI box) — the "compiles down to a no-op span check" bound.
+    spans = 0
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if tr.enabled:  # the per-site guard models/batching.py uses
+            spans += 1
+    elapsed = time.perf_counter() - t0
+    assert spans == 0
+    assert elapsed < 0.25, f"disabled-path guard too slow: {elapsed:.3f}s"
+    # and the buffer stays untouched
+    assert tr.traces() == []

@@ -1,0 +1,391 @@
+"""End-to-end observability: a serving request through the
+ContinuousBatcher produces the admit->prefill->decode->retire span tree,
+retrievable as Perfetto JSON via GET /debug/traces, with the same
+trace_id injected into the JSON log records emitted during the request;
+TTFT/inter-token histograms fill; /debug/profile serves the live
+BlockSampler summary; traceparent joins HTTP traces end to end.
+"""
+
+import asyncio
+import json
+import logging
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import pytest
+from prometheus_client import CollectorRegistry
+
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.obs.trace import configure, parse_traceparent
+from k8s_gpu_device_plugin_tpu.utils.log import JsonFormatter, get_logger
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture
+def tracer():
+    tr = configure(enabled=True)
+    tr.clear()
+    try:
+        yield tr
+    finally:
+        tr.enabled = False
+        tr.clear()
+
+
+@pytest.fixture
+def debug_log_records():
+    """Capture DEBUG-and-up records off the project logger (the shared
+    captured_log_records fixture filters at INFO; the batcher's
+    per-request lines are debug-level)."""
+    records: list[logging.LogRecord] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=logging.DEBUG)
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _span_names(spans):
+    return {s["name"] for s in spans}
+
+
+def test_batcher_request_span_tree_bucketed(setup, tracer, debug_log_records):
+    """The acceptance tree on the bucketed-prefill path, plus trace_id
+    correlation in the JSON log records emitted during the request."""
+    cfg, params = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           prompt_buckets=(8, 16))
+    rid = cb.submit(_prompt(1, 5, cfg), max_new=4)
+    cb.run()
+
+    (summary,) = tracer.traces()
+    assert summary["root"] == "request" and summary["status"] == "ok"
+    spans = tracer.get_trace(summary["trace_id"])
+    assert {"request", "admit", "prefill", "decode", "retire"} <= \
+        _span_names(spans)
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["request"]
+    assert root["parent_id"] is None and root["attrs"]["rid"] == rid
+    for child in ("admit", "prefill", "decode", "retire"):
+        assert by_name[child]["parent_id"] == root["span_id"]
+        assert by_name[child]["trace_id"] == root["trace_id"]
+    assert by_name["retire"]["attrs"]["reason"] == "budget"
+    assert by_name["decode"]["attrs"]["tokens"] == 4
+
+    # the request's log records carry the SAME trace_id once formatted
+    fmt = JsonFormatter()
+    entries = [json.loads(fmt.format(r)) for r in debug_log_records]
+    correlated = [e for e in entries if e.get("trace_id") == root["trace_id"]]
+    assert {e["msg"] for e in correlated} >= {
+        "request submitted", "request retired",
+    }
+
+
+def test_batcher_request_span_tree_chunked(setup, tracer):
+    """Chunked-prefill admission: prefill_chunk spans replace the
+    bucketed prefill span; multi-chunk prompts produce several."""
+    cfg, params = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           chunked_prefill=4)
+    cb.submit(_prompt(2, 10, cfg), max_new=3)
+    cb.run()
+    spans = tracer.get_trace(tracer.traces()[0]["trace_id"])
+    chunks = [s for s in spans if s["name"] == "prefill_chunk"]
+    assert len(chunks) >= 2  # 10 tokens / C=4 -> intermediate + final
+    assert any(s["attrs"].get("final") for s in chunks)
+    assert {"request", "admit", "decode", "retire"} <= _span_names(spans)
+
+
+def test_cancel_closes_span_tree(setup, tracer):
+    cfg, params = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           prompt_buckets=(8,))
+    rid = cb.submit(_prompt(3, 4, cfg), max_new=32)
+    cb.step()  # admit + first decode
+    assert cb.cancel(rid)
+    (summary,) = tracer.traces()  # cancel completes the trace
+    spans = tracer.get_trace(summary["trace_id"])
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["retire"]["attrs"]["reason"] == "cancelled"
+
+
+def test_ttft_and_inter_token_histograms(setup):
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    reg = CollectorRegistry()
+    metrics = ServingMetrics(registry=reg)
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           prompt_buckets=(8,), metrics=metrics)
+    cb.submit(_prompt(4, 5, cfg), max_new=4)
+    cb.submit(_prompt(5, 6, cfg), max_new=3)
+    cb.run()
+
+    def sample(name):
+        return reg.get_sample_value(name)
+
+    assert sample("tpu_serving_ttft_seconds_count") == 2
+    # 2 requests emit 4+3 tokens; the first of each arrives at prefill,
+    # so inter-token gaps = (4-1) + (3-1)
+    assert sample("tpu_serving_inter_token_seconds_count") == 5
+    assert sample("tpu_serving_ttft_seconds_sum") > 0
+    metrics.close()
+
+
+def test_batcher_disabled_tracing_leaves_no_traces(setup):
+    cfg, params = setup
+    tr = configure(enabled=False)
+    tr.clear()
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           prompt_buckets=(8,))
+    cb.submit(_prompt(6, 4, cfg), max_new=2)
+    cb.run()
+    assert tr.traces() == []
+
+
+# --- control-plane HTTP surface -------------------------------------------
+
+
+async def _control_plane(tmp_path, profiler=None, **cfg_kwargs):
+    from k8s_gpu_device_plugin_tpu.config import Config
+    from k8s_gpu_device_plugin_tpu.device.fake import FakeBackend
+    from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+    from k8s_gpu_device_plugin_tpu.server.server import Server
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    cfg = Config(
+        kubelet_socket_dir=str(tmp_path),
+        web_listen_address="127.0.0.1:0",
+        libtpu_path="",
+        **cfg_kwargs,
+    )
+    ready = Latch()
+    manager = PluginManager(cfg, ready, backend=FakeBackend("v5e-4"))
+    registry = CollectorRegistry()
+    server = Server(cfg, manager, ready, registry=registry,
+                    profiler=profiler)
+    stop = asyncio.Event()
+    mtask = asyncio.create_task(manager.start())
+    stask = asyncio.create_task(server.run(stop))
+    for _ in range(100):
+        if server.port:
+            break
+        await asyncio.sleep(0.05)
+    assert server.port, "server did not bind"
+
+    async def teardown():
+        stop.set()
+        await manager.stop()
+        await asyncio.gather(mtask, stask, return_exceptions=True)
+
+    return f"http://127.0.0.1:{server.port}", registry, teardown
+
+
+def test_debug_traces_endpoint_serves_batcher_trace(setup, tracer, tmp_path):
+    """The acceptance path: drive a request through the batcher, then
+    fetch its span tree over GET /debug/traces as Perfetto JSON."""
+    cfg, params = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           prompt_buckets=(8,))
+    cb.submit(_prompt(7, 5, cfg), max_new=3)
+    cb.run()
+    want = next(t for t in tracer.traces() if t["root"] == "request")
+
+    async def body():
+        base, _, teardown = await _control_plane(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/debug/traces") as resp:
+                    assert resp.status == 200
+                    data = (await resp.json())["data"]
+                    assert data["enabled"] is True
+                    ids = [t["trace_id"] for t in data["traces"]]
+                    assert want["trace_id"] in ids
+                async with session.get(
+                    f"{base}/debug/traces/{want['trace_id']}"
+                ) as resp:
+                    assert resp.status == 200
+                    chrome = await resp.json()
+                # valid Chrome/Perfetto trace-event JSON with the tree
+                events = chrome["traceEvents"]
+                complete = [e for e in events if e["ph"] == "X"]
+                names = {e["name"] for e in complete}
+                assert {"request", "admit", "prefill", "decode",
+                        "retire"} <= names
+                assert all(
+                    e["args"]["trace_id"] == want["trace_id"]
+                    for e in complete
+                )
+                async with session.get(
+                    f"{base}/debug/traces/{'0' * 32}"
+                ) as resp:
+                    assert resp.status == 404
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_control_plane_traceparent_and_span_metrics(tracer, tmp_path):
+    """HTTP middleware: an inbound W3C traceparent re-parents the
+    request span (response echoes the same trace id), and span-duration
+    histograms land on the server registry."""
+    caller = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+    async def body():
+        base, registry, teardown = await _control_plane(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"{base}/health", headers={"traceparent": caller}
+                ) as resp:
+                    assert resp.status == 200
+                    echoed = parse_traceparent(resp.headers["traceparent"])
+            assert echoed is not None and echoed.trace_id == "ab" * 16
+            # the joined trace is in the buffer under the CALLER's id
+            spans = tracer.get_trace("ab" * 16)
+            assert spans and spans[0]["name"] == "GET /health"
+            assert spans[0]["parent_id"] == "cd" * 8
+            count = registry.get_sample_value(
+                "tpu_obs_span_duration_seconds_count",
+                {"component": "http", "operation": "GET /health"},
+            )
+            assert count == 1
+        finally:
+            await teardown()
+
+    run(body())
+
+
+def test_debug_profile_endpoint(tmp_path):
+    from k8s_gpu_device_plugin_tpu.benchmark.profiler import Profiler
+
+    profiler = Profiler(out_dir=str(tmp_path / "prof"))
+    profiler.run()
+    try:
+        async def body():
+            base, _, teardown = await _control_plane(
+                tmp_path, profiler=profiler
+            )
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(f"{base}/debug/profile") as resp:
+                        assert resp.status == 200
+                        data = (await resp.json())["data"]
+                    assert data["running"] is True
+                    assert {"p50", "p99", "max"} <= set(
+                        data["block"]["loop_lag_ms"]
+                    )
+                    assert isinstance(data["block"]["lock_waits"], list)
+            finally:
+                await teardown()
+
+        run(body())
+    finally:
+        profiler.stop()
+
+
+def test_debug_profile_404_without_profiler(tmp_path):
+    async def body():
+        base, _, teardown = await _control_plane(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/debug/profile") as resp:
+                    assert resp.status == 404
+        finally:
+            await teardown()
+
+    run(body())
+
+
+# --- serving HTTP plane ----------------------------------------------------
+
+
+def test_serving_http_request_joins_batcher_tree(setup, tracer):
+    """Full serving path: HTTP POST -> engine thread hop -> batcher
+    span tree under the serving_http root, fetched back over the
+    serving server's own /debug/traces."""
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params = setup
+    engine = InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                             chunked_prefill=8)
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    prompt = _prompt(8, 5, cfg)
+
+    async def body():
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(f"{base}/v1/generate", json={
+                    "prompt": prompt, "max_new": 3,
+                }) as resp:
+                    assert resp.status == 200
+                    parent = parse_traceparent(resp.headers["traceparent"])
+                assert parent is not None
+                # the HTTP span's trace completes once the request
+                # retires on the engine thread; poll the buffer briefly
+                spans = None
+                for _ in range(100):
+                    spans = tracer.get_trace(parent.trace_id)
+                    if spans and any(
+                        s["name"] == "retire" for s in spans
+                    ) and any(
+                        s["name"].startswith("POST") for s in spans
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                by_name = {s["name"]: s for s in spans}
+                assert {"request", "admit", "decode", "retire"} <= set(by_name)
+                http_root = by_name["POST /v1/generate"]
+                assert http_root["parent_id"] is None
+                # the thread hop preserved parentage: batcher root under
+                # the HTTP span
+                assert by_name["request"]["parent_id"] == http_root["span_id"]
+                async with session.get(f"{base}/debug/traces") as resp:
+                    assert resp.status == 200
+                    listed = await resp.json()
+                assert parent.trace_id in [
+                    t["trace_id"] for t in listed["traces"]
+                ]
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    run(body())
