@@ -37,18 +37,27 @@ san-test:
 	$(MAKE) -C $(NATIVE_DIR) san-test
 
 # Full CI gate (SURVEY §5 race-detection/sanitizer row): lint, plain native
-# build + unit test, ASan/UBSan build + test, and the Python suite (which
-# includes the manager concurrency stress in tests/test_manager_stress.py).
-ci: lint native native-test san-test
+# build + unit test, ASan/UBSan build + test, the decode-pipeline
+# host-overhead smoke (CPU; exercises the pipelined AND sync serving
+# loops end to end), and the Python suite (which includes the manager
+# concurrency stress in tests/test_manager_stress.py).
+ci: lint native native-test san-test bench-host-overhead
 	python -m pytest tests/ -q
 
 bench:
 	python bench.py
 
+# CPU-runnable microbench: per-step host work of the continuous batcher
+# with the decode pipeline on vs off (tiny model; prints one JSON line
+# with decode_step_ms{,_sync}, device_step_ms, host_overhead_pct{,_sync}).
+bench-host-overhead:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.host_overhead
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
-.PHONY: all native native-test proto lint san-test ci test bench clean watch
+.PHONY: all native native-test proto lint san-test ci test bench \
+	bench-host-overhead clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -211,6 +211,10 @@ def lora_decode_bench(
             active=jnp.ones((batch,), bool),
             presence=st.presence,
             key=st.key,
+            # decode_step gates emission on the device-side budget now;
+            # give every row headroom for the whole timed run
+            budget=jnp.full((batch,), steps + 1, jnp.int32),
+            draws=st.draws,
         )
 
     allowed = jnp.ones((batch,), bool)

@@ -1,0 +1,77 @@
+"""Decode-pipeline host-overhead microbench (CPU-runnable; `make
+bench-host-overhead`).
+
+Times the per-step host work of the continuous batcher with the decode
+pipeline on vs off, at a deliberately tiny model size so it runs on any
+CPU in seconds: the model compute is small enough that the step time is
+dominated by exactly the host-side token processing (stop matching,
+budget retirement, metrics, bookkeeping) the pipeline exists to hide.
+The interesting numbers:
+
+- ``decode_step_ms`` / ``decode_step_ms_sync``: steady-state step wall
+  time, pipelined vs synchronous
+- ``device_step_ms``: the same step with NO host token processing (raw
+  ``decode_step`` dispatches)
+- ``host_overhead_pct`` / ``host_overhead_pct_sync``: the share of the
+  step the host adds on top of device compute, per mode — the pipeline
+  is doing its job when the pipelined share sits below the sync one
+- ``pipeline_speedup``: sync step time / pipelined step time
+
+Wired into ``make ci`` as a smoke run: it exercises the pipelined AND
+synchronous loops end to end (admission, chunked prefill, retirement,
+drain) on the CPU backend and fails loudly if either regresses into an
+exception — a cheap canary in front of the full pytest suite.
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def host_overhead_bench(
+    n_slots: int = 4,
+    n_requests: int = 8,
+    max_len: int = 128,
+    max_new: int = 24,
+    prompt_lens: tuple[int, ...] = (8, 17, 29),
+    chunked_prefill: int = 16,
+) -> dict:
+    """Run serve_bench's pipelined-vs-sync A/B at smoke scale and return
+    the host-overhead slice of it as a plain dict (JSON-printable)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    r = serve_bench(
+        cfg, n_slots=n_slots, n_requests=n_requests, max_len=max_len,
+        prompt_lens=prompt_lens, max_new=max_new,
+        prompt_buckets=(32, 64), chunked_prefill=chunked_prefill,
+    )
+    return {
+        "workload": "host_overhead",
+        "decode_step_ms": round(r.decode_step_ms, 3),
+        "decode_step_ms_sync": round(r.decode_step_ms_sync, 3),
+        "device_step_ms": round(r.device_step_ms, 3),
+        "host_overhead_pct": round(r.host_overhead_pct, 1),
+        "host_overhead_pct_sync": round(r.host_overhead_pct_sync, 1),
+        "pipeline_speedup": round(
+            r.decode_step_ms_sync / r.decode_step_ms, 3
+        ) if r.decode_step_ms else None,
+        "tokens_per_second": round(r.tokens_per_second, 1),
+        "tokens_per_second_sync": round(r.tokens_per_second_sync, 1),
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+        "max_new": max_new,
+    }
+
+
+def main() -> int:
+    print(json.dumps(host_overhead_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,16 +7,24 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
 
 - ``tokens_per_second``: generated tokens / wall time (the serving
   aggregate, host orchestration included — that overhead is real in
-  production, so it is NOT subtracted)
+  production, so it is NOT subtracted); measured in the default
+  PIPELINED mode (``pipeline_depth=1``)
 - ``requests_per_second``: completed requests / wall time
 - ``decode_step_ms``: mean decode-step latency once the pipe is full
+- the pipelined-vs-sync A/B pair (``*_sync`` twins of the above) plus
+  ``device_step_ms`` (pure device compute per step, measured by timing
+  raw ``decode_step`` dispatches with no host token processing) and
+  ``host_overhead_pct`` / ``host_overhead_pct_sync`` (step wall time
+  minus device compute, as a percentage of step wall time) — the
+  overlap win measured, not asserted: the pipeline is working when the
+  pipelined host overhead is materially below the sync one.
 
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
 
-Timing: the batcher's host loop synchronizes every step by design
-(emitted tokens come back to the host), so wall-clock timing is already
-serialization-safe on a relayed chip.
+Timing: emitted tokens come back to the host every step (lagged by one
+in pipelined mode), so wall-clock timing is already serialization-safe
+on a relayed chip.
 """
 
 from __future__ import annotations
@@ -25,8 +33,12 @@ import time
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
 
-from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    decode_step,
+)
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 
 
@@ -35,10 +47,21 @@ class ServeBenchResult:
     n_requests: int
     n_slots: int
     total_new_tokens: int
+    # pipelined mode (pipeline_depth=1, the serving default)
     wall_seconds: float
     tokens_per_second: float
     requests_per_second: float
     decode_step_ms: float
+    host_overhead_pct: float
+    # synchronous A/B twin (pipeline_depth=0)
+    wall_seconds_sync: float
+    tokens_per_second_sync: float
+    decode_step_ms_sync: float
+    host_overhead_pct_sync: float
+    # pure device compute per decode step (no host token processing)
+    device_step_ms: float
+    # the mode the primary (non-_sync) numbers were measured in
+    pipeline_depth: int = 1
 
 
 def serve_bench(
@@ -70,34 +93,37 @@ def serve_bench(
 
     prompts = make_prompts()
 
-    def run_once() -> tuple[float, float]:
-        cb = ContinuousBatcher(
+    def make_batcher(depth: int) -> ContinuousBatcher:
+        return ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
+            pipeline_depth=depth,
         )
+
+    def prime(cb: ContinuousBatcher, budget: int) -> None:
+        """Submit one request per slot and step until every slot is
+        DECODING: chunked admission advances one prefill chunk per step,
+        so a single step would leave most slots mid-prefill and the
+        "steady-state" figure would include prefill chunks."""
+        for p in prompts[:n_slots]:
+            cb.submit(p, max_new=budget)
+        guard = 0
+        while cb.pending or cb.prefilling:
+            cb.step()
+            guard += 1
+            assert guard < 10_000, "priming never converged"
+
+    def run_once(depth: int) -> tuple[float, float]:
+        cb = make_batcher(depth)
         for p in prompts:
             cb.submit(p, max_new=max_new)
-        # warm the pipe (compiles happen here), then time steady steps
         t0 = time.perf_counter()
         cb.run()
         wall = time.perf_counter() - t0
         # per-step latency with every slot busy, measured separately so
         # admission prefills don't pollute it
-        cb2 = ContinuousBatcher(
-            params, cfg, n_slots=n_slots, max_len=max_len,
-            prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
-        )
-        for p in prompts[:n_slots]:
-            cb2.submit(p, max_new=max_new)
-        # prime until every slot is DECODING: chunked admission advances
-        # one prefill chunk per step, so a single step would leave most
-        # slots mid-prefill and the "steady-state" figure would include
-        # prefill chunks (the very pollution this split avoids)
-        guard = 0
-        while cb2.pending or cb2.prefilling:
-            cb2.step()
-            guard += 1
-            assert guard < 10_000, "priming never converged"
+        cb2 = make_batcher(depth)
+        prime(cb2, max_new)
         t1 = time.perf_counter()
         steps = 16
         for _ in range(steps):
@@ -105,8 +131,36 @@ def serve_bench(
         step_ms = (time.perf_counter() - t1) / steps * 1000
         return wall, step_ms
 
-    run_once()  # compile pass (all buckets + decode)
-    wall, step_ms = run_once()
+    def device_only_ms(steps: int = 16) -> float:
+        """Pure device compute per decode step: raw ``decode_step``
+        dispatches over a primed full batch, NO host token processing.
+        The batcher is discarded after (its host view desyncs)."""
+        cb = make_batcher(0)
+        # headroom so the device-side budget never deactivates a row
+        # inside the timed window
+        prime(cb, min(max_new + steps + 8, max_len - max(prompt_lens)))
+        allowed = cb._batch_allowed()
+        knobs = cb._batch_knobs()
+        sel, bias, seeds = cb._batch_sel(), cb._batch_bias(), cb._batch_seeds()
+        eos = cb._eos_dev
+        state, emitted = cb.state, None
+        jax.block_until_ready(state.lengths)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, emitted, _ = decode_step(
+                params, state, allowed, eos, cfg, knobs,
+                sel=sel, bias=bias, seeds=seeds,
+            )
+        jax.block_until_ready(emitted)
+        return (time.perf_counter() - t0) / steps * 1000
+
+    run_once(1)  # compile pass (all buckets + decode)
+    wall, step_ms = run_once(1)
+    wall_sync, step_ms_sync = run_once(0)
+    device_ms = device_only_ms()
+
+    def overhead_pct(step: float) -> float:
+        return max(0.0, step - device_ms) / step * 100.0 if step else 0.0
 
     total_new = n_requests * max_new  # eos disabled: every budget runs out
     return ServeBenchResult(
@@ -117,4 +171,10 @@ def serve_bench(
         tokens_per_second=total_new / wall,
         requests_per_second=n_requests / wall,
         decode_step_ms=step_ms,
+        host_overhead_pct=overhead_pct(step_ms),
+        wall_seconds_sync=wall_sync,
+        tokens_per_second_sync=total_new / wall_sync,
+        decode_step_ms_sync=step_ms_sync,
+        host_overhead_pct_sync=overhead_pct(step_ms_sync),
+        device_step_ms=device_ms,
     )

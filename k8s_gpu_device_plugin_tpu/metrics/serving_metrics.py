@@ -93,6 +93,28 @@ class ServingMetrics:
             buckets=LATENCY_BUCKETS,
             registry=registry,
         )
+        # Decode-pipeline observability: how long the host spends
+        # ENQUEUEING a step vs WAITING for one (dispatch time that grows
+        # toward readback time means the overlap stopped hiding the
+        # host), and how often membership changes flush the in-flight
+        # step early (admissions/cancels interrupting steady state).
+        self.decode_dispatch_seconds = Histogram(
+            f"{prefix}_decode_dispatch_seconds",
+            "Time to enqueue one decode step (pipelined mode)",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.decode_readback_seconds = Histogram(
+            f"{prefix}_decode_readback_seconds",
+            "Time to read one decode step back and run its host work",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.pipeline_flushes = Counter(
+            f"{prefix}_pipeline_flushes_total",
+            "In-flight decode steps flushed early on membership changes",
+            registry=registry,
+        )
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
 
@@ -110,6 +132,9 @@ class ServingMetrics:
             self.tokens_per_second,
             self.ttft_seconds,
             self.inter_token_seconds,
+            self.decode_dispatch_seconds,
+            self.decode_readback_seconds,
+            self.pipeline_flushes,
         ):
             try:
                 self._registry.unregister(c)
@@ -158,3 +183,12 @@ class ServingMetrics:
 
     def observe_inter_token(self, seconds: float) -> None:
         self.inter_token_seconds.observe(seconds)
+
+    def observe_dispatch(self, seconds: float) -> None:
+        self.decode_dispatch_seconds.observe(seconds)
+
+    def observe_readback(self, seconds: float) -> None:
+        self.decode_readback_seconds.observe(seconds)
+
+    def on_pipeline_flush(self) -> None:
+        self.pipeline_flushes.inc()

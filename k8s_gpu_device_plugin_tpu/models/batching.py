@@ -27,6 +27,21 @@ The host-side :class:`ContinuousBatcher` owns the request queue, slot
 assignment and per-request budgets; the device state is a plain pytree
 (:class:`BatchState`) so the jitted step stays purely functional.
 
+- **The decode loop is pipelined** (``pipeline_depth=1``, the default):
+  each ``step()`` dispatches decode step t+1 before reading step t back,
+  so stop-sequence matching, retirement, metrics and stream publishing
+  overlap the device's next step instead of serializing with it. Budget
+  gating and the seeded draw index live ON DEVICE (``BatchState.budget``
+  / ``.draws``) and the membership mask / knobs / adapter / bias / seed
+  arrays are cached device residents, so the steady-state loop performs
+  ZERO per-step host->device transfers; the caches are invalidated only
+  on admit/retire/cancel, and the in-flight step is flushed only before
+  an admission that would reuse one of ITS live slots. The one-step lag
+  is exact: a just-retired slot's in-flight token is dropped on
+  readback, the same argument that already covers inactive-slot writes.
+  ``pipeline_depth=0`` restores the fully synchronous loop (debugging;
+  greedy and seeded token/logprob streams are bit-identical either way).
+
 Capability parity note: the reference repo (a device plugin) has no
 serving engine; this extends the workload stack the same way the
 allocator extends its scheduling (SURVEY §2 'Parallelism substrate').
@@ -68,11 +83,24 @@ class BatchState:
     active: jax.Array      # (B,) bool: slot is mid-generation
     presence: jax.Array    # (B, V) bool: repetition-penalty context mask
     key: jax.Array         # PRNG key (split per step, folded per slot)
+    # Per-slot generation budget, ON DEVICE: remaining tokens a slot may
+    # still emit, decremented inside the jitted decode step and gating
+    # emission exactly like ``active``. Host-side retirement used to be
+    # the only budget authority; carrying it here lets the pipelined
+    # loop dispatch step t+1 before reading step t without ever emitting
+    # (or paying a transfer for) a token beyond any slot's budget.
+    budget: jax.Array      # (B,) int32: tokens the slot may still emit
+    # Per-slot draw index for seeded sampling (fold_in(key(seed), i)),
+    # also ON DEVICE: it advances exactly once per emitted token, so the
+    # steady-state decode loop needs no host-rebuilt (B,) draws transfer
+    # and the pipelined dispatch always samples draw i with the true i.
+    draws: jax.Array       # (B,) int32: next seeded-draw index per slot
 
 
 jax.tree_util.register_dataclass(
     BatchState,
-    ("cache", "lengths", "last_token", "active", "presence", "key"),
+    ("cache", "lengths", "last_token", "active", "presence", "key",
+     "budget", "draws"),
     (),
 )
 
@@ -87,6 +115,8 @@ def init_batch_state(
         active=jnp.zeros((n_slots,), bool),
         presence=jnp.zeros((n_slots, cfg.vocab_size), bool),
         key=jax.random.key(seed),
+        budget=jnp.zeros((n_slots,), jnp.int32),
+        draws=jnp.zeros((n_slots,), jnp.int32),
     )
 
 
@@ -99,6 +129,7 @@ def prefill_insert(
     slot: jax.Array,         # scalar int32
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
+    max_new: jax.Array,      # scalar int32: the request's token budget
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
     bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
     seed: jax.Array | None = None,  # (1,) i32 per-request seed (draw 0)
@@ -158,6 +189,9 @@ def prefill_insert(
         active=state.active.at[write].set(True),
         presence=state.presence.at[write].set(seen[0]),
         key=key,
+        # the prefill itself emitted token 1 of max_new (seeded draw 0)
+        budget=state.budget.at[write].set(max_new - 1),
+        draws=state.draws.at[write].set(1),
     ), tok, logp
 
 
@@ -165,14 +199,13 @@ def prefill_insert(
 def decode_step(
     params,
     state: BatchState,
-    allowed: jax.Array,  # (B,) bool: host-side budget gate per slot
+    allowed: jax.Array,  # (B,) bool: host-side membership gate per slot
     eos_id: jax.Array,   # scalar int32 (-1 disables EOS stopping)
     cfg: LlamaConfig,
     knobs: jax.Array,    # (B, 4) f32 per-slot sampler knobs
     sel: jax.Array | None = None,  # (B, N) per-slot adapter one-hots
     bias: jax.Array | None = None,  # (B, V) per-slot logit biases
     seeds: jax.Array | None = None,  # (B,) i32 seeds (-1 = unseeded)
-    draws: jax.Array | None = None,  # (B,) i32 per-slot draw indices
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """One token for every slot (inactive slots compute-and-discard).
 
@@ -180,8 +213,13 @@ def decode_step(
     is -1 for slots that were not active this step. EOS tokens ARE
     emitted (matching ``generate``'s keep-the-EOS semantics) and
     deactivate the slot after.
+
+    ``allowed`` carries ONLY running-set membership (it changes on
+    admit/retire/cancel, never per step — the batcher caches the device
+    array); per-token budget gating and the seeded draw index live in
+    ``state`` so the steady-state loop transfers nothing to the device.
     """
-    was_active = state.active & allowed
+    was_active = state.active & allowed & (state.budget > 0)
     # Inactive slots still compute (fixed shapes) but must not WRITE at
     # their stale lengths: a mid-chunked-prefill neighbor's freshly
     # prefilled rows live there (reviewed failure: fresh slot at length 0
@@ -197,20 +235,30 @@ def decode_step(
     )
     key, sub = jax.random.split(state.key)
     tok, presence = sample_and_mark_dyn(
-        logits[:, -1], sub, knobs, state.presence, bias, seeds, draws
+        logits[:, -1], sub, knobs, state.presence, bias, seeds, state.draws
     )
     logps = token_logprob(logits[:, -1], tok)
     hit_eos = (tok == eos_id) & (eos_id >= 0)
     full = state.lengths + 1 >= cache_len
     emitted = jnp.where(was_active, tok, -1)
+    budget = jnp.where(was_active, state.budget - 1, state.budget)
     return BatchState(
         cache=cache,
         lengths=jnp.where(was_active, state.lengths + 1, state.lengths),
         last_token=jnp.where(was_active, tok, state.last_token),
-        active=was_active & ~hit_eos & ~full,
+        active=was_active & ~hit_eos & ~full & (budget > 0),
         presence=jnp.where(was_active[:, None], presence, state.presence),
         key=key,
+        budget=budget,
+        draws=jnp.where(was_active, state.draws + 1, state.draws),
     ), emitted, logps
+
+
+# distinguishes "cache invalid" (None) from a cached "no plane needed"
+# answer in the per-slot cache slots below, so the steady-state dispatch
+# never re-scans the running set to rediscover that nobody is seeded or
+# biased — one sentinel check per step instead of an O(slots) any()
+_NONE_CACHED = object()
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -298,6 +346,8 @@ class ContinuousBatcher:
         seed: int = 0,
         metrics=None,
         adapters=None,  # lora_serving.AdapterSet: multi-LoRA serving
+        pipeline_depth: int = 1,
+        trace_steps: bool = False,
     ):
         if adapters is not None:
             from k8s_gpu_device_plugin_tpu.models.lora_serving import (
@@ -317,6 +367,9 @@ class ContinuousBatcher:
         self.max_len = max_len
         self.sampler = sampler or Sampler()
         self.eos_id = -1 if eos_id is None else eos_id
+        # device-resident eos scalar: the decode dispatch must not pay
+        # even a scalar H2D per step (the zero-transfer steady state)
+        self._eos_dev = jnp.int32(self.eos_id)
         # chunked_prefill=C > 0: admission runs in C-token chunks
         # interleaved with decode steps (one chunk per step) instead of
         # one bucketed prefill dispatch — running slots' per-token latency
@@ -350,9 +403,33 @@ class ContinuousBatcher:
         # set membership changes (admit/retire/cancel) invalidate it, so
         # steady-state decode pays no per-token host build + transfer
         self._knobs_cache: jax.Array | None = None
+        # same lifecycle for the (n_slots,) membership mask and seeds:
+        # allowed is pure running-set membership (budget gating moved
+        # into BatchState), so it too only changes on admit/retire/cancel
+        self._allowed_cache: jax.Array | None = None
+        self._seeds_cache: jax.Array | None = None
+        # pipeline_depth=1 (the serving default): each step() dispatches
+        # decode step t+1 BEFORE reading step t back, so host per-token
+        # work (stop matching, retirement, metrics, streaming) overlaps
+        # the device's next step. 0 = today's fully synchronous loop
+        # (debugging / the speculative subclass). Token streams are
+        # bit-identical between the two for greedy and seeded requests.
+        if pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 or 1, got {pipeline_depth}"
+            )
+        self.pipeline_depth = int(pipeline_depth)
+        # the (at most one) dispatched-but-unread decode step:
+        # (step_no, emitted, logps) device arrays
+        self._inflight: tuple | None = None
+        self._step_no = 0
         # process-global tracer: every site below guards on .enabled, so
         # the default-off path is one attribute read per potential span
         self.tracer = get_tracer()
+        # per-step decode_dispatch/decode_readback spans are opt-in on
+        # top of tracing (they are batch-scoped root traces — always-on
+        # they would crowd the per-request trees out of the trace ring)
+        self.trace_steps = bool(trace_steps)
 
     def validate(self, prompt_len: int, max_new: int) -> None:
         """Raise ValueError iff submit(prompt of this length) would.
@@ -523,16 +600,21 @@ class ContinuousBatcher:
         """(n_slots, V) per-slot bias planes for the decode step; None
         when NO running request has a bias (the bias-free compile).
         Cached until the running set changes — same lifecycle as the
-        knobs/sel caches (invalidated together)."""
-        if not any(req.bias for req in self.running.values()):
-            return None
+        knobs/sel caches (invalidated together); the no-bias answer is
+        cached too (the _NONE_CACHED sentinel), so the steady-state
+        dispatch never re-scans the running set."""
         if self._bias_cache is None:
-            arr = np.zeros((self.n_slots, self.cfg.vocab_size), np.float32)
-            for slot, req in self.running.items():
-                for tok, b in req.bias:
-                    arr[slot, tok] += b
-            self._bias_cache = jnp.asarray(arr)
-        return self._bias_cache
+            if any(req.bias for req in self.running.values()):
+                arr = np.zeros(
+                    (self.n_slots, self.cfg.vocab_size), np.float32
+                )
+                for slot, req in self.running.items():
+                    for tok, b in req.bias:
+                        arr[slot, tok] += b
+                self._bias_cache = jnp.asarray(arr)
+            else:
+                self._bias_cache = _NONE_CACHED
+        return None if self._bias_cache is _NONE_CACHED else self._bias_cache
 
     def _req_seed(self, req: _Request) -> "jax.Array | None":
         """(1,) seed for one request's prefill sampling (draw 0)."""
@@ -540,21 +622,44 @@ class ContinuousBatcher:
             return None
         return jnp.asarray([req.seed], jnp.int32)
 
-    def _batch_seed_draws(self):
-        """((B,) seeds, (B,) draw indices) for the decode step — or
-        (None, None) when no running request is seeded (the unchanged
-        compile). Draw index = tokens generated so far, known host-side,
-        so no device state tracks it; rebuilt per step (a (B,) transfer,
-        noise next to the step)."""
-        if not any(req.seed is not None for req in self.running.values()):
-            return None, None
-        seeds = np.full((self.n_slots,), -1, np.int32)
-        draws = np.zeros((self.n_slots,), np.int32)
-        for slot, req in self.running.items():
-            if req.seed is not None:
-                seeds[slot] = req.seed
-                draws[slot] = len(req.out)
-        return jnp.asarray(seeds), jnp.asarray(draws)
+    def _batch_seeds(self):
+        """(B,) per-slot seeds for the decode step — or None when no
+        running request is seeded (the unchanged compile). The draw
+        index rides in ``BatchState.draws`` on device, so unlike the old
+        host-rebuilt (seeds, draws) pair this is cached until the
+        running set changes: the steady-state loop transfers nothing
+        (and, via the _NONE_CACHED sentinel, re-scans nothing)."""
+        if self._seeds_cache is None:
+            if any(req.seed is not None for req in self.running.values()):
+                seeds = np.full((self.n_slots,), -1, np.int32)
+                for slot, req in self.running.items():
+                    if req.seed is not None:
+                        seeds[slot] = req.seed
+                self._seeds_cache = jnp.asarray(seeds)
+            else:
+                self._seeds_cache = _NONE_CACHED
+        return None if self._seeds_cache is _NONE_CACHED else self._seeds_cache
+
+    def _batch_allowed(self) -> jax.Array:
+        """(B,) bool running-set membership mask for the decode step;
+        cached until the running set changes (one H2D per membership
+        event, zero in steady state — budget gating lives on device)."""
+        if self._allowed_cache is None:
+            allowed_np = np.zeros((self.n_slots,), bool)
+            allowed_np[list(self.running)] = True
+            self._allowed_cache = jnp.asarray(allowed_np)
+        return self._allowed_cache
+
+    def _invalidate_slot_caches(self) -> None:
+        """Drop every per-slot device-array cache (knobs, adapter
+        one-hots, bias planes, membership mask, seeds). The ONE
+        invalidation point for running-set membership changes — a new
+        cache added here can't miss a site."""
+        self._knobs_cache = None
+        self._sel_cache = None
+        self._bias_cache = None
+        self._allowed_cache = None
+        self._seeds_cache = None
 
     def _req_sel(self, req: _Request) -> "jax.Array | None":
         """(1, N) adapter one-hot for one request's prefill dispatches
@@ -626,7 +731,8 @@ class ContinuousBatcher:
                 self.state, tok, logp = prefill_insert(
                     self.params, self.state, padded,
                     jnp.int32(len(req.prompt)), jnp.int32(slot),
-                    self.cfg, self._req_knobs(req), sel=self._req_sel(req),
+                    self.cfg, self._req_knobs(req),
+                    jnp.int32(req.max_new), sel=self._req_sel(req),
                     bias=self._req_bias(req), seed=self._req_seed(req),
                 )
                 req.out.append(int(tok))  # device sync: prefill really done
@@ -636,9 +742,7 @@ class ContinuousBatcher:
                     prefill_span.end()
             self._on_first_token(req)
             self.running[slot] = req
-            self._knobs_cache = None
-            self._sel_cache = None
-            self._bias_cache = None
+            self._invalidate_slot_caches()
             self._finish_if_done(req)
 
     def _prefill_one_chunk(self) -> None:
@@ -692,9 +796,7 @@ class ContinuousBatcher:
         req.out_logp.append(float(logp))
         self._on_first_token(req)
         self.running[slot] = req
-        self._knobs_cache = None
-        self._sel_cache = None
-        self._bias_cache = None
+        self._invalidate_slot_caches()
         self._finish_if_done(req)
 
     def _on_first_token(self, req: _Request) -> None:
@@ -744,13 +846,14 @@ class ContinuousBatcher:
 
     def _apply_prefill_finish(self, chunk, fstart: int, plen: int,
                               slot: int) -> tuple[int, float]:
+        req = self.prefilling[slot]
         self.state, tok, logp = prefill_finish(
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
-            self.cfg, self._req_knobs(self.prefilling[slot]),
-            sel=self._req_sel(self.prefilling[slot]),
-            bias=self._req_bias(self.prefilling[slot]),
-            seed=self._req_seed(self.prefilling[slot]),
+            self.cfg, self._req_knobs(req), jnp.int32(req.max_new),
+            sel=self._req_sel(req),
+            bias=self._req_bias(req),
+            seed=self._req_seed(req),
         )
         return int(tok), float(logp)
 
@@ -771,9 +874,7 @@ class ContinuousBatcher:
                 if req.rid == rid:
                     del mapping[slot]
                     self._prefill_pos.pop(slot, None)
-                    self._knobs_cache = None
-                    self._sel_cache = None
-                    self._bias_cache = None
+                    self._invalidate_slot_caches()
                     self._retire_cancelled(req)
                     return True
         return False
@@ -802,24 +903,58 @@ class ContinuousBatcher:
             self.done_requests[req.rid] = req
             if req.slot in self.running:
                 del self.running[req.slot]
-                self._knobs_cache = None
-                self._sel_cache = None
-                self._bias_cache = None
+                self._invalidate_slot_caches()
             if self.metrics:
                 self.metrics.on_finish(reason)
             self._close_request_spans(req, reason)
 
     def step(self) -> None:
         """Admit what fits, advance at most one prefill chunk, then one
-        decode step for the whole batch."""
+        decode step for the whole batch.
+
+        With ``pipeline_depth=1`` the decode is double-buffered: this
+        call dispatches step t+1 and only then reads step t back, so the
+        host-side per-token work (stop matching, budget retirement,
+        metrics, stream publishing) runs while the device computes the
+        next step. The flush-first rule: drain the in-flight step before
+        this step can change slot occupancy (pending admissions, prefill
+        progress, or an emptied batch) IF any of the step's live slots
+        has since been freed by retire/cancel — otherwise that slot's
+        stale token could be attributed to its next occupant once the
+        occupant reaches ``running`` (bucketed admits land there in the
+        same call; chunked ones at their finish chunk, which can also be
+        the same call for short prompts). When every in-flight slot is
+        still running — the saturated queue, and steady chunked
+        admission — there is no hazard and no flush: the pipeline keeps
+        streaming through admissions.
+        """
+        n_emitted = 0
+        if self._inflight is not None and (
+            self.pending or self.prefilling or not self.running
+        ) and any(s not in self.running for s in self._inflight[3]):
+            n_emitted += self._flush_inflight()
         self._admit()
         self._prefill_one_chunk()
-        if not self.running:
+        if self.running:
+            allowed = self._batch_allowed()
+            if self.pipeline_depth:
+                prev, self._inflight = self._inflight, None
+                if prev is not None and self._inflight_covers_rest(prev):
+                    # budgets prove the in-flight step retires EVERY
+                    # running request: a dispatch-ahead would compute a
+                    # whole batch of -1 sentinels (the device budget
+                    # gate). Read it instead — the drain's last step
+                    # costs zero wasted compute.
+                    n_emitted += self._read_step(prev)
+                    if self.running:  # never on budget; belt for EOS/stop
+                        self._dispatch_decode(self._batch_allowed())
+                else:
+                    self._dispatch_decode(allowed)
+                    n_emitted += self._read_step(prev)
+            else:
+                n_emitted += self._decode_once(allowed)
+        elif not n_emitted:
             return
-        # host-built mask: one array transfer, not one scatter per slot
-        allowed_np = np.zeros((self.n_slots,), bool)
-        allowed_np[list(self.running)] = True
-        n_emitted = self._decode_once(jnp.asarray(allowed_np))
         if self.metrics:
             self.metrics.on_step(
                 n_emitted, len(self.pending), len(self.running),
@@ -827,16 +962,111 @@ class ContinuousBatcher:
             )
 
     def _decode_once(self, allowed) -> int:
-        """One decode dispatch for the whole batch; returns tokens emitted
-        (the speculative batcher overrides this with a draft+verify round
-        that can emit up to gamma tokens per slot)."""
-        seeds, draws = self._batch_seed_draws()
+        """One SYNCHRONOUS decode dispatch + readback for the whole
+        batch; returns tokens emitted (the speculative batcher overrides
+        this with a draft+verify round that can emit up to gamma tokens
+        per slot; it is also the whole decode path at pipeline_depth=0)."""
         self.state, emitted, logps = decode_step(
-            self.params, self.state, allowed, jnp.int32(self.eos_id),
+            self.params, self.state, allowed, self._eos_dev,
             self.cfg, self._batch_knobs(), sel=self._batch_sel(),
-            bias=self._batch_bias(), seeds=seeds, draws=draws,
+            bias=self._batch_bias(), seeds=self._batch_seeds(),
         )
         emitted, logps = jax.device_get((emitted, logps))  # one host sync
+        return self._apply_emitted(emitted, logps)
+
+    def _dispatch_decode(self, allowed) -> None:
+        """Enqueue one decode step WITHOUT waiting for its results: the
+        emitted/logps device arrays are parked in ``_inflight`` (their
+        D2H copies started immediately) and read by a later
+        ``_read_step``. In steady state every argument here is a cached
+        device array — zero host->device transfers per token."""
+        span = None
+        if self.trace_steps and self.tracer.enabled:
+            span = self.tracer.span(
+                "decode_dispatch", component="serving_engine",
+                step=self._step_no,
+            )
+        t0 = time.perf_counter()
+        self.state, emitted, logps = decode_step(
+            self.params, self.state, allowed, self._eos_dev,
+            self.cfg, self._batch_knobs(), sel=self._batch_sel(),
+            bias=self._batch_bias(), seeds=self._batch_seeds(),
+        )
+        for arr in (emitted, logps):
+            # start the D2H copy the moment the step completes, so the
+            # later device_get finds the bytes already on the host
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        if span is not None:
+            span.end()
+        if self.metrics:
+            observe = getattr(self.metrics, "observe_dispatch", None)
+            if observe is not None:
+                observe(time.perf_counter() - t0)
+        # the slots this dispatch counted as live (the allowed mask's
+        # true set): step() flushes before re-admitting any of them
+        self._inflight = (self._step_no, emitted, logps, tuple(self.running))
+        self._step_no += 1
+
+    def _read_step(self, inflight) -> int:
+        """Read one previously dispatched step back and run the host
+        per-token work for it. ``inflight`` is a ``_dispatch_decode``
+        record or None (the pipeline's first step has nothing to read)."""
+        if inflight is None:
+            return 0
+        step_no, emitted, logps, _slots = inflight
+        span = None
+        if self.trace_steps and self.tracer.enabled:
+            span = self.tracer.span(
+                "decode_readback", component="serving_engine", step=step_no,
+            )
+        t0 = time.perf_counter()
+        emitted, logps = jax.device_get((emitted, logps))
+        n = self._apply_emitted(emitted, logps)
+        if span is not None:
+            span.set(emitted=n).end()
+        if self.metrics:
+            observe = getattr(self.metrics, "observe_readback", None)
+            if observe is not None:
+                observe(time.perf_counter() - t0)
+        return n
+
+    def _inflight_covers_rest(self, inflight) -> bool:
+        """True when the in-flight step's pending tokens will retire
+        every running request on budget (len(out) plus the in-flight
+        emission reaches max_new for each). Sound because the device
+        budget counter can't disagree with the host count; conservative
+        because EOS/stop retirements aren't predictable host-side."""
+        slots = inflight[3]
+        return all(
+            len(req.out) + (1 if slot in slots else 0) >= req.max_new
+            for slot, req in self.running.items()
+        )
+
+    def _flush_inflight(self) -> int:
+        """Drain the in-flight step before an admission that could reuse
+        one of its live slots: its tokens are applied against the
+        CURRENT running map, so the freed slot's lagging token is
+        dropped here rather than leaking into the slot's next occupant.
+        (cancel() itself does NOT flush — it only shrinks ``running``,
+        which the readback's membership check already handles; the flush
+        happens in the step() that re-admits the slot.)"""
+        prev, self._inflight = self._inflight, None
+        if prev is None:
+            return 0
+        if self.metrics:
+            on_flush = getattr(self.metrics, "on_pipeline_flush", None)
+            if on_flush is not None:
+                on_flush()
+        return self._read_step(prev)
+
+    def _apply_emitted(self, emitted, logps) -> int:
+        """Host per-token work for one read-back step: append tokens and
+        logprobs, match stop sequences, retire finished requests, feed
+        the inter-token histogram. Slots not in ``running`` (retired or
+        cancelled since dispatch) and -1 sentinels are skipped — the
+        lag-by-one drop that makes the pipeline exact."""
         n_emitted = 0
         observe_it = (
             getattr(self.metrics, "observe_inter_token", None)
@@ -927,6 +1157,7 @@ def prefill_chunk(
         cache=_merge_slot(state.cache, sl, slot),
         lengths=state.lengths, last_token=state.last_token,
         active=state.active, presence=presence, key=state.key,
+        budget=state.budget, draws=state.draws,
     )
 
 
@@ -940,6 +1171,7 @@ def prefill_finish(
     slot: jax.Array,
     cfg: LlamaConfig,
     knobs: jax.Array,        # (4,) f32 sampler knobs for THIS request
+    max_new: jax.Array,      # scalar int32: the request's token budget
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
     bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
     seed: jax.Array | None = None,  # (1,) i32 per-request seed (draw 0)
@@ -980,6 +1212,8 @@ def prefill_finish(
         active=state.active.at[write].set(True),
         presence=state.presence.at[write].set(seen[0]),
         key=key,
+        budget=state.budget.at[write].set(max_new - 1),
+        draws=state.draws.at[write].set(1),
     ), tok, logp
 
 
@@ -1081,4 +1315,6 @@ def _insert_prefix(
         active=state.active,
         presence=state.presence.at[jnp.int32(slot)].set(presence),
         key=state.key,
+        budget=state.budget,
+        draws=state.draws,
     )

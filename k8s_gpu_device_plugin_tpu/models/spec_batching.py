@@ -73,7 +73,9 @@ def spec_decode_step(
     params_d,
     state: BatchState,        # target-side state (lengths are THE truth)
     draft_state: BatchState,  # only its cache participates
-    allowed: jax.Array,       # (B,) bool host gate (room + budget)
+    allowed: jax.Array,       # (B,) bool host membership gate (budget
+                              # rides in BatchState.budget; host drops
+                              # any round tail emitted past it)
     cfg_t: LlamaConfig,
     cfg_d: LlamaConfig,
     gamma: int,
@@ -166,6 +168,14 @@ def spec_decode_step(
         active=state.active,
         presence=state.presence,
         key=key,
+        # bookkeeping only: the spec batcher runs synchronously
+        # (pipeline_depth=0) and retires on budget host-side, dropping
+        # any tail the round emitted past it — clamp so a long
+        # acceptance run can't underflow the counter
+        budget=jnp.where(
+            was_active, jnp.maximum(state.budget - counts, 0), state.budget
+        ),
+        draws=state.draws,  # per-request seeds are rejected at submit
     )
     new_draft = BatchState(
         cache=d_cache,
@@ -174,6 +184,8 @@ def spec_decode_step(
         active=draft_state.active,
         presence=draft_state.presence,
         key=draft_state.key,
+        budget=draft_state.budget,
+        draws=draft_state.draws,
     )
     return new_state, new_draft, emitted, counts, logps
 
@@ -214,6 +226,11 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "SpeculativeBatcher does not support LoRA adapters (the "
                 "draft model has no stacks to mirror the target's)"
             )
+        # opt OUT of the decode pipeline: a speculative round's host side
+        # must see the per-slot acceptance counts before it can schedule
+        # the next round (the draft positions depend on them), so the
+        # dispatch-ahead overlap has nothing to hide behind
+        kw["pipeline_depth"] = 0
         super().__init__(params, cfg, n_slots, max_len, **kw)
         if not self.chunk:
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
@@ -275,6 +292,7 @@ class SpeculativeBatcher(ContinuousBatcher):
         )
 
     def _apply_prefill_finish(self, chunk, fstart, plen, slot):
+        max_new = self.prefilling[slot].max_new
         tok, logp = super()._apply_prefill_finish(chunk, fstart, plen, slot)
         # same chunk through the draft (its sampled token is unused; the
         # call exists to write the draft K/V rows and set its lengths)
@@ -283,6 +301,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             jnp.int32(plen), jnp.int32(slot),
             self.draft_cfg,
             jnp.asarray(sampler_knobs(self.sampler), jnp.float32),
+            jnp.int32(max_new),
         )
         return tok, logp
 

@@ -44,12 +44,17 @@ the adapters (models/lora_serving.py) and every request picks one —
 ``"adapter": "name"`` here, or the OpenAI ``"model"`` field (the base
 model's id or an adapter name; ``/v1/models`` lists all).
 
-Design notes: the batcher is synchronous by construction (a jitted step
-per token); the engine thread is its sole owner, and handlers never wait
-on device work — submissions ride a small locked queue the engine drains
-between steps. Shutdown drains nothing — serving pods are stateless,
-kubelet restarts re-register via the plugin, matching the daemon's
-stateless stance (SURVEY §5 checkpoint row).
+Design notes: the engine thread is the batcher's sole owner, and
+handlers never wait on device work — submissions ride a small locked
+queue the engine drains between steps. The batcher's decode loop is
+pipelined by default (``pipeline_depth=1``): each step dispatches the
+next device step BEFORE reading the previous one back, so the host-side
+token publishing this engine does per step overlaps the chip's compute
+(``--pipelineDepth 0`` restores the synchronous loop; ``--traceSteps``
+adds per-step decode_dispatch/decode_readback spans under ``--tracing``
+to see the overlap). Shutdown drains nothing — serving pods are
+stateless, kubelet restarts re-register via the plugin, matching the
+daemon's stateless stance (SURVEY §5 checkpoint row).
 """
 
 from __future__ import annotations
@@ -92,6 +97,8 @@ class InferenceEngine:
         metrics=None,
         batcher: ContinuousBatcher | None = None,
         adapters=None,  # lora_serving.AdapterSet (multi-LoRA serving)
+        pipeline_depth: int = 1,
+        trace_steps: bool = False,
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -105,6 +112,7 @@ class InferenceEngine:
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(chunked_prefill, max_len),
             metrics=metrics, adapters=adapters,
+            pipeline_depth=pipeline_depth, trace_steps=trace_steps,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -839,10 +847,22 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--draftCheckpointDir", default="")
     parser.add_argument("--gamma", type=int, default=4,
                         help="draft proposals verified per round")
+    parser.add_argument("--pipelineDepth", type=int, default=1,
+                        choices=[0, 1],
+                        help="decode pipeline: 1 (default) dispatches "
+                        "step t+1 before reading step t back so host "
+                        "token work overlaps device compute; 0 restores "
+                        "the synchronous loop (ignored with "
+                        "--draftPreset: the speculative round is "
+                        "synchronous by construction)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
                         "duration histograms on /metrics; default off")
+    parser.add_argument("--traceSteps", action="store_true",
+                        help="with --tracing: per-decode-step "
+                        "decode_dispatch/decode_readback spans (batch-"
+                        "scoped traces; shows the pipeline overlap)")
     args = parser.parse_args(argv)
 
     if args.tracing:
@@ -949,6 +969,8 @@ def _main(argv: list[str] | None = None) -> int:
         sampler=sampler, eos_id=eos_id,
         chunked_prefill=args.chunkedPrefill, metrics=metrics,
         batcher=batcher, adapters=adapters,
+        pipeline_depth=args.pipelineDepth,
+        trace_steps=args.traceSteps and args.tracing,
     )
     from prometheus_client import REGISTRY
 

@@ -41,15 +41,16 @@ def _oracle(params, prompt, cfg, max_new):
     return np.asarray(out)[0].tolist()
 
 
-def test_more_requests_than_slots_matches_generate(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_more_requests_than_slots_matches_generate(setup, depth):
     """4 requests, 2 slots, mixed prompt lengths and budgets: every
     request's stream must equal its dedicated-generate tokens (slot reuse
-    and batch neighbors must be invisible)."""
+    and batch neighbors must be invisible) — pipelined or not."""
     cfg, params = setup
     specs = [(1, 5, 6), (2, 12, 4), (3, 3, 8), (4, 9, 5)]  # (key, plen, new)
     cb = ContinuousBatcher(
         params, cfg, n_slots=2, max_len=64,
-        prompt_buckets=(4, 8, 16, 32),
+        prompt_buckets=(4, 8, 16, 32), pipeline_depth=depth,
     )
     prompts = {}
     for key, plen, max_new in specs:
@@ -62,12 +63,15 @@ def test_more_requests_than_slots_matches_generate(setup):
         assert results[rid] == _oracle(params, p, cfg, max_new), rid
 
 
-def test_midstream_admission(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_midstream_admission(setup, depth):
     """A request submitted while others are mid-decode must not perturb
-    them — and must itself decode exactly."""
+    them — and must itself decode exactly (pipelined mode flushes the
+    in-flight step before admitting)."""
     cfg, params = setup
     cb = ContinuousBatcher(
         params, cfg, n_slots=3, max_len=64, prompt_buckets=(8, 16),
+        pipeline_depth=depth,
     )
     p1 = _prompt(10, 6, cfg)
     r1 = cb.submit(p1, max_new=10)
@@ -80,11 +84,13 @@ def test_midstream_admission(setup):
     assert results[r2] == _oracle(params, p2, cfg, 6)
 
 
-def test_eos_frees_slot_for_queued_request(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_eos_frees_slot_for_queued_request(setup, depth):
     """EOS retirement: pick the token the model actually emits second for
     request A as the EOS id; A must stop right after it (EOS kept,
-    nothing beyond), and the queued request C must then run in A's slot
-    and still match its oracle."""
+    nothing beyond — pipelined mode drops A's lagging in-flight token),
+    and the queued request C must then run in A's slot and still match
+    its oracle."""
     cfg, params = setup
     pa = _prompt(20, 5, cfg)
     oracle_a = _oracle(params, pa, cfg, 6)
@@ -96,7 +102,7 @@ def test_eos_frees_slot_for_queued_request(setup):
 
     cb = ContinuousBatcher(
         params, cfg, n_slots=1, max_len=64, eos_id=eos,
-        prompt_buckets=(8, 16),
+        prompt_buckets=(8, 16), pipeline_depth=depth,
     )
     ra = cb.submit(pa, max_new=6)
     rb = cb.submit(pb, max_new=6)
@@ -217,13 +223,15 @@ def test_tp_sharded_batching_matches_unsharded():
     assert run(sharded) == run(params)
 
 
-def test_chunked_prefill_matches_generate(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_chunked_prefill_matches_generate(setup, depth):
     """chunked_prefill=C must change scheduling only: every request's
     stream still equals its dedicated-generate tokens (intermediate
     chunks attend exactly the slot's own earlier rows)."""
     cfg, params = setup
     cb = ContinuousBatcher(
         params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        pipeline_depth=depth,
     )
     specs = [(70, 11, 5), (71, 3, 6), (72, 9, 4)]  # (key, plen, new)
     prompts = {}
@@ -434,9 +442,11 @@ def test_serving_metrics_close_and_idle():
     m2.close()
 
 
-def test_stop_sequences_retire_requests(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_stop_sequences_retire_requests(setup, depth):
     """A request stops when its output ends with a stop sequence (tokens
-    kept); unrelated requests run to budget. Metrics record the reason."""
+    kept, the pipelined in-flight token past the match dropped);
+    unrelated requests run to budget. Metrics record the reason."""
     from prometheus_client import CollectorRegistry
 
     from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
@@ -451,7 +461,7 @@ def test_stop_sequences_retire_requests(setup):
     reg = CollectorRegistry()
     cb = ContinuousBatcher(
         params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
-        metrics=ServingMetrics(registry=reg),
+        metrics=ServingMetrics(registry=reg), pipeline_depth=depth,
     )
     r1 = cb.submit(p, max_new=6, stop=[stop])
     p2 = _prompt(301, 4, cfg)
@@ -491,7 +501,8 @@ def test_logprobs_match_full_context_forward(setup):
         )
 
 
-def test_cancel_in_every_state_frees_slot_and_records(setup):
+@pytest.mark.parametrize("depth", [0, 1])
+def test_cancel_in_every_state_frees_slot_and_records(setup, depth):
     """cancel() retires a request from pending, mid-prefill, and decoding;
     the slot is reusable, neighbors are untouched (token parity with the
     oracle), tokens-so-far land in done, and metrics count 'cancelled'."""
@@ -505,7 +516,7 @@ def test_cancel_in_every_state_frees_slot_and_records(setup):
     reg = CollectorRegistry()
     cb = ContinuousBatcher(
         params, cfg, n_slots=1, max_len=64, chunked_prefill=4,
-        metrics=ServingMetrics(registry=reg),
+        metrics=ServingMetrics(registry=reg), pipeline_depth=depth,
     )
 
     # pending: the single slot is busy, second submit queues

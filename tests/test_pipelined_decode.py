@@ -1,0 +1,460 @@
+"""The pipelined decode loop (pipeline_depth=1) vs the synchronous one.
+
+The pipeline's claim is exact, not approximate: dispatching step t+1
+before reading step t back must be INVISIBLE in the outputs — token
+streams AND per-token logprobs bit-identical to pipeline_depth=0 across
+every scheduling event that can interleave with an in-flight step
+(admission, retirement by stop sequence / budget / EOS, cancellation,
+chunked prefill, slot reuse, seeded sampling). On top of identity, the
+steady-state loop must hold its device-array caches stable (the
+zero-per-step-H2D design), flush the in-flight step on membership
+changes (the stale-token attribution hazard), and show the overlap in
+the opt-in per-step trace spans.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, max_new=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def _streams(cb):
+    """{rid: (tokens, logprobs)} for every retired request."""
+    return {
+        rid: (list(req.out), list(req.out_logp))
+        for rid, req in cb.done_requests.items()
+    }
+
+
+# --- bit-identity scenarios -------------------------------------------------
+#
+# Each scenario drives a fresh batcher (the depth is the only difference)
+# and returns its full {rid: (tokens, logprobs)} map; the test asserts
+# depth-0 and depth-1 agree EXACTLY — same compiled step, same inputs,
+# so equality is bitwise, floats included.
+
+
+def _scenario_bucketed_churn(params, cfg, depth):
+    """More requests than slots through bucketed prefill: every
+    retirement (budget) frees a slot for the next admission while a
+    step is in flight."""
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(4, 8, 16),
+        pipeline_depth=depth,
+    )
+    for key, plen, new in [(1, 5, 6), (2, 12, 4), (3, 3, 8), (4, 9, 5)]:
+        cb.submit(_prompt(key, plen, cfg), max_new=new)
+    cb.run()
+    return _streams(cb)
+
+
+def _scenario_chunked_midstream(params, cfg, depth):
+    """Chunked prefill interleaving with decode, plus a midstream
+    submission landing while a step is in flight."""
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        pipeline_depth=depth,
+    )
+    cb.submit(_prompt(10, 4, cfg), max_new=10)
+    for _ in range(3):
+        cb.step()
+    cb.submit(_prompt(11, 13, cfg), max_new=5)
+    cb.submit(_prompt(12, 7, cfg), max_new=6)
+    cb.run()
+    return _streams(cb)
+
+
+def _scenario_stop_sequences(params, cfg, depth):
+    """Stop-sequence retirement: the matched request must not grow an
+    extra token out of the in-flight step; its neighbor is untouched."""
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        pipeline_depth=depth,
+    )
+    p = _prompt(20, 5, cfg)
+    oracle = _oracle(cb.params, p, cfg, 8)
+    cb.submit(p, max_new=8, stop=[[oracle[1], oracle[2]]])
+    cb.submit(_prompt(21, 6, cfg), max_new=7)
+    cb.run()
+    return _streams(cb)
+
+
+def _scenario_cancel_and_reuse(params, cfg, depth):
+    """Deterministic cancellation mid-decode, then the freed slot is
+    reused — the stale in-flight token must vanish, not leak into the
+    next occupant. The cancelled stream's LENGTH is timing (the host
+    sees one fewer token when the last step is still in flight), so it
+    is prefix-checked here and excluded from the cross-mode equality;
+    the successor in the reused slot must be bit-identical."""
+    p1 = _prompt(30, 5, cfg)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+        pipeline_depth=depth,
+    )
+    r1 = cb.submit(p1, max_new=12)
+    for _ in range(4):
+        cb.step()
+    cb.cancel(r1)
+    cb.submit(_prompt(31, 6, cfg), max_new=5)
+    cb.run()
+    streams = _streams(cb)
+    got, _ = streams.pop(r1)
+    assert 1 <= len(got) < 12
+    assert got == _oracle(params, p1, cfg, 12)[: len(got)]
+    return streams
+
+
+def _scenario_eos(params, cfg, depth):
+    """EOS retirement with a queued successor into the same slot."""
+    p = _prompt(40, 5, cfg)
+    oracle = _oracle(params, p, cfg, 6)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+        eos_id=oracle[1], pipeline_depth=depth,
+    )
+    cb.submit(p, max_new=6)
+    cb.submit(_prompt(41, 7, cfg), max_new=6)
+    cb.run()
+    return _streams(cb)
+
+
+def _scenario_seeded_sampled(params, cfg, depth):
+    """Seeded sampled requests (their draw index now lives on device):
+    the i-th draw must use fold_in(key(seed), i) with the TRUE i even
+    when dispatched ahead of the host's token count."""
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
+        pipeline_depth=depth,
+    )
+    cb.submit(_prompt(50, 5, cfg), max_new=6,
+              sampler=Sampler(temperature=0.9, top_k=20), seed=7)
+    cb.submit(_prompt(51, 9, cfg), max_new=8,
+              sampler=Sampler(temperature=1.1, top_p=0.9), seed=123)
+    cb.submit(_prompt(52, 6, cfg), max_new=5)  # greedy neighbor
+    cb.run()
+    return _streams(cb)
+
+
+SCENARIOS = {
+    "bucketed_churn": _scenario_bucketed_churn,
+    "chunked_midstream": _scenario_chunked_midstream,
+    "stop_sequences": _scenario_stop_sequences,
+    "cancel_and_reuse": _scenario_cancel_and_reuse,
+    "eos": _scenario_eos,
+    "seeded_sampled": _scenario_seeded_sampled,
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_pipeline_bit_identical_to_sync(setup, name):
+    cfg, params = setup
+    sync = SCENARIOS[name](params, cfg, 0)
+    pipe = SCENARIOS[name](params, cfg, 1)
+    assert set(sync) == set(pipe)
+    for rid in sync:
+        assert pipe[rid][0] == sync[rid][0], (name, rid, "tokens")
+        assert pipe[rid][1] == sync[rid][1], (name, rid, "logprobs")
+
+
+# --- pipeline mechanics -----------------------------------------------------
+
+
+def test_pipeline_depth_validation(setup):
+    cfg, params = setup
+    for bad in (-1, 2):
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                              prompt_buckets=(8,), pipeline_depth=bad)
+
+
+def test_speculative_batcher_opts_out(setup):
+    """The draft+verify round needs each round's acceptance counts
+    before scheduling the next; the subclass forces the sync loop even
+    when asked for the pipeline."""
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    cfg, params = setup
+    draft_cfg = LlamaConfig.tiny(n_layers=1)
+    draft_params = init_params(jax.random.key(9), draft_cfg)
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
+        pipeline_depth=1,
+    )
+    assert sb.pipeline_depth == 0
+
+
+def test_steady_state_reuses_cached_device_arrays(setup):
+    """Zero per-step H2D: once every slot is decoding, the membership
+    mask / knobs / seeds caches must be the SAME device arrays step
+    after step (they rebuild only on admit/retire/cancel) and no step
+    may leave the pipeline empty."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(8,),
+        pipeline_depth=1,
+    )
+    cb.submit(_prompt(60, 5, cfg), max_new=32, seed=5,
+              sampler=Sampler(temperature=0.8))
+    cb.submit(_prompt(61, 6, cfg), max_new=32)
+    while cb.pending or cb.prefilling:
+        cb.step()
+    cb.step()  # prime the pipeline + build every cache
+    allowed0 = cb._batch_allowed()
+    knobs0 = cb._batch_knobs()
+    seeds0 = cb._batch_seeds()
+    for _ in range(5):
+        cb.step()
+        assert cb._inflight is not None  # one step always in flight
+        assert cb._batch_allowed() is allowed0
+        assert cb._batch_knobs() is knobs0
+        assert cb._batch_seeds() is seeds0
+    # a membership change (cancel) invalidates all of them at once
+    cb.cancel(next(iter(cb.running.values())).rid)
+    assert cb._allowed_cache is None and cb._knobs_cache is None
+    assert cb._seeds_cache is None
+
+
+def test_slot_reuse_flushes_inflight_but_saturation_does_not(setup):
+    """The flush rule is exactly as narrow as the hazard: re-admitting a
+    slot the in-flight dispatch counted as live flushes first (counted
+    by the pipeline_flushes metric); admissions into fresh slots — and a
+    saturated queue with no free slot — stay pipelined, flush-free."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    reg = CollectorRegistry()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(8,),
+        pipeline_depth=1, metrics=ServingMetrics(registry=reg),
+    )
+
+    def flushes():
+        return reg.get_sample_value("tpu_serving_pipeline_flushes_total")
+
+    cb.submit(_prompt(70, 5, cfg), max_new=16)
+    cb.step()                      # admit + dispatch: one step in flight
+    assert cb._inflight is not None
+    cb.submit(_prompt(71, 5, cfg), max_new=4)   # queued for a FRESH slot
+    cb.step()                      # no reuse hazard -> no flush
+    assert flushes() == 0
+    cb.submit(_prompt(72, 5, cfg), max_new=4)   # all slots busy: queued
+    cb.step()                      # saturation: still no flush
+    assert flushes() == 0
+
+    # now force the hazard: cancel a running request AFTER its slot was
+    # included in the in-flight dispatch, so the next admission reuses it
+    victim = next(iter(cb.running.values())).rid
+    cb.cancel(victim)
+    cb.step()                      # pending + freed live slot -> flush
+    assert flushes() >= 1
+    cb.run()
+    # every surviving stream still oracle-exact (no stale-token leak
+    # into the reused slot)
+    for rid, req in cb.done_requests.items():
+        assert req.out == _oracle(params, req.prompt, cfg, req.max_new)[
+            : len(req.out)
+        ]
+
+
+def test_budget_exhaustion_is_gated_on_device(setup):
+    """The device-side budget counter, not the host, stops emission: two
+    raw decode_step dispatches with the slot still ALLOWED emit a real
+    token then the -1 sentinel once the budget hits 0 — the property
+    that makes dispatch-ahead safe past any budget boundary."""
+    from k8s_gpu_device_plugin_tpu.models.batching import decode_step
+
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+        pipeline_depth=1,
+    )
+    cb.submit(_prompt(80, 5, cfg), max_new=2)
+    cb._admit()  # prefill emits token 1 of 2 -> device budget 1
+    allowed = jnp.ones((1,), bool)  # the host gate stays OPEN throughout
+    state, e1, _ = decode_step(
+        cb.params, cb.state, allowed, jnp.int32(-1), cfg, cb._batch_knobs()
+    )
+    state, e2, _ = decode_step(
+        cb.params, state, allowed, jnp.int32(-1), cfg, cb._batch_knobs()
+    )
+    assert int(jax.device_get(e1)[0]) >= 0      # budget 1: real token
+    assert int(jax.device_get(e2)[0]) == -1     # budget 0: gated on device
+    assert int(jax.device_get(state.budget)[0]) == 0
+
+
+def test_budget_drain_skips_the_wasted_dispatch(setup):
+    """When budgets prove the in-flight step retires every running
+    request, step() reads it WITHOUT dispatching ahead — the drain ends
+    with an empty pipeline instead of a whole-batch -1 compute."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(8,),
+        pipeline_depth=1,
+    )
+    r1 = cb.submit(_prompt(81, 5, cfg), max_new=3)
+    r2 = cb.submit(_prompt(82, 6, cfg), max_new=3)
+    cb.run()
+    assert len(cb.done[r1]) == 3 and len(cb.done[r2]) == 3
+    assert cb._inflight is None  # no stale step burned at the drain
+
+
+def test_eos_lag_token_is_dropped_from_inflight(setup):
+    """EOS retirement is NOT host-predictable, so the pipeline does
+    dispatch one step past it — that step's emission for the retired
+    slot must be the -1 sentinel (the device deactivated the slot) and
+    must never reach the stream."""
+    cfg, params = setup
+    p = _prompt(83, 5, cfg)
+    oracle = _oracle(params, p, cfg, 8)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+        eos_id=oracle[1], pipeline_depth=1,
+    )
+    rid = cb.submit(p, max_new=8)
+    cb.run()
+    assert cb.done[rid] == oracle[:2]  # stopped AT the eos token
+    assert cb._inflight is not None    # the unpredicted dispatch dangles
+    assert int(jax.device_get(cb._inflight[1])[0]) == -1
+
+
+def test_trace_steps_show_dispatch_ahead_of_readback(setup):
+    """Opt-in per-step spans: decode_dispatch for step t+1 must START
+    before decode_readback for step t (the overlap, visible in obs/)."""
+    from k8s_gpu_device_plugin_tpu.obs.trace import configure
+
+    cfg, params = setup
+    tr = configure(enabled=True)
+    tr.clear()
+    try:
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+            pipeline_depth=1, trace_steps=True,
+        )
+        cb.submit(_prompt(90, 5, cfg), max_new=6)
+        cb.run()
+        spans = []
+        for summary in tr.traces():
+            spans.extend(tr.get_trace(summary["trace_id"]) or [])
+        dispatch = {
+            s["attrs"]["step"]: s for s in spans
+            if s["name"] == "decode_dispatch"
+        }
+        readback = {
+            s["attrs"]["step"]: s for s in spans
+            if s["name"] == "decode_readback"
+        }
+        assert dispatch and readback
+        for step, rb in readback.items():
+            nxt = dispatch.get(step + 1)
+            if nxt is not None:
+                assert nxt["start_us"] <= rb["start_us"], step
+    finally:
+        tr.enabled = False
+        tr.clear()
+
+
+def test_sync_mode_emits_no_step_spans(setup):
+    """pipeline_depth=0 never dispatches ahead: no decode_dispatch spans
+    even with trace_steps on (the sync path is the old loop)."""
+    from k8s_gpu_device_plugin_tpu.obs.trace import configure
+
+    cfg, params = setup
+    tr = configure(enabled=True)
+    tr.clear()
+    try:
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=1, max_len=64, prompt_buckets=(8,),
+            pipeline_depth=0, trace_steps=True,
+        )
+        cb.submit(_prompt(91, 5, cfg), max_new=3)
+        cb.run()
+        names = set()
+        for summary in tr.traces():
+            names |= {
+                s["name"] for s in (tr.get_trace(summary["trace_id"]) or [])
+            }
+        assert "decode_dispatch" not in names
+        assert "decode_readback" not in names
+    finally:
+        tr.enabled = False
+        tr.clear()
+
+
+# --- threaded serving-engine stress -----------------------------------------
+
+
+def test_engine_threaded_stress_with_pipeline(setup):
+    """The serving engine with the pipeline ON under concurrent load:
+    12 requests over 3 slots submitted from interleaved asyncio tasks,
+    two cancelled mid-flight; every surviving stream equals its
+    dedicated-generate oracle and the engine stays alive."""
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        drain_queue,
+    )
+
+    cfg, params = setup
+    engine = InferenceEngine(
+        params, cfg, n_slots=3, max_len=64, chunked_prefill=8,
+        pipeline_depth=1,
+    )
+    assert engine.cb.pipeline_depth == 1
+    prompts = {i: _prompt(700 + i, 4 + (i % 5), cfg) for i in range(12)}
+
+    async def body():
+        async def one(i):
+            await asyncio.sleep(0.002 * (i % 4))  # stagger admissions
+            eid, q = engine.submit(prompts[i], max_new=4 + (i % 3))
+            if i in (5, 9):
+                await asyncio.sleep(0.01)
+                engine.cancel(eid)
+            toks, _ = await drain_queue(q)
+            return i, toks
+
+        return dict(await asyncio.gather(*(one(i) for i in range(12))))
+
+    try:
+        results = asyncio.run(asyncio.wait_for(body(), timeout=300))
+    finally:
+        engine.shutdown()
+    assert not engine._dead.is_set()
+    for i, toks in results.items():
+        want = _oracle(params, prompts[i], cfg, 4 + (i % 3))
+        if i in (5, 9):  # cancelled: any prefix of the oracle is legal
+            assert toks == want[: len(toks)]
+        else:
+            assert toks == want, i
