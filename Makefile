@@ -39,9 +39,10 @@ san-test:
 # Full CI gate (SURVEY §5 race-detection/sanitizer row): lint, plain native
 # build + unit test, ASan/UBSan build + test, the decode-pipeline
 # host-overhead smoke (CPU; exercises the pipelined AND sync serving
-# loops end to end), and the Python suite (which includes the manager
+# loops end to end), the prefix-cache smoke (radix trie + cached-vs-cold
+# serve A/B on CPU), and the Python suite (which includes the manager
 # concurrency stress in tests/test_manager_stress.py).
-ci: lint native native-test san-test bench-host-overhead
+ci: lint native native-test san-test bench-host-overhead bench-prefix-cache
 	python -m pytest tests/ -q
 
 bench:
@@ -53,11 +54,19 @@ bench:
 bench-host-overhead:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.host_overhead
 
+# CPU-runnable microbench: prefix-cache radix trie match/insert
+# throughput, the submit miss-path overhead (must be ~free with the
+# cache off), and a tiny cached-vs-cold serve A/B (one JSON line with
+# match_us/insert_us, submit_off_us/submit_miss_us, prefix_hit_rate,
+# prefill_tokens_saved_pct).
+bench-prefix-cache:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.prefix_cache_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint san-test ci test bench \
-	bench-host-overhead clean watch
+	bench-host-overhead bench-prefix-cache clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -468,6 +468,15 @@ def _run_serve() -> dict:
         "device_step_ms": round(r.device_step_ms, 2),
         "host_overhead_pct": round(r.host_overhead_pct, 1),
         "host_overhead_pct_sync": round(r.host_overhead_pct_sync, 1),
+        # prefix-cache cached-vs-cold A/B (shared-system-prompt +
+        # multi-turn workload): the redundant-prefill win measured the
+        # same way the pipeline's host-overhead win is
+        "prefix_hit_rate": round(r.prefix_hit_rate, 3),
+        "prefill_tokens_saved_pct": round(r.prefill_tokens_saved_pct, 1),
+        "prefill_tokens_computed_cold": r.prefill_tokens_computed_cold,
+        "prefill_tokens_computed_cached": r.prefill_tokens_computed_cached,
+        "wall_seconds_prefix_cold": round(r.wall_seconds_prefix_cold, 3),
+        "wall_seconds_prefix_cached": round(r.wall_seconds_prefix_cached, 3),
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),

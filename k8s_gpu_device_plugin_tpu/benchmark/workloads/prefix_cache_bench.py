@@ -1,0 +1,181 @@
+"""Prefix-cache microbench (CPU-runnable; ``make bench-prefix-cache``).
+
+The automatic prefix cache (serving/prefix_cache.py) sits ON the submit
+path: every request walks the radix tree once (twice when queued), and
+every completed prefill walks it again to promote. Those walks are pure
+host work, so this bench answers the two questions that decide whether
+the cache may stay on by default:
+
+- **trie throughput**: radix match and insert cost per operation, at
+  realistic prompt lengths — microseconds, not milliseconds, or the
+  cache would eat the host budget PR 2 just reclaimed;
+- **miss-path overhead**: per-submit cost with the cache OFF (`None` —
+  must be ~free: one attribute check) and with it ON but missing (the
+  full failed walk, the worst steady-state case for cache-hostile
+  traffic).
+
+It also smoke-runs the end-to-end cached-vs-cold serve A/B at tiny
+scale (the same shared-system-prompt + multi-turn workload the serve
+bench reports on hardware), so ``make ci`` exercises match ->
+_insert_prefix -> promote -> evict on the CPU backend and fails loudly
+if the prefix path regresses into an exception.
+
+Prints one JSON line, like the host_overhead twin.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def trie_bench(
+    n_prefixes: int = 512,
+    prompt_len: int = 480,
+    buckets: tuple[int, ...] | None = None,  # None = the shipped ladder
+) -> dict:
+    """Radix-tree match/insert throughput: no model, no KV rows (a stub
+    extractor returns a shared sentinel), so match_us is the pure host
+    walk a submit pays and insert_us is the promotion walk plus the
+    per-entry presence-mask build — everything except the row slice the
+    device does asynchronously anyway."""
+    from k8s_gpu_device_plugin_tpu.models.batching import (
+        DEFAULT_PROMPT_BUCKETS,
+    )
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    if buckets is None:
+        buckets = DEFAULT_PROMPT_BUCKETS  # measure the shipped ladder
+    cfg = LlamaConfig.tiny(n_layers=2)
+    vocab = cfg.vocab_size  # presence masks are (V,); ids must be in-vocab
+    pc = PrefixCache(cfg, buckets=buckets, budget_bytes=1 << 40)
+    rng = random.Random(7)
+    # half the prompts share one system prefix (the traffic the cache
+    # exists for), half are unique — the tree gets both deep shared
+    # paths and wide fan-out
+    sys_p = [rng.randrange(1, vocab) for _ in range(buckets[2])]
+    prompts = []
+    for i in range(n_prefixes):
+        tail = [rng.randrange(1, vocab) for _ in range(prompt_len)]
+        prompts.append((sys_p + tail)[:prompt_len] if i % 2 else tail)
+
+    stub_rows = object()  # promotion stores it opaquely; never computed on
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        pc.on_prefill_done(p, -1, lambda _p: stub_rows)
+    insert_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    hits = 0
+    for p in prompts:
+        hits += pc.match(p, -1) is not None
+    match_s = time.perf_counter() - t0
+
+    # the miss walk (cache-hostile traffic's steady state): fresh
+    # prompts that share nothing with the tree
+    misses = [
+        [rng.randrange(1, vocab) for _ in range(prompt_len)]
+        for _ in range(n_prefixes)
+    ]
+    t0 = time.perf_counter()
+    for p in misses:
+        pc.match(p, -1)
+    miss_s = time.perf_counter() - t0
+
+    return {
+        "insert_us": insert_s / n_prefixes * 1e6,
+        "match_us": match_s / n_prefixes * 1e6,
+        "match_miss_us": miss_s / n_prefixes * 1e6,
+        "match_hit_fraction": hits / n_prefixes,
+        "nodes": pc.stats.nodes,
+        "entries": pc.stats.entries,
+    }
+
+
+def submit_overhead_bench(n_submits: int = 400) -> dict:
+    """Per-submit cost with the cache OFF (prefix_cache=None) vs ON:
+    matching happens at ADMISSION, so submit itself must cost the same
+    either way — this pins that the cache adds nothing to the request
+    thread's path (the admission walk's cost is ``match_us`` above)."""
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    rng = random.Random(11)
+    prompts = [
+        [rng.randrange(1, cfg.vocab_size) for _ in range(48)]
+        for _ in range(n_submits)
+    ]
+
+    def time_submits(pc) -> float:
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=2, max_len=128,
+            prompt_buckets=(32, 64), chunked_prefill=16, prefix_cache=pc,
+        )
+        t0 = time.perf_counter()
+        for p in prompts:
+            cb.submit(p, max_new=4)
+        dt = time.perf_counter() - t0
+        cb.pending.clear()  # nothing ever runs; this is a submit bench
+        return dt / n_submits * 1e6
+
+    time_submits(None)  # warmup (tracer/logger lazy init dominates run 1)
+    off_us = time_submits(None)
+    cfg_cache = PrefixCache(cfg, buckets=(32, 64), budget_bytes=1 << 30)
+    miss_us = time_submits(cfg_cache)
+    return {
+        "submit_off_us": off_us,
+        "submit_miss_us": miss_us,
+        "miss_overhead_us": max(0.0, miss_us - off_us),
+    }
+
+
+def e2e_smoke() -> dict:
+    """Tiny cached-vs-cold serve A/B: the whole match/insert/promote/
+    evict path end to end on CPU (the CI canary half of this bench)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    r = serve_bench(
+        cfg, n_slots=2, n_requests=4, max_len=128, prompt_lens=(8, 17),
+        max_new=4, prompt_buckets=(16, 32, 64), chunked_prefill=16,
+        # the decode pipelined-vs-sync A/B is bench-host-overhead's job;
+        # this smoke wants only the prefix path
+        decode_ab=False,
+        prefix_ab=True, n_convs=2, n_turns=2, sys_len=40, turn_len=12,
+        prefix_max_new=4, prefix_cache_mb=64,
+    )
+    return {
+        "prefix_hit_rate": round(r.prefix_hit_rate, 3),
+        "prefill_tokens_saved_pct": round(r.prefill_tokens_saved_pct, 1),
+        "prefill_tokens_computed_cold": r.prefill_tokens_computed_cold,
+        "prefill_tokens_computed_cached": r.prefill_tokens_computed_cached,
+    }
+
+
+def prefix_cache_bench() -> dict:
+    out = {"workload": "prefix_cache"}
+    out.update({k: round(v, 3) if isinstance(v, float) else v
+                for k, v in trie_bench().items()})
+    out.update({k: round(v, 3) for k, v in submit_overhead_bench().items()})
+    out.update(e2e_smoke())
+    return out
+
+
+def main() -> int:
+    print(json.dumps(prefix_cache_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,11 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   minus device compute, as a percentage of step wall time) — the
   overlap win measured, not asserted: the pipeline is working when the
   pipelined host overhead is materially below the sync one.
+- the prefix-cache A/B (``prefix_ab=True``): a shared-system-prompt +
+  multi-turn conversation workload run cold (no cache) and cached
+  (serving/prefix_cache.py attached), reporting ``prefix_hit_rate``,
+  ``prefill_tokens_saved_pct`` and the computed-prefill-token counts of
+  both runs — the cache's win measured the same way the pipeline's is.
 
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
@@ -62,6 +67,37 @@ class ServeBenchResult:
     device_step_ms: float
     # the mode the primary (non-_sync) numbers were measured in
     pipeline_depth: int = 1
+    # prefix-cache A/B (shared-system-prompt + multi-turn workload; all
+    # zero when prefix_ab=False, chunked prefill is off, or the
+    # conversation workload doesn't fit max_len)
+    prefix_hit_rate: float = 0.0
+    prefill_tokens_saved_pct: float = 0.0
+    prefill_tokens_computed_cold: int = 0
+    prefill_tokens_computed_cached: int = 0
+    wall_seconds_prefix_cold: float = 0.0
+    wall_seconds_prefix_cached: float = 0.0
+
+
+class _PrefillRecorder:
+    """The batcher's metrics duck-type, recording only the prefill-token
+    provenance split (no prometheus; the A/B needs raw counts)."""
+
+    def __init__(self) -> None:
+        self.computed = 0
+        self.reused = 0
+
+    def on_prefill_tokens(self, n: int, source: str) -> None:
+        if source == "computed":
+            self.computed += n
+        else:
+            self.reused += n
+
+    # the batcher calls these unconditionally when metrics is set
+    def on_submit(self) -> None: ...
+    def on_prefill_chunk(self) -> None: ...
+    def on_first_token(self) -> None: ...
+    def on_step(self, emitted, queue, active, prefilling) -> None: ...
+    def on_finish(self, reason: str) -> None: ...
 
 
 def serve_bench(
@@ -74,6 +110,17 @@ def serve_bench(
     params=None,
     prompt_buckets: tuple[int, ...] = (64, 128, 256, 512),
     chunked_prefill: int = 256,
+    decode_ab: bool = True,
+    prefix_ab: bool = True,
+    n_convs: int = 6,
+    n_turns: int = 3,
+    # conversations must outgrow the prefill chunk by a wide margin:
+    # matches only save the compute below the back-scheduled finish
+    # window, so prompts near chunk size barely benefit (by design)
+    sys_len: int = 320,
+    turn_len: int = 96,
+    prefix_max_new: int = 16,
+    prefix_cache_mb: int = 1024,
 ) -> ServeBenchResult:
     from k8s_gpu_device_plugin_tpu.models.llama import init_params
 
@@ -154,13 +201,84 @@ def serve_bench(
         jax.block_until_ready(emitted)
         return (time.perf_counter() - t0) / steps * 1000
 
-    run_once(1)  # compile pass (all buckets + decode)
-    wall, step_ms = run_once(1)
-    wall_sync, step_ms_sync = run_once(0)
-    device_ms = device_only_ms()
+    # decode_ab=False skips the pipelined-vs-sync measurement entirely
+    # (those fields zero) for callers that only want the prefix A/B —
+    # e.g. the prefix-cache CI smoke, whose decode path bench-host-
+    # overhead already covers
+    if decode_ab:
+        run_once(1)  # compile pass (all buckets + decode)
+        wall, step_ms = run_once(1)
+        wall_sync, step_ms_sync = run_once(0)
+        device_ms = device_only_ms()
+    else:
+        wall = step_ms = wall_sync = step_ms_sync = device_ms = 0.0
 
     def overhead_pct(step: float) -> float:
         return max(0.0, step - device_ms) / step * 100.0 if step else 0.0
+
+    # --- prefix-cache A/B: shared system prompt + multi-turn waves ---
+    # Skipped (all-zero fields) when chunked prefill is off or the slots
+    # can't hold the conversation workload — small smoke configs; the
+    # runner's hardware config always fits.
+    hit_rate = saved_pct = wall_prefix_cold = wall_prefix_cached = 0.0
+    computed_cold = computed_cached = 0
+    if (
+        prefix_ab and chunked_prefill
+        and sys_len + n_turns * turn_len + prefix_max_new <= max_len
+    ):
+        from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+        def conv_waves() -> list[list[list[int]]]:
+            """n_convs conversations over ONE system prompt; each turn's
+            prompt extends the previous turn's by turn_len tokens (a
+            deterministic stand-in for user+assistant history growth, so
+            cold and cached runs see byte-identical traffic)."""
+            sys_p = jax.random.randint(
+                jax.random.key(777), (sys_len,), 1, cfg.vocab_size, "int32"
+            ).tolist()
+            history = {c: list(sys_p) for c in range(n_convs)}
+            waves = []
+            for t in range(n_turns):
+                wave = []
+                for c in range(n_convs):
+                    ext = jax.random.randint(
+                        jax.random.key(7000 + t * n_convs + c),
+                        (turn_len,), 1, cfg.vocab_size, "int32",
+                    ).tolist()
+                    history[c] = history[c] + ext
+                    wave.append(list(history[c]))
+                waves.append(wave)
+            return waves
+
+        waves = conv_waves()
+
+        def prefix_run(cache_on: bool):
+            rec = _PrefillRecorder()
+            pc = (
+                PrefixCache(cfg, buckets=prompt_buckets,
+                            budget_bytes=prefix_cache_mb << 20)
+                if cache_on else None
+            )
+            cb = ContinuousBatcher(
+                params, cfg, n_slots=n_slots, max_len=max_len,
+                prompt_buckets=prompt_buckets,
+                chunked_prefill=chunked_prefill, metrics=rec,
+                prefix_cache=pc,
+            )
+            t0 = time.perf_counter()
+            for wave in waves:  # a turn extends its finished predecessor
+                for p in wave:
+                    cb.submit(p, max_new=prefix_max_new)
+                cb.run()
+            return rec, pc, time.perf_counter() - t0
+
+        prefix_run(True)  # compile pass (extract/insert prefix jits)
+        rec_cached, pc, wall_prefix_cached = prefix_run(True)
+        rec_cold, _, wall_prefix_cold = prefix_run(False)
+        computed_cached, computed_cold = rec_cached.computed, rec_cold.computed
+        hit_rate = pc.stats.as_dict()["hit_rate"]
+        if computed_cold:
+            saved_pct = 100.0 * (1.0 - computed_cached / computed_cold)
 
     total_new = n_requests * max_new  # eos disabled: every budget runs out
     return ServeBenchResult(
@@ -168,13 +286,19 @@ def serve_bench(
         n_slots=n_slots,
         total_new_tokens=total_new,
         wall_seconds=wall,
-        tokens_per_second=total_new / wall,
-        requests_per_second=n_requests / wall,
+        tokens_per_second=total_new / wall if wall else 0.0,
+        requests_per_second=n_requests / wall if wall else 0.0,
         decode_step_ms=step_ms,
         host_overhead_pct=overhead_pct(step_ms),
         wall_seconds_sync=wall_sync,
-        tokens_per_second_sync=total_new / wall_sync,
+        tokens_per_second_sync=total_new / wall_sync if wall_sync else 0.0,
         decode_step_ms_sync=step_ms_sync,
         host_overhead_pct_sync=overhead_pct(step_ms_sync),
         device_step_ms=device_ms,
+        prefix_hit_rate=hit_rate,
+        prefill_tokens_saved_pct=saved_pct,
+        prefill_tokens_computed_cold=computed_cold,
+        prefill_tokens_computed_cached=computed_cached,
+        wall_seconds_prefix_cold=wall_prefix_cold,
+        wall_seconds_prefix_cached=wall_prefix_cached,
     )

@@ -56,6 +56,50 @@ class ServingMetrics:
             "Prefill chunks executed (chunked admission only)",
             registry=registry,
         )
+        # Prefill work by PROVENANCE: chunks say how many dispatches ran,
+        # this says how many prompt tokens they covered — and how many
+        # were served from prefilled prefix rows instead (the prefix
+        # cache's savings, directly observable as the computed/reused
+        # split instead of inferred from chunk counts).
+        self.prefill_tokens = Counter(
+            f"{prefix}_prefill_tokens_total",
+            "Prompt tokens prefilled, by provenance",
+            ["source"],  # computed | prefix_reused
+            registry=registry,
+        )
+        # Automatic prefix cache (serving/prefix_cache.py): request-level
+        # hit/miss (one disposition per request, counted at admission),
+        # LRU evictions, tokens served from cache, and HBM residency.
+        self.prefix_hits = Counter(
+            f"{prefix}_prefix_cache_hits_total",
+            "Requests admitted with a cached prefix",
+            registry=registry,
+        )
+        self.prefix_misses = Counter(
+            f"{prefix}_prefix_cache_misses_total",
+            "Requests admitted with no usable cached prefix",
+            registry=registry,
+        )
+        self.prefix_evictions = Counter(
+            f"{prefix}_prefix_cache_evictions_total",
+            "Cached prefixes evicted (LRU, HBM byte budget)",
+            registry=registry,
+        )
+        self.prefix_tokens_saved = Counter(
+            f"{prefix}_prefix_cache_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped via a cache hit",
+            registry=registry,
+        )
+        self.prefix_resident_bytes = Gauge(
+            f"{prefix}_prefix_cache_resident_bytes",
+            "HBM bytes held by cached prefixes",
+            registry=registry,
+        )
+        self.prefix_entries = Gauge(
+            f"{prefix}_prefix_cache_entries",
+            "Cached prefixes currently resident",
+            registry=registry,
+        )
         self.queue_depth = Gauge(
             f"{prefix}_queue_depth",
             "Requests waiting for a slot",
@@ -126,6 +170,13 @@ class ServingMetrics:
             self.requests_submitted,
             self.requests_finished,
             self.prefill_chunks,
+            self.prefill_tokens,
+            self.prefix_hits,
+            self.prefix_misses,
+            self.prefix_evictions,
+            self.prefix_tokens_saved,
+            self.prefix_resident_bytes,
+            self.prefix_entries,
             self.queue_depth,
             self.slots_active,
             self.slots_prefilling,
@@ -148,6 +199,27 @@ class ServingMetrics:
 
     def on_prefill_chunk(self) -> None:
         self.prefill_chunks.inc()
+
+    def on_prefill_tokens(self, n: int, source: str) -> None:
+        """``source`` is "computed" (ran through the model) or
+        "prefix_reused" (copied from cached prefix rows)."""
+        self.prefill_tokens.labels(source=source).inc(n)
+
+    # --- prefix-cache hooks (serving/prefix_cache.py) ---
+
+    def on_prefix_hit(self, tokens_reused: int) -> None:
+        self.prefix_hits.inc()
+        self.prefix_tokens_saved.inc(tokens_reused)
+
+    def on_prefix_miss(self) -> None:
+        self.prefix_misses.inc()
+
+    def on_prefix_evict(self, freed_bytes: int) -> None:
+        self.prefix_evictions.inc()
+
+    def set_prefix_resident_bytes(self, nbytes: int, entries: int) -> None:
+        self.prefix_resident_bytes.set(nbytes)
+        self.prefix_entries.set(entries)
 
     def on_first_token(self) -> None:
         """The first generated token is sampled at prefill time, outside

@@ -261,11 +261,40 @@ def decode_step(
 _NONE_CACHED = object()
 
 
+#: the one bucket ladder: prefill compiles, prefix-cache promotion
+#: boundaries and precompute_prefix padding all quantize to it (shared
+#: here so the serving layer can build a PrefixCache with the same
+#: boundaries the batcher will promote at)
+DEFAULT_PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+
+
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
         if n <= b:
             return b
     raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
+
+
+def effective_prefix_reuse(matched: int, prompt_len: int, chunk: int) -> int:
+    """Prefill compute a ``matched``-token prefix actually skips for a
+    ``prompt_len``-token prompt under chunked prefill, in tokens of
+    dispatched chunk work. The scheduler dispatches fixed-C intermediate
+    chunks from the prefix boundary and the SAME back-scheduled finish
+    chunk either way, so savings materialize only as whole skipped
+    intermediate chunks: a 64-token match against chunk=256 skips
+    nothing (the chunk grid just shifts), while a 256-token match skips
+    exactly one 256-token dispatch. The ONE definition of this —
+    cached_tokens, the prefix_reused metric and the cache's tokens_saved
+    all report it (``chunk=0`` = no cap, returns ``matched``)."""
+    if not chunk:
+        return matched
+
+    def n_chunks(start: int) -> int:
+        # intermediate chunks _prefill_one_chunk dispatches from
+        # ``start``: one per C while start + C < prompt_len
+        return max(0, -(-(prompt_len - start) // chunk) - 1)
+
+    return (n_chunks(0) - n_chunks(matched)) * chunk
 
 
 @dataclass
@@ -297,6 +326,10 @@ class _Request:
     # uses fold_in(key(seed), i), i = len(out) host-side — the sampled
     # stream reproduces regardless of batch composition or timing
     seed: "int | None" = None
+    # prompt tokens served from prefilled prefix rows instead of being
+    # recomputed (an automatic prefix-cache hit, or a manual prefix);
+    # surfaced as OpenAI usage prompt_tokens_details.cached_tokens
+    cached_tokens: int = 0
     # request-lifecycle observability: submit/last-token perf_counter
     # marks (TTFT + inter-token histograms) and the request's span tree
     # (obs/trace.py; None everywhere when tracing is off)
@@ -332,6 +365,10 @@ class ContinuousBatcher:
     per_request_bias = True
     #: per-request sampling seeds (same story)
     per_request_seed = True
+    #: automatic prefix caching rides chunked prefill + _insert_prefix;
+    #: the speculative subclass rejects prefixes outright (its draft
+    #: cache has no prefix rows), so it turns this off
+    supports_prefix_cache = True
 
     def __init__(
         self,
@@ -341,13 +378,14 @@ class ContinuousBatcher:
         max_len: int,
         sampler: Sampler | None = None,
         eos_id: int | None = None,
-        prompt_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024),
+        prompt_buckets: tuple[int, ...] = DEFAULT_PROMPT_BUCKETS,
         chunked_prefill: int = 0,
         seed: int = 0,
         metrics=None,
         adapters=None,  # lora_serving.AdapterSet: multi-LoRA serving
         pipeline_depth: int = 1,
         trace_steps: bool = False,
+        prefix_cache=None,  # serving.prefix_cache.PrefixCache (or None)
     ):
         if adapters is not None:
             from k8s_gpu_device_plugin_tpu.models.lora_serving import (
@@ -386,6 +424,45 @@ class ContinuousBatcher:
                 f"no prompt bucket fits max_len={max_len} "
                 f"(buckets={prompt_buckets})"
             )
+        # Automatic prefix caching (serving/prefix_cache.py): submit
+        # matches every prompt against it, the completed-prefill hook
+        # promotes into it. Duck-typed (match/on_prefill_done) so this
+        # module keeps its no-serving-imports layering.
+        if prefix_cache is not None:
+            if not self.supports_prefix_cache:
+                raise ValueError(
+                    "this batcher does not support an automatic prefix "
+                    "cache (speculative batching has no prefix rows to "
+                    "mirror onto the draft cache)"
+                )
+            if not self.chunk:
+                raise ValueError(
+                    "automatic prefix caching requires chunked_prefill=C "
+                    "(the chunk scheduler is what continues a prefill "
+                    "from the matched boundary)"
+                )
+            if not self.buckets:
+                raise ValueError(
+                    f"automatic prefix caching needs a prompt bucket <= "
+                    f"max_len={max_len} (buckets={prompt_buckets}): "
+                    "promotion boundaries are the bucket ladder"
+                )
+            # the cache's match gate, savings accounting and promotion
+            # boundaries all depend on THIS batcher's chunk window and
+            # bucket ladder; bind both here rather than trusting the
+            # construction site to pass matching values (a cache that
+            # already holds entries promoted on a different ladder
+            # cannot be re-keyed — its tree edges span those boundaries)
+            if prefix_cache.stats.nodes and \
+                    tuple(prefix_cache.buckets) != self.buckets:
+                raise ValueError(
+                    "prefix cache already holds entries promoted on a "
+                    f"different bucket ladder {prefix_cache.buckets} "
+                    f"(this batcher's: {self.buckets})"
+                )
+            prefix_cache.chunk = self.chunk
+            prefix_cache.buckets = self.buckets
+        self.prefix_cache = prefix_cache
         self.state = init_batch_state(cfg, n_slots, max_len, seed)
         self.pending: list[_Request] = []
         self.running: dict[int, _Request] = {}    # slot -> decoding request
@@ -518,7 +595,19 @@ class ContinuousBatcher:
         — N requests sharing a P-token system prompt pay one P-token
         prefill total. Requires chunked_prefill (the chunk scheduler is
         what continues from an arbitrary offset). ``adapter`` selects a
-        stacked LoRA adapter (-1 = base model)."""
+        stacked LoRA adapter (-1 = base model).
+
+        With an automatic ``prefix_cache`` attached, a request that
+        names no explicit prefix is matched against it at ADMISSION
+        (``_admit``): the longest cached prefix of its prompt
+        (adapter-keyed, so the weights guard below can never fire on a
+        cache hit) becomes the request's prefix and only the suffix is
+        chunk-prefilled — the same path as a manual prefix, so the
+        token/logprob streams are bit-identical with the cache on or
+        off. Matching at admission rather than here means a queued burst
+        behind one system prompt hits as soon as the first prefill
+        promotes it, and nothing is counted for requests that are
+        rejected below or cancelled while still pending."""
         if prefix is not None and not self.chunk:
             raise ValueError("prefix sharing requires chunked_prefill=C")
         total = len(prompt) + (len(prefix.tokens) if prefix else 0)
@@ -542,6 +631,14 @@ class ContinuousBatcher:
             rid, full, max_new, prefix=prefix,
             stop=tuple(tuple(s) for s in (stop or ()) if s),
             sampler=sampler, adapter=adapter, bias=bias, seed=seed,
+            # manual prefixes report EFFECTIVE reuse too (auto-matched
+            # ones are set at admission): rows the finish window
+            # recomputes anyway are not served-from-cache
+            cached_tokens=(
+                effective_prefix_reuse(
+                    len(prefix.tokens), len(full), self.chunk
+                ) if prefix else 0
+            ),
         )
         req.t_submit = time.perf_counter()
         if self.tracer.enabled:
@@ -705,6 +802,21 @@ class ContinuousBatcher:
                     t0=req.t_submit, slot=slot,
                 ).end()
             if self.chunk:
+                if (req.prefix is None and self.prefix_cache is not None
+                        and len(req.prompt) > 1):
+                    # THE automatic match site: at admission the request
+                    # is past validation, can no longer be cancelled-
+                    # while-pending, and sees every prefix promoted
+                    # since it queued (a whole burst behind one system
+                    # prompt pays one prefill, not queue-depth), so the
+                    # cache's hit/miss counters record final
+                    # per-request dispositions only
+                    hit = self.prefix_cache.match(req.prompt, req.adapter)
+                    if hit is not None:
+                        req.prefix, matched = hit
+                        req.cached_tokens = self.prefix_cache.effective_reuse(
+                            matched, len(req.prompt)
+                        )
                 start = 0
                 if req.prefix is not None:
                     # copy the shared rows + presence; suffix chunks
@@ -714,6 +826,11 @@ class ContinuousBatcher:
                         jnp.int32(slot),
                     )
                     start = len(req.prefix.tokens)
+                    # cached_tokens is already the effective reuse, on
+                    # both the manual and auto paths
+                    self._count_prefill_tokens(
+                        req.cached_tokens, "prefix_reused"
+                    )
                 self.prefilling[slot] = req
                 self._prefill_pos[slot] = start
                 continue
@@ -740,6 +857,7 @@ class ContinuousBatcher:
             finally:  # a raised dispatch must not pin the trace open
                 if prefill_span is not None:
                     prefill_span.end()
+            self._count_prefill_tokens(len(req.prompt), "computed")
             self._on_first_token(req)
             self.running[slot] = req
             self._invalidate_slot_caches()
@@ -769,6 +887,7 @@ class ContinuousBatcher:
                 if chunk_span is not None:
                     chunk_span.end()
             self._prefill_pos[slot] = start + c
+            self._count_prefill_tokens(c, "computed")
             if self.metrics:
                 self.metrics.on_prefill_chunk()
             return
@@ -792,12 +911,40 @@ class ContinuousBatcher:
             if finish_span is not None:
                 finish_span.end()
         del self.prefilling[slot], self._prefill_pos[slot]
+        self._count_prefill_tokens(plen - fstart, "computed")
         req.out.append(int(tok))
         req.out_logp.append(float(logp))
         self._on_first_token(req)
         self.running[slot] = req
         self._invalidate_slot_caches()
+        self._maybe_promote_prefix(req)
         self._finish_if_done(req)
+
+    def _count_prefill_tokens(self, n: int, source: str) -> None:
+        """Prefill work accounting by provenance: ``computed`` tokens ran
+        through the model (chunk overlap recompute included — it is real
+        compute), ``prefix_reused`` tokens were copied from prefilled
+        prefix rows. Duck-typed like the other optional metric hooks."""
+        if self.metrics is not None and n > 0:
+            count = getattr(self.metrics, "on_prefill_tokens", None)
+            if count is not None:
+                count(n, source)
+
+    def _maybe_promote_prefix(self, req: _Request) -> None:
+        """The promotion hook: a completed chunked prefill offers its
+        full prompt back to the prefix cache, which decides which
+        ``prompt_buckets`` boundaries to materialize (hit-count policy,
+        HBM byte budget) and pulls each boundary's rows straight out of
+        the slot via :func:`extract_prefix_rows` — the slot holds the
+        whole prompt's K/V at this moment regardless of how much of it
+        came from a matched prefix."""
+        if self.prefix_cache is None:
+            return
+        slot = jnp.int32(req.slot)
+        self.prefix_cache.on_prefill_done(
+            req.prompt, req.adapter,
+            lambda p: extract_prefix_rows(self.state, slot, p),
+        )
 
     def _on_first_token(self, req: _Request) -> None:
         """First generated token (sampled at prefill time): TTFT metric +
@@ -1126,6 +1273,21 @@ def _merge_slot(cache: KVCache, part: KVCache, slot) -> KVCache:
                    v_scale=g(cache.v_scale, part.v_scale))
 
 
+@partial(jax.jit, static_argnames=("p",))
+def extract_prefix_rows(state: BatchState, slot, p: int) -> KVCache:
+    """First ``p`` KV rows of ``slot`` as a (L, 1, p, Hkv, hd) KVCache —
+    the prefix-cache promotion slice. ``p`` is static and always a
+    ``prompt_buckets`` boundary, so this compiles once per boundary (and
+    ``_insert_prefix``, which consumes the result, does too). The state
+    is NOT donated: the batch keeps decoding from it."""
+    sl = _slot_cache(state.cache, slot)
+    f = lambda c: (  # noqa: E731
+        None if c is None else jax.lax.slice_in_dim(c, 0, p, axis=2)
+    )
+    return KVCache(k=f(sl.k), v=f(sl.v),
+                   k_scale=f(sl.k_scale), v_scale=f(sl.v_scale))
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def prefill_chunk(
     params,
@@ -1225,6 +1387,13 @@ def prefill_finish(
 # starts by copying those rows into its slot and chunk-prefills only its
 # own suffix. N requests sharing a P-token system prompt cost one
 # P-token prefill total instead of N.
+#
+# serving/prefix_cache.py builds the AUTOMATIC tier on top: a radix
+# index of promoted PrefixStates that _admit matches every prompt
+# against, populated by the completed-prefill hook above
+# (_maybe_promote_prefix + extract_prefix_rows) — no caller ever names
+# a prefix, multi-turn chats and shared system prompts just stop paying
+# for re-prefill.
 
 
 @dataclass(frozen=True)
@@ -1245,26 +1414,44 @@ class PrefixState:
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _precompute_prefix(params, prefix: jax.Array, cfg: LlamaConfig,
-                       sel: jax.Array | None = None):
-    scratch = KVCache.init(cfg, 1, prefix.shape[0])
+def _precompute_prefix(params, prefix: jax.Array, prefix_len: jax.Array,
+                       cfg: LlamaConfig, sel: jax.Array | None = None):
+    """Traces at the PADDED bucket length ``prefix.shape[0]``: the real
+    length rides as a traced scalar and only gates the presence writes
+    (causal attention already keeps the padding out of the real rows'
+    K/V), so every prefix in the same bucket shares one compile instead
+    of one compile per exact length."""
+    p = prefix.shape[0]
+    scratch = KVCache.init(cfg, 1, p)
     _, scratch = _forward_cached(
         params, prefix[None, :], scratch, jnp.int32(0), cfg,
         select_pos=jnp.int32(0),  # logits unused
         lora_sel=sel,
     )
-    seen = jnp.zeros((cfg.vocab_size,), bool).at[prefix].set(True)
+    # masked presence write over the real tokens only (.max = scatter-OR,
+    # the prefill_insert idiom: a token in both prefix and padding stays
+    # True)
+    seen = jnp.zeros((cfg.vocab_size,), bool).at[prefix].max(
+        jnp.arange(p) < prefix_len
+    )
     return scratch, seen
 
 
 def precompute_prefix(
     params, tokens: list[int], cfg: LlamaConfig,
     adapter: int = -1, n_adapters: int = 0,
+    prompt_buckets: tuple[int, ...] = DEFAULT_PROMPT_BUCKETS,
 ) -> PrefixState:
-    """Prefill a shared prefix once (one compile per prefix length).
-    ``params`` must already carry stacked adapters (attach_adapters) when
-    ``adapter`` >= 0 — pass the batcher's own ``.params``."""
-    arr = jnp.asarray(tokens, jnp.int32)
+    """Prefill a shared prefix once. The forward pads to the next
+    ``prompt_buckets`` boundary so similar-length prefixes share a
+    compile (one trace per bucket, not per length); the returned rows
+    are sliced back to the exact token count, so ``PrefixState`` and
+    ``_insert_prefix`` semantics are unchanged. ``params`` must already
+    carry stacked adapters (attach_adapters) when ``adapter`` >= 0 —
+    pass the batcher's own ``.params``."""
+    n = len(tokens)
+    pad = next((b for b in sorted(prompt_buckets) if b >= n), n)
+    arr = jnp.asarray(list(tokens) + [0] * (pad - n), jnp.int32)
     sel = None
     if adapter >= 0 and not n_adapters:
         # silently prefilling BASE rows while labeling them with the
@@ -1287,7 +1474,14 @@ def precompute_prefix(
                 "own .params (attach_adapters output), not the base tree"
             )
         sel = jnp.asarray(one_hot_sel(adapter, n_adapters))[None, :]
-    rows, seen = _precompute_prefix(params, arr, cfg, sel)
+    rows, seen = _precompute_prefix(params, arr, jnp.int32(n), cfg, sel)
+    if pad != n:
+        # slice back to the exact length: the padded tail rows are
+        # causal-masked garbage and must not enter _insert_prefix (they
+        # would be copied over the suffix's positions in the slot)
+        cut = lambda c: None if c is None else c[:, :, :n]  # noqa: E731
+        rows = KVCache(k=cut(rows.k), v=cut(rows.v),
+                       k_scale=cut(rows.k_scale), v_scale=cut(rows.v_scale))
     return PrefixState(rows=rows, tokens=tuple(tokens), presence=seen,
                        adapter=adapter)
 

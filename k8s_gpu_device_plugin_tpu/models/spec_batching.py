@@ -254,6 +254,9 @@ class SpeculativeBatcher(ContinuousBatcher):
     per_request_sampler = False
     per_request_bias = False  # the draft+verify round threads no planes
     per_request_seed = False  # same: no per-row key streams in the round
+    #: submit() rejects prefixes (below): the draft cache has no prefix
+    #: rows, so an automatic prefix cache must be refused at construction
+    supports_prefix_cache = False
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
                adapter=-1, logit_bias=None, seed=None):

@@ -621,6 +621,13 @@ class _OpenAIRoutes:
                         "token_logprobs": lps,
                     }
             choices.append(choice)
+        # prompt tokens served from the automatic prefix cache (OpenAI's
+        # usage.prompt_tokens_details.cached_tokens field). n>1 submits
+        # one engine request per choice over the same prompt and each
+        # matches independently (the first may even seed the cache for
+        # the rest mid-flight); usage is one envelope per API request, so
+        # report the best reuse any choice achieved.
+        infos = [self._server.engine.pop_request_info(eid) for eid, _ in subs]
         return web.json_response({
             "id": oai_id,
             "object": object_name,
@@ -629,6 +636,12 @@ class _OpenAIRoutes:
             "choices": choices,
             "usage": {
                 "prompt_tokens": len(prompt),
+                "prompt_tokens_details": {
+                    "cached_tokens": max(
+                        (i.get("cached_tokens", 0) for i in infos),
+                        default=0,
+                    ),
+                },
                 "completion_tokens": completion_tokens,
                 "total_tokens": len(prompt) + completion_tokens,
             },

@@ -1,0 +1,334 @@
+"""Automatic prefix caching: radix-tree KV reuse across serving requests.
+
+PR 2 removed the host from the decode loop; the remaining dominant
+serving cost under realistic traffic is redundant PREFILL — every
+multi-turn chat re-prefills its whole history, and every request behind
+a shared system prompt re-computes the same KV rows. The batcher already
+has the mechanism (``PrefixState`` / ``_insert_prefix``,
+models/batching.py: N requests naming one prefilled prefix pay one
+prefill total) but it is manual. This module makes it automatic:
+
+- a **radix tree over token ids** indexes every prefix the engine has
+  prefilled, keyed by ``(adapter, tokens)`` — adapter-aware because the
+  K/V rows depend on the weights that produced them (``PrefixState``
+  records its adapter; ``submit`` rejects a mismatch);
+- every incoming request is **matched automatically at admission** (the
+  slot-assignment step right after submit — past validation, and late
+  enough that a queued burst sees what its queue-mates just promoted):
+  the longest cached prefix of the prompt is inserted through the
+  existing ``_insert_prefix`` path, so only the suffix is
+  chunk-prefilled;
+- the cache **populates itself**: after a request's prefill completes
+  the batcher offers its prompt back (``on_prefill_done``), and prefixes
+  are promoted at the batcher's ``prompt_buckets`` boundaries — the same
+  ladder the prefill compiles quantize to, so ``_insert_prefix`` and the
+  row extraction compile once per boundary, not once per prompt;
+- residency is bounded by an **HBM byte budget** (computed from the KV
+  dtype and model config — :func:`prefix_kv_bytes`) with LRU eviction.
+
+Bucket-aligned radix edges: promotion and matching both happen at
+``prompt_buckets`` boundaries only, so the tree's edges span exactly one
+boundary gap each (root -> tokens[0:32] -> tokens[32:64] -> ...). That
+keeps the radix property (one hash per edge, O(prompt) total match cost)
+without per-token nodes, and two prompts diverging inside a gap share
+every boundary below their divergence — exactly the reuse the insert
+path can express, since it only copies whole boundary-aligned row
+blocks.
+
+Policy knobs: ``min_hits=1`` promotes every completed prefill
+("always"); ``min_hits=N`` defers the HBM spend until a prefix has been
+seen N times (first-repeat latency traded for less duplication — nested
+boundary entries each hold their own row copy). Eviction drops the
+device arrays only from the TREE; requests that already matched an
+entry hold their own reference, so an eviction mid-flight is invisible
+to them — the bit-exactness guarantee (cache on vs off produces
+identical greedy/seeded token and logprob streams) needs no pinning or
+refcounts, and tests/test_prefix_cache.py pins it across
+admit/retire/cancel/eviction interleavings.
+
+Single-threaded by design: every mutating call happens on the engine
+thread (``submit`` runs there via the engine's admission queue), the
+same discipline as the batcher itself. ``stats()`` is a GIL-consistent
+read for HTTP handlers.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    PrefixState,
+    effective_prefix_reuse,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
+
+
+def prefix_kv_bytes(cfg: LlamaConfig, p: int) -> int:
+    """HBM bytes a ``p``-token cached prefix occupies: K and V rows
+    (L, 1, p, Hkv, hd) in the cache dtype, plus the f32 scale planes on
+    the quantized paths. The byte budget is denominated in THIS, so an
+    operator's ``--prefixCacheMB`` means the same thing under bf16, int8
+    and int4 caches (int4 packs two codes per byte in HBM)."""
+    per_elt = {"int8": 1.0, "int4": 0.5}.get(cfg.cache_quant)
+    if per_elt is None:
+        per_elt = jnp.dtype(cfg.dtype).itemsize  # bf16/f32 path
+    elts = cfg.n_layers * p * cfg.n_kv_heads * cfg.head_dim
+    nbytes = 2 * elts * per_elt  # K + V
+    if cfg.cache_quant in ("int8", "int4"):
+        nbytes += 2 * cfg.n_layers * p * cfg.n_kv_heads * 4  # f32 scales
+    return int(nbytes)
+
+
+class _Node:
+    """One radix-tree node at a bucket-boundary depth. ``span`` is the
+    edge label from the parent (the tokens between the two boundaries);
+    ``entry`` is the materialized PrefixState when this boundary has
+    been promoted, None while it is only being hit-counted."""
+
+    __slots__ = ("span", "parent", "children", "entry", "entry_bytes",
+                 "hits", "depth")
+
+    def __init__(self, span: tuple, parent: "_Node | None", depth: int):
+        self.span = span
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}
+        self.entry: PrefixState | None = None
+        self.entry_bytes = 0
+        self.hits = 0
+        self.depth = depth
+
+
+@dataclass
+class PrefixCacheStats:
+    """Plain counters, exposed via ``stats()`` (and mirrored to the
+    prometheus ServingMetrics when one is attached)."""
+
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    promotions: int = 0
+    tokens_saved: int = 0
+    resident_bytes: int = 0
+    entries: int = 0
+    nodes: int = 0
+
+    def as_dict(self) -> dict:
+        d = {k: getattr(self, k) for k in (
+            "hits", "misses", "evictions", "promotions", "tokens_saved",
+            "resident_bytes", "entries", "nodes",
+        )}
+        total = self.hits + self.misses
+        d["hit_rate"] = self.hits / total if total else 0.0
+        return d
+
+
+@dataclass
+class PrefixCache:
+    """Adapter-aware radix index of prefilled prefixes with an LRU HBM
+    budget. The batcher is the only caller: ``match`` at admission,
+    ``on_prefill_done`` after each completed prefill."""
+
+    cfg: LlamaConfig
+    buckets: tuple[int, ...]
+    budget_bytes: int
+    #: promotion policy: 1 = always (every completed prefill's boundary
+    #: prefixes are materialized), N = only after N sightings
+    min_hits: int = 1
+    #: the batcher's chunked-prefill size, set by the consuming batcher
+    #: at construction. Savings are whole-chunk-granular (the scheduler
+    #: dispatches fixed-C chunks from the prefix boundary plus the same
+    #: finish chunk either way — effective_prefix_reuse), so matches
+    #: that would skip zero dispatches are refused and all reuse
+    #: accounting reports skipped dispatch work, not copied rows.
+    #: 0 = uncapped (pure-trie tests/benches).
+    chunk: int = 0
+    metrics: object = None
+    #: host-memory backstop for the hit-counting (unmaterialized) nodes:
+    #: beyond this, new prompts stop growing the tree (existing entries
+    #: keep matching; the LRU keeps recycling)
+    max_nodes: int = 65536
+    stats: PrefixCacheStats = field(default_factory=PrefixCacheStats)
+
+    def __post_init__(self):
+        self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not self.buckets:
+            raise ValueError("prefix cache needs a non-empty bucket ladder")
+        if self.min_hits < 1:
+            raise ValueError(f"min_hits must be >= 1, got {self.min_hits}")
+        self._roots: dict[int, _Node] = {}       # adapter -> tree root
+        self._lru: "OrderedDict[_Node, None]" = OrderedDict()
+        self._tracer = get_tracer()
+
+    # --- submit side ---
+
+    def match(self, tokens, adapter: int = -1):
+        """Longest cached prefix of ``tokens`` under ``adapter``, as
+        ``(PrefixState, matched_len)`` — or None. The match is capped at
+        ``len(tokens) - 1``: at least one suffix token must remain for
+        the finish chunk to sample the first generated token from.
+        Prompts no longer than ``chunk`` never match: the back-scheduled
+        finish window would recompute every matched row anyway, making a
+        hit pure overhead with phantom savings.
+
+        The batcher calls this once per request, at ADMISSION — past
+        validation, past cancel-while-pending, and after any prefix a
+        queue-mate's prefill promoted — so hits/misses record exactly
+        one final disposition per admitted request."""
+        node = self._roots.get(adapter)
+        best: _Node | None = None
+        depth = 0
+        if node is not None and len(tokens) > self.chunk:
+            cap = len(tokens) - 1
+            for b in self.buckets:
+                if b > cap:
+                    break
+                child = node.children.get(tuple(tokens[depth:b]))
+                if child is None:
+                    break
+                node, depth = child, b
+                if node.entry is not None:
+                    best = node
+        if best is not None and self.effective_reuse(
+            best.depth, len(tokens)
+        ) <= 0:
+            # the chunk grid would just shift without skipping a single
+            # dispatch (savings are whole-chunk-granular): a hit here is
+            # pure copy overhead, so it counts — and serves — as a miss
+            best = None
+        if best is None:
+            self.stats.misses += 1
+            if self.metrics is not None:
+                on_miss = getattr(self.metrics, "on_prefix_miss", None)
+                if on_miss is not None:
+                    on_miss()
+            return None
+        self._lru.move_to_end(best)
+        self.stats.hits += 1
+        saved = self.effective_reuse(best.depth, len(tokens))
+        self.stats.tokens_saved += saved
+        if self.metrics is not None:
+            on_hit = getattr(self.metrics, "on_prefix_hit", None)
+            if on_hit is not None:
+                on_hit(saved)
+        if self._tracer.enabled:
+            self._tracer.span(
+                "prefix_match", component="prefix_cache",
+                matched=best.depth, saved=saved, prompt_len=len(tokens),
+                adapter=adapter,
+            ).end()
+        return best.entry, best.depth
+
+    def effective_reuse(self, matched: int, prompt_len: int) -> int:
+        """This cache's view of :func:`effective_prefix_reuse` (the one
+        definition of the finish-window cap, models/batching.py)."""
+        return effective_prefix_reuse(matched, prompt_len, self.chunk)
+
+    # --- promotion side ---
+
+    def on_prefill_done(self, tokens, adapter: int, extract) -> None:
+        """A request's prefill just completed: walk/grow its boundary
+        path, bump hit counts, and materialize every boundary that
+        crossed ``min_hits`` and fits the budget. ``extract(p)`` returns
+        the slot's first ``p`` KV rows as a (L, 1, p, Hkv, hd) KVCache —
+        the batcher's jitted slice, one compile per boundary."""
+        if self.budget_bytes <= 0:
+            return
+        root = self._roots.get(adapter)
+        if root is None:
+            root = self._roots[adapter] = _Node((), None, 0)
+            self.stats.nodes += 1
+        node, depth = root, 0
+        # one presence mask per WALK, extended incrementally: boundary
+        # b's mask covers tokens[:b], so each materialization scatters
+        # only the tokens since the last one instead of rebuilding a
+        # (V,) mask from scratch per boundary (engine-thread host work)
+        presence_np = covered = None
+        for b in self.buckets:
+            if b > len(tokens):
+                break
+            span = tuple(tokens[depth:b])
+            child = node.children.get(span)
+            if child is None:
+                if self.stats.nodes >= self.max_nodes:
+                    return
+                child = _Node(span, node, b)
+                node.children[span] = child
+                self.stats.nodes += 1
+            node, depth = child, b
+            node.hits += 1
+            if node.entry is None and node.hits >= self.min_hits:
+                if presence_np is None:
+                    presence_np = np.zeros((self.cfg.vocab_size,), bool)
+                    covered = 0
+                presence_np[np.asarray(tokens[covered:b], np.int64)] = True
+                covered = b
+                self._materialize(node, tokens[:b], adapter, extract,
+                                  presence_np)
+
+    def _materialize(self, node: _Node, tokens, adapter: int, extract,
+                     presence_np) -> None:
+        nbytes = prefix_kv_bytes(self.cfg, node.depth)
+        if nbytes > self.budget_bytes:
+            return  # an uncacheable giant must not wipe the whole LRU
+        while self.stats.resident_bytes + nbytes > self.budget_bytes:
+            self._evict_lru()
+        node.entry = PrefixState(
+            rows=extract(node.depth), tokens=tuple(tokens),
+            # jnp.asarray copies NOW, so the walk extending presence_np
+            # for the next boundary cannot alias this entry's mask
+            presence=jnp.asarray(presence_np), adapter=adapter,
+        )
+        node.entry_bytes = nbytes
+        self._lru[node] = None
+        self.stats.promotions += 1
+        self.stats.entries += 1
+        self.stats.resident_bytes += nbytes
+        self._report_residency()
+        if self._tracer.enabled:
+            self._tracer.span(
+                "prefix_promote", component="prefix_cache",
+                prefix_len=node.depth, bytes=nbytes, adapter=adapter,
+                hits=node.hits,
+            ).end()
+
+    # --- eviction ---
+
+    def _evict_lru(self) -> None:
+        node, _ = self._lru.popitem(last=False)
+        freed, depth = node.entry_bytes, node.depth
+        node.entry = None
+        node.entry_bytes = 0
+        self.stats.evictions += 1
+        self.stats.entries -= 1
+        self.stats.resident_bytes -= freed
+        # prune entry-less leaves so the tree doesn't accumulate dead
+        # paths (their hit counts go with them — a pruned prefix starts
+        # cold again, which is what LRU eviction means)
+        while (
+            node is not None and node.entry is None and not node.children
+            and node.parent is not None
+        ):
+            del node.parent.children[node.span]
+            self.stats.nodes -= 1
+            node = node.parent
+        self._report_residency()
+        if self.metrics is not None:
+            on_evict = getattr(self.metrics, "on_prefix_evict", None)
+            if on_evict is not None:
+                on_evict(freed)
+        if self._tracer.enabled:
+            self._tracer.span(
+                "prefix_evict", component="prefix_cache",
+                prefix_len=depth, bytes=freed,
+            ).end()
+
+    def _report_residency(self) -> None:
+        if self.metrics is not None:
+            set_res = getattr(self.metrics, "set_prefix_resident_bytes", None)
+            if set_res is not None:
+                set_res(self.stats.resident_bytes, self.stats.entries)

@@ -44,6 +44,15 @@ the adapters (models/lora_serving.py) and every request picks one —
 ``"adapter": "name"`` here, or the OpenAI ``"model"`` field (the base
 model's id or an adapter name; ``/v1/models`` lists all).
 
+Automatic prefix caching (serving/prefix_cache.py; on by default):
+prompts sharing a cached prefix — system prompts, multi-turn chat
+histories — skip its re-prefill; the cache is a radix index over token
+ids, LRU-evicted under ``--prefixCacheMB`` of HBM, promotion gated by
+``--prefixCacheMinHits``, disabled by ``--prefixCacheOff``. Responses
+report the reuse (``cached_tokens`` natively, OpenAI
+``usage.prompt_tokens_details``), ``/v1/health`` carries live cache
+stats, and token/logprob streams are bit-identical cache on or off.
+
 Design notes: the engine thread is the batcher's sole owner, and
 handlers never wait on device work — submissions ride a small locked
 queue the engine drains between steps. The batcher's decode loop is
@@ -99,6 +108,7 @@ class InferenceEngine:
         adapters=None,  # lora_serving.AdapterSet (multi-LoRA serving)
         pipeline_depth: int = 1,
         trace_steps: bool = False,
+        prefix_cache=None,  # serving.prefix_cache.PrefixCache (or None)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -107,12 +117,19 @@ class InferenceEngine:
                 "pass adapters to the injected batcher's own constructor; "
                 "silently ignoring them here would 404 every adapter request"
             )
+        if batcher is not None and prefix_cache is not None:
+            raise ValueError(
+                "pass the prefix cache to the injected batcher's own "
+                "constructor; silently ignoring it here would serve every "
+                "request cold"
+            )
         self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(chunked_prefill, max_len),
             metrics=metrics, adapters=adapters,
             pipeline_depth=pipeline_depth, trace_steps=trace_steps,
+            prefix_cache=prefix_cache,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -132,6 +149,11 @@ class InferenceEngine:
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
         self._rid_to_eid: dict[int, int] = {}
+        # eid -> per-request wrap-up facts (cached_tokens today), recorded
+        # when the request retires and popped by the HTTP handler for the
+        # response envelope; capped so streams that never pop (client
+        # gone) age out instead of leaking
+        self._finished_info: dict[int, dict] = {}
         self._next_eid = 0
         self._thread = threading.Thread(
             target=self._loop, name="inference-engine", daemon=True
@@ -211,17 +233,28 @@ class InferenceEngine:
             self._cancelq.append(eid)
         self._work.set()
 
+    def pop_request_info(self, eid: int) -> dict:
+        """Per-request wrap-up facts recorded at retirement (empty dict
+        for unknown/aged-out eids). Pop-once: the handler that owns the
+        stream consumes it."""
+        with self._lock:
+            return self._finished_info.pop(eid, {})
+
     def stats(self) -> dict:
         # approximate cross-thread reads (GIL-consistent lengths)
         with self._lock:
             queued_local = len(self._subq)
-        return {
+        out = {
             "slots": self.cb.n_slots,
             "active": len(self.cb.running),
             "prefilling": len(self.cb.prefilling),
             "queued": len(self.cb.pending) + queued_local,
             "alive": not self._dead.is_set(),
         }
+        pc = getattr(self.cb, "prefix_cache", None)
+        if pc is not None:
+            out["prefix_cache"] = pc.stats.as_dict()
+        return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stop.set()
@@ -293,6 +326,13 @@ class InferenceEngine:
                 with self._lock:
                     loop, q = self._streams.pop(eid)
                     self._published.pop(eid)
+                    self._finished_info[eid] = {
+                        "cached_tokens": req.cached_tokens,
+                    }
+                    while len(self._finished_info) > 4096:  # unpopped: aged out
+                        self._finished_info.pop(
+                            next(iter(self._finished_info))
+                        )
                 del self._rid_to_eid[rid]
                 loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
 
@@ -591,7 +631,18 @@ class InferenceServer:
                 for eid_, _ in subs:
                     self.engine.cancel(eid_)
                 raise
-            payload = {"id": rid, "tokens": drained[0][0]}
+            infos = [self.engine.pop_request_info(eid_) for eid_, _ in subs]
+            payload = {
+                "id": rid, "tokens": drained[0][0],
+                # prompt tokens served from the prefix cache (0 when the
+                # cache is off or missed) — the native twin of OpenAI's
+                # usage.prompt_tokens_details.cached_tokens, with the
+                # same n>1 rule: the best reuse any choice achieved (the
+                # first choice may seed the cache for the rest)
+                "cached_tokens": max(
+                    (i.get("cached_tokens", 0) for i in infos), default=0
+                ),
+            }
             if want_logprobs:
                 payload["logprobs"] = drained[0][1]
             if n > 1:
@@ -629,6 +680,11 @@ class InferenceServer:
                     # text-as-you-go can decode the token prefix
                     # themselves with the same caveat)
                     done: dict = {"done": True}
+                    info = self.engine.pop_request_info(rid)
+                    if info.get("cached_tokens"):
+                        # only when the prefix cache actually served part
+                        # of the prompt — the common done event stays lean
+                        done["cached_tokens"] = info["cached_tokens"]
                     if self.tokenizer is not None:
                         with self.tracer.span(
                             "detokenize", component="serving",
@@ -855,6 +911,21 @@ def _main(argv: list[str] | None = None) -> int:
                         "the synchronous loop (ignored with "
                         "--draftPreset: the speculative round is "
                         "synchronous by construction)")
+    parser.add_argument("--prefixCacheMB", type=int, default=256,
+                        help="HBM byte budget (MiB) for the automatic "
+                        "prefix cache: prompts sharing a cached prefix "
+                        "(system prompts, multi-turn histories) skip its "
+                        "re-prefill; LRU-evicted under this budget. "
+                        "Requires chunked prefill; 0 disables")
+    parser.add_argument("--prefixCacheMinHits", type=int, default=1,
+                        help="promote a prefix into the cache after this "
+                        "many sightings (1 = every completed prefill; "
+                        "higher trades first-repeat latency for less "
+                        "HBM duplication across nested boundaries)")
+    parser.add_argument("--prefixCacheOff", action="store_true",
+                        help="disable the automatic prefix cache "
+                        "(equivalent to --prefixCacheMB 0; token and "
+                        "logprob streams are bit-identical either way)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -949,6 +1020,29 @@ def _main(argv: list[str] | None = None) -> int:
         scorer = Scorer(params, cfg, max_len=args.scoringMaxLen)
 
     metrics = ServingMetrics()
+    # Automatic prefix caching: on by default wherever it can work —
+    # chunked prefill (the suffix scheduler) and a non-speculative
+    # batcher (the draft cache has no prefix rows). Promotion boundaries
+    # are the batcher's own prompt-bucket ladder.
+    prefix_cache = None
+    if (
+        not args.prefixCacheOff and args.prefixCacheMB > 0
+        and args.chunkedPrefill > 0 and not args.draftPreset
+    ):
+        from k8s_gpu_device_plugin_tpu.models.batching import (
+            DEFAULT_PROMPT_BUCKETS,
+        )
+        from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+        buckets = tuple(b for b in DEFAULT_PROMPT_BUCKETS if b <= args.maxLen)
+        if buckets:  # a maxLen below the smallest boundary: nothing cacheable
+            prefix_cache = PrefixCache(
+                cfg,
+                buckets=buckets,
+                budget_bytes=args.prefixCacheMB << 20,
+                min_hits=args.prefixCacheMinHits,
+                metrics=metrics,
+            )
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -971,6 +1065,7 @@ def _main(argv: list[str] | None = None) -> int:
         batcher=batcher, adapters=adapters,
         pipeline_depth=args.pipelineDepth,
         trace_steps=args.traceSteps and args.tracing,
+        prefix_cache=prefix_cache,
     )
     from prometheus_client import REGISTRY
 

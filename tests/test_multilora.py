@@ -122,6 +122,27 @@ def test_adapter_prefix_compatibility(setup):
         cb.submit(suffix, max_new=6, prefix=prefix)  # base vs adapter-0
 
 
+def test_submit_rejects_base_prefix_for_adapter_request(setup):
+    """The remaining direction of the submit()-side weights guard: rows
+    prefilled with the BASE model (adapter=-1, plain params) must not
+    serve an adapter request. (The adapter->other-adapter and
+    adapter->base directions are pinned above; the base pairing's
+    serving exactness is pinned by test_batching's shared-prefix tests;
+    precompute-side argument guards further below.)"""
+    cfg, params, aset, merged = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           chunked_prefill=8, adapters=aset)
+    base_prefix = precompute_prefix(params, _prompt(75, 9, cfg), cfg)
+    suffix = _prompt(76, 4, cfg)
+    for adapter in (0, 1):
+        with pytest.raises(ValueError, match="prefix was prefilled"):
+            cb.submit(suffix, max_new=5, prefix=base_prefix,
+                      adapter=adapter)
+    # the base pairing passes the guard (no dispatch: just queued)
+    assert cb.submit(suffix, max_new=5, prefix=base_prefix) >= 0
+    cb.pending.clear()
+
+
 def test_adapter_validation(setup):
     cfg, params, aset, _ = setup
     cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,

@@ -88,9 +88,13 @@ def test_completions_token_ids_greedy_parity(setup):
         assert p["model"] == "tpu-serving"
         assert len(p["choices"]) == 1
         # no tokenizer: text is empty, but usage counts the real tokens
+        # (prompt_tokens_details is the prefix-cache reuse report — no
+        # cache on this server, so 0 cached)
         assert p["choices"][0]["finish_reason"] == "length"
         assert p["usage"] == {
-            "prompt_tokens": 6, "completion_tokens": 8, "total_tokens": 14,
+            "prompt_tokens": 6,
+            "prompt_tokens_details": {"cached_tokens": 0},
+            "completion_tokens": 8, "total_tokens": 14,
         }
 
     run(_with_server(setup, body))

@@ -1,0 +1,501 @@
+"""Automatic prefix caching (serving/prefix_cache.py + the batcher's
+submit-match/promotion hooks).
+
+Two layers of claims:
+
+- **Radix-tree mechanics** (host-only, stub rows): bucket-aligned
+  matching, longest-match, the len-1 cap, adapter keying, min-hit
+  promotion, LRU eviction under the HBM byte budget.
+- **Bit-exactness**: greedy and seeded token AND logprob streams are
+  identical with the cache on vs off, across admit/retire/cancel/
+  eviction interleavings — a cache hit replays the exact K/V rows the
+  full prefill would have computed, so the cache is invisible in the
+  outputs and only visible in the prefill-token accounting.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    _precompute_prefix,
+    precompute_prefix,
+)
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+    PrefixCache,
+    prefix_kv_bytes,
+)
+
+BUCKETS = (8, 16, 32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # the SAME config and batcher shapes as the neighboring serving test
+    # modules, so the forward/decode jit compiles are shared across the
+    # suite (the tier-1 run is wall-clock-tight; only the prefix-path
+    # jits — extract/insert/precompute — are this module's own)
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, max_new=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def _make_cache(cfg, budget_bytes=1 << 26, **kw):
+    return PrefixCache(cfg, buckets=BUCKETS, budget_bytes=budget_bytes, **kw)
+
+
+def _batcher(params, cfg, pc, depth=1, n_slots=2):
+    return ContinuousBatcher(
+        params, cfg, n_slots=n_slots, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, pipeline_depth=depth, prefix_cache=pc,
+    )
+
+
+# --- radix-tree mechanics (stub rows: no model, no device) ------------------
+
+
+def _stub_insert(pc, tokens, adapter=-1):
+    pc.on_prefill_done(tokens, adapter, lambda p: f"rows[:{p}]")
+
+
+def test_radix_longest_match_at_boundaries(setup):
+    cfg, _ = setup
+    pc = _make_cache(cfg)
+    base = _prompt(1, 32, cfg)
+    _stub_insert(pc, base)  # promotes boundaries 8, 16, 32
+    assert pc.stats.entries == 3
+
+    # longest boundary prefix wins, capped at len-1: a 32-token prompt
+    # equal to the cached prefix may only match 16 (one suffix token
+    # must remain to sample from)
+    state, n = pc.match(base, -1)
+    assert n == 16 and state.tokens == tuple(base[:16])
+    state, n = pc.match(base + _prompt(2, 3, cfg), -1)
+    assert n == 32 and state.tokens == tuple(base)
+    # divergence after 16: the 8- and 16-boundaries still match
+    state, n = pc.match(base[:16] + _prompt(3, 10, cfg), -1)
+    assert n == 16
+    state, n = pc.match(base[:8] + _prompt(4, 10, cfg), -1)
+    assert n == 8
+    # divergence inside the first bucket: miss
+    assert pc.match(_prompt(5, 20, cfg), -1) is None
+    assert pc.stats.misses == 1 and pc.stats.hits == 4
+
+
+def test_radix_adapter_keying(setup):
+    """The same token prefix under different adapters is two distinct
+    cache lines — a hit can never cross weights."""
+    cfg, _ = setup
+    pc = _make_cache(cfg)
+    toks = _prompt(6, 16, cfg)
+    _stub_insert(pc, toks, adapter=0)
+    assert pc.match(toks + [1, 2], adapter=0) is not None
+    assert pc.match(toks + [1, 2], adapter=-1) is None
+    assert pc.match(toks + [1, 2], adapter=1) is None
+    state, _ = pc.match(toks + [1, 2], adapter=0)
+    assert state.adapter == 0  # submit's weights guard can never fire
+
+
+def test_match_gated_and_capped_by_chunk_window(setup):
+    """With a chunk size bound (the batcher sets it), matches that skip
+    no chunk dispatch are refused — savings are whole-chunk-granular:
+    the scheduler runs fixed-C chunks from the prefix boundary plus the
+    same finish chunk either way — and reuse accounting reports the
+    dispatch work actually skipped."""
+    cfg, _ = setup
+    pc = _make_cache(cfg)
+    pc.chunk = 8  # what ContinuousBatcher.__init__ binds
+    base = _prompt(11, 16, cfg)
+    _stub_insert(pc, base)
+    assert pc.match(base[:8], -1) is None       # len == chunk: refused
+    # len 9, matched 8: the cold run's [0,8) chunk dispatch is skipped
+    # (the finish window computes [1,9) in both runs)
+    assert pc.match(base[:8] + [1], -1) is not None
+    assert pc.stats.tokens_saved == 8
+    _, n = pc.match(base + [1, 2], -1)          # len 18, matched 16
+    assert n == 16
+    assert pc.stats.tokens_saved == 8 + 16      # two chunks skipped
+    # a match that skips zero dispatches (the chunk grid just shifts:
+    # ceil(16/8) == ceil(12/8) intermediate+finish dispatches) is
+    # refused and counted as a miss, not a phantom-savings hit
+    pc2 = _make_cache(cfg)
+    pc2.chunk = 8
+    pc2.buckets = (4, 8, 16, 32)
+    _stub_insert(pc2, base[:4])
+    assert pc2.effective_reuse(4, 16) == 0
+    assert pc2.match(base[:4] + _prompt(12, 12, cfg), -1) is None
+    assert pc2.stats.misses == 1 and pc2.stats.hits == 0
+
+
+def test_min_hits_defers_promotion(setup):
+    cfg, _ = setup
+    pc = _make_cache(cfg, min_hits=2)
+    toks = _prompt(7, 16, cfg)
+    _stub_insert(pc, toks)
+    assert pc.stats.entries == 0  # seen once: counted, not materialized
+    _stub_insert(pc, toks)
+    assert pc.stats.entries == 2  # second sighting: boundaries 8 and 16
+    assert pc.match(toks + [1], -1) is not None
+
+
+def test_lru_eviction_under_byte_budget(setup):
+    cfg, _ = setup
+    b8 = prefix_kv_bytes(cfg, 8)
+    pc = _make_cache(cfg, budget_bytes=2 * b8)  # room for two 8-entries
+    p1, p2, p3 = (_prompt(k, 8, cfg) for k in (8, 9, 10))
+    _stub_insert(pc, p1)
+    _stub_insert(pc, p2)
+    assert pc.stats.entries == 2 and pc.stats.evictions == 0
+    pc.match(p1 + [1], -1)  # touch p1: p2 becomes LRU
+    _stub_insert(pc, p3)
+    assert pc.stats.entries == 2 and pc.stats.evictions == 1
+    assert pc.stats.resident_bytes <= 2 * b8
+    assert pc.match(p1 + [1], -1) is not None  # survivor (recently used)
+    assert pc.match(p2 + [1], -1) is None      # the LRU victim
+    assert pc.match(p3 + [1], -1) is not None
+    # an entry bigger than the whole budget is skipped, not evicted-for
+    pc_small = _make_cache(cfg, budget_bytes=b8 // 2)
+    _stub_insert(pc_small, p1)
+    assert pc_small.stats.entries == 0
+
+
+def test_prefix_kv_bytes_tracks_cache_dtype(setup):
+    """The budget is denominated in real HBM bytes: int8 halves the bf16
+    row cost (plus scale planes), int4 halves it again."""
+    cfg, _ = setup
+    from dataclasses import replace
+
+    bf16 = prefix_kv_bytes(cfg, 64)
+    i8 = prefix_kv_bytes(replace(cfg, cache_quant="int8"), 64)
+    i4 = prefix_kv_bytes(replace(cfg, cache_quant="int4"), 64)
+    assert bf16 == 2 * 64 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2
+    assert i8 < bf16 and i4 < i8
+
+
+def test_cache_requires_chunked_prefill_and_opt_out(setup):
+    cfg, params = setup
+    pc = _make_cache(cfg)
+    with pytest.raises(ValueError, match="chunked_prefill"):
+        ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                          prompt_buckets=BUCKETS, prefix_cache=pc)
+
+    class _NoPrefix(ContinuousBatcher):
+        supports_prefix_cache = False  # the SpeculativeBatcher stance
+
+    with pytest.raises(ValueError, match="does not support"):
+        _NoPrefix(params, cfg, n_slots=1, max_len=64,
+                  prompt_buckets=BUCKETS, chunked_prefill=8,
+                  prefix_cache=pc)
+
+    # the batcher rebinds a fresh cache's ladder to its own, but a cache
+    # already holding entries promoted on a DIFFERENT ladder is refused
+    # (its tree edges span those boundaries; re-keying would corrupt it)
+    pc2 = _make_cache(cfg)
+    _stub_insert(pc2, _prompt(99, 16, cfg))
+    with pytest.raises(ValueError, match="different bucket ladder"):
+        ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                          prompt_buckets=(16, 64), chunked_prefill=8,
+                          prefix_cache=pc2)
+
+
+# --- bit-exactness: cache on vs off ----------------------------------------
+#
+# One scheduling scenario, run four ways (cache {on, off} x pipeline
+# {0, 1}): staggered waves over shared system prompts — greedy and
+# seeded requests MIXED in the same batch — a cancel landing mid-flight,
+# and a byte budget small enough that promotions evict live entries
+# mid-run. Completed requests must produce identical tokens AND logprobs
+# in all four runs; the cancelled request's partial stream must agree on
+# the common prefix.
+
+
+def _scenario(params, cfg, cache_on, depth):
+    # room for ONE {8, 16} boundary set: promoting the second system
+    # prompt's boundaries must evict the first's mid-run
+    b = prefix_kv_bytes(cfg, 8) + prefix_kv_bytes(cfg, 16)
+    pc = _make_cache(cfg, budget_bytes=b) if cache_on else None
+    cb = _batcher(params, cfg, pc, depth=depth)
+    sys_a = _prompt(20, 17, cfg)
+    sys_b = _prompt(21, 18, cfg)
+    rids = []
+
+    def sub(base, tail_key, tail_n, new, seed=None):
+        p = base + _prompt(tail_key, tail_n, cfg)
+        rids.append(cb.submit(p, max_new=new, seed=seed))
+
+    # wave 1: two requests sharing sys_a (promotions happen here); one
+    # greedy, one seeded — both exactness regimes in one batch
+    sub(sys_a, 30, 5, 5)
+    sub(sys_a, 31, 4, 4, seed=4)
+    for _ in range(7):
+        cb.step()
+    # wave 2: sys_a again (should hit) + sys_b (miss, then promote)
+    sub(sys_a, 32, 6, 5, seed=5)
+    sub(sys_b, 33, 5, 6)
+    for _ in range(4):
+        cb.step()
+    cancelled = rids[2]
+    cb.cancel(cancelled)  # mid-flight: pending, prefilling or decoding
+    # wave 3: both prefixes again — under this budget the sys_b
+    # promotions evicted sys_a entries, so this mixes hits and re-misses
+    sub(sys_b, 34, 4, 4, seed=7)
+    sub(sys_a, 35, 3, 5)
+    cb.run()
+    streams = {
+        rid: (list(req.out), list(req.out_logp))
+        for rid, req in cb.done_requests.items()
+    }
+    return rids, cancelled, streams, pc
+
+
+def test_cache_on_off_bit_identical(setup):
+    cfg, params = setup
+    # (off, 1) is omitted: pipelined==sync with no cache is already
+    # test_pipelined_decode's pinned claim — here the cache is the axis
+    runs = {
+        (on, depth): _scenario(params, cfg, on, depth)
+        for on, depth in [(False, 0), (True, 0), (True, 1)]
+    }
+    ref_rids, ref_cancel, ref_streams, _ = runs[(False, 0)]
+    for key, (rids, cancelled, streams, pc) in runs.items():
+        assert rids == ref_rids and cancelled == ref_cancel
+        for i, rid in enumerate(rids):
+            if rid == cancelled:
+                # cancel lands at a run-dependent generation depth (the
+                # cache changes how many steps prefill takes), so the
+                # partial streams may differ in LENGTH across runs — but
+                # their common prefix must still be bit-identical
+                toks, lps = streams[rid]
+                rt, rl = ref_streams[rid]
+                n = min(len(toks), len(rt))
+                assert toks[:n] == rt[:n], key
+                assert lps[:n] == pytest.approx(rl[:n]), key
+            else:
+                assert streams[rid] == ref_streams[rid], (key, i)
+        if key[0]:  # cache-on runs must actually exercise the machinery
+            assert pc.stats.promotions > 0
+            assert pc.stats.hits > 0
+            assert pc.stats.evictions > 0
+
+
+def test_cached_streams_match_generate_oracle(setup):
+    """Beyond on/off equality: greedy cached streams equal dedicated
+    ``generate`` over the full prompt (the absolute reference)."""
+    cfg, params = setup
+    pc = _make_cache(cfg)
+    cb = _batcher(params, cfg, pc)
+    sys_p = _prompt(40, 20, cfg)
+    prompts = {}
+    # sequential waves so later submissions really hit the cache
+    for i, (k, n, new) in enumerate([(41, 5, 5), (42, 4, 4)]):
+        p = sys_p + _prompt(k, n, cfg)
+        rid = cb.submit(p, max_new=new)
+        prompts[rid] = (p, new)
+        cb.run()
+    assert pc.stats.hits >= 1 and pc.stats.tokens_saved > 0
+    for rid, (p, new) in prompts.items():
+        assert cb.done[rid] == _oracle(params, p, cfg, new), rid
+
+
+def test_auto_match_never_crosses_adapters(setup):
+    """The automatic path inherits the weights guard BY KEY: a prefix
+    promoted under the base model is invisible to adapter requests (and
+    vice versa), so submit's PrefixState.adapter check can never trip on
+    a cache hit."""
+    cfg, params = setup
+    pc = _make_cache(cfg)
+    cb = _batcher(params, cfg, pc)
+    p = _prompt(50, 20, cfg)
+    cb.submit(p + _prompt(51, 4, cfg), max_new=4)
+    cb.run()
+    assert pc.stats.entries > 0
+    # same tokens, different adapter key: pure miss, no exception
+    assert pc.match(p + [1, 2], adapter=0) is None
+
+
+def test_prefill_token_accounting(setup):
+    """prefill_tokens_total{source}: the cached run reports fewer
+    computed tokens and a nonzero reused count; the cold run reuses
+    nothing (satellite: tokens saved directly observable)."""
+    cfg, params = setup
+
+    class Rec:
+        computed = reused = 0
+
+        def on_prefill_tokens(self, n, source):
+            if source == "computed":
+                Rec.computed += n
+            else:
+                Rec.reused += n
+
+        def on_submit(self): ...
+        def on_prefill_chunk(self): ...
+        def on_first_token(self): ...
+        def on_step(self, *a): ...
+        def on_finish(self, reason): ...
+
+    def run(cache_on):
+        Rec.computed = Rec.reused = 0
+        pc = _make_cache(cfg) if cache_on else None
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+            chunked_prefill=8, prefix_cache=pc, metrics=Rec(),
+        )
+        sys_p = _prompt(60, 16, cfg)
+        for k in (61, 62):
+            cb.submit(sys_p + _prompt(k, 5, cfg), max_new=3)
+            cb.run()
+        return Rec.computed, Rec.reused
+
+    cold_computed, cold_reused = run(False)
+    cached_computed, cached_reused = run(True)
+    assert cold_reused == 0
+    assert cached_reused > 0
+    assert cached_computed < cold_computed
+
+
+def test_serving_metrics_prefix_surface():
+    """The prometheus side of the new counters registers, updates and
+    unregisters cleanly (labelled prefill_tokens_total included)."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.on_prefill_tokens(40, "computed")
+    m.on_prefill_tokens(24, "prefix_reused")
+    m.on_prefix_hit(24)
+    m.on_prefix_miss()
+    m.on_prefix_evict(4096)
+    m.set_prefix_resident_bytes(8192, 2)
+    g = reg.get_sample_value
+    pre = "tpu_serving"
+    assert g(f"{pre}_prefill_tokens_total",
+             {"source": "computed"}) == 40
+    assert g(f"{pre}_prefill_tokens_total",
+             {"source": "prefix_reused"}) == 24
+    assert g(f"{pre}_prefix_cache_hits_total") == 1
+    assert g(f"{pre}_prefix_cache_misses_total") == 1
+    assert g(f"{pre}_prefix_cache_evictions_total") == 1
+    assert g(f"{pre}_prefix_cache_tokens_saved_total") == 24
+    assert g(f"{pre}_prefix_cache_resident_bytes") == 8192
+    assert g(f"{pre}_prefix_cache_entries") == 2
+    m.close()
+    m2 = ServingMetrics(registry=reg)  # re-register on the same registry
+    m2.close()
+
+
+# --- satellite: precompute_prefix compiles per bucket, not per length -------
+
+
+def test_precompute_prefix_shares_compiles_within_bucket(setup):
+    """Two prefixes of different lengths inside one bucket must reuse a
+    single _precompute_prefix trace (the padded forward); the padded
+    rows are sliced back so PrefixState still covers exactly the real
+    tokens."""
+    cfg, params = setup
+    base = _precompute_prefix._cache_size()
+    s1 = precompute_prefix(params, _prompt(70, 10, cfg), cfg,
+                           prompt_buckets=BUCKETS)
+    after_first = _precompute_prefix._cache_size()
+    assert after_first == base + 1
+    s2 = precompute_prefix(params, _prompt(71, 13, cfg), cfg,
+                           prompt_buckets=BUCKETS)
+    assert _precompute_prefix._cache_size() == after_first  # shared trace
+    assert s1.rows.k.shape[2] == 10 and s2.rows.k.shape[2] == 13
+    # a third length in ANOTHER bucket traces again
+    precompute_prefix(params, _prompt(72, 20, cfg), cfg,
+                      prompt_buckets=BUCKETS)
+    assert _precompute_prefix._cache_size() == after_first + 1
+
+
+def test_padded_precompute_presence_masks_padding(setup):
+    """The padding tokens (id 0) must not count as 'seen' for the
+    repetition penalty unless they appear in the real prefix."""
+    cfg, params = setup
+    toks = [t if t != 0 else 1 for t in _prompt(73, 10, cfg)]
+    st = precompute_prefix(params, toks, cfg, prompt_buckets=BUCKETS)
+    presence = np.asarray(st.presence)
+    assert not presence[0]  # padding id, absent from the real tokens
+    assert all(presence[t] for t in toks)
+
+
+# (Padded precompute serving exactness end-to-end is pinned by
+# test_batching.py::test_shared_prefix_matches_generate: its 13-token
+# prefix pads to the 32-bucket under the default ladder and must still
+# match dedicated generate.)
+
+
+# --- engine/HTTP wiring -----------------------------------------------------
+
+
+def test_engine_reports_cached_tokens(setup):
+    """The serving engine surfaces per-request reuse: the second request
+    over a shared prefix retires with cached_tokens > 0 (the field the
+    native API and OpenAI usage report)."""
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        drain_queue,
+    )
+
+    cfg, params = setup
+    pc = _make_cache(cfg)
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        prefix_cache=pc,
+    )
+    # the batcher REBINDS the cache's ladder to its own buckets (the
+    # default ladder capped by max_len: (32, 64)), so the shared prefix
+    # must span the 32-boundary to be promotable
+    assert pc.buckets == (32, 64)
+    sys_p = _prompt(80, 40, cfg)
+
+    async def body():
+        eid1, q1 = engine.submit(sys_p + _prompt(81, 4, cfg), 4)
+        await drain_queue(q1)
+        eid2, q2 = engine.submit(sys_p + _prompt(82, 5, cfg), 4)
+        await drain_queue(q2)
+        return engine.pop_request_info(eid1), engine.pop_request_info(eid2)
+
+    try:
+        info1, info2 = asyncio.run(asyncio.wait_for(body(), timeout=300))
+    finally:
+        engine.shutdown()
+    assert info1.get("cached_tokens") == 0
+    # matched 32, all of it below the finish window (45 - 8): full reuse
+    assert info2.get("cached_tokens", 0) == 32
+    assert engine.pop_request_info(9999) == {}  # unknown eid: empty
+    stats_pc = pc.stats.as_dict()
+    assert stats_pc["hits"] == 1 and stats_pc["tokens_saved"] == 32
+
+
+def test_engine_rejects_prefix_cache_with_injected_batcher(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    cb = _batcher(params, cfg, None)
+    with pytest.raises(ValueError, match="injected batcher"):
+        InferenceEngine(params, cfg, batcher=cb,
+                        prefix_cache=_make_cache(cfg))
