@@ -42,7 +42,8 @@ san-test:
 # loops end to end), the prefix-cache smoke (radix trie + cached-vs-cold
 # serve A/B on CPU), and the Python suite (which includes the manager
 # concurrency stress in tests/test_manager_stress.py).
-ci: lint native native-test san-test bench-host-overhead bench-prefix-cache
+ci: lint native native-test san-test bench-host-overhead bench-prefix-cache \
+	bench-paged-kv
 	python -m pytest tests/ -q
 
 bench:
@@ -62,11 +63,18 @@ bench-host-overhead:
 bench-prefix-cache:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.prefix_cache_bench
 
+# CPU-runnable microbench: paged-KV page alloc/free + refcount cost,
+# the decode table-gather overhead vs the dense layout, and a tiny
+# paged-vs-dense serve A/B (one JSON line with page_alloc_free_us,
+# decode_step_ms_{dense,paged}, gather_overhead_pct, kv_hbm_saved_pct).
+bench-paged-kv:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.paged_kv_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint san-test ci test bench \
-	bench-host-overhead bench-prefix-cache clean watch
+	bench-host-overhead bench-prefix-cache bench-paged-kv clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -477,6 +477,13 @@ def _run_serve() -> dict:
         "prefill_tokens_computed_cached": r.prefill_tokens_computed_cached,
         "wall_seconds_prefix_cold": round(r.wall_seconds_prefix_cold, 3),
         "wall_seconds_prefix_cached": round(r.wall_seconds_prefix_cached, 3),
+        # paged-vs-dense KV A/B: decode-step cost of the page-table
+        # gather and the HBM the workload's peak page usage gives back
+        # vs the dense reservation (models/paging.py)
+        "tokens_per_second_paged": round(r.tokens_per_second_paged, 1),
+        "decode_step_ms_paged": round(r.decode_step_ms_paged, 2),
+        "kv_pages_peak": r.kv_pages_peak,
+        "kv_hbm_saved_pct": round(r.kv_hbm_saved_pct, 1),
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),

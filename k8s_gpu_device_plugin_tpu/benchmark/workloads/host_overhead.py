@@ -49,9 +49,10 @@ def host_overhead_bench(
         cfg, n_slots=n_slots, n_requests=n_requests, max_len=max_len,
         prompt_lens=prompt_lens, max_new=max_new,
         prompt_buckets=(32, 64), chunked_prefill=chunked_prefill,
-        # the prefix-cache A/B has its own CPU smoke (make
-        # bench-prefix-cache); this one stays a pure host-overhead probe
-        prefix_ab=False,
+        # the prefix-cache and paged-KV A/Bs have their own CPU smokes
+        # (make bench-prefix-cache / bench-paged-kv); this one stays a
+        # pure host-overhead probe
+        prefix_ab=False, paged_ab=False,
     )
     return {
         "workload": "host_overhead",

@@ -149,9 +149,10 @@ def e2e_smoke() -> dict:
     r = serve_bench(
         cfg, n_slots=2, n_requests=4, max_len=128, prompt_lens=(8, 17),
         max_new=4, prompt_buckets=(16, 32, 64), chunked_prefill=16,
-        # the decode pipelined-vs-sync A/B is bench-host-overhead's job;
-        # this smoke wants only the prefix path
-        decode_ab=False,
+        # the decode pipelined-vs-sync A/B is bench-host-overhead's job,
+        # the paged-KV A/B is bench-paged-kv's; this smoke wants only
+        # the prefix path
+        decode_ab=False, paged_ab=False,
         prefix_ab=True, n_convs=2, n_turns=2, sys_len=40, turn_len=12,
         prefix_max_new=4, prefix_cache_mb=64,
     )

@@ -23,6 +23,13 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   (serving/prefix_cache.py attached), reporting ``prefix_hit_rate``,
   ``prefill_tokens_saved_pct`` and the computed-prefill-token counts of
   both runs — the cache's win measured the same way the pipeline's is.
+- the paged-KV A/B (``paged_ab=True``): the main mixed-length workload
+  re-run with ``kv_layout="paged"`` (dense-equivalent pool), reporting
+  ``tokens_per_second_paged`` / ``decode_step_ms_paged`` (the
+  table-gather overhead, measured not guessed) and
+  ``kv_hbm_saved_pct`` — how much of the dense layout's static KV
+  reservation the workload's PEAK page usage actually needed (the HBM
+  a paged operator could give back by shrinking ``kv_pages``).
 
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
@@ -34,6 +41,7 @@ on a relayed chip.
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass
 
@@ -76,6 +84,13 @@ class ServeBenchResult:
     prefill_tokens_computed_cached: int = 0
     wall_seconds_prefix_cold: float = 0.0
     wall_seconds_prefix_cached: float = 0.0
+    # paged-KV A/B (the same mixed-length workload under
+    # kv_layout="paged"; all zero when paged_ab=False)
+    wall_seconds_paged: float = 0.0
+    tokens_per_second_paged: float = 0.0
+    decode_step_ms_paged: float = 0.0
+    kv_pages_peak: int = 0
+    kv_hbm_saved_pct: float = 0.0
 
 
 class _PrefillRecorder:
@@ -112,6 +127,8 @@ def serve_bench(
     chunked_prefill: int = 256,
     decode_ab: bool = True,
     prefix_ab: bool = True,
+    paged_ab: bool = True,
+    kv_page_size: int = 64,
     n_convs: int = 6,
     n_turns: int = 3,
     # conversations must outgrow the prefill chunk by a wide margin:
@@ -140,11 +157,12 @@ def serve_bench(
 
     prompts = make_prompts()
 
-    def make_batcher(depth: int) -> ContinuousBatcher:
+    def make_batcher(depth: int, kv_layout: str = "dense") -> ContinuousBatcher:
         return ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
-            pipeline_depth=depth,
+            pipeline_depth=depth, kv_layout=kv_layout,
+            kv_page_size=kv_page_size if kv_layout == "paged" else None,
         )
 
     def prime(cb: ContinuousBatcher, budget: int) -> None:
@@ -160,23 +178,25 @@ def serve_bench(
             guard += 1
             assert guard < 10_000, "priming never converged"
 
-    def run_once(depth: int) -> tuple[float, float]:
-        cb = make_batcher(depth)
+    def run_once(depth: int, kv_layout: str = "dense"
+                 ) -> tuple[float, float, int]:
+        cb = make_batcher(depth, kv_layout)
         for p in prompts:
             cb.submit(p, max_new=max_new)
         t0 = time.perf_counter()
         cb.run()
         wall = time.perf_counter() - t0
+        peak = cb.pool.peak_in_use if cb.pool is not None else 0
         # per-step latency with every slot busy, measured separately so
         # admission prefills don't pollute it
-        cb2 = make_batcher(depth)
+        cb2 = make_batcher(depth, kv_layout)
         prime(cb2, max_new)
         t1 = time.perf_counter()
         steps = 16
         for _ in range(steps):
             cb2.step()
         step_ms = (time.perf_counter() - t1) / steps * 1000
-        return wall, step_ms
+        return wall, step_ms, peak
 
     def device_only_ms(steps: int = 16) -> float:
         """Pure device compute per decode step: raw ``decode_step``
@@ -207,11 +227,35 @@ def serve_bench(
     # overhead already covers
     if decode_ab:
         run_once(1)  # compile pass (all buckets + decode)
-        wall, step_ms = run_once(1)
-        wall_sync, step_ms_sync = run_once(0)
+        wall, step_ms, _ = run_once(1)
+        wall_sync, step_ms_sync, _ = run_once(0)
         device_ms = device_only_ms()
     else:
         wall = step_ms = wall_sync = step_ms_sync = device_ms = 0.0
+
+    # --- paged-KV A/B: the same workload through the page pool ---
+    wall_paged = step_ms_paged = saved_hbm_pct = 0.0
+    pages_peak = 0
+    if paged_ab:
+        if max_len % kv_page_size:
+            # zeroed paged fields would be indistinguishable from a
+            # broken paged run — say why they are zero (no silent caps)
+            print(
+                f"serve_bench: paged A/B skipped — max_len={max_len} is "
+                f"not a multiple of kv_page_size={kv_page_size}",
+                file=sys.stderr,
+            )
+        else:
+            from k8s_gpu_device_plugin_tpu.models.paging import (
+                kv_token_bytes,
+            )
+
+            run_once(1, "paged")  # compile pass (the paged jit twins)
+            wall_paged, step_ms_paged, pages_peak = run_once(1, "paged")
+            dense_bytes = n_slots * max_len * kv_token_bytes(cfg)
+            peak_bytes = pages_peak * kv_page_size * kv_token_bytes(cfg)
+            if dense_bytes:
+                saved_hbm_pct = 100.0 * (1.0 - peak_bytes / dense_bytes)
 
     def overhead_pct(step: float) -> float:
         return max(0.0, step - device_ms) / step * 100.0 if step else 0.0
@@ -301,4 +345,11 @@ def serve_bench(
         prefill_tokens_computed_cached=computed_cached,
         wall_seconds_prefix_cold=wall_prefix_cold,
         wall_seconds_prefix_cached=wall_prefix_cached,
+        wall_seconds_paged=wall_paged,
+        tokens_per_second_paged=(
+            total_new / wall_paged if wall_paged else 0.0
+        ),
+        decode_step_ms_paged=step_ms_paged,
+        kv_pages_peak=pages_peak,
+        kv_hbm_saved_pct=saved_hbm_pct,
     )

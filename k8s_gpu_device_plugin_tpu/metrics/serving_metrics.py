@@ -100,6 +100,40 @@ class ServingMetrics:
             "Cached prefixes currently resident",
             registry=registry,
         )
+        # Paged KV cache (models/paging.py; kv_layout="paged"): pool
+        # occupancy, internal fragmentation (allocated page capacity not
+        # covered by live tokens), and admission rejections by reason
+        # (pool_pressure = transient deferral, request_too_large = the
+        # request outsizes the whole pool). kv_reserved_bytes is set by
+        # BOTH layouts (dense: the static slot reservation; paged: the
+        # pool arrays), so flipping --kvLayout shows up as a directly
+        # comparable HBM number on /metrics.
+        self.kv_pages_total = Gauge(
+            f"{prefix}_kv_pages_total",
+            "Allocatable KV pool pages (paged layout; trap page excluded)",
+            registry=registry,
+        )
+        self.kv_pages_in_use = Gauge(
+            f"{prefix}_kv_pages_in_use",
+            "KV pool pages currently referenced by slots or cached prefixes",
+            registry=registry,
+        )
+        self.kv_page_fragmentation_pct = Gauge(
+            f"{prefix}_kv_page_fragmentation_pct",
+            "Allocated KV page capacity not covered by live tokens (%)",
+            registry=registry,
+        )
+        self.kv_admission_rejected = Counter(
+            f"{prefix}_kv_admission_rejected_total",
+            "Admissions refused or deferred by the KV pool, by reason",
+            ["reason"],  # pool_pressure | request_too_large
+            registry=registry,
+        )
+        self.kv_reserved_bytes = Gauge(
+            f"{prefix}_kv_reserved_bytes",
+            "Static HBM held by the KV cache arrays (both layouts)",
+            registry=registry,
+        )
         self.queue_depth = Gauge(
             f"{prefix}_queue_depth",
             "Requests waiting for a slot",
@@ -177,6 +211,11 @@ class ServingMetrics:
             self.prefix_tokens_saved,
             self.prefix_resident_bytes,
             self.prefix_entries,
+            self.kv_pages_total,
+            self.kv_pages_in_use,
+            self.kv_page_fragmentation_pct,
+            self.kv_admission_rejected,
+            self.kv_reserved_bytes,
             self.queue_depth,
             self.slots_active,
             self.slots_prefilling,
@@ -220,6 +259,19 @@ class ServingMetrics:
     def set_prefix_resident_bytes(self, nbytes: int, entries: int) -> None:
         self.prefix_resident_bytes.set(nbytes)
         self.prefix_entries.set(entries)
+
+    # --- paged-KV hooks (models/batching.py kv_stats/_report_kv_gauges) ---
+
+    def set_kv_pages(self, total: int, in_use: int, frag_pct: float) -> None:
+        self.kv_pages_total.set(total)
+        self.kv_pages_in_use.set(in_use)
+        self.kv_page_fragmentation_pct.set(frag_pct)
+
+    def on_kv_admission_rejected(self, reason: str) -> None:
+        self.kv_admission_rejected.labels(reason=reason).inc()
+
+    def set_kv_reserved_bytes(self, nbytes: int) -> None:
+        self.kv_reserved_bytes.set(nbytes)
 
     def on_first_token(self) -> None:
         """The first generated token is sampled at prefill time, outside

@@ -86,6 +86,26 @@ class KVCache:
             k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
         )
 
+    @staticmethod
+    def init_paged(cfg: LlamaConfig, n_pages: int, page_size: int) -> "KVCache":
+        """Paged-layout pool: (L, n_pages, page_size, Hkv, hd). Slots map
+        virtual positions onto pages through ``BatchState.pages`` tables
+        (models/batching.py); page 0 is the reserved trap page
+        (models/paging.py). bf16 only — the quantized caches' scale
+        planes are not paged, and the serving layer refuses the combo
+        with a clear error before ever reaching here."""
+        if cfg.cache_quant != "none":
+            raise NotImplementedError(
+                "paged KV layout supports bf16 caches only "
+                f"(cache_quant={cfg.cache_quant!r}); serve the quantized "
+                "cache with kv_layout='dense'"
+            )
+        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
+                 cfg.head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
+        )
+
 
 jax.tree_util.register_dataclass(KVCache, ("k", "v", "k_scale", "v_scale"), ())
 
@@ -110,14 +130,38 @@ def _quantize_kv(x: jax.Array, qdtype=None) -> tuple[jax.Array, jax.Array]:
     return quantize_int8(x, axis=-1)
 
 
-def _cache_write(cache, scale, x, length):
+def _cache_write(cache, scale, x, length, pages=None, page_size=0):
     """Write T new tokens' K or V at ``length``; quantizing to the
     cache's own dtype when it is int8/int4 (scale is the matching scale
     plane, else None).
 
     ``length`` may be a scalar (uniform batch — the classic decode) or a
     (B,) vector (continuous batching: every slot writes at its own
-    position; a vmapped dynamic_update_slice is one per-row scatter)."""
+    position; a vmapped dynamic_update_slice is one per-row scatter).
+
+    With ``pages`` (B, n_slot_pages) int32 the cache is a PAGED pool
+    (n_pages, page_size, Hkv, hd): position p of row b lands in page
+    ``pages[b, p // page_size]`` at offset ``p % page_size`` — one
+    scatter through the table instead of a dynamic-slice write. The
+    batcher zeroes inactive rows' table entries before the step, so
+    their garbage writes land in the reserved trap page 0, never in a
+    page that may have been reallocated to a live slot."""
+    if pages is not None:
+        b, t = x.shape[:2]
+        if jnp.ndim(length) == 0:
+            pos = jnp.broadcast_to(
+                length + jnp.arange(t, dtype=jnp.int32), (b, t)
+            )
+        else:
+            pos = length[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        # clamp keeps the page lookup in-bounds for inactive slots parked
+        # at the virtual last row (their writes are trapped anyway)
+        pos = jnp.clip(pos, 0, pages.shape[1] * page_size - 1)
+        pidx = jnp.take_along_axis(pages, pos // page_size, axis=1)
+        off = pos % page_size
+        assert scale is None, "paged KV layout is bf16-only"
+        return cache.at[pidx, off].set(x.astype(cache.dtype)), None
+
     def write(c, val, l):
         if jnp.ndim(l) == 0:
             return jax.lax.dynamic_update_slice(c, val, (0, l, 0, 0))
@@ -132,11 +176,41 @@ def _cache_write(cache, scale, x, length):
 
 
 def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
-                      cfg: LlamaConfig):
+                      cfg: LlamaConfig, pages=None):
     """q: (B, T, Hq, hd) attends over cache[:, :max_len] masked to
     positions < length + T (rows are the T new tokens at absolute
-    positions length..length+T-1). All-f32 softmax."""
+    positions length..length+T-1). All-f32 softmax.
+
+    With ``pages`` (B, n_slot_pages) the cache is a paged pool
+    (n_pages, page_size, Hkv, hd). T=1 with ``decode_attn="ragged"``
+    runs the paged Pallas kernel (ops/paged_attention.py: the DMA
+    indices go through the table, so HBM traffic scales with live
+    pages). Otherwise the XLA fallback GATHERS the slot's pages into the
+    same (B, S, Hkv, hd) view the dense layout stores directly and runs
+    the identical einsum — identical values in identical positions, so
+    the two layouts' outputs are bitwise equal (garbage rows differ but
+    sit behind exact-zero softmax weights in both)."""
     b, t, hq, hd = q.shape
+    if pages is not None:
+        if t == 1 and k_scale is None and cfg.decode_attn == "ragged":
+            from k8s_gpu_device_plugin_tpu.ops import paged_attention
+
+            interpret = jax.default_backend() != "tpu"
+            if paged_attention.supports(
+                q, k_cache, pages, require_pltpu=not interpret
+            ):
+                lens = (
+                    jnp.full((b,), length, jnp.int32)
+                    if jnp.ndim(length) == 0
+                    else length.astype(jnp.int32)
+                ) + 1
+                return paged_attention.paged_decode_attention(
+                    q, k_cache, v_cache, pages, lens, scale=hd ** -0.5,
+                    window=cfg.sliding_window, interpret=interpret,
+                )
+        k_cache = k_cache[pages].reshape(b, -1, *k_cache.shape[-2:])
+        v_cache = v_cache[pages].reshape(b, -1, *v_cache.shape[-2:])
+        pages = None  # below here the gathered view IS the dense cache
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
     if t == 1 and k_scale is None and cfg.decode_attn == "ragged":
@@ -288,20 +362,24 @@ def _mlp_out(x, layer, cfg, sel=None):
 
 
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
-                  positions, cfg, sel=None):
+                  positions, cfg, sel=None, pages=None):
     """One transformer block over T new tokens with cache read+write.
 
     Returns (x_out, k_cache, v_cache, k_scale, v_scale) with the new
     tokens' K/V written at ``length + arange(T)``. Same algebra as the
     training ``_block`` (models/llama.py) minus sharding annotations; MoE
-    MLPs run the dense-mix decode path (``_decode_moe_mlp``)."""
+    MLPs run the dense-mix decode path (``_decode_moe_mlp``). ``pages``
+    (B, n_slot_pages) switches the cache leaves to the paged pool layout
+    — writes scatter through the table, reads gather through it."""
     b, t, d = x.shape
 
     q, k, v = _project_qkv(x, layer, positions, cfg, sel)
-    k_cache, k_scale = _cache_write(k_cache, k_scale, k, length)
-    v_cache, v_scale = _cache_write(v_cache, v_scale, v, length)
+    ps = cfg.kv_page_size if pages is not None else 0
+    k_cache, k_scale = _cache_write(k_cache, k_scale, k, length, pages, ps)
+    v_cache, v_scale = _cache_write(v_cache, v_scale, v, length, pages, ps)
 
-    attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length, cfg)
+    attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
+                             cfg, pages=pages)
     x = x + _qm_lora(
         attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer, "wo", sel
     )
@@ -313,6 +391,7 @@ def _forward_cached(
     last_only: bool = False,
     select_pos: jax.Array | None = None,
     lora_sel: jax.Array | None = None,
+    pages: jax.Array | None = None,
 ):
     """Run T tokens (starting at absolute position ``length``) through all
     layers with cache update. Returns (logits (B, T, V) f32, new cache);
@@ -323,7 +402,9 @@ def _forward_cached(
     (continuous batching), keeping the lm_head matmul and its logits at
     1/T the cost. ``lora_sel`` (B, N) selects per-row stacked LoRA
     adapters when ``params["layers"]`` carries them
-    (models/lora_serving.py)."""
+    (models/lora_serving.py). ``pages`` (B, n_slot_pages) marks the
+    cache as a paged pool and routes every layer's cache write/read
+    through the table (models/batching.py owns the tables)."""
     from k8s_gpu_device_plugin_tpu.models.llama import cast_params_for_compute
 
     # master-weight checkpoints (param_dtype=f32) decode in compute dtype —
@@ -346,7 +427,7 @@ def _forward_cached(
         layer, k_c, v_c, k_s, v_s = layer_and_cache
         x, k_c, v_c, k_s, v_s = _decode_block(
             x, layer, k_c, v_c, k_s, v_s, length, positions, cfg,
-            sel=lora_sel,
+            sel=lora_sel, pages=pages,
         )
         return x, (k_c, v_c, k_s, v_s)
 

@@ -106,6 +106,18 @@ class LlamaConfig:
     # cached K/V per (position, head) with f32 scales — half the cache HBM
     # traffic and twice the context capacity of bf16, dequantized on read.
     cache_quant: str = "none"
+    # Serving KV-cache LAYOUT (models/batching.py): "dense" preallocates
+    # (n_slots, max_len) rows per slot; "paged" maps each slot's virtual
+    # positions onto a shared (n_pages, kv_page_size) page pool through a
+    # per-slot page table (models/paging.py) — HBM scales with LIVE
+    # tokens, and prefix-cache reuse becomes page-table aliasing instead
+    # of row copies. bf16 caches only; token/logprob streams are
+    # bit-identical between the two layouts (test-pinned).
+    kv_layout: str = "dense"
+    # token rows per physical page when kv_layout == "paged"; must divide
+    # the batcher's max_len, and multiples of 8 keep the Pallas paged
+    # decode kernel's pages sublane-aligned
+    kv_page_size: int = 64
     # Fused lm_head+cross-entropy (ops/fused_ce.py): never materializes the
     # (B,S,V) logits. Training-loss only (no logits output, no accuracy);
     # requires the vocab axis unsharded (tp == 1) — loss_fn falls back
@@ -148,6 +160,16 @@ class LlamaConfig:
                 f"cache_quant must be 'none', 'int8' or 'int4', got "
                 f"{self.cache_quant!r} — an unknown value would silently "
                 "run a bf16 cache"
+            )
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got "
+                f"{self.kv_layout!r} — an unknown value would silently "
+                "serve the dense layout"
+            )
+        if self.kv_page_size < 1:
+            raise ValueError(
+                f"kv_page_size must be >= 1, got {self.kv_page_size}"
             )
         if self.act not in ("silu", "gelu_tanh"):
             raise ValueError(

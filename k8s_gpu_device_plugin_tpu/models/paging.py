@@ -1,0 +1,147 @@
+"""Host-side page pool for the paged KV cache (models/batching.py).
+
+The dense serving cache reserves ``n_slots * max_len`` token rows of HBM
+up front, so a 40-token request in a 2048-token slot strands 98% of its
+reservation. The paged layout (the vLLM idea, TPU-shaped by the "Ragged
+Paged Attention" line of work — PAPERS.md) carves the KV HBM into
+fixed-size *pages* of ``page_size`` token rows and maps each slot's
+virtual positions onto physical pages through a per-slot int32 page
+table. This module is the HOST half of that design: a free-list
+allocator with per-page reference counts. It never touches device
+memory — the device side is the ``(L, n_pages, page_size, Hkv, hd)``
+pool arrays in :class:`~..models.generate.KVCache` and the page-table
+rows in ``BatchState.pages``; the batcher keeps the two in sync (every
+table row it uploads was first reserved here).
+
+Refcounts are what make prefix sharing zero-copy: a promoted prefix
+holds a reference on the pages it spans, every admission that aliases
+it takes another, and a page returns to the free list only when the
+last holder drops it. Page 0 is RESERVED as the trap page: unset table
+entries point at it, and the decode step redirects inactive slots'
+writes to it — so a freed-and-reallocated page can never be scribbled
+on by its previous owner's lagging compute (the paged analogue of the
+dense layout's last-row write redirect).
+
+Single-threaded by design, like the batcher that owns it: every call
+happens on the engine thread.
+"""
+
+from __future__ import annotations
+
+
+class PagePool:
+    """Free-list page allocator with reference counts.
+
+    ``n_pages`` counts physical pages INCLUDING the reserved trap page 0,
+    so ``capacity`` (allocatable pages) is ``n_pages - 1``. ``alloc``
+    raises on exhaustion — callers must check :attr:`free_pages` first
+    (the batcher defers admission instead of failing mid-flight).
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"page pool needs >= 2 pages (1 allocatable + the "
+                f"reserved trap page 0), got {n_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently freed pages are re-used first (their
+        # pool rows are likelier to still be warm in any cache hierarchy)
+        self._free: list[int] = list(range(n_pages - 1, 0, -1))
+        self._refs: dict[int, int] = {}
+        #: high-water mark of pages simultaneously in use (the serve
+        #: bench's kv_hbm_saved_pct denominator needs the peak, not the
+        #: instantaneous value)
+        self.peak_in_use = 0
+
+    # --- capacity views ---
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the trap page excluded)."""
+        return self.n_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        """Pages covering ``n_tokens`` contiguous rows (ceil division)."""
+        return -(-int(n_tokens) // self.page_size)
+
+    # --- allocation ---
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` pages off the free list (each at refcount 1)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, free {len(self._free)} "
+                f"(capacity {self.capacity})"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return out
+
+    def incref(self, pages) -> None:
+        """Add one reference to each of ``pages`` (prefix aliasing: the
+        new holder shares the physical rows instead of copying them)."""
+        for p in pages:
+            if p not in self._refs:
+                raise ValueError(f"incref of unallocated page {p}")
+            self._refs[p] += 1
+
+    def decref(self, pages) -> list[int]:
+        """Drop one reference from each of ``pages``; pages reaching
+        zero return to the free list. Returns the freed page ids."""
+        freed = []
+        for p in pages:
+            r = self._refs.get(p)
+            if r is None:
+                raise ValueError(f"decref of unallocated page {p}")
+            if r == 1:
+                del self._refs[p]
+                self._free.append(p)
+                freed.append(p)
+            else:
+                self._refs[p] = r - 1
+        return freed
+
+    # --- integrity ---
+
+    def check(self) -> None:
+        """Invariant sweep (tests call this after racy interleavings):
+        refcounts positive, free list disjoint from the allocated set and
+        trap-free, and the two partitions cover the capacity exactly."""
+        assert all(r > 0 for r in self._refs.values()), "non-positive ref"
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate page in free list"
+        assert 0 not in free and 0 not in self._refs, "trap page leaked"
+        assert not (free & set(self._refs)), "page both free and allocated"
+        assert len(free) + len(self._refs) == self.capacity, "pages lost"
+
+
+def kv_token_bytes(cfg) -> int:
+    """HBM bytes one cached token row costs (K + V across all layers,
+    scale planes included on the quantized-cache paths) — the
+    denominator both layouts' resident-bytes gauges share, so the dense
+    reservation and the paged pool are comparable on /metrics. The paged
+    layout itself refuses quantized caches (their scale planes are not
+    paged); the quant arms here keep the DENSE gauge honest."""
+    import jax.numpy as jnp
+
+    per_elt = {"int8": 1.0, "int4": 0.5}.get(cfg.cache_quant)
+    if per_elt is None:
+        per_elt = jnp.dtype(cfg.dtype).itemsize
+    nbytes = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * per_elt
+    if cfg.cache_quant in ("int8", "int4"):
+        nbytes += 2 * cfg.n_layers * cfg.n_kv_heads * 4  # f32 scales
+    return int(nbytes)

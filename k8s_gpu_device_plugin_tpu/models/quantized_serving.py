@@ -28,6 +28,24 @@ import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
 
+
+def check_cache_quant_kv_layout(cfg) -> None:
+    """The ONE definition of the quantized-cache / paged-KV exclusion
+    (the admission-rule pattern: the batcher raises through this, tests
+    pin it here). The int8/int4 serving caches store per-(position,
+    head) f32 scale planes alongside the code arrays; the paged layout
+    pages only the K/V codes — paging the scales too would double every
+    table lookup and the dequant-fusion contract in _cached_attention
+    has never been measured through a gather. Refuse loudly rather than
+    silently serving a dense cache."""
+    if cfg.cache_quant != "none" and cfg.kv_layout == "paged":
+        raise ValueError(
+            "kv_layout='paged' supports bf16 caches only: the "
+            f"quantized-serving KV cache (cache_quant={cfg.cache_quant!r}) "
+            "stores scale planes that are not paged — serve it with "
+            "kv_layout='dense'"
+        )
+
 # weight leaves quantized per layer (contraction axis is axis -2 for all)
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 

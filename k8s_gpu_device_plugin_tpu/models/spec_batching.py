@@ -257,6 +257,12 @@ class SpeculativeBatcher(ContinuousBatcher):
     #: submit() rejects prefixes (below): the draft cache has no prefix
     #: rows, so an automatic prefix cache must be refused at construction
     supports_prefix_cache = False
+    #: the paged KV layout is refused at construction (ContinuousBatcher
+    #: checks this flag): the draft cache mirrors the target's slot
+    #: geometry row-for-row, and there are no draft page tables to
+    #: mirror admissions/aliasing onto — silently running the draft
+    #: dense while the target pages would desynchronize the two caches
+    supports_paged_kv = False
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
                adapter=-1, logit_bias=None, seed=None):

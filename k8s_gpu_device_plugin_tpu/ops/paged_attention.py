@@ -1,0 +1,134 @@
+"""Paged decode attention: the ragged Pallas kernel reading through a
+page table.
+
+``ops/ragged_decode.py`` makes the dense serving cache's decode read
+ragged — HBM traffic scales with each slot's live prefix instead of
+``B * max_len``. The paged KV layout (models/batching.py) goes further:
+physical rows live in a shared ``(n_pages, page_size, Hkv, hd)`` pool
+and each slot's virtual positions map onto pages through a per-slot
+int32 table, so HBM RESIDENCY also scales with live tokens and prefix
+reuse is page aliasing. This kernel is the read side of that layout
+(the direction of "Ragged Paged Attention", PAPERS.md): the grid is
+(B, n_slot_pages) with one kv block per PAGE, the page table and the
+per-slot lengths ride as scalar prefetch, and the kv BlockSpec's index
+map resolves grid cell (b, j) to physical page ``table[b, j]`` —
+clamped into the row's live span so out-of-range cells re-map to a page
+that is loaded anyway and Pallas elides the duplicate DMA.
+
+The kernel BODY is ``ragged_decode._kernel`` unchanged (online-softmax
+flash accumulation at T=1, block size = page_size): masking only needs
+each block's virtual position, which is ``j * page_size`` in both
+layouts. Only the DMA routing differs — exactly the page-table
+indirection the layout adds.
+
+bf16 caches, T=1, GQA; same ``supports()``/interpret-mode pattern as the
+ragged kernel, so the CPU test suite runs it in interpret mode and the
+serving integration stays behind ``LlamaConfig(decode_attn="ragged")``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from k8s_gpu_device_plugin_tpu.ops.ragged_decode import (
+    _HAS_PLTPU,
+    _first_block,
+    _kernel,
+    _last_block,
+)
+
+if _HAS_PLTPU:  # pragma: no branch
+    from jax.experimental.pallas import tpu as pltpu
+
+
+def supports(
+    q: jax.Array, k_pool: jax.Array, pages: jax.Array, hd_ok=(64, 128),
+    require_pltpu: bool = True,
+) -> bool:
+    """Shapes the kernel tiles cleanly: T==1 GQA, a lane-aligned head
+    dim, and a sublane-aligned page size (the page IS the kv block, so
+    it must be a clean VMEM tile). ``require_pltpu=False`` relaxes only
+    the TPU-build check (interpret mode still needs every SHAPE
+    constraint to hold)."""
+    if require_pltpu and not _HAS_PLTPU:
+        return False
+    if q.ndim != 4 or q.shape[1] != 1:
+        return False
+    b, _, hq, hd = q.shape
+    ps = k_pool.shape[1]
+    return (
+        hd in hd_ok
+        and hq % k_pool.shape[2] == 0
+        and ps % 8 == 0
+        and pages.shape[0] == b
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "window", "interpret")
+)
+def paged_decode_attention(
+    q: jax.Array,          # (B, 1, Hq, hd)
+    k_pool: jax.Array,     # (n_pages, page_size, Hkv, hd) bf16
+    v_pool: jax.Array,     # (n_pages, page_size, Hkv, hd)
+    pages: jax.Array,      # (B, n_slot_pages) int32 page table
+    lengths: jax.Array,    # (B,) int32 live rows per slot (query at len-1)
+    scale: float,
+    window: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """(B, 1, Hq, hd) decode attention gathering pages through the table."""
+    b, t, hq, hd = q.shape
+    assert t == 1, "paged decode attention is a T=1 kernel"
+    ps = k_pool.shape[1]
+    hkv = k_pool.shape[2]
+    n_slot_pages = pages.shape[1]
+    lengths = lengths.astype(jnp.int32)
+    pages = pages.astype(jnp.int32)
+    group = hq // hkv
+
+    def kv_map(bi, j, lens, table):
+        # clamp into the live span FIRST (dead grid cells re-map to a
+        # live page -> consecutive identical indices elide the DMA),
+        # then resolve virtual page j to its physical pool page
+        lo = _first_block(lens[bi], window, ps)
+        hi = _last_block(lens[bi], ps)
+        return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_slot_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, hq, hd), lambda bi, j, lens, table: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, ps, hkv, hd), kv_map),
+            pl.BlockSpec((1, ps, hkv, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hq, hd), lambda bi, j, lens, table: (bi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, group, 1), jnp.float32),   # m
+            pltpu.VMEM((hkv, group, 1), jnp.float32),   # l
+            pltpu.VMEM((hkv, group, hd), jnp.float32),  # acc
+        ],
+    )
+
+    def kernel(lens_ref, table_ref, *refs):
+        # the table participates in DMA routing only; the masking body is
+        # the ragged kernel's, with page_size as the block size
+        _kernel(lens_ref, *refs, bk=ps, hq=hq, hkv=hkv, hd=hd,
+                scale=scale, window=window)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hq, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lengths, pages, q, k_pool, v_pool)
+    return out[:, None]

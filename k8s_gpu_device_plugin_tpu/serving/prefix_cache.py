@@ -65,6 +65,7 @@ from k8s_gpu_device_plugin_tpu.models.batching import (
     effective_prefix_reuse,
 )
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.paging import kv_token_bytes
 from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
 
 
@@ -73,15 +74,19 @@ def prefix_kv_bytes(cfg: LlamaConfig, p: int) -> int:
     (L, 1, p, Hkv, hd) in the cache dtype, plus the f32 scale planes on
     the quantized paths. The byte budget is denominated in THIS, so an
     operator's ``--prefixCacheMB`` means the same thing under bf16, int8
-    and int4 caches (int4 packs two codes per byte in HBM)."""
-    per_elt = {"int8": 1.0, "int4": 0.5}.get(cfg.cache_quant)
-    if per_elt is None:
-        per_elt = jnp.dtype(cfg.dtype).itemsize  # bf16/f32 path
-    elts = cfg.n_layers * p * cfg.n_kv_heads * cfg.head_dim
-    nbytes = 2 * elts * per_elt  # K + V
-    if cfg.cache_quant in ("int8", "int4"):
-        nbytes += 2 * cfg.n_layers * p * cfg.n_kv_heads * 4  # f32 scales
-    return int(nbytes)
+    and int4 caches (int4 packs two codes per byte in HBM). Under the
+    paged KV layout an entry PINS whole pool pages (models/paging.py),
+    so residency rounds ``p`` up to the page boundary. This is a
+    PER-ENTRY charge: nested entries promoted from one prompt share
+    physical pages (each holds its own pool reference), and each is
+    charged for every page it pins — so the cache-wide sum is an upper
+    bound on distinct pages denied to the pool, and the byte budget
+    evicts conservatively (never lets the cache outgrow ``budget_bytes``
+    of pins, may evict while distinct residency is lower)."""
+    if getattr(cfg, "kv_layout", "dense") == "paged":
+        ps = cfg.kv_page_size
+        p = -(-p // ps) * ps
+    return p * kv_token_bytes(cfg)
 
 
 class _Node:
@@ -148,6 +153,15 @@ class PrefixCache:
     #: 0 = uncapped (pure-trie tests/benches).
     chunk: int = 0
     metrics: object = None
+    #: entry constructor, rebound by a PAGED batcher: under
+    #: kv_layout="paged" the extractor returns page ids (zero-copy
+    #: aliasing) and entries are PagedPrefixState; dense stays the
+    #: row-copying PrefixState. Same kwargs either way.
+    entry_factory: object = PrefixState
+    #: eviction hook, bound by a paged batcher: an evicted entry's page
+    #: references must return to the pool (dense entries are plain
+    #: immutable arrays — dropping the reference IS the release)
+    release_entry: object = None
     #: host-memory backstop for the hit-counting (unmaterialized) nodes:
     #: beyond this, new prompts stop growing the tree (existing entries
     #: keep matching; the LRU keeps recycling)
@@ -166,7 +180,7 @@ class PrefixCache:
 
     # --- submit side ---
 
-    def match(self, tokens, adapter: int = -1):
+    def match(self, tokens, adapter: int = -1, count: bool = True):
         """Longest cached prefix of ``tokens`` under ``adapter``, as
         ``(PrefixState, matched_len)`` — or None. The match is capped at
         ``len(tokens) - 1``: at least one suffix token must remain for
@@ -178,7 +192,11 @@ class PrefixCache:
         The batcher calls this once per request, at ADMISSION — past
         validation, past cancel-while-pending, and after any prefix a
         queue-mate's prefill promoted — so hits/misses record exactly
-        one final disposition per admitted request."""
+        one final disposition per admitted request. ``count=False``
+        splits lookup from disposition: a paged-pool deferral can still
+        end in a cancel, so the batcher looks up at the queue head and
+        calls :meth:`record_match` only when the request takes a slot
+        (prometheus counters cannot un-count a phantom hit)."""
         node = self._roots.get(adapter)
         best: _Node | None = None
         depth = 0
@@ -201,15 +219,32 @@ class PrefixCache:
             # pure copy overhead, so it counts — and serves — as a miss
             best = None
         if best is None:
+            if count:
+                self.record_match(None, len(tokens), adapter)
+            return None
+        # keep the entry warm even on an uncounted lookup: a deferred
+        # request is about to alias it, so the LRU (and the paged pool's
+        # pressure-relief eviction) should treat it as just-used
+        self._lru.move_to_end(best)
+        if count:
+            self.record_match(best.depth, len(tokens), adapter)
+        return best.entry, best.depth
+
+    def record_match(self, depth: "int | None", prompt_len: int,
+                     adapter: int = -1) -> None:
+        """Record one request's final hit/miss disposition (``depth`` is
+        the matched prefix length, None for a miss). Split from
+        :meth:`match` so the paged batcher can commit it at slot
+        assignment rather than at the (cancellable) queue-head lookup."""
+        if depth is None:
             self.stats.misses += 1
             if self.metrics is not None:
                 on_miss = getattr(self.metrics, "on_prefix_miss", None)
                 if on_miss is not None:
                     on_miss()
-            return None
-        self._lru.move_to_end(best)
+            return
         self.stats.hits += 1
-        saved = self.effective_reuse(best.depth, len(tokens))
+        saved = self.effective_reuse(depth, prompt_len)
         self.stats.tokens_saved += saved
         if self.metrics is not None:
             on_hit = getattr(self.metrics, "on_prefix_hit", None)
@@ -218,10 +253,9 @@ class PrefixCache:
         if self._tracer.enabled:
             self._tracer.span(
                 "prefix_match", component="prefix_cache",
-                matched=best.depth, saved=saved, prompt_len=len(tokens),
+                matched=depth, saved=saved, prompt_len=prompt_len,
                 adapter=adapter,
             ).end()
-        return best.entry, best.depth
 
     def effective_reuse(self, matched: int, prompt_len: int) -> int:
         """This cache's view of :func:`effective_prefix_reuse` (the one
@@ -276,8 +310,14 @@ class PrefixCache:
         if nbytes > self.budget_bytes:
             return  # an uncacheable giant must not wipe the whole LRU
         while self.stats.resident_bytes + nbytes > self.budget_bytes:
-            self._evict_lru()
-        node.entry = PrefixState(
+            # keep=node: the eviction's prune cascade must not detach
+            # the (entry-less, possibly still childless) node this very
+            # call is materializing — pruning it mid-walk would leave
+            # the promotion writing into a subtree the matcher can no
+            # longer reach, and a later eviction of that orphan would
+            # try to delete a span its parent no longer holds
+            self._evict_lru(keep=node)
+        node.entry = self.entry_factory(
             rows=extract(node.depth), tokens=tuple(tokens),
             # jnp.asarray copies NOW, so the walk extending presence_np
             # for the next boundary cannot alias this entry's mask
@@ -298,9 +338,27 @@ class PrefixCache:
 
     # --- eviction ---
 
-    def _evict_lru(self) -> None:
+    def evict_one(self) -> bool:
+        """Evict the least-recently-used entry; False when the cache is
+        already empty. The paged batcher's pool-pressure relief valve:
+        cached prefixes are reclaimable pool capacity, and without a way
+        to reclaim them an idle server whose free pages are all pinned
+        by promoted prefixes would defer admissions forever (match-time
+        pins keep any prefix a queued request already aliases)."""
+        if not self._lru:
+            return False
+        self._evict_lru()
+        return True
+
+    def _evict_lru(self, keep: "_Node | None" = None) -> None:
         node, _ = self._lru.popitem(last=False)
         freed, depth = node.entry_bytes, node.depth
+        if self.release_entry is not None:
+            # paged layout: give the entry's page references back to the
+            # pool BEFORE the tree forgets it (requests that already
+            # matched hold their own pins, so this never frees rows a
+            # mid-flight admission is about to alias)
+            self.release_entry(node.entry)
         node.entry = None
         node.entry_bytes = 0
         self.stats.evictions += 1
@@ -308,10 +366,15 @@ class PrefixCache:
         self.stats.resident_bytes -= freed
         # prune entry-less leaves so the tree doesn't accumulate dead
         # paths (their hit counts go with them — a pruned prefix starts
-        # cold again, which is what LRU eviction means)
+        # cold again, which is what LRU eviction means). ``keep`` guards
+        # the node a _materialize in progress is about to fill; the
+        # identity check makes pruning safe even if a stale orphan ever
+        # reaches the LRU — deleting a SPAN rather than THIS node would
+        # sever a live branch.
         while (
-            node is not None and node.entry is None and not node.children
-            and node.parent is not None
+            node is not None and node is not keep and node.entry is None
+            and not node.children and node.parent is not None
+            and node.parent.children.get(node.span) is node
         ):
             del node.parent.children[node.span]
             self.stats.nodes -= 1

@@ -109,6 +109,9 @@ class InferenceEngine:
         pipeline_depth: int = 1,
         trace_steps: bool = False,
         prefix_cache=None,  # serving.prefix_cache.PrefixCache (or None)
+        kv_layout: str | None = None,   # None = cfg.kv_layout
+        kv_page_size: int | None = None,
+        kv_pages: int = 0,
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -123,6 +126,13 @@ class InferenceEngine:
                 "constructor; silently ignoring it here would serve every "
                 "request cold"
             )
+        if batcher is not None and (kv_layout is not None
+                                    or kv_page_size is not None or kv_pages):
+            raise ValueError(
+                "pass the KV layout to the injected batcher's own "
+                "constructor; silently ignoring it here would serve the "
+                "dense layout while reporting paged flags"
+            )
         self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
@@ -130,6 +140,8 @@ class InferenceEngine:
             metrics=metrics, adapters=adapters,
             pipeline_depth=pipeline_depth, trace_steps=trace_steps,
             prefix_cache=prefix_cache,
+            kv_layout=kv_layout, kv_page_size=kv_page_size,
+            kv_pages=kv_pages,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -254,6 +266,11 @@ class InferenceEngine:
         pc = getattr(self.cb, "prefix_cache", None)
         if pc is not None:
             out["prefix_cache"] = pc.stats.as_dict()
+        kv_stats = getattr(self.cb, "kv_stats", None)
+        if kv_stats is not None:
+            # KV residency (both layouts; paged adds pool occupancy +
+            # fragmentation) — mirrored by the OpenAI façade's health
+            out["kv"] = kv_stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -926,6 +943,25 @@ def _main(argv: list[str] | None = None) -> int:
                         help="disable the automatic prefix cache "
                         "(equivalent to --prefixCacheMB 0; token and "
                         "logprob streams are bit-identical either way)")
+    parser.add_argument("--kvLayout", default="dense",
+                        choices=["dense", "paged"],
+                        help="serving KV-cache layout: 'dense' reserves "
+                        "maxLen rows per slot; 'paged' maps slots onto a "
+                        "shared page pool (HBM scales with live tokens, "
+                        "prefix-cache hits alias pages with zero copies; "
+                        "bf16 caches only — token/logprob streams are "
+                        "bit-identical either way)")
+    parser.add_argument("--kvPageSize", type=int, default=64,
+                        help="token rows per KV page with --kvLayout "
+                        "paged; must divide --maxLen (multiples of 8 "
+                        "keep the Pallas paged kernel aligned)")
+    parser.add_argument("--kvPages", type=int, default=0,
+                        help="physical pages in the paged KV pool "
+                        "(includes the reserved trap page); 0 sizes it "
+                        "to dense-equivalent capacity — shrink to "
+                        "overcommit HBM against live tokens (admission "
+                        "then gates on pool pressure instead of slots "
+                        "alone)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -1043,6 +1079,29 @@ def _main(argv: list[str] | None = None) -> int:
                 min_hits=args.prefixCacheMinHits,
                 metrics=metrics,
             )
+    if args.kvLayout == "paged" and args.draftPreset:
+        raise SystemExit(
+            "--kvLayout paged is unsupported with --draftPreset: the "
+            "speculative batcher's draft cache has no page tables to "
+            "mirror the target's aliasing onto"
+        )
+    if args.kvLayout == "paged" and args.cacheQuant != "none":
+        raise SystemExit(
+            "--kvLayout paged is unsupported with --cacheQuant: the "
+            "quantized cache's scale planes are not paged; drop one flag"
+        )
+    if args.kvLayout == "dense" and (
+        args.kvPages or args.kvPageSize != 64
+    ):
+        # silently serving the full static reservation when the operator
+        # asked for a sized pool would mislead exactly like the combos
+        # refused above (64 is the --kvPageSize default, the one value
+        # that cannot be told apart from "not passed")
+        raise SystemExit(
+            "--kvPages/--kvPageSize have no effect under --kvLayout "
+            "dense (the dense cache reserves slots*maxLen rows); add "
+            "--kvLayout paged"
+        )
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1066,6 +1125,11 @@ def _main(argv: list[str] | None = None) -> int:
         pipeline_depth=args.pipelineDepth,
         trace_steps=args.traceSteps and args.tracing,
         prefix_cache=prefix_cache,
+        kv_layout=None if batcher is not None else args.kvLayout,
+        kv_page_size=None if batcher is not None else (
+            args.kvPageSize if args.kvLayout == "paged" else None
+        ),
+        kv_pages=0 if batcher is not None else args.kvPages,
     )
     from prometheus_client import REGISTRY
 

@@ -41,16 +41,21 @@ def _oracle(params, prompt, cfg, max_new):
     return np.asarray(out)[0].tolist()
 
 
-@pytest.mark.parametrize("depth", [0, 1])
-def test_more_requests_than_slots_matches_generate(setup, depth):
+@pytest.mark.parametrize("depth,kv_layout", [
+    (0, "dense"), (1, "dense"), (1, "paged"),
+])
+def test_more_requests_than_slots_matches_generate(setup, depth, kv_layout):
     """4 requests, 2 slots, mixed prompt lengths and budgets: every
     request's stream must equal its dedicated-generate tokens (slot reuse
-    and batch neighbors must be invisible) — pipelined or not."""
+    and batch neighbors must be invisible) — pipelined or not, dense or
+    paged KV (the paged pool reuses pages as slots retire)."""
     cfg, params = setup
     specs = [(1, 5, 6), (2, 12, 4), (3, 3, 8), (4, 9, 5)]  # (key, plen, new)
     cb = ContinuousBatcher(
         params, cfg, n_slots=2, max_len=64,
         prompt_buckets=(4, 8, 16, 32), pipeline_depth=depth,
+        kv_layout=kv_layout,
+        kv_page_size=16 if kv_layout == "paged" else None,
     )
     prompts = {}
     for key, plen, max_new in specs:
@@ -223,15 +228,22 @@ def test_tp_sharded_batching_matches_unsharded():
     assert run(sharded) == run(params)
 
 
-@pytest.mark.parametrize("depth", [0, 1])
-def test_chunked_prefill_matches_generate(setup, depth):
+@pytest.mark.parametrize("depth,kv_layout", [
+    (0, "dense"), (1, "dense"), (0, "paged"), (1, "paged"),
+])
+def test_chunked_prefill_matches_generate(setup, depth, kv_layout):
     """chunked_prefill=C must change scheduling only: every request's
     stream still equals its dedicated-generate tokens (intermediate
-    chunks attend exactly the slot's own earlier rows)."""
+    chunks attend exactly the slot's own earlier rows — under the paged
+    layout, through the slot's page table). The paged legs use C=8, the
+    one paged chunk size the whole suite compiles (test_paged_kv.py and
+    the prefix-cache slice share it)."""
     cfg, params = setup
     cb = ContinuousBatcher(
-        params, cfg, n_slots=2, max_len=64, chunked_prefill=4,
-        pipeline_depth=depth,
+        params, cfg, n_slots=2, max_len=64,
+        chunked_prefill=4 if kv_layout == "dense" else 8,
+        pipeline_depth=depth, kv_layout=kv_layout,
+        kv_page_size=16 if kv_layout == "paged" else None,
     )
     specs = [(70, 11, 5), (71, 3, 6), (72, 9, 4)]  # (key, plen, new)
     prompts = {}

@@ -1,0 +1,778 @@
+"""Paged KV cache (models/paging.py + the kv_layout="paged" batcher
+path + ops/paged_attention.py).
+
+Three layers of claims:
+
+- **Bit-exactness**: greedy and seeded token AND logprob streams are
+  identical between the dense and paged layouts across admit/retire/
+  cancel/stop/chunked-prefill/prefix-eviction interleavings — the paged
+  gather reproduces the dense view value-for-value, and every garbage
+  row sits behind an exact-zero softmax weight in both layouts.
+- **Zero-copy prefix sharing**: automatic cache hits and promotions
+  move NO KV rows (asserted via the batching.kv_copy_counts hook);
+  the only copy left is the tail-page COW when a promotion boundary is
+  not page-aligned — asserted to be exactly one page.
+- **Pool discipline**: refcount invariants hold under prefix hit +
+  cancel + eviction races, pool exhaustion defers (transient) or
+  refuses (request outsizes the pool), and retirement drains the pool.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models import batching
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.paging import PagePool, kv_token_bytes
+from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+    PrefixCache,
+    prefix_kv_bytes,
+)
+
+BUCKETS = (8, 16, 32)
+PS = 16  # page size: divides max_len=64; boundary 8 is page-UNALIGNED
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # same tiny config as the neighboring serving modules so the shared
+    # (dense) compiles are reused; the paged twins compile once here
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, max_new=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def _batcher(params, cfg, layout, pc=None, depth=1, n_slots=2, chunk=8,
+             **kw):
+    return ContinuousBatcher(
+        params, cfg, n_slots=n_slots, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=chunk, pipeline_depth=depth, prefix_cache=pc,
+        kv_layout=layout, kv_page_size=PS if layout == "paged" else None,
+        **kw,
+    )
+
+
+# --- host allocator ---------------------------------------------------------
+
+
+def test_page_pool_mechanics():
+    pool = PagePool(8, 16)  # 7 allocatable + trap
+    assert pool.capacity == 7 and pool.free_pages == 7
+    a = pool.alloc(3)
+    assert 0 not in a and pool.in_use == 3
+    pool.incref(a[:2])            # share two pages
+    freed = pool.decref(a)        # slot retires: only the unshared frees
+    assert freed == [a[2]]
+    assert pool.in_use == 2
+    assert pool.decref(a[:2]) == a[:2]
+    assert pool.in_use == 0 and pool.peak_in_use == 3
+    pool.check()
+    assert pool.pages_for_tokens(1) == 1
+    assert pool.pages_for_tokens(16) == 1
+    assert pool.pages_for_tokens(17) == 2
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc(8)
+    with pytest.raises(ValueError):
+        pool.decref([3])  # not allocated
+    with pytest.raises(ValueError):
+        PagePool(1, 16)   # no allocatable page besides the trap
+
+
+# --- bit-exactness: dense vs paged -----------------------------------------
+#
+# One scheduling scenario run per layout (both pipelined and sync):
+# staggered waves over shared system prompts with greedy and SEEDED
+# requests mixed in one batch, a stop sequence, a mid-flight cancel, and
+# a prefix-cache byte budget small enough that promotions evict live
+# entries mid-run. Completed requests must produce identical tokens AND
+# logprobs across all runs; the cancelled request's partial stream must
+# agree on the common prefix.
+
+
+def _scenario(params, cfg, layout, depth):
+    b = prefix_kv_bytes(cfg, 8) + prefix_kv_bytes(cfg, 16)
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=b)
+    cb = _batcher(params, cfg, layout, pc=pc, depth=depth)
+    sys_a = _prompt(20, 17, cfg)
+    sys_b = _prompt(21, 18, cfg)
+    rids = []
+
+    def sub(base, tail_key, tail_n, new, seed=None, stop=None):
+        p = base + _prompt(tail_key, tail_n, cfg)
+        rids.append(cb.submit(p, max_new=new, seed=seed, stop=stop))
+
+    # wave 1: two requests sharing sys_a (promotions happen here); one
+    # greedy, one seeded — both exactness regimes in one batch
+    sub(sys_a, 30, 5, 5)
+    sub(sys_a, 31, 4, 4, seed=4)
+    for _ in range(7):
+        cb.step()
+    # wave 2: sys_a again (hit) + sys_b (miss, then promote + evict)
+    sub(sys_a, 32, 6, 5, seed=5)
+    sub(sys_b, 33, 5, 6)
+    for _ in range(4):
+        cb.step()
+    cancelled = rids[2]
+    cb.cancel(cancelled)
+    # wave 3: both prefixes again (hits + re-misses after eviction); one
+    # request carries a stop sequence that can't fire (exercises the
+    # matching) — interleavings identical across layouts by construction
+    sub(sys_b, 34, 4, 4, seed=7)
+    sub(sys_a, 35, 3, 5, stop=[[cfg.vocab_size - 1, cfg.vocab_size - 1]])
+    cb.run()
+    streams = {
+        rid: (list(req.out), list(req.out_logp))
+        for rid, req in cb.done_requests.items()
+    }
+    if cb.pool is not None:
+        cb.pool.check()
+    return rids, cancelled, streams, pc, cb
+
+
+def test_dense_paged_bit_identical_streams(setup):
+    cfg, params = setup
+    # (paged, 0) is omitted: paged==dense at depth 0 is already covered
+    # per-request by the kv_layout-parameterized oracle tests in
+    # test_batching.py — here the pipelined paged engine (the serving
+    # default) is the axis, against the sync dense reference
+    runs = {
+        (layout, depth): _scenario(params, cfg, layout, depth)
+        for layout, depth in [("dense", 0), ("paged", 1)]
+    }
+    ref_rids, ref_cancel, ref_streams, _, _ = runs[("dense", 0)]
+    for key, (rids, cancelled, streams, pc, cb) in runs.items():
+        assert rids == ref_rids and cancelled == ref_cancel
+        for rid in rids:
+            if rid == cancelled:
+                # the cancel lands at a run-dependent depth; the common
+                # prefix must still be bit-identical
+                toks, lps = streams[rid]
+                rt, rl = ref_streams[rid]
+                n = min(len(toks), len(rt))
+                assert toks[:n] == rt[:n], key
+                assert lps[:n] == rl[:n], key
+            else:
+                assert streams[rid][0] == ref_streams[rid][0], key
+                # logprobs bit-identical, not approx: the paged gather
+                # feeds the SAME einsum the dense layout runs
+                assert streams[rid][1] == ref_streams[rid][1], key
+        if key[0] == "paged":  # the machinery must actually be exercised
+            assert pc.stats.promotions > 0 and pc.stats.hits > 0
+            assert pc.stats.evictions > 0
+
+
+def test_paged_streams_match_generate_oracle(setup):
+    """Beyond layout equality: paged greedy streams equal dedicated
+    ``generate`` over the full prompt (the absolute reference), bucketed
+    admission included."""
+    cfg, params = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        kv_layout="paged", kv_page_size=PS,
+    )
+    prompts = {}
+    for key, plen, new in [(1, 5, 6), (2, 12, 4), (3, 3, 8)]:
+        p = _prompt(key, plen, cfg)
+        prompts[cb.submit(p, max_new=new)] = (p, new)
+    results = cb.run()
+    for rid, (p, new) in prompts.items():
+        assert results[rid] == _oracle(params, p, cfg, new), rid
+    cb.pool.check()
+    assert cb.pool.in_use == 0  # every retirement drained its pages
+
+
+# --- zero-copy prefix sharing ----------------------------------------------
+
+
+def test_prefix_hits_copy_zero_kv_rows(setup):
+    """The acceptance claim, asserted through the copy-counter hook: a
+    page-aligned promotion + hit moves no KV rows at all (dense would
+    copy the boundary rows twice: extract at promotion, insert at hit)."""
+    cfg, params = setup
+    batching.reset_kv_copy_counts()
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 26)
+    cb = _batcher(params, cfg, "paged", pc=pc)
+    sys_p = _prompt(40, 20, cfg)
+    prompts = {}
+    for k, n, new in [(41, 5, 5), (42, 4, 4)]:
+        p = sys_p + _prompt(k, n, cfg)
+        rid = cb.submit(p, max_new=new)
+        prompts[rid] = (p, new)
+        cb.run()
+    assert pc.stats.hits >= 1 and pc.stats.promotions >= 1
+    counts = batching.kv_copy_counts()
+    assert counts["rows"] == 0, counts
+    assert counts["cow_pages"] == 0, counts  # 16-boundary: page-aligned
+    for rid, (p, new) in prompts.items():
+        assert cb.done[rid] == _oracle(params, p, cfg, new), rid
+
+    # the dense twin of the same traffic DOES copy rows — the counter
+    # measures the thing paging removes
+    batching.reset_kv_copy_counts()
+    pc_d = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 26)
+    cb_d = _batcher(params, cfg, "dense", pc=pc_d)
+    for k, n, new in [(41, 5, 5), (42, 4, 4)]:
+        cb_d.submit(sys_p + _prompt(k, n, cfg), max_new=new)
+        cb_d.run()
+    assert batching.kv_copy_counts()["rows"] > 0
+
+
+def test_cow_on_unaligned_tail_page(setup):
+    """A promotion boundary inside a page (boundary 8, page size 16)
+    aliases zero full pages and copy-on-writes exactly the tail page;
+    the hitting stream still equals the oracle and the donor's stream
+    is untouched (shared page content never mutated through the COW)."""
+    cfg, params = setup
+    batching.reset_kv_copy_counts()
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 26)
+    cb = _batcher(params, cfg, "paged", pc=pc)
+    base = _prompt(50, 8, cfg)
+    p1 = base + _prompt(51, 5, cfg)
+    r1 = cb.submit(p1, max_new=8)
+    # drive p1 past its prefill (promotion happens at the finish chunk)
+    # but keep it DECODING, so the donor is still writing into the
+    # shared tail page while p2 aliases it
+    while cb.prefilling or cb.pending:
+        cb.step()
+    assert cb.running and pc.stats.promotions >= 1
+    p2 = base + _prompt(52, 6, cfg)
+    r2 = cb.submit(p2, max_new=4)
+    cb.run()
+    assert pc.stats.hits == 1
+    counts = batching.kv_copy_counts()
+    assert counts["cow_pages"] == 1, counts
+    assert counts["rows"] == 0, counts
+    assert cb.done[r1] == _oracle(params, p1, cfg, 8)  # donor unharmed
+    assert cb.done[r2] == _oracle(params, p2, cfg, 4)
+    cb.pool.check()
+
+
+def test_refcount_invariants_under_hit_cancel_evict(setup):
+    """Prefix hit + mid-flight cancel + LRU eviction racing: every page
+    reference balances — after retiring everything and evicting the
+    surviving entries, the pool is exactly drained."""
+    cfg, params = setup
+    b = prefix_kv_bytes(cfg, 8) + prefix_kv_bytes(cfg, 16)
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=b)
+    cb = _batcher(params, cfg, "paged", pc=pc)
+    sys_a, sys_b = _prompt(60, 17, cfg), _prompt(61, 18, cfg)
+    r_cancel = cb.submit(sys_a + _prompt(62, 4, cfg), max_new=6)
+    cb.submit(sys_a + _prompt(63, 5, cfg), max_new=4)
+    for _ in range(5):
+        cb.step()
+    cb.cancel(r_cancel)  # mid-flight: its pages must free, pins balance
+    cb.submit(sys_b + _prompt(64, 5, cfg), max_new=4)  # promotes + evicts
+    cb.submit(sys_a + _prompt(65, 3, cfg), max_new=3)
+    cb.run()
+    cb.pool.check()
+    # whatever is still in use is exactly the surviving entries' pages
+    entry_pages = set()
+    for root in pc._roots.values():
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.entry is not None:
+                entry_pages.update(node.entry.page_ids)
+    assert cb.pool.in_use == len(entry_pages)
+    # cancel-while-PENDING with a matched (pinned) prefix must unpin
+    cb2 = _batcher(params, cfg, "paged", pc=None, n_slots=1)
+    before = cb2.pool.in_use
+    r_a = cb2.submit(_prompt(66, 9, cfg), max_new=40)  # hogs the slot
+    r_b = cb2.submit(_prompt(67, 9, cfg), max_new=4)   # stays pending
+    for _ in range(3):
+        cb2.step()
+    assert cb2.cancel(r_b) is True
+    cb2.cancel(r_a)
+    cb2.run()
+    cb2.pool.check()
+    assert cb2.pool.in_use == before == 0
+
+
+# --- admission: pool pressure ----------------------------------------------
+
+
+class _KvRec:
+    """metrics duck-type recording only the KV hooks."""
+
+    def __init__(self):
+        self.rejected = []
+        self.pages = None
+        self.reserved = None
+
+    def on_kv_admission_rejected(self, reason):
+        self.rejected.append(reason)
+
+    def set_kv_pages(self, total, in_use, frag):
+        self.pages = (total, in_use, frag)
+
+    def set_kv_reserved_bytes(self, nbytes):
+        self.reserved = nbytes
+
+    def on_submit(self): ...
+    def on_prefill_chunk(self): ...
+    def on_first_token(self): ...
+    def on_step(self, *a): ...
+    def on_finish(self, reason): ...
+
+
+def test_pool_exhaustion_defers_then_admits(setup):
+    """A pool with room for ONE request at a time: the second request
+    waits under pool pressure (counted once) and admits after the first
+    retires — streams exact throughout."""
+    cfg, params = setup
+    rec = _KvRec()
+    # 4 pages: one 9-token + 4-new request needs ceil(13/16)=1 page...
+    # use budgets that need 2 pages each so two can't coexist (pool 3)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="paged", kv_page_size=PS,
+        kv_pages=3 + 1, metrics=rec,  # 3 allocatable + trap
+    )
+    p1, p2 = _prompt(70, 9, cfg), _prompt(71, 10, cfg)
+    r1 = cb.submit(p1, max_new=20)  # ceil(29/16) = 2 pages
+    r2 = cb.submit(p2, max_new=20)  # 2 pages: must wait for r1
+    results = cb.run()
+    assert results[r1] == _oracle(params, p1, cfg, 20)
+    assert results[r2] == _oracle(params, p2, cfg, 20)
+    assert rec.rejected.count("pool_pressure") == 1  # one deferred spell
+    assert rec.pages is not None and rec.pages[0] == 3
+    assert rec.reserved == 4 * PS * kv_token_bytes(cfg)
+    cb.pool.check()
+
+
+def test_pool_pressure_evicts_cached_prefixes(setup):
+    """Promoted prefixes pin pool pages; when those pins are what stands
+    between a non-matching request and its reservation, admission must
+    evict LRU cache entries instead of deferring forever (the dense
+    layout would have admitted the same request)."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, prefix_cache=pc, kv_layout="paged",
+        kv_page_size=PS, kv_pages=4 + 1,  # 4 allocatable + trap
+    )
+    # promote prefixes at buckets 8 and 16: both entries pin the slot's
+    # first page, which survives the slot's retirement
+    p_a = _prompt(80, 17, cfg)
+    r_a = cb.submit(p_a, max_new=7)  # ceil(24/16) = 2 pages
+    assert cb.run(max_steps=100)[r_a] == _oracle(params, p_a, cfg, 7)
+    assert pc.stats.entries == 2 and cb.pool.in_use == 1
+    # a non-matching request needing the WHOLE pool: only eviction of
+    # the pinned entries can free its fourth page
+    p_b = _prompt(81, 33, cfg)
+    r_b = cb.submit(p_b, max_new=31)  # ceil(64/16) = 4 pages
+    results = cb.run(max_steps=200)
+    assert results[r_b] == _oracle(params, p_b, cfg, 31)
+    # both pinned entries went to the relief valve (r_b's own prefill
+    # re-promoted its boundaries afterwards — that's the cache working)
+    assert pc.stats.evictions == 2
+    cb.pool.check()
+
+
+def test_futile_eviction_is_skipped(setup):
+    """When the pages a deferred request is short of are held by RUNNING
+    slots, destroying the prefix cache frees nothing — the relief valve
+    must leave the cache alone and just wait for a slot to retire."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, prefix_cache=pc, kv_layout="paged",
+        kv_page_size=PS, kv_pages=6,  # 5 allocatable + trap
+    )
+    p_x = _prompt(90, 17, cfg)
+    r_x = cb.submit(p_x, max_new=7)  # promotes: entries pin 1 page
+    cb.run(max_steps=100)
+    assert pc.stats.entries == 2 and cb.pool.in_use == 1
+    p_l = _prompt(91, 9, cfg)
+    r_l = cb.submit(p_l, max_new=40)  # 4 pages: drains the free list
+    p_m = _prompt(92, 9, cfg)
+    r_m = cb.submit(p_m, max_new=20)  # 2 pages: must wait, NOT evict
+    for _ in range(6):
+        cb.step()
+    # m is deferred behind l's pages; full cache destruction could free
+    # at most 1 page — evicting would be futile and must not happen
+    assert pc.stats.evictions == 0 and pc.stats.entries >= 2
+    results = cb.run(max_steps=400)
+    assert results[r_x] == _oracle(params, p_x, cfg, 7)
+    assert results[r_l] == _oracle(params, p_l, cfg, 40)
+    assert results[r_m] == _oracle(params, p_m, cfg, 20)
+    assert pc.stats.evictions == 0  # m admitted off l's retirement alone
+    cb.pool.check()
+
+
+def test_cancel_while_deferred_counts_no_hit(setup):
+    """A matched request cancelled while deferred under pool pressure
+    never ran: its hit (and tokens-saved) must not be recorded — the
+    disposition commits only when a request takes a slot."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, prefix_cache=pc, kv_layout="paged",
+        kv_page_size=PS, kv_pages=6,
+    )
+    sys_p = _prompt(95, 17, cfg)
+    cb.submit(sys_p, max_new=7)
+    cb.run(max_steps=100)          # promotes sys_p's boundaries
+    cb.submit(_prompt(96, 9, cfg), max_new=40)  # hogs the free list
+    r_h = cb.submit(sys_p + _prompt(97, 4, cfg), max_new=20)  # a hit...
+    for _ in range(4):
+        cb.step()                  # ...matched + pinned, then deferred
+    hits_before = pc.stats.hits
+    assert cb.cancel(r_h) is True  # cancelled while still pending
+    assert pc.stats.hits == hits_before == 0
+    assert pc.stats.tokens_saved == 0
+    cb.run(max_steps=400)
+    cb.pool.check()                # the match-time pins were returned
+
+
+def test_paged_cache_cannot_move_between_batchers(setup):
+    """A cache holding paged entries is bound to the pool that promoted
+    them: re-attaching it to any new batcher must fail loudly (its page
+    ids index the OLD pool), and an emptied cache re-attached to a dense
+    batcher must shed the paged entry hooks."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = _batcher(params, cfg, "paged", pc=pc)
+    p = _prompt(85, 17, cfg)
+    cb.submit(p, max_new=4)
+    cb.run(max_steps=100)
+    assert pc.stats.entries > 0
+    with pytest.raises(ValueError, match="paged entries"):
+        _batcher(params, cfg, "paged", pc=pc)
+    # drain the cache: a fresh DENSE batcher may then take it, and must
+    # restore the dense row-entry hooks the paged batcher rebound
+    while pc.evict_one():
+        pass
+    assert pc.stats.entries == 0
+    cb2 = _batcher(params, cfg, "dense", pc=pc)
+    assert pc.entry_factory is batching.PrefixState
+    assert pc.release_entry is None
+    r = cb2.submit(p, max_new=4)
+    assert cb2.run(max_steps=100)[r] == _oracle(params, p, cfg, 4)
+
+
+def test_request_outsizing_pool_is_refused(setup):
+    cfg, params = setup
+    rec = _KvRec()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="paged", kv_page_size=PS,
+        kv_pages=2 + 1, metrics=rec,  # 2 allocatable pages = 32 tokens
+    )
+    with pytest.raises(ValueError, match="KV pages"):
+        cb.submit(_prompt(72, 20, cfg), max_new=20)  # needs 3 pages
+    assert rec.rejected == ["request_too_large"]
+    # a fitting request still sails through
+    p = _prompt(73, 9, cfg)
+    rid = cb.submit(p, max_new=4)
+    assert cb.run()[rid] == _oracle(params, p, cfg, 4)
+
+
+# --- opt-outs ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cache_quant", ["int8", "int4"])
+def test_quantized_cache_refuses_paged(setup, cache_quant):
+    """The quantized-serving caches store scale planes the paged pool
+    does not carry: the combination must fail loudly at construction
+    (one pinned test per code width)."""
+    cfg, params = setup
+    cfg_q = LlamaConfig.tiny(n_layers=2, cache_quant=cache_quant)
+    with pytest.raises(ValueError, match="bf16 caches only"):
+        ContinuousBatcher(
+            params, cfg_q, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+            kv_layout="paged", kv_page_size=PS,
+        )
+
+
+def test_speculative_batcher_refuses_paged(setup):
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    cfg, params = setup
+    draft_cfg = LlamaConfig.tiny(n_layers=1)
+    draft_params = init_params(jax.random.key(9), draft_cfg)
+    assert SpeculativeBatcher.supports_paged_kv is False
+    with pytest.raises(ValueError, match="does not support kv_layout"):
+        SpeculativeBatcher(
+            params, cfg, draft_params, draft_cfg,
+            n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
+            kv_layout="paged", kv_page_size=PS,
+        )
+
+
+def test_page_size_must_divide_max_len(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatcher(
+            params, cfg, n_slots=1, max_len=60, prompt_buckets=BUCKETS,
+            kv_layout="paged", kv_page_size=PS,
+        )
+
+
+def test_pinned_tail_on_tight_pool_admits_cold(setup):
+    """Futile-deferral escape: on an IDLE server, a prefix hit whose
+    partial tail page is pinned can occupy the very capacity its own
+    reservation needs (pool of 3, entry holds 1, cold need is 3). The
+    batcher must not defer forever — it drops the hit, reclaims the now
+    unpinned entry, and admits COLD (the stream still matches the
+    oracle; only the reuse is lost)."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="paged", kv_page_size=PS,
+        kv_pages=3 + 1, prefix_cache=pc,  # 3 allocatable = 48 tokens
+    )
+    p_a = _prompt(90, 9, cfg)
+    r_a = cb.submit(p_a, max_new=4)
+    assert cb.run(max_steps=100)[r_a] == _oracle(params, p_a, cfg, 4)
+    assert pc.stats.promotions == 1  # boundary 8: one PARTIAL page
+    assert cb.pool.in_use == 1       # pinned by the entry alone (idle)
+    # shares the 8-token boundary; worst case 9 + 26 = 35 tokens = all
+    # 3 pages, while the matched entry pins 1 of them
+    p_b = p_a[:8] + _prompt(91, 1, cfg)
+    r_b = cb.submit(p_b, max_new=26)
+    assert cb.run(max_steps=200)[r_b] == _oracle(params, p_b, cfg, 26)
+    assert pc.stats.evictions == 1   # A's entry was sacrificed
+    assert pc.stats.hits == 0        # ... so B ran cold, counted a miss
+    assert pc.stats.misses == 2
+    cb.pool.check()
+    # B's own completed prefill re-promoted at the same boundary: the
+    # one resident page is the NEW entry's, everything else returned
+    assert pc.stats.entries == 1 and cb.pool.in_use == 1
+
+
+def test_manual_paged_prefix_refused(setup):
+    """PagedPrefixState entries hold pool-internal page references the
+    attached cache owns; submitting one manually would reach admission
+    unpinned, where pressure-relief eviction could free and reallocate
+    its pages — the submit wall must refuse it."""
+    cfg, params = setup
+    cb = _batcher(params, cfg, "paged")
+    entry = batching.PagedPrefixState(
+        page_ids=(1,), tokens=tuple(_prompt(95, 8, cfg)),
+        presence=jnp.zeros((64,), bool), adapter=-1,
+    )
+    with pytest.raises(ValueError, match="manually"):
+        cb.submit(_prompt(96, 9, cfg), max_new=4, prefix=entry)
+
+
+def test_negative_kv_pages_refused(setup):
+    """A negative pool size must fail loudly, not silently fall back to
+    the dense-equivalent default (the refuse-loudly posture every other
+    invalid knob on this path takes)."""
+    cfg, params = setup
+    with pytest.raises(ValueError, match="kv_pages"):
+        ContinuousBatcher(
+            params, cfg, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+            kv_layout="paged", kv_page_size=PS, kv_pages=-512,
+        )
+
+
+# --- the paged Pallas kernel ------------------------------------------------
+
+
+def test_paged_attention_kernel_matches_gather(setup):
+    """ops/paged_attention.py in interpret mode vs the XLA gather
+    reference _cached_attention falls back to — same table, same
+    lengths, windowed and unwindowed."""
+    from k8s_gpu_device_plugin_tpu.ops import paged_attention
+
+    b, ps, n_pages, hkv, hq, hd, npg = 3, 8, 16, 2, 8, 64, 4
+    kp = jax.random.normal(
+        jax.random.key(1), (n_pages, ps, hkv, hd), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.key(2), (n_pages, ps, hkv, hd), jnp.bfloat16
+    )
+    q = jax.random.normal(jax.random.key(3), (b, 1, hq, hd), jnp.bfloat16)
+    table = jnp.asarray(
+        np.random.RandomState(0).choice(
+            np.arange(1, n_pages), (b, npg), replace=False
+        ),
+        jnp.int32,
+    )
+    lengths = jnp.asarray([5, 17, 32], jnp.int32)
+    assert paged_attention.supports(q, kp, table, require_pltpu=False)
+
+    def ref(window):
+        kd = kp[table].reshape(b, npg * ps, hkv, hd).astype(jnp.float32)
+        vd = vp[table].reshape(b, npg * ps, hkv, hd).astype(jnp.float32)
+        qf = q.astype(jnp.float32).reshape(b, hkv, hq // hkv, hd)
+        s = jnp.einsum("bkgd,bskd->bkgs", qf, kd) * hd ** -0.5
+        pos = jnp.arange(npg * ps)[None, None, None, :]
+        keep = pos < lengths[:, None, None, None]
+        if window:
+            keep &= pos >= jnp.maximum(lengths - window, 0)[
+                :, None, None, None
+            ]
+        s = jnp.where(keep, s, -1e30)
+        pr = jax.nn.softmax(s, -1)
+        return jnp.einsum("bkgs,bskd->bkgd", pr, vd).reshape(b, 1, hq, hd)
+
+    for window in (0, 12):
+        out = paged_attention.paged_decode_attention(
+            q, kp, vp, table, lengths, scale=hd ** -0.5, window=window,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref(window)),
+            atol=5e-2, rtol=5e-2,
+        )
+
+    # shape gates: T>1 and ragged page sizes are refused
+    assert not paged_attention.supports(
+        jnp.zeros((b, 2, hq, hd), jnp.bfloat16), kp, table,
+        require_pltpu=False,
+    )
+    assert not paged_attention.supports(
+        q, jnp.zeros((n_pages, 12, hkv, hd), jnp.bfloat16), table,
+        require_pltpu=False,
+    )
+
+
+def test_paged_ragged_fallback_at_attention_level(setup):
+    """decode_attn='ragged' + paged with an UNSUPPORTED head dim (the
+    tiny preset's 16) must fall back to the gather path and agree with
+    decode_attn='auto' bitwise — pinned at the _cached_attention level
+    so the fallback costs no extra whole-model compile in the suite."""
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.generate import _cached_attention
+
+    cfg, _ = setup
+    pcfg = replace(cfg, kv_layout="paged", kv_page_size=PS)
+    b, hkv, hd, n_pages, npg = 2, pcfg.n_kv_heads, pcfg.head_dim, 9, 4
+    q = jax.random.normal(
+        jax.random.key(1), (b, 1, pcfg.n_heads, hd), jnp.bfloat16
+    )
+    kp = jax.random.normal(
+        jax.random.key(2), (n_pages, PS, hkv, hd), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.key(3), (n_pages, PS, hkv, hd), jnp.bfloat16
+    )
+    pages = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+    lens = jnp.asarray([7, 40], jnp.int32)
+    auto = _cached_attention(q, kp, vp, None, None, lens, pcfg, pages=pages)
+    ragged = _cached_attention(
+        q, kp, vp, None, None, lens,
+        replace(pcfg, decode_attn="ragged"), pages=pages,
+    )
+    assert np.array_equal(
+        np.asarray(auto, np.float32), np.asarray(ragged, np.float32)
+    )
+
+
+# --- stats & health surfaces ------------------------------------------------
+
+
+def test_kv_stats_both_layouts(setup):
+    cfg, params = setup
+    dense = _batcher(params, cfg, "dense")
+    s = dense.kv_stats()
+    assert s["layout"] == "dense"
+    assert s["reserved_bytes"] == 2 * 64 * kv_token_bytes(cfg)
+    paged = _batcher(params, cfg, "paged")
+    s = paged.kv_stats()
+    assert s["layout"] == "paged" and s["page_size"] == PS
+    assert s["pages_in_use"] == 0 and s["fragmentation_pct"] == 0.0
+    assert s["reserved_bytes"] == paged.pool.n_pages * PS * kv_token_bytes(cfg)
+    rid = paged.submit(_prompt(90, 9, cfg), max_new=4)
+    paged.step()
+    s = paged.kv_stats()
+    assert s["pages_in_use"] >= 1 and 0.0 <= s["fragmentation_pct"] <= 100.0
+    paged.cancel(rid)
+
+
+def test_serving_metrics_kv_surface():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.set_kv_pages(128, 16, 12.5)
+    m.on_kv_admission_rejected("pool_pressure")
+    m.set_kv_reserved_bytes(1 << 20)
+    g = reg.get_sample_value
+    pre = "tpu_serving"
+    assert g(f"{pre}_kv_pages_total") == 128
+    assert g(f"{pre}_kv_pages_in_use") == 16
+    assert g(f"{pre}_kv_page_fragmentation_pct") == 12.5
+    assert g(f"{pre}_kv_admission_rejected_total",
+             {"reason": "pool_pressure"}) == 1
+    assert g(f"{pre}_kv_reserved_bytes") == 1 << 20
+    m.close()
+    m2 = ServingMetrics(registry=reg)  # names freed by close()
+    m2.close()
+
+
+def test_engine_health_reports_kv(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        kv_layout="paged", kv_page_size=PS,
+    )
+    try:
+        kv = engine.stats()["kv"]
+        assert kv["layout"] == "paged" and kv["pages_total"] > 0
+    finally:
+        engine.shutdown()
+    with pytest.raises(ValueError, match="injected batcher"):
+        InferenceEngine(
+            params, cfg,
+            batcher=ContinuousBatcher(
+                params, cfg, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+            ),
+            kv_layout="paged",
+        )
+
+
+def test_prefix_kv_bytes_rounds_to_pages(setup):
+    cfg, _ = setup
+    from dataclasses import replace
+
+    pcfg = replace(cfg, kv_layout="paged", kv_page_size=PS)
+    assert prefix_kv_bytes(pcfg, 8) == prefix_kv_bytes(pcfg, 16)
+    assert prefix_kv_bytes(pcfg, 8) == prefix_kv_bytes(cfg, 16)
+    assert prefix_kv_bytes(pcfg, 17) == prefix_kv_bytes(cfg, 32)
+
+
+def test_paged_kv_bench_machinery():
+    """The CI microbench's host pieces at tiny scale (the full bench
+    runs as `make bench-paged-kv`; here only the allocator half — the
+    gather A/B would recompile a third config in the suite)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.paged_kv_bench import (
+        allocator_bench,
+    )
+
+    out = allocator_bench(n_ops=50, n_pages=64, page_size=16)
+    assert out["page_alloc_free_us"] > 0
+    assert out["page_incref_decref_us"] > 0

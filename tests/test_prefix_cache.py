@@ -63,10 +63,12 @@ def _make_cache(cfg, budget_bytes=1 << 26, **kw):
     return PrefixCache(cfg, buckets=BUCKETS, budget_bytes=budget_bytes, **kw)
 
 
-def _batcher(params, cfg, pc, depth=1, n_slots=2):
+def _batcher(params, cfg, pc, depth=1, n_slots=2, kv_layout="dense"):
     return ContinuousBatcher(
         params, cfg, n_slots=n_slots, max_len=64, prompt_buckets=BUCKETS,
         chunked_prefill=8, pipeline_depth=depth, prefix_cache=pc,
+        kv_layout=kv_layout,
+        kv_page_size=16 if kv_layout == "paged" else None,
     )
 
 
@@ -297,12 +299,15 @@ def test_cache_on_off_bit_identical(setup):
             assert pc.stats.evictions > 0
 
 
-def test_cached_streams_match_generate_oracle(setup):
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_cached_streams_match_generate_oracle(setup, kv_layout):
     """Beyond on/off equality: greedy cached streams equal dedicated
-    ``generate`` over the full prompt (the absolute reference)."""
+    ``generate`` over the full prompt (the absolute reference) — under
+    the paged layout the hits are zero-copy page aliases, and the
+    streams must not notice."""
     cfg, params = setup
     pc = _make_cache(cfg)
-    cb = _batcher(params, cfg, pc)
+    cb = _batcher(params, cfg, pc, kv_layout=kv_layout)
     sys_p = _prompt(40, 20, cfg)
     prompts = {}
     # sequential waves so later submissions really hit the cache
