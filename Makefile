@@ -43,7 +43,7 @@ san-test:
 # serve A/B on CPU), and the Python suite (which includes the manager
 # concurrency stress in tests/test_manager_stress.py).
 ci: lint native native-test san-test bench-host-overhead bench-prefix-cache \
-	bench-paged-kv
+	bench-paged-kv bench-spec
 	python -m pytest tests/ -q
 
 bench:
@@ -70,11 +70,22 @@ bench-prefix-cache:
 bench-paged-kv:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.paged_kv_bench
 
+# CPU-runnable microbench: speculative decoding on the fast path —
+# draft-loop dispatch overhead per accepted token (spec round vs plain
+# decode step, self-draft full acceptance), the paged verify-window
+# scatter cost, and a tiny spec-vs-plain serve A/B asserting the
+# acceptance accounting (one JSON line with spec_round_ms,
+# spec_ms_per_accepted_token, verify_scatter_overhead_pct,
+# spec_acceptance_rate).
+bench-spec:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.spec_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint san-test ci test bench \
-	bench-host-overhead bench-prefix-cache bench-paged-kv clean watch
+	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
+	clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -452,7 +452,7 @@ def _run_serve() -> dict:
 
     _require_accelerator()
     cfg = _bench_model_cfg()
-    r = serve_bench(cfg)
+    r = serve_bench(cfg, spec_ab=True)
     return {
         "workload": "serve",
         "tokens_per_second": round(r.tokens_per_second, 1),
@@ -484,6 +484,16 @@ def _run_serve() -> dict:
         "decode_step_ms_paged": round(r.decode_step_ms_paged, 2),
         "kv_pages_peak": r.kv_pages_peak,
         "kv_hbm_saved_pct": round(r.kv_hbm_saved_pct, 1),
+        # spec-vs-plain A/B: acceptance quality and the per-accepted-
+        # token cost of the draft+verify round against the plain
+        # pipelined numbers above (random-weight draft: machinery cost)
+        "tokens_per_second_spec": round(r.tokens_per_second_spec, 1),
+        "spec_acceptance_rate": round(r.spec_acceptance_rate, 3),
+        "spec_accepted_per_round": round(r.spec_accepted_per_round, 2),
+        "spec_ms_per_accepted_token": round(
+            r.spec_ms_per_accepted_token, 3
+        ),
+        "spec_gamma": r.spec_gamma,
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),

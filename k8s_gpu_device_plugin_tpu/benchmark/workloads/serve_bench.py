@@ -30,6 +30,13 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   ``kv_hbm_saved_pct`` — how much of the dense layout's static KV
   reservation the workload's PEAK page usage actually needed (the HBM
   a paged operator could give back by shrinking ``kv_pages``).
+- the spec-vs-plain A/B (``spec_ab=True``): the same workload through a
+  ``SpeculativeBatcher`` (draft defaults to a quarter-depth twin of the
+  target; pass ``draft_cfg``/``draft_params`` for a real draft),
+  reporting ``tokens_per_second_spec``, ``spec_acceptance_rate``,
+  ``spec_accepted_per_round`` and ``spec_ms_per_accepted_token`` — the
+  speculative win (or loss, for a weak draft) measured against the
+  plain pipelined run in the same artifact.
 
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
@@ -91,6 +98,14 @@ class ServeBenchResult:
     decode_step_ms_paged: float = 0.0
     kv_pages_peak: int = 0
     kv_hbm_saved_pct: float = 0.0
+    # speculative A/B (the same workload through a SpeculativeBatcher;
+    # all zero when spec_ab=False or chunked prefill is off)
+    wall_seconds_spec: float = 0.0
+    tokens_per_second_spec: float = 0.0
+    spec_acceptance_rate: float = 0.0
+    spec_accepted_per_round: float = 0.0
+    spec_ms_per_accepted_token: float = 0.0
+    spec_gamma: int = 0
 
 
 class _PrefillRecorder:
@@ -128,6 +143,11 @@ def serve_bench(
     decode_ab: bool = True,
     prefix_ab: bool = True,
     paged_ab: bool = True,
+    spec_ab: bool = False,
+    draft_cfg: "LlamaConfig | None" = None,
+    draft_params=None,
+    gamma: int = 4,
+    spec_kv_layout: str = "dense",
     kv_page_size: int = 64,
     n_convs: int = 6,
     n_turns: int = 3,
@@ -257,6 +277,67 @@ def serve_bench(
             if dense_bytes:
                 saved_hbm_pct = 100.0 * (1.0 - peak_bytes / dense_bytes)
 
+    # --- spec-vs-plain A/B: the same workload through a draft+verify ---
+    wall_spec = spec_rate = spec_per_round = spec_ms_acc = 0.0
+    spec_g = 0
+    if spec_ab:
+        if not chunked_prefill:
+            print(
+                "serve_bench: spec A/B skipped — speculative batching "
+                "requires chunked_prefill",
+                file=sys.stderr,
+            )
+        elif max(prompt_lens) + max_new + gamma > max_len:
+            print(
+                "serve_bench: spec A/B skipped — prompt + max_new + "
+                f"gamma {gamma} exceeds max_len={max_len}",
+                file=sys.stderr,
+            )
+        else:
+            from dataclasses import replace as _replace
+
+            from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+                SpeculativeBatcher,
+            )
+
+            d_cfg = draft_cfg
+            d_params = draft_params
+            if d_cfg is None:
+                # a quarter-depth twin: the classic "same family,
+                # smaller" draft shape (random weights — this measures
+                # the MACHINERY's cost; acceptance-rate numbers are
+                # meaningful only with trained params)
+                d_cfg = _replace(cfg, n_layers=max(1, cfg.n_layers // 4))
+            if d_params is None:
+                d_params = jax.jit(
+                    lambda k: init_params(k, d_cfg)
+                )(jax.random.key(1))
+
+            def spec_run() -> tuple[float, dict]:
+                sb = SpeculativeBatcher(
+                    params, cfg, d_params, d_cfg,
+                    n_slots=n_slots, max_len=max_len, gamma=gamma,
+                    prompt_buckets=prompt_buckets,
+                    chunked_prefill=chunked_prefill,
+                    kv_layout=spec_kv_layout,
+                    kv_page_size=(
+                        kv_page_size if spec_kv_layout == "paged" else None
+                    ),
+                )
+                for p in prompts:
+                    sb.submit(p, max_new=max_new)
+                t0 = time.perf_counter()
+                sb.run()
+                return time.perf_counter() - t0, sb.spec_stats()
+
+            spec_run()  # compile pass (draft chunk/finish + the round)
+            wall_spec, st = spec_run()
+            spec_rate = st["acceptance_rate"]
+            spec_per_round = st["accepted_per_round"]
+            spec_g = st["gamma"]
+            emitted = n_requests * max_new
+            spec_ms_acc = wall_spec * 1000.0 / emitted if emitted else 0.0
+
     def overhead_pct(step: float) -> float:
         return max(0.0, step - device_ms) / step * 100.0 if step else 0.0
 
@@ -352,4 +433,12 @@ def serve_bench(
         decode_step_ms_paged=step_ms_paged,
         kv_pages_peak=pages_peak,
         kv_hbm_saved_pct=saved_hbm_pct,
+        wall_seconds_spec=wall_spec,
+        tokens_per_second_spec=(
+            total_new / wall_spec if wall_spec else 0.0
+        ),
+        spec_acceptance_rate=spec_rate,
+        spec_accepted_per_round=spec_per_round,
+        spec_ms_per_accepted_token=spec_ms_acc,
+        spec_gamma=spec_g,
     )

@@ -134,6 +134,35 @@ class ServingMetrics:
             "Static HBM held by the KV cache arrays (both layouts)",
             registry=registry,
         )
+        # Speculative decoding (models/spec_batching.py): rounds run,
+        # tokens the draft proposed vs tokens the verify accepted (bonus
+        # token included), and the per-slot-round acceptance-length
+        # distribution — the signal for picking gamma: a histogram mass
+        # near gamma says raise it, mass at 1 says the draft isn't
+        # earning its keep. The spec path used to export NOTHING;
+        # acceptance rate was invisible in production.
+        self.spec_rounds = Counter(
+            f"{prefix}_spec_rounds_total",
+            "Speculative draft+verify rounds executed",
+            registry=registry,
+        )
+        self.spec_tokens_drafted = Counter(
+            f"{prefix}_spec_tokens_drafted_total",
+            "Draft proposals scored by verify rounds (gamma per active "
+            "slot-round)",
+            registry=registry,
+        )
+        self.spec_tokens_accepted = Counter(
+            f"{prefix}_spec_tokens_accepted_total",
+            "Tokens accepted per verify round (bonus token included)",
+            registry=registry,
+        )
+        self.spec_accepted_per_round = Histogram(
+            f"{prefix}_spec_accepted_per_round",
+            "Accepted tokens per slot per verify round",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, float("inf")),
+            registry=registry,
+        )
         self.queue_depth = Gauge(
             f"{prefix}_queue_depth",
             "Requests waiting for a slot",
@@ -216,6 +245,10 @@ class ServingMetrics:
             self.kv_page_fragmentation_pct,
             self.kv_admission_rejected,
             self.kv_reserved_bytes,
+            self.spec_rounds,
+            self.spec_tokens_drafted,
+            self.spec_tokens_accepted,
+            self.spec_accepted_per_round,
             self.queue_depth,
             self.slots_active,
             self.slots_prefilling,
@@ -272,6 +305,17 @@ class ServingMetrics:
 
     def set_kv_reserved_bytes(self, nbytes: int) -> None:
         self.kv_reserved_bytes.set(nbytes)
+
+    # --- speculative-decoding hook (models/spec_batching.py) ---
+
+    def on_spec_round(self, gamma: int, accepted_counts) -> None:
+        """One verify round: ``accepted_counts`` holds each active
+        slot's device-side acceptance (1..gamma, bonus included)."""
+        self.spec_rounds.inc()
+        self.spec_tokens_drafted.inc(gamma * len(accepted_counts))
+        self.spec_tokens_accepted.inc(sum(accepted_counts))
+        for c in accepted_counts:
+            self.spec_accepted_per_round.observe(c)
 
     def on_first_token(self) -> None:
         """The first generated token is sampled at prefill time, outside

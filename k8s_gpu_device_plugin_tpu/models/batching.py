@@ -406,6 +406,9 @@ class _Request:
     defer_counted: bool = False
     _pinned_pages: "list[int] | None" = None
     _new_pages: "list[int] | None" = None
+    # the speculative batcher's draft-pool twin of ``_new_pages``: a
+    # successful draft reservation carried to the draft-table install
+    _draft_new_pages: "list[int] | None" = None
     # matched prefix depth carried from the (uncounted) queue-head match
     # to the slot-assignment commit, where the hit/miss disposition is
     # recorded — a deferred request can still be cancelled, and a
@@ -440,12 +443,13 @@ class ContinuousBatcher:
     #: per-request sampling seeds (same story)
     per_request_seed = True
     #: automatic prefix caching rides chunked prefill + _insert_prefix;
-    #: the speculative subclass rejects prefixes outright (its draft
-    #: cache has no prefix rows), so it turns this off
+    #: a subclass whose prefill path cannot mirror prefix rows may turn
+    #: this off (the speculative batcher supports it: the target aliases
+    #: cached rows/pages and the draft cheaply re-prefills the prefix)
     supports_prefix_cache = True
-    #: the paged KV layout (kv_layout="paged"): the speculative subclass
-    #: opts out (its draft cache mirrors the target's slot geometry and
-    #: has no page tables to mirror the aliasing onto)
+    #: the paged KV layout (kv_layout="paged"); a subclass without page
+    #: plumbing may turn this off (the speculative batcher supports it
+    #: with a second, draft-sized pool)
     supports_paged_kv = True
 
     def __init__(
@@ -484,8 +488,7 @@ class ContinuousBatcher:
             if not self.supports_paged_kv:
                 raise ValueError(
                     "this batcher does not support kv_layout='paged' "
-                    "(speculative batching mirrors a draft cache with no "
-                    "page tables to alias)"
+                    "(no page tables to route its cache writes through)"
                 )
             from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
                 check_cache_quant_kv_layout,
@@ -546,8 +549,8 @@ class ContinuousBatcher:
             if not self.supports_prefix_cache:
                 raise ValueError(
                     "this batcher does not support an automatic prefix "
-                    "cache (speculative batching has no prefix rows to "
-                    "mirror onto the draft cache)"
+                    "cache (no way to serve a request from cached "
+                    "prefix rows)"
                 )
             if not self.chunk:
                 raise ValueError(
@@ -671,8 +674,9 @@ class ContinuousBatcher:
         # decode step t+1 BEFORE reading step t back, so host per-token
         # work (stop matching, retirement, metrics, streaming) overlaps
         # the device's next step. 0 = today's fully synchronous loop
-        # (debugging / the speculative subclass). Token streams are
-        # bit-identical between the two for greedy and seeded requests.
+        # (debugging). Token streams are bit-identical between the two
+        # for greedy and seeded requests — the speculative subclass
+        # rides the same machinery through the dispatch/apply seams.
         if pipeline_depth not in (0, 1):
             raise ValueError(
                 f"pipeline_depth must be 0 or 1, got {pipeline_depth}"
@@ -707,7 +711,9 @@ class ContinuousBatcher:
             # a request whose worst case outsizes the whole pool can
             # never be admitted and must be refused here (transient
             # pressure defers in _admit instead)
-            need = self.pool.pages_for_tokens(prompt_len + max_new)
+            need = self.pool.pages_for_tokens(
+                self._kv_need_tokens(prompt_len, max_new)
+            )
             if need > self.pool.capacity:
                 self._count_kv_rejection("request_too_large")
                 raise ValueError(
@@ -1077,6 +1083,7 @@ class ContinuousBatcher:
                     )
                 self.prefilling[slot] = req
                 self._prefill_pos[slot] = start
+                self._on_prefill_scheduled(req, slot, start)
                 continue
             bucket = _bucket(len(req.prompt), self.buckets)
             padded = jnp.asarray(
@@ -1109,13 +1116,24 @@ class ContinuousBatcher:
 
     # --- paged-KV admission plumbing (no-ops on the dense layout) ---
 
+    def _kv_need_tokens(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case cache rows one admission must cover — the paged
+        reservation's denominator, shared by ``validate`` and
+        ``_reserve_pages`` so submit-time refusal and admission-time
+        deferral can never disagree. The speculative subclass adds its
+        ``gamma`` verify window (each round may write that far past the
+        accepted length)."""
+        return prompt_len + max_new
+
     def _reserve_pages(self, req: _Request) -> bool:
         """Pool-pressure check + reservation for one admission: aliased
         prefix pages are already pinned (match time), so only the COW
         tail and the fresh pages draw on the free list. False = defer
         (the request keeps its queue head; pages free as slots retire)."""
         ps = self.pool.page_size
-        total = self.pool.pages_for_tokens(len(req.prompt) + req.max_new)
+        total = self.pool.pages_for_tokens(
+            self._kv_need_tokens(len(req.prompt), req.max_new)
+        )
         aliased = 0
         if isinstance(req.prefix, PagedPrefixState):
             # full shared pages alias; a partial tail still needs a
@@ -1499,6 +1517,14 @@ class ContinuousBatcher:
     # overridable seams (the speculative batcher mirrors these onto a
     # second, draft-model state)
 
+    def _on_prefill_scheduled(self, req: _Request, slot: int,
+                              start: int) -> None:
+        """A chunked prefill was just scheduled for ``slot``, continuing
+        from ``start`` (> 0 iff a prefix served rows [0, start)). Base:
+        nothing to do. The speculative batcher backfills its draft cache
+        here — the prefix rows the target aliased were never run through
+        the draft model, so the draft cheaply re-prefills them."""
+
     def _apply_prefill_chunk(self, chunk, start: int, slot: int) -> None:
         self.state = prefill_chunk(
             self.params, self.state, chunk,
@@ -1596,7 +1622,7 @@ class ContinuousBatcher:
         n_emitted = 0
         if self._inflight is not None and (
             self.pending or self.prefilling or not self.running
-        ) and any(s not in self.running for s in self._inflight[3]):
+        ) and any(s not in self.running for s in self._inflight[2]):
             n_emitted += self._flush_inflight()
         self._admit()
         self._prefill_one_chunk()
@@ -1626,25 +1652,38 @@ class ContinuousBatcher:
                 len(self.prefilling),
             )
 
-    def _decode_once(self, allowed) -> int:
-        """One SYNCHRONOUS decode dispatch + readback for the whole
-        batch; returns tokens emitted (the speculative batcher overrides
-        this with a draft+verify round that can emit up to gamma tokens
-        per slot; it is also the whole decode path at pipeline_depth=0)."""
+    def _decode_dispatch(self, allowed):
+        """Enqueue ONE device decode dispatch and return the result
+        arrays a later :meth:`_apply_decode_result` consumes. The
+        overridable device half of a decode step: the speculative
+        batcher dispatches a whole draft+verify round here instead (its
+        result tuple carries per-slot acceptance counts too). Both
+        halves must stay purely functional over ``self.state`` so the
+        pipelined loop can hold one dispatch in flight."""
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, self._eos_dev,
             self.cfg, self._batch_knobs(), sel=self._batch_sel(),
             bias=self._batch_bias(), seeds=self._batch_seeds(),
         )
-        emitted, logps = jax.device_get((emitted, logps))  # one host sync
+        return (emitted, logps)
+
+    def _apply_decode_result(self, arrs) -> int:
+        """The host half: sync ``arrs`` (one host sync) and run the
+        per-token work. Returns tokens emitted."""
+        emitted, logps = jax.device_get(arrs)
         return self._apply_emitted(emitted, logps)
+
+    def _decode_once(self, allowed) -> int:
+        """One SYNCHRONOUS decode dispatch + readback for the whole
+        batch (the whole decode path at pipeline_depth=0)."""
+        return self._apply_decode_result(self._decode_dispatch(allowed))
 
     def _dispatch_decode(self, allowed) -> None:
         """Enqueue one decode step WITHOUT waiting for its results: the
-        emitted/logps device arrays are parked in ``_inflight`` (their
-        D2H copies started immediately) and read by a later
-        ``_read_step``. In steady state every argument here is a cached
-        device array — zero host->device transfers per token."""
+        result device arrays are parked in ``_inflight`` (their D2H
+        copies started immediately) and read by a later ``_read_step``.
+        In steady state every argument here is a cached device array —
+        zero host->device transfers per token."""
         span = None
         if self.trace_steps and self.tracer.enabled:
             span = self.tracer.span(
@@ -1652,12 +1691,8 @@ class ContinuousBatcher:
                 step=self._step_no,
             )
         t0 = time.perf_counter()
-        self.state, emitted, logps = decode_step(
-            self.params, self.state, allowed, self._eos_dev,
-            self.cfg, self._batch_knobs(), sel=self._batch_sel(),
-            bias=self._batch_bias(), seeds=self._batch_seeds(),
-        )
-        for arr in (emitted, logps):
+        arrs = self._decode_dispatch(allowed)
+        for arr in arrs:
             # start the D2H copy the moment the step completes, so the
             # later device_get finds the bytes already on the host
             start = getattr(arr, "copy_to_host_async", None)
@@ -1671,7 +1706,7 @@ class ContinuousBatcher:
                 observe(time.perf_counter() - t0)
         # the slots this dispatch counted as live (the allowed mask's
         # true set): step() flushes before re-admitting any of them
-        self._inflight = (self._step_no, emitted, logps, tuple(self.running))
+        self._inflight = (self._step_no, arrs, tuple(self.running))
         self._step_no += 1
 
     def _read_step(self, inflight) -> int:
@@ -1680,15 +1715,14 @@ class ContinuousBatcher:
         record or None (the pipeline's first step has nothing to read)."""
         if inflight is None:
             return 0
-        step_no, emitted, logps, _slots = inflight
+        step_no, arrs, _slots = inflight
         span = None
         if self.trace_steps and self.tracer.enabled:
             span = self.tracer.span(
                 "decode_readback", component="serving_engine", step=step_no,
             )
         t0 = time.perf_counter()
-        emitted, logps = jax.device_get((emitted, logps))
-        n = self._apply_emitted(emitted, logps)
+        n = self._apply_decode_result(arrs)
         if span is not None:
             span.set(emitted=n).end()
         if self.metrics:
@@ -1703,7 +1737,7 @@ class ContinuousBatcher:
         emission reaches max_new for each). Sound because the device
         budget counter can't disagree with the host count; conservative
         because EOS/stop retirements aren't predictable host-side."""
-        slots = inflight[3]
+        slots = inflight[2]
         return all(
             len(req.out) + (1 if slot in slots else 0) >= req.max_new
             for slot, req in self.running.items()

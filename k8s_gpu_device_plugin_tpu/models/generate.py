@@ -176,7 +176,7 @@ def _cache_write(cache, scale, x, length, pages=None, page_size=0):
 
 
 def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
-                      cfg: LlamaConfig, pages=None):
+                      cfg: LlamaConfig, pages=None, verify=False):
     """q: (B, T, Hq, hd) attends over cache[:, :max_len] masked to
     positions < length + T (rows are the T new tokens at absolute
     positions length..length+T-1). All-f32 softmax.
@@ -206,6 +206,30 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
                 ) + 1
                 return paged_attention.paged_decode_attention(
                     q, k_cache, v_cache, pages, lens, scale=hd ** -0.5,
+                    window=cfg.sliding_window, interpret=interpret,
+                )
+        elif (verify and t > 1 and k_scale is None
+              and cfg.decode_attn == "ragged"):
+            # the speculative verify window: T=gamma queries per slot at
+            # consecutive positions, page-table-routed DMA (the verify
+            # variant of the ragged kernel). Gated on the EXPLICIT
+            # ``verify`` flag, not just the shape: a small prefill chunk
+            # (t <= 16) would pass supports_verify too, and routing it
+            # through the flash kernel would break the dense-vs-paged
+            # bit-identity the gather below preserves.
+            from k8s_gpu_device_plugin_tpu.ops import paged_attention
+
+            interpret = jax.default_backend() != "tpu"
+            if paged_attention.supports_verify(
+                q, k_cache, pages, require_pltpu=not interpret
+            ):
+                bases = (
+                    jnp.full((b,), length, jnp.int32)
+                    if jnp.ndim(length) == 0
+                    else length.astype(jnp.int32)
+                )
+                return paged_attention.paged_verify_attention(
+                    q, k_cache, v_cache, pages, bases, scale=hd ** -0.5,
                     window=cfg.sliding_window, interpret=interpret,
                 )
         k_cache = k_cache[pages].reshape(b, -1, *k_cache.shape[-2:])
@@ -362,7 +386,7 @@ def _mlp_out(x, layer, cfg, sel=None):
 
 
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
-                  positions, cfg, sel=None, pages=None):
+                  positions, cfg, sel=None, pages=None, verify=False):
     """One transformer block over T new tokens with cache read+write.
 
     Returns (x_out, k_cache, v_cache, k_scale, v_scale) with the new
@@ -379,7 +403,7 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
     v_cache, v_scale = _cache_write(v_cache, v_scale, v, length, pages, ps)
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
-                             cfg, pages=pages)
+                             cfg, pages=pages, verify=verify)
     x = x + _qm_lora(
         attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer, "wo", sel
     )
@@ -392,6 +416,7 @@ def _forward_cached(
     select_pos: jax.Array | None = None,
     lora_sel: jax.Array | None = None,
     pages: jax.Array | None = None,
+    verify: bool = False,
 ):
     """Run T tokens (starting at absolute position ``length``) through all
     layers with cache update. Returns (logits (B, T, V) f32, new cache);
@@ -404,7 +429,10 @@ def _forward_cached(
     adapters when ``params["layers"]`` carries them
     (models/lora_serving.py). ``pages`` (B, n_slot_pages) marks the
     cache as a paged pool and routes every layer's cache write/read
-    through the table (models/batching.py owns the tables)."""
+    through the table (models/batching.py owns the tables); ``verify``
+    marks a speculative T=gamma verify window, the only multi-token
+    paged read allowed onto the flash verify kernel (prefill chunks
+    must keep the bit-identical gather)."""
     from k8s_gpu_device_plugin_tpu.models.llama import cast_params_for_compute
 
     # master-weight checkpoints (param_dtype=f32) decode in compute dtype —
@@ -427,7 +455,7 @@ def _forward_cached(
         layer, k_c, v_c, k_s, v_s = layer_and_cache
         x, k_c, v_c, k_s, v_s = _decode_block(
             x, layer, k_c, v_c, k_s, v_s, length, positions, cfg,
-            sel=lora_sel, pages=pages,
+            sel=lora_sel, pages=pages, verify=verify,
         )
         return x, (k_c, v_c, k_s, v_s)
 

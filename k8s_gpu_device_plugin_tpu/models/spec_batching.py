@@ -1,4 +1,4 @@
-"""Speculative decoding inside continuous batching.
+"""Speculative decoding inside continuous batching — on the fast path.
 
 The two serving levers compose: the slot engine keeps the chip busy
 across requests (models/batching.py); speculative decoding cuts each
@@ -24,38 +24,79 @@ Per round, for every decoding slot simultaneously:
 4. ``lengths += count`` per slot; both caches' rejected rows are hidden
    by the position mask and overwritten by later writes.
 
+This batcher is a first-class citizen of the fast serving stack, not a
+fork of the slow one:
+
+- **Paged KV** (``kv_layout="paged"``): the target cache writes and
+  reads through the shared page pool exactly like the plain batcher —
+  the verify round scatters its gamma-token window through the slot's
+  page table — and the DRAFT cache gets its own (much smaller, the
+  draft model's bytes) pool with the same trap-page and refcount
+  semantics. Admission reserves pages in BOTH pools (worst case
+  ``prompt + max_new + gamma`` rows each: a round may write gamma rows
+  past the accepted length, so the reservation must cover them — a
+  trap-routed write is harmless, but a verify READ of a trapped row
+  would not be) and defers on pressure in either.
+- **Prefix cache**: the target aliases cached prefix rows/pages exactly
+  as the dense path does (zero-copy page hits, COW tail page); the
+  draft cache has no rows for the matched region, so it cheaply
+  RE-PREFILLS the prefix through its own small model at admission
+  (``_on_prefill_scheduled``) using the cold path's exact chunk grid —
+  the draft K/V are bit-identical to a cold admission's, so streams
+  are identical cache on or off. Manual ``submit(prefix=...)`` rides
+  the same backfill.
+- **Overlapped rounds** (``pipeline_depth=1``, the default): round t+1
+  dispatches before round t's readback, so rejection bookkeeping, stop
+  matching and stream publishing run on host while the chip drafts and
+  verifies the next round. Sound for the same reason the plain
+  pipeline is: the device state (lengths, budgets, caches) advances
+  functionally inside the jitted round, so round t+1 never needs the
+  host's view of round t — the host only DROPS tokens (retired slots,
+  budget tails), and the flush-on-slot-reuse rule in ``step()`` keeps
+  a freed slot's lagging round from leaking into its next occupant.
+
 Output contract: under a GREEDY sampler, emitted tokens are IDENTICAL
 to the plain batcher's (and therefore to dedicated ``generate``) up to
 float determinism — the T=gamma verify and T=1 decode are different XLA
 programs, so bf16 near-tie argmaxes can flip; at f32 parity is
 token-exact (the same caveat models/speculative.py documents,
-test-pinned here too). Under a SAMPLED sampler the guarantee is
-distributional, not token-wise: each token is exactly target-
-distributed (the speculative sampling theorem; the _accept_round core
-is statistically pinned in tests/test_speculative.py).
+test-pinned here too). Within the speculative matrix the pin is harder:
+dense vs paged, cache on vs off, and pipeline depth 0 vs 1 are all
+BIT-identical in tokens and logprobs (tests/test_spec_fastpath.py).
+Under a SAMPLED sampler the guarantee is distributional, not
+token-wise: each token is exactly target-distributed (the speculative
+sampling theorem; the _accept_round core is statistically pinned in
+tests/test_speculative.py).
 
 Capacity: each round may write gamma rows beyond the accepted length, so
 ``submit`` reserves ``gamma`` extra rows (prompt + max_new + gamma <=
 max_len) and the inactive-slot write redirect targets the top gamma rows
-(provably outside every live prompt window under that reservation).
+(provably outside every live prompt window under that reservation); on
+the paged layout inactive slots' tables redirect to the trap page
+instead, and the page reservation covers the same gamma window.
 """
 
 from __future__ import annotations
 
+from dataclasses import replace
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from k8s_gpu_device_plugin_tpu.models.batching import (
     BatchState,
     ContinuousBatcher,
+    _Request,
+    _set_slot_pages,
     init_batch_state,
     prefill_chunk,
     prefill_finish,
 )
 from k8s_gpu_device_plugin_tpu.models.generate import _forward_cached
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+from k8s_gpu_device_plugin_tpu.models.paging import PagePool, kv_token_bytes
 from k8s_gpu_device_plugin_tpu.models.sampling import (
     sampler_knobs,
     Sampler,
@@ -64,6 +105,8 @@ from k8s_gpu_device_plugin_tpu.models.sampling import (
     token_logprob,
 )
 from k8s_gpu_device_plugin_tpu.models.speculative import _accept_round
+from k8s_gpu_device_plugin_tpu.obs.trace import attach
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
 
 @partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma", "sampler"),
@@ -72,7 +115,7 @@ def spec_decode_step(
     params_t,
     params_d,
     state: BatchState,        # target-side state (lengths are THE truth)
-    draft_state: BatchState,  # only its cache participates
+    draft_state: BatchState,  # only its cache (and page table) participate
     allowed: jax.Array,       # (B,) bool host membership gate (budget
                               # rides in BatchState.budget; host drops
                               # any round tail emitted past it)
@@ -88,15 +131,28 @@ def spec_decode_step(
     emitted token is exactly target-distributed under the filtered
     distribution (the speculative sampling theorem, per slot).
 
+    On the paged layout both forwards route their cache writes/reads
+    through the respective page tables; inactive slots' table rows are
+    redirected to the trap page (the plain decode_step discipline), so
+    a retired slot's stale table can never scribble a page since
+    reallocated to a live neighbor.
+
     Returns (state, draft_state, emitted (B, gamma) int32 with -1 beyond
     each row's count, counts (B,) int32, logps (B, gamma) f32).
     """
     greedy = sampler.is_greedy
     was_active = state.active & allowed
     b = state.lengths.shape[0]
-    cache_len = state.cache.k.shape[2]
-    # inactive slots write into the top gamma rows — outside every live
-    # prompt/generation window thanks to the submit-side gamma reservation
+    if cfg_t.kv_layout == "paged":
+        cache_len = state.pages.shape[1] * cfg_t.kv_page_size
+        pages_t = jnp.where(was_active[:, None], state.pages, 0)
+        pages_d = jnp.where(was_active[:, None], draft_state.pages, 0)
+    else:
+        cache_len = state.cache.k.shape[2]
+        pages_t = pages_d = None
+    # inactive slots write into the top gamma rows (dense: outside every
+    # live prompt/generation window thanks to the submit-side gamma
+    # reservation; paged: the zeroed table rows trap the writes anyway)
     base = jnp.where(was_active, state.lengths, cache_len - gamma)
     key, kdraft, kaccept = jax.random.split(state.key, 3)
 
@@ -104,7 +160,8 @@ def spec_decode_step(
     def draft_body(carry, j):
         tok, d_cache = carry
         logits, d_cache = _forward_cached(
-            params_d, tok[:, None], d_cache, base + j, cfg_d
+            params_d, tok[:, None], d_cache, base + j, cfg_d,
+            pages=pages_d,
         )
         if greedy:
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
@@ -129,7 +186,8 @@ def spec_decode_step(
         [state.last_token[:, None], d_toks[:, :-1]], axis=1
     )
     v_logits, t_cache = _forward_cached(
-        params_t, verify_in, state.cache, base, cfg_t
+        params_t, verify_in, state.cache, base, cfg_t, pages=pages_t,
+        verify=True,
     )
 
     idx = jnp.arange(gamma, dtype=jnp.int32)[None, :]
@@ -168,14 +226,14 @@ def spec_decode_step(
         active=state.active,
         presence=state.presence,
         key=key,
-        # bookkeeping only: the spec batcher runs synchronously
-        # (pipeline_depth=0) and retires on budget host-side, dropping
-        # any tail the round emitted past it — clamp so a long
-        # acceptance run can't underflow the counter
+        # bookkeeping only: the host retires on budget and drops any
+        # tail the round emitted past it — clamp so a long acceptance
+        # run can't underflow the counter
         budget=jnp.where(
             was_active, jnp.maximum(state.budget - counts, 0), state.budget
         ),
         draws=state.draws,  # per-request seeds are rejected at submit
+        pages=state.pages,
     )
     new_draft = BatchState(
         cache=d_cache,
@@ -186,6 +244,7 @@ def spec_decode_step(
         key=draft_state.key,
         budget=draft_state.budget,
         draws=draft_state.draws,
+        pages=draft_state.pages,
     )
     return new_state, new_draft, emitted, counts, logps
 
@@ -198,7 +257,14 @@ class SpeculativeBatcher(ContinuousBatcher):
     target-distributed either way. Repetition penalty is unsupported
     (the filtered distributions would need per-slot presence threading).
     Requires chunked prefill (both models' caches prefill through the
-    same chunk schedule)."""
+    same chunk schedule).
+
+    Composes with the fast-path stack: ``kv_layout="paged"`` pages both
+    caches (``draft_kv_pages`` sizes the draft pool; 0 = the draft's
+    dense-equivalent capacity), an attached ``prefix_cache`` serves the
+    target zero-copy while the draft re-prefills the matched region,
+    and ``pipeline_depth=1`` (default) overlaps round t+1's dispatch
+    with round t's host bookkeeping."""
 
     def __init__(
         self,
@@ -209,6 +275,7 @@ class SpeculativeBatcher(ContinuousBatcher):
         n_slots: int,
         max_len: int,
         gamma: int = 4,
+        draft_kv_pages: int = 0,
         **kw,
     ):
         sampler = kw.get("sampler")
@@ -226,18 +293,66 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "SpeculativeBatcher does not support LoRA adapters (the "
                 "draft model has no stacks to mirror the target's)"
             )
-        # opt OUT of the decode pipeline: a speculative round's host side
-        # must see the per-slot acceptance counts before it can schedule
-        # the next round (the draft positions depend on them), so the
-        # dispatch-ahead overlap has nothing to hide behind
-        kw["pipeline_depth"] = 0
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        # the gamma reservation participates in _kv_need_tokens, which
+        # super().__init__-time gauge reporting may consult — set first
+        self.gamma = int(gamma)
         super().__init__(params, cfg, n_slots, max_len, **kw)
         if not self.chunk:
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
-        self.gamma = int(gamma)
         self.draft_params = draft_params
-        self.draft_cfg = draft_cfg
-        self.draft_state = init_batch_state(draft_cfg, n_slots, max_len)
+        # the draft rides the SAME layout as the target (self.cfg is the
+        # post-kwarg config): mismatched layouts would desynchronize the
+        # two caches' write plumbing
+        if self.cfg.kv_layout == "paged" and draft_cfg.cache_quant != "none":
+            raise ValueError(
+                "the draft cache cannot be quantized under "
+                "kv_layout='paged' (scale planes are not paged)"
+            )
+        self.draft_cfg = replace(
+            draft_cfg, kv_layout=self.cfg.kv_layout,
+            kv_page_size=self.cfg.kv_page_size,
+        )
+        # the draft's own page pool: same page/slot geometry as the
+        # target's (the tables are twins), far fewer bytes (the draft
+        # model's layers/heads). Refcounts exist for symmetry but no
+        # draft prefix entries ever share pages — pages free exactly at
+        # slot retirement.
+        self.draft_pool: PagePool | None = None
+        self._draft_slot_pages: dict[int, list[int]] = {}
+        # slot -> pending draft-backfill chunk starts (prefix
+        # admissions; drained one chunk per step by _prefill_one_chunk)
+        self._draft_backfill: dict[int, list[int]] = {}
+        n_draft_pages = 0
+        if self.cfg.kv_layout == "paged":
+            if draft_kv_pages < 0:
+                raise ValueError(
+                    f"draft_kv_pages must be >= 0 (0 = dense-equivalent "
+                    f"pool), got {draft_kv_pages}"
+                )
+            per_slot = max_len // self.cfg.kv_page_size
+            n_draft_pages = (
+                int(draft_kv_pages) if draft_kv_pages > 0
+                else n_slots * per_slot + 1
+            )
+            self.draft_pool = PagePool(n_draft_pages, self.cfg.kv_page_size)
+        self.draft_state = init_batch_state(
+            self.draft_cfg, n_slots, max_len, n_pages=n_draft_pages
+        )
+        # host-side acceptance accounting (spec_stats / the metrics
+        # hooks): rounds that had >= 1 active slot, gamma-proposals
+        # drafted, and device-side accepted counts (bonus included;
+        # host truncation on EOS/stop/budget does not un-count them)
+        self._spec_rounds = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if self.metrics is not None:
+            # re-push the reservation gauge now that kv_stats() can see
+            # the draft cache: spec-vs-plain HBM must be apples-to-apples
+            set_res = getattr(self.metrics, "set_kv_reserved_bytes", None)
+            if set_res is not None:
+                set_res(self.kv_stats()["reserved_bytes"])
 
     def validate(self, prompt_len: int, max_new: int) -> None:
         # reserve gamma rows: each round may write that far past the
@@ -248,29 +363,29 @@ class SpeculativeBatcher(ContinuousBatcher):
                 f"{self.gamma} exceeds slot capacity {self.max_len}"
             )
         super().validate(prompt_len, max_new)
+        if self.draft_pool is not None:
+            # the draft pool is a second admission wall: a request whose
+            # worst case outsizes it can never run (the target-pool twin
+            # of the base class's request_too_large check)
+            need = self.draft_pool.pages_for_tokens(
+                self._kv_need_tokens(prompt_len, max_new)
+            )
+            if need > self.draft_pool.capacity:
+                self._count_kv_rejection("request_too_large")
+                raise ValueError(
+                    f"request needs {need} draft KV pages but the draft "
+                    f"pool holds {self.draft_pool.capacity}; raise "
+                    "draft_kv_pages or shrink the request"
+                )
 
     #: draft/verify distributions are built from ONE static sampler; a
     #: per-request override would desynchronize the rejection sampling
     per_request_sampler = False
     per_request_bias = False  # the draft+verify round threads no planes
     per_request_seed = False  # same: no per-row key streams in the round
-    #: submit() rejects prefixes (below): the draft cache has no prefix
-    #: rows, so an automatic prefix cache must be refused at construction
-    supports_prefix_cache = False
-    #: the paged KV layout is refused at construction (ContinuousBatcher
-    #: checks this flag): the draft cache mirrors the target's slot
-    #: geometry row-for-row, and there are no draft page tables to
-    #: mirror admissions/aliasing onto — silently running the draft
-    #: dense while the target pages would desynchronize the two caches
-    supports_paged_kv = False
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
                adapter=-1, logit_bias=None, seed=None):
-        if prefix is not None:
-            raise NotImplementedError(
-                "shared prefixes are not supported with speculative "
-                "batching yet (the draft cache has no prefix rows)"
-            )
         if sampler is not None:
             raise ValueError(
                 "per-request samplers are not supported with speculative "
@@ -288,8 +403,133 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "batching (the round threads no per-row key streams)"
             )
         # adapter >= 0 rejected by validate_adapter: __init__ refuses
-        # adapter stacks, so n_adapters is always 0 here
-        return super().submit(prompt, max_new, stop=stop, adapter=adapter)
+        # adapter stacks, so n_adapters is always 0 here. A prefix
+        # (manual or an automatic cache hit at admission) serves the
+        # TARGET rows; the draft re-prefills the region itself
+        # (_on_prefill_scheduled).
+        return super().submit(prompt, max_new, prefix=prefix, stop=stop,
+                              adapter=adapter)
+
+    # --- paged-KV plumbing: the draft pool mirrors every admission ---
+
+    def _kv_need_tokens(self, prompt_len: int, max_new: int) -> int:
+        # the verify round writes up to gamma rows past the accepted
+        # length; a trap-routed WRITE would be harmless, but those rows
+        # are READ back by the same round's attention — they must be
+        # real pages
+        return prompt_len + max_new + self.gamma
+
+    def _reserve_pages(self, req: _Request) -> bool:
+        need_d = 0
+        if self.draft_pool is not None:
+            need_d = self.draft_pool.pages_for_tokens(
+                self._kv_need_tokens(len(req.prompt), req.max_new)
+            )
+            if need_d > self.draft_pool.free_pages:
+                # nothing to reclaim here: no prefix entries ever pin
+                # draft pages, so the free list grows only as slots
+                # retire — defer at the queue head like target pressure
+                if not req.defer_counted:
+                    req.defer_counted = True
+                    self._count_kv_rejection("pool_pressure")
+                    if req.span is not None:
+                        with attach(req.span):
+                            get_logger().debug(
+                                "admission deferred: draft KV pool "
+                                "pressure",
+                                extra={"fields": {
+                                    "rid": req.rid, "need_pages": need_d,
+                                    "free_pages":
+                                        self.draft_pool.free_pages,
+                                }},
+                            )
+                return False
+        if not super()._reserve_pages(req):
+            return False
+        if self.draft_pool is not None:
+            # single-threaded engine: the free-list check above still
+            # holds, so this alloc cannot raise
+            req._draft_new_pages = self.draft_pool.alloc(need_d)
+        return True
+
+    def _install_pages(self, req: _Request, slot: int) -> None:
+        super()._install_pages(req, slot)
+        if self.draft_pool is None:
+            return
+        assert slot not in self._draft_slot_pages, "draft slot pages leaked"
+        ids = req._draft_new_pages or []
+        req._draft_new_pages = None
+        row = np.zeros((self.draft_state.pages.shape[1],), np.int32)
+        row[: len(ids)] = ids
+        self._draft_slot_pages[slot] = ids
+        self.draft_state = _set_slot_pages(
+            self.draft_state, jnp.asarray(row), jnp.int32(slot)
+        )
+
+    def _release_slot_pages(self, slot: int, req=None) -> None:
+        super()._release_slot_pages(slot, req)
+        # a slot cancelled mid-backfill must not leak its queue onto
+        # the next occupant (called on every retire/cancel path)
+        self._draft_backfill.pop(slot, None)
+        if self.draft_pool is not None:
+            ids = self._draft_slot_pages.pop(slot, None)
+            if ids:
+                self.draft_pool.decref(ids)
+
+    def kv_stats(self) -> dict:
+        """Target stats plus the draft cache's reservation (and pool
+        occupancy when paged), with ``reserved_bytes`` covering BOTH
+        models' caches — the satellite comparability fix: spec-vs-plain
+        and paged-vs-dense HBM numbers on /metrics and /v1/health are
+        apples-to-apples only if the draft bytes are visible."""
+        s = super().kv_stats()
+        draft_cfg = getattr(self, "draft_cfg", None)
+        if draft_cfg is None:
+            return s  # mid-__init__ gauge push: draft cache not built yet
+        tb = kv_token_bytes(draft_cfg)
+        if self.draft_pool is None:
+            draft = {
+                "layout": "dense",
+                "reserved_bytes": self.n_slots * self.max_len * tb,
+            }
+        else:
+            dp = self.draft_pool
+            draft = {
+                "layout": "paged",
+                "page_size": dp.page_size,
+                "pages_total": dp.capacity,
+                "pages_in_use": dp.in_use,
+                "pages_free": dp.free_pages,
+                "reserved_bytes": dp.n_pages * dp.page_size * tb,
+            }
+        s["target_reserved_bytes"] = s["reserved_bytes"]
+        s["draft_reserved_bytes"] = draft["reserved_bytes"]
+        s["reserved_bytes"] += draft["reserved_bytes"]
+        s["draft"] = draft
+        return s
+
+    def spec_stats(self) -> dict:
+        """Acceptance accounting for /v1/health (the production view the
+        old spec path never exported): drafted counts gamma proposals
+        per active slot-round, accepted counts the device-side per-round
+        acceptance (bonus token included)."""
+        drafted, rounds = self._spec_drafted, self._spec_rounds
+        slot_rounds = drafted // self.gamma  # active slot-rounds
+        return {
+            "gamma": self.gamma,
+            "rounds": rounds,
+            "tokens_drafted": drafted,
+            "tokens_accepted": self._spec_accepted,
+            "acceptance_rate": (
+                self._spec_accepted / drafted if drafted else 0.0
+            ),
+            # mean accepted tokens per SLOT per round (1..gamma): the
+            # gamma-picking signal — near gamma says raise it, near 1
+            # says the draft isn't earning its keep
+            "accepted_per_round": (
+                self._spec_accepted / slot_rounds if slot_rounds else 0.0
+            ),
+        }
 
     # mirror every prefill onto the draft cache
 
@@ -314,7 +554,75 @@ class SpeculativeBatcher(ContinuousBatcher):
         )
         return tok, logp
 
-    def _decode_once(self, allowed) -> int:
+    def _on_prefill_scheduled(self, req, slot: int, start: int) -> None:
+        """Draft backfill for prefix admissions: the target slot holds
+        rows [0, start) from the cache (aliased pages or copied rows),
+        but the draft model never saw those tokens — queue a re-prefill
+        through the draft on the COLD path's exact chunk grid
+        (intermediate chunks at 0, C, 2C, ... plus a back-scheduled
+        final window), so the draft K/V are bit-identical to a cold
+        admission's and acceptance quality is unaffected by cache hits.
+        The queue drains ONE chunk per step (:meth:`_prefill_one_chunk`)
+        — the target's own pacing contract: a cache hit must not stall
+        running decodes behind a multi-chunk draft burst. The draft is
+        the CHEAP model — the classic trade: pay a small draft prefill
+        to keep the big target prefill cached."""
+        self._draft_backfill.pop(slot, None)
+        if start <= 0:
+            return
+        c = self.chunk
+        starts = []
+        p = 0
+        while p + c < start:
+            starts.append(p)
+            p += c
+        starts.append(max(0, start - c))
+        self._draft_backfill[slot] = starts
+
+    def _prefill_one_chunk(self) -> None:
+        # the oldest mid-prefill slot's draft backfill drains FIRST:
+        # the mirrored suffix chunks ATTEND draft rows [0, start), so
+        # they may not dispatch until the backfill completes — and it
+        # advances one chunk per step, the same per-step latency bound
+        # the chunk scheduler gives the target's own prefill
+        if self.prefilling:
+            slot = next(iter(self.prefilling))
+            pending = self._draft_backfill.get(slot)
+            if pending:
+                req = self.prefilling[slot]
+                s = pending.pop(0)
+                if not pending:
+                    del self._draft_backfill[slot]
+                span = None
+                if self.tracer.enabled and req.span is not None:
+                    span = self.tracer.span(
+                        "draft_backfill", component="serving",
+                        parent=req.span, start=s, tokens=self.chunk,
+                    )
+                try:
+                    # the window may run past ``start`` (short prefixes
+                    # / unaligned grids): those are real prompt tokens
+                    # whose rows the mirrored suffix chunks rewrite
+                    # identically, and any padding rows land beyond the
+                    # prompt, never attended (the prefill_finish
+                    # garbage-row argument)
+                    rest = req.prompt[s:s + self.chunk]
+                    chunk = jnp.asarray(
+                        rest + [0] * (self.chunk - len(rest)), jnp.int32
+                    )
+                    self.draft_state = prefill_chunk(
+                        self.draft_params, self.draft_state, chunk,
+                        jnp.int32(s), jnp.int32(slot), self.draft_cfg,
+                    )
+                finally:
+                    if span is not None:
+                        span.end()
+                return
+        super()._prefill_one_chunk()
+
+    # --- the decode seams: one draft+verify round per step ---
+
+    def _decode_dispatch(self, allowed):
         # The submit-side gamma reservation guarantees room: a running
         # slot has len(out) < max_new, so length + gamma <= max_len.
         for slot, req in self.running.items():
@@ -327,10 +635,17 @@ class SpeculativeBatcher(ContinuousBatcher):
             self.params, self.draft_params, self.state, self.draft_state,
             allowed, self.cfg, self.draft_cfg, self.gamma, self.sampler,
         )
-        emitted, counts, logps = jax.device_get(
-            (emitted, counts, logps)
-        )  # one host sync per round
+        return (emitted, counts, logps)
+
+    def _apply_decode_result(self, arrs) -> int:
+        emitted, counts, logps = jax.device_get(arrs)  # one sync per round
         n_emitted = 0
+        # acceptance accounting from the DEVICE counts, not the running
+        # map: a slot cancelled/retired between dispatch and readback
+        # (the pipelined lag) still really drafted and scored gamma
+        # proposals — dropping it would bias acceptance_rate and the
+        # gamma-tuning histogram upward under cancel-heavy traffic
+        accepted = [int(c) for c in counts if c > 0]
         for slot, req in list(self.running.items()):
             for j in range(int(counts[slot])):
                 tok = int(emitted[slot, j])
@@ -342,4 +657,24 @@ class SpeculativeBatcher(ContinuousBatcher):
                 self._finish_if_done(req)
                 if req.rid in self.done:
                     break  # EOS/stop/budget mid-round: drop the tail
+        if accepted:
+            self._spec_rounds += 1
+            self._spec_drafted += self.gamma * len(accepted)
+            self._spec_accepted += sum(accepted)
+            if self.metrics is not None:
+                on_round = getattr(self.metrics, "on_spec_round", None)
+                if on_round is not None:
+                    on_round(self.gamma, accepted)
         return n_emitted
+
+    def _inflight_covers_rest(self, inflight) -> bool:
+        # a round emits up to gamma tokens per slot: predicting with
+        # gamma avoids dispatching a wasted round past every request's
+        # budget; when acceptance falls short the base step() simply
+        # re-dispatches after the read (one sync bubble, never wrong)
+        slots = inflight[2]
+        return all(
+            len(req.out) + (self.gamma if slot in slots else 0)
+            >= req.max_new
+            for slot, req in self.running.items()
+        )

@@ -15,13 +15,25 @@ map resolves grid cell (b, j) to physical page ``table[b, j]`` —
 clamped into the row's live span so out-of-range cells re-map to a page
 that is loaded anyway and Pallas elides the duplicate DMA.
 
-The kernel BODY is ``ragged_decode._kernel`` unchanged (online-softmax
-flash accumulation at T=1, block size = page_size): masking only needs
-each block's virtual position, which is ``j * page_size`` in both
-layouts. Only the DMA routing differs — exactly the page-table
+The T=1 kernel BODY is ``ragged_decode._kernel`` unchanged
+(online-softmax flash accumulation, block size = page_size): masking
+only needs each block's virtual position, which is ``j * page_size`` in
+both layouts. Only the DMA routing differs — exactly the page-table
 indirection the layout adds.
 
-bf16 caches, T=1, GQA; same ``supports()``/interpret-mode pattern as the
+The **verify variant** (:func:`paged_verify_attention`) generalizes the
+body to a small multi-query window per slot — the speculative batcher's
+round scores ``gamma`` draft tokens in one target forward, so each slot
+carries T=gamma queries at consecutive positions ``base..base+T-1``
+with a causal stagger (query t sees keys <= base+t). The grid, DMA
+routing and scalar-prefetch shape are the T=1 kernel's; only the mask
+gains a per-query position row and the accumulators a T axis. This is
+exactly the multi-token shape the TPU paged-kernel literature verifies
+through page tables (arXiv:2604.15464); the XLA gather fallback in
+``models/generate._cached_attention`` stays the bit-identical
+reference on CPU.
+
+bf16 caches, GQA; same ``supports()``/interpret-mode pattern as the
 ragged kernel, so the CPU test suite runs it in interpret mode and the
 serving integration stays behind ``LlamaConfig(decode_attn="ragged")``.
 """
@@ -132,3 +144,175 @@ def paged_decode_attention(
         interpret=interpret,
     )(lengths, pages, q, k_pool, v_pool)
     return out[:, None]
+
+
+# --- the multi-query verify variant (speculative decoding) ------------------
+
+_NEG_BIG = -1e30
+#: widest verify window the kernel accepts: the T queries' accumulators
+#: all live in VMEM scratch at once, and a speculative gamma is small by
+#: construction (past ~8 the acceptance tail pays for itself) — larger
+#: windows (prefill chunks) stay on the XLA gather path
+MAX_VERIFY_T = 16
+
+
+def supports_verify(
+    q: jax.Array, k_pool: jax.Array, pages: jax.Array, hd_ok=(64, 128),
+    require_pltpu: bool = True,
+) -> bool:
+    """Shape gate for the verify kernel: a small multi-query window
+    (2 <= T <= MAX_VERIFY_T) over the same clean tiles the T=1 kernel
+    needs. ``require_pltpu=False`` relaxes only the TPU-build check."""
+    if require_pltpu and not _HAS_PLTPU:
+        return False
+    if q.ndim != 4 or not (2 <= q.shape[1] <= MAX_VERIFY_T):
+        return False
+    b, _, hq, hd = q.shape
+    ps = k_pool.shape[1]
+    return (
+        hd in hd_ok
+        and hq % k_pool.shape[2] == 0
+        and ps % 8 == 0
+        and pages.shape[0] == b
+    )
+
+
+def _verify_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, bk: int, t: int, hq: int, hkv: int, hd: int,
+                   scale: float, window: int):
+    """The ragged flash body with a T axis: query row t sits at virtual
+    position ``base + t`` and keeps keys ``k_pos <= base + t`` (minus
+    the sliding-window floor) — the exact mask the dense verify einsum
+    applies, so acceptance decisions cannot drift between layouts."""
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    base = base_ref[bi]
+    group = hq // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # live kv span across ALL T queries: the earliest query's window
+    # floor up to the last query's position (base + t - 1, whose row the
+    # round's own write just filled — live rows = base + t)
+    live = (j >= _first_block(base + 1, window, bk)) & (
+        j <= _last_block(base + t, bk)
+    )
+
+    @pl.when(live)
+    def _block():
+        # (T, Hkv, g, hd) -> (Hkv, T*g, hd): T and g are both batch-like
+        # for the dots; the mask below re-separates them
+        q = (
+            q_ref[0].reshape(t, hkv, group, hd).transpose(1, 0, 2, 3)
+            .reshape(hkv, t * group, hd).astype(jnp.float32)
+        )
+        k = k_ref[0].astype(jnp.float32)      # (bk, Hkv, hd)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k.transpose(1, 2, 0),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                              # (Hkv, T*g, bk)
+        s = s.reshape(hkv, t, group, bk)
+        pos = j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, bk), 3
+        )
+        q_pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, t, 1, 1), 1
+        )
+        keep = pos <= q_pos
+        if window > 0:
+            keep &= q_pos - pos < window
+        s = jnp.where(keep, s, _NEG_BIG)
+        m_prev = m_ref[...]                    # (Hkv, T, g, 1)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                 # (Hkv, T, g, bk)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p.reshape(hkv, t * group, bk), v.transpose(1, 0, 2),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(hkv, t, group, hd)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (
+            out.transpose(1, 0, 2, 3).reshape(t, hq, hd).astype(o_ref.dtype)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "window", "interpret")
+)
+def paged_verify_attention(
+    q: jax.Array,          # (B, T, Hq, hd) — T = the verify window
+    k_pool: jax.Array,     # (n_pages, page_size, Hkv, hd) bf16
+    v_pool: jax.Array,     # (n_pages, page_size, Hkv, hd)
+    pages: jax.Array,      # (B, n_slot_pages) int32 page table
+    base: jax.Array,       # (B,) int32 position of each slot's FIRST query
+    scale: float,
+    window: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """(B, T, Hq, hd) verify attention gathering pages through the
+    table: query t of slot b sits at position ``base[b] + t`` and
+    attends causally up to itself (the speculative round's gamma-token
+    verify window, one kernel launch for the whole batch)."""
+    b, t, hq, hd = q.shape
+    assert t >= 2, "use paged_decode_attention for T=1"
+    ps = k_pool.shape[1]
+    hkv = k_pool.shape[2]
+    n_slot_pages = pages.shape[1]
+    base = base.astype(jnp.int32)
+    pages = pages.astype(jnp.int32)
+    group = hq // hkv
+
+    def kv_map(bi, j, bases, table):
+        lo = _first_block(bases[bi] + 1, window, ps)
+        hi = _last_block(bases[bi] + t, ps)
+        return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_slot_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, t, hq, hd), lambda bi, j, bases, table: (bi, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, ps, hkv, hd), kv_map),
+            pl.BlockSpec((1, ps, hkv, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, t, hq, hd), lambda bi, j, bases, table: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # m
+            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # l
+            pltpu.VMEM((hkv, t, group, hd), jnp.float32),  # acc
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel, bk=ps, t=t, hq=hq, hkv=hkv, hd=hd, scale=scale,
+        window=window,
+    )
+
+    def body(bases_ref, table_ref, *refs):
+        kernel(bases_ref, *refs)
+
+    out = pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((b, t, hq, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(base, pages, q, k_pool, v_pool)
+    return out

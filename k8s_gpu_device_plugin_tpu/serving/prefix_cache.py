@@ -50,6 +50,14 @@ Single-threaded by design: every mutating call happens on the engine
 thread (``submit`` runs there via the engine's admission queue), the
 same discipline as the batcher itself. ``stats()`` is a GIL-consistent
 read for HTTP handlers.
+
+The speculative batcher (models/spec_batching.py) consumes this cache
+through the same two calls: entries always hold TARGET-model rows (or
+page refs), matched and aliased exactly as here; the draft cache never
+enters the tree — the batcher re-prefills the matched region through
+the draft model at admission, which keeps every entry reusable by both
+speculative and plain batchers' traffic shapes without draft-keyed
+roots.
 """
 
 from __future__ import annotations

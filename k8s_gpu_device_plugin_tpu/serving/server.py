@@ -269,8 +269,14 @@ class InferenceEngine:
         kv_stats = getattr(self.cb, "kv_stats", None)
         if kv_stats is not None:
             # KV residency (both layouts; paged adds pool occupancy +
-            # fragmentation) — mirrored by the OpenAI façade's health
+            # fragmentation; speculative batchers fold the draft cache
+            # in) — mirrored by the OpenAI façade's health
             out["kv"] = kv_stats()
+        spec_stats = getattr(self.cb, "spec_stats", None)
+        if spec_stats is not None:
+            # speculative acceptance (rounds, drafted/accepted tokens,
+            # acceptance rate) — the production view of gamma's health
+            out["spec"] = spec_stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -916,18 +922,27 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--draftPreset", default="",
                         help="enable speculative decoding with this draft "
                         "model preset (greedy or sampled; repetition "
-                        "penalty unsupported)")
+                        "penalty unsupported). Composes with the fast "
+                        "path: --kvLayout paged pages both caches, the "
+                        "automatic prefix cache serves the target "
+                        "zero-copy, --pipelineDepth 1 overlaps rounds")
     parser.add_argument("--draftCheckpointDir", default="")
     parser.add_argument("--gamma", type=int, default=4,
-                        help="draft proposals verified per round")
+                        help="draft proposals verified per round (pick "
+                        "from the spec_accepted_per_round histogram: "
+                        "mass at gamma = raise it, mass at 1 = lower it)")
+    parser.add_argument("--draftKvPages", type=int, default=0,
+                        help="with --draftPreset and --kvLayout paged: "
+                        "physical pages in the DRAFT model's KV pool "
+                        "(0 sizes it to the draft's dense-equivalent "
+                        "capacity)")
     parser.add_argument("--pipelineDepth", type=int, default=1,
                         choices=[0, 1],
                         help="decode pipeline: 1 (default) dispatches "
-                        "step t+1 before reading step t back so host "
-                        "token work overlaps device compute; 0 restores "
-                        "the synchronous loop (ignored with "
-                        "--draftPreset: the speculative round is "
-                        "synchronous by construction)")
+                        "step t+1 (or speculative round t+1) before "
+                        "reading step t back so host token work "
+                        "overlaps device compute; 0 restores the "
+                        "synchronous loop")
     parser.add_argument("--prefixCacheMB", type=int, default=256,
                         help="HBM byte budget (MiB) for the automatic "
                         "prefix cache: prompts sharing a cached prefix "
@@ -1057,13 +1072,14 @@ def _main(argv: list[str] | None = None) -> int:
 
     metrics = ServingMetrics()
     # Automatic prefix caching: on by default wherever it can work —
-    # chunked prefill (the suffix scheduler) and a non-speculative
-    # batcher (the draft cache has no prefix rows). Promotion boundaries
-    # are the batcher's own prompt-bucket ladder.
+    # chunked prefill (the suffix scheduler) is the only requirement;
+    # the speculative batcher serves the target from the cache and
+    # re-prefills the draft's rows itself. Promotion boundaries are the
+    # batcher's own prompt-bucket ladder.
     prefix_cache = None
     if (
         not args.prefixCacheOff and args.prefixCacheMB > 0
-        and args.chunkedPrefill > 0 and not args.draftPreset
+        and args.chunkedPrefill > 0
     ):
         from k8s_gpu_device_plugin_tpu.models.batching import (
             DEFAULT_PROMPT_BUCKETS,
@@ -1079,12 +1095,6 @@ def _main(argv: list[str] | None = None) -> int:
                 min_hits=args.prefixCacheMinHits,
                 metrics=metrics,
             )
-    if args.kvLayout == "paged" and args.draftPreset:
-        raise SystemExit(
-            "--kvLayout paged is unsupported with --draftPreset: the "
-            "speculative batcher's draft cache has no page tables to "
-            "mirror the target's aliasing onto"
-        )
     if args.kvLayout == "paged" and args.cacheQuant != "none":
         raise SystemExit(
             "--kvLayout paged is unsupported with --cacheQuant: the "
@@ -1102,6 +1112,13 @@ def _main(argv: list[str] | None = None) -> int:
             "dense (the dense cache reserves slots*maxLen rows); add "
             "--kvLayout paged"
         )
+    if args.draftKvPages and (
+        args.kvLayout != "paged" or not args.draftPreset
+    ):
+        raise SystemExit(
+            "--draftKvPages sizes the speculative draft model's page "
+            "pool: it needs both --draftPreset and --kvLayout paged"
+        )
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1110,12 +1127,24 @@ def _main(argv: list[str] | None = None) -> int:
 
         draft_cfg = getattr(LlamaConfig, args.draftPreset)()
         draft_params = load_params(draft_cfg, args.draftCheckpointDir)
+        # the fast-path stack goes to the batcher's own constructor
+        # (the engine refuses the flags alongside an injected batcher):
+        # prefix cache, paged KV for BOTH caches, pipelined rounds
         batcher = SpeculativeBatcher(
             params, cfg, draft_params, draft_cfg,
             n_slots=args.slots, max_len=args.maxLen, gamma=args.gamma,
+            draft_kv_pages=args.draftKvPages,
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(args.chunkedPrefill, args.maxLen),
             metrics=metrics,
+            pipeline_depth=args.pipelineDepth,
+            trace_steps=args.traceSteps and args.tracing,
+            prefix_cache=prefix_cache,
+            kv_layout=args.kvLayout,
+            kv_page_size=(
+                args.kvPageSize if args.kvLayout == "paged" else None
+            ),
+            kv_pages=args.kvPages,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1124,7 +1153,7 @@ def _main(argv: list[str] | None = None) -> int:
         batcher=batcher, adapters=adapters,
         pipeline_depth=args.pipelineDepth,
         trace_steps=args.traceSteps and args.tracing,
-        prefix_cache=prefix_cache,
+        prefix_cache=None if batcher is not None else prefix_cache,
         kv_layout=None if batcher is not None else args.kvLayout,
         kv_page_size=None if batcher is not None else (
             args.kvPageSize if args.kvLayout == "paged" else None

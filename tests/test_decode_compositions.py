@@ -5,6 +5,15 @@ The serving stack has grown orthogonal levers (sliding window, int8 KV
 cache, int8 weights, samplers with penalty, EOS); each has its own oracle
 tests, but interactions are where regressions hide — this sweep is cheap
 insurance that the cross-product keeps executing.
+
+The ``--draftPreset`` axis: speculative decoding now composes with the
+fast path (paged KV, prefix cache, pipelined rounds — pinned end to end
+in tests/test_spec_fastpath.py), so the sweep here pins the REMAINING
+boundary — every combination the speculative round genuinely cannot
+thread (per-request sampler overrides, logit-bias planes, per-request
+seeds, repetition penalty) fails with an actionable error message at
+submit/construction, never a silent fallback, while the identical
+submit sails through the plain batcher.
 """
 
 from dataclasses import replace
@@ -96,3 +105,82 @@ def test_attn_bias_composes_with_batching_and_int8_weights():
     # int8 weights perturb logits, not the mechanism: tokens must be valid
     # and the biased path must EXECUTE (shape errors/dropped biases crash)
     assert len(got) == 6 and all(0 <= t < cfg.vocab_size for t in got)
+
+
+# --- the --draftPreset axis --------------------------------------------------
+
+
+def _spec_batcher(params, **kw):
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    # self-draft: the composition gates don't depend on the draft's size
+    return SpeculativeBatcher(
+        params, BASE, params, BASE, n_slots=1, max_len=32, gamma=2,
+        chunked_prefill=8, **kw,
+    )
+
+
+@pytest.mark.parametrize("knob", ["sampler", "logit_bias", "seed"])
+@pytest.mark.parametrize("spec", [False, True])
+def test_per_request_knobs_compose_or_refuse_with_speculative(
+    spec, knob, base_params
+):
+    """Per-request knobs x speculative decoding: the plain batcher
+    accepts every one of them; the speculative batcher refuses each
+    with a pinned, actionable message (the round threads ONE sampler,
+    no bias planes, no per-row key streams) — and its engine-facing
+    capability flag agrees, so the HTTP layer 422s instead of silently
+    falling back."""
+    kwargs = {
+        "sampler": dict(sampler=Sampler(temperature=0.5, top_k=8)),
+        "logit_bias": dict(logit_bias={3: 1.0}),
+        "seed": dict(seed=7),
+    }[knob]
+    if not spec:
+        from k8s_gpu_device_plugin_tpu.models.batching import (
+            ContinuousBatcher,
+        )
+
+        cb = ContinuousBatcher(base_params, BASE, n_slots=1, max_len=32,
+                               chunked_prefill=8)
+        assert cb.submit([1, 2, 3], max_new=2, **kwargs) >= 0  # queued
+        return
+    sb = _spec_batcher(base_params)
+    message = {
+        "sampler": "per-request samplers",
+        "logit_bias": "logit_bias",
+        "seed": "per-request seeds",
+    }[knob]
+    with pytest.raises(ValueError, match=message):
+        sb.submit([1, 2, 3], max_new=2, **kwargs)
+    flag = {
+        "sampler": "per_request_sampler",
+        "logit_bias": "per_request_bias",
+        "seed": "per_request_seed",
+    }[knob]
+    assert getattr(sb, flag) is False
+
+
+def test_speculative_composition_matrix(base_params):
+    """The docs/serving.md composition matrix, pinned: repetition
+    penalty refuses at construction (actionable, not silent), while the
+    fast-path trio — paged KV (draft pool included), automatic prefix
+    cache, pipelined rounds — all CONSTRUCT together (their stream
+    exactness is pinned in tests/test_spec_fastpath.py)."""
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        _spec_batcher(
+            base_params,
+            sampler=Sampler(temperature=0.7, repetition_penalty=1.2),
+        )
+    pc = PrefixCache(BASE, buckets=(8, 16), budget_bytes=1 << 20)
+    sb = _spec_batcher(
+        base_params, prefix_cache=pc, kv_layout="paged", kv_page_size=8,
+        pipeline_depth=1,
+    )
+    assert sb.pool is not None and sb.draft_pool is not None
+    assert sb.prefix_cache is pc
+    assert sb.pipeline_depth == 1

@@ -505,7 +505,12 @@ def test_quantized_cache_refuses_paged(setup, cache_quant):
         )
 
 
-def test_speculative_batcher_refuses_paged(setup):
+def test_speculative_batcher_supports_paged(setup):
+    """Speculative decoding joined the paged fast path: construction
+    pages BOTH caches (the draft gets its own pool with the same
+    trap-page/refcount semantics). Stream exactness across the full
+    dense/paged x cache x pipeline matrix is pinned in
+    tests/test_spec_fastpath.py; here the old refusal is pinned GONE."""
     from k8s_gpu_device_plugin_tpu.models.spec_batching import (
         SpeculativeBatcher,
     )
@@ -513,13 +518,21 @@ def test_speculative_batcher_refuses_paged(setup):
     cfg, params = setup
     draft_cfg = LlamaConfig.tiny(n_layers=1)
     draft_params = init_params(jax.random.key(9), draft_cfg)
-    assert SpeculativeBatcher.supports_paged_kv is False
-    with pytest.raises(ValueError, match="does not support kv_layout"):
-        SpeculativeBatcher(
-            params, cfg, draft_params, draft_cfg,
-            n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
-            kv_layout="paged", kv_page_size=PS,
-        )
+    assert SpeculativeBatcher.supports_paged_kv is True
+    sb = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
+        kv_layout="paged", kv_page_size=PS,
+    )
+    assert sb.pool is not None and sb.draft_pool is not None
+    assert sb.draft_state.pages is not None
+    assert sb.draft_pool.page_size == PS
+    # the pools are independent: draft capacity defaults to the draft's
+    # dense-equivalent page count (same geometry, far fewer bytes)
+    assert sb.draft_pool.capacity == sb.pool.capacity
+    assert sb.kv_stats()["draft_reserved_bytes"] < (
+        sb.kv_stats()["target_reserved_bytes"]
+    )
 
 
 def test_page_size_must_divide_max_len(setup):

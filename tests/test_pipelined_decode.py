@@ -194,10 +194,12 @@ def test_pipeline_depth_validation(setup):
                               prompt_buckets=(8,), pipeline_depth=bad)
 
 
-def test_speculative_batcher_opts_out(setup):
-    """The draft+verify round needs each round's acceptance counts
-    before scheduling the next; the subclass forces the sync loop even
-    when asked for the pipeline."""
+def test_speculative_batcher_rides_the_pipeline(setup):
+    """The old opt-out is gone: acceptance counts live ON DEVICE
+    (lengths/budget advance inside the jitted round), so round t+1 can
+    dispatch before round t's readback — the subclass honors the
+    requested depth and defaults to the pipelined loop. Depth 0-vs-1
+    stream exactness is pinned in tests/test_spec_fastpath.py."""
     from k8s_gpu_device_plugin_tpu.models.spec_batching import (
         SpeculativeBatcher,
     )
@@ -210,7 +212,13 @@ def test_speculative_batcher_opts_out(setup):
         n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
         pipeline_depth=1,
     )
-    assert sb.pipeline_depth == 0
+    assert sb.pipeline_depth == 1
+    sb0 = SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=2, max_len=64, gamma=2, chunked_prefill=8,
+        pipeline_depth=0,
+    )
+    assert sb0.pipeline_depth == 0
 
 
 def test_steady_state_reuses_cached_device_arrays(setup):

@@ -201,7 +201,7 @@ def test_cache_requires_chunked_prefill_and_opt_out(setup):
                           prompt_buckets=BUCKETS, prefix_cache=pc)
 
     class _NoPrefix(ContinuousBatcher):
-        supports_prefix_cache = False  # the SpeculativeBatcher stance
+        supports_prefix_cache = False  # a subclass opting out
 
     with pytest.raises(ValueError, match="does not support"):
         _NoPrefix(params, cfg, n_slots=1, max_len=64,

@@ -118,13 +118,11 @@ def test_spec_batching_guards(setup):
     )
     with pytest.raises(ValueError, match="gamma"):
         sb.submit(list(range(1, 21)), max_new=10)  # 20+10+4 > 32
-    with pytest.raises(NotImplementedError):
-        from k8s_gpu_device_plugin_tpu.models.batching import (
-            precompute_prefix,
-        )
-
-        prefix = precompute_prefix(params, [1, 2, 3], cfg)
-        sb.submit([4, 5], max_new=2, prefix=prefix)
+    # shared prefixes are SUPPORTED now (the target serves the cached
+    # rows, the draft re-prefills them) — pinned end to end with the
+    # oracle comparison in tests/test_spec_fastpath.py
+    assert SpeculativeBatcher.supports_prefix_cache is True
+    assert SpeculativeBatcher.supports_paged_kv is True
 
 
 def test_speculative_engine_serves_over_http(setup):

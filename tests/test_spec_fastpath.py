@@ -1,0 +1,467 @@
+"""Speculative decoding on the fast serving path (models/spec_batching.py
++ paged KV + prefix cache + overlapped rounds).
+
+Three layers of claims:
+
+- **Bit-exactness inside the speculative matrix**: greedy token AND
+  logprob streams are identical across kv_layout {dense, paged} x
+  prefix cache {on, off} x pipeline_depth {0, 1}, over admit/retire/
+  cancel/stop/eviction interleavings — the paged gather reproduces the
+  dense verify view value-for-value, a cache hit replays the exact rows
+  a cold prefill computes (target aliased, draft re-prefilled on the
+  cold chunk grid), and the overlapped round only ever DROPS tokens.
+- **Greedy parity with the non-speculative path**: tokens equal the
+  plain ContinuousBatcher's (and the ``generate`` oracle) exactly at
+  f32; logprobs agree to float tolerance only — the T=gamma verify and
+  the T=1 decode are different XLA programs (the models/speculative.py
+  caveat), so the logprob pin across the two PATHS is allclose while
+  the pin across the speculative MATRIX is bitwise.
+- **Pool discipline**: the draft pool mirrors every admission with the
+  same trap-page/refcount semantics, drains at retirement, defers under
+  draft pool pressure, and prefix hits still move zero KV rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models import batching
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    precompute_prefix,
+)
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.spec_batching import SpeculativeBatcher
+from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+    PrefixCache,
+    prefix_kv_bytes,
+)
+
+BUCKETS = (8, 16, 32)
+PS = 16  # divides max_len=64; boundary 8 is page-UNALIGNED (COW case)
+GAMMA = 3
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # the same f32 configs as tests/test_spec_batching.py so the dense
+    # spec compiles are shared across the two modules; the paged twins
+    # compile once here
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    draft_cfg = LlamaConfig.tiny(n_layers=1, d_model=64, n_heads=4,
+                                 n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    draft_params = init_params(jax.random.key(1), draft_cfg)
+    return cfg, params, draft_cfg, draft_params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _spec(setup, layout, pc=None, depth=1, n_slots=2, **kw):
+    cfg, params, draft_cfg, draft_params = setup
+    return SpeculativeBatcher(
+        params, cfg, draft_params, draft_cfg,
+        n_slots=n_slots, max_len=64, gamma=GAMMA, chunked_prefill=8,
+        prompt_buckets=BUCKETS, pipeline_depth=depth, prefix_cache=pc,
+        kv_layout=layout, kv_page_size=PS if layout == "paged" else None,
+        **kw,
+    )
+
+
+# --- the matrix: dense/paged x cache on/off x depth 0/1 ---------------------
+#
+# One scheduling scenario per configuration: staggered waves behind two
+# shared system prompts (promotion, hits, a re-miss after eviction under
+# a deliberately tight byte budget), a mid-flight cancel, and a stop
+# sequence — interleavings identical across configurations by
+# construction, so completed streams must be bit-identical.
+
+
+def _scenario(setup, layout, depth, cache_on):
+    cfg = setup[0]
+    pc = None
+    if cache_on:
+        b = prefix_kv_bytes(cfg, 8) + prefix_kv_bytes(cfg, 16)
+        if layout == "paged":
+            from dataclasses import replace
+
+            b = prefix_kv_bytes(
+                replace(cfg, kv_layout="paged", kv_page_size=PS), 16
+            ) * 2
+        pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=b)
+    sb = _spec(setup, layout, pc=pc, depth=depth)
+    sys_a = _prompt(520, 17, cfg)
+    sys_b = _prompt(521, 18, cfg)
+    rids = []
+
+    def sub(base, tail_key, tail_n, new, stop=None):
+        p = base + _prompt(tail_key, tail_n, cfg)
+        rids.append(sb.submit(p, max_new=new, stop=stop))
+
+    # wave 1: two requests behind sys_a (promotes its boundaries)
+    sub(sys_a, 530, 5, 5)
+    sub(sys_a, 531, 4, 4)
+    for _ in range(7):
+        sb.step()
+    # wave 2: sys_a again (hit) + sys_b (miss -> promote -> evict under
+    # the tight budget)
+    sub(sys_a, 532, 6, 5)
+    sub(sys_b, 533, 5, 6)
+    for _ in range(4):
+        sb.step()
+    cancelled = rids[2]
+    sb.cancel(cancelled)
+    # wave 3: both prefixes again (hits + re-misses post-eviction); a
+    # stop sequence that can't fire exercises the matching
+    sub(sys_b, 534, 4, 4)
+    sub(sys_a, 535, 3, 5,
+        stop=[[cfg.vocab_size - 1, cfg.vocab_size - 1]])
+    sb.run()
+    streams = {
+        rid: (list(req.out), list(req.out_logp))
+        for rid, req in sb.done_requests.items()
+    }
+    if sb.pool is not None:
+        sb.pool.check()
+        sb.draft_pool.check()
+    return rids, cancelled, streams, pc, sb
+
+
+def test_spec_matrix_bit_identical_streams(setup):
+    """dense/depth0/cache-on is the reference; dense/depth1/cache-OFF
+    pins the cache and the overlap, paged/depth1/cache-on pins the
+    paged layout riding both. supports_* flags are pinned flipped."""
+    assert SpeculativeBatcher.supports_paged_kv is True
+    assert SpeculativeBatcher.supports_prefix_cache is True
+    runs = {
+        key: _scenario(setup, *key)
+        for key in [("dense", 0, True), ("dense", 1, False),
+                    ("paged", 1, True)]
+    }
+    ref_rids, ref_cancel, ref_streams, _, _ = runs[("dense", 0, True)]
+    for key, (rids, cancelled, streams, pc, sb) in runs.items():
+        assert rids == ref_rids and cancelled == ref_cancel
+        for rid in rids:
+            if rid == cancelled:
+                # the cancel lands at a run-dependent depth; the common
+                # prefix must still be bit-identical
+                toks, lps = streams[rid]
+                rt, rl = ref_streams[rid]
+                n = min(len(toks), len(rt))
+                assert toks[:n] == rt[:n], key
+                assert lps[:n] == rl[:n], key
+            else:
+                assert streams[rid][0] == ref_streams[rid][0], key
+                assert streams[rid][1] == ref_streams[rid][1], key
+        if pc is not None:  # the cache machinery must actually engage
+            assert pc.stats.promotions > 0 and pc.stats.hits > 0, key
+            assert pc.stats.evictions > 0, key
+        st = sb.spec_stats()
+        assert st["rounds"] > 0 and st["tokens_accepted"] > 0
+
+
+def test_spec_greedy_parity_with_plain_path(setup):
+    """The acceptance bar vs the NON-speculative path: same scenario
+    traffic through a plain ContinuousBatcher — tokens exactly equal
+    (f32), logprobs allclose (T=gamma verify vs T=1 decode are
+    different XLA programs; the models/speculative.py caveat)."""
+    cfg, params, _, _ = setup
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8,
+    )
+    sys_a = _prompt(520, 17, cfg)
+    sys_b = _prompt(521, 18, cfg)
+    plain = {}
+    for base, key, n, new in [(sys_a, 530, 5, 5), (sys_a, 531, 4, 4),
+                              (sys_b, 533, 5, 6), (sys_b, 534, 4, 4),
+                              (sys_a, 535, 3, 5)]:
+        p = base + _prompt(key, n, cfg)
+        rid = cb.submit(p, max_new=new)
+        plain[(key, n)] = rid
+    cb.run()
+    # 1:1 comparison (no cancel): every stream pinned to the plain
+    # path AND the generate oracle
+    sb = _spec(setup, "dense", depth=1)
+    spec_rids = {}
+    for base, key, n, new in [(sys_a, 530, 5, 5), (sys_b, 533, 5, 6),
+                              (sys_a, 535, 3, 5)]:
+        p = base + _prompt(key, n, cfg)
+        spec_rids[sb.submit(p, max_new=new)] = ((key, n), p, new)
+    sb.run()
+    for rid, (pk, p, new) in spec_rids.items():
+        spec_req = sb.done_requests[rid]
+        plain_req = cb.done_requests[plain[pk]]
+        assert spec_req.out == plain_req.out, pk
+        assert spec_req.out == _oracle(params, p, cfg, new), pk
+        np.testing.assert_allclose(
+            np.asarray(spec_req.out_logp), np.asarray(plain_req.out_logp),
+            atol=1e-4, rtol=1e-4,
+        )
+
+
+def test_spec_manual_prefix_supported(setup):
+    """submit(prefix=...) stops being refused: the target serves the
+    precomputed rows, the draft re-prefills them, and the stream equals
+    the full-prompt oracle."""
+    cfg, params, _, _ = setup
+    sb = _spec(setup, "dense")
+    sys_p = _prompt(540, 12, cfg)
+    prefix = precompute_prefix(params, sys_p, cfg,
+                               prompt_buckets=BUCKETS)
+    suffix = _prompt(541, 6, cfg)
+    rid = sb.submit(suffix, max_new=5, prefix=prefix)
+    out = sb.run()[rid]
+    assert out == _oracle(params, sys_p + suffix, cfg, 5)
+
+
+# --- pool discipline ---------------------------------------------------------
+
+
+def test_spec_paged_zero_copy_and_drained_pools(setup):
+    """Prefix hits move zero KV rows under the paged spec batcher (the
+    PR-4 claim, now holding with a draft cache in the loop), and BOTH
+    pools drain to exactly the surviving cache entries' pages."""
+    cfg, params, _, _ = setup
+    batching.reset_kv_copy_counts()
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 26)
+    sb = _spec(setup, "paged", pc=pc)
+    sys_p = _prompt(550, 20, cfg)
+    for k, n, new in [(551, 5, 5), (552, 4, 4)]:
+        p = sys_p + _prompt(k, n, cfg)
+        rid = sb.submit(p, max_new=new)
+        sb.run()
+        assert sb.done[rid] == _oracle(params, p, cfg, new)
+    assert pc.stats.hits >= 1 and pc.stats.promotions >= 1
+    counts = batching.kv_copy_counts()
+    assert counts["rows"] == 0, counts
+    sb.pool.check()
+    sb.draft_pool.check()
+    # target pool: only the promoted entries' pages survive retirement;
+    # the draft pool has no prefix entries, so it drains to zero
+    assert sb.draft_pool.in_use == 0
+    assert sb.pool.in_use > 0  # the cache's pins
+
+
+def test_spec_draft_pool_pressure_defers_then_admits(setup):
+    """A draft pool with room for ONE request: the second defers under
+    pool pressure (counted once) and admits after the first retires —
+    streams exact throughout, both pools drained after."""
+    cfg, params, _, _ = setup
+
+    class _Rec:
+        def __init__(self):
+            self.rejected = []
+
+        def on_kv_admission_rejected(self, reason):
+            self.rejected.append(reason)
+
+        def on_submit(self): ...
+        def on_prefill_chunk(self): ...
+        def on_first_token(self): ...
+        def on_step(self, *a): ...
+        def on_finish(self, reason): ...
+
+    rec = _Rec()
+    # per request: ceil((9 + 20 + 3)/16) = 2 draft pages; a 2-page draft
+    # pool (3 with trap) can hold exactly one at a time, while the
+    # target pool keeps dense-equivalent capacity
+    sb = _spec(setup, "paged", metrics=rec, draft_kv_pages=2 + 1)
+    p1, p2 = _prompt(560, 9, cfg), _prompt(561, 9, cfg)
+    r1 = sb.submit(p1, max_new=20)
+    r2 = sb.submit(p2, max_new=20)
+    results = sb.run()
+    assert results[r1] == _oracle(params, p1, cfg, 20)
+    assert results[r2] == _oracle(params, p2, cfg, 20)
+    assert rec.rejected.count("pool_pressure") == 1
+    sb.pool.check()
+    sb.draft_pool.check()
+    assert sb.pool.in_use == 0 and sb.draft_pool.in_use == 0
+    # a request outsizing the DRAFT pool is refused at submit
+    with pytest.raises(ValueError, match="draft KV pages"):
+        sb.submit(_prompt(562, 20, cfg), max_new=25)
+    assert rec.rejected.count("request_too_large") == 1
+
+
+# --- the verify kernel -------------------------------------------------------
+
+
+def test_paged_verify_kernel_matches_gather(setup):
+    """ops/paged_attention.py's multi-query verify variant in interpret
+    mode vs the XLA gather reference — same table, same base positions,
+    windowed and unwindowed; plus the shape gates."""
+    from k8s_gpu_device_plugin_tpu.ops import paged_attention
+
+    b, ps, n_pages, hkv, hq, hd, npg, t = 3, 8, 16, 2, 8, 64, 4, 4
+    kp = jax.random.normal(
+        jax.random.key(1), (n_pages, ps, hkv, hd), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        jax.random.key(2), (n_pages, ps, hkv, hd), jnp.bfloat16
+    )
+    q = jax.random.normal(jax.random.key(3), (b, t, hq, hd), jnp.bfloat16)
+    table = jnp.asarray(
+        np.random.RandomState(0).choice(
+            np.arange(1, n_pages), (b, npg), replace=False
+        ),
+        jnp.int32,
+    )
+    base = jnp.asarray([5, 17, 27], jnp.int32)
+    assert paged_attention.supports_verify(q, kp, table,
+                                           require_pltpu=False)
+
+    def ref(window):
+        kd = kp[table].reshape(b, npg * ps, hkv, hd).astype(jnp.float32)
+        vd = vp[table].reshape(b, npg * ps, hkv, hd).astype(jnp.float32)
+        qf = q.astype(jnp.float32).reshape(b, t, hkv, hq // hkv, hd)
+        s = jnp.einsum("btkgd,bskd->btkgs", qf, kd) * hd ** -0.5
+        pos = jnp.arange(npg * ps)[None, None, None, None, :]
+        q_pos = (base[:, None, None, None, None]
+                 + jnp.arange(t)[None, :, None, None, None])
+        keep = pos <= q_pos
+        if window:
+            keep &= q_pos - pos < window
+        s = jnp.where(keep, s, -1e30)
+        pr = jax.nn.softmax(s, -1)
+        return jnp.einsum("btkgs,bskd->btkgd", pr, vd).reshape(
+            b, t, hq, hd
+        )
+
+    for window in (0, 12):
+        out = paged_attention.paged_verify_attention(
+            q, kp, vp, table, base, scale=hd ** -0.5, window=window,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref(window)),
+            atol=5e-2, rtol=5e-2,
+        )
+
+    # shape gates: T=1 belongs to the decode kernel, huge windows
+    # (prefill chunks) to the gather, ragged page sizes to nobody
+    assert not paged_attention.supports_verify(
+        jnp.zeros((b, 1, hq, hd), jnp.bfloat16), kp, table,
+        require_pltpu=False,
+    )
+    assert not paged_attention.supports_verify(
+        jnp.zeros((b, 32, hq, hd), jnp.bfloat16), kp, table,
+        require_pltpu=False,
+    )
+    assert not paged_attention.supports_verify(
+        q, jnp.zeros((n_pages, 12, hkv, hd), jnp.bfloat16), table,
+        require_pltpu=False,
+    )
+
+    # the routing gate: a T>1 paged read that is NOT a verify window (a
+    # small prefill chunk has the same shape) must stay on the bitwise
+    # XLA gather even under decode_attn="ragged" — only the explicit
+    # verify flag may route onto the flash kernel, whose accumulation
+    # is allclose-not-bitwise to the gather
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.generate import _cached_attention
+
+    cfg = LlamaConfig.tiny(n_layers=1, d_model=512, n_heads=8,
+                           n_kv_heads=2, d_ff=256)
+    vcfg = replace(cfg, kv_layout="paged", kv_page_size=ps,
+                   decode_attn="ragged")
+    chunk_like = _cached_attention(q, kp, vp, None, None, base, vcfg,
+                                   pages=table)
+    gather = _cached_attention(
+        q, kp, vp, None, None, base, replace(vcfg, decode_attn="auto"),
+        pages=table,
+    )
+    assert np.array_equal(
+        np.asarray(chunk_like, np.float32), np.asarray(gather, np.float32)
+    )
+    verified = _cached_attention(q, kp, vp, None, None, base, vcfg,
+                                 pages=table, verify=True)
+    np.testing.assert_allclose(
+        np.asarray(verified, np.float32), np.asarray(gather, np.float32),
+        atol=5e-2, rtol=5e-2,
+    )
+
+
+# --- metrics & health surfaces ----------------------------------------------
+
+
+def test_spec_metrics_surface():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.on_spec_round(4, [4, 2, 1])
+    g = reg.get_sample_value
+    pre = "tpu_serving"
+    assert g(f"{pre}_spec_rounds_total") == 1
+    assert g(f"{pre}_spec_tokens_drafted_total") == 12
+    assert g(f"{pre}_spec_tokens_accepted_total") == 7
+    assert g(f"{pre}_spec_accepted_per_round_count") == 3
+    assert g(f"{pre}_spec_accepted_per_round_sum") == 7
+    m.close()
+    m2 = ServingMetrics(registry=reg)  # names freed by close()
+    m2.close()
+
+
+def test_spec_stats_and_kv_comparability(setup):
+    """The two health satellites: spec_stats() exposes acceptance, and
+    kv_stats() folds the draft cache into reserved_bytes (with the
+    target/draft split kept visible) so spec-vs-plain HBM comparisons
+    are apples-to-apples."""
+    cfg, params, draft_cfg, _ = setup
+    from k8s_gpu_device_plugin_tpu.models.paging import kv_token_bytes
+
+    sb = _spec(setup, "paged")
+    p = _prompt(570, 6, cfg)
+    rid = sb.submit(p, max_new=6)
+    assert sb.run()[rid] == _oracle(params, p, cfg, 6)
+    st = sb.spec_stats()
+    assert st["gamma"] == GAMMA and st["rounds"] > 0
+    assert 0.0 < st["acceptance_rate"] <= 1.0
+    assert 1.0 <= st["accepted_per_round"] <= GAMMA
+    kv = sb.kv_stats()
+    assert kv["reserved_bytes"] == (
+        kv["target_reserved_bytes"] + kv["draft_reserved_bytes"]
+    )
+    assert kv["draft"]["layout"] == "paged"
+    assert kv["draft"]["reserved_bytes"] == (
+        sb.draft_pool.n_pages * PS * kv_token_bytes(draft_cfg)
+    )
+    # dense spec reports the draft's dense reservation the same way
+    sd = _spec(setup, "dense")
+    kvd = sd.kv_stats()
+    assert kvd["draft"]["layout"] == "dense"
+    assert kvd["reserved_bytes"] == (
+        kvd["target_reserved_bytes"] + kvd["draft_reserved_bytes"]
+    )
+
+
+def test_engine_health_reports_spec(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params, _, _ = setup
+    sb = _spec(setup, "paged")
+    engine = InferenceEngine(params, cfg, batcher=sb)
+    try:
+        stats = engine.stats()
+        assert stats["spec"]["gamma"] == GAMMA
+        assert "acceptance_rate" in stats["spec"]
+        assert stats["kv"]["draft_reserved_bytes"] > 0
+    finally:
+        engine.shutdown()
