@@ -25,13 +25,35 @@ test: native-test
 # Static gate: ruff (when installed — hermetic containers may lack it;
 # compileall still catches syntax/indentation rot everywhere) plus a
 # full bytecode compile of the package, tests, and top-level drivers.
+# The rule set is PINNED in pyproject.toml [tool.ruff] so lint means the
+# same thing on every machine; when ruff is absent the pinned selection
+# is printed so the skip is visible in CI logs, not silent.
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check k8s_gpu_device_plugin_tpu tests bench.py tools; \
 	else \
-		echo "lint: ruff not installed; running compileall only"; \
+		echo "lint: ruff not installed — SKIPPING the pinned rule set" \
+		     "(pyproject.toml [tool.ruff.lint]):"; \
+		python -c "import re; \
+s = open('pyproject.toml').read(); \
+f = lambda key: (lambda m: ' '.join(re.findall(r'\"([A-Z0-9]+)\"', m.group(1))) \
+    if m else '(not found in pyproject.toml)')( \
+    re.search(r'(?m)^' + key + r' = \[(.*?)\]', s, re.S)); \
+print('lint:   select =', f('select')); \
+print('lint:   ignore =', f('ignore'))"; \
+		echo "lint: compileall + make analyze still gate"; \
 	fi
 	python -m compileall -q k8s_gpu_device_plugin_tpu tests tools bench.py
+
+# Project-invariant static analysis (tools/graftlint): six AST checkers
+# encoding the serving-stack invariants PRs 1-5 established (hot-path
+# H2D, jit recompile hazards, tracer leaks, thread ownership, page
+# refcount pairing, blocking-in-async). Exits non-zero on any new
+# violation; GRAFTLINT_STRICT=1 also refuses a stale baseline. Last
+# stdout line is a one-line JSON summary (the bench-runner convention).
+# ANALYZE_PATHS overrides the analyzed file set (used by fixture tests).
+analyze:
+	python -m tools.graftlint $(ANALYZE_PATHS)
 
 san-test:
 	$(MAKE) -C $(NATIVE_DIR) san-test
@@ -42,8 +64,10 @@ san-test:
 # loops end to end), the prefix-cache smoke (radix trie + cached-vs-cold
 # serve A/B on CPU), and the Python suite (which includes the manager
 # concurrency stress in tests/test_manager_stress.py).
-ci: lint native native-test san-test bench-host-overhead bench-prefix-cache \
-	bench-paged-kv bench-spec
+# analyze runs right after lint — fail fast on invariant regressions
+# BEFORE the (slow) native builds and CPU benches burn their minutes.
+ci: lint analyze native native-test san-test bench-host-overhead \
+	bench-prefix-cache bench-paged-kv bench-spec
 	python -m pytest tests/ -q
 
 bench:
@@ -83,7 +107,7 @@ bench-spec:
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
-.PHONY: all native native-test proto lint san-test ci test bench \
+.PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
 	clean watch
 

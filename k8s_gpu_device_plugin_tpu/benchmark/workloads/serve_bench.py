@@ -53,7 +53,6 @@ import time
 from dataclasses import dataclass
 
 import jax
-import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.models.batching import (
     ContinuousBatcher,

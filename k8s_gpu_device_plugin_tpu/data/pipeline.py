@@ -136,11 +136,11 @@ class DataLoader:
         )
         inputs, targets = local[:, :-1], local[:, 1:]
         if jax.process_count() > 1:
-            make = lambda x: jax.make_array_from_process_local_data(
+            make = lambda x: jax.make_array_from_process_local_data(  # noqa: E731
                 self._sharding, x
             )
         else:
-            make = lambda x: jax.device_put(x, self._sharding)
+            make = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
         return {"inputs": make(inputs), "targets": make(targets)}
 
     def __iter__(self) -> Iterator[dict]:

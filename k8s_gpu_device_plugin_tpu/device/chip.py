@@ -15,7 +15,7 @@ topology-aware allocator.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Iterable, Iterator
 
 HEALTHY = "Healthy"      # pluginapi.Healthy

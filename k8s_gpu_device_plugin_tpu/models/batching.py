@@ -143,7 +143,7 @@ def init_batch_state(
     )
 
 
-def _scatter_rows_paged(cache, rows, row, p: int, ps: int):
+def _scatter_rows_paged(cache, rows, row, p: int, ps: int):  # graftlint: hot-path=traced
     """Scatter ``p`` contiguous single-row cache rows (L, 1, p, H, d)
     through a slot's page table ``row``: token i lands in page
     ``row[i // ps]`` at offset ``i % ps``. The one definition of the
@@ -244,7 +244,7 @@ def prefill_insert(
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def decode_step(
+def decode_step(  # graftlint: hot-path
     params,
     state: BatchState,
     allowed: jax.Array,  # (B,) bool: host-side membership gate per slot
@@ -513,8 +513,8 @@ class ContinuousBatcher:
         else:
             self.adapter_names = ()
         self.n_adapters = len(self.adapter_names)
-        self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs
-        self._bias_cache: jax.Array | None = None  # (n_slots, V), like knobs
+        self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs; owner: engine
+        self._bias_cache: jax.Array | None = None  # (n_slots, V), like knobs; owner: engine
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -626,8 +626,8 @@ class ContinuousBatcher:
         # capacity the dense layout would (plus the trap page), so
         # flipping the layout alone can never ADMIT less — operators
         # shrink kv_pages to overcommit HBM against live tokens.
-        self.pool: PagePool | None = None
-        self._slot_pages: dict[int, list[int]] = {}
+        self.pool: PagePool | None = None  # owner: engine
+        self._slot_pages: dict[int, list[int]] = {}  # owner: engine
         n_pages = 0
         if cfg.kv_layout == "paged":
             if kv_pages < 0:
@@ -639,16 +639,17 @@ class ContinuousBatcher:
             per_slot = max_len // cfg.kv_page_size
             n_pages = int(kv_pages) if kv_pages > 0 else n_slots * per_slot + 1
             self.pool = PagePool(n_pages, cfg.kv_page_size)
+        # owner: engine (snapshot via kv_stats() for cross-thread reads)
         self.state = init_batch_state(cfg, n_slots, max_len, seed,
                                       n_pages=n_pages)
-        self.pending: list[_Request] = []
-        self.running: dict[int, _Request] = {}    # slot -> decoding request
-        self.prefilling: dict[int, _Request] = {}  # slot -> mid-prefill req
-        self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start
-        self.done: dict[int, list[int]] = {}
+        self.pending: list[_Request] = []  # owner: engine
+        self.running: dict[int, _Request] = {}    # slot -> decoding request; owner: engine
+        self.prefilling: dict[int, _Request] = {}  # slot -> mid-prefill req; owner: engine
+        self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start; owner: engine
+        self.done: dict[int, list[int]] = {}  # owner: engine
         # full retired _Request objects (tokens + logprobs); the serving
         # engine pops from BOTH maps per request to keep memory bounded
-        self.done_requests: dict[int, "_Request"] = {}
+        self.done_requests: dict[int, "_Request"] = {}  # owner: engine
         self._next_rid = 0
         # optional metrics.ServingMetrics (or anything with its hooks);
         # None = zero overhead, no prometheus dependency on this path
@@ -664,12 +665,12 @@ class ContinuousBatcher:
         # cached (n_slots, 4) device array for the decode step; running-
         # set membership changes (admit/retire/cancel) invalidate it, so
         # steady-state decode pays no per-token host build + transfer
-        self._knobs_cache: jax.Array | None = None
+        self._knobs_cache: jax.Array | None = None  # owner: engine
         # same lifecycle for the (n_slots,) membership mask and seeds:
         # allowed is pure running-set membership (budget gating moved
         # into BatchState), so it too only changes on admit/retire/cancel
-        self._allowed_cache: jax.Array | None = None
-        self._seeds_cache: jax.Array | None = None
+        self._allowed_cache: jax.Array | None = None  # owner: engine
+        self._seeds_cache: jax.Array | None = None  # owner: engine
         # pipeline_depth=1 (the serving default): each step() dispatches
         # decode step t+1 BEFORE reading step t back, so host per-token
         # work (stop matching, retirement, metrics, streaming) overlaps
@@ -684,7 +685,7 @@ class ContinuousBatcher:
         self.pipeline_depth = int(pipeline_depth)
         # the (at most one) dispatched-but-unread decode step:
         # (step_no, emitted, logps) device arrays
-        self._inflight: tuple | None = None
+        self._inflight: tuple | None = None  # owner: engine
         self._step_no = 0
         # process-global tracer: every site below guards on .enabled, so
         # the default-off path is one attribute read per potential span
@@ -1460,14 +1461,20 @@ class ContinuousBatcher:
             slot_pages = self._slot_pages[req.slot]
 
             def extract(p: int):
+                # nothing between the incref and the return: a call in
+                # that window (the gauge push used to sit here) could
+                # raise before the cache records the page refs, leaking
+                # them — graftlint's refcount-pairing rule
                 ids = tuple(slot_pages[: self.pool.pages_for_tokens(p)])
                 self.pool.incref(ids)
-                self._report_kv_gauges()
                 return ids
 
             self.prefix_cache.on_prefill_done(
                 req.prompt, req.adapter, extract
             )
+            # gauges once per promotion pass (not per boundary), after
+            # every extracted boundary's refs are owned by cache entries
+            self._report_kv_gauges()
             return
         slot = jnp.int32(req.slot)
 
@@ -1652,7 +1659,7 @@ class ContinuousBatcher:
                 len(self.prefilling),
             )
 
-    def _decode_dispatch(self, allowed):
+    def _decode_dispatch(self, allowed):  # graftlint: hot-path
         """Enqueue ONE device decode dispatch and return the result
         arrays a later :meth:`_apply_decode_result` consumes. The
         overridable device half of a decode step: the speculative
@@ -1667,7 +1674,7 @@ class ContinuousBatcher:
         )
         return (emitted, logps)
 
-    def _apply_decode_result(self, arrs) -> int:
+    def _apply_decode_result(self, arrs) -> int:  # graftlint: hot-path
         """The host half: sync ``arrs`` (one host sync) and run the
         per-token work. Returns tokens emitted."""
         emitted, logps = jax.device_get(arrs)
@@ -1678,7 +1685,7 @@ class ContinuousBatcher:
         batch (the whole decode path at pipeline_depth=0)."""
         return self._apply_decode_result(self._decode_dispatch(allowed))
 
-    def _dispatch_decode(self, allowed) -> None:
+    def _dispatch_decode(self, allowed) -> None:  # graftlint: hot-path
         """Enqueue one decode step WITHOUT waiting for its results: the
         result device arrays are parked in ``_inflight`` (their D2H
         copies started immediately) and read by a later ``_read_step``.
@@ -1709,7 +1716,7 @@ class ContinuousBatcher:
         self._inflight = (self._step_no, arrs, tuple(self.running))
         self._step_no += 1
 
-    def _read_step(self, inflight) -> int:
+    def _read_step(self, inflight) -> int:  # graftlint: hot-path
         """Read one previously dispatched step back and run the host
         per-token work for it. ``inflight`` is a ``_dispatch_decode``
         record or None (the pipeline's first step has nothing to read)."""
@@ -1760,7 +1767,7 @@ class ContinuousBatcher:
                 on_flush()
         return self._read_step(prev)
 
-    def _apply_emitted(self, emitted, logps) -> int:
+    def _apply_emitted(self, emitted, logps) -> int:  # graftlint: hot-path
         """Host per-token work for one read-back step: append tokens and
         logprobs, match stop sequences, retire finished requests, feed
         the inter-token histogram. Slots not in ``running`` (retired or

@@ -130,7 +130,7 @@ def _quantize_kv(x: jax.Array, qdtype=None) -> tuple[jax.Array, jax.Array]:
     return quantize_int8(x, axis=-1)
 
 
-def _cache_write(cache, scale, x, length, pages=None, page_size=0):
+def _cache_write(cache, scale, x, length, pages=None, page_size=0):  # graftlint: hot-path=traced
     """Write T new tokens' K or V at ``length``; quantizing to the
     cache's own dtype when it is int8/int4 (scale is the matching scale
     plane, else None).
@@ -175,7 +175,7 @@ def _cache_write(cache, scale, x, length, pages=None, page_size=0):
     return write(cache, q, length), write(scale, s, length)
 
 
-def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
+def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlint: hot-path=traced
                       cfg: LlamaConfig, pages=None, verify=False):
     """q: (B, T, Hq, hd) attends over cache[:, :max_len] masked to
     positions < length + T (rows are the T new tokens at absolute

@@ -24,7 +24,6 @@ model-family API (train + generate + ... + finetune).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Callable
 
 import jax

@@ -50,12 +50,12 @@ class PagePool:
         self.page_size = int(page_size)
         # LIFO free list: recently freed pages are re-used first (their
         # pool rows are likelier to still be warm in any cache hierarchy)
-        self._free: list[int] = list(range(n_pages - 1, 0, -1))
-        self._refs: dict[int, int] = {}
+        self._free: list[int] = list(range(n_pages - 1, 0, -1))  # owner: engine
+        self._refs: dict[int, int] = {}  # owner: engine
         #: high-water mark of pages simultaneously in use (the serve
         #: bench's kv_hbm_saved_pct denominator needs the peak, not the
         #: instantaneous value)
-        self.peak_in_use = 0
+        self.peak_in_use = 0  # owner: engine
 
     # --- capacity views ---
 

@@ -111,7 +111,7 @@ from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 
 @partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma", "sampler"),
          donate_argnums=(2, 3))
-def spec_decode_step(
+def spec_decode_step(  # graftlint: hot-path
     params_t,
     params_d,
     state: BatchState,        # target-side state (lengths are THE truth)
@@ -319,11 +319,11 @@ class SpeculativeBatcher(ContinuousBatcher):
         # model's layers/heads). Refcounts exist for symmetry but no
         # draft prefix entries ever share pages — pages free exactly at
         # slot retirement.
-        self.draft_pool: PagePool | None = None
-        self._draft_slot_pages: dict[int, list[int]] = {}
+        self.draft_pool: PagePool | None = None  # owner: engine
+        self._draft_slot_pages: dict[int, list[int]] = {}  # owner: engine
         # slot -> pending draft-backfill chunk starts (prefix
         # admissions; drained one chunk per step by _prefill_one_chunk)
-        self._draft_backfill: dict[int, list[int]] = {}
+        self._draft_backfill: dict[int, list[int]] = {}  # owner: engine
         n_draft_pages = 0
         if self.cfg.kv_layout == "paged":
             if draft_kv_pages < 0:
@@ -337,6 +337,7 @@ class SpeculativeBatcher(ContinuousBatcher):
                 else n_slots * per_slot + 1
             )
             self.draft_pool = PagePool(n_draft_pages, self.cfg.kv_page_size)
+        # owner: engine (kv_stats() snapshots it for /v1/health)
         self.draft_state = init_batch_state(
             self.draft_cfg, n_slots, max_len, n_pages=n_draft_pages
         )
@@ -344,9 +345,9 @@ class SpeculativeBatcher(ContinuousBatcher):
         # hooks): rounds that had >= 1 active slot, gamma-proposals
         # drafted, and device-side accepted counts (bonus included;
         # host truncation on EOS/stop/budget does not un-count them)
-        self._spec_rounds = 0
-        self._spec_drafted = 0
-        self._spec_accepted = 0
+        self._spec_rounds = 0  # owner: engine
+        self._spec_drafted = 0  # owner: engine
+        self._spec_accepted = 0  # owner: engine
         if self.metrics is not None:
             # re-push the reservation gauge now that kv_stats() can see
             # the draft cache: spec-vs-plain HBM must be apples-to-apples
@@ -622,7 +623,7 @@ class SpeculativeBatcher(ContinuousBatcher):
 
     # --- the decode seams: one draft+verify round per step ---
 
-    def _decode_dispatch(self, allowed):
+    def _decode_dispatch(self, allowed):  # graftlint: hot-path
         # The submit-side gamma reservation guarantees room: a running
         # slot has len(out) < max_new, so length + gamma <= max_len.
         for slot, req in self.running.items():
@@ -637,7 +638,7 @@ class SpeculativeBatcher(ContinuousBatcher):
         )
         return (emitted, counts, logps)
 
-    def _apply_decode_result(self, arrs) -> int:
+    def _apply_decode_result(self, arrs) -> int:  # graftlint: hot-path
         emitted, counts, logps = jax.device_get(arrs)  # one sync per round
         n_emitted = 0
         # acceptance accounting from the DEVICE counts, not the running

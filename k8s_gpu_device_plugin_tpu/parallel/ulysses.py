@@ -16,7 +16,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP

@@ -23,7 +23,8 @@ OpenAI semantics honored beyond the envelope: a matched stop sequence is
 NEVER part of the returned text (the native API keeps it, like EOS) —
 non-streamed responses trim the matched suffix, and streams hold back
 the last ``max(stop)`` tokens (a suffix match can span exactly that
-many) until they can no longer complete a stop match. Sampling: ``temperature``/``top_p`` present builds a
+many) until they can no longer complete a stop match. Sampling:
+``temperature``/``top_p`` present builds a
 per-request Sampler (the absent knob gets OpenAI's 1.0 default); neither
 present runs the server's default sampler, so a speculative engine
 (shared sampler) still serves knob-less requests instead of 422ing all.
@@ -701,9 +702,15 @@ class _OpenAIRoutes:
 
         try:
             if chat:
-                role = {"index": 0, "finish_reason": None,
-                        "delta": {"role": "assistant"}}
-                await resp.write(f"data: {json.dumps({'id': oai_id, 'object': chunk_object, 'created': created, 'model': c['model'], 'choices': [role]})}\n\n".encode())
+                role_evt = {
+                    "id": oai_id, "object": chunk_object,
+                    "created": created, "model": c["model"],
+                    "choices": [{"index": 0, "finish_reason": None,
+                                 "delta": {"role": "assistant"}}],
+                }
+                await resp.write(
+                    f"data: {json.dumps(role_evt)}\n\n".encode()
+                )
             while True:
                 item = await q.get()
                 if item is None:

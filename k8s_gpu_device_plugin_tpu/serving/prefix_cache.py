@@ -182,8 +182,8 @@ class PrefixCache:
             raise ValueError("prefix cache needs a non-empty bucket ladder")
         if self.min_hits < 1:
             raise ValueError(f"min_hits must be >= 1, got {self.min_hits}")
-        self._roots: dict[int, _Node] = {}       # adapter -> tree root
-        self._lru: "OrderedDict[_Node, None]" = OrderedDict()
+        self._roots: dict[int, _Node] = {}  # adapter root; owner: engine
+        self._lru: "OrderedDict[_Node, None]" = OrderedDict()  # owner: engine
         self._tracer = get_tracer()
 
     # --- submit side ---

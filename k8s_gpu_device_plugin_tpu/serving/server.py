@@ -174,7 +174,7 @@ class InferenceEngine:
 
     # --- request side (event loop thread) ---
 
-    def submit(
+    def submit(  # graftlint: cross-thread
         self, prompt: list[int], max_new: int,
         stop: list[list[int]] | None = None,
         sampler: Sampler | None = None,
@@ -237,7 +237,7 @@ class InferenceEngine:
         self._work.set()
         return eid, q
 
-    def cancel(self, eid: int) -> None:
+    def cancel(self, eid: int) -> None:  # graftlint: cross-thread
         """Thread-safe: queue a cancellation; the engine thread applies it
         between steps (a disconnected client must free its slot instead of
         decoding to the token budget). Unknown/finished eids are no-ops."""
@@ -245,14 +245,14 @@ class InferenceEngine:
             self._cancelq.append(eid)
         self._work.set()
 
-    def pop_request_info(self, eid: int) -> dict:
+    def pop_request_info(self, eid: int) -> dict:  # graftlint: cross-thread
         """Per-request wrap-up facts recorded at retirement (empty dict
         for unknown/aged-out eids). Pop-once: the handler that owns the
         stream consumes it."""
         with self._lock:
             return self._finished_info.pop(eid, {})
 
-    def stats(self) -> dict:
+    def stats(self) -> dict:  # graftlint: cross-thread
         # approximate cross-thread reads (GIL-consistent lengths)
         with self._lock:
             queued_local = len(self._subq)

@@ -35,6 +35,72 @@ except Exception:  # noqa: BLE001 - best effort; devices check below is the gate
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# --- hang visibility ------------------------------------------------------
+
+import faulthandler  # noqa: E402
+import threading as _threading  # noqa: E402
+
+# Crash stacks (SIGSEGV/SIGABRT — the intermittent jaxlib compile
+# segfault class documented on the fixtures below) always print with
+# tracebacks instead of a bare signal death.
+faulthandler.enable()
+
+# Dump-on-timeout: the tier-1 gate wraps the suite in `timeout -k 870`,
+# which SIGKILLs a deadlocked run with no diagnostics — a stress-test
+# deadlock used to eat the whole budget and die silently. Two layers:
+#
+# - pytest's own faulthandler plugin (faulthandler_timeout=300 in
+#   pyproject.toml) dumps all thread stacks when a single test phase
+#   hangs. It owns CPython's ONE dump_traceback_later slot (armed per
+#   test, cancelled after), so this file must not use that API — a
+#   conftest-armed timer would be silently disarmed at test #1.
+# - a daemon threading.Timer here covers everything OUTSIDE a test
+#   phase (collection, session-fixture finalizers): shortly before the
+#   tier-1 wall it dumps every thread's stack via
+#   faulthandler.dump_traceback. A Python-level timer cannot fire if a
+#   C extension deadlocks while HOLDING the GIL — pytest's C-side timer
+#   covers that case for test bodies — but it survives pytest's
+#   arm/cancel cycle, which the singleton API does not.
+#
+# The dump goes to stderr AND to .hang_dump.log at the repo root:
+# pytest's fd-level capture owns fd 2 by the time this conftest loads,
+# and a SIGKILLed run never replays its capture tmpfile — the log file
+# is what survives the kill. A healthy run never creates it. Noise-
+# safe either way: exit codes are unaffected.
+_HANG_DUMP_S = float(os.environ.get("GRAFT_HANG_DUMP_SECONDS", "840"))
+_HANG_DUMP_FILE = os.environ.get(
+    "GRAFT_HANG_DUMP_FILE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".hang_dump.log"),
+)
+if _HANG_DUMP_S > 0:
+    def _dump_stacks_before_the_wall() -> None:
+        msg = (
+            f"\n=== conftest hang watchdog: {_HANG_DUMP_S:.0f}s elapsed, "
+            "dumping all thread stacks before the tier-1 timeout kill "
+            f"(also persisted to {_HANG_DUMP_FILE}) ===\n"
+        )
+        targets = [sys.stderr]
+        try:
+            targets.append(open(_HANG_DUMP_FILE, "w"))
+        except OSError:
+            pass
+        for t in targets:
+            try:
+                t.write(msg)
+                t.flush()
+                faulthandler.dump_traceback(all_threads=True, file=t)
+                if t is not sys.stderr:
+                    t.close()
+            except Exception:  # noqa: BLE001 - diagnostics must not raise
+                pass
+
+    _hang_timer = _threading.Timer(_HANG_DUMP_S,
+                                   _dump_stacks_before_the_wall)
+    _hang_timer.daemon = True  # never outlives a finished run
+    _hang_timer.start()
+
+
 # --- shared fixtures ------------------------------------------------------
 
 import logging  # noqa: E402

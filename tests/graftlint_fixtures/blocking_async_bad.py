@@ -1,0 +1,17 @@
+# ruff: noqa
+"""Firing fixture: blocking work on the event loop."""
+import subprocess
+import time
+
+import jax
+
+
+async def handler(request):
+    time.sleep(0.5)                  # BAD: stalls every stream
+    jax.device_get(request.arr)      # BAD: device sync on the loop
+    request.arr.block_until_ready()  # BAD: same, method form
+    subprocess.run(["ls"])           # BAD: sync subprocess
+    request.task.result()            # BAD: concurrent.futures wait
+    request.stop_event.wait()        # BAD: threading.Event wait
+    with open("/tmp/x") as f:        # BAD: sync file I/O
+        return f.read()

@@ -1,0 +1,12 @@
+# ruff: noqa
+"""Non-firing twin: awaits and executor hops only."""
+import asyncio
+
+
+async def handler(request, embedder, ids):
+    await asyncio.sleep(0.1)
+    loop = asyncio.get_running_loop()
+    vec = await loop.run_in_executor(None, embedder.embed, ids)
+    item = await request.queue.get()
+    await request.stop_event.wait()  # asyncio.Event: the awaited twin
+    return vec, item

@@ -1,0 +1,20 @@
+# ruff: noqa
+"""Firing fixture: per-step H2D transfers inside registered hot paths."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Batcher:
+    def _decode_dispatch(self, allowed):  # graftlint: hot-path
+        knobs = jnp.asarray(self._knob_list)       # BAD: per-step H2D
+        dev = jax.device_put(np.asarray(allowed))  # BAD: two more
+        mask = jnp.zeros(4, bool)                  # BAD: host-side build
+        return self.step(knobs, dev, mask)
+
+    def step(self, *args):  # graftlint: hot-path
+        return args
+
+    def _apply_decode_result(self, arrs):  # graftlint: hot-path
+        self._budget -= 1  # BAD: host scalar carry, re-fed to a hot call
+        return self.step(self._budget)

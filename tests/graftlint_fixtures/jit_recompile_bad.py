@@ -1,0 +1,49 @@
+# ruff: noqa
+"""Firing fixture: jit call sites that defeat the compile cache."""
+from functools import partial
+
+import jax
+
+
+def step(x):
+    return x
+
+
+def per_call(x):
+    return jax.jit(lambda v: v + 1)(x)  # BAD: built-and-invoked, cache dies
+
+
+def in_loop(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(step)  # BAD: rebuilt (empty cache) every iteration
+        out.append(f(x))
+    return out
+
+
+class Engine:
+    @jax.jit
+    def decode(self, state):  # BAD: jit over a method hashes/traces self
+        return state
+
+    def make(self):
+        @partial(jax.jit, static_argnames=("cfg",))
+        def inner(x):  # BAD: static names a missing param; closes over self
+            return x + self.bias
+
+        return inner
+
+
+@partial(jax.jit, static_argnames=("shapes",))
+def bad_static(x, shapes: list = []):  # BAD: unhashable static default
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def bad_argnum(x, cfgs: dict = {}):  # BAD: positional static, unhashable
+    return x
+
+
+@partial(jax.jit, static_argnums=(5,))
+def bad_argnum_range(x):  # BAD: argnum points past the signature
+    return x

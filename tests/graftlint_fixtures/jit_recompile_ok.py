@@ -1,0 +1,27 @@
+# ruff: noqa
+"""Non-firing twin: module-scope wrappers, factories, hashable statics."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("n",))
+def step(x, n: int = 1):
+    return x * n
+
+
+_jitted = jax.jit(lambda v: v + 1)  # module scope: built exactly once
+
+
+def factory(cfg):
+    def inner(x):
+        return x
+
+    # factory pattern: the wrapper persists with the caller, its cache
+    # lives as long as the returned callable does
+    return jax.jit(inner)
+
+
+def drive(xs):
+    f = jax.jit(step)  # built once BEFORE the loop
+    return [f(x) for x in xs]

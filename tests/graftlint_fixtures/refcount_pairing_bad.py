@@ -1,0 +1,24 @@
+# ruff: noqa
+"""Firing fixture: page retains with no reachable release."""
+
+
+class Holder:
+    def grab(self, n):
+        self.pool.alloc(n)  # BAD: result discarded at refcount 1
+
+    def window(self, req, n):
+        pages = self.pool.alloc(n)
+        self.report()  # BAD: can raise before ownership is recorded
+        req._pages = pages
+
+    def orphan(self, n):
+        pages = self.pool.alloc(n)
+        return None  # BAD: returns WITHOUT the retained pages
+
+    def stash(self, n):
+        # BAD (at the ledger level): nothing ever reads '_lost' and
+        # decrefs, so the ledger is never drained
+        self._lost = self.pool.alloc(n)
+
+    def report(self):
+        pass

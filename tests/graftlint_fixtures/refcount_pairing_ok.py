@@ -1,0 +1,33 @@
+# ruff: noqa
+"""Non-firing twin: every retain reaches a store, return, or decref."""
+
+
+class Holder:
+    def reserve(self, req, n):
+        req._new_pages = self.pool.alloc(n)  # retain-and-record, atomic
+
+    def pin(self, req, entry):
+        pin = list(entry.page_ids)
+        self.pool.incref(pin)
+        req._pinned = pin  # next statement, no raise window
+
+    def extract(self, slot_pages, n):
+        ids = tuple(slot_pages[:n])
+        self.pool.incref(ids)
+        return ids  # ownership handed to the caller
+
+    def transfer(self, req, slot):
+        # ownership chain: _new_pages -> _slot_pages (drained below)
+        ids = req._new_pages
+        self._slot_pages[slot] = ids
+
+    def release(self, req, slot):
+        ids = self._slot_pages.pop(slot, None)
+        if ids:
+            self.pool.decref(ids)
+        pins = req._pinned
+        if pins:
+            self.pool.decref(pins)
+        more = req._new_pages
+        if more:
+            self.pool.decref(more)

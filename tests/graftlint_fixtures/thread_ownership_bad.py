@@ -1,0 +1,26 @@
+# ruff: noqa
+"""Firing fixture: engine-owned state touched from handler contexts."""
+
+
+class Batcher:
+    def __init__(self):
+        self.running = {}  # owner: engine
+        self.pool = None   # owner: engine
+
+    def kv_stats(self):
+        return {"pages_free": 0}
+
+
+class Server:
+    def __init__(self, cb):
+        self.cb = cb
+
+    async def health(self, request):
+        return {
+            "active": len(self.cb.running),           # OK: atomic len
+            "slots": list(self.cb.running.values()),  # BAD: iteration races
+            "free": self.cb.pool.free_pages,          # BAD: pool internals
+        }
+
+    def stats(self):  # graftlint: cross-thread
+        return dict(self.cb.running)  # BAD: cross-thread dict copy

@@ -1,0 +1,26 @@
+# ruff: noqa
+"""Non-firing twin: snapshots and atomic lengths only."""
+
+
+class Batcher:
+    def __init__(self):
+        self.running = {}  # owner: engine
+        self.pool = None   # owner: engine
+
+    def kv_stats(self):
+        # engine-side snapshot method: list() before iterating
+        return {"in_use": len(list(self.running))}
+
+
+class Server:
+    def __init__(self, cb):
+        self.cb = cb
+
+    async def health(self, request):
+        return {
+            "active": len(self.cb.running),  # atomic len: sanctioned
+            "kv": self.cb.kv_stats(),        # the snapshot boundary
+        }
+
+    def stats(self):  # graftlint: cross-thread
+        return {"queued": len(self.cb.running)}

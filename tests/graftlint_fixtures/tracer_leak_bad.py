@@ -1,0 +1,29 @@
+# ruff: noqa
+"""Firing fixture: host-state writes from inside traced bodies."""
+from functools import partial
+
+import jax
+
+_COUNTS = {"steps": 0}
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def bad_step(state, x):
+    _COUNTS["steps"] += 1  # BAD: module global mutated at trace time
+    state.cache = x        # BAD: attribute write on a parameter
+    return state
+
+
+@jax.jit
+def bad_global(x):
+    global _TOTAL          # BAD: global declared in a traced body
+    _TOTAL = x
+    return x
+
+
+def outer(xs):
+    def body(carry, x):
+        _COUNTS["last"] = x  # BAD: scan bodies trace like jit bodies
+        return carry, x
+
+    return jax.lax.scan(body, 0, xs)

@@ -1,0 +1,23 @@
+# ruff: noqa
+"""Non-firing twin: purely functional traced bodies; host writes stay
+on the host side of the jit boundary."""
+from functools import partial
+
+import jax
+
+_COUNTS = {"steps": 0}
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def good_step(state, x):
+    new = state.replace(cache=x)  # functional update, returned in carry
+    return new
+
+
+def outer(xs):
+    def body(carry, x):
+        return carry + x, x
+
+    total, ys = jax.lax.scan(body, 0, xs)
+    _COUNTS["steps"] += 1  # host code AFTER the traced call: fine
+    return total, ys

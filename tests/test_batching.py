@@ -204,7 +204,7 @@ def test_tp_sharded_batching_matches_unsharded():
     """Continuous batching with tp-sharded params (GSPMD propagates from
     the param shardings; no batching-specific annotations) must emit the
     same greedy tokens as the unsharded batcher."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from k8s_gpu_device_plugin_tpu.models.llama import param_shardings
     from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec, make_mesh

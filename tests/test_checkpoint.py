@@ -7,7 +7,6 @@ cadence policies hold.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

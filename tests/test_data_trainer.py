@@ -76,7 +76,7 @@ def test_loader_yields_sharded_batches(mesh):
 
 
 def test_loader_resume_reproduces_stream(mesh):
-    mk = lambda: DataLoader(
+    mk = lambda: DataLoader(  # noqa: E731
         SyntheticSource(100, seed=3), batch_size=8, seq_len=16, mesh=mesh,
         prefetch=0,
     )

@@ -6,7 +6,6 @@ import asyncio
 
 import aiohttp
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

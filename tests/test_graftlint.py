@@ -1,0 +1,365 @@
+"""graftlint: framework + per-checker fixture tests.
+
+Each checker must FIRE on its ``tests/graftlint_fixtures/*_bad.py``
+fixture and stay SILENT on the ``*_ok.py`` twin; the framework tests
+pin suppressions, baseline matching (incl. strict-mode stale refusal),
+and the CLI contract `make analyze` relies on (exit codes + the
+one-line JSON summary)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftlint.checkers import ALL_CHECKERS
+from tools.graftlint.core import (
+    load_baseline,
+    load_project,
+    run_checkers,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "graftlint_fixtures")
+
+CHECKER_FIXTURE = {
+    "hot-path-h2d": "hot_path_h2d",
+    "jit-recompile-hazard": "jit_recompile",
+    "tracer-leak": "tracer_leak",
+    "thread-ownership": "thread_ownership",
+    "refcount-pairing": "refcount_pairing",
+    "blocking-in-async": "blocking_async",
+}
+
+
+def _checker(name):
+    return next(c for c in ALL_CHECKERS if c.name == name)
+
+
+def _run_on(path, checkers=None):
+    project = load_project([path], root=REPO)
+    new, baselined, stale = run_checkers(
+        project, checkers or ALL_CHECKERS, baseline={}
+    )
+    return new
+
+
+# --- one firing and one non-firing fixture per checker --------------------
+
+
+@pytest.mark.parametrize("rule", sorted(CHECKER_FIXTURE))
+def test_checker_fires_on_bad_fixture(rule):
+    bad = os.path.join(FIXTURES, CHECKER_FIXTURE[rule] + "_bad.py")
+    found = _run_on(bad, [_checker(rule)])
+    assert found, f"{rule} must fire on its bad fixture"
+    assert all(v.rule == rule for v in found)
+
+
+@pytest.mark.parametrize("rule", sorted(CHECKER_FIXTURE))
+def test_checker_silent_on_ok_fixture(rule):
+    ok = os.path.join(FIXTURES, CHECKER_FIXTURE[rule] + "_ok.py")
+    found = _run_on(ok, [_checker(rule)])
+    assert found == [], f"{rule} false-positives: {found}"
+
+
+def test_ok_fixtures_clean_under_every_checker():
+    """The ok twins must survive the WHOLE suite, not just their own
+    rule (a fixture that trips a neighboring checker would poison the
+    pointed-at-fixtures failure test with the wrong rule)."""
+    for stem in sorted(CHECKER_FIXTURE.values()):
+        ok = os.path.join(FIXTURES, stem + "_ok.py")
+        assert _run_on(ok) == []
+
+
+# --- specific findings the fixtures encode --------------------------------
+
+
+def test_hot_path_flags_transfer_and_carry():
+    bad = os.path.join(FIXTURES, "hot_path_h2d_bad.py")
+    keys = {v.key for v in _run_on(bad, [_checker("hot-path-h2d")])}
+    assert "jnp.asarray" in keys
+    assert "jax.device_put" in keys
+    # the constructor family is H2D on the HOST side of a hot path...
+    assert "jnp.zeros" in keys
+    assert "carry:_budget" in keys
+    # ...but a trace-time constant in jitted/traced hot paths: the ok
+    # fixture's hot-path=traced function uses jnp.arange and stays
+    # silent (covered by test_checker_silent_on_ok_fixture)
+
+
+def test_thread_ownership_allows_atomic_len():
+    bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
+    found = _run_on(bad, [_checker("thread-ownership")])
+    # the len(self.cb.running) read on the same handler must NOT fire;
+    # the iteration/copy/pool reads must
+    assert len(found) == 3
+    assert {v.key for v in found} == {"running", "pool"}
+
+
+def test_thread_ownership_ignores_method_lookups(tmp_path):
+    """The owned-name match is receiver-blind, so METHOD calls that
+    merely share a name with owned state (task.done(), fut.wait()) must
+    not fire — only reads of the attribute as data do."""
+    f = tmp_path / "serving" / "h.py"
+    f.parent.mkdir()
+    f.write_text(
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self.done = {}  # owner: engine\n"
+        "async def h(task, cb):\n"
+        "    if task.done():\n"          # method call: exempt
+        "        return None\n"
+        "    return cb.done\n"           # data read: fires
+    )
+    found = _run_on(str(f), [_checker("thread-ownership")])
+    assert len(found) == 1 and found[0].line == 7
+
+
+def test_blocking_async_result_wait_only_when_not_awaited():
+    bad = os.path.join(FIXTURES, "blocking_async_bad.py")
+    keys = {v.key for v in _run_on(bad, [_checker("blocking-in-async")])}
+    assert "(...).result" in keys and "(...).wait" in keys
+    # the ok twin awaits its Event.wait(): covered by
+    # test_checker_silent_on_ok_fixture staying green
+
+
+def test_refcount_distinguishes_the_four_shapes():
+    bad = os.path.join(FIXTURES, "refcount_pairing_bad.py")
+    keys = {v.key for v in _run_on(bad, [_checker("refcount-pairing")])}
+    assert "alloc-dropped" in keys
+    assert any(k.startswith("raise-window") for k in keys)
+    assert "alloc-dropped-at-return" in keys
+    assert "undrained:_lost" in keys
+
+
+def test_jit_recompile_covers_each_hazard():
+    bad = os.path.join(FIXTURES, "jit_recompile_bad.py")
+    keys = {v.key for v in _run_on(bad, [_checker("jit-recompile-hazard")])}
+    assert keys >= {
+        "jit-immediately-invoked", "jit-in-loop", "jit-method",
+        "jit-closure-self", "static-missing:cfg",
+        "static-unhashable:shapes",
+        # static_argnums resolves to the positional param's name; an
+        # out-of-range index surfaces through the missing-param arm
+        "static-unhashable:cfgs", "static-missing:<argnum 5>",
+    }
+
+
+# --- suppressions ---------------------------------------------------------
+
+
+def test_inline_suppression_silences_one_line(tmp_path):
+    src = (
+        "import time\n"
+        "async def h(request):\n"
+        "    time.sleep(1)  # graftlint: disable=blocking-in-async\n"
+        "    time.sleep(2)\n"
+    )
+    f = tmp_path / "supp.py"
+    f.write_text(src)
+    found = _run_on(str(f), [_checker("blocking-in-async")])
+    assert len(found) == 1 and found[0].line == 4
+
+
+def test_comment_line_suppression_covers_next_line(tmp_path):
+    src = (
+        "import time\n"
+        "async def h(request):\n"
+        "    # graftlint: disable=blocking-in-async\n"
+        "    time.sleep(1)\n"
+    )
+    f = tmp_path / "supp2.py"
+    f.write_text(src)
+    assert _run_on(str(f), [_checker("blocking-in-async")]) == []
+
+
+def test_trailing_suppression_does_not_bleed_downward(tmp_path):
+    src = (
+        "import time\n"
+        "async def h(request):\n"
+        "    x = 1  # graftlint: disable=blocking-in-async\n"
+        "    time.sleep(1)\n"
+    )
+    f = tmp_path / "supp3.py"
+    f.write_text(src)
+    assert len(_run_on(str(f), [_checker("blocking-in-async")])) == 1
+
+
+# --- baseline semantics ---------------------------------------------------
+
+
+def _one_violation_project(tmp_path):
+    f = tmp_path / "v.py"
+    f.write_text(
+        "import time\nasync def h(request):\n    time.sleep(1)\n"
+    )
+    return load_project([str(f)], root=str(tmp_path))
+
+
+def test_baseline_matches_by_fingerprint_not_line(tmp_path):
+    project = _one_violation_project(tmp_path)
+    baseline = {"blocking-in-async": [{
+        "path": "v.py", "symbol": "h", "key": "time.sleep",
+        "reason": "fixture",
+    }]}
+    new, baselined, stale = run_checkers(
+        project, [_checker("blocking-in-async")], baseline
+    )
+    assert new == [] and len(baselined) == 1 and stale == []
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    project = _one_violation_project(tmp_path)
+    baseline = {"blocking-in-async": [
+        {"path": "v.py", "symbol": "h", "key": "time.sleep",
+         "reason": "fixture"},
+        {"path": "v.py", "symbol": "h", "key": "jax.device_get",
+         "reason": "fixed long ago: same file, no longer fires"},
+    ]}
+    _new, _baselined, stale = run_checkers(
+        project, [_checker("blocking-in-async")], baseline
+    )
+    assert len(stale) == 1 and stale[0]["key"] == "jax.device_get"
+
+
+def test_staleness_is_scoped_to_the_analyzed_paths(tmp_path):
+    """A subset run (ANALYZE_PATHS=...) must not misread baseline
+    entries for UNANALYZED files as fixed — strict mode over one module
+    would otherwise spuriously fail on the rest of the baseline."""
+    project = _one_violation_project(tmp_path)
+    baseline = {"blocking-in-async": [
+        {"path": "v.py", "symbol": "h", "key": "time.sleep",
+         "reason": "fixture"},
+        {"path": "elsewhere/not_analyzed.py", "symbol": "g",
+         "key": "time.sleep", "reason": "lives outside this subset"},
+    ]}
+    new, baselined, stale = run_checkers(
+        project, [_checker("blocking-in-async")], baseline
+    )
+    assert new == [] and len(baselined) == 1 and stale == []
+
+
+def test_baseline_count_bounds_same_fingerprint_violations(tmp_path):
+    """Fingerprints exclude line numbers (they drift), so the per-entry
+    ``count`` is what keeps a NEW violation with an old fingerprint
+    from hiding behind the grandfathered one."""
+    f = tmp_path / "v.py"
+    f.write_text(
+        "import time\n"
+        "async def h(request):\n"
+        "    time.sleep(1)\n"
+        "    time.sleep(2)\n"   # second site, same fingerprint
+    )
+    project = load_project([str(f)], root=str(tmp_path))
+    entry = {"path": "v.py", "symbol": "h", "key": "time.sleep",
+             "reason": "grandfathered single site", "count": 1}
+    new, baselined, stale = run_checkers(
+        project, [_checker("blocking-in-async")],
+        {"blocking-in-async": [entry]},
+    )
+    assert len(baselined) == 1 and len(new) == 1  # the excess surfaces
+    # raising the count absorbs both; an over-count reads as stale
+    new2, baselined2, stale2 = run_checkers(
+        project, [_checker("blocking-in-async")],
+        {"blocking-in-async": [dict(entry, count=3)]},
+    )
+    assert new2 == [] and len(baselined2) == 2
+    assert len(stale2) == 1 and stale2[0]["fired"] == 2
+
+
+def test_baseline_requires_reasons(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"r": [{"path": "x.py", "key": "k"}]}))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(p))
+
+
+def test_checked_in_baseline_is_valid_and_justified():
+    base = load_baseline(
+        os.path.join(REPO, "tools", "graftlint", "baseline.json")
+    )
+    for rule, entries in base.items():
+        assert rule in CHECKER_FIXTURE  # only registered rules
+        for e in entries:
+            assert len(e["reason"]) > 20  # a real sentence, not "ok"
+    # the two invariants PRs 2 and 4 claim outright must hold with NO
+    # grandfathering (the acceptance bar for this suite)
+    assert "hot-path-h2d" not in base
+    assert "thread-ownership" not in base
+
+
+# --- the tree as shipped, and the CLI contract ----------------------------
+
+
+def _cli(args, env=None):
+    e = dict(os.environ)
+    e.pop("GRAFTLINT_STRICT", None)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=REPO, capture_output=True, text=True, env=e, timeout=120,
+    )
+
+
+def test_tree_as_shipped_is_clean_strict():
+    """`make analyze` must pass on the tree: zero new violations AND no
+    stale baseline, over the same default paths the Makefile uses."""
+    r = _cli(["--strict"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["violations"] == 0
+    assert summary["rules"] == len(ALL_CHECKERS) == 6
+    assert summary["files"] > 100  # really walked the tree
+
+
+def test_seeded_fixture_fails_the_suite_when_pointed_at_it():
+    r = _cli(["--no-baseline", os.path.join("tests", "graftlint_fixtures")])
+    assert r.returncode == 1
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["violations"] > 0
+
+
+def test_strict_env_var_refuses_stale_baseline(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    stale = tmp_path / "stale.json"
+    # the entry names the ANALYZED file (staleness is path-scoped) but
+    # no longer fires in it
+    rel = os.path.relpath(str(clean), REPO).replace(os.sep, "/")
+    stale.write_text(json.dumps({"blocking-in-async": [{
+        "path": rel, "symbol": "h", "key": "time.sleep",
+        "reason": "this entry no longer fires anywhere",
+    }]}))
+    relaxed = _cli([str(clean), "--baseline", str(stale)])
+    assert relaxed.returncode == 0  # stale tolerated without strict
+    strict = _cli([str(clean), "--baseline", str(stale)],
+                  env={"GRAFTLINT_STRICT": "1"})
+    assert strict.returncode == 1
+    assert "stale" in strict.stdout
+
+
+def test_cli_errors_on_missing_paths():
+    """A typo'd path must error loudly, not silently shrink coverage —
+    violations:0 over the subset that happened to exist would read as
+    'checked everything'."""
+    ok_file = os.path.join("tests", "graftlint_fixtures",
+                           "blocking_async_ok.py")
+    r = _cli([ok_file, "tests_typo_dir"])
+    assert r.returncode == 2
+    assert "tests_typo_dir" in r.stderr
+
+
+def test_cli_json_mode_and_list():
+    r = _cli(["--json", os.path.join("tests", "graftlint_fixtures",
+                                     "blocking_async_bad.py"),
+              "--no-baseline"])
+    data = json.loads(r.stdout)
+    assert data["summary"]["violations"] == len(data["violations"]) > 0
+    names = {v["rule"] for v in data["violations"]}
+    assert names == {"blocking-in-async"}
+    lst = _cli(["--list"])
+    assert lst.returncode == 0
+    for c in ALL_CHECKERS:
+        assert c.name in lst.stdout

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import asyncio
 
-import grpc
 import pytest
 
 from k8s_gpu_device_plugin_tpu.config import Config

@@ -6,7 +6,6 @@ config plumbing, and the workload-side WorkerEnv / global-mesh helpers —
 all without hardware, per SURVEY §4 "multi-node without a cluster".
 """
 
-import asyncio
 
 import pytest
 

@@ -87,7 +87,9 @@ def test_page_pool_mechanics():
     assert pool.pages_for_tokens(16) == 1
     assert pool.pages_for_tokens(17) == 2
     with pytest.raises(RuntimeError, match="exhausted"):
-        pool.alloc(8)
+        # the alloc RAISES (nothing allocated): the unpaired-retain rule
+        # is exactly what this line exists to provoke
+        pool.alloc(8)  # graftlint: disable=refcount-pairing
     with pytest.raises(ValueError):
         pool.decref([3])  # not allocated
     with pytest.raises(ValueError):
@@ -789,3 +791,59 @@ def test_paged_kv_bench_machinery():
     out = allocator_bench(n_ops=50, n_pages=64, page_size=16)
     assert out["page_alloc_free_us"] > 0
     assert out["page_incref_decref_us"] > 0
+
+
+def test_pool_free_returns_to_baseline_after_promotion_failure(setup):
+    """Induced failure paths must not strand page references.
+
+    The promotion extractor used to push KV gauges BETWEEN taking page
+    refs and handing them to the cache entry; a raising (duck-typed)
+    metrics hook in that window stranded the refs with no owner — found
+    by graftlint's refcount-pairing checker, fixed by making the
+    incref->record window call-free (gauges move after on_prefill_done).
+    Pinned here: even when the gauge push raises mid-step, every
+    reference stays owned, and draining slots + cache returns the pool
+    to its free-count baseline."""
+    cfg, params = setup
+
+    class _ArmedRaiser(_KvRec):
+        armed = False
+
+        def set_kv_pages(self, *a):
+            if self.armed:
+                raise RuntimeError("scrape backend down")
+            super().set_kv_pages(*a)
+
+    rec = _ArmedRaiser()
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = _batcher(params, cfg, "paged", pc=pc, metrics=rec)
+    baseline = cb.pool.free_pages
+    rid = cb.submit(_prompt(120, 17, cfg), max_new=7)
+    cb.step()  # admission + first chunk: gauges healthy here
+    rec.armed = True
+    with pytest.raises(RuntimeError, match="scrape backend down"):
+        for _ in range(50):
+            cb.step()  # finish chunk promotes -> the gauge push raises
+    rec.armed = False
+    # the promotion itself completed BEFORE the raise: both boundary
+    # entries own their refs (the old code died inside the extractor,
+    # leaving 0 entries and the increfs stranded)
+    assert pc.stats.entries == 2
+    cb.pool.check()
+    cb.cancel(rid)
+    while pc.evict_one():
+        pass
+    cb.run(max_steps=50)
+    cb.pool.check()
+    assert cb.pool.free_pages == baseline  # every failure path balanced
+
+    # submit-side refusal (request_too_large): no pages move at all
+    small = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="paged", kv_page_size=PS, kv_pages=3,
+    )
+    base2 = small.pool.free_pages
+    with pytest.raises(ValueError, match="pool"):
+        small.submit(_prompt(121, 30, cfg), max_new=30)
+    assert small.pool.free_pages == base2 == 2
+    small.pool.check()

@@ -20,7 +20,8 @@ def test_block_sampler_measures_loop_lag():
         sampler.watch_loop(asyncio.get_running_loop())
         sampler.start()
         await asyncio.sleep(0.1)   # healthy: probes land fast
-        time.sleep(0.3)            # block the loop (the sin being metered)
+        # graftlint: disable=blocking-in-async (the sin being metered)
+        time.sleep(0.3)            # deliberately block the loop
         await asyncio.sleep(0.1)
         sampler.stop()
 

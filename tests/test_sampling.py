@@ -168,7 +168,6 @@ def test_repetition_penalty_in_rolling_matches_generate():
     """Greedy + penalty is deterministic, and rolling's windowed decode
     with a penalty must equal the unbounded windowed generate with the
     same penalty (presence threading is identical)."""
-    from dataclasses import replace
 
     from k8s_gpu_device_plugin_tpu.models.generate import generate
     from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params

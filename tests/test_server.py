@@ -4,7 +4,6 @@ import asyncio
 import json
 
 import aiohttp
-import pytest
 from prometheus_client import CollectorRegistry
 
 from k8s_gpu_device_plugin_tpu.config import Config

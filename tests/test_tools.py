@@ -1,0 +1,232 @@
+"""tools/watchdog.py + tools/harvest.py: the unattended hardware-window
+pipeline finally gets tests (it previously shipped on faith — `make
+analyze` runs over tools/, so the code it checks should be backed by
+something executable too).
+
+No chip, no subprocesses against real hardware: the harvest tests
+drive the pure helpers (median/spread discipline, journal resume
+predicates, priority rules) against a tmp journal, and the watchdog
+tests run ``main()`` with a stubbed harvest pass so every exit rule
+(drained queue, stop file, deadline, duplicate instance) is pinned.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+# import through the tools namespace package (repo root is on sys.path
+# via conftest) — a bare `import watchdog` would collide with the pypi
+# filesystem-events package of the same name when both are importable
+import tools.watchdog as watchdog  # noqa: E402
+
+harvest = watchdog.harvest  # the same module object watchdog drives
+
+
+def test_smoke_importable_and_wired_together():
+    # watchdog defers its priority rule to harvest's — ONE implementation
+    assert watchdog.harvest is harvest
+    assert callable(harvest.script_outranked)
+    assert harvest.QUEUE and all(len(row) == 3 for row in harvest.QUEUE)
+    # every queue row's timeout is positive and names are unique
+    names = [n for n, _, _ in harvest.QUEUE]
+    assert len(names) == len(set(names))
+    assert all(t > 0 for _, _, t in harvest.QUEUE)
+
+
+# --- harvest: repeat/median/spread discipline ------------------------------
+
+
+def test_primary_key_picks_first_present_metric():
+    assert harvest.primary_key({"mfu_pct": 55.1, "noise": 1}) == "mfu_pct"
+    assert harvest.primary_key(
+        {"tokens_per_second": 10}) == "tokens_per_second"
+    assert harvest.primary_key({"unrelated": "x"}) is None
+
+
+def test_median_of_returns_a_really_measured_run():
+    reps = [{"mfu_pct": 50.0}, {"mfu_pct": 54.0}, {"mfu_pct": 52.0}]
+    med, spread = harvest.median_of(reps)
+    assert med == {"mfu_pct": 52.0}  # the middle MEASUREMENT, not a mean
+    assert spread["metric"] == "mfu_pct"
+    assert spread["values"] == [50.0, 54.0, 52.0]
+    assert spread["rel_spread_pct"] == pytest.approx(
+        100 * (54 - 50) / 52, abs=0.01
+    )
+
+
+def test_median_of_even_count_takes_lower_middle():
+    reps = [{"mfu_pct": v} for v in (50.0, 51.0, 52.0, 53.0)]
+    med, _ = harvest.median_of(reps)
+    assert med["mfu_pct"] == 51.0  # lower-middle: never an interpolation
+
+
+def test_median_of_single_or_keyless_is_passthrough():
+    only = [{"mfu_pct": 50.0}]
+    assert harvest.median_of(only) == (only[0], None)
+    keyless = [{"a": 1}, {"a": 2}]
+    assert harvest.median_of(keyless) == (keyless[0], None)
+
+
+# --- harvest: journal persistence + resume ---------------------------------
+
+
+@pytest.fixture
+def journal(tmp_path, monkeypatch):
+    path = str(tmp_path / "harvest_results.jsonl")
+    monkeypatch.setattr(harvest, "RESULTS_PATH", path)
+    return path
+
+
+def test_persist_writes_consolidated_median_row(journal):
+    reps = [{"mfu_pct": 50.0}, {"mfu_pct": 54.0}, {"mfu_pct": 52.0}]
+    rec = harvest.persist("train", reps[0], repeats=reps)
+    lines = [json.loads(line) for line in open(journal)]
+    assert lines[-1] == rec
+    assert rec["workload"] == "train"
+    assert rec["result"] == {"mfu_pct": 52.0}  # adoption reads the median
+    assert rec["n_repeats"] == 3 and len(rec["repeats"]) == 3
+    assert rec["spread"]["values"] == [50.0, 54.0, 52.0]
+
+
+def test_persist_single_failure_row(journal):
+    rec = harvest.persist("decode", None)
+    assert rec["result"] is None
+    assert json.loads(open(journal).read())["workload"] == "decode"
+
+
+def test_landed_rows_shares_bench_predicates(journal, monkeypatch):
+    harvest.persist("train", {"mfu_pct": 55.0}, repeats=[{"mfu_pct": 55.0}])
+    harvest.persist("decode", None)  # failed: must not count as landed
+    # stale rows are bench.journal_row_fresh's call — pin the sharing by
+    # forcing its verdict and watching landed_rows() obey it
+    monkeypatch.setattr(harvest.bench, "journal_row_fresh", lambda rec: True)
+    assert harvest.landed_rows() == {"train"}
+    monkeypatch.setattr(harvest.bench, "journal_row_fresh", lambda rec: False)
+    assert harvest.landed_rows() == set()
+
+
+def test_landed_rows_survives_garbage_lines(journal):
+    with open(journal, "w") as f:
+        f.write("not json\n\n")
+    assert harvest.landed_rows() == set()  # no crash, nothing landed
+
+
+# --- harvest/watchdog: single-instance priority rule -----------------------
+
+
+def test_script_outranked_start_tick_priority(monkeypatch):
+    me = os.getpid()
+    monkeypatch.setattr(harvest, "_script_pids", lambda s: [111, 222])
+    ticks = {111: 5, 222: 50, me: 20}
+    monkeypatch.setattr(
+        harvest, "_proc_start_ticks", lambda pid: ticks.get(pid, 1 << 62)
+    )
+    # pid 111 started earlier than us -> we are outranked
+    assert harvest.script_outranked("harvest.py") is True
+    ticks[111] = 40  # both peers younger than us -> we win
+    assert harvest.script_outranked("harvest.py") is False
+
+
+def test_watchdog_outranked_delegates_to_harvest(monkeypatch):
+    seen = []
+    monkeypatch.setattr(
+        harvest, "script_outranked",
+        lambda script: seen.append(script) or False,
+    )
+    assert watchdog.outranked() is False
+    assert seen == ["watchdog.py"]
+
+
+# --- watchdog main loop: every exit rule, no real subprocesses -------------
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+        self.pid = 4242
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+@pytest.fixture
+def wd(tmp_path, monkeypatch):
+    """watchdog.main() harness: stop file in tmp, no elder instances,
+    no sleeping, scripted harvest return codes."""
+    monkeypatch.setattr(sys, "argv", ["watchdog.py"])
+    monkeypatch.setattr(watchdog, "STOP_PATH", str(tmp_path / ".stop"))
+    monkeypatch.setattr(watchdog, "outranked", lambda: False)
+    monkeypatch.setattr(watchdog.time, "sleep", lambda s: None)
+    rcs = []
+    monkeypatch.setattr(
+        watchdog.subprocess, "Popen",
+        lambda *a, **kw: _FakeProc(rcs.pop(0)),
+    )
+    return rcs
+
+
+def test_watchdog_exits_when_queue_drained(wd, capsys):
+    wd.append(3)  # harvest: nothing left to measure
+    assert watchdog.main() == 0
+    assert "queue drained" in capsys.readouterr().out
+
+
+def test_watchdog_reenters_immediately_after_landing_rows(wd, capsys):
+    wd.extend([0, 3])  # rows landed -> straight back in -> drained
+    assert watchdog.main() == 0
+    out = capsys.readouterr().out
+    assert "re-entering immediately" in out and "queue drained" in out
+
+
+def test_watchdog_backs_off_on_busy_then_stops_on_stop_file(
+    wd, capsys, monkeypatch
+):
+    wd.append(4)  # chip busy (bench.py owns it)
+
+    real_exists = os.path.exists
+
+    def exists(path):
+        if path == watchdog.STOP_PATH:
+            # appears after the first pass's back-off
+            return len(wd) == 0 and exists.armed
+        return real_exists(path)
+
+    exists.armed = False
+    monkeypatch.setattr(watchdog.os.path, "exists", exists)
+    monkeypatch.setattr(
+        watchdog.time, "sleep",
+        lambda s: setattr(exists, "armed", True),
+    )
+    assert watchdog.main() == 0
+    out = capsys.readouterr().out
+    assert "backing off" in out and "stop file present" in out
+
+
+def test_watchdog_removes_stale_stop_file_and_runs(wd, capsys):
+    open(watchdog.STOP_PATH, "w").close()  # stale leftover, no elder
+    wd.append(3)
+    assert watchdog.main() == 0
+    assert not os.path.exists(watchdog.STOP_PATH)
+    assert "stale" in capsys.readouterr().out
+
+
+def test_watchdog_yields_to_elder_instance(wd, capsys, monkeypatch):
+    monkeypatch.setattr(watchdog, "outranked", lambda: True)
+    assert watchdog.main() == 4
+    assert "already running" in capsys.readouterr().out
+
+
+def test_watchdog_deadline_stops_the_loop(wd, capsys, monkeypatch):
+    wd.extend([1, 1, 1, 1, 1])  # wedged passes forever
+    t = [0.0]
+
+    def fake_time():
+        t[0] += 5 * 3600.0  # each clock read burns five hours
+        return t[0]
+
+    monkeypatch.setattr(watchdog.time, "time", fake_time)
+    rc = watchdog.main()
+    assert rc == 0
+    assert "deadline reached" in capsys.readouterr().out

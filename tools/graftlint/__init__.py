@@ -1,0 +1,45 @@
+"""graftlint: project-invariant static analysis for the serving stack.
+
+``make lint`` (ruff + compileall) catches syntax rot and style; it knows
+nothing about the invariants PRs 1-5 established — zero per-step
+host-to-device transfers in the decode loop, engine-thread-only state
+snapshotted before crossing to HTTP handlers, paired page alloc/free
+with refcount discipline. A regression in any of those surfaces only as
+a flaky stress test or a silent perf cliff. graftlint encodes them as
+AST checkers that run over the whole tree in ``make analyze``.
+
+Layout:
+
+- :mod:`tools.graftlint.core` — the framework: project loader,
+  annotation/suppression comment parsing, the ``Checker`` protocol,
+  baseline matching, human + JSON reporting.
+- :mod:`tools.graftlint.checkers` — the per-invariant plugins (one
+  module per rule; the registry is ``ALL_CHECKERS``).
+- ``tools/graftlint/baseline.json`` — grandfathered violations, each
+  with a written justification. ``GRAFTLINT_STRICT=1`` additionally
+  refuses a stale baseline (entries that no longer fire).
+
+Source annotations the checkers read (plain comments, zero runtime
+cost):
+
+- ``# graftlint: hot-path`` on a ``def`` line registers the function as
+  a decode-loop hot path (the hot-path-h2d checker's scope).
+- ``# owner: engine`` on a ``self.x = ...`` line declares the attribute
+  engine-thread-only (the thread-ownership checker's scope).
+- ``# graftlint: cross-thread`` on a ``def`` line marks a non-async
+  function that runs off the engine thread (HTTP/event-loop side).
+- ``# graftlint: disable=<rule>[,<rule>...]`` suppresses the named
+  rule(s) on that line.
+
+Usage::
+
+    python -m tools.graftlint [paths...] [--json] [--strict] [--list]
+"""
+
+from tools.graftlint.core import (  # noqa: F401  (the public surface)
+    Checker,
+    Project,
+    Violation,
+    load_project,
+    run_checkers,
+)

@@ -1,0 +1,116 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit 0 when every finding is suppressed or baselined; 1 when new
+violations exist (or, under ``--strict``/``GRAFTLINT_STRICT=1``, when
+the baseline has gone stale — a fixed violation must leave the baseline
+with the fix, so the grandfather list only ever shrinks honestly).
+
+The last stdout line is always the one-line JSON summary the CI spine
+consumes (the bench-runner convention: one parseable line no matter
+what)::
+
+    {"rules": 6, "files": 187, "violations": 0, "baselined": 1}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint.checkers import ALL_CHECKERS
+from tools.graftlint.core import load_baseline, load_project, run_checkers
+
+DEFAULT_PATHS = ["k8s_gpu_device_plugin_tpu", "tests", "tools", "bench.py"]
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="project-invariant static analysis (see "
+                    "docs/static_analysis.md)",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help=f"files/dirs to analyze (default: "
+                             f"{' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--json", action="store_true",
+                        help="emit full machine-readable findings "
+                             "instead of human lines")
+    parser.add_argument("--strict", action="store_true",
+                        help="also fail on a stale baseline (entries "
+                             "that no longer fire); GRAFTLINT_STRICT=1 "
+                             "implies this")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file (default: the checked-in "
+                             "tools/graftlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (every violation is "
+                             "new); what the fixture tests use")
+    parser.add_argument("--list", action="store_true",
+                        help="list the registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for c in ALL_CHECKERS:
+            print(f"{c.name}: {c.description}")
+        return 0
+
+    strict = args.strict or os.environ.get("GRAFTLINT_STRICT") == "1"
+    paths = args.paths or DEFAULT_PATHS
+    # a typo'd path must ERROR, not silently shrink the analyzed set —
+    # CI reporting violations:0 over the subset it happened to find
+    # would read as "covered everything"
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path(s): {' '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+        return 2
+
+    project = load_project(paths)
+    new, baselined, stale = run_checkers(project, ALL_CHECKERS, baseline)
+
+    summary = {
+        "rules": len(ALL_CHECKERS),
+        "files": len(project.modules) + len(project.parse_errors),
+        "violations": len(new),
+        "baselined": len(baselined),
+    }
+    if stale:
+        summary["stale_baseline"] = len(stale)
+
+    if args.json:
+        print(json.dumps({
+            "summary": summary,
+            "violations": [v.__dict__ for v in new],
+            "baselined": [v.__dict__ for v in baselined],
+            "stale": stale,
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        if stale and strict:
+            for e in stale:
+                print(
+                    f"stale baseline entry [{e.get('rule')}] "
+                    f"{e.get('path')} ({e.get('symbol')}/{e.get('key')}): "
+                    "no longer fires — remove it"
+                )
+        print(json.dumps(summary))
+
+    if new:
+        return 1
+    if strict and stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+"""Checker registry: one module per project invariant.
+
+Order is the report order (hot-path and thread-ownership first: those
+are the two rules contractually running with an empty baseline)."""
+
+from tools.graftlint.checkers.hot_path_h2d import HotPathH2D
+from tools.graftlint.checkers.thread_ownership import ThreadOwnership
+from tools.graftlint.checkers.tracer_leak import TracerLeak
+from tools.graftlint.checkers.jit_recompile import JitRecompileHazard
+from tools.graftlint.checkers.refcount_pairing import RefcountPairing
+from tools.graftlint.checkers.blocking_async import BlockingInAsync
+
+ALL_CHECKERS = [
+    HotPathH2D(),
+    ThreadOwnership(),
+    TracerLeak(),
+    JitRecompileHazard(),
+    RefcountPairing(),
+    BlockingInAsync(),
+]

@@ -1,0 +1,140 @@
+"""blocking-in-async: no blocking work on the event loop.
+
+One aiohttp event loop serves every stream; a single blocking call in a
+handler stalls ALL of them for its duration (and an SSE consumer sees
+it as a cross-request inter-token latency spike no metric attributes
+correctly). Device syncs are the worst offenders: ``jax.device_get``/
+``.block_until_ready()`` park the thread until the chip finishes —
+that's the engine thread's job, never the handler's. The sanctioned
+shapes are the engine's queue bridge (``loop.call_soon_threadsafe`` +
+``await q.get()``) and ``run_in_executor`` for CPU-bound work (the
+embeddings/scoring handlers).
+
+Flags, lexically inside any ``async def`` (nested sync helpers
+included — they run on the loop when called inline):
+
+- ``time.sleep`` (asyncio.sleep exists for a reason);
+- blocking device syncs: ``jax.device_get``, ``.block_until_ready()``,
+  ``jax.block_until_ready``;
+- sync subprocess/network/file I/O: ``subprocess.*``, ``os.system``,
+  ``requests.*``, ``urllib.request.*``, ``socket.create_connection``,
+  bare ``open()``;
+- un-awaited ``.result()``/``.wait()`` method calls — the
+  ``concurrent.futures``/``threading`` blocking waits; their awaited
+  twins (``await stop.wait()`` on an asyncio.Event) are the async
+  primitives and are exempt.
+
+Functions only DEFINED in an async scope and handed to
+``run_in_executor``/``asyncio.to_thread`` run off-loop; flag-free by
+suppression if a checker false-positive ever matters (none today).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Checker,
+    Project,
+    Violation,
+    call_name,
+    walk_functions,
+)
+
+BLOCKING_EXACT = {
+    "time.sleep", "os.system", "jax.device_get", "jax.block_until_ready",
+    "socket.create_connection", "open",
+}
+BLOCKING_PREFIXES = (
+    "subprocess.", "requests.", "urllib.request.",
+)
+BLOCKING_METHODS = {"block_until_ready"}
+#: method names that block only in their SYNC form — exempt when the
+#: call is directly awaited (asyncio.Event.wait / asyncio futures)
+BLOCKING_UNLESS_AWAITED = {"result", "wait"}
+
+
+class BlockingInAsync(Checker):
+    name = "blocking-in-async"
+    description = (
+        "time.sleep, blocking device syncs, or sync I/O inside "
+        "async def handlers"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        out: list[Violation] = []
+        for mod in project.modules:
+            for func, qual, _cls in walk_functions(mod.tree):
+                if not isinstance(func, ast.AsyncFunctionDef):
+                    continue
+                out.extend(self._check_async(mod, func, qual))
+        return out
+
+    def _check_async(self, mod, func, qual) -> list[Violation]:
+        # exempt the ASYNC forms of result/wait: directly awaited calls
+        # (await stop.wait() on an asyncio.Event) and the
+        # coroutine-returning ``.wait()`` handed straight to a
+        # scheduler (asyncio.create_task(ev.wait())). ``.result()`` is
+        # never coroutine-returning, so nesting it inside an asyncio.*
+        # call (asyncio.gather(fut.result())) still evaluates — and
+        # blocks — eagerly on the loop, and stays flagged.
+        awaited = {
+            id(n.value) for n in ast.walk(func)
+            if isinstance(n, ast.Await)
+        }
+        schedulers = {
+            "asyncio.create_task", "asyncio.ensure_future",
+            "asyncio.wait_for", "asyncio.shield", "asyncio.gather",
+        }
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call) and call_name(n) in schedulers:
+                awaited.update(
+                    id(a) for a in n.args
+                    if isinstance(a, ast.Call)
+                    and isinstance(a.func, ast.Attribute)
+                    and a.func.attr == "wait"
+                )
+        out: list[Violation] = []
+        for node in self._walk_loop_code(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            hit = None
+            if name in BLOCKING_EXACT:
+                hit = name
+            elif any(name.startswith(p) for p in BLOCKING_PREFIXES):
+                hit = name
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in BLOCKING_METHODS:
+                hit = f"(...).{node.func.attr}"
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in BLOCKING_UNLESS_AWAITED
+                    and id(node) not in awaited):
+                hit = f"(...).{node.func.attr}"
+            if hit is None:
+                continue
+            out.append(Violation(
+                rule=self.name, path=mod.path, line=node.lineno,
+                col=node.col_offset, symbol=qual, key=hit,
+                message=(
+                    f"{hit}() blocks the event loop: every concurrent "
+                    "request stalls behind it — await the async "
+                    "equivalent, or push it through "
+                    "loop.run_in_executor (the embeddings-handler "
+                    "pattern)"
+                ),
+            ))
+        return out
+
+    @staticmethod
+    def _walk_loop_code(func):
+        """Everything lexically in the async def, descending into
+        nested SYNC defs (they run on the loop when called inline) but
+        not nested ASYNC defs (checked as their own contexts)."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.AsyncFunctionDef):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,145 @@
+"""hot-path-h2d: zero per-step host->device transfers in the decode loop.
+
+PR 2's contract: the steady-state decode loop performs NO host->device
+transfer — membership masks, sampler knobs, bias planes, seeds and the
+EOS scalar are cached device residents, and budget/draw state lives in
+the jitted carry. A ``jnp.asarray`` (or friends) sneaking back into a
+per-step function silently reintroduces a per-token transfer; nothing
+crashes, serving just gets slower (the host-overhead bench would
+eventually notice, several PRs too late).
+
+Scope: functions whose ``def`` line carries ``# graftlint: hot-path``
+(the decode-loop registry: ``decode_step``/``spec_decode_step``, the
+dispatch/apply seams, the paged gather/scatter helpers in generate.py),
+including any function nested inside them.
+
+Host vs traced hot paths: a jit-DECORATED hot function (or one marked
+``# graftlint: hot-path=traced`` — the undecorated helpers that only
+ever run inside another function's trace, like ``_cache_write``) runs
+its body at trace time, where ``jnp.arange``/``jnp.full`` build
+compile-time constants, not per-step transfers. A HOST hot function
+(the dispatch/apply seams) runs its body every step, where the same
+constructors ARE a per-step host-array build + transfer.
+
+Flags:
+
+- in every hot path: calls that explicitly materialize host data onto
+  the device (``jnp.array``, ``jnp.asarray``, ``jax.device_put``,
+  ``np.asarray``/``np.array`` — host arrays built here transfer the
+  moment they hit a jit boundary — and device scalar constructors like
+  ``jnp.int32(x)``);
+- in HOST hot paths only: the device-array constructor family
+  (``jnp.zeros``/``ones``/``full``/``empty``/``arange``/``eye``);
+- Python-scalar carry mutations: an AugAssign to ``self.X`` where
+  ``self.X`` is also passed into a hot-path call in the same function —
+  the pre-PR-2 budget-counter idiom (host mutates a scalar, re-uploads
+  it every step).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Checker,
+    Project,
+    Violation,
+    call_name,
+    is_jit_decorator,
+    walk_functions,
+)
+
+H2D_CALLS = {
+    "jnp.array", "jnp.asarray", "jax.numpy.array", "jax.numpy.asarray",
+    "jax.device_put", "np.asarray", "np.array", "numpy.asarray",
+    "numpy.array", "jnp.int32", "jnp.int64", "jnp.float32", "jnp.float16",
+    "jnp.bfloat16", "jnp.bool_", "jax.random.key",
+}
+#: H2D only when evaluated on the HOST side (at trace time these build
+#: compile-time constants — legitimate in the jitted step bodies)
+CONSTRUCTOR_CALLS = {
+    "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty", "jnp.arange",
+    "jnp.eye",
+}
+
+
+class HotPathH2D(Checker):
+    name = "hot-path-h2d"
+    description = (
+        "host->device transfers or host-scalar carries inside functions "
+        "registered (# graftlint: hot-path) as decode-loop hot paths"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        out: list[Violation] = []
+        for mod in project.modules:
+            funcs = list(walk_functions(mod.tree))
+            hot: list[tuple[ast.AST, str, bool]] = []
+            hot_names: set[str] = set()
+            for node, qual, _cls in funcs:
+                plain = mod.def_has_marker(node, "hot-path")
+                traced_mark = mod.def_has_marker(node, "hot-path=traced")
+                if not (plain or traced_mark):
+                    continue
+                traced = traced_mark or any(
+                    is_jit_decorator(d) for d in node.decorator_list
+                )
+                hot.append((node, qual, traced))
+                hot_names.add(node.name)
+            for node, qual, traced in hot:
+                # nested defs inherit the hot scope; walk_functions
+                # already yields them separately only if they carry
+                # their own marker, so walk the whole subtree here
+                out.extend(self._check_func(mod, node, qual, hot_names,
+                                            traced))
+        return out
+
+    def _check_func(self, mod, func, qual, hot_names,
+                    traced) -> list[Violation]:
+        out: list[Violation] = []
+        hot_call_args: set[str] = set()  # self.X attrs fed to hot calls
+        aug_assigns: list[ast.AugAssign] = []
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in H2D_CALLS or (
+                    not traced and name in CONSTRUCTOR_CALLS
+                ):
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        col=node.col_offset, symbol=qual, key=name,
+                        message=(
+                            f"{name}() in a decode-loop hot path is a "
+                            "per-step host->device transfer; cache the "
+                            "device array across steps or move the value "
+                            "into the jitted carry"
+                        ),
+                    ))
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in hot_names:
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"):
+                            hot_call_args.add(arg.attr)
+            elif isinstance(node, ast.AugAssign):
+                aug_assigns.append(node)
+        for node in aug_assigns:
+            t = node.target
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" and t.attr in hot_call_args):
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=node.lineno,
+                    col=node.col_offset, symbol=qual,
+                    key=f"carry:{t.attr}",
+                    message=(
+                        f"self.{t.attr} is mutated host-side AND passed "
+                        "into a hot-path call: a Python-scalar carry "
+                        "re-uploaded every step — move it into the "
+                        "device-side state (the BatchState.budget/draws "
+                        "pattern)"
+                    ),
+                ))
+        return out

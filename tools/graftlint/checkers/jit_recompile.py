@@ -1,0 +1,297 @@
+"""jit-recompile-hazard: call sites that silently defeat the jit cache.
+
+The serving stack's compile budget is engineered: one prefill compile
+per bucket, two per chunk schedule, one decode step per config. The
+cache key is (function identity, static args, shapes) — so a wrapper
+built per call, an unhashable static, or a method closure over mutable
+instance state all turn "compiled once" into "compiled per call/
+per mutation", which on TPU is a multi-second stall per occurrence and
+exactly the host-side overhead the pod-scaling literature says erodes
+concurrency (ROADMAP: arXiv:2011.03641).
+
+Flags:
+
+- ``jax.jit(...)`` (or ``pjit``) EVALUATED inside a function body: the
+  wrapper is rebuilt every call, so its cache starts empty every call.
+  Decorators and module-scope wrapping evaluate once and are fine.
+- a jit-decorated function or lambda that closes over ``self``: the
+  instance is captured at wrap time; mutable state changes do not
+  re-key the cache (stale compile) or, if hashed, recompile per
+  mutation.
+- ``static_argnames``/``static_argnums`` naming a parameter whose
+  default or annotation is an unhashable container (list/dict/set):
+  the first call raises or, worse, the value is rebuilt per call and
+  never hits the cache.
+- ``static_argnames`` naming a parameter the wrapped function does not
+  even have (the typo silently makes the arg dynamic).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    JIT_WRAPPERS,
+    Checker,
+    Project,
+    Violation,
+    call_name,
+    dotted_name,
+    is_jit_decorator,
+    walk_functions,
+    walk_own,
+)
+
+UNHASHABLE_ANNOT = {"list", "dict", "set", "List", "Dict", "Set"}
+
+
+def _jit_call_parts(call: ast.Call):
+    """For a Call that builds a jit wrapper, return (wrapped_fn_node,
+    static_kwargs) — handles ``jax.jit(f, ...)`` and
+    ``partial(jax.jit, ...)`` (no wrapped fn). None if not a jit call."""
+    name = call_name(call)
+    if name in JIT_WRAPPERS:
+        fn = call.args[0] if call.args else None
+        return fn, call.keywords
+    if name.rsplit(".", 1)[-1] == "partial" and call.args:
+        if dotted_name(call.args[0]) in JIT_WRAPPERS:
+            return None, call.keywords
+    return None
+
+
+class JitRecompileHazard(Checker):
+    name = "jit-recompile-hazard"
+    description = (
+        "jit wrappers built per call, closures over mutable instance "
+        "state, or unhashable/mistyped static args"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        out: list[Violation] = []
+        for mod in project.modules:
+            # bench workloads and tests are one-shot processes: a sweep
+            # deliberately builds one wrapper per measured variant, a
+            # test builds one per assertion — the cache-reuse invariant
+            # protects the long-lived serving/train processes. Fixture
+            # files stay eligible (the firing fixtures live there).
+            if "graftlint_fixtures" not in mod.path and (
+                "benchmark/" in mod.path or mod.path.startswith("tests/")
+                or "/tests/" in mod.path
+            ):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod) -> list[Violation]:
+        out: list[Violation] = []
+        funcs = list(walk_functions(mod.tree))
+
+        # (a) jit wrapper whose cache cannot survive: built-and-invoked
+        # in one expression, or rebuilt every iteration of a loop. The
+        # factory pattern (build once, assign/return, reuse) is fine —
+        # the wrapper object persists, so its cache does.
+        for func, qual, _cls in funcs:
+            loop_spans: list[tuple[int, int]] = []
+            for node in walk_own(func):
+                if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                    loop_spans.append(
+                        (node.lineno, getattr(node, "end_lineno",
+                                              node.lineno))
+                    )
+            for node in walk_own(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                immediate = (
+                    isinstance(node.func, ast.Call)
+                    and call_name(node.func) in JIT_WRAPPERS
+                )
+                fresh_in_loop = call_name(node) in JIT_WRAPPERS and any(
+                    lo < node.lineno <= hi for lo, hi in loop_spans
+                ) and not self._is_decorator_of_any(node, funcs)
+                if immediate:
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        col=node.col_offset, symbol=qual,
+                        key="jit-immediately-invoked",
+                        message=(
+                            "jit wrapper built and invoked in one "
+                            "expression: the wrapper (and its compile "
+                            "cache) is discarded after the call, so "
+                            "every occurrence recompiles — build the "
+                            "jit once at module scope and reuse it"
+                        ),
+                    ))
+                elif fresh_in_loop:
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=node.lineno,
+                        col=node.col_offset, symbol=qual,
+                        key="jit-in-loop",
+                        message=(
+                            "jit wrapper rebuilt every loop iteration: "
+                            "each fresh wrapper starts with an empty "
+                            "cache and recompiles — hoist the jit out "
+                            "of the loop"
+                        ),
+                    ))
+
+        # (b) jit-decorated defs/lambdas closing over self
+        for func, qual, cls in funcs:
+            if not any(self._is_jit_dec(d) for d in func.decorator_list):
+                continue
+            params = {a.arg for a in func.args.posonlyargs
+                      + func.args.args + func.args.kwonlyargs}
+            if "self" in params:
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=func.lineno,
+                    col=func.col_offset, symbol=qual, key="jit-method",
+                    message=(
+                        "jit applied to a method: 'self' becomes a "
+                        "traced (or hashed) argument, so mutable "
+                        "instance state either recompiles per mutation "
+                        "or silently serves a stale compile — jit a "
+                        "free function over explicit state instead"
+                    ),
+                ))
+            elif any(
+                isinstance(n, ast.Name) and n.id == "self"
+                and isinstance(n.ctx, ast.Load)
+                for n in ast.walk(func)
+            ):
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=func.lineno,
+                    col=func.col_offset, symbol=qual,
+                    key="jit-closure-self",
+                    message=(
+                        "jit-decorated function closes over 'self': the "
+                        "instance is captured at wrap time, so mutable "
+                        "state changes never re-key the cache (stale "
+                        "compile) — pass the state as an argument"
+                    ),
+                ))
+
+        # (c)+(d) static_argnames hygiene on decorated defs
+        for func, qual, _cls in funcs:
+            statics = self._static_names(func)
+            if statics is None:
+                continue
+            names = {a.arg for a in func.args.posonlyargs
+                     + func.args.args + func.args.kwonlyargs}
+            annot = {
+                a.arg: a.annotation
+                for a in func.args.posonlyargs + func.args.args
+                + func.args.kwonlyargs
+            }
+            defaults = self._defaults_by_name(func)
+            for s in statics:
+                if s not in names:
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=func.lineno,
+                        col=func.col_offset, symbol=qual,
+                        key=f"static-missing:{s}",
+                        message=(
+                            f"static_argnames names {s!r} but the "
+                            "function has no such parameter: the typo "
+                            "silently leaves the real arg dynamic"
+                        ),
+                    ))
+                    continue
+                problem = self._unhashable(annot.get(s), defaults.get(s))
+                if problem:
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=func.lineno,
+                        col=func.col_offset, symbol=qual,
+                        key=f"static-unhashable:{s}",
+                        message=(
+                            f"static arg {s!r} is {problem}: statics "
+                            "must hash stably or every call misses the "
+                            "cache (or raises) — use a tuple/frozen "
+                            "dataclass"
+                        ),
+                    ))
+        return out
+
+    @staticmethod
+    def _is_jit_dec(dec: ast.AST) -> bool:
+        return is_jit_decorator(dec)
+
+    @staticmethod
+    def _is_decorator_of_any(node: ast.Call, funcs) -> bool:
+        return any(
+            node in f.decorator_list
+            or any(node in ast.walk(d) for d in f.decorator_list)
+            for f, _q, _c in funcs
+        )
+
+    @staticmethod
+    def _static_names(func) -> "set[str] | None":
+        for dec in func.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            parts = _jit_call_parts(dec)
+            if parts is None:
+                continue
+            _, kwargs = parts
+            out: set[str] = set()
+            found = False
+            pos = [a.arg for a in func.args.posonlyargs + func.args.args]
+            for kw in kwargs:
+                if kw.arg == "static_argnames":
+                    found = True
+                    vals = kw.value
+                    if isinstance(vals, ast.Constant) and isinstance(
+                        vals.value, str
+                    ):
+                        out.add(vals.value)
+                    elif isinstance(vals, (ast.Tuple, ast.List)):
+                        out.update(
+                            e.value for e in vals.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+                elif kw.arg == "static_argnums":
+                    found = True
+                    vals = kw.value
+                    idxs = []
+                    if isinstance(vals, ast.Constant) and isinstance(
+                        vals.value, int
+                    ):
+                        idxs = [vals.value]
+                    elif isinstance(vals, (ast.Tuple, ast.List)):
+                        idxs = [
+                            e.value for e in vals.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)
+                        ]
+                    for i in idxs:
+                        # an out-of-range index surfaces as a name the
+                        # signature cannot have -> the missing-param arm
+                        out.add(pos[i] if 0 <= i < len(pos)
+                                else f"<argnum {i}>")
+            if found:
+                return out
+        return None
+
+    @staticmethod
+    def _defaults_by_name(func) -> dict:
+        args = func.args.posonlyargs + func.args.args
+        defaults = func.args.defaults
+        out = {}
+        for a, d in zip(args[len(args) - len(defaults):], defaults):
+            out[a.arg] = d
+        for a, d in zip(func.args.kwonlyargs, func.args.kw_defaults):
+            if d is not None:
+                out[a.arg] = d
+        return out
+
+    @staticmethod
+    def _unhashable(annotation, default) -> str:
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            return "defaulted to an unhashable container literal"
+        base = annotation
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        if base is not None:
+            nm = dotted_name(base).rsplit(".", 1)[-1]
+            if nm in UNHASHABLE_ANNOT:
+                return f"annotated as unhashable {nm!r}"
+        return ""

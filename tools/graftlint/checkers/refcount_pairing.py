@@ -1,0 +1,402 @@
+"""refcount-pairing: every page retain must have a reachable release.
+
+The page pool (models/paging.py) is a manual refcount domain: ``alloc``
+and ``incref`` take references, ``decref`` gives them back, and a
+reference that never reaches a ``decref`` is HBM leaked until restart
+(the pool has no GC — ``check()`` asserts the books balance, but only
+when a test thinks to call it). The batcher's discipline is an
+ownership ledger: every retained page id list is either released in the
+same function or stored into a long-lived attribute (``req._new_pages``,
+``self._slot_pages[slot]``, ...) that some release path demonstrably
+drains.
+
+What this checker enforces, per call site on a ``*pool*`` receiver:
+
+1. **No dropped retains**: an ``alloc``/``incref`` whose result/argument
+   is never stored, returned, or released in that function leaks.
+2. **Exception edges**: between the retain and the statement that
+   records ownership there must be no call that can raise (a tiny
+   allowlist of builtins excepted) — a raise in that window strands the
+   references with no release path. ``x.attr = pool.alloc(n)`` (retain
+   and record in one statement) is the canonical safe shape.
+3. **Drained ledgers**: every attribute a retained value is stored
+   under must be drained somewhere in the analyzed tree — a function
+   that reads that attribute and calls ``decref`` — either directly or
+   through a chain of ownership transfers (``_new_pages`` →
+   ``_slot_pages`` → released at slot retirement).
+
+A ``return`` of the retained value transfers ownership to the caller
+(the promotion-extractor pattern); callers are then covered by the same
+rules at their own store sites.
+
+``PagePool`` itself (the class DEFINING alloc/decref) is exempt — its
+bodies are the primitive, not call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Checker,
+    Project,
+    Violation,
+    call_name,
+    dotted_name,
+    walk_functions,
+    walk_own,
+)
+
+RETAIN_METHODS = {"alloc", "incref"}
+RELEASE_METHODS = {"decref"}
+#: calls allowed between a retain and its ownership store (cannot
+#: meaningfully raise for the argument shapes used here)
+SAFE_CALLS = {
+    "len", "list", "tuple", "int", "min", "max", "range", "bool",
+    "perf_counter", "monotonic", "time",
+}
+
+
+def _header_nodes(stmt: ast.stmt):
+    """The nodes a statement evaluates BEFORE entering any nested
+    block: compound statements contribute only their header expressions
+    (their bodies are separate blocks, scanned on their own)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield from ast.walk(stmt.test)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield from ast.walk(stmt.iter)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield from ast.walk(item.context_expr)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Try)):
+        return
+    else:
+        yield from ast.walk(stmt)
+
+
+def _is_pool_call(call: ast.Call, methods: set[str]) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in methods:
+        return False
+    recv = dotted_name(call.func.value)
+    return "pool" in recv.lower()
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _stored_attr(target: ast.AST) -> "str | None":
+    """Attribute name an assignment target records ownership under:
+    ``req._new_pages = ...`` -> ``_new_pages``;
+    ``self._slot_pages[slot] = ...`` -> ``_slot_pages``."""
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Subscript) and isinstance(
+        target.value, ast.Attribute
+    ):
+        return target.value.attr
+    return None
+
+
+def _calls_outside_safe(node: ast.AST, extra_safe: set[str]) -> "str | None":
+    """First call in ``node`` that could raise (not allowlisted)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in SAFE_CALLS or name in extra_safe or \
+                    leaf in RETAIN_METHODS | RELEASE_METHODS:
+                continue
+            return name or "<dynamic call>"
+    return None
+
+
+class RefcountPairing(Checker):
+    name = "refcount-pairing"
+    description = (
+        "page-pool alloc/incref without a reachable matching release "
+        "(ownership store, paired decref, or return) on all exits"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        out: list[Violation] = []
+        # global ledger book-keeping for rule 3
+        stores: list[tuple] = []      # (mod, line, qual, attr)
+        drains: set[str] = set()      # attrs drained by a decref-holder
+        edges: set[tuple[str, str]] = set()  # attr read -> attr stored
+
+        for mod in project.modules:
+            allocator_classes = self._allocator_classes(mod)
+            for func, qual, cls in walk_functions(mod.tree):
+                if cls in allocator_classes:
+                    continue
+                fout, fstores = self._check_func(mod, func, qual)
+                out.extend(fout)
+                stores.extend(fstores)
+                reads = {
+                    n.attr for n in ast.walk(func)
+                    if isinstance(n, ast.Attribute)
+                    and isinstance(n.ctx, ast.Load)
+                }
+                stored_here = {
+                    s[3] for s in fstores
+                } | self._all_stored_attrs(func)
+                has_release = any(
+                    isinstance(n, ast.Call)
+                    and _is_pool_call(n, RELEASE_METHODS)
+                    for n in ast.walk(func)
+                )
+                if has_release:
+                    drains.update(reads)
+                else:
+                    for r in reads:
+                        for s in stored_here:
+                            if r != s:
+                                edges.add((r, s))
+
+        # propagate drained-ness backwards through ownership transfers
+        changed = True
+        while changed:
+            changed = False
+            for r, s in edges:
+                if s in drains and r not in drains:
+                    drains.add(r)
+                    changed = True
+
+        for mod, line, qual, attr in stores:
+            if attr not in drains:
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=line, col=0,
+                    symbol=qual, key=f"undrained:{attr}",
+                    message=(
+                        f"retained pages stored under '{attr}' but no "
+                        "analyzed function both reads that attribute "
+                        "and calls decref (directly or via an ownership "
+                        "transfer chain): the ledger is never drained"
+                    ),
+                ))
+        return out
+
+    @staticmethod
+    def _allocator_classes(mod) -> set[str]:
+        """Classes whose methods ARE the primitives (defining alloc AND
+        incref AND decref — PagePool and fixture twins): their bodies
+        are skipped, they are not call sites. One module walk, consulted
+        per function."""
+        out: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                names = {
+                    n.name for n in node.body
+                    if isinstance(n, ast.FunctionDef)
+                }
+                if RETAIN_METHODS <= names and "decref" in names:
+                    out.add(node.name)
+        return out
+
+    @staticmethod
+    def _all_stored_attrs(func) -> set[str]:
+        out = set()
+        for n in ast.walk(func):
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            for t in targets:
+                a = _stored_attr(t)
+                if a:
+                    out.add(a)
+        return out
+
+    def _check_func(self, mod, func, qual):
+        out: list[Violation] = []
+        stores: list[tuple] = []
+        has_local_release = any(
+            isinstance(n, ast.Call) and _is_pool_call(n, RELEASE_METHODS)
+            for n in ast.walk(func)
+        )
+        for block in self._blocks(func):
+            for i, stmt in enumerate(block):
+                for call in _header_nodes(stmt):
+                    if not (isinstance(call, ast.Call)
+                            and _is_pool_call(call, RETAIN_METHODS)):
+                        continue
+                    v, st = self._check_retain(
+                        mod, func, qual, block, i, stmt, call,
+                        has_local_release,
+                    )
+                    out.extend(v)
+                    stores.extend(st)
+        return out, stores
+
+    @staticmethod
+    def _blocks(func):
+        """Every statement list in the function (own body only)."""
+        yield func.body
+        for node in walk_own(func):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a nested def's body is ITS block, not ours
+            for field in ("body", "orelse", "finalbody"):
+                blk = getattr(node, field, None)
+                if isinstance(blk, list) and blk and isinstance(
+                    blk[0], ast.stmt
+                ):
+                    yield blk
+            for h in getattr(node, "handlers", []) or []:
+                yield h.body
+
+    def _check_retain(self, mod, func, qual, block, i, stmt, call,
+                      has_local_release):
+        """Classify one retain site; returns (violations, ledger stores)."""
+        out: list[Violation] = []
+        stores: list[tuple] = []
+        method = call.func.attr
+
+        # retain-and-record in one statement: x.attr = pool.alloc(n)
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            attrs = [_stored_attr(t) for t in stmt.targets]
+            named = [a for a in attrs if a]
+            if named:
+                for a in named:
+                    stores.append((mod, stmt.lineno, qual, a))
+                return out, stores
+            # plain local name: scan forward for transfer/release
+            locals_ = set()
+            for t in stmt.targets:
+                locals_.update(_names_in(t))
+            return self._scan_forward(
+                mod, qual, block, i, stmt, method, locals_,
+                has_local_release,
+            )
+
+        # bare expression: pool.incref(pins) — the argument names carry
+        # the retained pages
+        if isinstance(stmt, ast.Expr) and stmt.value is call:
+            if method == "alloc":
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=stmt.lineno,
+                    col=stmt.col_offset, symbol=qual, key="alloc-dropped",
+                    message=(
+                        "alloc() result discarded: the pages are "
+                        "allocated at refcount 1 with no holder — "
+                        "nothing can ever release them"
+                    ),
+                ))
+                return out, stores
+            names = set()
+            for a in call.args:
+                names.update(_names_in(a))
+            names.discard("self")
+            if not names:
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=stmt.lineno,
+                    col=stmt.col_offset, symbol=qual,
+                    key="incref-anonymous",
+                    message=(
+                        "incref() of an expression with no local name: "
+                        "the extra references cannot be tracked to a "
+                        "release — bind the page list to a name that an "
+                        "ownership store or decref provably covers"
+                    ),
+                ))
+                return out, stores
+            return self._scan_forward(
+                mod, qual, block, i, stmt, method, names,
+                has_local_release,
+            )
+
+        # retain nested in a larger expression (return pool.alloc(n),
+        # f(pool.alloc(n))...): a return transfers to the caller; any
+        # other shape is untrackable
+        if isinstance(stmt, ast.Return):
+            return out, stores
+        out.append(Violation(
+            rule=self.name, path=mod.path, line=stmt.lineno,
+            col=stmt.col_offset, symbol=qual, key=f"{method}-embedded",
+            message=(
+                f"{method}() embedded in a larger expression: the "
+                "retained pages have no name a release path can be "
+                "checked against — assign them first"
+            ),
+        ))
+        return out, stores
+
+    def _scan_forward(self, mod, qual, block, i, stmt, method, names,
+                      has_local_release):
+        """The retained pages live in local ``names``; walk the rest of
+        the block for the ownership disposition and flag raising calls
+        in the unprotected window."""
+        out: list[Violation] = []
+        stores: list[tuple] = []
+        for later in block[i + 1:]:
+            # disposition reached?
+            if isinstance(later, ast.Assign) and (
+                _names_in(later.value) & names
+            ):
+                attrs = [_stored_attr(t) for t in later.targets]
+                named = [a for a in attrs if a]
+                if named:
+                    for a in named:
+                        stores.append((mod, later.lineno, qual, a))
+                    return out, stores
+                # renamed local: follow the new name too
+                for t in later.targets:
+                    names |= _names_in(t)
+                continue
+            if isinstance(later, ast.Return):
+                if later.value is not None and (
+                    _names_in(later.value) & names
+                ):
+                    return out, stores  # ownership handed to the caller
+                if not has_local_release:
+                    out.append(Violation(
+                        rule=self.name, path=mod.path, line=later.lineno,
+                        col=later.col_offset, symbol=qual,
+                        key=f"{method}-dropped-at-return",
+                        message=(
+                            "function returns WITHOUT the pages "
+                            f"retained by {method}() above: the "
+                            "references are dropped with no release "
+                            "path"
+                        ),
+                    ))
+                return out, stores
+            if any(
+                isinstance(n, ast.Call)
+                and _is_pool_call(n, RELEASE_METHODS)
+                and (_names_in(n) & names)
+                for n in ast.walk(later)
+            ):
+                return out, stores  # released locally
+            # still in the unprotected window: a raise here strands refs
+            raiser = _calls_outside_safe(later, extra_safe=set())
+            if raiser is not None:
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=later.lineno,
+                    col=later.col_offset, symbol=qual,
+                    key=f"raise-window:{raiser.rsplit('.', 1)[-1]}",
+                    message=(
+                        f"{raiser}() can raise between the {method}() "
+                        "and the statement that records ownership: the "
+                        "retained pages would leak — record ownership "
+                        "first (or wrap with a releasing finally)"
+                    ),
+                ))
+                return out, stores
+        else:
+            if not has_local_release:
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=stmt.lineno,
+                    col=stmt.col_offset, symbol=qual,
+                    key=f"{method}-unreleased",
+                    message=(
+                        f"{method}() result reaches the end of the "
+                        "block with no ownership store, return, or "
+                        "decref: the references leak"
+                    ),
+                ))
+        return out, stores

@@ -1,0 +1,150 @@
+"""thread-ownership: engine-thread state crosses threads via snapshots.
+
+The engine thread is the batcher's sole owner (serving/server.py design
+note); HTTP handlers and the metrics scrape run on other threads. The
+PR-4 contract for crossing that boundary: either a ``*_stats()`` method
+that SNAPSHOTS engine state before returning it (``kv_stats`` list()s
+the dicts it iterates), or a GIL-atomic ``len()`` of one container (the
+documented approximate-read contract of ``InferenceEngine.stats``).
+Anything else — iterating ``running`` mid-admission, reading the pool's
+free list — races the engine thread and raises (dict mutated during
+iteration) or returns torn state.
+
+Conventions this checker reads:
+
+- ``# owner: engine`` on a ``self.x = ...`` line (anywhere in the
+  project) declares attribute ``x`` engine-thread-only.
+- Cross-thread contexts are every ``async def`` plus any function whose
+  ``def`` line carries ``# graftlint: cross-thread`` (the event-loop-
+  side InferenceEngine methods), in the serving/metrics consumer
+  modules.
+
+In a cross-thread context, any read or write of an engine-owned
+attribute is flagged unless the access is the sole argument of a bare
+``len()`` call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    Checker,
+    Project,
+    Violation,
+    walk_functions,
+)
+
+#: modules whose functions can run off the engine thread (the HTTP
+#: planes and the prometheus side); models/ is engine-side by layering
+CONSUMER_PATH_PARTS = ("serving/", "metrics/", "graftlint_fixtures/")
+
+
+class ThreadOwnership(Checker):
+    name = "thread-ownership"
+    description = (
+        "# owner: engine attributes read outside the engine thread "
+        "without a *_stats() snapshot or an atomic len()"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        owned = self._collect_owned(project)
+        if not owned:
+            return []
+        out: list[Violation] = []
+        for mod in project.modules:
+            if not any(p in mod.path for p in CONSUMER_PATH_PARTS):
+                continue
+            for func, qual, _cls in walk_functions(mod.tree):
+                is_cross = isinstance(func, ast.AsyncFunctionDef) or \
+                    mod.def_has_marker(func, "cross-thread")
+                if not is_cross:
+                    continue
+                out.extend(self._check_func(mod, func, qual, owned))
+        return out
+
+    @staticmethod
+    def _collect_owned(project: Project) -> set[str]:
+        owned: set[str] = set()
+        for mod in project.modules:
+            if not mod.owner_lines:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    # the annotation may ride the assignment line(s) or
+                    # a standalone comment line immediately above (a
+                    # TRAILING comment on the previous statement does
+                    # not bleed down)
+                    end = getattr(node, "end_lineno", node.lineno)
+                    hit = any(
+                        ln in mod.owner_lines
+                        for ln in range(node.lineno, end + 1)
+                    ) or (
+                        node.lineno - 1 in mod.owner_lines
+                        and mod.comment_only_line(node.lineno - 1)
+                    )
+                    if not hit:
+                        continue
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            owned.add(t.attr)
+        return owned
+
+    def _check_func(self, mod, func, qual, owned) -> list[Violation]:
+        # attribute nodes that are the sole argument of a bare len()
+        # call are the sanctioned GIL-atomic read; attribute nodes that
+        # ARE a call's func are METHOD lookups on some other object
+        # (task.done(), fut.result()) — the owned-name match is
+        # receiver-blind, so treating those as state reads would flag
+        # every asyncio future in a handler
+        atomic: set[int] = set()
+        method_lookups: set[int] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    method_lookups.add(id(node.func))
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "len" and len(node.args) == 1
+                        and not node.keywords):
+                    atomic.add(id(node.args[0]))
+        out: list[Violation] = []
+        # nested ASYNC defs are their own cross-thread contexts (checked
+        # separately — descending again would double-report); nested
+        # sync helpers run on this thread when called inline, so they
+        # stay in the walk
+        def walk_same_context(root):
+            stack = list(ast.iter_child_nodes(root))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.AsyncFunctionDef):
+                    continue
+                yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+        for node in walk_same_context(func):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in owned or id(node) in atomic \
+                    or id(node) in method_lookups:
+                continue
+            action = (
+                "written" if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read"
+            )
+            out.append(Violation(
+                rule=self.name, path=mod.path, line=node.lineno,
+                col=node.col_offset, symbol=qual, key=node.attr,
+                message=(
+                    f"engine-owned attribute '{node.attr}' {action} from "
+                    "a cross-thread context; go through a *_stats() "
+                    "snapshot (or an atomic len()) instead of touching "
+                    "engine state directly"
+                ),
+            ))
+        return out

@@ -1,0 +1,190 @@
+"""tracer-leak: no host-state writes from inside traced function bodies.
+
+A jitted function body runs at TRACE time with abstract tracers for
+values. Writing a tracer into ``self.*`` or a module global "works"
+once, then the stored tracer escapes its trace (JAX's leaked-tracer
+error at best, silent staleness at worst: the attribute keeps the value
+from compile #1 forever while the jit cache replays the compiled
+program). The batcher keeps every jitted step purely functional over
+``BatchState`` for exactly this reason.
+
+Traced scopes: functions decorated with ``jax.jit``/``pjit`` (directly
+or through ``functools.partial``), functions wrapped by name anywhere
+in the module (``f = jax.jit(g)``), everything nested inside those, and
+local functions handed to ``jax.lax.scan``/``while_loop``/``fori_loop``
+/``cond``/``vmap``/``jax.checkpoint`` (their bodies trace the same
+way).
+
+Flags inside traced scopes: assignments/augmented assignments to
+``self.<attr>`` or to attributes of any parameter, ``global``/
+``nonlocal`` declarations, and subscript stores into module-level
+names.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (
+    JIT_WRAPPERS,
+    Checker,
+    Project,
+    Violation,
+    call_name,
+    dotted_name,
+    is_jit_decorator,
+    walk_functions,
+    walk_own,
+)
+
+TRACING_CONSUMERS = {
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.vmap", "vmap", "jax.checkpoint", "jax.remat",
+}
+
+
+class TracerLeak(Checker):
+    name = "tracer-leak"
+    description = (
+        "writes to self.* or module globals from inside jitted/traced "
+        "function bodies"
+    )
+
+    def run(self, project: Project) -> list[Violation]:
+        out: list[Violation] = []
+        for mod in project.modules:
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod) -> list[Violation]:
+        module_names = self._module_level_names(mod.tree)
+        # names handed to a jit wrapper or a tracing consumer anywhere
+        # in the module (f = jax.jit(g); lax.scan(body, ...)); name-
+        # level matching is a heuristic, which is all a linter needs
+        wrapped: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                n = call_name(node)
+                if n in JIT_WRAPPERS and node.args and isinstance(
+                    node.args[0], ast.Name
+                ):
+                    wrapped.add(node.args[0].id)
+                elif n in TRACING_CONSUMERS:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            wrapped.add(arg.id)
+
+        funcs = list(walk_functions(mod.tree))
+        traced_quals: set[str] = set()
+        for func, qual, _cls in funcs:
+            if func.name in wrapped or any(
+                is_jit_decorator(d) for d in func.decorator_list
+            ):
+                traced_quals.add(qual)
+        out: list[Violation] = []
+        for func, qual, _cls in funcs:
+            traced = qual in traced_quals or any(
+                qual.startswith(t + ".") for t in traced_quals
+            )
+            if traced:
+                out.extend(self._check_traced_body(
+                    mod, func, qual, module_names
+                ))
+        return out
+
+    def _check_traced_body(self, mod, func, qual, module_names):
+        params = {
+            a.arg for a in (
+                func.args.posonlyargs + func.args.args
+                + func.args.kwonlyargs
+            )
+        }
+        if func.args.vararg:
+            params.add(func.args.vararg.arg)
+        if func.args.kwarg:
+            params.add(func.args.kwarg.arg)
+        out: list[Violation] = []
+        for node in walk_own(func):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                out.append(Violation(
+                    rule=self.name, path=mod.path, line=node.lineno,
+                    col=node.col_offset, symbol=qual,
+                    key=f"{kw}:{','.join(node.names)}",
+                    message=(
+                        f"'{kw} {', '.join(node.names)}' inside a traced "
+                        "body: host state written at trace time leaks "
+                        "tracers (or freezes at compile #1); thread the "
+                        "value through the carry instead"
+                    ),
+                ))
+                continue
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                for leaf in self._flatten(t):
+                    v = self._bad_target(mod, leaf, qual, params,
+                                         module_names)
+                    if v is not None:
+                        out.append(v)
+        return out
+
+    @staticmethod
+    def _flatten(t: ast.AST):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from TracerLeak._flatten(e)
+        else:
+            yield t
+
+    def _bad_target(self, mod, t, qual, params, module_names):
+        if isinstance(t, ast.Attribute):
+            base = t.value
+            if isinstance(base, ast.Name) and (
+                base.id == "self" or base.id in params
+            ):
+                who = ("self" if base.id == "self"
+                       else f"parameter '{base.id}'")
+                return Violation(
+                    rule=self.name, path=mod.path, line=t.lineno,
+                    col=t.col_offset, symbol=qual, key=f"attr:{t.attr}",
+                    message=(
+                        f"attribute write {base.id}.{t.attr} inside a "
+                        f"traced body stores a tracer on {who}; jitted "
+                        "steps must stay purely functional (return the "
+                        "new value in the carry)"
+                    ),
+                )
+        if isinstance(t, ast.Subscript):
+            base = dotted_name(t.value)
+            if base and base.split(".", 1)[0] in module_names:
+                return Violation(
+                    rule=self.name, path=mod.path, line=t.lineno,
+                    col=t.col_offset, symbol=qual, key=f"global:{base}",
+                    message=(
+                        f"subscript store into module-level '{base}' "
+                        "inside a traced body runs at trace time only "
+                        "(and can capture tracers); mutate it from host "
+                        "code outside the jit"
+                    ),
+                )
+        return None
+
+    @staticmethod
+    def _module_level_names(tree: ast.Module) -> set[str]:
+        names: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for leaf in TracerLeak._flatten(t):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                names.add(node.target.id)
+        return names

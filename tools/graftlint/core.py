@@ -1,0 +1,384 @@
+"""graftlint framework: project loading, annotations, baseline, runner.
+
+Checkers are AST-level and never execute the analyzed code, so modules
+that need a TPU (or a live jax) analyze the same as pure host code. The
+framework owns everything rule-independent:
+
+- loading a file set into :class:`Project` (one parsed
+  :class:`ModuleInfo` per file, with its comment annotations extracted
+  via :mod:`tokenize` so string literals can't spoof them);
+- ``# graftlint: disable=<rule>`` suppression matching;
+- the checked-in baseline (grandfathered violations with justification)
+  and its delta semantics (strict mode refuses stale entries too);
+- shared AST helpers checkers would otherwise each reinvent
+  (dotted call names, function walks, def-line markers).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+#: directories never analyzed unless explicitly given as a root (the
+#: fixture tree exists to FAIL the checkers; analyzing it by default
+#: would make every `make analyze` red by design)
+EXCLUDED_DIR_NAMES = ("graftlint_fixtures", "__pycache__", ".git")
+
+_COMMENT_RE = re.compile(r"#\s*graftlint:\s*([a-z-]+)(?:=([\w,.-]+))?")
+_OWNER_RE = re.compile(r"owner:\s*engine\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding. ``symbol`` (enclosing def qualname) and ``key`` (a
+    checker-chosen stable token, e.g. the flagged call name) form the
+    baseline fingerprint together with ``rule`` and ``path`` — line
+    numbers deliberately do not: they drift with every edit."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"
+    key: str = ""
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.key)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+            f"{self.message} [{self.symbol}]"
+        )
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus its comment-level annotations."""
+
+    path: str                      # repo-relative, forward slashes
+    tree: ast.Module
+    lines: list[str]
+    #: line -> rule names suppressed on that line ("all" wildcard)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    #: line -> graftlint markers on that line (e.g. "hot-path")
+    markers: dict[int, set[str]] = field(default_factory=dict)
+    #: lines carrying an ``# owner: engine`` annotation
+    owner_lines: set[int] = field(default_factory=set)
+
+    def line_has_marker(self, line: int, marker: str) -> bool:
+        return marker in self.markers.get(line, ())
+
+    def def_has_marker(self, node: ast.AST, marker: str) -> bool:
+        """Marker on the ``def`` line, any decorator line, or a
+        STANDALONE comment line immediately above the first
+        decorator/def (a trailing comment on the previous statement
+        does not bleed down — same contract as suppressions)."""
+        first = min(
+            [node.lineno] + [d.lineno for d in node.decorator_list]
+        )
+        candidates = {node.lineno}
+        candidates.update(d.lineno for d in node.decorator_list)
+        if self.comment_only_line(first - 1):
+            candidates.add(first - 1)
+        return any(self.line_has_marker(ln, marker) for ln in candidates)
+
+    def comment_only_line(self, line: int) -> bool:
+        """True when ``line`` holds nothing but a comment — only those
+        annotate the statement BELOW them; a trailing comment annotates
+        its own line alone (no bleed onto the next statement)."""
+        if not (1 <= line <= len(self.lines)):
+            return False
+        return self.lines[line - 1].lstrip().startswith("#")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A ``# graftlint: disable=`` comment suppresses its own line,
+        plus the line below when it is a standalone comment line (the
+        convention for statements too long to carry a trailing one)."""
+        rules = set(self.suppressions.get(line, ()))
+        if self.comment_only_line(line - 1):
+            rules |= set(self.suppressions.get(line - 1, ()))
+        return rule in rules or "all" in rules
+
+
+@dataclass
+class Project:
+    """The analyzed file set. Checkers receive the whole project so
+    cross-module rules (annotation collection in models/, enforcement in
+    serving/) need no side channels."""
+
+    root: str
+    modules: list[ModuleInfo]
+    parse_errors: list[Violation] = field(default_factory=list)
+
+
+
+class Checker:
+    """Plugin protocol: subclass, set ``name``/``description``,
+    implement :meth:`run`. Suppression filtering happens in the runner —
+    checkers report everything they see."""
+
+    name = "abstract"
+    description = ""
+
+    def run(self, project: Project) -> list[Violation]:
+        raise NotImplementedError
+
+
+# --- comment annotation extraction ---------------------------------------
+
+
+def _extract_annotations(source: str, info: ModuleInfo) -> None:
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [
+            (t.start[0], t.string) for t in tokens
+            if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # fall back to a line scan; a file this broken will also fail to
+        # ast.parse and be reported as a parse error
+        comments = [
+            (i + 1, line) for i, line in enumerate(info.lines)
+            if "#" in line
+        ]
+    for line_no, text in comments:
+        if _OWNER_RE.search(text):
+            info.owner_lines.add(line_no)
+        m = _COMMENT_RE.search(text)
+        if not m:
+            continue
+        kind, arg = m.group(1), m.group(2)
+        if kind == "disable" and arg:
+            info.suppressions.setdefault(line_no, set()).update(
+                a.strip() for a in arg.split(",") if a.strip()
+            )
+        else:
+            info.markers.setdefault(line_no, set()).add(
+                kind if not arg else f"{kind}={arg}"
+            )
+
+
+# --- project loading ------------------------------------------------------
+
+
+def _iter_py_files(root_arg: str) -> list[str]:
+    if os.path.isfile(root_arg):
+        return [root_arg] if root_arg.endswith(".py") else []
+    # an excluded dir name EXPLICITLY given as a root is analyzed (this
+    # is how the fixture tests point the suite at a seeded violation)
+    explicit = any(part in EXCLUDED_DIR_NAMES
+                   for part in os.path.abspath(root_arg).split(os.sep))
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root_arg):
+        if not explicit:
+            dirnames[:] = [
+                d for d in dirnames if d not in EXCLUDED_DIR_NAMES
+            ]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def load_project(paths: list[str], root: str | None = None) -> Project:
+    """Parse every ``.py`` under ``paths`` (files or directories) into a
+    :class:`Project`. Unparseable files become ``parse-error``
+    violations instead of aborting the run — a syntax error in one file
+    must not hide findings in the rest."""
+    root = os.path.abspath(root or os.getcwd())
+    project = Project(root=root, modules=[])
+    seen: set[str] = set()
+    for p in paths:
+        for fpath in _iter_py_files(p):
+            apath = os.path.abspath(fpath)
+            if apath in seen:
+                continue
+            seen.add(apath)
+            rel = os.path.relpath(apath, root).replace(os.sep, "/")
+            try:
+                with open(apath, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError as e:
+                project.parse_errors.append(Violation(
+                    rule="parse-error", path=rel, line=0, col=0,
+                    message=f"unreadable: {e}", key="unreadable",
+                ))
+                continue
+            info = ModuleInfo(
+                path=rel, tree=ast.Module(body=[], type_ignores=[]),
+                lines=source.splitlines(),
+            )
+            _extract_annotations(source, info)
+            try:
+                info.tree = ast.parse(source, filename=rel)
+            except SyntaxError as e:
+                project.parse_errors.append(Violation(
+                    rule="parse-error", path=rel, line=e.lineno or 0,
+                    col=e.offset or 0, message=f"syntax error: {e.msg}",
+                    key="syntax",
+                ))
+                continue
+            project.modules.append(info)
+    return project
+
+
+# --- shared AST helpers ---------------------------------------------------
+
+
+#: names that wrap a function into a jit-compiled callable
+JIT_WRAPPERS = ("jax.jit", "jit", "jax.pjit", "pjit")
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, "" for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jax.jit(...)``."""
+    if dotted_name(dec) in JIT_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name in JIT_WRAPPERS:
+            return True
+        if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return dotted_name(dec.args[0]) in JIT_WRAPPERS
+    return False
+
+
+def walk_own(func: ast.AST):
+    """Walk a function's OWN body: statements of nested defs belong to
+    the nested function's report, not this one's."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def walk_functions(tree: ast.Module):
+    """Yield ``(func_node, qualname, class_name)`` for every function in
+    the module, depth-first. ``qualname`` joins nesting with dots
+    (``Class.method.inner``); ``class_name`` is the nearest enclosing
+    class or ""."""
+
+    def visit(node, prefix: str, cls: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, q, cls
+                yield from visit(child, q, cls)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield from visit(child, q, child.name)
+            else:
+                yield from visit(child, prefix, cls)
+
+    yield from visit(tree, "", "")
+
+
+# --- baseline -------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict:
+    """``{rule: [{path, symbol, key, count?, reason}, ...]}``. Every
+    entry MUST carry a non-empty ``reason`` — a grandfathered violation
+    without a written justification is itself an error. ``count``
+    (default 1) is how many sites the entry covers: the fingerprint
+    deliberately excludes line numbers (they drift), so the count is
+    what stops a NEW violation with the same fingerprint from hiding
+    behind an old one."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    for rule, entries in data.items():
+        for e in entries:
+            if not e.get("reason"):
+                raise ValueError(
+                    f"baseline entry for {rule} at {e.get('path')} has "
+                    "no 'reason': every grandfathered violation needs a "
+                    "written justification"
+                )
+    return data
+
+
+def run_checkers(
+    project: Project,
+    checkers: list[Checker],
+    baseline: dict | None = None,
+) -> tuple[list[Violation], list[Violation], list[dict]]:
+    """Run every checker; returns ``(new, baselined, stale)`` where
+    ``new`` are unsuppressed violations absent from the baseline,
+    ``baselined`` matched an entry, and ``stale`` are baseline entries
+    that no longer fire (strict mode refuses those: a fixed violation
+    must leave the baseline with the fix)."""
+    baseline = baseline or {}
+    by_path = {m.path: m for m in project.modules}
+    raw: list[Violation] = list(project.parse_errors)
+    for checker in checkers:
+        raw.extend(checker.run(project))
+
+    fingerprints: dict[tuple, dict] = {}
+    budget: dict[tuple, int] = {}  # sites each entry may still absorb
+    for rule, entries in baseline.items():
+        for e in entries:
+            fp = (rule, e.get("path", ""), e.get("symbol", "<module>"),
+                  e.get("key", ""))
+            fingerprints[fp] = e
+            budget[fp] = int(e.get("count", 1))
+
+    new: list[Violation] = []
+    baselined: list[Violation] = []
+    fired: dict[tuple, int] = {}
+    seen_exact: set[tuple] = set()
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.rule)):
+        exact = (v.rule, v.path, v.line, v.col, v.symbol, v.key)
+        if exact in seen_exact:
+            continue
+        seen_exact.add(exact)
+        mod = by_path.get(v.path)
+        if mod is not None and mod.suppressed(v.rule, v.line):
+            continue
+        fp = v.fingerprint()
+        if fp in fingerprints and budget.get(fp, 0) > 0:
+            # count-bounded: a NEW violation sharing an old entry's
+            # fingerprint (lines excluded — they drift) must not hide
+            # behind it once the entry's site count is used up
+            budget[fp] -= 1
+            fired[fp] = fired.get(fp, 0) + 1
+            baselined.append(v)
+        else:
+            new.append(v)
+    # staleness is only judged for entries whose file was ANALYZED this
+    # run (a subset invocation must not misread the rest of the
+    # baseline as fixed); an UNDER-firing count is stale too — fixing
+    # one of an entry's sites must shrink its count with the fix
+    stale = []
+    for fp, e in fingerprints.items():
+        if fp[1] not in by_path:
+            continue
+        n = fired.get(fp, 0)
+        if n < int(e.get("count", 1)):
+            stale.append(dict(e, rule=fp[0], fired=n))
+    return new, baselined, stale
