@@ -67,7 +67,7 @@ san-test:
 # analyze runs right after lint — fail fast on invariant regressions
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
-	bench-prefix-cache bench-paged-kv bench-spec
+	bench-prefix-cache bench-paged-kv bench-spec bench-sched
 	python -m pytest tests/ -q
 
 bench:
@@ -104,12 +104,22 @@ bench-paged-kv:
 bench-spec:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.spec_bench
 
+# CPU-runnable microbench: the SLO scheduler — plan-pass cost at a deep
+# queue (µs of host work per batcher step), a forced-preemption and
+# queue-cap-rejection determinism check, and a tiny open-loop Poisson
+# two-tenant smoke through the fifo AND slo arms asserting the
+# goodput/rejection/preemption A/B fields are present and sane (one
+# JSON line with plan_us, forced_preemptions, queue_cap_rejected and
+# the openloop/goodput/ttft field set).
+bench-sched:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.sched_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	clean watch
+	bench-sched clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

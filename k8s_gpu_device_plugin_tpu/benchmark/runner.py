@@ -494,6 +494,32 @@ def _run_serve() -> dict:
             r.spec_ms_per_accepted_token, 3
         ),
         "spec_gamma": r.spec_gamma,
+        # slo-vs-fifo open-loop A/B (serving/scheduler.py): the SAME
+        # Poisson two-tenant trace (2x overload phase) through both
+        # policies — p50/p99 TTFT for the deadlined gold tenant in the
+        # overload phase, aggregate inter-token percentiles, goodput
+        # (tokens that met their deadline), deadline-miss rate and the
+        # scheduler's interventions. The slo win is these rows' delta.
+        "openloop_requests": r.openloop_requests,
+        "openloop_base_rps": round(r.openloop_base_rps, 2),
+        "openloop_overload_x": r.openloop_overload_x,
+        "ttft_p50_ms_hi_fifo": round(r.ttft_p50_ms_hi_fifo, 1),
+        "ttft_p99_ms_hi_fifo": round(r.ttft_p99_ms_hi_fifo, 1),
+        "ttft_p50_ms_hi_slo": round(r.ttft_p50_ms_hi_slo, 1),
+        "ttft_p99_ms_hi_slo": round(r.ttft_p99_ms_hi_slo, 1),
+        "itl_p50_ms_fifo": round(r.itl_p50_ms_fifo, 2),
+        "itl_p99_ms_fifo": round(r.itl_p99_ms_fifo, 2),
+        "itl_p50_ms_slo": round(r.itl_p50_ms_slo, 2),
+        "itl_p99_ms_slo": round(r.itl_p99_ms_slo, 2),
+        "goodput_tokens_hi_fifo": r.goodput_tokens_hi_fifo,
+        "goodput_tokens_hi_slo": r.goodput_tokens_hi_slo,
+        "goodput_tokens_fifo": r.goodput_tokens_fifo,
+        "goodput_tokens_slo": r.goodput_tokens_slo,
+        "deadline_miss_pct_hi_fifo": round(r.deadline_miss_pct_hi_fifo, 1),
+        "deadline_miss_pct_hi_slo": round(r.deadline_miss_pct_hi_slo, 1),
+        "rejected_fifo": r.rejected_fifo,
+        "rejected_slo": r.rejected_slo,
+        "preemptions_slo": r.preemptions_slo,
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),

@@ -1,0 +1,196 @@
+"""SLO-scheduler microbench + open-loop smoke (CPU; ``make bench-sched``).
+
+The scheduler's costs are pure host work, so CPU measures them honestly:
+
+- **plan cost**: one ``SloScheduler.plan`` pass (quota refill + policy
+  sort + preemption scan) at a deep queue, in µs — this runs once per
+  batcher step and must stay invisible next to a decode dispatch.
+- **open-loop smoke**: a tiny Poisson two-tenant trace with a 2x
+  overload phase through the fifo AND slo arms (the serve_bench
+  ``sched_ab`` machinery at miniature scale), asserting the A/B row's
+  goodput/rejection/preemption fields are present and sane.
+- **determinism checks**: a hand-built trace that MUST preempt (bronze
+  monopolizes every slot, a deadlined gold request arrives) and a
+  queue cap that MUST reject — the two interventions the slo policy
+  exists for, asserted rather than hoped for.
+
+Prints one JSON line, like the host_overhead/prefix_cache/paged/spec
+twins.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def _tiny_setup():
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.models.llama import init_params
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    return cfg, params
+
+
+def plan_cost_bench(depth: int = 256, passes: int = 200) -> dict:
+    """µs per SloScheduler.plan pass over a ``depth``-deep queue (the
+    sort + quota refill + preemption scan, no device work). Uses a
+    stub batcher so this measures the SCHEDULER, not jax."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        SloScheduler,
+        TenantQuota,
+    )
+
+    class _Req:
+        __slots__ = ("rid", "tenant", "priority", "deadline", "prompt",
+                     "max_new", "out", "defer_counted", "preemptions")
+
+        def __init__(self, rid):
+            self.rid = rid
+            self.tenant = ("gold", "silver", "bronze")[rid % 3]
+            self.priority = rid % 3
+            self.deadline = None if rid % 2 else 10.0 + rid
+            self.prompt = [1] * 64
+            self.max_new = 32
+            self.out = []
+            self.defer_counted = False
+            self.preemptions = 0
+
+    class _StubCb:
+        n_slots = 8
+        chunk = 16
+        supports_preemption = True
+        metrics = None
+
+        def __init__(self):
+            self.pending = [_Req(i) for i in range(depth)]
+            self.running = {}
+            self.prefilling = {}
+
+    sched = SloScheduler(quotas={
+        "gold": TenantQuota(rate=1000.0, burst=4000.0, weight=4.0),
+        "bronze": TenantQuota(rate=200.0, burst=800.0, weight=1.0),
+    })
+    cb = _StubCb()
+    for r in cb.pending:
+        sched.on_submit(r, cb)
+    sched.plan(cb, time.perf_counter())  # warm tenant states
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        sched.plan(cb, time.perf_counter())
+    plan_us = (time.perf_counter() - t0) / passes * 1e6
+    return {"plan_depth": depth, "plan_us": round(plan_us, 2)}
+
+
+def openloop_smoke() -> dict:
+    """serve_bench's slo-vs-fifo open-loop A/B at miniature scale:
+    Poisson arrivals, two tenants, 2x overload — asserts every field
+    the runner serve row publishes exists and is sane."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        sched_openloop_ab,
+    )
+
+    cfg, params = _tiny_setup()
+    fields = sched_openloop_ab(
+        cfg, params, n_slots=2, max_len=128,
+        prompt_buckets=(16, 32, 64), chunked_prefill=16,
+        base_rps=6.0, base_s=1.0, overload_s=1.5, overload_x=2.0,
+        max_new=12, prompt_len=24, sys_len=12,
+        gold_deadline_ms=400, max_queue=16, seed=3,
+    )
+    for key in (
+        "goodput_tokens_hi_fifo", "goodput_tokens_hi_slo",
+        "goodput_tokens_fifo", "goodput_tokens_slo",
+        "rejected_fifo", "rejected_slo", "preemptions_slo",
+        "ttft_p99_ms_hi_fifo", "ttft_p99_ms_hi_slo",
+        "deadline_miss_pct_hi_fifo", "deadline_miss_pct_hi_slo",
+    ):
+        assert key in fields, f"A/B row missing {key}"
+        assert fields[key] >= 0, f"{key} negative: {fields[key]}"
+    assert fields["openloop_requests"] > 0
+    assert fields["goodput_tokens_slo"] > 0, "slo arm produced no goodput"
+    assert fields["goodput_tokens_fifo"] > 0, "fifo arm produced no goodput"
+    return fields
+
+
+def determinism_checks() -> dict:
+    """The two interventions, forced: (a) bronze fills every slot with
+    long decodes, a deadlined gold request arrives -> the slo policy
+    MUST preempt and gold must finish first; (b) a queue cap MUST
+    reject the overflow with SchedulerOverloadError."""
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        Scheduler,
+        SchedulerOverloadError,
+        SloScheduler,
+    )
+
+    cfg, params = _tiny_setup()
+
+    def prompt(key, n):
+        return jax.random.randint(
+            jax.random.key(key), (n,), 1, cfg.vocab_size, "int32"
+        ).tolist()
+
+    sched = SloScheduler()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=128, prompt_buckets=(16, 32),
+        chunked_prefill=16, scheduler=sched,
+    )
+    for i in range(2):
+        cb.submit(prompt(i, 12), max_new=64, tenant="bronze", priority=2)
+    for _ in range(10):
+        cb.step()
+    assert cb.running, "bronze requests should be decoding"
+    gold = cb.submit(prompt(9, 12), max_new=8, tenant="gold", priority=0,
+                     deadline_ms=1)
+    guard = 0
+    while gold not in cb.done:
+        cb.step()
+        guard += 1
+        assert guard < 500, "gold never finished"
+    assert sched.preemptions >= 1, "no preemption under forced pressure"
+    assert len(cb.done[gold]) == 8
+    bronze_busy = sum(len(r.out) for r in cb.running.values())
+    cb.run()
+    assert bronze_busy < 2 * 64, "gold finished before bronze drained"
+
+    cap = Scheduler(max_queue=2)
+    cb2 = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=128, prompt_buckets=(16, 32),
+        chunked_prefill=16, scheduler=cap,
+    )
+    rejected = 0
+    for i in range(5):
+        try:
+            cb2.submit(prompt(20 + i, 12), max_new=4)
+        except SchedulerOverloadError:
+            cap.count_sync_rejection(cb2)
+            rejected += 1
+    assert rejected >= 1, "queue cap never rejected"
+    cb2.run()
+    return {
+        "forced_preemptions": sched.preemptions,
+        "queue_cap_rejected": rejected,
+    }
+
+
+def main() -> dict:
+    out = {"workload": "sched_bench"}
+    out.update(plan_cost_bench())
+    out.update(determinism_checks())
+    out.update({
+        k: (round(v, 2) if isinstance(v, float) else v)
+        for k, v in openloop_smoke().items()
+    })
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

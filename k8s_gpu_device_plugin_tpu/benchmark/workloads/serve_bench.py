@@ -37,6 +37,18 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   ``spec_accepted_per_round`` and ``spec_ms_per_accepted_token`` — the
   speculative win (or loss, for a weak draft) measured against the
   plain pipelined run in the same artifact.
+- the slo-vs-fifo A/B (``sched_ab=True``): an OPEN-LOOP load generator
+  (requests arrive on a clock regardless of completions — the
+  methodology every closed-loop number hides overload behavior from):
+  Poisson or trace-driven arrivals for two tenants (``gold``: high
+  priority, deadlined, shared-system-prefix skew; ``bronze``: low
+  priority, bulk), a base phase at the offered rate and a 2x OVERLOAD
+  phase, replayed identically through the fifo and slo schedulers
+  (serving/scheduler.py). Reported per arm: p50/p99 TTFT for the gold
+  tenant in the overload phase, aggregate inter-token p50/p99, goodput
+  (tokens of requests that met their deadline), deadline-miss rate, and
+  the rejection/preemption counts — the numbers a millions-of-users
+  operator actually runs on.
 
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
@@ -105,6 +117,31 @@ class ServeBenchResult:
     spec_accepted_per_round: float = 0.0
     spec_ms_per_accepted_token: float = 0.0
     spec_gamma: int = 0
+    # slo-vs-fifo open-loop A/B (all zero when sched_ab=False or
+    # chunked prefill is off): _fifo/_slo twins over the SAME trace.
+    # "hi" = the gold (high-priority, deadlined) tenant, measured over
+    # the 2x overload phase; goodput = tokens of requests that finished
+    # by their deadline (requests with none always count).
+    openloop_requests: int = 0
+    openloop_base_rps: float = 0.0
+    openloop_overload_x: float = 0.0
+    ttft_p50_ms_hi_fifo: float = 0.0
+    ttft_p99_ms_hi_fifo: float = 0.0
+    ttft_p50_ms_hi_slo: float = 0.0
+    ttft_p99_ms_hi_slo: float = 0.0
+    itl_p50_ms_fifo: float = 0.0
+    itl_p99_ms_fifo: float = 0.0
+    itl_p50_ms_slo: float = 0.0
+    itl_p99_ms_slo: float = 0.0
+    goodput_tokens_hi_fifo: int = 0
+    goodput_tokens_hi_slo: int = 0
+    goodput_tokens_fifo: int = 0
+    goodput_tokens_slo: int = 0
+    deadline_miss_pct_hi_fifo: float = 0.0
+    deadline_miss_pct_hi_slo: float = 0.0
+    rejected_fifo: int = 0
+    rejected_slo: int = 0
+    preemptions_slo: int = 0
 
 
 class _PrefillRecorder:
@@ -129,6 +166,312 @@ class _PrefillRecorder:
     def on_finish(self, reason: str) -> None: ...
 
 
+class _OpenLoopRecorder(_PrefillRecorder):
+    """Adds inter-token latency sampling (what a streaming client
+    perceives between events) to the prefill recorder."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.itl: list[float] = []
+
+    def observe_inter_token(self, seconds: float) -> None:
+        self.itl.append(seconds)
+
+
+def _pct(xs, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]); 0.0 on empty."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def openloop_trace(
+    cfg,
+    *,
+    seed: int = 0,
+    base_s: float = 4.0,
+    overload_s: float = 4.0,
+    base_rps: float = 4.0,
+    overload_x: float = 2.0,
+    gold_frac: float = 0.4,
+    prompt_len: int = 96,
+    sys_len: int = 48,
+    shared_prefix_frac: float = 0.7,
+    max_new: int = 32,
+    gold_deadline_ms: int = 1500,
+    bronze_deadline_ms: int = 0,
+) -> list[dict]:
+    """Open-loop arrival trace: Poisson arrivals at ``base_rps`` for
+    ``base_s`` seconds, then ``overload_x`` times that for
+    ``overload_s`` (the phase every closed-loop benchmark cannot see —
+    arrivals do NOT wait for completions). Two tenants: ``gold``
+    (priority 0, deadlined, ``shared_prefix_frac`` of its prompts lead
+    with one shared system prefix — the skew real multi-tenant traffic
+    has) and ``bronze`` (priority 2, bulk, random prompts). The trace is
+    a plain list of dicts, so callers can also hand-build or replay one
+    (trace-driven mode)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    # the shared prefix must leave at least one suffix token so every
+    # prompt is exactly prompt_len — a sys_len >= prompt_len would grow
+    # gold prompts past the caller's capacity budget (prompt + max_new
+    # <= max_len) and crash the submit
+    sys_len = max(0, min(sys_len, prompt_len - 1))
+    sys_prefix = rng.integers(
+        1, cfg.vocab_size, size=sys_len, dtype=np.int32
+    ).tolist()
+
+    def arrivals(t0: float, dur: float, rps: float, phase: str):
+        t = t0
+        out = []
+        while True:
+            t += float(rng.exponential(1.0 / rps))
+            if t >= t0 + dur:
+                return out
+            gold = bool(rng.random() < gold_frac)
+            if gold and sys_len and rng.random() < shared_prefix_frac:
+                tail = rng.integers(
+                    1, cfg.vocab_size, size=prompt_len - sys_len,
+                    dtype=np.int32,
+                ).tolist()
+                prompt = sys_prefix + tail
+            else:
+                prompt = rng.integers(
+                    1, cfg.vocab_size, size=prompt_len, dtype=np.int32
+                ).tolist()
+            deadline = gold_deadline_ms if gold else bronze_deadline_ms
+            out.append({
+                "t": t,
+                "tenant": "gold" if gold else "bronze",
+                "priority": 0 if gold else 2,
+                "deadline_ms": deadline or None,
+                "prompt": prompt,
+                "max_new": max_new,
+                "phase": phase,
+            })
+
+    trace = arrivals(0.0, base_s, base_rps, "base")
+    trace += arrivals(base_s, overload_s, base_rps * overload_x, "overload")
+    trace.sort(key=lambda e: e["t"])
+    return trace
+
+
+def open_loop_run(cb, trace: list[dict]) -> dict:
+    """Drive one batcher through an open-loop trace in real time:
+    arrivals submit at their clock instant whatever the queue looks
+    like; queue-full submissions count as rejections and are dropped
+    (what the HTTP plane's 429 does). Returns per-request facts plus
+    the scheduler's own counters."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        SchedulerOverloadError,
+    )
+
+    meta: dict[int, dict] = {}
+    sync_rejected = 0
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(trace) or cb.pending or cb.prefilling or cb.running:
+        now = time.perf_counter() - t0
+        while i < len(trace) and trace[i]["t"] <= now:
+            e = trace[i]
+            i += 1
+            try:
+                rid = cb.submit(
+                    e["prompt"], max_new=e["max_new"], tenant=e["tenant"],
+                    priority=e["priority"], deadline_ms=e["deadline_ms"],
+                )
+            except SchedulerOverloadError:
+                if cb.scheduler is not None:
+                    cb.scheduler.count_sync_rejection(cb)
+                sync_rejected += 1
+                continue
+            meta[rid] = e
+        if cb.pending or cb.prefilling or cb.running:
+            cb.step()
+        elif i < len(trace):
+            time.sleep(max(0.0, min(0.005, trace[i]["t"] - now)))
+    wall = time.perf_counter() - t0
+
+    per_request = []
+    async_rejected = 0
+    for rid, e in meta.items():
+        req = cb.done_requests.get(rid)
+        if req is None:
+            continue
+        rejected = req.reject_reason is not None
+        if rejected:
+            async_rejected += 1
+        met = (not rejected) and (
+            req.deadline is None or req.t_done <= req.deadline
+        )
+        per_request.append({
+            "tenant": e["tenant"],
+            "phase": e["phase"],
+            "deadlined": e["deadline_ms"] is not None,
+            "rejected": rejected,
+            "preemptions": req.preemptions,
+            "ttft_s": (
+                req.t_first_tok - req.t_submit if req.t_first_tok else None
+            ),
+            "met_deadline": met,
+            "tokens": len(req.out),
+            "goodput": len(req.out) if met else 0,
+        })
+    stats = (
+        cb.scheduler.sched_stats() if cb.scheduler is not None else {}
+    )
+    return {
+        "wall_seconds": wall,
+        "offered": len(trace),
+        "submitted": len(meta),
+        "rejected": sync_rejected + async_rejected,
+        "preemptions": stats.get("preemptions", 0),
+        "per_request": per_request,
+        "sched_stats": stats,
+    }
+
+
+def sched_openloop_ab(
+    cfg,
+    params,
+    *,
+    n_slots: int,
+    max_len: int,
+    prompt_buckets: tuple[int, ...],
+    chunked_prefill: int,
+    base_rps: float,
+    base_s: float = 4.0,
+    overload_x: float = 2.0,
+    overload_s: float = 4.0,
+    max_new: int = 32,
+    prompt_len: int = 96,
+    sys_len: int = 48,
+    gold_deadline_ms: int = 1500,
+    max_queue: int = 0,
+    defer_budget_ms: int = 0,
+    quotas=None,
+    prefix_cache_mb: int = 0,
+    seed: int = 0,
+    trace: "list[dict] | None" = None,
+) -> dict:
+    """The slo-vs-fifo A/B: ONE trace (built here or caller-supplied),
+    replayed through a fifo-scheduled and an slo-scheduled batcher.
+    Returns the ``openloop_*`` / ``*_fifo`` / ``*_slo`` field dict the
+    ServeBenchResult carries (and the runner serve row publishes)."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        Scheduler,
+        SloScheduler,
+    )
+
+    if trace is None:
+        trace = openloop_trace(
+            cfg, seed=seed, base_s=base_s, overload_s=overload_s,
+            base_rps=base_rps, overload_x=overload_x,
+            prompt_len=prompt_len, sys_len=sys_len, max_new=max_new,
+            gold_deadline_ms=gold_deadline_ms,
+        )
+
+    def run_arm(scheduler):
+        rec = _OpenLoopRecorder()
+        pc = None
+        if prefix_cache_mb > 0 and chunked_prefill:
+            from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+                PrefixCache,
+            )
+
+            pc = PrefixCache(cfg, buckets=prompt_buckets,
+                             budget_bytes=prefix_cache_mb << 20)
+        cb = ContinuousBatcher(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            chunked_prefill=chunked_prefill, metrics=rec,
+            prefix_cache=pc, scheduler=scheduler,
+        )
+        out = open_loop_run(cb, trace)
+        out["itl"] = rec.itl
+        return out
+
+    def make_fifo():
+        return Scheduler(max_queue=max_queue,
+                         defer_budget_ms=defer_budget_ms)
+
+    def make_slo():
+        return SloScheduler(max_queue=max_queue,
+                            defer_budget_ms=defer_budget_ms,
+                            quotas=quotas)
+
+    # compile pass: the chunk/finish/decode jits are shape-dependent
+    # only, so a small CLOSED-LOOP run warms them without replaying the
+    # whole real-time trace (which would add a third base_s+overload_s
+    # arm of pure wall-clock to every serve bench)
+    warm = ContinuousBatcher(
+        params, cfg, n_slots=n_slots, max_len=max_len,
+        prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
+    )
+    for e in trace[: 2 * n_slots]:
+        warm.submit(list(e["prompt"]), max_new=e["max_new"])
+    warm.run()
+
+    slo = run_arm(make_slo())
+    fifo = run_arm(make_fifo())
+
+    def summarize(arm):
+        reqs = arm["per_request"]
+        hi_over = [
+            r for r in reqs
+            if r["tenant"] == "gold" and r["phase"] == "overload"
+        ]
+        ttfts = [r["ttft_s"] for r in hi_over if r["ttft_s"] is not None]
+        deadlined = [r for r in reqs if r["deadlined"]]
+        return {
+            "ttft_p50_ms_hi": _pct(ttfts, 50) * 1000.0,
+            "ttft_p99_ms_hi": _pct(ttfts, 99) * 1000.0,
+            "itl_p50_ms": _pct(arm["itl"], 50) * 1000.0,
+            "itl_p99_ms": _pct(arm["itl"], 99) * 1000.0,
+            "goodput_hi": sum(
+                r["goodput"] for r in reqs if r["tenant"] == "gold"
+            ),
+            "goodput": sum(r["goodput"] for r in reqs),
+            "miss_pct_hi": (
+                100.0 * sum(
+                    1 for r in deadlined
+                    if r["tenant"] == "gold" and not r["met_deadline"]
+                ) / max(1, sum(
+                    1 for r in deadlined if r["tenant"] == "gold"
+                ))
+            ),
+            "rejected": arm["rejected"],
+            "preemptions": arm["preemptions"],
+        }
+
+    f, s = summarize(fifo), summarize(slo)
+    return {
+        "openloop_requests": len(trace),
+        "openloop_base_rps": base_rps,
+        "openloop_overload_x": overload_x,
+        "ttft_p50_ms_hi_fifo": f["ttft_p50_ms_hi"],
+        "ttft_p99_ms_hi_fifo": f["ttft_p99_ms_hi"],
+        "ttft_p50_ms_hi_slo": s["ttft_p50_ms_hi"],
+        "ttft_p99_ms_hi_slo": s["ttft_p99_ms_hi"],
+        "itl_p50_ms_fifo": f["itl_p50_ms"],
+        "itl_p99_ms_fifo": f["itl_p99_ms"],
+        "itl_p50_ms_slo": s["itl_p50_ms"],
+        "itl_p99_ms_slo": s["itl_p99_ms"],
+        "goodput_tokens_hi_fifo": f["goodput_hi"],
+        "goodput_tokens_hi_slo": s["goodput_hi"],
+        "goodput_tokens_fifo": f["goodput"],
+        "goodput_tokens_slo": s["goodput"],
+        "deadline_miss_pct_hi_fifo": f["miss_pct_hi"],
+        "deadline_miss_pct_hi_slo": s["miss_pct_hi"],
+        "rejected_fifo": f["rejected"],
+        "rejected_slo": s["rejected"],
+        "preemptions_slo": s["preemptions"],
+    }
+
+
 def serve_bench(
     cfg: LlamaConfig,
     n_slots: int = 8,
@@ -143,6 +486,9 @@ def serve_bench(
     prefix_ab: bool = True,
     paged_ab: bool = True,
     spec_ab: bool = False,
+    sched_ab: bool = True,
+    sched_base_s: float = 4.0,
+    sched_overload_s: float = 4.0,
     draft_cfg: "LlamaConfig | None" = None,
     draft_params=None,
     gamma: int = 4,
@@ -404,6 +750,42 @@ def serve_bench(
         if computed_cold:
             saved_pct = 100.0 * (1.0 - computed_cached / computed_cold)
 
+    # --- slo-vs-fifo open-loop A/B: one trace, two schedulers ---
+    sched_fields: dict = {}
+    if sched_ab and chunked_prefill:
+        # offered load calibrated against this config's measured
+        # closed-loop capacity: the base phase runs a touch under it,
+        # the overload phase at 2x — a fixed rate would either idle a
+        # fast chip or bury a slow one, and neither measures scheduling
+        if wall > 0:
+            capacity_rps = n_requests / wall
+        else:
+            cal = make_batcher(1)
+            for p in prompts[: 2 * n_slots]:
+                cal.submit(p, max_new=max_new)
+            t0 = time.perf_counter()
+            cal.run()
+            capacity_rps = 2 * n_slots / (time.perf_counter() - t0)
+        base_rps = max(0.5, 0.8 * capacity_rps)
+        # gold's deadline: ~4x a request's unloaded service time, so a
+        # well-scheduled overload phase can still meet it while a FIFO
+        # queue behind bronze bulk work cannot
+        service_ms = max_new * step_ms if step_ms else 0.0
+        gold_deadline_ms = max(500, int(4 * service_ms)) if service_ms \
+            else 1500
+        sched_fields = sched_openloop_ab(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            chunked_prefill=chunked_prefill,
+            base_rps=base_rps, base_s=sched_base_s,
+            overload_s=sched_overload_s,
+            max_new=max_new,
+            prompt_len=min(prompt_lens[0], max_len - max_new - 1),
+            sys_len=min(48, max_len // 4),
+            gold_deadline_ms=gold_deadline_ms,
+            max_queue=8 * n_slots,
+        )
+
     total_new = n_requests * max_new  # eos disabled: every budget runs out
     return ServeBenchResult(
         n_requests=n_requests,
@@ -440,4 +822,5 @@ def serve_bench(
         spec_accepted_per_round=spec_per_round,
         spec_ms_per_accepted_token=spec_ms_acc,
         spec_gamma=spec_g,
+        **sched_fields,
     )

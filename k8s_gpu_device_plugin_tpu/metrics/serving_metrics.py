@@ -48,7 +48,7 @@ class ServingMetrics:
         self.requests_finished = Counter(
             f"{prefix}_requests_finished_total",
             "Requests retired, by reason",
-            ["reason"],  # eos | budget | stop (sequence hit) | cancelled
+            ["reason"],  # eos | budget | stop | cancelled | rejected
             registry=registry,
         )
         self.prefill_chunks = Counter(
@@ -163,6 +163,49 @@ class ServingMetrics:
             buckets=(1, 2, 3, 4, 6, 8, 12, 16, float("inf")),
             registry=registry,
         )
+        # SLO scheduling (serving/scheduler.py): how long admission
+        # makes a request wait, whether deadlines held (misses + how
+        # late), which tenants' tokens were USEFUL (goodput = tokens of
+        # requests that finished by their deadline), and the scheduler's
+        # two interventions (preemption, overload rejection). Label
+        # cardinality is bounded: tenants are operator-configured,
+        # priority is a single digit.
+        self.sched_queue_wait_seconds = Histogram(
+            f"{prefix}_sched_queue_wait_seconds",
+            "Time a request waited between submission and slot assignment",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.sched_deadline_misses = Counter(
+            f"{prefix}_sched_deadline_misses_total",
+            "Requests that finished after their deadline, by tenant",
+            ["tenant"],
+            registry=registry,
+        )
+        self.sched_deadline_overrun_seconds = Histogram(
+            f"{prefix}_sched_deadline_overrun_seconds",
+            "How far past its deadline a missing request finished",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.sched_goodput_tokens = Counter(
+            f"{prefix}_sched_goodput_tokens_total",
+            "Tokens of requests that met their deadline (or had none), "
+            "by tenant and priority class",
+            ["tenant", "priority"],
+            registry=registry,
+        )
+        self.sched_preemptions = Counter(
+            f"{prefix}_sched_preemptions_total",
+            "Decoding slots evicted for a higher class (slo policy)",
+            registry=registry,
+        )
+        self.sched_rejected = Counter(
+            f"{prefix}_sched_rejected_total",
+            "Requests refused by the scheduler, by reason",
+            ["reason"],  # queue_full | defer_budget
+            registry=registry,
+        )
         self.queue_depth = Gauge(
             f"{prefix}_queue_depth",
             "Requests waiting for a slot",
@@ -249,6 +292,12 @@ class ServingMetrics:
             self.spec_tokens_drafted,
             self.spec_tokens_accepted,
             self.spec_accepted_per_round,
+            self.sched_queue_wait_seconds,
+            self.sched_deadline_misses,
+            self.sched_deadline_overrun_seconds,
+            self.sched_goodput_tokens,
+            self.sched_preemptions,
+            self.sched_rejected,
             self.queue_depth,
             self.slots_active,
             self.slots_prefilling,
@@ -305,6 +354,26 @@ class ServingMetrics:
 
     def set_kv_reserved_bytes(self, nbytes: int) -> None:
         self.kv_reserved_bytes.set(nbytes)
+
+    # --- scheduler hooks (serving/scheduler.py) ---
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        self.sched_queue_wait_seconds.observe(seconds)
+
+    def on_deadline_miss(self, tenant: str, overrun_seconds: float) -> None:
+        self.sched_deadline_misses.labels(tenant=tenant).inc()
+        self.sched_deadline_overrun_seconds.observe(overrun_seconds)
+
+    def on_goodput(self, tenant: str, priority: str, tokens: int) -> None:
+        self.sched_goodput_tokens.labels(
+            tenant=tenant, priority=priority
+        ).inc(tokens)
+
+    def on_preemption(self) -> None:
+        self.sched_preemptions.inc()
+
+    def on_sched_rejected(self, reason: str) -> None:
+        self.sched_rejected.labels(reason=reason).inc()
 
     # --- speculative-decoding hook (models/spec_batching.py) ---
 

@@ -414,6 +414,26 @@ class _Request:
     # recorded — a deferred request can still be cancelled, and a
     # counted hit for a request that never ran would be a phantom
     _match_depth: "int | None" = None
+    # SLO scheduling identity (serving/scheduler.py): tenant + priority
+    # class (lower = more urgent) ride every request; ``deadline`` is an
+    # ABSOLUTE perf_counter instant (None = no deadline). The fifo
+    # default ignores all three beyond accounting.
+    tenant: str = "default"
+    priority: int = 1
+    deadline: "float | None" = None
+    # preemption/resume bookkeeping: ``prefilled_out`` counts emitted
+    # tokens folded back into ``prompt`` by _preempt_slot (the resumed
+    # prefill recomputes their K/V; the finish chunk's sampled token is
+    # emission — and seeded draw — number prefilled_out).
+    prefilled_out: int = 0
+    preemptions: int = 0
+    # set when the scheduler rejects a queued request (defer budget):
+    # surfaced through the stream info so the HTTP planes answer 429
+    reject_reason: "str | None" = None
+    # first-token and retirement perf_counter marks (the open-loop
+    # bench reads TTFT / completion-vs-deadline off retired requests)
+    t_first_tok: float = 0.0
+    t_done: float = 0.0
 
 
 
@@ -451,6 +471,11 @@ class ContinuousBatcher:
     #: plumbing may turn this off (the speculative batcher supports it
     #: with a second, draft-sized pool)
     supports_paged_kv = True
+    #: the slo scheduler may evict a decoding slot and resume it later
+    #: via re-prefill (requires chunked prefill); a subclass whose
+    #: device state cannot be rebuilt that way turns this off (the
+    #: speculative batcher: the draft cache has no resume path)
+    supports_preemption = True
 
     def __init__(
         self,
@@ -471,6 +496,7 @@ class ContinuousBatcher:
         kv_layout: str | None = None,   # None = take cfg.kv_layout
         kv_page_size: int | None = None,  # None = take cfg.kv_page_size
         kv_pages: int = 0,  # paged pool size; 0 = dense-equivalent HBM
+        scheduler=None,  # serving.scheduler.Scheduler (or None = FIFO)
     ):
         # the KV layout rides in the (static) cfg so every jitted step
         # branches on it at trace time; the explicit kwargs are sugar so
@@ -643,6 +669,25 @@ class ContinuousBatcher:
         self.state = init_batch_state(cfg, n_slots, max_len, seed,
                                       n_pages=n_pages)
         self.pending: list[_Request] = []  # owner: engine
+        # Pluggable admission policy (serving/scheduler.py), duck-typed
+        # like the prefix cache and metrics so this module keeps its
+        # no-serving-imports layering. None = today's FIFO admission
+        # with ZERO added calls; the fifo Scheduler object is behavior-
+        # identical (it never reorders, never preempts) but keeps the
+        # SLO ledgers, so streams are pinned bit-identical either way.
+        # Its own mutable state is engine-owned; cross-thread readers go
+        # through scheduler.sched_stats().
+        self.scheduler = scheduler
+        if scheduler is not None and getattr(scheduler, "preempt_enabled",
+                                             False):
+            if not self.supports_preemption:
+                # demote loudly-but-safely is wrong here: an operator
+                # who asked for preemption must know this engine cannot
+                raise ValueError(
+                    "this batcher does not support preemption (no "
+                    "resume path for its device state); use the slo "
+                    "scheduler with preempt=False or the fifo policy"
+                )
         self.running: dict[int, _Request] = {}    # slot -> decoding request; owner: engine
         self.prefilling: dict[int, _Request] = {}  # slot -> mid-prefill req; owner: engine
         self._prefill_pos: dict[int, int] = {}     # slot -> next chunk start; owner: engine
@@ -771,6 +816,35 @@ class ContinuousBatcher:
             raise ValueError(f"seed must be in [0, 2^31), got {seed}")
         return seed
 
+    @staticmethod
+    def validate_sched(tenant, priority, deadline_ms) -> tuple:
+        """The scheduling half of the admission rule (static, like
+        ``validate_seed``): one definition of a valid (tenant, priority,
+        deadline_ms) triple, shared by submit, the serving engine's
+        request thread, and both HTTP parsers. Returns the normalized
+        triple; ``deadline_ms`` None/0 means no deadline."""
+        if tenant is None or tenant == "":
+            tenant = "default"
+        if not isinstance(tenant, str) or len(tenant) > 64:
+            raise ValueError(
+                "tenant must be a string of at most 64 characters"
+            )
+        priority = 1 if priority is None else int(priority)
+        if not (0 <= priority <= 9):
+            raise ValueError(
+                f"priority must be in [0, 9] (lower = more urgent), "
+                f"got {priority}"
+            )
+        if deadline_ms is not None:
+            deadline_ms = int(deadline_ms)
+            if deadline_ms < 0:
+                raise ValueError(
+                    f"deadline_ms must be >= 0 (0 = none), got {deadline_ms}"
+                )
+            if deadline_ms == 0:
+                deadline_ms = None
+        return tenant, priority, deadline_ms
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -792,6 +866,9 @@ class ContinuousBatcher:
         adapter: int = -1,
         logit_bias=None,
         seed: "int | None" = None,
+        tenant: str = "default",
+        priority: int = 1,
+        deadline_ms: "int | None" = None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -833,6 +910,9 @@ class ContinuousBatcher:
         self.validate_adapter(adapter)
         bias = self.validate_bias(logit_bias)
         seed = self.validate_seed(seed)
+        tenant, priority, deadline_ms = self.validate_sched(
+            tenant, priority, deadline_ms
+        )
         if prefix is not None and prefix.adapter != adapter:
             # the prefix rows were prefilled under ONE set of weights;
             # reusing them under another would serve wrong K/V silently
@@ -843,10 +923,17 @@ class ContinuousBatcher:
         rid = self._next_rid
         self._next_rid += 1
         full = (list(prefix.tokens) if prefix else []) + list(prompt)
+        now = time.perf_counter()
         req = _Request(
             rid, full, max_new, prefix=prefix,
             stop=tuple(tuple(s) for s in (stop or ()) if s),
             sampler=sampler, adapter=adapter, bias=bias, seed=seed,
+            tenant=tenant, priority=priority,
+            # the deadline anchors at submit receipt: queue wait counts
+            # against it (that is the point of deadline scheduling)
+            deadline=(
+                now + deadline_ms / 1000.0 if deadline_ms else None
+            ),
             # manual prefixes report EFFECTIVE reuse too (auto-matched
             # ones are set at admission): rows the finish window
             # recomputes anyway are not served-from-cache
@@ -856,7 +943,12 @@ class ContinuousBatcher:
                 ) if prefix else 0
             ),
         )
-        req.t_submit = time.perf_counter()
+        req.t_submit = now
+        if self.scheduler is not None:
+            # admission control (queue cap, quota charge) BEFORE the
+            # request queues or counts anywhere; a raise here leaves the
+            # batcher untouched (SchedulerOverloadError -> HTTP 429)
+            self.scheduler.on_submit(req, self)
         if self.tracer.enabled:
             # root of the request's span tree; parent (if any) is the
             # ambient context — the HTTP handler's span attached around
@@ -1002,6 +1094,20 @@ class ContinuousBatcher:
         return self._sel_cache
 
     def _admit(self) -> None:
+        if self.scheduler is not None and (self.pending or self.running):
+            # one scheduling pass per admission pass: the policy may
+            # reorder ``pending`` in place (the head IS the admission
+            # order), expire over-budget pool-pressure deferrals, and
+            # name at most one running slot to preempt for the head
+            now = time.perf_counter()
+            rejects, preempt_slot = self.scheduler.plan(self, now)
+            for req in rejects:
+                self.pending.remove(req)
+                self._release_pinned(req)  # paged: match-time page pins
+                req.reject_reason = "pool_pressure"
+                self._retire_rejected(req, now)
+            if preempt_slot is not None:
+                self._preempt_slot(preempt_slot)
         free = [
             s for s in range(self.n_slots)
             if s not in self.running and s not in self.prefilling
@@ -1047,6 +1153,11 @@ class ContinuousBatcher:
             self.pending.pop(0)
             slot = free.pop(0)
             req.slot = slot
+            if self.scheduler is not None:
+                # commit point: the request has a slot — queue-wait and
+                # WFQ virtual time charge land here, past every
+                # cancellable wait (the record_match discipline)
+                self.scheduler.on_admitted(req, self, time.perf_counter())
             if req.matched:
                 # the request is past every cancellable wait: commit its
                 # hit/miss disposition (one per request that reaches a
@@ -1132,8 +1243,13 @@ class ContinuousBatcher:
         tail and the fresh pages draw on the free list. False = defer
         (the request keeps its queue head; pages free as slots retire)."""
         ps = self.pool.page_size
+        # a resumed request's prompt already CONTAINS its pre-preemption
+        # output (prefilled_out tokens), so only the remaining budget
+        # adds rows — the reservation is exactly the original worst case
         total = self.pool.pages_for_tokens(
-            self._kv_need_tokens(len(req.prompt), req.max_new)
+            self._kv_need_tokens(
+                len(req.prompt), req.max_new - req.prefilled_out
+            )
         )
         aliased = 0
         if isinstance(req.prefix, PagedPrefixState):
@@ -1358,7 +1474,10 @@ class ContinuousBatcher:
         # mid-generator dict mutation raises RuntimeError (the same
         # approximate-read contract as stats()'s atomic len() calls)
         live = sum(
-            len(r.prompt) + len(r.out) for r in list(self.running.values())
+            # resumed requests: prompt already holds prefilled_out of
+            # the out tokens — don't count those rows twice
+            len(r.prompt) + len(r.out) - r.prefilled_out
+            for r in list(self.running.values())
         ) + sum(self._prefill_pos.get(s, 0) for s in list(self.prefilling))
         cap_tokens = self.pool.in_use * self.pool.page_size
         return {
@@ -1488,14 +1607,19 @@ class ContinuousBatcher:
 
     def _on_first_token(self, req: _Request) -> None:
         """First generated token (sampled at prefill time): TTFT metric +
-        the request's decode-phase span opens."""
+        the request's decode-phase span opens. A RESUMED request's
+        finish-chunk token is a real emission (counted) but not a first
+        token — its TTFT was observed at the original admission."""
         now = time.perf_counter()
         req.t_last_tok = now
         if self.metrics:
             self.metrics.on_first_token()
-            observe = getattr(self.metrics, "observe_ttft", None)
-            if observe is not None:  # duck-typed: older/fake metrics lack it
-                observe(now - req.t_submit)
+            if req.preemptions == 0:
+                observe = getattr(self.metrics, "observe_ttft", None)
+                if observe is not None:  # duck-typed: fakes may lack it
+                    observe(now - req.t_submit)
+        if req.preemptions == 0:
+            req.t_first_tok = now
         if req.span is not None:
             req.decode_span = self.tracer.span(
                 "decode", component="serving", parent=req.span,
@@ -1542,13 +1666,22 @@ class ContinuousBatcher:
     def _apply_prefill_finish(self, chunk, fstart: int, plen: int,
                               slot: int) -> tuple[int, float]:
         req = self.prefilling[slot]
+        # a resumed request (preempted mid-decode) already emitted
+        # prefilled_out tokens — they sit in the prompt now, so the
+        # finish chunk samples emission number prefilled_out (the same
+        # seeded draw index the dropped decode would have used) against
+        # the REMAINING budget; prefilled_out == 0 keeps today's trace
         self.state, tok, logp = prefill_finish(
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
-            self.cfg, self._req_knobs(req), jnp.int32(req.max_new),
+            self.cfg, self._req_knobs(req),
+            jnp.int32(req.max_new - req.prefilled_out),
             sel=self._req_sel(req),
             bias=self._req_bias(req),
             seed=self._req_seed(req),
+            draw0=(
+                jnp.int32(req.prefilled_out) if req.prefilled_out else None
+            ),
         )
         return int(tok), float(logp)
 
@@ -1581,9 +1714,76 @@ class ContinuousBatcher:
         # `running` each step, and admission overwrites the slot's rows
         self.done[req.rid] = req.out
         self.done_requests[req.rid] = req
+        req.t_done = time.perf_counter()
+        if self.scheduler is not None:
+            # cancel-while-queued refunds the quota charge here
+            self.scheduler.on_retired(req, self, "cancelled", req.t_done)
         if self.metrics:
             self.metrics.on_finish("cancelled")
         self._close_request_spans(req, "cancelled")
+
+    def _retire_rejected(self, req: _Request, now: float) -> None:
+        """The scheduler expired this queued request (its pool-pressure
+        deferral outlived the budget): retire it with whatever it has
+        (nothing — it never took a slot) so its stream closes and the
+        HTTP plane can answer 429 off ``reject_reason``."""
+        self.done[req.rid] = req.out
+        self.done_requests[req.rid] = req
+        req.t_done = now
+        if self.scheduler is not None:
+            self.scheduler.on_retired(req, self, "rejected", now)
+        if self.metrics:
+            self.metrics.on_finish("rejected")
+        self._close_request_spans(req, "rejected")
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict the decoding request in ``slot`` and requeue it for a
+        later resume (the slo scheduler's pressure valve). The emitted
+        tokens fold back into the prompt, so the resumed admission
+        chunk-prefills them like any other prompt — and the prefix
+        cache serves whatever boundaries the ORIGINAL prefill promoted,
+        so only the uncached tail recomputes. The finish chunk then
+        samples emission (and seeded draw) number ``prefilled_out``,
+        making the resumed stream bit-identical to an uninterrupted
+        run for greedy and seeded requests (pinned)."""
+        req = self.running.pop(slot)
+        self._invalidate_slot_caches()
+        self._release_slot_pages(slot, req)
+        req.prompt = list(req.prompt) + [
+            int(t) for t in req.out[req.prefilled_out:]
+        ]
+        req.prefilled_out = len(req.out)
+        req.preemptions += 1
+        req.slot = -1
+        req.defer_counted = False
+        # re-match at re-admission: the longer prompt may hit a deeper
+        # promoted boundary than the original did
+        req.matched = False
+        req.prefix = None
+        req._match_depth = None
+        if req.decode_span is not None:
+            req.decode_span.set(tokens=len(req.out)).end()
+            req.decode_span = None
+        if self.tracer.enabled and req.span is not None:
+            self.tracer.span(
+                "preempt", component="serving", parent=req.span,
+                slot=slot, emitted=len(req.out),
+            ).end()
+            with attach(req.span):
+                get_logger().debug(
+                    "request preempted",
+                    extra={"fields": {"rid": req.rid, "slot": slot,
+                                      "tokens": len(req.out)}},
+                )
+        if self.scheduler is not None:
+            self.scheduler.on_preempted(req, self, time.perf_counter())
+        # requeue at the head; the next plan() pass re-sorts by policy
+        self.pending.insert(0, req)
+        if self.tracer.enabled and req.span is not None:
+            self.tracer.span(
+                "requeue", component="serving", parent=req.span,
+                queued=len(self.pending),
+            ).end()
 
     def _finish_if_done(self, req: _Request) -> None:
         """EOS, a stop sequence, or budget exhaustion retires the request
@@ -1598,10 +1798,15 @@ class ContinuousBatcher:
             reason = "eos" if hit_eos else ("stop" if hit_stop else "budget")
             self.done[req.rid] = req.out
             self.done_requests[req.rid] = req
+            req.t_done = time.perf_counter()
             if req.slot in self.running:
                 del self.running[req.slot]
                 self._invalidate_slot_caches()
                 self._release_slot_pages(req.slot, req)
+            if self.scheduler is not None:
+                # deadline disposition (met -> goodput, missed -> miss
+                # counter + overrun histogram) commits at retirement
+                self.scheduler.on_retired(req, self, reason, req.t_done)
             if self.metrics:
                 self.metrics.on_finish(reason)
             self._close_request_spans(req, reason)
@@ -1905,6 +2110,9 @@ def prefill_finish(
     sel: jax.Array | None = None,  # (1, N) adapter one-hot for THIS request
     bias: jax.Array | None = None,  # (1, V) logit bias for THIS request
     seed: jax.Array | None = None,  # (1,) i32 per-request seed (draw 0)
+    draw0: jax.Array | None = None,  # scalar i32: first seeded-draw index
+    #   (None = 0, the fresh-request trace; a preempted request resumes
+    #   at draw prefilled_out so its seeded stream continues exactly)
 ) -> tuple[BatchState, jax.Array, jax.Array]:
     """Final chunk: run it, sample the first generated token (returned
     with its logprob), activate the slot.
@@ -1939,6 +2147,7 @@ def prefill_finish(
     tok, seen = sample_and_mark_dyn(
         logits[:, 0], sub, knobs[None, :], seen[None, :], bias,
         seed,  # draw index defaults to 0 (the first draw) in the sampler
+        None if draw0 is None else draw0[None],
     )
     logp = token_logprob(logits[:, 0], tok)[0]
     tok = tok[0]
@@ -1951,7 +2160,9 @@ def prefill_finish(
         presence=state.presence.at[write].set(seen[0]),
         key=key,
         budget=state.budget.at[write].set(max_new - 1),
-        draws=state.draws.at[write].set(1),
+        draws=state.draws.at[write].set(
+            1 if draw0 is None else draw0 + 1
+        ),
         pages=state.pages,
     ), tok, logp
 

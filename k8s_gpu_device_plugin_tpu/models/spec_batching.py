@@ -384,9 +384,16 @@ class SpeculativeBatcher(ContinuousBatcher):
     per_request_sampler = False
     per_request_bias = False  # the draft+verify round threads no planes
     per_request_seed = False  # same: no per-row key streams in the round
+    #: preemption resumes by re-prefilling prompt+output through the
+    #: chunk scheduler; here that would have to rebuild BOTH caches and
+    #: both page pools mid-round (the verify window included), which no
+    #: pin covers — the slo scheduler still orders/quotas spec engines,
+    #: it just never evicts their slots (construct it with preempt=False)
+    supports_preemption = False
 
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
-               adapter=-1, logit_bias=None, seed=None):
+               adapter=-1, logit_bias=None, seed=None,
+               tenant="default", priority=1, deadline_ms=None):
         if sampler is not None:
             raise ValueError(
                 "per-request samplers are not supported with speculative "
@@ -409,7 +416,8 @@ class SpeculativeBatcher(ContinuousBatcher):
         # TARGET rows; the draft re-prefills the region itself
         # (_on_prefill_scheduled).
         return super().submit(prompt, max_new, prefix=prefix, stop=stop,
-                              adapter=adapter)
+                              adapter=adapter, tenant=tenant,
+                              priority=priority, deadline_ms=deadline_ms)
 
     # --- paged-KV plumbing: the draft pool mirrors every admission ---
 

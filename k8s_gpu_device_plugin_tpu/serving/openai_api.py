@@ -198,6 +198,13 @@ class _OpenAIRoutes:
         # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
         # the modulo would wrap an invalid seed into range silently
         seed = ContinuousBatcher.validate_seed(body.get("seed"))
+        # SLO extension fields (serving/scheduler.py; OpenAI SDKs pass
+        # them via extra_body): validated by the batcher's shared rule,
+        # defaulted at the engine edge when absent
+        ContinuousBatcher.validate_sched(
+            body.get("tenant"), body.get("priority"),
+            body.get("deadline_ms"),
+        )
         # "model" routes: the base model's id (or absent) -> base; a
         # loaded LoRA adapter's name -> that adapter. Anything else is
         # OpenAI's model_not_found.
@@ -213,6 +220,9 @@ class _OpenAIRoutes:
             "stop": stop_lists, "sampler": sampler,
             "model": model, "adapter": adapter, "logit_bias": logit_bias,
             "seed": seed,
+            "tenant": body.get("tenant"),
+            "priority": body.get("priority"),
+            "deadline_ms": body.get("deadline_ms"),
         }
 
     def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
@@ -233,14 +243,25 @@ class _OpenAIRoutes:
         # response stays reproducible while the n samples stay distinct —
         # the same seed for every choice would return n identical copies.
         # best_of > n samples the extras; _respond ranks and keeps n.
-        return [
-            self._server.engine.submit(
-                prompt, c["max_new"], stop=c["stop"], sampler=c["sampler"],
-                adapter=c["adapter"], logit_bias=c["logit_bias"],
-                seed=None if c["seed"] is None else (c["seed"] + i) % 2**31,
-            )
-            for i in range(c.get("best_of") or c["n"])
-        ]
+        subs = []
+        try:
+            for i in range(c.get("best_of") or c["n"]):
+                subs.append(self._server.engine.submit(
+                    prompt, c["max_new"], stop=c["stop"],
+                    sampler=c["sampler"],
+                    adapter=c["adapter"], logit_bias=c["logit_bias"],
+                    seed=(
+                        None if c["seed"] is None
+                        else (c["seed"] + i) % 2**31
+                    ),
+                    tenant=c["tenant"], priority=c["priority"],
+                    deadline_ms=c["deadline_ms"],
+                ))
+        except Exception:
+            for eid, _ in subs:  # a partially submitted n>1 burst
+                self._server.engine.cancel(eid)
+            raise
+        return subs
 
     @staticmethod
     def _finish_reason(n_out: int, max_new: int) -> str:
@@ -555,10 +576,19 @@ class _OpenAIRoutes:
         self, request: web.Request, prompt: list[int], c: dict,
         want_logprobs: bool, object_name: str, id_prefix: str, chat: bool,
     ) -> web.StreamResponse:
+        from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+            SchedulerOverloadError,
+        )
+
         try:
             subs = self._submit(prompt, c)
         except ValueError as e:  # capacity/bucket/sampler validation
             return _oai_error(str(e), 422)
+        except SchedulerOverloadError as e:  # queue full: 429 + Retry-After
+            sched = getattr(self._server.engine.cb, "scheduler", None)
+            if sched is not None:
+                sched.count_sync_rejection(self._server.engine.cb)
+            return _oai_overloaded(str(e), e.reason, e.retry_after)
         except RuntimeError as e:  # engine dead
             return _oai_error(str(e), 503)
         rid = subs[0][0]
@@ -629,6 +659,18 @@ class _OpenAIRoutes:
         # the rest mid-flight); usage is one envelope per API request, so
         # report the best reuse any choice achieved.
         infos = [self._server.engine.pop_request_info(eid) for eid, _ in subs]
+        reject = next(
+            (i["reject_reason"] for i in infos if i.get("reject_reason")),
+            None,
+        )
+        if reject is not None and completion_tokens == 0:
+            # rejected while queued (pool-pressure deferral past the
+            # budget) before a single token: overload, not a completion
+            return _oai_overloaded(
+                "request rejected under overload before admission",
+                reject,
+                max((i.get("retry_after", 1) for i in infos), default=1),
+            )
         return web.json_response({
             "id": oai_id,
             "object": object_name,
@@ -714,6 +756,27 @@ class _OpenAIRoutes:
             while True:
                 item = await q.get()
                 if item is None:
+                    if not all_out:
+                        info = self._server.engine.pop_request_info(rid)
+                        if info.get("reject_reason"):
+                            # rejected while queued, zero tokens: the
+                            # SSE stream is already 200, so the overload
+                            # signal rides an error event (the OpenAI
+                            # stream-error shape SDKs surface) before
+                            # [DONE] — a bare finish_reason "stop" would
+                            # read as a successful empty completion
+                            err = {"error": {
+                                "message": "request rejected under "
+                                           "overload before admission",
+                                "type": "rate_limit_error",
+                                "code": info["reject_reason"],
+                                "retry_after": info.get("retry_after", 1),
+                            }}
+                            await resp.write(
+                                f"data: {json.dumps(err)}\n\n".encode()
+                            )
+                            await resp.write(b"data: [DONE]\n\n")
+                            break
                     kept = trim_stop_suffix(all_out, c["stop"])
                     stopped = len(kept) < len(all_out)
                     # flush pending tokens that survive the trim
@@ -752,6 +815,21 @@ def _check_token_ids(ids: list, vocab: int) -> list[int]:
         if not (0 <= t < vocab):
             raise ValueError(f"token id {t} outside vocab [0, {vocab})")
     return list(ids)
+
+
+def _oai_overloaded(message: str, reason: str,
+                    retry_after: int) -> web.Response:
+    """Scheduler overload (queue full / deferral budget): HTTP 429 with
+    a Retry-After header and OpenAI's retryable error envelope —
+    ``rate_limit_error`` is the type SDK backoff logic keys on, and the
+    ``code`` says WHICH valve fired. Deliberately not the generic
+    ``invalid_request_error`` path: a retry CAN succeed here."""
+    return web.json_response(
+        {"error": {"message": message, "type": "rate_limit_error",
+                   "code": reason, "retry_after": int(retry_after)}},
+        status=429,
+        headers={"Retry-After": str(int(retry_after))},
+    )
 
 
 def _oai_error(message: str, status: int, code: str | None = None) -> web.Response:

@@ -77,6 +77,9 @@ from aiohttp import web
 from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+    SchedulerOverloadError,
+)
 from k8s_gpu_device_plugin_tpu.obs.trace import (
     TRACEPARENT_HEADER,
     attach,
@@ -112,6 +115,9 @@ class InferenceEngine:
         kv_layout: str | None = None,   # None = cfg.kv_layout
         kv_page_size: int | None = None,
         kv_pages: int = 0,
+        scheduler=None,  # serving.scheduler.Scheduler (None = plain FIFO)
+        default_priority: int = 1,
+        default_deadline_ms: int = 0,
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -133,6 +139,17 @@ class InferenceEngine:
                 "constructor; silently ignoring it here would serve the "
                 "dense layout while reporting paged flags"
             )
+        if batcher is not None and scheduler is not None:
+            raise ValueError(
+                "pass the scheduler to the injected batcher's own "
+                "constructor; silently ignoring it here would admit FIFO "
+                "while reporting the requested policy"
+            )
+        # request-edge SLO defaults: a request that names no tenant /
+        # priority / deadline gets these (the "defaulted at the server
+        # edge" contract — the batcher itself never invents a deadline)
+        self._default_priority = int(default_priority)
+        self._default_deadline_ms = int(default_deadline_ms)
         self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
@@ -141,7 +158,7 @@ class InferenceEngine:
             pipeline_depth=pipeline_depth, trace_steps=trace_steps,
             prefix_cache=prefix_cache,
             kv_layout=kv_layout, kv_page_size=kv_page_size,
-            kv_pages=kv_pages,
+            kv_pages=kv_pages, scheduler=scheduler,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -154,9 +171,9 @@ class InferenceEngine:
         self._dead = threading.Event()
         self._subq: list[
             tuple[int, list[int], int, tuple, "Sampler | None", int, tuple,
-                  int | None, object]
+                  int | None, object, str, int, "int | None"]
         ] = []  # (eid, prompt, max_new, stop, sampler, adapter, bias,
-        #          seed, trace_parent)
+        #          seed, trace_parent, tenant, priority, deadline_ms)
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -181,18 +198,41 @@ class InferenceEngine:
         adapter: int = -1,
         logit_bias=None,
         seed: int | None = None,
+        tenant: str | None = None,
+        priority: int | None = None,
+        deadline_ms: int | None = None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
         Validates EVERYTHING the batcher would (capacity, bucket fit in
         bucketed mode, adapter range) so admission on the engine thread
         can never raise — an admission error there would otherwise kill
-        the loop and hang every stream."""
+        the loop and hang every stream. Scheduling identity defaults at
+        THIS edge: tenant "default", the server's --defaultDeadlineMs,
+        priority 1. Raises SchedulerOverloadError (-> HTTP 429) when the
+        scheduler's queue cap is already full."""
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
         self.cb.validate(len(prompt), max_new)  # the batcher's own rule
         self.cb.validate_adapter(adapter)
         logit_bias = self.cb.validate_bias(logit_bias)
+        if priority is None:
+            priority = self._default_priority
+        if deadline_ms is None and self._default_deadline_ms:
+            deadline_ms = self._default_deadline_ms
+        tenant, priority, deadline_ms = self.cb.validate_sched(
+            tenant, priority, deadline_ms
+        )
+        sched = getattr(self.cb, "scheduler", None)
+        if sched is not None:
+            # queue-cap gate on the REQUEST thread so overload answers
+            # 429 immediately instead of queueing doomed work; atomic
+            # len() reads only (the engine thread owns the queues). The
+            # engine-thread check in cb.submit stays authoritative — a
+            # race past this one is caught there and closes the stream.
+            with self._lock:
+                queued_local = len(self._subq)
+            sched.check_capacity(len(self.cb.pending) + queued_local)
         if sampler is not None and not getattr(
             self.cb, "per_request_sampler", False
         ):
@@ -230,7 +270,8 @@ class InferenceEngine:
             self._next_eid += 1
             self._subq.append(
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
-                 adapter, logit_bias, seed, trace_parent)
+                 adapter, logit_bias, seed, trace_parent,
+                 tenant, priority, deadline_ms)
             )
             self._streams[eid] = (loop, q)
             self._published[eid] = 0
@@ -277,6 +318,12 @@ class InferenceEngine:
             # speculative acceptance (rounds, drafted/accepted tokens,
             # acceptance rate) — the production view of gamma's health
             out["spec"] = spec_stats()
+        sched = getattr(self.cb, "scheduler", None)
+        if sched is not None:
+            # queue + per-tenant SLO view (policy, quota levels,
+            # preemptions, deadline misses, goodput) — snapshotted by
+            # the scheduler, same contract as kv_stats
+            out["sched"] = sched.sched_stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -290,14 +337,35 @@ class InferenceEngine:
         with self._lock:
             batch, self._subq = self._subq, []
         for (eid, prompt, max_new, stop, sampler, adapter, bias, seed,
-             trace_parent) in batch:
-            with attach(trace_parent):
-                rid = self.cb.submit(
-                    prompt, max_new=max_new,
-                    stop=[list(st) for st in stop],
-                    sampler=sampler, adapter=adapter, logit_bias=bias,
-                    seed=seed,
-                )
+             trace_parent, tenant, priority, deadline_ms) in batch:
+            try:
+                with attach(trace_parent):
+                    rid = self.cb.submit(
+                        prompt, max_new=max_new,
+                        stop=[list(st) for st in stop],
+                        sampler=sampler, adapter=adapter, logit_bias=bias,
+                        seed=seed, tenant=tenant, priority=priority,
+                        deadline_ms=deadline_ms,
+                    )
+            except SchedulerOverloadError as e:
+                # the request-thread capacity gate raced a burst: close
+                # this stream with the rejection recorded so its handler
+                # answers 429 (an uncaught raise here would kill the
+                # engine loop and hang every stream)
+                sched = getattr(self.cb, "scheduler", None)
+                if sched is not None:
+                    sched.count_sync_rejection(self.cb)
+                with self._lock:
+                    stream = self._streams.pop(eid, None)
+                    self._published.pop(eid, None)
+                    self._finished_info[eid] = {
+                        "reject_reason": e.reason,
+                        "retry_after": e.retry_after,
+                    }
+                if stream is not None:
+                    loop, q = stream
+                    loop.call_soon_threadsafe(q.put_nowait, None)
+                continue
             self._rid_to_eid[rid] = eid
 
     def _apply_cancellations(self) -> None:
@@ -346,12 +414,19 @@ class InferenceEngine:
                 # pop done too: a long-running server must not retain
                 # every request's token list forever
                 self.cb.done.pop(rid, None)
+                info = {"cached_tokens": req.cached_tokens}
+                if req.reject_reason is not None:
+                    # scheduler rejection (pool-pressure deferral past
+                    # the budget): the handler turns this into a 429
+                    info["reject_reason"] = req.reject_reason
+                    sched = getattr(self.cb, "scheduler", None)
+                    info["retry_after"] = (
+                        sched.retry_after_s() if sched is not None else 1
+                    )
                 with self._lock:
                     loop, q = self._streams.pop(eid)
                     self._published.pop(eid)
-                    self._finished_info[eid] = {
-                        "cached_tokens": req.cached_tokens,
-                    }
+                    self._finished_info[eid] = info
                     while len(self._finished_info) > 4096:  # unpopped: aged out
                         self._finished_info.pop(
                             next(iter(self._finished_info))
@@ -408,6 +483,20 @@ class InferenceEngine:
                 self._published.clear()
             for loop, q in streams.values():
                 loop.call_soon_threadsafe(q.put_nowait, None)
+
+
+def _overload_response(message: str, reason: str,
+                       retry_after: int) -> web.Response:
+    """HTTP 429 for scheduler overload (queue full, deferral budget):
+    a structured body + a Retry-After header, NOT the generic 4xx/5xx
+    error path — clients must be able to tell 'back off and retry'
+    from 'this request can never succeed'."""
+    return web.json_response(
+        {"error": message, "code": "overloaded", "reason": reason,
+         "retry_after": int(retry_after)},
+        status=429,
+        headers={"Retry-After": str(int(retry_after))},
+    )
 
 
 def _parse_logit_bias(raw) -> dict | None:
@@ -578,6 +667,13 @@ class InferenceServer:
             stream = bool(body.get("stream", False))
             n = int(body.get("n", 1))
             adapter = self.resolve_adapter(body.get("adapter"))
+            # SLO identity (serving/scheduler.py): optional on the wire,
+            # defaulted at the engine edge; validated by the batcher's
+            # shared rule so both HTTP planes mean the same thing
+            tenant = body.get("tenant")
+            priority = body.get("priority")
+            deadline_ms = body.get("deadline_ms")
+            ContinuousBatcher.validate_sched(tenant, priority, deadline_ms)
             logit_bias = _parse_logit_bias(body.get("logit_bias"))
             # validate BEFORE the per-choice (seed+i) % 2^31 derivation —
             # the modulo would wrap an invalid seed into range silently
@@ -626,19 +722,27 @@ class InferenceServer:
                 )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=400)
+        subs: list[tuple[int, asyncio.Queue]] = []
         try:
             # n>1 with a seed: per-choice seeds (seed+i), reproducible AND
             # distinct — one seed for all n would return identical copies
-            subs = [
-                self.engine.submit(
+            for i in range(n):
+                subs.append(self.engine.submit(
                     prompt, max_new, stop=stop, sampler=sampler,
                     adapter=adapter, logit_bias=logit_bias,
                     seed=None if seed is None else (seed + i) % 2**31,
-                )
-                for i in range(n)
-            ]
+                    tenant=tenant, priority=priority,
+                    deadline_ms=deadline_ms,
+                ))
         except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
+        except SchedulerOverloadError as e:  # queue full: transient
+            for eid_, _ in subs:  # a partially submitted n>1 burst
+                self.engine.cancel(eid_)
+            sched = getattr(self.engine.cb, "scheduler", None)
+            if sched is not None:
+                sched.count_sync_rejection(self.engine.cb)
+            return _overload_response(str(e), e.reason, e.retry_after)
         except RuntimeError as e:  # engine dead
             return web.json_response({"error": str(e)}, status=503)
         rid, q = subs[0]
@@ -655,6 +759,18 @@ class InferenceServer:
                     self.engine.cancel(eid_)
                 raise
             infos = [self.engine.pop_request_info(eid_) for eid_, _ in subs]
+            reject = next(
+                (i["reject_reason"] for i in infos
+                 if i.get("reject_reason")), None,
+            )
+            if reject is not None and not any(d[0] for d in drained):
+                # rejected while queued (deferral budget / a raced queue
+                # cap) before emitting anything: overload, not a result
+                return _overload_response(
+                    "request rejected under overload before admission",
+                    reject,
+                    max((i.get("retry_after", 1) for i in infos), default=1),
+                )
             payload = {
                 "id": rid, "tokens": drained[0][0],
                 # prompt tokens served from the prefix cache (0 when the
@@ -704,6 +820,12 @@ class InferenceServer:
                     # themselves with the same caveat)
                     done: dict = {"done": True}
                     info = self.engine.pop_request_info(rid)
+                    if info.get("reject_reason"):
+                        # the SSE stream is already prepared (200), so a
+                        # mid-stream overload rejection rides the done
+                        # event instead of a status code
+                        done["rejected"] = info["reject_reason"]
+                        done["retry_after"] = info.get("retry_after", 1)
                     if info.get("cached_tokens"):
                         # only when the prefix cache actually served part
                         # of the prompt — the common done event stays lean
@@ -977,6 +1099,37 @@ def _main(argv: list[str] | None = None) -> int:
                         "overcommit HBM against live tokens (admission "
                         "then gates on pool pressure instead of slots "
                         "alone)")
+    parser.add_argument("--schedPolicy", default="fifo",
+                        choices=["fifo", "slo"],
+                        help="admission policy (serving/scheduler.py): "
+                        "'fifo' is arrival order, bit-identical to the "
+                        "pre-scheduler server; 'slo' orders by priority "
+                        "class, per-tenant weighted fairness and "
+                        "earliest deadline, enforces --tenantQuota, and "
+                        "preempts lower-class decodes when a deadline "
+                        "would be missed (disabled with --draftPreset: "
+                        "the speculative engine has no resume path)")
+    parser.add_argument("--tenantQuota", default="",
+                        help="per-tenant token-bucket quotas + WFQ "
+                        "weights (requires --schedPolicy slo): "
+                        "name=rate[:burst=B][:weight=W],... — rate in "
+                        "tokens/s (prompt + budgeted output charged at "
+                        "submit); over-quota tenants are demoted behind "
+                        "every in-quota class, never dropped")
+    parser.add_argument("--defaultDeadlineMs", type=int, default=0,
+                        help="deadline applied to requests that name "
+                        "none (0 = no deadline): the SLO the slo policy "
+                        "schedules against and the deadline-miss/goodput "
+                        "metrics report on")
+    parser.add_argument("--maxQueue", type=int, default=0,
+                        help="pending-request cap (0 = unbounded): past "
+                        "it, submissions answer HTTP 429 with Retry-After "
+                        "instead of queueing doomed work (either policy)")
+    parser.add_argument("--deferBudgetMs", type=int, default=0,
+                        help="how long one request may sit pool-pressure-"
+                        "deferred at the queue head before it is rejected "
+                        "with 429 (0 = wait forever, the pre-scheduler "
+                        "behavior; either policy)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -1119,6 +1272,22 @@ def _main(argv: list[str] | None = None) -> int:
             "--draftKvPages sizes the speculative draft model's page "
             "pool: it needs both --draftPreset and --kvLayout paged"
         )
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import make_scheduler
+
+    try:
+        scheduler = make_scheduler(
+            args.schedPolicy,
+            max_queue=args.maxQueue,
+            defer_budget_ms=args.deferBudgetMs,
+            tenant_quota=args.tenantQuota,
+            # the speculative engine has no preemption resume path; the
+            # slo policy still orders/quotas it (documented, not silent:
+            # the health endpoint reports the policy either way)
+            preempt=not args.draftPreset,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1145,6 +1314,7 @@ def _main(argv: list[str] | None = None) -> int:
                 args.kvPageSize if args.kvLayout == "paged" else None
             ),
             kv_pages=args.kvPages,
+            scheduler=scheduler,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1159,6 +1329,8 @@ def _main(argv: list[str] | None = None) -> int:
             args.kvPageSize if args.kvLayout == "paged" else None
         ),
         kv_pages=0 if batcher is not None else args.kvPages,
+        scheduler=None if batcher is not None else scheduler,
+        default_deadline_ms=args.defaultDeadlineMs,
     )
     from prometheus_client import REGISTRY
 

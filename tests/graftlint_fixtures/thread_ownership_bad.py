@@ -11,16 +11,33 @@ class Batcher:
         return {"pages_free": 0}
 
 
+class Scheduler:
+    """The serving/scheduler.py shape: policy ledgers are engine-thread
+    state; consumers must go through the sched_stats() snapshot."""
+
+    def __init__(self):
+        self._tenants = {}     # owner: engine
+        self.rejections = {}   # owner: engine
+
+    def sched_stats(self):
+        return {"tenants": {k: dict(v) for k, v in list(self._tenants.items())}}
+
+
 class Server:
-    def __init__(self, cb):
+    def __init__(self, cb, sched):
         self.cb = cb
+        self.sched = sched
 
     async def health(self, request):
         return {
             "active": len(self.cb.running),           # OK: atomic len
             "slots": list(self.cb.running.values()),  # BAD: iteration races
             "free": self.cb.pool.free_pages,          # BAD: pool internals
+            "tenants": dict(self.sched._tenants),     # BAD: ledger copy races
         }
 
     def stats(self):  # graftlint: cross-thread
         return dict(self.cb.running)  # BAD: cross-thread dict copy
+
+    def overload(self):  # graftlint: cross-thread
+        return self.sched.rejections["queue_full"]  # BAD: ledger read

@@ -12,14 +12,30 @@ class Batcher:
         return {"in_use": len(list(self.running))}
 
 
+class Scheduler:
+    """The serving/scheduler.py shape: every ledger is engine-owned and
+    crosses threads only through the sched_stats() snapshot (or the
+    queue-cap check's atomic len, computed by the caller)."""
+
+    def __init__(self):
+        self._tenants = {}     # owner: engine
+        self.rejections = {}   # owner: engine
+
+    def sched_stats(self):
+        # engine-state snapshot: list() before iterating, plain copies out
+        return {"tenants": {k: dict(v) for k, v in list(self._tenants.items())}}
+
+
 class Server:
-    def __init__(self, cb):
+    def __init__(self, cb, sched):
         self.cb = cb
+        self.sched = sched
 
     async def health(self, request):
         return {
             "active": len(self.cb.running),  # atomic len: sanctioned
             "kv": self.cb.kv_stats(),        # the snapshot boundary
+            "sched": self.sched.sched_stats(),  # ditto for the scheduler
         }
 
     def stats(self):  # graftlint: cross-thread

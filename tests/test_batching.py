@@ -193,11 +193,18 @@ def test_serve_bench_machinery(setup):
         cfg, n_slots=2, n_requests=4, max_len=32,
         prompt_lens=(4, 7), max_new=4, params=params,
         prompt_buckets=(8, 16), chunked_prefill=8,
+        sched_base_s=0.5, sched_overload_s=0.5,
     )
     assert r.tokens_per_second > 0
     assert r.requests_per_second > 0
     assert r.decode_step_ms > 0
     assert r.total_new_tokens == 16
+    # the slo-vs-fifo open-loop A/B ran: both arms produced goodput and
+    # the offered load was calibrated off the measured capacity
+    assert r.openloop_requests > 0
+    assert r.openloop_base_rps > 0
+    assert r.goodput_tokens_fifo > 0
+    assert r.goodput_tokens_slo > 0
 
 
 def test_tp_sharded_batching_matches_unsharded():

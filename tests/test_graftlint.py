@@ -92,9 +92,12 @@ def test_thread_ownership_allows_atomic_len():
     bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
     found = _run_on(bad, [_checker("thread-ownership")])
     # the len(self.cb.running) read on the same handler must NOT fire;
-    # the iteration/copy/pool reads must
-    assert len(found) == 3
-    assert {v.key for v in found} == {"running", "pool"}
+    # the iteration/copy/pool reads must — and the scheduler-shaped
+    # ledger reads (serving/scheduler.py state) fire the same way
+    assert len(found) == 5
+    assert {v.key for v in found} == {
+        "running", "pool", "_tenants", "rejections",
+    }
 
 
 def test_thread_ownership_ignores_method_lookups(tmp_path):

@@ -438,6 +438,64 @@ def test_oai_error_types_key_sdk_retries():
         assert payload["error"]["type"] == expected
 
 
+def test_overload_returns_429_with_retry_after(setup):
+    """The pinned overload contract (serving/scheduler.py): a queue-full
+    rejection answers HTTP 429 with a Retry-After header and OpenAI's
+    retryable rate_limit_error envelope carrying the valve that fired —
+    NOT the generic invalid_request_error path (a retry CAN succeed)."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+
+    cfg, params = setup
+    prompt = _prompt(7, 9, cfg)
+
+    async def body(session, base):
+        # long decodes hold both slots; with a 1-deep queue a rapid
+        # burst must overflow it
+        posts = [
+            session.post(f"{base}/v1/completions", json={
+                "prompt": list(prompt), "max_tokens": 48,
+            })
+            for _ in range(8)
+        ]
+        results = await asyncio.gather(*posts)
+        rejected = [r for r in results if r.status == 429]
+        served = [r for r in results if r.status == 200]
+        assert rejected, "a 1-deep queue never overflowed under a burst"
+        assert served, "the queue cap must not reject everything"
+        for r in rejected:
+            assert "Retry-After" in r.headers
+            assert int(r.headers["Retry-After"]) >= 1
+            err = (await r.json())["error"]
+            assert err["type"] == "rate_limit_error"
+            assert err["code"] == "queue_full"
+            assert err["retry_after"] >= 1
+        for r in results:
+            await r.release()
+
+    run(_with_server(setup, body, scheduler=Scheduler(max_queue=1)))
+
+
+def test_sched_fields_parse_and_route(setup):
+    """tenant/priority/deadline_ms ride the OpenAI body (extra_body in
+    SDKs); invalid values are a 400 before submission."""
+    cfg, params = setup
+    prompt = _prompt(8, 9, cfg)
+
+    async def body(session, base):
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": list(prompt), "max_tokens": 2,
+            "tenant": "gold", "priority": 0, "deadline_ms": 60_000,
+        })
+        assert r.status == 200, await r.text()
+        r = await session.post(f"{base}/v1/completions", json={
+            "prompt": list(prompt), "max_tokens": 2, "priority": 99,
+        })
+        assert r.status == 400
+        assert "priority" in (await r.json())["error"]["message"]
+
+    run(_with_server(setup, body))
+
+
 def test_echo_prompt_scoring_matches_forward_oracle(setup):
     """echo=true + max_tokens=0 + logprobs returns the prompt's own
     teacher-forced logprobs (the lm-eval loglikelihood contract), equal

@@ -137,6 +137,44 @@ def test_health_and_validation(setup):
     run(_with_server(setup, body))
 
 
+def test_native_overload_429_and_sched_health(setup):
+    """Native-API twin of the OpenAI 429 pin: queue-full answers 429
+    with Retry-After and the structured overload body, and /v1/health
+    carries the scheduler's queue + per-tenant snapshot."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+
+    cfg, params = setup
+    prompt = _prompt(31, 9, cfg)
+
+    async def body(session, base):
+        posts = [
+            session.post(f"{base}/v1/generate", json={
+                "prompt": list(prompt), "max_new": 48, "tenant": "gold",
+            })
+            for _ in range(8)
+        ]
+        results = await asyncio.gather(*posts)
+        rejected = [r for r in results if r.status == 429]
+        served = [r for r in results if r.status == 200]
+        assert rejected and served
+        for r in rejected:
+            assert int(r.headers["Retry-After"]) >= 1
+            p = await r.json()
+            assert p["code"] == "overloaded"
+            assert p["reason"] == "queue_full"
+        async with session.get(f"{base}/v1/health") as r:
+            stats = await r.json()
+            sched = stats["sched"]
+            assert sched["policy"] == "fifo"
+            assert sched["max_queue"] == 1
+            assert sched["rejections"]["queue_full"] == len(rejected)
+            assert "gold" in sched["tenants"]
+        for r in results:
+            await r.release()
+
+    run(_with_server(setup, body, scheduler=Scheduler(max_queue=1)))
+
+
 def test_metrics_endpoint_exports_serving_counters(setup):
     from prometheus_client import CollectorRegistry
 
