@@ -67,7 +67,7 @@ san-test:
 # analyze runs right after lint — fail fast on invariant regressions
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
-	bench-prefix-cache bench-paged-kv bench-spec bench-sched
+	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp
 	python -m pytest tests/ -q
 
 bench:
@@ -114,12 +114,21 @@ bench-spec:
 bench-sched:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.sched_bench
 
+# CPU-runnable smoke: tensor-parallel serving on the forced 8-device
+# platform — a tp=1 vs tp=2 bit-identical stream check plus a tiny tp
+# throughput A/B asserting the new serve-row fields are present and
+# sane (one JSON line with tokens_per_second_tp, decode_step_ms_tp,
+# kv_pages_peak_per_shard_tp, kv_shard_reserved_bytes_tp,
+# tp_collective_overhead_pct).
+bench-tp:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.tp_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched clean watch
+	bench-sched bench-tp clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -450,9 +450,16 @@ def _run_serve() -> dict:
         serve_bench,
     )
 
+    import jax as _jax
+
     _require_accelerator()
     cfg = _bench_model_cfg()
-    r = serve_bench(cfg, spec_ab=True)
+    # the tp sweep arm engages whenever the allocated slice has chips to
+    # shard over (tp=2 is the first point of the scaling curve; deeper
+    # sweeps ride the same field set via BENCH_TP)
+    tp_degree = int(os.environ.get("BENCH_TP", 2))
+    r = serve_bench(cfg, spec_ab=True,
+                    tp_ab=len(_jax.devices()) > 1, tp_degree=tp_degree)
     return {
         "workload": "serve",
         "tokens_per_second": round(r.tokens_per_second, 1),
@@ -520,6 +527,25 @@ def _run_serve() -> dict:
         "rejected_fifo": r.rejected_fifo,
         "rejected_slo": r.rejected_slo,
         "preemptions_slo": r.preemptions_slo,
+        # tensor-parallel sweep A/B (parallel/tp_serving.py): the same
+        # workload tp-sharded — throughput/step-latency vs the tp=1
+        # primaries, the per-shard KV residency (the capacity win: each
+        # shard holds 1/tp of the bytes, so a replica fits tp times the
+        # pages/slots), and the measured collective overhead per step
+        "tp_degree": r.tp_degree,
+        "tp_layout": r.tp_layout,
+        "tokens_per_second_tp": round(r.tokens_per_second_tp, 1),
+        "tokens_per_second_tp_base": round(
+            r.tokens_per_second_tp_base, 1
+        ),
+        "decode_step_ms_tp": round(r.decode_step_ms_tp, 2),
+        "decode_step_ms_tp_base": round(r.decode_step_ms_tp_base, 2),
+        "device_step_ms_tp": round(r.device_step_ms_tp, 2),
+        "kv_pages_peak_per_shard_tp": r.kv_pages_peak_per_shard_tp,
+        "kv_shard_reserved_bytes_tp": r.kv_shard_reserved_bytes_tp,
+        "tp_collective_overhead_pct": round(
+            r.tp_collective_overhead_pct, 1
+        ),
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),

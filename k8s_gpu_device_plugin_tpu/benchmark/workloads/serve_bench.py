@@ -50,6 +50,16 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   the rejection/preemption counts — the numbers a millions-of-users
   operator actually runs on.
 
+- the tensor-parallel sweep A/B (``tp_ab=True``): the same workload
+  through a tp-sharded batcher (weights column-cut, KV on the head axis
+  over a ``tp_degree``-device mesh — parallel/tp_serving.py), reporting
+  ``tokens_per_second_tp`` / ``decode_step_ms_tp``, the per-shard
+  ``kv_pages_peak_per_shard_tp`` + ``kv_shard_reserved_bytes_tp`` (the
+  capacity win: each shard holds 1/tp of the KV bytes), and
+  ``tp_collective_overhead_pct`` — the measured device-step cost of the
+  gather collectives the bit-identity recipe inserts. The scaling curve
+  the BENCH artifacts pick up.
+
 Admission runs through chunked prefill by default (the production
 scheduler); pass ``chunked_prefill=0`` for bucketed one-shot prefills.
 
@@ -142,6 +152,33 @@ class ServeBenchResult:
     rejected_fifo: int = 0
     rejected_slo: int = 0
     preemptions_slo: int = 0
+    # tensor-parallel sweep A/B (``tp_ab=True``): the same mixed-length
+    # workload through a tp-sharded batcher (weights column-cut, KV on
+    # the head axis — parallel/tp_serving.py), against the tp=1 primary
+    # numbers above. All zero when tp_ab=False or tp doesn't divide the
+    # visible device / KV-head count (skip printed, never silent).
+    # ``kv_pages_peak_per_shard_tp`` is the PER-SHARD peak (page counts
+    # are replicated across shards; the bytes behind them divide by tp,
+    # which is the capacity win: ``kv_shard_reserved_bytes_tp`` vs the
+    # single-chip reservation). ``tp_collective_overhead_pct`` is the
+    # measured device-step cost of the inserted collectives (all-gathers
+    # at the wo/w2/sampling gather points): tp device step vs tp=1
+    # device step — on hardware the span tracer's decode_dispatch/
+    # readback pair attributes the same gap per step.
+    tp_degree: int = 0
+    tp_layout: str = ""
+    wall_seconds_tp: float = 0.0
+    tokens_per_second_tp: float = 0.0
+    # the LAYOUT-MATCHED tp=1 baseline (same kv layout as the tp arm —
+    # compare *_tp against these, not the dense primaries, or the paged
+    # gather cost would be misattributed to tensor parallelism)
+    tokens_per_second_tp_base: float = 0.0
+    decode_step_ms_tp: float = 0.0
+    decode_step_ms_tp_base: float = 0.0
+    device_step_ms_tp: float = 0.0
+    kv_pages_peak_per_shard_tp: int = 0
+    kv_shard_reserved_bytes_tp: int = 0
+    tp_collective_overhead_pct: float = 0.0
 
 
 class _PrefillRecorder:
@@ -487,6 +524,8 @@ def serve_bench(
     paged_ab: bool = True,
     spec_ab: bool = False,
     sched_ab: bool = True,
+    tp_ab: bool = False,
+    tp_degree: int = 2,
     sched_base_s: float = 4.0,
     sched_overload_s: float = 4.0,
     draft_cfg: "LlamaConfig | None" = None,
@@ -522,12 +561,14 @@ def serve_bench(
 
     prompts = make_prompts()
 
-    def make_batcher(depth: int, kv_layout: str = "dense") -> ContinuousBatcher:
+    def make_batcher(depth: int, kv_layout: str = "dense",
+                     tp: int = 1) -> ContinuousBatcher:
         return ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
             pipeline_depth=depth, kv_layout=kv_layout,
             kv_page_size=kv_page_size if kv_layout == "paged" else None,
+            tp=tp,
         )
 
     def prime(cb: ContinuousBatcher, budget: int) -> None:
@@ -543,9 +584,9 @@ def serve_bench(
             guard += 1
             assert guard < 10_000, "priming never converged"
 
-    def run_once(depth: int, kv_layout: str = "dense"
+    def run_once(depth: int, kv_layout: str = "dense", tp: int = 1
                  ) -> tuple[float, float, int]:
-        cb = make_batcher(depth, kv_layout)
+        cb = make_batcher(depth, kv_layout, tp)
         for p in prompts:
             cb.submit(p, max_new=max_new)
         t0 = time.perf_counter()
@@ -554,7 +595,7 @@ def serve_bench(
         peak = cb.pool.peak_in_use if cb.pool is not None else 0
         # per-step latency with every slot busy, measured separately so
         # admission prefills don't pollute it
-        cb2 = make_batcher(depth, kv_layout)
+        cb2 = make_batcher(depth, kv_layout, tp)
         prime(cb2, max_new)
         t1 = time.perf_counter()
         steps = 16
@@ -563,11 +604,14 @@ def serve_bench(
         step_ms = (time.perf_counter() - t1) / steps * 1000
         return wall, step_ms, peak
 
-    def device_only_ms(steps: int = 16) -> float:
+    def device_only_ms(steps: int = 16, kv_layout: str = "dense",
+                       tp: int = 1) -> float:
         """Pure device compute per decode step: raw ``decode_step``
         dispatches over a primed full batch, NO host token processing.
-        The batcher is discarded after (its host view desyncs)."""
-        cb = make_batcher(0)
+        The batcher is discarded after (its host view desyncs). The tp
+        arm dispatches under the mesh scope, so the timed steps include
+        exactly the collectives the serving loop pays."""
+        cb = make_batcher(0, kv_layout, tp)
         # headroom so the device-side budget never deactivates a row
         # inside the timed window
         prime(cb, min(max_new + steps + 8, max_len - max(prompt_lens)))
@@ -577,13 +621,14 @@ def serve_bench(
         eos = cb._eos_dev
         state, emitted = cb.state, None
         jax.block_until_ready(state.lengths)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, emitted, _ = decode_step(
-                params, state, allowed, eos, cfg, knobs,
-                sel=sel, bias=bias, seeds=seeds,
-            )
-        jax.block_until_ready(emitted)
+        with cb._dispatch_scope():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, emitted, _ = decode_step(
+                    cb.params, state, allowed, eos, cb.cfg, knobs,
+                    sel=sel, bias=bias, seeds=seeds,
+                )
+            jax.block_until_ready(emitted)
         return (time.perf_counter() - t0) / steps * 1000
 
     # decode_ab=False skips the pipelined-vs-sync measurement entirely
@@ -786,6 +831,80 @@ def serve_bench(
             max_queue=8 * n_slots,
         )
 
+    # --- tensor-parallel sweep A/B: the same workload tp-sharded ---
+    tp_fields: dict = {}
+    if tp_ab and tp_degree > 1:
+        n_dev = len(jax.devices())
+        if n_dev % tp_degree or cfg.n_kv_heads % tp_degree:
+            print(
+                f"serve_bench: tp A/B skipped — tp={tp_degree} must "
+                f"divide the device count ({n_dev}) and n_kv_heads "
+                f"({cfg.n_kv_heads})",
+                file=sys.stderr,
+            )
+        else:
+            # the tp arm runs paged when the geometry allows (the point
+            # of tp serving is more pages per replica; per-shard peak is
+            # the number an operator sizes kv_pages from), dense
+            # otherwise — either way against the SAME workload
+            tp_layout = (
+                "paged" if max_len % kv_page_size == 0 else "dense"
+            )
+            run_once(1, tp_layout, tp_degree)  # compile pass (tp jits)
+            wall_tp, step_ms_tp, peak_tp = run_once(1, tp_layout, tp_degree)
+            # layout-matched tp=1 baseline: the *_tp numbers must be
+            # read against the SAME kv layout, or the paged gather cost
+            # would be misattributed to tensor parallelism. Reuse the
+            # decode/paged A/B runs when they exist; else measure.
+            if tp_layout == "dense" and decode_ab:
+                wall_base, step_ms_base = wall, step_ms
+            elif tp_layout == "paged" and wall_paged:
+                wall_base, step_ms_base = wall_paged, step_ms_paged
+            else:
+                run_once(1, tp_layout)  # compile pass (tp=1 twins)
+                wall_base, step_ms_base, _ = run_once(1, tp_layout)
+            dev_tp = device_only_ms(kv_layout=tp_layout, tp=tp_degree)
+            dev_1 = (
+                device_ms if (decode_ab and tp_layout == "dense")
+                else device_only_ms(kv_layout=tp_layout)
+            )
+            # one shard's static reservation, arithmetically (building a
+            # probe batcher just to read kv_stats would re-shard the
+            # whole weight tree and allocate a fourth KV pool): the
+            # dense-equivalent pool is n_slots*(max_len/ps)+1 pages
+            from dataclasses import replace as _replace
+
+            from k8s_gpu_device_plugin_tpu.models.paging import (
+                kv_shard_token_bytes,
+            )
+
+            per = kv_shard_token_bytes(_replace(cfg, tp=tp_degree))
+            if tp_layout == "paged":
+                n_pages = n_slots * (max_len // kv_page_size) + 1
+                shard_bytes = n_pages * kv_page_size * per
+            else:
+                shard_bytes = n_slots * max_len * per
+            tp_fields = {
+                "tp_degree": tp_degree,
+                "tp_layout": tp_layout,
+                "wall_seconds_tp": wall_tp,
+                "tokens_per_second_tp": (
+                    n_requests * max_new / wall_tp if wall_tp else 0.0
+                ),
+                "tokens_per_second_tp_base": (
+                    n_requests * max_new / wall_base if wall_base else 0.0
+                ),
+                "decode_step_ms_tp": step_ms_tp,
+                "decode_step_ms_tp_base": step_ms_base,
+                "device_step_ms_tp": dev_tp,
+                "kv_pages_peak_per_shard_tp": peak_tp,
+                "kv_shard_reserved_bytes_tp": shard_bytes,
+                "tp_collective_overhead_pct": (
+                    max(0.0, dev_tp - dev_1) / dev_tp * 100.0
+                    if dev_tp else 0.0
+                ),
+            }
+
     total_new = n_requests * max_new  # eos disabled: every budget runs out
     return ServeBenchResult(
         n_requests=n_requests,
@@ -823,4 +942,5 @@ def serve_bench(
         spec_ms_per_accepted_token=spec_ms_acc,
         spec_gamma=spec_g,
         **sched_fields,
+        **tp_fields,
     )

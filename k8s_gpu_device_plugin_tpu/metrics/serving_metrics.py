@@ -134,6 +134,32 @@ class ServingMetrics:
             "Static HBM held by the KV cache arrays (both layouts)",
             registry=registry,
         )
+        # Tensor-parallel serving (tp>1 only — at tp=1 these series are
+        # never emitted, so the single-chip gauge surface stays byte-
+        # comparable across the flag flip): each shard's slice of the KV
+        # reservation/occupancy. Page COUNTS are identical across shards
+        # (one replicated host-side page table); the BYTES divide by tp.
+        # Label cardinality is bounded by the mesh size.
+        self.kv_shard_reserved_bytes = Gauge(
+            f"{prefix}_kv_shard_reserved_bytes",
+            "Static KV HBM held on one tensor-parallel shard",
+            ["shard"],
+            registry=registry,
+        )
+        self.kv_shard_pages_in_use = Gauge(
+            f"{prefix}_kv_shard_pages_in_use",
+            "KV pool pages referenced on one tensor-parallel shard "
+            "(identical across shards by design — divergence means a "
+            "table/pool bug)",
+            ["shard"],
+            registry=registry,
+        )
+        self.kv_shard_in_use_bytes = Gauge(
+            f"{prefix}_kv_shard_in_use_bytes",
+            "Allocated KV page bytes resident on one tensor-parallel shard",
+            ["shard"],
+            registry=registry,
+        )
         # Speculative decoding (models/spec_batching.py): rounds run,
         # tokens the draft proposed vs tokens the verify accepted (bonus
         # token included), and the per-slot-round acceptance-length
@@ -288,6 +314,9 @@ class ServingMetrics:
             self.kv_page_fragmentation_pct,
             self.kv_admission_rejected,
             self.kv_reserved_bytes,
+            self.kv_shard_reserved_bytes,
+            self.kv_shard_pages_in_use,
+            self.kv_shard_in_use_bytes,
             self.spec_rounds,
             self.spec_tokens_drafted,
             self.spec_tokens_accepted,
@@ -354,6 +383,25 @@ class ServingMetrics:
 
     def set_kv_reserved_bytes(self, nbytes: int) -> None:
         self.kv_reserved_bytes.set(nbytes)
+
+    def set_kv_shards(self, shards) -> None:
+        """Per-shard KV residency under tensor-parallel serving: one
+        dict per shard from ``kv_stats()["shards"]`` (snapshot-built on
+        the engine thread; this hook only writes gauges). Never called
+        at tp=1 — the aggregate gauges above are that surface."""
+        for s in shards:
+            label = str(s["shard"])
+            self.kv_shard_reserved_bytes.labels(shard=label).set(
+                s["reserved_bytes"]
+            )
+            if "pages_in_use" in s:
+                self.kv_shard_pages_in_use.labels(shard=label).set(
+                    s["pages_in_use"]
+                )
+            if "in_use_bytes" in s:
+                self.kv_shard_in_use_bytes.labels(shard=label).set(
+                    s["in_use_bytes"]
+                )
 
     # --- scheduler hooks (serving/scheduler.py) ---
 

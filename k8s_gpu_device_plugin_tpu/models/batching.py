@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import time
 import weakref
+from contextlib import nullcontext
 from dataclasses import dataclass, field, replace
 from functools import partial
 
@@ -71,7 +72,11 @@ from k8s_gpu_device_plugin_tpu.models.generate import (
     _forward_cached,
 )
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
-from k8s_gpu_device_plugin_tpu.models.paging import PagePool, kv_token_bytes
+from k8s_gpu_device_plugin_tpu.models.paging import (
+    PagePool,
+    kv_shard_token_bytes,
+    kv_token_bytes,
+)
 from k8s_gpu_device_plugin_tpu.models.sampling import (
     Sampler,
     sample_and_mark_dyn,
@@ -497,6 +502,7 @@ class ContinuousBatcher:
         kv_page_size: int | None = None,  # None = take cfg.kv_page_size
         kv_pages: int = 0,  # paged pool size; 0 = dense-equivalent HBM
         scheduler=None,  # serving.scheduler.Scheduler (or None = FIFO)
+        tp: int | None = None,  # None = take cfg.tp (1 = single chip)
     ):
         # the KV layout rides in the (static) cfg so every jitted step
         # branches on it at trace time; the explicit kwargs are sugar so
@@ -510,6 +516,21 @@ class ContinuousBatcher:
                     else int(kv_page_size)
                 ),
             )
+        # tensor parallelism rides in the static cfg the same way: every
+        # jitted step's tp constraints branch on it at trace time, and
+        # tp=1 (the default) traces EXACTLY the single-chip graph
+        if tp is not None and int(tp) != cfg.tp:
+            cfg = replace(cfg, tp=int(tp))
+        # the mesh (and the startup divisibility validation — tp must
+        # divide the device count and the KV-head count) comes first:
+        # everything below device_puts against it
+        self.mesh = None
+        if cfg.tp > 1:
+            from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+                serving_mesh,
+            )
+
+            self.mesh = serving_mesh(cfg.tp, cfg.n_kv_heads)
         if cfg.kv_layout == "paged":
             if not self.supports_paged_kv:
                 raise ValueError(
@@ -541,6 +562,16 @@ class ContinuousBatcher:
         self.n_adapters = len(self.adapter_names)
         self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs; owner: engine
         self._bias_cache: jax.Array | None = None  # (n_slots, V), like knobs; owner: engine
+        if self.mesh is not None:
+            # load-time weight shard (the pjit/NamedSharding pattern):
+            # column-cut projections + lm_head, replicated reduction
+            # weights — the bit-identity-safe recipe; adapter stacks
+            # (attached above) and quantized leaves replicate
+            from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+                shard_serving_params,
+            )
+
+            params = shard_serving_params(params, cfg, self.mesh)
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -548,8 +579,9 @@ class ContinuousBatcher:
         self.sampler = sampler or Sampler()
         self.eos_id = -1 if eos_id is None else eos_id
         # device-resident eos scalar: the decode dispatch must not pay
-        # even a scalar H2D per step (the zero-transfer steady state)
-        self._eos_dev = jnp.int32(self.eos_id)
+        # even a scalar H2D per step (the zero-transfer steady state);
+        # under tp it commits replicated onto the mesh once, here
+        self._eos_dev = self._dev(jnp.int32(self.eos_id))
         # chunked_prefill=C > 0: admission runs in C-token chunks
         # interleaved with decode steps (one chunk per step) instead of
         # one bucketed prefill dispatch — running slots' per-token latency
@@ -625,6 +657,18 @@ class ContinuousBatcher:
                     f"(this batcher's: {cfg.kv_layout!r}); dense rows "
                     "and page-id tuples are not interchangeable"
                 )
+            if prefix_cache.stats.entries and (
+                getattr(prefix_cache.cfg, "tp", 1) != cfg.tp
+            ):
+                # dense entries hold rows sharded over the promoting
+                # batcher's mesh; re-aliasing them under a different
+                # (or no) mesh would silently reshard mid-stream
+                raise ValueError(
+                    "prefix cache already holds entries materialized "
+                    f"under tp={getattr(prefix_cache.cfg, 'tp', 1)} "
+                    f"(this batcher's: {cfg.tp}); attach a fresh "
+                    "PrefixCache"
+                )
             prefix_cache.chunk = self.chunk
             prefix_cache.buckets = self.buckets
             # rebind the byte-accounting config too: paged entries round
@@ -668,6 +712,18 @@ class ContinuousBatcher:
         # owner: engine (snapshot via kv_stats() for cross-thread reads)
         self.state = init_batch_state(cfg, n_slots, max_len, seed,
                                       n_pages=n_pages)
+        if self.mesh is not None:
+            # every BatchState leaf gets an EXPLICIT sharding at init —
+            # cache (dense rows or the paged pool) on the KV-head axis,
+            # everything else (lengths/masks/key/budgets and the one
+            # replicated host-side page table) replicated — and every
+            # jitted step preserves them, so prefill/decode/spec-verify
+            # dispatch as sharded jits with the zero-H2D carry intact
+            from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+                shard_batch_state,
+            )
+
+            self.state = shard_batch_state(self.state, self.mesh)
         self.pending: list[_Request] = []  # owner: engine
         # Pluggable admission policy (serving/scheduler.py), duck-typed
         # like the prefix cache and metrics so this module keeps its
@@ -970,10 +1026,32 @@ class ContinuousBatcher:
 
     # --- internals ---
 
+    def _dev(self, x) -> jax.Array:
+        """Host value -> resident device array. tp=1: a plain asarray,
+        exactly the old upload. tp>1: committed REPLICATED onto the tp
+        mesh — jit requires one device assembly across its args, and an
+        uncommitted single-device array would be re-transferred on
+        every call, quietly breaking the zero-per-step-H2D contract the
+        hot-path-h2d checker pins."""
+        x = jnp.asarray(x)
+        if self.mesh is None:
+            return x
+        from k8s_gpu_device_plugin_tpu.parallel.tp_serving import replicate
+
+        return replicate(x, self.mesh)
+
+    def _dispatch_scope(self):  # graftlint: hot-path
+        """The mesh scope every device dispatch runs under: tp>1 traces
+        bind the tp-axis sharding constraints in models/generate.py
+        inside it; tp=1 returns a nullcontext and traces exactly the
+        pre-tp graphs (the constraints no-op without a mesh). Runs once
+        per step — registered hot so no transfer ever sneaks in."""
+        return self.mesh if self.mesh is not None else nullcontext()
+
     def _req_knobs(self, req: _Request) -> jax.Array:
-        return jnp.asarray(
+        return self._dev(jnp.asarray(
             sampler_knobs(req.sampler or self.sampler), jnp.float32
-        )
+        ))
 
     def _batch_knobs(self) -> jax.Array:
         """(n_slots, 4) per-slot sampler knobs for the decode step (the
@@ -987,7 +1065,7 @@ class ContinuousBatcher:
             for slot, req in self.running.items():
                 if req.sampler is not None:
                     arr[slot] = sampler_knobs(req.sampler)
-            self._knobs_cache = jnp.asarray(arr)
+            self._knobs_cache = self._dev(arr)
         return self._knobs_cache
 
     def _req_bias(self, req: _Request) -> "jax.Array | None":
@@ -999,7 +1077,7 @@ class ContinuousBatcher:
         arr = np.zeros((1, self.cfg.vocab_size), np.float32)
         for tok, b in req.bias:
             arr[0, tok] += b
-        return jnp.asarray(arr)
+        return self._dev(arr)
 
     def _batch_bias(self) -> "jax.Array | None":
         """(n_slots, V) per-slot bias planes for the decode step; None
@@ -1016,7 +1094,7 @@ class ContinuousBatcher:
                 for slot, req in self.running.items():
                     for tok, b in req.bias:
                         arr[slot, tok] += b
-                self._bias_cache = jnp.asarray(arr)
+                self._bias_cache = self._dev(arr)
             else:
                 self._bias_cache = _NONE_CACHED
         return None if self._bias_cache is _NONE_CACHED else self._bias_cache
@@ -1025,7 +1103,7 @@ class ContinuousBatcher:
         """(1,) seed for one request's prefill sampling (draw 0)."""
         if req.seed is None:
             return None
-        return jnp.asarray([req.seed], jnp.int32)
+        return self._dev(jnp.asarray([req.seed], jnp.int32))
 
     def _batch_seeds(self):
         """(B,) per-slot seeds for the decode step — or None when no
@@ -1040,7 +1118,7 @@ class ContinuousBatcher:
                 for slot, req in self.running.items():
                     if req.seed is not None:
                         seeds[slot] = req.seed
-                self._seeds_cache = jnp.asarray(seeds)
+                self._seeds_cache = self._dev(seeds)
             else:
                 self._seeds_cache = _NONE_CACHED
         return None if self._seeds_cache is _NONE_CACHED else self._seeds_cache
@@ -1052,7 +1130,7 @@ class ContinuousBatcher:
         if self._allowed_cache is None:
             allowed_np = np.zeros((self.n_slots,), bool)
             allowed_np[list(self.running)] = True
-            self._allowed_cache = jnp.asarray(allowed_np)
+            self._allowed_cache = self._dev(allowed_np)
         return self._allowed_cache
 
     def _invalidate_slot_caches(self) -> None:
@@ -1073,7 +1151,9 @@ class ContinuousBatcher:
             return None
         from k8s_gpu_device_plugin_tpu.models.lora_serving import one_hot_sel
 
-        return jnp.asarray(one_hot_sel(req.adapter, self.n_adapters))[None, :]
+        return self._dev(
+            jnp.asarray(one_hot_sel(req.adapter, self.n_adapters))[None, :]
+        )
 
     def _batch_sel(self) -> "jax.Array | None":
         """(n_slots, N) per-slot adapter one-hots for the decode step;
@@ -1090,7 +1170,7 @@ class ContinuousBatcher:
             arr = np.zeros((self.n_slots, self.n_adapters), np.float32)
             for slot, req in self.running.items():
                 arr[slot] = one_hot_sel(req.adapter, self.n_adapters)
-            self._sel_cache = jnp.asarray(arr)
+            self._sel_cache = self._dev(arr)
         return self._sel_cache
 
     def _admit(self) -> None:
@@ -1448,7 +1528,10 @@ class ContinuousBatcher:
                 count(reason)
 
     def _report_kv_gauges(self) -> None:
-        if self.metrics is None or self.pool is None:
+        if self.metrics is None:
+            return
+        self._report_kv_shard_gauges()
+        if self.pool is None:
             return
         set_pages = getattr(self.metrics, "set_kv_pages", None)
         if set_pages is not None:
@@ -1456,19 +1539,62 @@ class ContinuousBatcher:
             set_pages(s["pages_total"], s["pages_in_use"],
                       s["fragmentation_pct"])
 
+    def _report_kv_shard_gauges(self) -> None:
+        """Per-shard KV gauges (tp>1 only — the tp=1 gauge surface is
+        byte-identical to the pre-tp server, for comparability)."""
+        if self.metrics is None or self.cfg.tp <= 1:
+            return
+        set_shards = getattr(self.metrics, "set_kv_shards", None)
+        if set_shards is not None:
+            set_shards(self.kv_stats().get("shards", []))
+
+    def _kv_shard_view(self, out: dict) -> dict:
+        """Append the per-shard view to a kv_stats dict under tp>1: one
+        entry per tensor-parallel shard, each holding its slice of every
+        page/row (page COUNTS are identical across shards by design —
+        one replicated host-side table — while the BYTES behind them
+        split by tp). tp=1 returns ``out`` untouched: the health surface
+        stays byte-comparable with the single-chip server."""
+        if self.cfg.tp <= 1:
+            return out
+        per = kv_shard_token_bytes(self.cfg)
+        shards = []
+        for i in range(self.cfg.tp):
+            s: dict = {"shard": i}
+            if self.pool is None:
+                s["reserved_bytes"] = self.n_slots * self.max_len * per
+            else:
+                s["reserved_bytes"] = (
+                    self.pool.n_pages * self.pool.page_size * per
+                )
+                s["in_use_bytes"] = (
+                    self.pool.in_use * self.pool.page_size * per
+                )
+                s["pages_total"] = self.pool.capacity
+                s["pages_in_use"] = self.pool.in_use
+                s["pages_free"] = self.pool.free_pages
+            shards.append(s)
+        out["tp"] = self.cfg.tp
+        out["shards"] = shards
+        return out
+
     def kv_stats(self) -> dict:
         """KV residency for /v1/health and the gauges — both layouts
         report ``reserved_bytes`` (the static HBM the cache arrays hold)
         so dense and paged are directly comparable; paged adds the pool
         occupancy and internal fragmentation (allocated page capacity
         not covered by live tokens — tail-page waste plus pages pinned
-        by promoted prefixes)."""
+        by promoted prefixes). Under tensor-parallel serving (tp>1) a
+        ``shards`` list reports each shard's slice alongside the
+        aggregates; at tp=1 the dict is exactly the pre-tp one. Always a
+        SNAPSHOT built from engine-owned state (the thread-ownership
+        contract: /v1/health reads this cross-thread)."""
         tb = kv_token_bytes(self.cfg)
         if self.pool is None:
-            return {
+            return self._kv_shard_view({
                 "layout": "dense",
                 "reserved_bytes": self.n_slots * self.max_len * tb,
-            }
+            })
         # list() snapshots before iterating: /v1/health calls this from
         # the HTTP thread while the engine thread admits/retires, and a
         # mid-generator dict mutation raises RuntimeError (the same
@@ -1480,7 +1606,7 @@ class ContinuousBatcher:
             for r in list(self.running.values())
         ) + sum(self._prefill_pos.get(s, 0) for s in list(self.prefilling))
         cap_tokens = self.pool.in_use * self.pool.page_size
-        return {
+        return self._kv_shard_view({
             "layout": "paged",
             "page_size": self.pool.page_size,
             "pages_total": self.pool.capacity,
@@ -1492,7 +1618,7 @@ class ContinuousBatcher:
             ),
             "reserved_bytes": self.pool.n_pages * self.pool.page_size * tb,
             "in_use_bytes": cap_tokens * tb,
-        }
+        })
 
     def _prefill_one_chunk(self) -> None:
         """Advance the oldest mid-prefill request by one chunk; on its
@@ -1830,7 +1956,21 @@ class ContinuousBatcher:
         still running — the saturated queue, and steady chunked
         admission — there is no hazard and no flush: the pipeline keeps
         streaming through admissions.
+
+        Every device dispatch a step makes — admission prefills, page-
+        table installs, prefix promotion slices, the decode dispatch —
+        runs inside :meth:`_dispatch_scope`, so under tp>1 every trace
+        binds the tensor-parallel sharding constraints (tp=1 is a
+        nullcontext: today's graphs exactly).
         """
+        with self._dispatch_scope():
+            self._step_inner()
+
+    def _step_inner(self) -> None:  # graftlint: hot-path
+        # the per-step driver is REGISTERED hot: everything it runs —
+        # sharded or not — must keep the zero-per-step-H2D contract (a
+        # per-step device_put of, say, the page table would silently
+        # re-upload the whole table every token)
         n_emitted = 0
         if self._inflight is not None and (
             self.pending or self.prefilling or not self.running
@@ -2262,7 +2402,19 @@ def precompute_prefix(
                 "own .params (attach_adapters output), not the base tree"
             )
         sel = jnp.asarray(one_hot_sel(adapter, n_adapters))[None, :]
-    rows, seen = _precompute_prefix(params, arr, jnp.int32(n), cfg, sel)
+    scope = nullcontext()
+    if cfg.tp > 1:
+        # trace under the serving mesh so the tp constraints bind (the
+        # caller passes the batcher's SHARDED params; an unconstrained
+        # trace would leave the partitioner free to psum, breaking the
+        # bit-identity the inserted rows must preserve)
+        from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+            serving_mesh,
+        )
+
+        scope = serving_mesh(cfg.tp, cfg.n_kv_heads)
+    with scope:
+        rows, seen = _precompute_prefix(params, arr, jnp.int32(n), cfg, sel)
     if pad != n:
         # slice back to the exact length: the padded tail rows are
         # causal-masked garbage and must not enter _insert_prefix (they

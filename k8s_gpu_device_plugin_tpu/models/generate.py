@@ -40,6 +40,8 @@ from k8s_gpu_device_plugin_tpu.models.llama import (
     rms_norm,
     rope,
 )
+from k8s_gpu_device_plugin_tpu.parallel.mesh import constrain
+from k8s_gpu_device_plugin_tpu.parallel.tp_serving import HEADS, REPLICATED
 from k8s_gpu_device_plugin_tpu.models.quantized_serving import (
     qexpert_einsum,
     qhead_matmul,
@@ -191,8 +193,16 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlin
     the two layouts' outputs are bitwise equal (garbage rows differ but
     sit behind exact-zero softmax weights in both)."""
     b, t, hq, hd = q.shape
+    # Tensor-parallel serving runs the XLA paths only: a pallas_call is
+    # an opaque custom call the SPMD partitioner cannot shard, so under
+    # tp>1 it would force the head-sharded cache replicated — undoing
+    # the KV win the sharding exists for. The XLA gather/einsum below is
+    # head-parallel and bitwise equal to the kernels' contract anyway;
+    # a tp-aware kernel (shard_map over the head axis) is future work.
+    kernels_ok = cfg.tp == 1
     if pages is not None:
-        if t == 1 and k_scale is None and cfg.decode_attn == "ragged":
+        if (t == 1 and k_scale is None and cfg.decode_attn == "ragged"
+                and kernels_ok):
             from k8s_gpu_device_plugin_tpu.ops import paged_attention
 
             interpret = jax.default_backend() != "tpu"
@@ -209,7 +219,7 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlin
                     window=cfg.sliding_window, interpret=interpret,
                 )
         elif (verify and t > 1 and k_scale is None
-              and cfg.decode_attn == "ragged"):
+              and cfg.decode_attn == "ragged" and kernels_ok):
             # the speculative verify window: T=gamma queries per slot at
             # consecutive positions, page-table-routed DMA (the verify
             # variant of the ragged kernel). Gated on the EXPLICIT
@@ -237,7 +247,8 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlin
         pages = None  # below here the gathered view IS the dense cache
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
-    if t == 1 and k_scale is None and cfg.decode_attn == "ragged":
+    if (t == 1 and k_scale is None and cfg.decode_attn == "ragged"
+            and kernels_ok):
         # Pallas ragged decode: stream only each row's live cache prefix
         # (ops/ragged_decode.py); opt-in until a hardware window confirms
         # the win. Live rows are positions <= length (the current token's
@@ -370,6 +381,16 @@ def _project_qkv(x, layer, positions, cfg, sel=None):
     q = q.reshape(b, t, cfg.n_heads, hd)
     k = k.reshape(b, t, cfg.n_kv_heads, hd)
     v = v.reshape(b, t, cfg.n_kv_heads, hd)
+    if cfg.tp > 1:
+        # tensor-parallel serving: pin q/k/v to the head shards the
+        # column-cut wq/wk/wv produced (parallel/tp_serving.py) so the
+        # cache write and attention stay head-local — per-head bits are
+        # exactly the tp=1 bits (no contraction ever crosses a shard).
+        # constrain() no-ops when no mesh scope is active (tp=1 never
+        # enters one), so the single-chip graph is untouched.
+        q = constrain(q, HEADS)
+        k = constrain(k, HEADS)
+        v = constrain(v, HEADS)
     return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
 
 
@@ -382,7 +403,12 @@ def _mlp_out(x, layer, cfg, sel=None):
         _qm_lora(h, layer, "w1", sel).astype(jnp.float32), cfg
     ).astype(x.dtype)
     up = _qm_lora(h, layer, "w3", sel)
-    return _qm_lora(gate * up, layer, "w2", sel)
+    hidden = gate * up
+    if cfg.tp > 1:
+        # same no-psum rule as wo: gather the (column-sharded) hidden
+        # activation and run the replicated w2 contraction whole
+        hidden = constrain(hidden, REPLICATED)
+    return _qm_lora(hidden, layer, "w2", sel)
 
 
 def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
@@ -404,6 +430,13 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
                              cfg, pages=pages, verify=verify)
+    if cfg.tp > 1:
+        # gather the head-sharded attention output to replicated BEFORE
+        # the wo contraction: wo stays replicated and the matmul runs
+        # whole on every shard — identical bits, where a row-sharded wo
+        # + psum would split the f32 accumulation (the one thing that
+        # breaks the tp=1-vs-tp=N stream pin)
+        attn = constrain(attn, REPLICATED)
     x = x + _qm_lora(
         attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer, "wo", sel
     )
@@ -469,6 +502,11 @@ def _forward_cached(
     elif select_pos is not None:
         x = jax.lax.dynamic_slice_in_dim(x, select_pos, 1, axis=1)
     logits = qhead_matmul(x, head_weights(params, cfg), cfg.dtype)
+    if cfg.tp > 1:
+        # the lm_head is column-sharded over vocab (each shard's logit
+        # columns are bitwise the tp=1 columns); sampling needs the full
+        # distribution on every device — gather, pure data movement
+        logits = constrain(logits, REPLICATED)
     return logits, KVCache(
         k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new
     )

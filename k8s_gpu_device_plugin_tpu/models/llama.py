@@ -118,6 +118,18 @@ class LlamaConfig:
     # the batcher's max_len, and multiples of 8 keep the Pallas paged
     # decode kernel's pages sublane-aligned
     kv_page_size: int = 64
+    # Serving tensor parallelism (models/batching.py + parallel/
+    # tp_serving.py): shards the decode path over a tp-axis device mesh —
+    # q/k/v/gate/up projections and the lm_head column-wise, the KV cache
+    # (dense rows and the paged pool alike) on the KV-head axis. 1 (the
+    # default) is exactly the single-chip path: no mesh is ever built and
+    # the traced graphs are unchanged. The sharding recipe is chosen so
+    # no cross-device contraction ever splits a reduction (column shards
+    # + gather-to-replicated before wo/w2/sampling), which is what keeps
+    # tp>1 token/logprob streams BIT-identical to tp=1 (test-pinned).
+    # Must divide n_kv_heads (and therefore n_heads); validated at mesh
+    # construction with an actionable error.
+    tp: int = 1
     # Fused lm_head+cross-entropy (ops/fused_ce.py): never materializes the
     # (B,S,V) logits. Training-loss only (no logits output, no accuracy);
     # requires the vocab axis unsharded (tp == 1) — loss_fn falls back
@@ -170,6 +182,10 @@ class LlamaConfig:
         if self.kv_page_size < 1:
             raise ValueError(
                 f"kv_page_size must be >= 1, got {self.kv_page_size}"
+            )
+        if self.tp < 1:
+            raise ValueError(
+                f"tp must be >= 1 (1 = single-chip serving), got {self.tp}"
             )
         if self.act not in ("silu", "gelu_tanh"):
             raise ValueError(

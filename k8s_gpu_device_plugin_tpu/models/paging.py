@@ -135,7 +135,13 @@ def kv_token_bytes(cfg) -> int:
     denominator both layouts' resident-bytes gauges share, so the dense
     reservation and the paged pool are comparable on /metrics. The paged
     layout itself refuses quantized caches (their scale planes are not
-    paged); the quant arms here keep the DENSE gauge honest."""
+    paged); the quant arms here keep the DENSE gauge honest.
+
+    This is the AGGREGATE across tensor-parallel shards: the cache
+    shards on the KV-head axis (parallel/tp_serving.py), so a page id
+    names the same page on every shard and the ALLOCATOR above stays
+    one host-side free list regardless of tp — only the bytes behind
+    each page split, by :func:`kv_shard_token_bytes`."""
     import jax.numpy as jnp
 
     per_elt = {"int8": 1.0, "int4": 0.5}.get(cfg.cache_quant)
@@ -145,3 +151,14 @@ def kv_token_bytes(cfg) -> int:
     if cfg.cache_quant in ("int8", "int4"):
         nbytes += 2 * cfg.n_layers * cfg.n_kv_heads * 4  # f32 scales
     return int(nbytes)
+
+
+def kv_shard_token_bytes(cfg) -> int:
+    """Per-SHARD HBM bytes of one cached token row under tensor-parallel
+    serving: each of ``cfg.tp`` shards holds ``n_kv_heads / tp`` heads'
+    worth of every page/row — K/V values AND the quantized scale planes,
+    which are per-(position, head) and shard on the same axis
+    (parallel/tp_serving.py ``batch_state_shardings``) — so the division
+    is exact (the mesh validation guarantees tp | n_kv_heads). tp=1
+    degenerates to :func:`kv_token_bytes`."""
+    return kv_token_bytes(cfg) // max(1, getattr(cfg, "tp", 1))

@@ -301,7 +301,6 @@ class SpeculativeBatcher(ContinuousBatcher):
         super().__init__(params, cfg, n_slots, max_len, **kw)
         if not self.chunk:
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
-        self.draft_params = draft_params
         # the draft rides the SAME layout as the target (self.cfg is the
         # post-kwarg config): mismatched layouts would desynchronize the
         # two caches' write plumbing
@@ -310,10 +309,29 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "the draft cache cannot be quantized under "
                 "kv_layout='paged' (scale planes are not paged)"
             )
+        if self.cfg.tp > 1 and draft_cfg.n_kv_heads % self.cfg.tp:
+            # the draft cache shards on the SAME tp mesh as the target;
+            # a draft whose KV heads don't divide would trace unsharded
+            # and silently replicate its cache across every shard
+            raise ValueError(
+                f"tp={self.cfg.tp} does not divide the draft model's "
+                f"n_kv_heads={draft_cfg.n_kv_heads}: the draft KV cache "
+                "shards on the same mesh as the target — pick a tp from "
+                "the common divisors of both head counts"
+            )
         self.draft_cfg = replace(
             draft_cfg, kv_layout=self.cfg.kv_layout,
-            kv_page_size=self.cfg.kv_page_size,
+            kv_page_size=self.cfg.kv_page_size, tp=self.cfg.tp,
         )
+        if self.mesh is not None:
+            from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+                shard_serving_params,
+            )
+
+            draft_params = shard_serving_params(
+                draft_params, self.draft_cfg, self.mesh
+            )
+        self.draft_params = draft_params
         # the draft's own page pool: same page/slot geometry as the
         # target's (the tables are twins), far fewer bytes (the draft
         # model's layers/heads). Refcounts exist for symmetry but no
@@ -341,6 +359,16 @@ class SpeculativeBatcher(ContinuousBatcher):
         self.draft_state = init_batch_state(
             self.draft_cfg, n_slots, max_len, n_pages=n_draft_pages
         )
+        if self.mesh is not None:
+            # the draft state leaves shard exactly like the target's:
+            # cache on the KV-head axis, table/masks replicated
+            from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+                shard_batch_state,
+            )
+
+            self.draft_state = shard_batch_state(
+                self.draft_state, self.mesh
+            )
         # host-side acceptance accounting (spec_stats / the metrics
         # hooks): rounds that had >= 1 active slot, gamma-proposals
         # drafted, and device-side accepted counts (bonus included;
@@ -515,6 +543,14 @@ class SpeculativeBatcher(ContinuousBatcher):
         s["draft_reserved_bytes"] = draft["reserved_bytes"]
         s["reserved_bytes"] += draft["reserved_bytes"]
         s["draft"] = draft
+        for shard in s.get("shards", ()):  # tp>1: draft bytes split too
+            per_shard_draft = draft["reserved_bytes"] // self.cfg.tp
+            shard["draft_reserved_bytes"] = per_shard_draft
+            # the shard's reserved_bytes must mean what the aggregate
+            # means (target + draft): the kv_shard_reserved_bytes gauge
+            # is what an operator sizes per-chip HBM from, and the
+            # shard gauges must sum to the aggregate gauge
+            shard["reserved_bytes"] += per_shard_draft
         return s
 
     def spec_stats(self) -> dict:

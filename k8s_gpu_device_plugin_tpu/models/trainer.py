@@ -403,9 +403,12 @@ def _main(argv: list[str] | None = None) -> int:
             model, quant=args.quant, fused_ce=args.fusedCE,
             param_dtype=jnp.float32 if args.masterWeights else None,
         )
-    spec = MeshSpec.for_devices(
-        len(jax.devices()), tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
-        fsdp=args.fsdp,
+    # the shared mesh-flag rule (also behind the inference server's
+    # --tp): axis sizes validated against the device count at startup
+    # with an actionable error instead of deep inside a pjit trace
+    spec = MeshSpec.from_flags(
+        tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep, fsdp=args.fsdp,
+        n_devices=len(jax.devices()),
     )
     cfg = TrainerConfig(
         model=model,

@@ -86,6 +86,65 @@ class MeshSpec:
             raise ValueError(f"remainder {rest} not divisible by fsdp={fsdp}")
         return MeshSpec(dp=rest // fsdp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp)
 
+    @staticmethod
+    def from_flags(
+        tp: int = 1,
+        sp: int = 1,
+        ep: int = 1,
+        pp: int = 1,
+        fsdp: int | None = None,
+        n_devices: int | None = None,
+        n_kv_heads: int | None = None,
+        exact: bool = False,
+    ) -> "MeshSpec":
+        """The ONE mesh-construction/validation rule behind every CLI
+        surface (the trainer's --tp/--sp/... flags and the inference
+        server's --tp), so flag errors mean the same thing everywhere
+        and fail at STARTUP with an actionable message instead of deep
+        inside a pjit trace.
+
+        ``n_devices`` defaults to ``len(jax.devices())``. ``exact=True``
+        is the SERVING shape: dp/fsdp stay 1 (the returned spec spans
+        exactly tp*sp*ep*pp devices — the serving mesh never
+        data-parallels leftovers, chips beyond it simply stay unused);
+        the divisibility check below still applies either way, because
+        a tp that doesn't divide the allocated chip count is almost
+        always a mis-sized flag, and failing loudly at startup beats
+        silently serving a lopsided slice. ``n_kv_heads`` adds the
+        serving KV-shard divisibility check: the cache shards on the
+        KV-head axis, so tp must divide it."""
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        inner = tp * sp * ep * pp
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if inner > n_devices:
+            raise ValueError(
+                f"mesh tp*sp*ep*pp={inner} needs {inner} devices but only "
+                f"{n_devices} are visible; lower the axis sizes or "
+                "allocate a larger slice"
+            )
+        if n_devices % inner != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*sp*ep*pp="
+                f"{inner}; pick axis sizes whose product divides the "
+                "device count (a non-dividing size is almost always a "
+                "mis-sized flag — fail at startup, not mid-trace)"
+            )
+        if n_kv_heads is not None and n_kv_heads % tp != 0:
+            raise ValueError(
+                f"tp={tp} does not divide n_kv_heads={n_kv_heads}: the "
+                "serving KV cache shards on the KV-head axis, so every "
+                "shard must hold a whole number of heads — pick a tp "
+                f"from the divisors of {n_kv_heads}"
+            )
+        if exact:
+            # serving: the mesh IS the device set (dp/fsdp stay 1)
+            return MeshSpec(dp=1, fsdp=1, tp=tp, sp=sp, ep=ep, pp=pp)
+        return MeshSpec.for_devices(
+            n_devices, tp=tp, sp=sp, ep=ep, pp=pp, fsdp=fsdp
+        )
+
 
 def make_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
     """Build a Mesh with ICI-friendly physical layout."""

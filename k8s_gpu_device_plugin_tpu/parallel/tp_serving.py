@@ -1,0 +1,203 @@
+"""Tensor-parallel SERVING shardings: the decode path over a tp mesh.
+
+The training stack shards for throughput (parallel/mesh.py +
+models/llama.py ``param_specs``: megatron column/row pairs whose row
+halves psum partial products). Serving has a harder contract — the
+house style pins greedy+seeded token AND logprob streams BIT-identical
+across every engine knob, and a psum splits a floating-point reduction
+into per-shard partials whose summation order differs from the
+single-chip contraction (measurably: bf16 operands, f32 accumulation,
+~1e-5 drift — enough to flip a near-tie argmax). So the serving recipe
+shards only what stays bitwise exact:
+
+- **Column shards** (``wq``/``wk``/``wv`` + the Qwen2 biases,
+  ``w1``/``w3``, ``lm_head``): the contraction runs whole on every
+  shard — each device computes its output columns with the same
+  K-accumulation order the full matmul uses, so the sharded columns are
+  bitwise equal to the corresponding columns of the tp=1 result.
+- **Head shards** (the KV cache — dense rows and the paged pool alike —
+  and the q/k/v/attention activations): attention is embarrassingly
+  parallel over heads (scores, softmax and the V-contraction never
+  cross a head), so each shard's heads are bitwise the tp=1 heads. This
+  is the serving win the ROADMAP names: the KV HBM per chip drops by
+  tp, so a replica holds tp times the pages/slots/prefix entries.
+- **Replicated reductions** (``wo``, ``w2``, sampling): the activation
+  is gathered to replicated (pure data movement) and the contraction
+  runs whole on every device — identical bits, no psum anywhere.
+
+``cfg.tp`` is static (models/llama.py), so the tp=1 graphs are
+LITERALLY today's graphs — no mesh, no constraints, nothing to pin.
+The constraints in models/generate.py bind only when the dispatch is
+traced under the mesh scope the batcher enters around ``step()``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_TP, MeshSpec
+
+#: the KV cache's head axis in both layouts — dense (L, B, S, Hkv, hd)
+#: and paged (L, n_pages, page_size, Hkv, hd) put it third-from-last
+KV_SPEC = P(None, None, None, AXIS_TP, None)
+#: (B, T, H, hd) activation sharding for q/k/v and the attention output
+HEADS = P(None, None, AXIS_TP, None)
+#: fully replicated (the gather point before wo/w2/sampling)
+REPLICATED = P()
+
+
+def serving_mesh(tp: int, n_kv_heads: int, devices: list | None = None
+                 ) -> Mesh:
+    """A 1-axis ``tp`` mesh over the first ``tp`` devices, validated by
+    the shared flag rule (``MeshSpec.from_flags``): tp must divide both
+    the visible device count and the KV-head count, failing at startup
+    with an actionable error rather than inside a trace."""
+    n = len(devices) if devices is not None else len(jax.devices())
+    MeshSpec.from_flags(tp=tp, n_devices=n, n_kv_heads=n_kv_heads,
+                        exact=True)
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices[:tp]).reshape(tp), (AXIS_TP,))
+
+
+def serving_param_specs(cfg) -> dict:
+    """PartitionSpecs per serving parameter (see module docstring for
+    why this is NOT training's ``param_specs``): column shards where a
+    slice is bitwise the full result, replicated everywhere a shard
+    would split a reduction. Dimensions tp does not divide fall back to
+    replicated (correct, just unsharded) — only the KV-head divisibility
+    is a hard startup requirement."""
+    col = P(None, None, AXIS_TP)
+    rep2 = P(None, None)
+    ff_ok = cfg.d_ff % cfg.tp == 0
+    layers = {
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        # q/k/v columns are head-aligned (tp | n_kv_heads | n_heads)
+        "wq": col, "wk": col, "wv": col,
+        # wo contracts over heads: replicated (the no-psum rule)
+        "wo": rep2,
+    }
+    if cfg.attn_bias:
+        layers.update({
+            "bq": P(None, AXIS_TP), "bk": P(None, AXIS_TP),
+            "bv": P(None, AXIS_TP),
+        })
+    if cfg.is_moe:
+        # expert MLPs stay replicated: the dense-mix decode path
+        # contracts over experts and d_ff both — no bit-safe column cut
+        layers.update({
+            "router": rep2,
+            "moe_w1": P(None, None, None), "moe_w2": P(None, None, None),
+            "moe_w3": P(None, None, None),
+        })
+    else:
+        layers.update({
+            "w1": col if ff_ok else rep2,
+            "w3": col if ff_ok else rep2,
+            "w2": rep2,  # contracts over d_ff: replicated
+        })
+    out = {
+        "embed": P(None, None),  # token gather: replicated lookup
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tied_embeddings:
+        out["lm_head"] = (
+            P(None, AXIS_TP) if cfg.vocab_size % cfg.tp == 0
+            else P(None, None)
+        )
+    return out
+
+
+def _spec_tree_map(fn, specs, tree):
+    """Map ``fn(spec, leaf)`` over ``tree`` following ``specs``; leaves
+    the spec tree lacks (LoRA stacks, quantized {"q","s"} dicts, Gemma
+    extras) replicate — sharding them is a later optimization, serving
+    them bit-identically is the contract."""
+    if isinstance(tree, dict):
+        return {
+            k: _spec_tree_map(
+                fn, specs.get(k, P()) if isinstance(specs, dict) else P(), v
+            )
+            for k, v in tree.items()
+        }
+    if tree is None:
+        return None
+    return fn(specs if isinstance(specs, P) else P(), tree)
+
+
+def shard_serving_params(params: dict, cfg, mesh: Mesh) -> dict:
+    """device_put the serving weight tree onto the tp mesh per
+    :func:`serving_param_specs` — the pjit/NamedSharding load-time shard
+    (SNIPPETS.md [1][2]); leaves the spec tree doesn't name (adapter
+    stacks, quantized leaves) are replicated."""
+    specs = serving_param_specs(cfg)
+
+    def put(spec, leaf):
+        try:
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        except ValueError:
+            # a dimension the spec's axis doesn't divide (converted
+            # checkpoints with odd head counts): replicate it instead
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    return _spec_tree_map(put, specs, params)
+
+
+def batch_state_shardings(mesh: Mesh) -> dict:
+    """NamedShardings per BatchState field: the cache — K/V AND the
+    quantized scale planes, whose per-(position, head) layout puts the
+    head axis in the same third-from-last slot — on the KV-head axis,
+    every other leaf (lengths, masks, key, budgets, page tables)
+    replicated. Dense rows and the paged pool share the specs: both
+    5-D layouts carry Hkv third-from-last. The page TABLE is replicated
+    by design: one host-side allocator hands out page ids that mean the
+    same physical page slice on every shard."""
+    kv = NamedSharding(mesh, KV_SPEC)
+    rep = NamedSharding(mesh, REPLICATED)
+    return {
+        "cache": {"k": kv, "v": kv, "k_scale": kv, "v_scale": kv},
+        "lengths": rep, "last_token": rep, "active": rep,
+        "presence": rep, "key": rep, "budget": rep, "draws": rep,
+        "pages": rep,
+    }
+
+
+def shard_batch_state(state, mesh: Mesh):
+    """device_put a freshly initialized BatchState onto the mesh (init
+    only: every jitted step preserves these shardings thereafter)."""
+    sh = batch_state_shardings(mesh)
+
+    def put(x, s):
+        return None if x is None else jax.device_put(x, s)
+
+    from k8s_gpu_device_plugin_tpu.models.batching import BatchState
+    from k8s_gpu_device_plugin_tpu.models.generate import KVCache
+
+    return BatchState(
+        cache=KVCache(
+            k=put(state.cache.k, sh["cache"]["k"]),
+            v=put(state.cache.v, sh["cache"]["v"]),
+            k_scale=put(state.cache.k_scale, sh["cache"]["k_scale"]),
+            v_scale=put(state.cache.v_scale, sh["cache"]["v_scale"]),
+        ),
+        lengths=put(state.lengths, sh["lengths"]),
+        last_token=put(state.last_token, sh["last_token"]),
+        active=put(state.active, sh["active"]),
+        presence=put(state.presence, sh["presence"]),
+        key=put(state.key, sh["key"]),
+        budget=put(state.budget, sh["budget"]),
+        draws=put(state.draws, sh["draws"]),
+        pages=put(state.pages, sh["pages"]),
+    )
+
+
+def replicate(x, mesh: Mesh):
+    """Commit a host-built array onto the mesh replicated — the tp>1
+    twin of the batcher's cached device uploads (knobs, masks, seeds,
+    the EOS scalar): committed once per membership change, resident
+    thereafter, so the steady-state decode loop still transfers nothing
+    per step."""
+    return jax.device_put(x, NamedSharding(mesh, REPLICATED))

@@ -90,7 +90,15 @@ def prefix_kv_bytes(cfg: LlamaConfig, p: int) -> int:
     charged for every page it pins — so the cache-wide sum is an upper
     bound on distinct pages denied to the pool, and the byte budget
     evicts conservatively (never lets the cache outgrow ``budget_bytes``
-    of pins, may evict while distinct residency is lower)."""
+    of pins, may evict while distinct residency is lower).
+
+    Under tensor-parallel serving (``cfg.tp`` > 1) this is the
+    AGGREGATE across shards — each shard resides ``1/tp`` of it
+    (parallel/tp_serving.py: entries' rows/pages shard on the KV-head
+    axis) — so ``--prefixCacheMB`` keeps meaning total HBM given to the
+    cache, and a tp replica's budget buys tp times the entries per
+    shard. Entries are mesh-bound: the batcher attach guard refuses a
+    cache whose entries were materialized under a different tp."""
     if getattr(cfg, "kv_layout", "dense") == "paged":
         ps = cfg.kv_page_size
         p = -(-p // ps) * ps

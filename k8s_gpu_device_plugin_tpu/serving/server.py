@@ -118,6 +118,7 @@ class InferenceEngine:
         scheduler=None,  # serving.scheduler.Scheduler (None = plain FIFO)
         default_priority: int = 1,
         default_deadline_ms: int = 0,
+        tp: int | None = None,  # None = take cfg.tp (1 = single chip)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -145,6 +146,12 @@ class InferenceEngine:
                 "constructor; silently ignoring it here would admit FIFO "
                 "while reporting the requested policy"
             )
+        if batcher is not None and tp not in (None, 1):
+            raise ValueError(
+                "pass tp to the injected batcher's own constructor; "
+                "silently ignoring it here would serve single-chip "
+                "while reporting a sharded mesh"
+            )
         # request-edge SLO defaults: a request that names no tenant /
         # priority / deadline gets these (the "defaulted at the server
         # edge" contract — the batcher itself never invents a deadline)
@@ -158,7 +165,7 @@ class InferenceEngine:
             pipeline_depth=pipeline_depth, trace_steps=trace_steps,
             prefix_cache=prefix_cache,
             kv_layout=kv_layout, kv_page_size=kv_page_size,
-            kv_pages=kv_pages, scheduler=scheduler,
+            kv_pages=kv_pages, scheduler=scheduler, tp=tp,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -995,6 +1002,15 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--maxLen", type=int, default=2048)
     parser.add_argument("--chunkedPrefill", type=int, default=256)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel shards: weights (q/k/v/"
+                        "gate/up/lm_head columns) and the KV cache "
+                        "(dense rows or the paged pool, on the KV-head "
+                        "axis) shard over a tp-device mesh — tp times "
+                        "the KV pages/slots per replica; must divide "
+                        "the visible device count and the model's "
+                        "n_kv_heads (validated at startup); token/"
+                        "logprob streams are bit-identical to --tp 1")
     def _eos_arg(value: str):
         """'none' or a negative int -> EOS stopping OFF; an id -> that id.
         Keeps argparse's clean usage error for garbage like '1.5'."""
@@ -1154,6 +1170,18 @@ def _main(argv: list[str] | None = None) -> int:
         from dataclasses import replace as _replace
 
         cfg = _replace(cfg, cache_quant=args.cacheQuant)
+    if args.tp != 1:
+        # fail BEFORE the (slow) weight load: the shared flag rule
+        # (parallel/mesh.py MeshSpec.from_flags — the same validation
+        # the trainer's mesh flags go through) checks tp against the
+        # device count and the model's KV-head count
+        from k8s_gpu_device_plugin_tpu.parallel.mesh import MeshSpec
+
+        try:
+            MeshSpec.from_flags(tp=args.tp, n_kv_heads=cfg.n_kv_heads,
+                                exact=True)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
     params = load_params(cfg, args.checkpointDir)
 
     sampler = Sampler(temperature=args.temperature, top_k=args.topK,
@@ -1315,6 +1343,7 @@ def _main(argv: list[str] | None = None) -> int:
             ),
             kv_pages=args.kvPages,
             scheduler=scheduler,
+            tp=args.tp,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1331,6 +1360,7 @@ def _main(argv: list[str] | None = None) -> int:
         kv_pages=0 if batcher is not None else args.kvPages,
         scheduler=None if batcher is not None else scheduler,
         default_deadline_ms=args.defaultDeadlineMs,
+        tp=None if batcher is not None else args.tp,
     )
     from prometheus_client import REGISTRY
 

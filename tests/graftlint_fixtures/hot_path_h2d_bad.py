@@ -18,3 +18,10 @@ class Batcher:
     def _apply_decode_result(self, arrs):  # graftlint: hot-path
         self._budget -= 1  # BAD: host scalar carry, re-fed to a hot call
         return self.step(self._budget)
+
+    def _step_inner(self):  # graftlint: hot-path
+        # BAD: re-uploading the (replicated) page table every step —
+        # the tp serving path commits it once at admission; a per-step
+        # device_put would re-transfer the whole table per token
+        pages = jax.device_put(self._page_table_np, self._sharding)
+        return self.step(pages)

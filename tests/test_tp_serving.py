@@ -1,0 +1,554 @@
+"""Tensor-parallel serving (parallel/tp_serving.py + the tp batcher
+path): the sharded decode fast path pinned bit-identical to tp=1.
+
+Three layers of claims, mirroring test_paged_kv.py:
+
+- **Bit-exactness**: greedy and seeded token AND logprob streams are
+  identical between tp=1 and tp=2/4 (on the conftest-forced 8-device
+  CPU platform) across dense/paged x prefix cache on/off x pipeline
+  depth 0/1, over admit/retire/cancel/eviction interleavings — and
+  across scheduler preemption/resume. The sharding recipe makes this a
+  structural property (column shards + head shards + gather-before-
+  reduce; no psum ever splits an accumulation), and these tests keep it
+  one.
+- **Shard plumbing**: weights/cache/state carry the intended shardings,
+  the steady-state decode arguments are committed mesh residents (the
+  zero-per-step-H2D contract extends to tp), kv_stats()/health/gauges
+  report per-shard AND aggregate views (tp=1 output byte-identical to
+  the pre-tp server), and admission accounting under pool pressure
+  drains back to baseline on every shard (the PR-6/PR-8 leak-pin
+  pattern).
+- **Startup validation**: the one mesh-flag rule (MeshSpec.from_flags,
+  shared with the trainer CLI) refuses tp values that don't divide the
+  device count or the KV-head count with actionable errors; stale
+  prefix caches and injected-batcher flag combos are refused like their
+  kv_layout twins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    precompute_prefix,
+)
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_TP, MeshSpec
+from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+    PrefixCache,
+    prefix_kv_bytes,
+)
+
+BUCKETS = (8, 16, 32)
+PS = 16  # page size: divides max_len=64 (the test_paged_kv geometry)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # the same tiny config as the neighboring serving modules so shared
+    # (tp=1) compiles are reused; the tp twins compile once here.
+    # n_kv_heads=4, n_heads=8: tp=2 and tp=4 both divide cleanly.
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _batcher(params, cfg, tp, layout="dense", pc=None, depth=1, n_slots=2,
+             chunk=8, **kw):
+    return ContinuousBatcher(
+        params, cfg, n_slots=n_slots, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=chunk, pipeline_depth=depth, prefix_cache=pc,
+        kv_layout=layout, kv_page_size=PS if layout == "paged" else None,
+        tp=tp, **kw,
+    )
+
+
+# --- bit-exactness: tp=1 vs tp=2/4 ----------------------------------------
+#
+# One scheduling scenario (the test_paged_kv shape: staggered waves over
+# a shared system prompt, greedy and SEEDED requests mixed, a stop
+# sequence that can't fire, a mid-flight cancel, a prefix-cache budget
+# small enough that promotion evicts mid-run) replayed across the
+# composed matrix. Completed requests must produce identical tokens AND
+# logprobs; the cancelled request's partial stream must agree on the
+# common prefix.
+
+
+def _scenario(params, cfg, tp, layout, depth, cache_on):
+    pc = None
+    if cache_on:
+        b = prefix_kv_bytes(cfg, 8) + prefix_kv_bytes(cfg, 16)
+        pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=b)
+    cb = _batcher(params, cfg, tp, layout, pc=pc, depth=depth)
+    sys_a = _prompt(20, 17, cfg)
+    rids = []
+
+    def sub(base, tail_key, tail_n, new, seed=None, stop=None):
+        p = base + _prompt(tail_key, tail_n, cfg)
+        rids.append(cb.submit(p, max_new=new, seed=seed, stop=stop))
+
+    sub(sys_a, 30, 5, 5)
+    sub(sys_a, 31, 4, 4, seed=4)
+    for _ in range(7):
+        cb.step()
+    sub(sys_a, 32, 6, 5, seed=5)
+    sub([], 33, 9, 4)
+    for _ in range(4):
+        cb.step()
+    cancelled = rids[2]
+    cb.cancel(cancelled)
+    sub(sys_a, 35, 3, 5, stop=[[cfg.vocab_size - 1, cfg.vocab_size - 1]])
+    cb.run()
+    if cb.pool is not None:
+        cb.pool.check()
+    streams = {
+        rid: (list(req.out), list(req.out_logp))
+        for rid, req in cb.done_requests.items()
+    }
+    return rids, cancelled, streams, cb
+
+
+def test_tp_streams_bit_identical_across_matrix(setup):
+    cfg, params = setup
+    ref_rids, ref_cancel, ref, _ = _scenario(
+        params, cfg, 1, "dense", 0, True
+    )
+    # tp=2 sweeps the composition axes (dense/paged x cache on/off x
+    # depth 0/1, pruned to the informative cells like test_paged_kv);
+    # tp=4 pins the deepest mesh on the full-feature cell
+    cells = [
+        (2, "dense", 1, True),
+        (2, "paged", 0, True),
+        (2, "paged", 1, False),
+        (2, "dense", 0, False),
+        (4, "paged", 1, True),
+    ]
+    for tp, layout, depth, cache_on in cells:
+        rids, cancelled, streams, cb = _scenario(
+            params, cfg, tp, layout, depth, cache_on
+        )
+        key = (tp, layout, depth, cache_on)
+        assert rids == ref_rids and cancelled == ref_cancel, key
+        for rid in rids:
+            if rid == cancelled:
+                toks, lps = streams[rid]
+                rt, rl = ref[rid]
+                n = min(len(toks), len(rt))
+                assert toks[:n] == rt[:n], key
+                assert lps[:n] == rl[:n], key
+            else:
+                # tokens AND logprobs bit-identical: no contraction in
+                # the sharded graph ever splits an accumulation
+                assert streams[rid] == ref[rid], key
+        assert cb.mesh is not None and cb.cfg.tp == tp
+
+
+def test_tp_preempt_resume_bit_identical(setup):
+    """The scheduler's preempt/resume path (fold output into prompt,
+    re-prefill, resume the seeded draw index) composes with tp: the
+    preempted-then-resumed streams are pinned identical tp=1 vs tp=2."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import SloScheduler
+
+    cfg, params = setup
+
+    def run(tp):
+        cb = _batcher(params, cfg, tp, "paged", n_slots=1,
+                      scheduler=SloScheduler(preempt=True))
+        r_low = cb.submit(_prompt(5, 8, cfg), max_new=24, priority=5)
+        for _ in range(6):
+            cb.step()
+        cb.submit(_prompt(6, 6, cfg), max_new=4, priority=0,
+                  deadline_ms=1)
+        cb.run()
+        assert cb.done_requests[r_low].preemptions >= 1, "never preempted"
+        cb.pool.check()
+        assert cb.pool.in_use == 0
+        return {
+            rid: (list(r.out), list(r.out_logp))
+            for rid, r in cb.done_requests.items()
+        }
+
+    assert run(2) == run(1)
+
+
+def test_tp_manual_prefix_bit_identical(setup):
+    """A manual precompute_prefix prefix (dense rows, traced under the
+    serving mesh when cfg.tp>1) inserts into the sharded cache with the
+    streams pinned to tp=1."""
+    from dataclasses import replace
+
+    cfg, params = setup
+
+    def run(tp):
+        tcfg = replace(cfg, tp=tp)
+        cb = _batcher(params, tcfg, tp)
+        pre = precompute_prefix(
+            cb.params, _prompt(40, 9, cfg), tcfg,
+            prompt_buckets=BUCKETS,
+        )
+        rid = cb.submit(_prompt(41, 4, cfg), max_new=5, prefix=pre)
+        cb.run()
+        req = cb.done_requests[rid]
+        return list(req.out), list(req.out_logp)
+
+    assert run(2) == run(1)
+
+
+def test_tp_speculative_bit_identical(setup):
+    """The spec-verify dispatch as a sharded jit: draft+verify rounds
+    under tp=2 (paged, both pools) pin to the tp=1 spec streams."""
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    cfg, params = setup
+    d_cfg = replace(cfg, n_layers=1)
+    d_params = init_params(jax.random.key(1), d_cfg)
+
+    def run(tp):
+        sb = SpeculativeBatcher(
+            params, cfg, d_params, d_cfg, n_slots=2, max_len=64,
+            gamma=3, prompt_buckets=BUCKETS, chunked_prefill=8,
+            kv_layout="paged", kv_page_size=PS, tp=tp,
+        )
+        sb.submit(_prompt(1, 11, cfg), max_new=6)
+        sb.submit(_prompt(2, 7, cfg), max_new=5)
+        sb.run()
+        sb.pool.check()
+        sb.draft_pool.check()
+        if tp > 1:
+            # the shard gauges must mean what the aggregate means
+            # (target + draft) and sum back to it exactly
+            s = sb.kv_stats()
+            assert sum(
+                sh["reserved_bytes"] for sh in s["shards"]
+            ) == s["reserved_bytes"]
+        return {
+            rid: (list(r.out), list(r.out_logp))
+            for rid, r in sb.done_requests.items()
+        }
+
+    assert run(2) == run(1)
+
+
+def test_tp_speculative_draft_heads_must_divide(setup):
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.spec_batching import (
+        SpeculativeBatcher,
+    )
+
+    cfg, params = setup
+    d_cfg = replace(cfg, n_layers=1, n_heads=3, n_kv_heads=3)
+    d_params = init_params(jax.random.key(1), d_cfg)
+    with pytest.raises(ValueError, match="draft model's"):
+        SpeculativeBatcher(
+            params, cfg, d_params, d_cfg, n_slots=1, max_len=64,
+            prompt_buckets=BUCKETS, chunked_prefill=8, tp=2,
+        )
+
+
+# --- shard plumbing --------------------------------------------------------
+
+
+def test_weights_and_state_carry_the_intended_shardings(setup):
+    from jax.sharding import PartitionSpec as P
+
+    cfg, params = setup
+    cb = _batcher(params, cfg, 2, "paged")
+    # column-cut projections, replicated reduction weights
+    assert cb.params["layers"]["wq"].sharding.spec == P(None, None, AXIS_TP)
+    assert cb.params["layers"]["wo"].sharding.spec == P(None, None)
+    assert cb.params["lm_head"].sharding.spec == P(None, AXIS_TP)
+    # cache on the KV-head axis; table + masks replicated
+    assert cb.state.cache.k.sharding.spec == P(
+        None, None, None, AXIS_TP, None
+    )
+    assert cb.state.pages.sharding.spec == P()
+    assert cb.state.lengths.sharding.spec == P()
+
+
+def test_steady_state_args_are_committed_mesh_residents(setup):
+    """The zero-per-step-H2D contract under tp: every decode-dispatch
+    argument the batcher caches is COMMITTED on the tp mesh (an
+    uncommitted single-device array would be re-transferred every
+    step), and steady-state steps reuse the same cached objects."""
+    cfg, params = setup
+    cb = _batcher(params, cfg, 2)
+    cb.submit(_prompt(50, 9, cfg), max_new=16, seed=3)
+    for _ in range(5):
+        cb.step()
+    assert cb.running, "expected a decoding slot"
+    mesh_devs = set(cb.mesh.devices.flat)
+    cached = [cb._batch_allowed(), cb._batch_knobs(), cb._eos_dev,
+              cb._batch_seeds()]
+    for arr in cached:
+        assert arr.committed, "cached dispatch arg not committed"
+        assert set(arr.sharding.device_set) == mesh_devs
+    before = (cb._allowed_cache, cb._knobs_cache, cb._seeds_cache)
+    cb.step()
+    cb.step()
+    assert (cb._allowed_cache, cb._knobs_cache, cb._seeds_cache) \
+        == before, "steady-state steps rebuilt a cached dispatch arg"
+
+
+def test_kv_stats_shard_view(setup):
+    cfg, params = setup
+    # tp=1: BYTE-identical surface to the pre-tp server (no tp/shards
+    # keys) for both layouts — the comparability satellite
+    cb1 = _batcher(params, cfg, 1)
+    assert set(cb1.kv_stats()) == {"layout", "reserved_bytes"}
+    cb1p = _batcher(params, cfg, 1, "paged")
+    assert "shards" not in cb1p.kv_stats() and "tp" not in cb1p.kv_stats()
+    # tp=2: per-shard AND aggregate; bytes divide exactly, page counts
+    # replicate (one host-side table)
+    cb = _batcher(params, cfg, 2, "paged")
+    s = cb.kv_stats()
+    assert s["tp"] == 2 and len(s["shards"]) == 2
+    for sh in s["shards"]:
+        assert sh["reserved_bytes"] * 2 == s["reserved_bytes"]
+        assert sh["pages_total"] == s["pages_total"]
+        assert sh["pages_free"] == s["pages_free"]
+    # dense tp=2: per-shard reservation halves too
+    cbd = _batcher(params, cfg, 2)
+    sd = cbd.kv_stats()
+    assert sd["shards"][0]["reserved_bytes"] * 2 == sd["reserved_bytes"]
+
+
+def test_serving_metrics_shard_gauges():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.set_kv_shards([
+        {"shard": 0, "reserved_bytes": 100, "pages_in_use": 3,
+         "in_use_bytes": 48},
+        {"shard": 1, "reserved_bytes": 100, "pages_in_use": 3,
+         "in_use_bytes": 48},
+    ])
+    v = reg.get_sample_value(
+        "tpu_serving_kv_shard_reserved_bytes", {"shard": "1"}
+    )
+    assert v == 100
+    assert reg.get_sample_value(
+        "tpu_serving_kv_shard_pages_in_use", {"shard": "0"}
+    ) == 3
+    m.close()
+
+
+def test_batcher_pushes_shard_gauges(setup):
+    """The batcher's gauge hook feeds per-shard dicts under tp>1 and
+    never at tp=1 (the comparability rule)."""
+    cfg, params = setup
+
+    class _Rec:
+        def __init__(self):
+            self.shards = None
+            self.calls = 0
+
+        def set_kv_shards(self, shards):
+            self.shards = shards
+            self.calls += 1
+
+        def set_kv_pages(self, *a): ...
+        def set_kv_reserved_bytes(self, *a): ...
+        def on_submit(self): ...
+        def on_prefill_chunk(self): ...
+        def on_prefill_tokens(self, *a): ...
+        def on_first_token(self): ...
+        def on_step(self, *a): ...
+        def on_finish(self, reason): ...
+
+    rec1 = _Rec()
+    _batcher(params, cfg, 1, "paged", metrics=rec1)
+    assert rec1.calls == 0, "tp=1 must not emit shard gauges"
+    rec = _Rec()
+    cb = _batcher(params, cfg, 2, "paged", metrics=rec)
+    assert rec.calls > 0 and len(rec.shards) == 2
+    rid = cb.submit(_prompt(60, 9, cfg), max_new=4)
+    cb.run()
+    assert cb.done[rid]
+    assert rec.shards[0]["pages_in_use"] == 0  # drained back
+
+
+def test_sharded_admission_under_pool_pressure(setup):
+    """Satellite pin: per-shard page-reservation accounting under
+    pressure — a pool sized for one request defers the second on EVERY
+    shard's free count, cancel-while-queued returns each shard's pool
+    free-count to baseline, and the drain leaves all shards at the
+    starting free count (the PR-6/PR-8 leak-pin pattern, tp edition)."""
+    cfg, params = setup
+    # 5 allocatable pages: one 9-prompt/8-new request needs
+    # ceil(17/16)=2... size so exactly one request fits
+    cb = _batcher(params, cfg, 2, "paged", kv_pages=4, n_slots=2)
+    baseline = [s["pages_free"] for s in cb.kv_stats()["shards"]]
+    r1 = cb.submit(_prompt(70, 9, cfg), max_new=8)    # needs 2 pages
+    r2 = cb.submit(_prompt(71, 9, cfg), max_new=8)    # must defer
+    cb.step()
+    shards = cb.kv_stats()["shards"]
+    assert all(s["pages_free"] < b for s, b in zip(shards, baseline)), \
+        "admission did not draw on the (replicated) shard free counts"
+    assert cb.pending and cb.pending[0].rid == r2, "r2 should be deferred"
+    # cancel the queued request: nothing may leak on any shard
+    assert cb.cancel(r2)
+    cb.run()
+    assert cb.done[r1] is not None
+    after = [s["pages_free"] for s in cb.kv_stats()["shards"]]
+    assert after == baseline, f"shard free counts leaked: {after}"
+    cb.pool.check()
+
+
+# --- startup validation ----------------------------------------------------
+
+
+def test_from_flags_shared_rule():
+    # 8 virtual devices (conftest): tp=3 doesn't divide
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshSpec.from_flags(tp=3, n_devices=8, exact=True)
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        MeshSpec.from_flags(tp=8, n_devices=8, n_kv_heads=4, exact=True)
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        MeshSpec.from_flags(tp=16, n_devices=8, exact=True)
+    # the trainer shape: leftover devices fill dp
+    spec = MeshSpec.from_flags(tp=2, n_devices=8)
+    assert spec.tp == 2 and spec.dp == 4
+    # the serving shape: dp stays 1 (unused chips stay unused)
+    spec = MeshSpec.from_flags(tp=2, n_devices=8, n_kv_heads=4, exact=True)
+    assert spec.tp == 2 and spec.dp == 1 and spec.num_devices == 2
+
+
+def test_batcher_tp_must_divide_kv_heads(setup):
+    cfg, params = setup  # n_kv_heads=4
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        _batcher(params, cfg, 8)
+
+
+def test_engine_refuses_tp_with_injected_batcher(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    with pytest.raises(ValueError, match="injected batcher"):
+        InferenceEngine(
+            params, cfg,
+            batcher=ContinuousBatcher(
+                params, cfg, n_slots=1, max_len=64,
+                prompt_buckets=BUCKETS,
+            ),
+            tp=2,
+        )
+
+
+def test_engine_health_reports_shards(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        kv_layout="paged", kv_page_size=PS, tp=2,
+    )
+    try:
+        kv = engine.stats()["kv"]
+        assert kv["tp"] == 2 and len(kv["shards"]) == 2
+        assert kv["shards"][0]["reserved_bytes"] * 2 == kv["reserved_bytes"]
+    finally:
+        engine.shutdown()
+
+
+def test_prefix_cache_cannot_move_between_tp_degrees(setup):
+    """Like the paged/dense attach guards: entries materialized under
+    one mesh (sharded rows) must not be served by a batcher on another
+    (or none)."""
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 24)
+    cb = _batcher(params, cfg, 2, pc=pc)
+    cb.submit(_prompt(80, 17, cfg), max_new=3)
+    cb.run()
+    assert pc.stats.entries > 0, "nothing promoted"
+    with pytest.raises(ValueError, match="tp="):
+        _batcher(params, cfg, 1, pc=pc)
+
+
+def test_serve_bench_tp_skip_is_loud(setup, capsys):
+    """A tp that can't shard this config skips the A/B with a printed
+    reason and zeroed fields — never silently (the no-silent-caps
+    house rule)."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    cfg, params = setup
+    r = serve_bench(
+        cfg, n_slots=2, n_requests=2, max_len=64, prompt_lens=(12,),
+        max_new=4, params=params, prompt_buckets=BUCKETS,
+        chunked_prefill=8, decode_ab=False, prefix_ab=False,
+        paged_ab=False, spec_ab=False, sched_ab=False,
+        tp_ab=True, tp_degree=3,  # 3 divides neither 8 devs nor 4 heads
+    )
+    assert r.tp_degree == 0 and r.tokens_per_second_tp == 0.0
+    assert "tp A/B skipped" in capsys.readouterr().err
+
+
+def test_tp_quantized_cache_streams_and_shard_bytes(setup):
+    """The int8 KV cache (dense layout — paged refuses quant) composes
+    with tp: scale planes shard on the head axis alongside K/V, so the
+    streams pin to tp=1 AND the per-shard byte gauge stays exactly
+    aggregate/tp (a replicated scale plane would under-report)."""
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.paging import (
+        kv_shard_token_bytes,
+        kv_token_bytes,
+    )
+
+    cfg, params = setup
+    qcfg = replace(cfg, cache_quant="int8")
+    assert kv_shard_token_bytes(replace(qcfg, tp=2)) * 2 \
+        == kv_token_bytes(qcfg)
+
+    def run(tp):
+        cb = _batcher(params, qcfg, tp)
+        if tp > 1:
+            from jax.sharding import PartitionSpec as P
+
+            assert cb.state.cache.k_scale.sharding.spec == P(
+                None, None, None, AXIS_TP, None
+            )
+            s = cb.kv_stats()
+            assert s["shards"][0]["reserved_bytes"] * 2 \
+                == s["reserved_bytes"]
+        rid = cb.submit(_prompt(95, 10, cfg), max_new=5)
+        cb.run()
+        req = cb.done_requests[rid]
+        return list(req.out), list(req.out_logp)
+
+    assert run(2) == run(1)
+
+
+def test_tp_streams_match_generate_oracle(setup):
+    """Beyond tp=1 equality: tp=2 greedy streams equal dedicated
+    ``generate`` over the full prompt (the absolute reference)."""
+    from k8s_gpu_device_plugin_tpu.models.generate import generate
+
+    cfg, params = setup
+    cb = _batcher(params, cfg, 2, "paged")
+    p = _prompt(90, 12, cfg)
+    rid = cb.submit(p, max_new=5)
+    results = cb.run()
+    oracle = np.asarray(
+        generate(params, jnp.asarray([p], jnp.int32), cfg, max_new=5)
+    )[0].tolist()
+    assert results[rid] == oracle
