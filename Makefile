@@ -67,8 +67,9 @@ san-test:
 # analyze runs right after lint — fail fast on invariant regressions
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
-	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp
-	python -m pytest tests/ -q
+	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
+	bench-obs
+	python -m pytest tests/ -q -m "not slow"
 
 bench:
 	python bench.py
@@ -123,12 +124,22 @@ bench-sched:
 bench-tp:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.tp_bench
 
+# CPU-runnable microbench: the latency-attribution layer's two cost
+# claims — the disabled-path guard is nanoseconds (the whole hot-path
+# cost with attribution off) and the per-retired-request record path
+# stays microseconds — plus an end-to-end on-vs-off serve A/B and a
+# flight-recorder retention smoke (one JSON line with
+# attribution_us_per_request, attribution_record_us, noop_guard_ns,
+# slow_captured, serving_mfu_pct).
+bench-obs:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.obs_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched bench-tp clean watch
+	bench-sched bench-tp bench-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

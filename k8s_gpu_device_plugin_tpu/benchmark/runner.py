@@ -527,6 +527,21 @@ def _run_serve() -> dict:
         "rejected_fifo": r.rejected_fifo,
         "rejected_slo": r.rejected_slo,
         "preemptions_slo": r.preemptions_slo,
+        # live serving MFU/roofline accounting (metrics/roofline.py):
+        # model-FLOPs utilization of the primary pipelined run vs the
+        # generation's spec-sheet peak, the decode HBM-roofline
+        # bandwidth share, and goodput tokens per model TFLOP — the
+        # serving-efficiency numbers an operator ranks configs by
+        "serving_mfu_pct": round(r.serving_mfu_pct, 4),
+        "hbm_bw_util_pct": round(r.hbm_bw_util_pct, 4),
+        "goodput_tokens_per_tflop": round(r.goodput_tokens_per_tflop, 1),
+        "mfu_generation": r.mfu_generation,
+        # tail-latency flight recorder over the open-loop A/B
+        # (obs/attribution.py): per-arm capture counts plus ONE full
+        # step-level timeline so the artifact explains its own tail
+        "slow_requests_fifo": r.slow_requests_fifo,
+        "slow_requests_slo": r.slow_requests_slo,
+        "slow_request_timeline": r.slow_timeline,
         # tensor-parallel sweep A/B (parallel/tp_serving.py): the same
         # workload tp-sharded — throughput/step-latency vs the tp=1
         # primaries, the per-shard KV residency (the capacity win: each

@@ -165,6 +165,24 @@ class ServeBenchResult:
     # at the wo/w2/sampling gather points): tp device step vs tp=1
     # device step — on hardware the span tracer's decode_dispatch/
     # readback pair attributes the same gap per step.
+    # live serving MFU/roofline accounting (metrics/roofline.py) of the
+    # PRIMARY pipelined run: model-FLOPs utilization vs the generation's
+    # spec-sheet peak, the decode HBM-roofline bandwidth share, and
+    # goodput tokens per model TFLOP — the goodput-per-FLOP number the
+    # Gemma serving comparison ranks configurations by. Off-TPU the
+    # generation falls back to v5e (the RATIOS are then vs that peak;
+    # still comparable run-to-run on the same host).
+    serving_mfu_pct: float = 0.0
+    hbm_bw_util_pct: float = 0.0
+    goodput_tokens_per_tflop: float = 0.0
+    mfu_generation: str = ""
+    # tail-latency flight recorder (obs/attribution.py) over the
+    # open-loop A/B: how many requests each arm's recorder captured
+    # (threshold breach / deadline miss / p99-of-window), and ONE full
+    # captured timeline so the artifact explains its own tail
+    slow_requests_fifo: int = 0
+    slow_requests_slo: int = 0
+    slow_timeline: "dict | None" = None
     tp_degree: int = 0
     tp_layout: str = ""
     wall_seconds_tp: float = 0.0
@@ -412,6 +430,10 @@ def sched_openloop_ab(
         )
 
     def run_arm(scheduler):
+        from k8s_gpu_device_plugin_tpu.obs.attribution import (
+            RequestAttributor,
+        )
+
         rec = _OpenLoopRecorder()
         pc = None
         if prefix_cache_mb > 0 and chunked_prefill:
@@ -421,14 +443,19 @@ def sched_openloop_ab(
 
             pc = PrefixCache(cfg, buckets=prompt_buckets,
                              budget_bytes=prefix_cache_mb << 20)
+        # the flight recorder rides each arm (p99-of-window + deadline-
+        # miss triggering): a tail outlier in the A/B leaves a full
+        # step-level timeline in the artifact instead of a bare p99
+        att = RequestAttributor(window=64, window_min=8)
         cb = ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets,
             chunked_prefill=chunked_prefill, metrics=rec,
-            prefix_cache=pc, scheduler=scheduler,
+            prefix_cache=pc, scheduler=scheduler, attribution=att,
         )
         out = open_loop_run(cb, trace)
         out["itl"] = rec.itl
+        out["slow"] = att.slow_stats()
         return out
 
     def make_fifo():
@@ -485,7 +512,17 @@ def sched_openloop_ab(
         }
 
     f, s = summarize(fifo), summarize(slo)
+    # one full captured timeline rides the artifact (slo arm preferred —
+    # its tail is the one the A/B exists to explain; fifo as fallback)
+    slow_timeline = None
+    for arm in (slo, fifo):
+        if arm["slow"]["requests"]:
+            slow_timeline = arm["slow"]["requests"][0]
+            break
     return {
+        "slow_requests_fifo": fifo["slow"]["captured"],
+        "slow_requests_slo": slo["slow"]["captured"],
+        "slow_timeline": slow_timeline,
         "openloop_requests": len(trace),
         "openloop_base_rps": base_rps,
         "openloop_overload_x": overload_x,
@@ -562,13 +599,13 @@ def serve_bench(
     prompts = make_prompts()
 
     def make_batcher(depth: int, kv_layout: str = "dense",
-                     tp: int = 1) -> ContinuousBatcher:
+                     tp: int = 1, mfu=None) -> ContinuousBatcher:
         return ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
             pipeline_depth=depth, kv_layout=kv_layout,
             kv_page_size=kv_page_size if kv_layout == "paged" else None,
-            tp=tp,
+            tp=tp, mfu=mfu,
         )
 
     def prime(cb: ContinuousBatcher, budget: int) -> None:
@@ -584,9 +621,9 @@ def serve_bench(
             guard += 1
             assert guard < 10_000, "priming never converged"
 
-    def run_once(depth: int, kv_layout: str = "dense", tp: int = 1
-                 ) -> tuple[float, float, int]:
-        cb = make_batcher(depth, kv_layout, tp)
+    def run_once(depth: int, kv_layout: str = "dense", tp: int = 1,
+                 mfu=None) -> tuple[float, float, int]:
+        cb = make_batcher(depth, kv_layout, tp, mfu=mfu)
         for p in prompts:
             cb.submit(p, max_new=max_new)
         t0 = time.perf_counter()
@@ -635,9 +672,26 @@ def serve_bench(
     # (those fields zero) for callers that only want the prefix A/B —
     # e.g. the prefix-cache CI smoke, whose decode path bench-host-
     # overhead already covers
+    mfu_pct = bw_pct = good_per_tflop = 0.0
+    mfu_gen = ""
     if decode_ab:
+        from k8s_gpu_device_plugin_tpu.metrics.roofline import (
+            MfuAccumulator,
+            ServingCostModel,
+        )
+
         run_once(1)  # compile pass (all buckets + decode)
-        wall, step_ms, _ = run_once(1)
+        # the primary run carries a live MFU accumulator: its totals /
+        # wall are the serving-efficiency numbers the runner row reports
+        cost = ServingCostModel.for_config(cfg)
+        acc = MfuAccumulator(cost)
+        wall, step_ms, _ = run_once(1, mfu=acc)
+        flops, nbytes = acc.totals()
+        mfu_gen = cost.generation
+        mfu_pct = cost.mfu_pct(flops, wall)
+        bw_pct = cost.hbm_bw_util_pct(nbytes, wall)
+        if flops > 0:
+            good_per_tflop = (n_requests * max_new) / (flops / 1e12)
         wall_sync, step_ms_sync, _ = run_once(0)
         device_ms = device_only_ms()
     else:
@@ -941,6 +995,10 @@ def serve_bench(
         spec_accepted_per_round=spec_per_round,
         spec_ms_per_accepted_token=spec_ms_acc,
         spec_gamma=spec_g,
+        serving_mfu_pct=mfu_pct,
+        hbm_bw_util_pct=bw_pct,
+        goodput_tokens_per_tflop=good_per_tflop,
+        mfu_generation=mfu_gen,
         **sched_fields,
         **tp_fields,
     )

@@ -1,0 +1,265 @@
+"""Serving MFU / roofline cost model + live accumulator.
+
+The offline benches already do hardware-efficiency math — matmul_mfu
+divides achieved FLOP/s by the generation's spec-sheet peak, and
+``LlamaConfig.flops_per_token`` prices a training token — but serving
+exposed no hardware-efficiency number at all: an operator could see
+tokens/s fall and not know whether the chip was underfed (batch too
+small, host-bound) or the model simply hit the decode bandwidth wall.
+This module prices serving work from the config math alone and divides
+by the peaks in ``device/topology.py``:
+
+- **Prefill** is compute-bound: ``2 * matmul_params`` FLOPs per prompt
+  token (the inference-forward third of the 6N training figure —
+  ``flops_per_token()`` is fwd+bwd, see models/llama.py:272; like that
+  figure, O(S) attention-score FLOPs are excluded, so reported MFU is
+  slightly conservative).
+- **Decode** is memory-bound: each step streams the weights once plus
+  every live context row of the active slots, so the roofline number is
+  HBM bytes moved vs the generation's spec-sheet bandwidth.
+
+Both are tp-aware: a tp-sharded server divides the same model bytes and
+FLOPs across ``tp`` chips, so the denominators scale by ``tp``.
+
+:class:`MfuAccumulator` is the live half: engine-owned (the batcher
+drives it from the step loop; cross-thread readers go through
+:meth:`mfu_stats`), windowed like ``tokens_per_second`` (fresh gauges
+every ~1s of busy time), with per-tenant FLOP attribution at retirement
+so goodput-per-TFLOP — the number the Gemma serving comparison
+(arXiv:2605.25645) ranks configurations by — is a live metric, not a
+bench afterthought.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from k8s_gpu_device_plugin_tpu.device.topology import GENERATIONS
+
+
+def detect_generation_name(default: str = "v5e") -> str:
+    """Best-effort TPU generation of the visible accelerator (the
+    matmul_mfu mapping); ``default`` on CPU/unknown backends — the
+    ratios are then against that generation's peaks, which keeps the
+    math exercisable (and pinned) off-hardware."""
+    try:
+        from k8s_gpu_device_plugin_tpu.benchmark.workloads.matmul_mfu import (
+            detect_generation,
+        )
+
+        return detect_generation()
+    except Exception:  # noqa: BLE001 - no jax backend / no devices
+        return default
+
+
+@dataclass(frozen=True)
+class ServingCostModel:
+    """Pure pricing math for one serving config on one generation.
+
+    ``flops_per_token`` here is the INFERENCE forward (2 * matmul
+    params); ``weight_bytes`` prices the decode step's weight stream
+    from the same matmul-parameter count at the activation dtype (a
+    weight-quantized server streams fewer bytes than this model says —
+    the reported bandwidth utilization is then an overestimate, noted
+    in docs/observability.md)."""
+
+    generation: str
+    peak_tflops: float        # per chip, dense bf16
+    hbm_gbps: float           # per chip, GB/s
+    flops_per_token: float    # inference forward, per token
+    weight_bytes: int         # matmul weights streamed per decode step
+    kv_token_bytes: int       # HBM bytes one cached token row costs
+    tp: int = 1
+
+    @staticmethod
+    def for_config(cfg, generation: str | None = None,
+                   tp: int | None = None) -> "ServingCostModel":
+        from k8s_gpu_device_plugin_tpu.models.paging import kv_token_bytes
+
+        gen_name = generation or detect_generation_name()
+        gen = GENERATIONS.get(gen_name) or GENERATIONS["v5e"]
+        fwd = cfg.flops_per_token() / 3.0  # 6N is fwd+bwd; serving runs fwd
+        # matmul params = fwd flops / 2 (one multiply-add per weight);
+        # dtype width from the config's activation dtype (2 for bf16)
+        import jax.numpy as jnp
+
+        width = jnp.dtype(cfg.dtype).itemsize
+        return ServingCostModel(
+            generation=gen.name,
+            peak_tflops=gen.peak_bf16_tflops,
+            hbm_gbps=gen.hbm_bandwidth_gbps,
+            flops_per_token=fwd,
+            weight_bytes=int(fwd / 2.0) * int(width),
+            kv_token_bytes=kv_token_bytes(cfg),
+            tp=tp if tp is not None else max(1, getattr(cfg, "tp", 1)),
+        )
+
+    # --- pricing ---------------------------------------------------------
+
+    def prefill_flops(self, n_tokens: int) -> float:
+        """Model FLOPs of prefilling ``n_tokens`` prompt tokens."""
+        return self.flops_per_token * n_tokens
+
+    def decode_flops(self, n_tokens: int) -> float:
+        """Model FLOPs of emitting ``n_tokens`` decode tokens."""
+        return self.flops_per_token * n_tokens
+
+    def decode_step_bytes(self, active: int, live_tokens: int) -> float:
+        """HBM bytes one decode step streams: the weights once (batched
+        decode amortizes them across the whole batch) plus every live
+        context row read by attention, plus the ``active`` rows
+        written."""
+        return float(
+            self.weight_bytes
+            + live_tokens * self.kv_token_bytes
+            + active * self.kv_token_bytes
+        )
+
+    def mfu_pct(self, flops: float, seconds: float) -> float:
+        """Achieved model FLOP/s as % of the slice peak (tp chips)."""
+        if seconds <= 0:
+            return 0.0
+        return 100.0 * (flops / seconds) / (self.peak_tflops * 1e12 * self.tp)
+
+    def hbm_bw_util_pct(self, nbytes: float, seconds: float) -> float:
+        """Achieved HBM stream as % of the slice bandwidth (tp chips)."""
+        if seconds <= 0:
+            return 0.0
+        return 100.0 * (nbytes / seconds) / (self.hbm_gbps * 1e9 * self.tp)
+
+
+class MfuAccumulator:
+    """Live serving MFU/roofline accounting, driven by the batcher.
+
+    All mutable state is engine-thread-owned (the step loop is the only
+    writer); /v1/health and the gauges cross threads only through the
+    :meth:`mfu_stats` snapshot and the duck-typed metrics hooks (which
+    only write prometheus collectors, internally locked)."""
+
+    def __init__(self, model: ServingCostModel, metrics=None,
+                 window_s: float = 1.0):
+        self.model = model
+        self.metrics = metrics
+        self.window_s = float(window_s)
+        self._flops_total = 0.0     # owner: engine
+        self._bytes_total = 0.0     # owner: engine
+        self._win_flops = 0.0       # owner: engine
+        self._win_bytes = 0.0       # owner: engine
+        self._win_tokens = 0        # owner: engine
+        self._win_t0 = time.monotonic()  # owner: engine
+        self._mfu_pct = 0.0         # owner: engine (last closed window)
+        self._bw_pct = 0.0          # owner: engine
+        self._win_tps = 0.0         # owner: engine
+        # tenant -> [model_flops, goodput_tokens]; bounded by the same
+        # operator-configured tenant set the scheduler labels carry
+        self._tenants: dict[str, list] = {}  # owner: engine
+
+    # --- batcher hooks (engine thread) -----------------------------------
+
+    def on_prefill_tokens(self, n: int) -> None:
+        """``n`` COMPUTED prompt tokens ran through the model (prefix-
+        reused tokens moved no FLOPs and are deliberately not priced)."""
+        f = self.model.prefill_flops(n)
+        self._flops_total += f
+        self._win_flops += f
+
+    def on_step(self, emitted: int, active: int, live_tokens: int) -> None:
+        """One decode step: ``emitted`` tokens sampled, ``active`` slots
+        computing over ``live_tokens`` total context rows."""
+        f = self.model.decode_flops(emitted)
+        b = self.model.decode_step_bytes(active, live_tokens) if active \
+            else 0.0
+        self._flops_total += f
+        self._bytes_total += b
+        self._win_flops += f
+        self._win_bytes += b
+        self._win_tokens += emitted
+        dt = time.monotonic() - self._win_t0
+        if dt >= self.window_s:
+            self._close_window(dt)
+
+    def _close_window(self, dt: float) -> None:
+        self._mfu_pct = self.model.mfu_pct(self._win_flops, dt)
+        self._bw_pct = self.model.hbm_bw_util_pct(self._win_bytes, dt)
+        self._win_tps = self._win_tokens / dt
+        if self.metrics is not None:
+            set_mfu = getattr(self.metrics, "set_mfu", None)
+            if set_mfu is not None:
+                set_mfu(self._mfu_pct, self._bw_pct)
+            count = getattr(self.metrics, "on_model_work", None)
+            if count is not None:
+                count(self._win_flops, self._win_bytes)
+        self._win_flops = 0.0
+        self._win_bytes = 0.0
+        self._win_tokens = 0
+        self._win_t0 = time.monotonic()
+
+    def on_idle(self) -> None:
+        """Busy->idle: zero the window gauges instead of freezing them."""
+        self._mfu_pct = 0.0
+        self._bw_pct = 0.0
+        self._win_tps = 0.0
+        self._win_flops = 0.0
+        self._win_bytes = 0.0
+        self._win_tokens = 0
+        self._win_t0 = time.monotonic()
+        if self.metrics is not None:
+            set_mfu = getattr(self.metrics, "set_mfu", None)
+            if set_mfu is not None:
+                set_mfu(0.0, 0.0)
+
+    def on_retired(self, req, goodput_tokens: int) -> None:
+        """Per-tenant FLOP attribution at retirement: the prefill
+        tokens this request ACTUALLY ran through the model (the
+        batcher's per-request counter — a request rejected while queued
+        computed nothing, one cancelled mid-prefill only its dispatched
+        chunks) plus its decode tokens. ``goodput_tokens`` follows the
+        scheduler's rule (0 when the deadline was missed) so
+        tokens-per-TFLOP is a GOODPUT ratio."""
+        flops = (
+            self.model.prefill_flops(req.prefill_computed)
+            + self.model.decode_flops(len(req.out))
+        )
+        t = self._tenants.get(req.tenant)
+        if t is None:
+            t = self._tenants[req.tenant] = [0.0, 0]
+        t[0] += flops
+        t[1] += int(goodput_tokens)
+        if self.metrics is not None:
+            count = getattr(self.metrics, "on_tenant_flops", None)
+            if count is not None:
+                count(req.tenant, flops)
+
+    def totals(self) -> tuple[float, float]:
+        """(model FLOPs, HBM bytes) accumulated so far — the bench's
+        post-run denominator (engine thread or a finished run only)."""
+        return self._flops_total, self._bytes_total
+
+    # --- cross-thread snapshot -------------------------------------------
+
+    def mfu_stats(self) -> dict:
+        """Snapshot for /v1/health (the kv_stats contract: plain numbers
+        copied under the GIL, list() before iterating)."""
+        tenants = {}
+        for name, (flops, good) in list(self._tenants.items()):
+            tflops = flops / 1e12
+            tenants[name] = {
+                "model_tflops": round(tflops, 6),
+                "goodput_tokens": good,
+                "goodput_tokens_per_tflop": (
+                    round(good / tflops, 3) if tflops > 0 else 0.0
+                ),
+            }
+        return {
+            "generation": self.model.generation,
+            "tp": self.model.tp,
+            "peak_tflops": self.model.peak_tflops * self.model.tp,
+            "hbm_gbps": self.model.hbm_gbps * self.model.tp,
+            "serving_mfu_pct": round(self._mfu_pct, 4),
+            "hbm_bw_util_pct": round(self._bw_pct, 4),
+            "window_tokens_per_second": round(self._win_tps, 3),
+            "model_tflops_total": round(self._flops_total / 1e12, 6),
+            "hbm_gb_total": round(self._bytes_total / 1e9, 6),
+            "tenants": tenants,
+        }

@@ -33,6 +33,11 @@ class ServingMetrics:
     engine restarts) to unregister its collectors first.
     """
 
+    #: the latency observers accept an optional exemplar id (a second
+    #: positional) — the batcher checks this instead of try/excepting,
+    #: so duck-typed fakes with single-arg observers keep working
+    supports_exemplars = True
+
     def __init__(self, registry=REGISTRY, prefix: str = "tpu_serving"):
         self._registry = registry
         self.tokens_total = Counter(
@@ -269,6 +274,57 @@ class ServingMetrics:
             buckets=LATENCY_BUCKETS,
             registry=registry,
         )
+        # Per-request latency ATTRIBUTION (obs/attribution.py): every
+        # retired request's wall time partitions into phases that sum to
+        # it (queue_wait -> prefill -> decode, repeating across
+        # preemptions); each phase lands here, exemplar-tagged with the
+        # request's trace id, so "p99 TTFT regressed" decomposes into
+        # WHICH phase grew — and pivots to a concrete trace. Label
+        # cardinality is the fixed phase set.
+        self.request_phase_seconds = Histogram(
+            f"{prefix}_request_phase_seconds",
+            "Wall time one retired request spent in one lifecycle phase",
+            ["phase"],  # queue_wait | prefill | decode
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        # Live serving MFU / roofline accounting (metrics/roofline.py):
+        # prefill model-FLOPs + decode HBM-stream priced from the config
+        # math against device/topology.py spec-sheet peaks, accumulated
+        # per ~1s window of busy time. The gauges answer "is the chip
+        # underfed or at the bandwidth wall"; the counters make
+        # tokens-per-TFLOP derivable over any scrape interval.
+        self.serving_mfu = Gauge(
+            f"{prefix}_mfu_pct",
+            "Model FLOPs utilization over the last busy window (% of "
+            "the slice's spec-sheet peak)",
+            registry=registry,
+        )
+        self.hbm_bw_util = Gauge(
+            f"{prefix}_hbm_bw_util_pct",
+            "Decode HBM-roofline bandwidth utilization over the last "
+            "busy window (% of the slice's spec-sheet bandwidth)",
+            registry=registry,
+        )
+        self.model_flops = Counter(
+            f"{prefix}_model_flops_total",
+            "Model FLOPs served (prefill + decode, config-math priced)",
+            registry=registry,
+        )
+        self.hbm_bytes = Counter(
+            f"{prefix}_model_hbm_bytes_total",
+            "Decode HBM bytes streamed (weights + live KV, roofline "
+            "model)",
+            registry=registry,
+        )
+        self.tenant_flops = Counter(
+            f"{prefix}_tenant_model_flops_total",
+            "Model FLOPs attributed per tenant at request retirement "
+            "(divide sched_goodput_tokens_total by this for "
+            "goodput-per-FLOP)",
+            ["tenant"],
+            registry=registry,
+        )
         # Decode-pipeline observability: how long the host spends
         # ENQUEUEING a step vs WAITING for one (dispatch time that grows
         # toward readback time means the overlap stopped hiding the
@@ -333,6 +389,12 @@ class ServingMetrics:
             self.tokens_per_second,
             self.ttft_seconds,
             self.inter_token_seconds,
+            self.request_phase_seconds,
+            self.serving_mfu,
+            self.hbm_bw_util,
+            self.model_flops,
+            self.hbm_bytes,
+            self.tenant_flops,
             self.decode_dispatch_seconds,
             self.decode_readback_seconds,
             self.pipeline_flushes,
@@ -463,11 +525,46 @@ class ServingMetrics:
     def on_finish(self, reason: str) -> None:
         self.requests_finished.labels(reason=reason).inc()
 
-    def observe_ttft(self, seconds: float) -> None:
-        self.ttft_seconds.observe(seconds)
+    @staticmethod
+    def _exemplar(exemplar_id) -> "dict | None":
+        """Trace-correlation exemplar for a latency bucket: rendered by
+        the OpenMetrics exposition (`/metrics` with an openmetrics
+        Accept header), ignored by the classic text format. The id is
+        the request's trace_id under --tracing, else its "rid:N" stand-
+        in — either way the bucket names a concrete example request."""
+        if not exemplar_id:
+            return None
+        return {"trace_id": str(exemplar_id)[:64]}
 
-    def observe_inter_token(self, seconds: float) -> None:
-        self.inter_token_seconds.observe(seconds)
+    def observe_ttft(self, seconds: float, exemplar_id=None) -> None:
+        self.ttft_seconds.observe(seconds, self._exemplar(exemplar_id))
+
+    def observe_inter_token(self, seconds: float, exemplar_id=None) -> None:
+        self.inter_token_seconds.observe(
+            seconds, self._exemplar(exemplar_id)
+        )
+
+    # --- attribution hooks (obs/attribution.py) ---
+
+    def observe_phase(self, phase: str, seconds: float,
+                      exemplar_id=None) -> None:
+        """One retired request's wall time in one lifecycle phase."""
+        self.request_phase_seconds.labels(phase=phase).observe(
+            seconds, self._exemplar(exemplar_id)
+        )
+
+    # --- MFU/roofline hooks (metrics/roofline.py MfuAccumulator) ---
+
+    def set_mfu(self, mfu_pct: float, bw_pct: float) -> None:
+        self.serving_mfu.set(mfu_pct)
+        self.hbm_bw_util.set(bw_pct)
+
+    def on_model_work(self, flops: float, nbytes: float) -> None:
+        self.model_flops.inc(flops)
+        self.hbm_bytes.inc(nbytes)
+
+    def on_tenant_flops(self, tenant: str, flops: float) -> None:
+        self.tenant_flops.labels(tenant=tenant).inc(flops)
 
     def observe_dispatch(self, seconds: float) -> None:
         self.decode_dispatch_seconds.observe(seconds)

@@ -439,6 +439,16 @@ class _Request:
     # bench reads TTFT / completion-vs-deadline off retired requests)
     t_first_tok: float = 0.0
     t_done: float = 0.0
+    # per-request latency attribution (obs/attribution.py): a
+    # RequestTimeline while attribution is enabled, else None — the
+    # None check IS the hot path's entire cost when the layer is off
+    timeline: object = None
+    # prompt tokens that actually RAN through the model for this
+    # request (chunk-overlap recompute included; prefix-reused rows
+    # excluded) — the MFU layer's per-tenant prefill charge: a request
+    # rejected while queued or cancelled mid-prefill must be charged
+    # for what it computed, not its whole prompt
+    prefill_computed: int = 0
 
 
 
@@ -503,6 +513,8 @@ class ContinuousBatcher:
         kv_pages: int = 0,  # paged pool size; 0 = dense-equivalent HBM
         scheduler=None,  # serving.scheduler.Scheduler (or None = FIFO)
         tp: int | None = None,  # None = take cfg.tp (1 = single chip)
+        attribution=None,  # obs.attribution.RequestAttributor (or None)
+        mfu=None,  # metrics.roofline.MfuAccumulator (or None)
     ):
         # the KV layout rides in the (static) cfg so every jitted step
         # branches on it at trace time; the explicit kwargs are sugar so
@@ -788,6 +800,15 @@ class ContinuousBatcher:
         # (step_no, emitted, logps) device arrays
         self._inflight: tuple | None = None  # owner: engine
         self._step_no = 0
+        # Per-request latency attribution + live MFU/roofline accounting
+        # (obs/attribution.py, metrics/roofline.py). Duck-typed and
+        # optional like metrics: None (the default) leaves the hot path
+        # with nothing but `is not None` checks — the bit-identity /
+        # no-overhead house pin. Both objects' mutable state is engine-
+        # thread-owned; cross-thread readers go through the batcher's
+        # attribution_stats()/mfu_stats() snapshot methods.
+        self.attribution = attribution
+        self.mfu = mfu
         # process-global tracer: every site below guards on .enabled, so
         # the default-off path is one attribute read per potential span
         self.tracer = get_tracer()
@@ -884,6 +905,14 @@ class ContinuousBatcher:
         if not isinstance(tenant, str) or len(tenant) > 64:
             raise ValueError(
                 "tenant must be a string of at most 64 characters"
+            )
+        if not tenant.isprintable():
+            # the tenant rides metric LABELS ({tenant=...}) and JSON log
+            # fields: control characters would be escaped differently by
+            # every consumer (Prometheus text vs JSON vs trace attrs) —
+            # refuse at the one admission rule instead
+            raise ValueError(
+                "tenant must contain printable characters only"
             )
         priority = 1 if priority is None else int(priority)
         if not (0 <= priority <= 9):
@@ -1008,17 +1037,26 @@ class ContinuousBatcher:
         if self.tracer.enabled:
             # root of the request's span tree; parent (if any) is the
             # ambient context — the HTTP handler's span attached around
-            # this call by the serving engine
+            # this call by the serving engine. tenant/priority ride the
+            # span attrs AND the log fields so log correlation can slice
+            # by SLO identity, not just trace_id/span_id.
             req.span = self.tracer.span(
                 "request", component="serving", rid=rid,
                 prompt_len=len(full), max_new=max_new,
+                tenant=tenant, priority=priority,
             )
             with attach(req.span):  # the log line carries the trace ids
                 get_logger().debug(
                     "request submitted",
                     extra={"fields": {"rid": rid, "prompt_len": len(full),
-                                      "max_new": max_new}},
+                                      "max_new": max_new, "tenant": tenant,
+                                      "priority": priority}},
                 )
+        if self.attribution is not None:
+            req.timeline = self.attribution.start(
+                req,
+                trace_id=req.span.trace_id if req.span is not None else "",
+            )
         self.pending.append(req)
         if self.metrics:
             self.metrics.on_submit()
@@ -1212,9 +1250,16 @@ class ContinuousBatcher:
                 # take a phantom hit back — the disposition commits at
                 # slot assignment below.
                 req.matched = True
+                t_match = (
+                    time.perf_counter() if req.timeline is not None else 0.0
+                )
                 hit = self.prefix_cache.match(
                     req.prompt, req.adapter, count=False
                 )
+                if req.timeline is not None:
+                    req.timeline.prefix_match_s += (
+                        time.perf_counter() - t_match
+                    )
                 if hit is not None:
                     req.prefix, matched = hit
                     req._match_depth = matched
@@ -1228,8 +1273,17 @@ class ContinuousBatcher:
                         pin = list(req.prefix.page_ids)
                         self.pool.incref(pin)
                         req._pinned_pages = pin
-            if self.pool is not None and not self._reserve_pages(req):
-                break  # head-of-line wait: pages free as slots retire
+            if self.pool is not None:
+                t_pages = (
+                    time.perf_counter() if req.timeline is not None else 0.0
+                )
+                reserved = self._reserve_pages(req)
+                if req.timeline is not None:
+                    req.timeline.page_alloc_s += (
+                        time.perf_counter() - t_pages
+                    )
+                if not reserved:
+                    break  # head-of-line wait: pages free as slots retire
             self.pending.pop(0)
             slot = free.pop(0)
             req.slot = slot
@@ -1238,6 +1292,10 @@ class ContinuousBatcher:
                 # WFQ virtual time charge land here, past every
                 # cancellable wait (the record_match discipline)
                 self.scheduler.on_admitted(req, self, time.perf_counter())
+            if req.timeline is not None:
+                # the attribution cursor leaves queue_wait exactly where
+                # the admit span ends: slot assignment
+                req.timeline.advance("prefill", time.perf_counter())
             if req.matched:
                 # the request is past every cancellable wait: commit its
                 # hit/miss disposition (one per request that reaches a
@@ -1253,7 +1311,14 @@ class ContinuousBatcher:
                     t0=req.t_submit, slot=slot,
                 ).end()
             if self.pool is not None:
+                t_inst = (
+                    time.perf_counter() if req.timeline is not None else 0.0
+                )
                 self._install_pages(req, slot)
+                if req.timeline is not None:
+                    req.timeline.page_alloc_s += (
+                        time.perf_counter() - t_inst
+                    )
             if self.chunk:
                 start = 0
                 if req.prefix is not None:
@@ -1300,7 +1365,7 @@ class ContinuousBatcher:
             finally:  # a raised dispatch must not pin the trace open
                 if prefill_span is not None:
                     prefill_span.end()
-            self._count_prefill_tokens(len(req.prompt), "computed")
+            self._count_prefill_tokens(len(req.prompt), "computed", req)
             self._on_first_token(req)
             self.running[slot] = req
             self._invalidate_slot_caches()
@@ -1638,13 +1703,19 @@ class ContinuousBatcher:
                     "prefill_chunk", component="serving", parent=req.span,
                     start=start, tokens=c,
                 )
+            t_chunk = (
+                time.perf_counter() if req.timeline is not None else 0.0
+            )
             try:
                 self._apply_prefill_chunk(chunk, start, slot)
             finally:
                 if chunk_span is not None:
                     chunk_span.end()
+            if req.timeline is not None:
+                now = time.perf_counter()
+                req.timeline.add_chunk(now, now - t_chunk)
             self._prefill_pos[slot] = start + c
-            self._count_prefill_tokens(c, "computed")
+            self._count_prefill_tokens(c, "computed", req)
             if self.metrics:
                 self.metrics.on_prefill_chunk()
             return
@@ -1662,13 +1733,17 @@ class ContinuousBatcher:
                 "prefill_chunk", component="serving", parent=req.span,
                 start=fstart, tokens=c, final=True,
             )
+        t_chunk = time.perf_counter() if req.timeline is not None else 0.0
         try:
             tok, logp = self._apply_prefill_finish(chunk, fstart, plen, slot)
         finally:
             if finish_span is not None:
                 finish_span.end()
+        if req.timeline is not None:
+            now = time.perf_counter()
+            req.timeline.add_chunk(now, now - t_chunk)
         del self.prefilling[slot], self._prefill_pos[slot]
-        self._count_prefill_tokens(plen - fstart, "computed")
+        self._count_prefill_tokens(plen - fstart, "computed", req)
         req.out.append(int(tok))
         req.out_logp.append(float(logp))
         self._on_first_token(req)
@@ -1677,15 +1752,25 @@ class ContinuousBatcher:
         self._maybe_promote_prefix(req)
         self._finish_if_done(req)
 
-    def _count_prefill_tokens(self, n: int, source: str) -> None:
+    def _count_prefill_tokens(self, n: int, source: str,
+                              req: "_Request | None" = None) -> None:
         """Prefill work accounting by provenance: ``computed`` tokens ran
         through the model (chunk overlap recompute included — it is real
         compute), ``prefix_reused`` tokens were copied from prefilled
-        prefix rows. Duck-typed like the other optional metric hooks."""
+        prefix rows. Duck-typed like the other optional metric hooks.
+        ``req`` attributes computed tokens to the request so the MFU
+        layer's retirement charge matches what actually ran."""
         if self.metrics is not None and n > 0:
             count = getattr(self.metrics, "on_prefill_tokens", None)
             if count is not None:
                 count(n, source)
+        if n > 0 and source == "computed":
+            if req is not None:
+                req.prefill_computed += n
+            if self.mfu is not None:
+                # only COMPUTED tokens moved FLOPs; prefix-reused rows
+                # cost nothing (that is the cache's point)
+                self.mfu.on_prefill_tokens(n)
 
     def _maybe_promote_prefix(self, req: _Request) -> None:
         """The promotion hook: a completed chunked prefill offers its
@@ -1743,9 +1828,19 @@ class ContinuousBatcher:
             if req.preemptions == 0:
                 observe = getattr(self.metrics, "observe_ttft", None)
                 if observe is not None:  # duck-typed: fakes may lack it
-                    observe(now - req.t_submit)
+                    if req.timeline is not None and getattr(
+                        self.metrics, "supports_exemplars", False
+                    ):
+                        # the TTFT bucket carries a trace-id exemplar so
+                        # a histogram spike pivots to a concrete request
+                        observe(now - req.t_submit, req.timeline.xid)
+                    else:
+                        observe(now - req.t_submit)
         if req.preemptions == 0:
             req.t_first_tok = now
+        if req.timeline is not None:
+            # TTFT ends here: the prefill segment closes, decode opens
+            req.timeline.advance("decode", now)
         if req.span is not None:
             req.decode_span = self.tracer.span(
                 "decode", component="serving", parent=req.span,
@@ -1811,6 +1906,43 @@ class ContinuousBatcher:
         )
         return int(tok), float(logp)
 
+    def _attr_retired(self, req: _Request, reason: str) -> None:
+        """Attribution + MFU wrap-up for one retired request — all three
+        retirement paths (finish, cancel, reject) funnel here after
+        ``t_done`` is set. The deadline disposition mirrors the
+        scheduler's goodput rule so tokens-per-TFLOP is a goodput
+        ratio, not a raw-throughput one."""
+        if self.attribution is None and self.mfu is None:
+            return
+        missed = req.deadline is not None and req.t_done > req.deadline
+        if self.mfu is not None:
+            goodput = (
+                0 if (missed or reason in ("cancelled", "rejected"))
+                else len(req.out)
+            )
+            self.mfu.on_retired(req, goodput)
+        if self.attribution is not None and req.timeline is not None:
+            self.attribution.on_retired(
+                req, reason, req.t_done, deadline_missed=missed
+            )
+
+    def attribution_stats(self) -> "dict | None":
+        """Cross-thread snapshot of the attribution layer's COUNTERS
+        (None when disabled) — the kv_stats()/sched_stats() contract.
+        The timeline payloads stay behind the /debug endpoints'
+        request_stats()/slow_stats(): health polls must not pay for
+        copying 256 timeline dicts they discard."""
+        if self.attribution is None:
+            return None
+        return self.attribution.count_stats()
+
+    def mfu_stats(self) -> "dict | None":
+        """Cross-thread snapshot of the live MFU/roofline accounting
+        (None when disabled)."""
+        if self.mfu is None:
+            return None
+        return self.mfu.mfu_stats()
+
     def cancel(self, rid: int) -> bool:
         """Retire ``rid`` wherever it lives — pending, mid-prefill, or
         decoding — freeing its slot for the next admission; tokens
@@ -1846,6 +1978,7 @@ class ContinuousBatcher:
             self.scheduler.on_retired(req, self, "cancelled", req.t_done)
         if self.metrics:
             self.metrics.on_finish("cancelled")
+        self._attr_retired(req, "cancelled")
         self._close_request_spans(req, "cancelled")
 
     def _retire_rejected(self, req: _Request, now: float) -> None:
@@ -1860,6 +1993,7 @@ class ContinuousBatcher:
             self.scheduler.on_retired(req, self, "rejected", now)
         if self.metrics:
             self.metrics.on_finish("rejected")
+        self._attr_retired(req, "rejected")
         self._close_request_spans(req, "rejected")
 
     def _preempt_slot(self, slot: int) -> None:
@@ -1887,6 +2021,11 @@ class ContinuousBatcher:
         req.matched = False
         req.prefix = None
         req._match_depth = None
+        if req.timeline is not None:
+            # the decode segment closes at eviction; a fresh queue_wait
+            # opens (the resumed admission closes it again), so the
+            # phase sums stay exact across preemption cycles
+            req.timeline.advance("queue_wait", time.perf_counter())
         if req.decode_span is not None:
             req.decode_span.set(tokens=len(req.out)).end()
             req.decode_span = None
@@ -1935,6 +2074,7 @@ class ContinuousBatcher:
                 self.scheduler.on_retired(req, self, reason, req.t_done)
             if self.metrics:
                 self.metrics.on_finish(reason)
+            self._attr_retired(req, reason)
             self._close_request_spans(req, reason)
 
     def step(self) -> None:
@@ -2003,6 +2143,15 @@ class ContinuousBatcher:
                 n_emitted, len(self.pending), len(self.running),
                 len(self.prefilling),
             )
+        if self.mfu is not None:
+            # live context rows the step's attention read (host ints
+            # over <= n_slots requests — no device work, keeps the
+            # zero-per-step-H2D contract this driver is registered for)
+            live = sum(
+                len(r.prompt) + len(r.out) - r.prefilled_out
+                for r in self.running.values()
+            )
+            self.mfu.on_step(n_emitted, len(self.running), live)
 
     def _decode_dispatch(self, allowed):  # graftlint: hot-path
         """Enqueue ONE device decode dispatch and return the result
@@ -2119,23 +2268,53 @@ class ContinuousBatcher:
         cancelled since dispatch) and -1 sentinels are skipped — the
         lag-by-one drop that makes the pipeline exact."""
         n_emitted = 0
-        observe_it = (
-            getattr(self.metrics, "observe_inter_token", None)
-            if self.metrics else None
-        )
-        now = time.perf_counter() if observe_it is not None else 0.0
+        observe_it, track, exemplars, now = self._token_tracking()
         for slot, req in list(self.running.items()):
             tok = int(emitted[slot])
             if tok >= 0:
                 n_emitted += 1
                 req.out.append(tok)
                 req.out_logp.append(float(logps[slot]))
-                if observe_it is not None:
-                    if req.t_last_tok:
-                        observe_it(now - req.t_last_tok)
-                    req.t_last_tok = now
+                if track:
+                    self._mark_emitted_token(req, now, observe_it,
+                                             exemplars)
                 self._finish_if_done(req)
         return n_emitted
+
+    def _token_tracking(self):
+        """Per-readback setup for inter-token tracking: returns
+        (observe_it, track, exemplars, now) — shared by the plain and
+        speculative readback loops so the ITL/exemplar/timeline
+        semantics have ONE definition. ``track`` is False (and ``now``
+        unread) when neither metrics nor attribution want per-token
+        facts — the hot path's whole cost is this tuple build."""
+        observe_it = (
+            getattr(self.metrics, "observe_inter_token", None)
+            if self.metrics else None
+        )
+        track = observe_it is not None or self.attribution is not None
+        exemplars = observe_it is not None and getattr(
+            self.metrics, "supports_exemplars", False
+        )
+        return (observe_it, track, exemplars,
+                time.perf_counter() if track else 0.0)
+
+    def _mark_emitted_token(self, req: _Request, now: float, observe_it,
+                            exemplars: bool) -> None:
+        """One emitted token's inter-token bookkeeping: the gap since
+        the request's previous token feeds the ITL histogram (exemplar-
+        tagged with the request's trace id when supported) and the
+        attribution timeline; ``t_last_tok`` advances either way."""
+        if req.t_last_tok:
+            gap = now - req.t_last_tok
+            if observe_it is not None:
+                if exemplars and req.timeline is not None:
+                    observe_it(gap, req.timeline.xid)
+                else:
+                    observe_it(gap)
+            if req.timeline is not None:
+                req.timeline.add_itl(now, gap)
+        req.t_last_tok = now
 
     def run(self, max_steps: int | None = None) -> dict[int, list[int]]:
         """Drive until every submitted request finished (or max_steps)."""

@@ -691,7 +691,17 @@ class SpeculativeBatcher(ContinuousBatcher):
         # proposals — dropping it would bias acceptance_rate and the
         # gamma-tuning histogram upward under cancel-heavy traffic
         accepted = [int(c) for c in counts if c > 0]
+        # inter-token tracking reuses the base loop's helpers: a round
+        # delivers its accepted tokens as one burst, so the first token
+        # carries the round interval and the rest gap ~0 — exactly what
+        # a streaming client perceives. (The spec path previously fed
+        # the ITL histogram nothing at all.)
+        observe_it, track, exemplars, now = self._token_tracking()
         for slot, req in list(self.running.items()):
+            if req.timeline is not None and int(counts[slot]) > 0:
+                # per-request attribution: this round drafted+verified
+                # for the slot (obs/attribution.py timeline fact)
+                req.timeline.spec_rounds += 1
             for j in range(int(counts[slot])):
                 tok = int(emitted[slot, j])
                 if tok < 0:
@@ -699,6 +709,9 @@ class SpeculativeBatcher(ContinuousBatcher):
                 n_emitted += 1
                 req.out.append(tok)
                 req.out_logp.append(float(logps[slot, j]))
+                if track:
+                    self._mark_emitted_token(req, now, observe_it,
+                                             exemplars)
                 self._finish_if_done(req)
                 if req.rid in self.done:
                     break  # EOS/stop/budget mid-round: drop the tail

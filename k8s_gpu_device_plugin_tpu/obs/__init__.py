@@ -9,7 +9,12 @@ Three pillars, one correlation key:
   buffer (``GET /debug/traces`` serves it; ``chrome://tracing`` and
   https://ui.perfetto.dev open it directly);
 - ``prom.py``   — span-duration Prometheus histograms per
-  (component, operation), driven by the tracer's end-of-span listener.
+  (component, operation), driven by the tracer's end-of-span listener;
+- ``attribution.py`` — per-request latency attribution (phase
+  timelines that sum exactly to each request's wall time) + the
+  tail-latency flight recorder (step-level detail for threshold/p99
+  breachers, ``GET /debug/slow``); exemplar-tagged phase histograms
+  ride ``metrics/serving_metrics.py``.
 
 ``utils/log.py`` injects the active ``trace_id``/``span_id`` into every
 JSON record, so one id follows a unit of work across logs, metrics

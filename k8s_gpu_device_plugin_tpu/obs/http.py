@@ -22,9 +22,52 @@ def route_label(request) -> str:
     return getattr(resource, "canonical", None) or "unmatched"
 
 
-def traces_payload(tracer: Tracer) -> dict:
-    """``GET /debug/traces``: buffer state + newest-first summaries."""
-    return {"enabled": tracer.enabled, "traces": tracer.traces()}
+def parse_trace_query(query) -> tuple["int | None", "int | None"]:
+    """Shared ``?limit=``/``?since=`` parsing for the trace endpoints
+    (both HTTP planes): ``limit`` caps the summary count, ``since`` (a
+    ``start_us`` microsecond timestamp) returns only traces that
+    STARTED after it — the incremental-poll idiom, so a long-running
+    server never has to ship the whole ring per poll. Raises ValueError
+    on malformed values (the planes answer 400)."""
+    limit = since = None
+    raw = query.get("limit")
+    if raw is not None:
+        try:
+            limit = int(raw)
+        except ValueError:
+            raise ValueError(f"limit must be an integer, got {raw!r}") \
+                from None
+        if limit < 0:
+            raise ValueError(f"limit must be >= 0, got {limit}")
+    raw = query.get("since")
+    if raw is not None:
+        try:
+            since = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"since must be an integer start_us timestamp, got {raw!r}"
+            ) from None
+    return limit, since
+
+
+def traces_payload(tracer: Tracer, limit: "int | None" = None,
+                   since_us: "int | None" = None) -> dict:
+    """``GET /debug/traces``: buffer state + newest-first summaries.
+
+    ``total`` always reports the full buffer population so a limited
+    page is distinguishable from a small buffer."""
+    traces = tracer.traces()
+    total = len(traces)
+    if since_us is not None:
+        traces = [t for t in traces if t["start_us"] > since_us]
+    if limit is not None:
+        traces = traces[:limit]  # newest-first: the limit keeps the newest
+    return {
+        "enabled": tracer.enabled,
+        "total": total,
+        "returned": len(traces),
+        "traces": traces,
+    }
 
 
 def trace_detail_payload(tracer: Tracer, trace_id: str) -> dict | None:

@@ -152,7 +152,15 @@ class Server:
     # --- observability debug surface (obs/) ---
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
-        return web.json_response(success(traces_payload(self.tracer)))
+        from k8s_gpu_device_plugin_tpu.obs.http import parse_trace_query
+
+        try:
+            limit, since = parse_trace_query(request.query)
+        except ValueError as e:
+            return web.json_response(failed(str(e)), status=400)
+        return web.json_response(
+            success(traces_payload(self.tracer, limit=limit, since_us=since))
+        )
 
     async def _debug_trace_one(self, request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]

@@ -223,6 +223,10 @@ class _OpenAIRoutes:
             "tenant": body.get("tenant"),
             "priority": body.get("priority"),
             "deadline_ms": body.get("deadline_ms"),
+            # opt-in per-request latency attribution on the response
+            # envelope (obs/attribution.py; SDKs pass it via extra_body,
+            # like the SLO fields above) — non-streamed responses only
+            "timeline": bool(body.get("timeline", False)),
         }
 
     def _budget(self, c: dict, prompt: list[int], default: int | None) -> None:
@@ -671,7 +675,7 @@ class _OpenAIRoutes:
                 reject,
                 max((i.get("retry_after", 1) for i in infos), default=1),
             )
-        return web.json_response({
+        envelope = {
             "id": oai_id,
             "object": object_name,
             "created": created,
@@ -688,7 +692,12 @@ class _OpenAIRoutes:
                 "completion_tokens": completion_tokens,
                 "total_tokens": len(prompt) + completion_tokens,
             },
-        })
+        }
+        if c.get("timeline"):
+            # extension field (opt-in, like the SLO extras): the primary
+            # choice's phase breakdown; null under --attributionOff
+            envelope["timeline"] = infos[0].get("timeline")
+        return web.json_response(envelope)
 
     async def _stream(
         self, request: web.Request, q: asyncio.Queue, oai_id: str,

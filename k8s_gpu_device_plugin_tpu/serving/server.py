@@ -119,6 +119,8 @@ class InferenceEngine:
         default_priority: int = 1,
         default_deadline_ms: int = 0,
         tp: int | None = None,  # None = take cfg.tp (1 = single chip)
+        attribution=None,  # obs.attribution.RequestAttributor (or None)
+        mfu=None,  # metrics.roofline.MfuAccumulator (or None)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -152,6 +154,13 @@ class InferenceEngine:
                 "silently ignoring it here would serve single-chip "
                 "while reporting a sharded mesh"
             )
+        if batcher is not None and (attribution is not None
+                                    or mfu is not None):
+            raise ValueError(
+                "pass attribution/mfu to the injected batcher's own "
+                "constructor; silently ignoring them here would serve "
+                "no timelines while reporting the layer enabled"
+            )
         # request-edge SLO defaults: a request that names no tenant /
         # priority / deadline gets these (the "defaulted at the server
         # edge" contract — the batcher itself never invents a deadline)
@@ -166,6 +175,7 @@ class InferenceEngine:
             prefix_cache=prefix_cache,
             kv_layout=kv_layout, kv_page_size=kv_page_size,
             kv_pages=kv_pages, scheduler=scheduler, tp=tp,
+            attribution=attribution, mfu=mfu,
         )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
@@ -331,6 +341,21 @@ class InferenceEngine:
             # preemptions, deadline misses, goodput) — snapshotted by
             # the scheduler, same contract as kv_stats
             out["sched"] = sched.sched_stats()
+        mfu_stats = getattr(self.cb, "mfu_stats", None)
+        if mfu_stats is not None:
+            # live MFU/roofline view (metrics/roofline.py): generation
+            # peaks, windowed mfu/bandwidth %, per-tenant goodput-per-
+            # TFLOP — snapshot-built, same contract as kv_stats
+            mfu = mfu_stats()
+            if mfu is not None:
+                out["mfu"] = mfu
+        attr_stats = getattr(self.cb, "attribution_stats", None)
+        if attr_stats is not None:
+            attr = attr_stats()
+            if attr is not None:
+                # counts only on health; the timelines themselves live
+                # on /debug/requests and /debug/slow
+                out["attribution"] = attr
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -422,6 +447,12 @@ class InferenceEngine:
                 # every request's token list forever
                 self.cb.done.pop(rid, None)
                 info = {"cached_tokens": req.cached_tokens}
+                tl = getattr(req, "timeline", None)
+                if tl is not None and tl.record is not None:
+                    # the finalized attribution record (a plain dict,
+                    # built at retirement on the engine thread): the
+                    # HTTP handler exports it when the request opted in
+                    info["timeline"] = tl.record
                 if req.reject_reason is not None:
                     # scheduler rejection (pool-pressure deferral past
                     # the budget): the handler turns this into a 429
@@ -479,6 +510,10 @@ class InferenceEngine:
                         )
                         if on_idle is not None:
                             on_idle()
+                        # same busy->idle zeroing for the MFU window
+                        mfu = getattr(self.cb, "mfu", None)
+                        if mfu is not None:
+                            mfu.on_idle()
                     self._work.wait(timeout=0.05)
                     self._work.clear()
                 was_busy = busy
@@ -568,6 +603,14 @@ class InferenceServer:
         self.app.router.add_get(
             "/debug/traces/{trace_id}", self._debug_trace_one
         )
+        # per-request latency attribution (obs/attribution.py): recent
+        # retired-request timelines, one by rid, and the tail-latency
+        # flight recorder (step-level detail for threshold breachers)
+        self.app.router.add_get("/debug/requests", self._debug_requests)
+        self.app.router.add_get(
+            "/debug/requests/{rid}", self._debug_request_one
+        )
+        self.app.router.add_get("/debug/slow", self._debug_slow)
         if registry is not None:
             self.app.router.add_get("/metrics", self._metrics)
         # OpenAI-compatible façade (serving/openai_api.py): /v1/completions,
@@ -625,9 +668,65 @@ class InferenceServer:
             return response
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
-        from k8s_gpu_device_plugin_tpu.obs.http import traces_payload
+        from k8s_gpu_device_plugin_tpu.obs.http import (
+            parse_trace_query,
+            traces_payload,
+        )
 
-        return web.json_response(traces_payload(self.tracer))
+        try:
+            limit, since = parse_trace_query(request.query)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            traces_payload(self.tracer, limit=limit, since_us=since)
+        )
+
+    def _attributor(self):
+        """The engine's RequestAttributor, or None when the layer is
+        off. Handlers touch it ONLY through its *_stats()/get()
+        snapshot methods (thread-ownership contract)."""
+        return getattr(self.engine.cb, "attribution", None)
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        att = self._attributor()
+        if att is None:
+            return web.json_response(
+                {"error": "attribution disabled (start without "
+                          "--attributionOff)"},
+                status=404,
+            )
+        return web.json_response(att.request_stats())
+
+    async def _debug_request_one(self, request: web.Request) -> web.Response:
+        att = self._attributor()
+        if att is None:
+            return web.json_response(
+                {"error": "attribution disabled (start without "
+                          "--attributionOff)"},
+                status=404,
+            )
+        try:
+            rid = int(request.match_info["rid"])
+        except ValueError:
+            return web.json_response(
+                {"error": "rid must be an integer"}, status=400
+            )
+        record = att.get(rid)
+        if record is None:
+            return web.json_response(
+                {"error": "request not in the timeline buffer"}, status=404
+            )
+        return web.json_response(record)
+
+    async def _debug_slow(self, request: web.Request) -> web.Response:
+        att = self._attributor()
+        if att is None:
+            return web.json_response(
+                {"error": "attribution disabled (start without "
+                          "--attributionOff)"},
+                status=404,
+            )
+        return web.json_response(att.slow_stats())
 
     async def _debug_trace_one(self, request: web.Request) -> web.Response:
         from k8s_gpu_device_plugin_tpu.obs.http import trace_detail_payload
@@ -646,6 +745,24 @@ class InferenceServer:
         return web.json_response(stats, status=200 if stats["alive"] else 503)
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        # Content negotiation: an OpenMetrics scraper (Prometheus with
+        # exemplar storage) gets the OpenMetrics exposition — the only
+        # text format that renders the trace-id exemplars on the
+        # TTFT/inter-token/phase histogram buckets; everyone else gets
+        # the classic text format, byte-compatible with the pre-PR
+        # surface (exemplars simply omitted).
+        if "application/openmetrics-text" in request.headers.get(
+            "Accept", ""
+        ):
+            from prometheus_client.openmetrics.exposition import (
+                CONTENT_TYPE_LATEST,
+                generate_latest,
+            )
+
+            return web.Response(
+                body=generate_latest(self.registry),
+                headers={"Content-Type": CONTENT_TYPE_LATEST},
+            )
         from prometheus_client import generate_latest
 
         return web.Response(
@@ -688,6 +805,10 @@ class InferenceServer:
             stop = body.get("stop", [])
             stop_text = body.get("stop_text", [])
             want_logprobs = bool(body.get("logprobs", False))
+            # opt-in per-request latency attribution on the response
+            # (obs/attribution.py): phase breakdown of this request's
+            # TTFT and wall time; requires the server-side layer
+            want_timeline = bool(body.get("timeline", False))
             # per-request sampling: any knob present builds a full
             # Sampler (its own validation applies); absent fields default
             # to greedy/off, NOT to the server sampler — a request that
@@ -791,6 +912,11 @@ class InferenceServer:
             }
             if want_logprobs:
                 payload["logprobs"] = drained[0][1]
+            if want_timeline:
+                # the primary choice's attribution record (null when the
+                # server runs --attributionOff — opt-in field, never an
+                # error: the stream itself already succeeded)
+                payload["timeline"] = infos[0].get("timeline")
             if n > 1:
                 payload["completions"] = [d[0] for d in drained]
                 if want_logprobs:
@@ -837,6 +963,10 @@ class InferenceServer:
                         # only when the prefix cache actually served part
                         # of the prompt — the common done event stays lean
                         done["cached_tokens"] = info["cached_tokens"]
+                    if want_timeline:
+                        # null under --attributionOff, like the
+                        # non-streamed payload — the documented contract
+                        done["timeline"] = info.get("timeline")
                     if self.tokenizer is not None:
                         with self.tracer.span(
                             "detokenize", component="serving",
@@ -1146,6 +1276,20 @@ def _main(argv: list[str] | None = None) -> int:
                         "deferred at the queue head before it is rejected "
                         "with 429 (0 = wait forever, the pre-scheduler "
                         "behavior; either policy)")
+    parser.add_argument("--attributionOff", action="store_true",
+                        help="disable per-request latency attribution + "
+                        "live MFU accounting (obs/attribution.py): no "
+                        "timelines on the done payloads or "
+                        "/debug/requests, no /debug/slow flight "
+                        "recorder, no serving_mfu_pct — token/logprob "
+                        "streams are bit-identical either way")
+    parser.add_argument("--slowRequestMs", type=float, default=0.0,
+                        help="flight-recorder threshold: requests whose "
+                        "total wall time reaches this keep full step-"
+                        "level detail on GET /debug/slow (deadline "
+                        "misses always do; 0 adds automatic p99-of-"
+                        "window triggering so the tail stays "
+                        "explainable untuned)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -1316,6 +1460,29 @@ def _main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         raise SystemExit(str(e)) from None
 
+    # Per-request latency attribution + live MFU/roofline accounting:
+    # on by default (the operator-facing numbers), one flag off. The
+    # cost model prices against the detected TPU generation's spec-sheet
+    # peaks (device/topology.py); off-TPU it falls back to v5e so the
+    # ratios stay well-defined.
+    attribution = None
+    mfu = None
+    if not args.attributionOff:
+        from k8s_gpu_device_plugin_tpu.metrics.roofline import (
+            MfuAccumulator,
+            ServingCostModel,
+        )
+        from k8s_gpu_device_plugin_tpu.obs.attribution import (
+            RequestAttributor,
+        )
+
+        attribution = RequestAttributor(
+            slow_ms=args.slowRequestMs, metrics=metrics
+        )
+        mfu = MfuAccumulator(
+            ServingCostModel.for_config(cfg, tp=args.tp), metrics=metrics
+        )
+
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1344,6 +1511,8 @@ def _main(argv: list[str] | None = None) -> int:
             kv_pages=args.kvPages,
             scheduler=scheduler,
             tp=args.tp,
+            attribution=attribution,
+            mfu=mfu,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1361,6 +1530,8 @@ def _main(argv: list[str] | None = None) -> int:
         scheduler=None if batcher is not None else scheduler,
         default_deadline_ms=args.defaultDeadlineMs,
         tp=None if batcher is not None else args.tp,
+        attribution=None if batcher is not None else attribution,
+        mfu=None if batcher is not None else mfu,
     )
     from prometheus_client import REGISTRY
 

@@ -23,10 +23,24 @@ class Scheduler:
         return {"tenants": {k: dict(v) for k, v in list(self._tenants.items())}}
 
 
+class Recorder:
+    """The obs/attribution.py shape: the flight-recorder ring and the
+    recent-timeline ring are engine-written; HTTP readers must go
+    through the slow_stats()/request_stats() snapshots."""
+
+    def __init__(self):
+        self._slow_ring = []  # owner: engine
+        self._recent = []     # owner: engine
+
+    def slow_stats(self):
+        return {"requests": [dict(r) for r in list(self._slow_ring)]}
+
+
 class Server:
-    def __init__(self, cb, sched):
+    def __init__(self, cb, sched, rec):
         self.cb = cb
         self.sched = sched
+        self.rec = rec
 
     async def health(self, request):
         return {
@@ -35,6 +49,9 @@ class Server:
             "free": self.cb.pool.free_pages,          # BAD: pool internals
             "tenants": dict(self.sched._tenants),     # BAD: ledger copy races
         }
+
+    async def slow(self, request):
+        return list(self.rec._slow_ring)  # BAD: ring iteration races
 
     def stats(self):  # graftlint: cross-thread
         return dict(self.cb.running)  # BAD: cross-thread dict copy

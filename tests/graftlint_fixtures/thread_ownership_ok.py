@@ -26,10 +26,24 @@ class Scheduler:
         return {"tenants": {k: dict(v) for k, v in list(self._tenants.items())}}
 
 
+class Recorder:
+    """The obs/attribution.py shape: engine-owned rings cross threads
+    only through the *_stats() snapshot methods."""
+
+    def __init__(self):
+        self._slow_ring = []  # owner: engine
+        self._recent = []     # owner: engine
+
+    def slow_stats(self):
+        # engine-state snapshot: list() before iterating, copies out
+        return {"requests": [dict(r) for r in list(self._slow_ring)]}
+
+
 class Server:
-    def __init__(self, cb, sched):
+    def __init__(self, cb, sched, rec):
         self.cb = cb
         self.sched = sched
+        self.rec = rec
 
     async def health(self, request):
         return {
@@ -37,6 +51,9 @@ class Server:
             "kv": self.cb.kv_stats(),        # the snapshot boundary
             "sched": self.sched.sched_stats(),  # ditto for the scheduler
         }
+
+    async def slow(self, request):
+        return self.rec.slow_stats()  # the flight-recorder boundary
 
     def stats(self):  # graftlint: cross-thread
         return {"queued": len(self.cb.running)}

@@ -1,0 +1,667 @@
+"""Per-request latency attribution, flight recorder, MFU accounting.
+
+Pins the tentpole contracts of the observability layer:
+
+- a retired request's phase breakdown SUMS to its measured wall time
+  (exact by the cursor construction, within float rounding) across
+  dense/paged x prefix cache on/off x pipeline 0/1 x speculative;
+- token/logprob streams are bit-identical with the layer on or off
+  (it never touches device state — the house pin);
+- the flight recorder retains step-level detail exactly for threshold
+  breachers / deadline misses / p99-of-window outliers;
+- /metrics parses as valid Prometheus AND OpenMetrics text with
+  trace-id exemplars on the TTFT/inter-token/phase buckets, and the
+  kv_shard_*/spec_*/tenant-labeled series survive both parsers with
+  gnarly (printable) label values;
+- the roofline cost model prices prefill/decode per the config math
+  against device/topology.py spec peaks, tp-aware;
+- the serving HTTP surface exports timelines (opt-in done field,
+  /debug/requests{,/rid}, /debug/slow) and /v1/health carries the live
+  MFU view.
+"""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_gpu_device_plugin_tpu.metrics.roofline import (
+    MfuAccumulator,
+    ServingCostModel,
+)
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.models.spec_batching import SpeculativeBatcher
+from k8s_gpu_device_plugin_tpu.obs.attribution import (
+    RequestAttributor,
+    RequestTimeline,
+)
+from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+BUCKETS = (8, 16, 32)
+PS = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    draft_cfg = LlamaConfig.tiny(n_layers=1, d_model=64, n_heads=4,
+                                 n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    draft_params = init_params(jax.random.key(1), draft_cfg)
+    return cfg, params, draft_cfg, draft_params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _batcher(setup, layout, cache, depth, spec, attribution=None, mfu=None):
+    cfg, params, draft_cfg, draft_params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 22) \
+        if cache else None
+    kw = dict(
+        n_slots=2, max_len=64, chunked_prefill=8, prompt_buckets=BUCKETS,
+        pipeline_depth=depth, prefix_cache=pc,
+        kv_layout=layout, kv_page_size=PS if layout == "paged" else None,
+        attribution=attribution, mfu=mfu,
+    )
+    if spec:
+        return SpeculativeBatcher(
+            params, cfg, draft_params, draft_cfg, gamma=3, **kw
+        )
+    return ContinuousBatcher(params, cfg, **kw)
+
+
+MATRIX = [
+    ("dense", False, 0, False),
+    ("dense", False, 1, False),
+    ("dense", True, 1, False),
+    ("paged", False, 1, False),
+    ("paged", True, 0, False),
+    ("paged", True, 1, False),
+    ("dense", False, 1, True),
+    ("paged", True, 1, True),
+]
+
+
+@pytest.mark.parametrize("layout,cache,depth,spec", MATRIX)
+def test_phase_breakdown_sums_to_wall_time(setup, layout, cache, depth, spec):
+    """The acceptance pin: every retired request's segments (and the
+    aggregated phases) sum to its measured submit->done wall time,
+    across the whole serving feature matrix."""
+    cfg = setup[0]
+    att = RequestAttributor()
+    cb = _batcher(setup, layout, cache, depth, spec, attribution=att)
+    rids = [
+        cb.submit(_prompt(7, 12, cfg), max_new=5),
+        # the speculative engine shares one sampler/key stream: no
+        # per-request seed on that arm
+        cb.submit(_prompt(8, 20, cfg), max_new=4,
+                  seed=None if spec else 11, tenant="gold", priority=0),
+        cb.submit(_prompt(7, 12, cfg), max_new=3),  # same prompt: cache hit
+    ]
+    cb.run()
+    stats = att.request_stats()
+    assert stats["retired"] == len(rids)
+    for rec in stats["requests"]:
+        seg_sum = sum(d for _, _, d in rec["segments"])
+        phase_sum = sum(rec["phases"].values())
+        assert seg_sum == pytest.approx(rec["total_s"], abs=5e-5)
+        assert phase_sum == pytest.approx(rec["total_s"], abs=5e-5)
+        # contiguity: each segment starts where the previous ended
+        cursor = 0.0
+        for _name, start, dur in rec["segments"]:
+            assert start == pytest.approx(cursor, abs=5e-5)
+            cursor = start + dur
+        # TTFT is the queue_wait + prefill share (no preemptions here)
+        assert rec["ttft_s"] == pytest.approx(
+            rec["phases"]["queue_wait"] + rec["phases"]["prefill"],
+            abs=5e-5,
+        )
+        assert rec["phases"]["decode"] >= 0.0
+        assert rec["detail"]["itl"]["count"] == max(0, rec["tokens"] - 1)
+        if spec:
+            assert rec["spec_rounds"] >= 1
+    # the cache-on arm's repeat prompt reused its prefix
+    if cache and not spec:
+        by_rid = {r["rid"]: r for r in stats["requests"]}
+        assert by_rid[rids[2]]["cached_tokens"] >= 0  # effective-reuse capped
+
+
+@pytest.mark.parametrize("layout,depth", [("dense", 1), ("paged", 0)])
+def test_streams_bit_identical_attribution_on_off(setup, layout, depth):
+    """The house pin: attribution attached or absent, greedy AND seeded
+    token/logprob streams are bit-identical (the layer never touches
+    device state)."""
+    cfg = setup[0]
+
+    def run(att):
+        cb = _batcher(setup, layout, False, depth, False, attribution=att)
+        cb.submit(_prompt(21, 12, cfg), max_new=6)
+        cb.submit(_prompt(22, 9, cfg), max_new=5, seed=7,
+                  sampler=Sampler(temperature=0.8, top_k=8))
+        cb.run()
+        return {
+            rid: (tuple(r.out), tuple(r.out_logp))
+            for rid, r in cb.done_requests.items()
+        }
+
+    assert run(None) == run(RequestAttributor())
+
+
+# --- flight recorder ------------------------------------------------------
+
+
+class _FakeReq:
+    def __init__(self, rid, t_submit, tenant="default"):
+        self.rid = rid
+        self.tenant = tenant
+        self.priority = 1
+        self.t_submit = t_submit
+        self.t_first_tok = t_submit
+        self.out = [1, 2]
+        self.prompt = [3] * 4
+        self.cached_tokens = 0
+        self.prefill_computed = 4
+        self.prefilled_out = 0
+        self.preemptions = 0
+        self.deadline = None
+        self.timeline = None
+
+
+def _retire(att, rid, total_s, missed=False):
+    t0 = time.perf_counter()
+    req = _FakeReq(rid, t0)
+    req.timeline = att.start(req)
+    req.timeline.advance("prefill", t0)
+    req.timeline.advance("decode", t0)
+    att.on_retired(req, "budget", t0 + total_s, deadline_missed=missed)
+    return req
+
+
+def test_flight_recorder_threshold_and_deadline():
+    att = RequestAttributor(slow_ms=5.0, window_min=10_000)  # p99 off
+    _retire(att, 0, 0.001)           # fast: summary only
+    _retire(att, 1, 0.050)           # breaches 5ms: full detail
+    _retire(att, 2, 0.001, missed=True)  # deadline miss: always kept
+    slow = att.slow_stats()
+    assert slow["captured"] == 2
+    kept = {r["rid"] for r in slow["requests"]}
+    assert kept == {1, 2}
+    for r in slow["requests"]:
+        assert r["slow"] is True and "steps" in r
+    # the fast request still has a summary (no step detail)
+    rec = att.get(0)
+    assert rec is not None and "steps" not in rec
+    # get() prefers the slow-ring record (with detail)
+    assert "steps" in att.get(1)
+
+
+def test_flight_recorder_p99_auto_trigger():
+    att = RequestAttributor(slow_ms=0.0, window=64, window_min=8)
+    for i in range(10):
+        _retire(att, i, 0.001)
+    assert att.slow_stats()["captured"] == 0 or \
+        att.slow_stats()["captured"] <= 2  # equal-latency ties may capture
+    _retire(att, 99, 0.500)  # 500x the window p99: must be captured
+    assert any(r["rid"] == 99 for r in att.slow_stats()["requests"])
+
+
+def test_recent_ring_is_bounded():
+    att = RequestAttributor(recent=4, slow_ms=10_000.0, window_min=10_000)
+    for i in range(10):
+        _retire(att, i, 0.001)
+    stats = att.request_stats()
+    assert stats["retired"] == 10
+    assert [r["rid"] for r in stats["requests"]] == [9, 8, 7, 6]
+
+
+def test_timeline_cursor_exactness_across_preemption_shape():
+    """Synthetic preempt/resume cycle: queue->prefill->decode->queue->
+    prefill->decode still sums exactly."""
+    att = RequestAttributor()
+    t0 = 100.0
+    req = _FakeReq(0, t0)
+    tl = att.start(req)
+    req.timeline = tl
+    tl.advance("prefill", t0 + 1)     # admitted
+    tl.advance("decode", t0 + 3)      # first token
+    tl.advance("queue_wait", t0 + 4)  # preempted
+    tl.advance("prefill", t0 + 6)     # re-admitted
+    tl.advance("decode", t0 + 7)      # resumed first token
+    rec = att.on_retired(req, "budget", t0 + 9)
+    assert rec["phases"] == {
+        "queue_wait": pytest.approx(3.0),
+        "prefill": pytest.approx(3.0),
+        "decode": pytest.approx(3.0),
+    }
+    assert sum(d for _, _, d in rec["segments"]) == pytest.approx(9.0)
+
+
+# --- metrics: exemplars, exposition, escaping -----------------------------
+
+
+def _populated_metrics():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.observe_ttft(0.05, "ab" * 16)
+    m.observe_ttft(0.2)  # no exemplar
+    m.observe_inter_token(0.004, "cd" * 16)
+    m.observe_phase("queue_wait", 0.001, "ab" * 16)
+    m.observe_phase("decode", 0.01, None)
+    m.set_kv_shards([
+        {"shard": 0, "reserved_bytes": 1024, "pages_in_use": 3,
+         "in_use_bytes": 512},
+        {"shard": 1, "reserved_bytes": 1024, "pages_in_use": 3,
+         "in_use_bytes": 512},
+    ])
+    m.on_spec_round(4, [2, 3])
+    # printable-but-gnarly tenant: quotes and backslashes must escape
+    # identically in both expositions (the satellite's parse pin)
+    tenant = 'we"ird\\tenant'
+    m.on_goodput(tenant, "0", 7)
+    m.on_deadline_miss(tenant, 0.5)
+    m.on_tenant_flops(tenant, 1e9)
+    m.set_mfu(12.5, 40.0)
+    m.on_model_work(1e9, 2e9)
+    return reg, m
+
+
+def test_metrics_exposition_parses_classic_and_openmetrics():
+    from prometheus_client import generate_latest
+    from prometheus_client.openmetrics.exposition import (
+        generate_latest as om_latest,
+    )
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families as om_parse,
+    )
+    from prometheus_client.parser import text_string_to_metric_families
+
+    reg, m = _populated_metrics()
+    try:
+        classic = generate_latest(reg).decode()
+        fams = {f.name: f for f in text_string_to_metric_families(classic)}
+        # the kv_shard gauges and spec counters render + parse with
+        # consistent label escaping
+        assert "tpu_serving_kv_shard_reserved_bytes" in fams
+        assert "tpu_serving_spec_rounds" in fams
+        good = fams["tpu_serving_sched_goodput_tokens"]
+        assert any(
+            s.labels.get("tenant") == 'we"ird\\tenant' for s in good.samples
+        )
+
+        om = om_latest(reg).decode()
+        assert om.endswith("# EOF\n")
+        om_fams = {f.name: f for f in om_parse(om)}
+        good_om = om_fams["tpu_serving_sched_goodput_tokens"]
+        assert any(
+            s.labels.get("tenant") == 'we"ird\\tenant'
+            for s in good_om.samples
+        )
+        # exemplars present on the TTFT/ITL/phase buckets
+        def exemplars(name):
+            return [
+                s.exemplar for s in om_fams[name].samples
+                if s.name.endswith("_bucket") and s.exemplar
+            ]
+
+        assert any(
+            e.labels == {"trace_id": "ab" * 16}
+            for e in exemplars("tpu_serving_ttft_seconds")
+        )
+        assert exemplars("tpu_serving_inter_token_seconds")
+        assert exemplars("tpu_serving_request_phase_seconds")
+    finally:
+        m.close()
+
+
+def test_tenant_label_rejects_control_characters():
+    """The one admission rule keeps control characters out of metric
+    labels and JSON logs (escaping-consistency satellite)."""
+    with pytest.raises(ValueError):
+        ContinuousBatcher.validate_sched("a\nb", 1, None)
+    with pytest.raises(ValueError):
+        ContinuousBatcher.validate_sched("a\tb", 1, None)
+    tenant, _, _ = ContinuousBatcher.validate_sched('we"ird\\tenant', 1, None)
+    assert tenant == 'we"ird\\tenant'
+
+
+# --- roofline cost model --------------------------------------------------
+
+
+def test_cost_model_prices_from_config_math():
+    cfg = LlamaConfig.tiny()
+    model = ServingCostModel.for_config(cfg, generation="v5e")
+    # inference forward = one third of the 6N (fwd+bwd) training figure
+    assert model.flops_per_token == pytest.approx(cfg.flops_per_token() / 3)
+    # weight stream = matmul params x dtype width (bf16 = 2 bytes)
+    assert model.weight_bytes == int(model.flops_per_token / 2) * 2
+    assert model.prefill_flops(100) == pytest.approx(
+        100 * model.flops_per_token
+    )
+    # the step's byte roofline: weights once + live KV read + write rows
+    b = model.decode_step_bytes(active=2, live_tokens=50)
+    assert b == model.weight_bytes + 52 * model.kv_token_bytes
+    # utilization algebra: peak for one second == 100%
+    assert model.mfu_pct(model.peak_tflops * 1e12, 1.0) == pytest.approx(100.0)
+    assert model.hbm_bw_util_pct(model.hbm_gbps * 1e9, 1.0) == \
+        pytest.approx(100.0)
+
+
+def test_cost_model_is_tp_aware():
+    cfg = LlamaConfig.tiny()
+    m1 = ServingCostModel.for_config(cfg, generation="v5e", tp=1)
+    m2 = ServingCostModel.for_config(cfg, generation="v5e", tp=2)
+    # the same achieved FLOP/s is half the utilization on twice the chips
+    assert m2.mfu_pct(1e12, 1.0) == pytest.approx(m1.mfu_pct(1e12, 1.0) / 2)
+
+
+def test_mfu_accumulator_totals_and_tenants():
+    cfg = LlamaConfig.tiny()
+    model = ServingCostModel.for_config(cfg, generation="v5e")
+    acc = MfuAccumulator(model)
+    acc.on_prefill_tokens(10)
+    acc.on_step(emitted=2, active=2, live_tokens=20)
+    flops, nbytes = acc.totals()
+    assert flops == pytest.approx(12 * model.flops_per_token)
+    assert nbytes == pytest.approx(model.decode_step_bytes(2, 20))
+    req = _FakeReq(0, 0.0, tenant="gold")
+    acc.on_retired(req, goodput_tokens=2)
+    stats = acc.mfu_stats()
+    assert stats["generation"] == "v5e"
+    assert stats["tenants"]["gold"]["goodput_tokens"] == 2
+    assert stats["tenants"]["gold"]["model_tflops"] > 0
+    acc.on_idle()
+    assert acc.mfu_stats()["serving_mfu_pct"] == 0.0
+
+
+def test_mfu_window_closes_and_pushes_gauges():
+    class _Rec:
+        def __init__(self):
+            self.mfu = None
+            self.work = []
+
+        def set_mfu(self, mfu_pct, bw_pct):
+            self.mfu = (mfu_pct, bw_pct)
+
+        def on_model_work(self, flops, nbytes):
+            self.work.append((flops, nbytes))
+
+    cfg = LlamaConfig.tiny()
+    rec = _Rec()
+    acc = MfuAccumulator(
+        ServingCostModel.for_config(cfg, generation="v5e"),
+        metrics=rec, window_s=0.0,  # every step closes a window
+    )
+    acc.on_step(emitted=1, active=1, live_tokens=10)
+    assert rec.mfu is not None and rec.mfu[1] > 0
+    assert rec.work
+
+
+# --- serving HTTP surface -------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+async def _with_server(setup, body, attribution="on", registry=None,
+                       metrics=None):
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params = setup[0], setup[1]
+    att = mfu = None
+    if attribution == "on":
+        att = RequestAttributor(window_min=4, metrics=metrics)
+        mfu = MfuAccumulator(
+            ServingCostModel.for_config(cfg, generation="v5e"),
+            metrics=metrics,
+        )
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        attribution=att, mfu=mfu, metrics=metrics,
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0,
+                             registry=registry)
+    stop = asyncio.Event()
+    task = asyncio.create_task(server.run(stop))
+    for _ in range(100):
+        if server.bound_port:
+            break
+        await asyncio.sleep(0.05)
+    try:
+        base = f"http://127.0.0.1:{server.bound_port}"
+        async with aiohttp.ClientSession() as session:
+            await body(session, base)
+    finally:
+        stop.set()
+        await asyncio.wait_for(task, 30)
+
+
+def test_generate_timeline_opt_in_and_debug_endpoints(setup):
+    cfg = setup[0]
+    prompt = _prompt(31, 10, cfg)
+
+    async def body(session, base):
+        # without the opt-in field: no timeline key
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": prompt, "max_new": 4,
+        }) as resp:
+            assert resp.status == 200
+            plain = await resp.json()
+            assert "timeline" not in plain
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": prompt, "max_new": 4, "timeline": True,
+        }) as resp:
+            assert resp.status == 200
+            payload = await resp.json()
+        tl = payload["timeline"]
+        assert tl["tokens"] == 4
+        assert set(tl["phases"]) == {"queue_wait", "prefill", "decode"}
+        assert sum(tl["phases"].values()) == pytest.approx(
+            tl["total_s"], abs=5e-5
+        )
+        rid = tl["rid"]
+        # /debug/requests lists it; /debug/requests/{rid} serves it
+        async with session.get(f"{base}/debug/requests") as resp:
+            assert resp.status == 200
+            listing = await resp.json()
+        assert listing["retired"] >= 2
+        assert any(r["rid"] == rid for r in listing["requests"])
+        async with session.get(f"{base}/debug/requests/{rid}") as resp:
+            assert resp.status == 200
+            one = await resp.json()
+        assert one["rid"] == rid
+        async with session.get(f"{base}/debug/requests/notanint") as resp:
+            assert resp.status == 400
+        async with session.get(f"{base}/debug/requests/999999") as resp:
+            assert resp.status == 404
+        # the flight recorder answers (capture depends on the window)
+        async with session.get(f"{base}/debug/slow") as resp:
+            assert resp.status == 200
+            slow = await resp.json()
+        assert "requests" in slow and "captured" in slow
+        # /v1/health carries the live MFU view + attribution counts
+        async with session.get(f"{base}/v1/health") as resp:
+            health = await resp.json()
+        assert health["mfu"]["generation"] == "v5e"
+        assert health["attribution"]["retired"] >= 2
+
+    run(_with_server(setup, body))
+
+
+def test_sse_done_event_carries_timeline(setup):
+    cfg = setup[0]
+    prompt = _prompt(33, 8, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": prompt, "max_new": 3, "stream": True,
+            "timeline": True,
+        }) as resp:
+            assert resp.status == 200
+            raw = (await resp.read()).decode()
+        events = [
+            json.loads(line[len("data: "):])
+            for line in raw.splitlines() if line.startswith("data: ")
+        ]
+        done = events[-1]
+        assert done["done"] is True
+        assert done["timeline"]["tokens"] == 3
+
+    run(_with_server(setup, body))
+
+
+def test_openai_envelope_timeline_opt_in(setup):
+    cfg = setup[0]
+    prompt = _prompt(35, 9, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "max_tokens": 3, "timeline": True,
+        }) as resp:
+            assert resp.status == 200
+            payload = await resp.json()
+        assert payload["timeline"]["tokens"] == 3
+        async with session.post(f"{base}/v1/completions", json={
+            "prompt": prompt, "max_tokens": 3,
+        }) as resp:
+            assert "timeline" not in await resp.json()
+
+    run(_with_server(setup, body))
+
+
+def test_debug_endpoints_404_when_attribution_off(setup):
+    async def body(session, base):
+        for path in ("/debug/requests", "/debug/requests/0", "/debug/slow"):
+            async with session.get(f"{base}{path}") as resp:
+                assert resp.status == 404
+
+    run(_with_server(setup, body, attribution="off"))
+
+
+def test_metrics_endpoint_negotiates_openmetrics_with_exemplars(setup):
+    from prometheus_client import CollectorRegistry
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families as om_parse,
+    )
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg = setup[0]
+    reg = CollectorRegistry()
+    metrics = ServingMetrics(registry=reg)
+    prompt = _prompt(37, 10, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": prompt, "max_new": 4,
+        }) as resp:
+            assert resp.status == 200
+        # classic (no Accept): stays text/plain and parses
+        async with session.get(f"{base}/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            classic = await resp.text()
+        assert list(text_string_to_metric_families(classic))
+        # openmetrics: negotiated, parses, exemplars on the TTFT bucket
+        async with session.get(f"{base}/metrics", headers={
+            "Accept": "application/openmetrics-text; version=1.0.0",
+        }) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            om = await resp.text()
+        fams = {f.name: f for f in om_parse(om)}
+        ttft = fams["tpu_serving_ttft_seconds"]
+        ex = [
+            s.exemplar for s in ttft.samples
+            if s.name.endswith("_bucket") and s.exemplar
+        ]
+        assert ex and "trace_id" in ex[0].labels
+        phase = fams["tpu_serving_request_phase_seconds"]
+        assert any(
+            s.exemplar for s in phase.samples if s.name.endswith("_bucket")
+        )
+
+    try:
+        run(_with_server(setup, body, registry=reg, metrics=metrics))
+    finally:
+        metrics.close()
+
+
+def test_engine_refuses_attribution_with_injected_batcher(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup[0], setup[1]
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                           chunked_prefill=8)
+    with pytest.raises(ValueError, match="attribution"):
+        InferenceEngine(params, cfg, batcher=cb,
+                        attribution=RequestAttributor())
+    cb2 = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                            chunked_prefill=8)
+    with pytest.raises(ValueError, match="attribution"):
+        InferenceEngine(
+            params, cfg, batcher=cb2,
+            mfu=MfuAccumulator(
+                ServingCostModel.for_config(cfg, generation="v5e")
+            ),
+        )
+
+
+# --- serve_bench integration ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bench_reports_mfu_and_slow_timeline(setup):
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    cfg, params = setup[0], setup[1]
+    r = serve_bench(
+        cfg, n_slots=2, n_requests=4, max_len=64,
+        prompt_lens=(8, 12), max_new=4, params=params,
+        prompt_buckets=BUCKETS, chunked_prefill=8,
+        paged_ab=False, prefix_ab=False, spec_ab=False,
+        sched_base_s=0.5, sched_overload_s=0.5,
+    )
+    assert r.serving_mfu_pct > 0.0
+    assert r.hbm_bw_util_pct > 0.0
+    assert r.goodput_tokens_per_tflop > 0.0
+    assert r.mfu_generation
+    # the open-loop A/B captured at least one slow-request timeline
+    assert r.slow_timeline is not None
+    assert "steps" in r.slow_timeline
+
+
+def test_timeline_slots_bound_step_detail():
+    tl = RequestTimeline(0, "rid:0", "default", 1, 0.0)
+    for i in range(5000):
+        tl.add_itl(float(i), 0.001)
+    from k8s_gpu_device_plugin_tpu.obs.attribution import MAX_STEP_DETAIL
+
+    assert len(tl.steps) == MAX_STEP_DETAIL
+    assert tl.itl_count == 5000

@@ -92,11 +92,12 @@ def test_thread_ownership_allows_atomic_len():
     bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
     found = _run_on(bad, [_checker("thread-ownership")])
     # the len(self.cb.running) read on the same handler must NOT fire;
-    # the iteration/copy/pool reads must — and the scheduler-shaped
-    # ledger reads (serving/scheduler.py state) fire the same way
-    assert len(found) == 5
+    # the iteration/copy/pool reads must — the scheduler-shaped ledger
+    # reads (serving/scheduler.py state) and the flight-recorder ring
+    # (obs/attribution.py state) fire the same way
+    assert len(found) == 6
     assert {v.key for v in found} == {
-        "running", "pool", "_tenants", "rejections",
+        "running", "pool", "_tenants", "rejections", "_slow_ring",
     }
 
 

@@ -1,0 +1,117 @@
+"""Perfetto/Chrome trace-event exporter (obs/export.py), direct tests.
+
+The exporter was previously covered only incidentally through the HTTP
+endpoints; this pins the conversion contract itself: every span record
+becomes exactly one complete ("ph": "X") event, nested and CROSS-THREAD
+spans keep their parent/child pairing through the args, components map
+stably to track ids with one thread_name metadata event each, and the
+file writer round-trips through JSON.
+"""
+
+import json
+import threading
+
+from k8s_gpu_device_plugin_tpu.obs.export import (
+    to_chrome_trace,
+    write_trace_file,
+)
+from k8s_gpu_device_plugin_tpu.obs.trace import Tracer, attach
+
+
+def _build_trace():
+    """One trace: serving root -> nested child (same thread) + a child
+    ended on ANOTHER thread (the engine-hop shape), components split
+    across two tracks."""
+    tr = Tracer()
+    tr.enabled = True
+    root = tr.span("request", component="serving", rid=7)
+    with attach(root):
+        with tr.span("prefill", component="serving", bucket=32):
+            pass
+        cross = tr.span("decode_dispatch", component="serving_engine",
+                        step=3)
+
+    def end_on_worker():
+        cross.end()
+
+    t = threading.Thread(target=end_on_worker, name="engine-worker")
+    t.start()
+    t.join()
+    root.end()
+    spans = tr.get_trace(root.trace_id)
+    assert spans is not None and len(spans) == 3
+    return root, spans
+
+
+def test_round_trip_event_pairing_and_track_ids():
+    root, spans = _build_trace()
+    doc = to_chrome_trace(spans)
+    events = doc["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    # one complete event per span record, nothing invented or dropped
+    assert len(complete) == len(spans) == 3
+    by_name = {e["name"]: e for e in complete}
+
+    # parent/child pairing survives: both children point at the root's
+    # span_id, the root at None — the same ids the span records carry
+    root_ev = by_name["request"]
+    assert root_ev["args"]["parent_id"] is None
+    assert root_ev["args"]["span_id"] == root.span_id
+    for child in ("prefill", "decode_dispatch"):
+        assert by_name[child]["args"]["parent_id"] == root.span_id
+        assert by_name[child]["args"]["trace_id"] == root.trace_id
+
+    # the cross-thread child records the worker thread it ENDED on
+    assert by_name["decode_dispatch"]["args"]["thread"] == "engine-worker"
+
+    # components -> stable track ids; one thread_name metadata event per
+    # component, labeled with the component
+    tids = {e["cat"]: e["tid"] for e in complete}
+    assert set(tids) == {"serving", "serving_engine"}
+    assert tids["serving"] != tids["serving_engine"]
+    assert by_name["prefill"]["tid"] == root_ev["tid"]
+    meta_by_tid = {e["tid"]: e["args"]["name"] for e in meta}
+    assert meta_by_tid[tids["serving"]] == "serving"
+    assert meta_by_tid[tids["serving_engine"]] == "serving_engine"
+
+    # nesting is temporal: the child's window sits inside the root's
+    assert root_ev["ts"] <= by_name["prefill"]["ts"]
+    assert (by_name["prefill"]["ts"] + by_name["prefill"]["dur"]
+            <= root_ev["ts"] + root_ev["dur"] + 1)  # 1us floor on dur
+
+    # attrs ride through args, JSON-serializable
+    assert root_ev["args"]["rid"] == 7
+    assert by_name["prefill"]["args"]["bucket"] == 32
+    assert by_name["decode_dispatch"]["args"]["step"] == 3
+
+
+def test_zero_duration_spans_get_visible_floor():
+    _, spans = _build_trace()
+    for s in spans:
+        s["dur_us"] = 0
+    doc = to_chrome_trace(spans)
+    assert all(
+        e["dur"] >= 1 for e in doc["traceEvents"] if e["ph"] == "X"
+    )
+
+
+def test_non_serializable_attrs_are_stringified():
+    _, spans = _build_trace()
+    spans[0]["attrs"] = {"obj": object(), "ok": 1.5, "none": None}
+    doc = to_chrome_trace(spans)
+    json.dumps(doc)  # must not raise
+    ev = next(e for e in doc["traceEvents"]
+              if e["ph"] == "X" and e["name"] == spans[0]["name"])
+    assert isinstance(ev["args"]["obj"], str)
+    assert ev["args"]["ok"] == 1.5
+    assert ev["args"]["none"] is None
+
+
+def test_write_trace_file_round_trips(tmp_path):
+    _, spans = _build_trace()
+    path = write_trace_file(spans, str(tmp_path / "sub" / "trace.json"))
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded == json.loads(json.dumps(to_chrome_trace(spans)))
+    assert loaded["displayTimeUnit"] == "ms"

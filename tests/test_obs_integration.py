@@ -389,3 +389,100 @@ def test_serving_http_request_joins_batcher_tree(setup, tracer):
             await asyncio.wait_for(task, 30)
 
     run(body())
+
+
+def test_serving_debug_traces_limit_and_since(setup, tracer):
+    """GET /debug/traces pagination: ?limit= caps the summary count
+    (keeping the newest), ?since= filters on start_us, `total` still
+    reports the full buffer population, and malformed values answer
+    400 — a long-running server never ships its whole ring per poll."""
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params = setup
+    engine = InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                             chunked_prefill=8)
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+
+    async def body():
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        for _ in range(100):
+            if server.bound_port:
+                break
+            await asyncio.sleep(0.05)
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as session:
+                for i in range(3):
+                    async with session.post(f"{base}/v1/generate", json={
+                        "prompt": _prompt(40 + i, 5, cfg), "max_new": 2,
+                    }) as resp:
+                        assert resp.status == 200
+                # traces complete on the engine thread: poll until the
+                # buffer holds all three request trees. (Every fetch is
+                # itself traced, so `total` keeps growing — assertions
+                # below avoid cross-fetch total equality.)
+                def n_posts(payload):
+                    return sum(
+                        1 for t in payload["traces"]
+                        if t["root"].startswith("POST")
+                    )
+
+                for _ in range(200):
+                    async with session.get(f"{base}/debug/traces") as resp:
+                        full = await resp.json()
+                    if n_posts(full) >= 3:
+                        break
+                    await asyncio.sleep(0.05)
+                assert n_posts(full) >= 3
+                assert full["total"] == len(full["traces"])
+                assert full["returned"] == len(full["traces"])
+
+                async with session.get(
+                    f"{base}/debug/traces?limit=1"
+                ) as resp:
+                    assert resp.status == 200
+                    page = await resp.json()
+                assert page["returned"] == len(page["traces"]) == 1
+                # total reports the buffer population, not the page size
+                assert page["total"] >= full["total"]
+                # newest-first: the limited page's entry is at least as
+                # new as everything the earlier full fetch returned
+                assert page["traces"][0]["start_us"] >= \
+                    full["traces"][0]["start_us"]
+
+                # since= on the middle trace's start: only newer ones
+                cutoff = full["traces"][1]["start_us"]
+                async with session.get(
+                    f"{base}/debug/traces?since={cutoff}"
+                ) as resp:
+                    newer = await resp.json()
+                assert all(
+                    t["start_us"] > cutoff for t in newer["traces"]
+                )
+                assert full["traces"][1]["trace_id"] not in [
+                    t["trace_id"] for t in newer["traces"]
+                ]
+
+                async with session.get(
+                    f"{base}/debug/traces?limit=0"
+                ) as resp:
+                    empty = await resp.json()
+                assert empty["traces"] == [] and empty["total"] >= 3
+
+                for bad in ("limit=x", "limit=-1", "since=nope"):
+                    async with session.get(
+                        f"{base}/debug/traces?{bad}"
+                    ) as resp:
+                        assert resp.status == 400
+
+                # the control-plane shares the same parser: covered by
+                # obs.http.parse_trace_query unit behavior above
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    run(body())
