@@ -68,7 +68,7 @@ san-test:
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs
+	bench-obs bench-kernels
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -124,6 +124,16 @@ bench-sched:
 bench-tp:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.tp_bench
 
+# CPU-runnable smoke: the unified ragged-paged attention kernel —
+# interpret-mode unified-vs-gather parity across decode/verify/prefill
+# x dense/paged, the autotuner sweep->persist->reload round trip (a
+# scratch tilings cache is written and re-resolved), and a tp=2
+# shard_map bitwise-identity check on the forced 8-device platform
+# (one JSON line with per-mode max_err, autotune_best_*_bk and
+# tp_kernel_bitwise).
+bench-kernels:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.kernel_bench
+
 # CPU-runnable microbench: the latency-attribution layer's two cost
 # claims — the disabled-path guard is nanoseconds (the whole hot-path
 # cost with attribution off) and the per-retired-request record path
@@ -139,7 +149,7 @@ clean:
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched bench-tp bench-obs clean watch
+	bench-sched bench-tp bench-obs bench-kernels clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

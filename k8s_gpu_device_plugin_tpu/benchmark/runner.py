@@ -344,6 +344,14 @@ def _flash_tune_result(workload: str, **kw) -> dict:
             bq, _, bk = best.partition("x")
             entries[f"{direction}:{seq}"] = (int(bq), int(bk))
     tuning_file = record_tuned_blocks(entries) if entries else ""
+    if entries:
+        # mirror into the per-generation store (ops/tunings.py — the
+        # unified kernel's cache): a sweep on THIS chip generation tunes
+        # every later run on the same generation, and can never mis-tune
+        # another (the legacy flat file has no such key)
+        from k8s_gpu_device_plugin_tpu.ops import tunings
+
+        tunings.record({f"flash:{k}": v for k, v in entries.items()})
     return {
         "workload": workload,
         "shape": list(r.shape),
@@ -441,6 +449,32 @@ def _run_decode_int4w() -> dict:
     jnp.int4 packed (if tokens/s lands at int8 parity instead of above
     it, it does not)."""
     return _decode_result("decode_int4w", weight_quant="int4")
+
+
+def _run_kernel_tune() -> dict:
+    """Block/grid autotune of the unified ragged-paged attention kernel
+    (ops/ragged_paged_attention.py) at the serving decode/verify/prefill
+    shapes; winners persist per device generation (ops/tunings.py) so
+    every later run on this chip generation dispatches on measured
+    tilings."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.kernel_tune import (
+        kernel_tune,
+    )
+
+    _require_accelerator()
+    r = kernel_tune()
+    return {
+        "workload": "kernel_tune",
+        "generation": r.generation,
+        "shape": list(r.shape),
+        "mode_ms": {
+            m: {k: round(v, 3) if isinstance(v, float) else v
+                for k, v in ms.items()}
+            for m, ms in r.mode_ms.items()
+        },
+        "best": r.best,
+        "tuning_file": r.tunings_path,
+    }
 
 
 def _run_serve() -> dict:
@@ -561,6 +595,11 @@ def _run_serve() -> dict:
         "tp_collective_overhead_pct": round(
             r.tp_collective_overhead_pct, 1
         ),
+        # kernel-vs-gather at the tp point (decode_attn ragged vs xla,
+        # same sharded batch): the unified ragged-paged kernel's win
+        # over the gather fallback as a tracked number
+        "decode_step_ms_kernel": round(r.decode_step_ms_kernel, 2),
+        "decode_step_ms_gather": round(r.decode_step_ms_gather, 2),
         "n_requests": r.n_requests,
         "n_slots": r.n_slots,
         "model": _model_dims(cfg),
@@ -658,6 +697,7 @@ WORKLOADS = {
     "breakdown_attn": _run_breakdown_attn,
     "flash_tune": _run_flash_tune,
     "flash_tune_long": _run_flash_tune_long,
+    "kernel_tune": _run_kernel_tune,
     "opt_tune": _run_opt_tune,
     "remat_tune": _run_remat_tune,
     "serve": _run_serve,

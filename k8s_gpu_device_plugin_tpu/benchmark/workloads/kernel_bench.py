@@ -1,0 +1,217 @@
+"""Unified-kernel microbench + autotuner smoke (CPU-runnable;
+``make bench-kernels``).
+
+Three claims the unified ragged-paged kernel stack makes, exercised on
+CPU so ``make ci`` catches a break before any hardware window does:
+
+- **parity**: the kernel (interpret mode) matches the XLA gather's
+  attention semantics across all three grid specializations — decode
+  (T=1), verify (T=gamma) and prefill-chunk — on dense AND paged
+  caches (max-abs error vs the f32 reference, asserted tight);
+- **autotuner round trip**: a tiny interpret-mode ``kernel_tune`` sweep
+  WRITES the per-device-generation tilings cache and the kernel's
+  block resolver RELOADS the winners on the next dispatch (asserted by
+  pointing the store at a scratch file, sweeping, clearing the
+  in-process cache and resolving again);
+- **tp routing**: the dispatcher keeps the kernel under a tp=2
+  shard_map with bitwise-identical output to the tp=1 kernel (the
+  forced 8-device CPU platform — the PR-8 bit-identity contract, now
+  WITH the kernel).
+
+Interpret-mode timings are not performance numbers (the kernel runs as
+a jax interpreter on CPU); they are reported so regressions in dispatch
+overhead are at least visible run-to-run on the same host.
+
+Prints one JSON line, like the host_overhead/paged_kv twins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+# the tp routing smoke needs devices to shard over; force the 8-device
+# CPU platform BEFORE jax initializes (the tp_bench pattern — a no-op
+# when the caller already forced a count)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+B, S, HQ, HKV, HD = 2, 128, 8, 4, 64
+
+
+def _kv():
+    import jax
+    import jax.numpy as jnp
+
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    k = jax.random.normal(kk, (B, S, HKV, HD), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, HKV, HD), jnp.bfloat16)
+    return kq, k, v
+
+
+def _gather_ref(q, k, v, base, scale, window=0):
+    import jax
+    import jax.numpy as jnp
+
+    b, t, hq, hd = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    s = k.shape[1]
+    qg = q.reshape(b, t, hkv, g, hd).astype(jnp.float32)
+    sc = jnp.einsum("btkgd,bskd->btkgs", qg, k.astype(jnp.float32)) * scale
+    q_pos = base[:, None, None, None, None] + jnp.arange(t)[
+        None, :, None, None, None]
+    k_pos = jnp.arange(s)[None, None, None, None, :]
+    keep = k_pos <= q_pos
+    if window > 0:
+        keep &= q_pos - k_pos < window
+    sc = jnp.where(keep, sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum(
+        "btkgs,bskd->btkgd", p, v.astype(jnp.float32)
+    ).reshape(b, t, hq, hd)
+
+
+def parity_bench() -> dict:
+    """Unified-vs-gather per mode (dense + paged), interpret mode: the
+    max-abs error vs the f32 reference and the (interpret) wall ms."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    Hq, Hkv, hd = HQ, HKV, HD
+    kq, k, v = _kv()
+    ps = 16
+    n_pages = B * (S // ps) + 1
+    kp = k.reshape(B * (S // ps), ps, Hkv, hd)
+    kp = jnp.concatenate([jnp.zeros((1, ps, Hkv, hd), k.dtype), kp])
+    vp = v.reshape(B * (S // ps), ps, Hkv, hd)
+    vp = jnp.concatenate([jnp.zeros((1, ps, Hkv, hd), v.dtype), vp])
+    table = jnp.arange(1, n_pages, dtype=jnp.int32).reshape(B, S // ps)
+
+    out = {}
+    for mode, t in (("decode", 1), ("verify", 4), ("prefill", 32)):
+        q = jax.random.normal(kq, (B, t, Hq, hd), jnp.bfloat16)
+        base = jnp.asarray([S // 3 - t, S - t], jnp.int32)
+        want = _gather_ref(q, k, v, base, hd ** -0.5)
+        for layout, pages in (("dense", None), ("paged", table)):
+            t0 = time.perf_counter()
+            got = ragged_paged_attention(
+                q, k if pages is None else kp,
+                v if pages is None else vp, base, pages,
+                scale=hd ** -0.5, block_k=32, interpret=True,
+            )
+            got.block_until_ready()
+            ms = (time.perf_counter() - t0) * 1000
+            err = float(np.max(np.abs(
+                np.asarray(got, np.float32) - np.asarray(want)
+            )))
+            assert err < 0.02, f"{mode}/{layout} parity broke: {err}"
+            out[f"{mode}_{layout}_max_err"] = round(err, 5)
+            out[f"{mode}_{layout}_interpret_ms"] = round(ms, 2)
+    return out
+
+
+def autotune_smoke() -> dict:
+    """Sweep -> persist -> reload: the acceptance loop of the tilings
+    cache, against a scratch file so the checkout's real cache (and any
+    hardware entries in it) is never touched."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.kernel_tune import (
+        kernel_tune,
+    )
+    from k8s_gpu_device_plugin_tpu.ops import tunings
+
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    os.unlink(path)  # the sweep must CREATE it
+    old = os.environ.get(tunings.TUNINGS_FILE_ENV)
+    os.environ[tunings.TUNINGS_FILE_ENV] = path
+    tunings.clear_cache()
+    try:
+        r = kernel_tune(
+            batch=2, seq=128, n_heads=8, n_kv_heads=4, head_dim=64,
+            blocks=(64, 32), repeats=1, iters=2, interpret=True,
+            prefill_t=32,
+        )
+        assert r.tunings_path == path, "sweep did not write the cache"
+        assert os.path.exists(path), "tilings cache file missing"
+        assert r.best["decode"] in (64, 32), r.best
+        # reload: a fresh in-process view must resolve the winner
+        tunings.clear_cache()
+        resolved = tunings.resolve("rpa:decode:hkv4:hd64", 128)
+        assert resolved == (r.best["decode"],), (resolved, r.best)
+        # nearest-smaller-seq fallback (the flash resolver's rule)
+        assert tunings.resolve("rpa:decode:hkv4:hd64", 512) == resolved
+        gen = r.generation
+    finally:
+        if old is None:
+            os.environ.pop(tunings.TUNINGS_FILE_ENV, None)
+        else:
+            os.environ[tunings.TUNINGS_FILE_ENV] = old
+        tunings.clear_cache()
+        if os.path.exists(path):
+            os.unlink(path)
+    return {
+        "autotune_generation": gen,
+        "autotune_best_decode_bk": r.best["decode"],
+        "autotune_best_prefill_bk": r.best["prefill"],
+        "autotune_cache_round_trip": 1,
+    }
+
+
+def tp_dispatch_smoke() -> dict:
+    """The dispatcher keeps the kernel under shard_map at tp=2 with
+    bitwise tp=1 output (needs the forced multi-device platform; skips
+    with a reason on a genuinely single-device host)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return {"tp_kernel_bitwise": -1}  # skip-with-signal, never silent
+    import jax.numpy as jnp
+
+    from k8s_gpu_device_plugin_tpu.ops.attention import (
+        serving_cache_attention,
+    )
+    from k8s_gpu_device_plugin_tpu.parallel.tp_serving import serving_mesh
+
+    kq, k, v = _kv()
+    q = jax.random.normal(kq, (B, 1, HQ, HD), jnp.bfloat16)
+    base = jnp.asarray([5, 100], jnp.int32)
+    one = serving_cache_attention(q, k, v, base, decode_attn="ragged")
+    mesh = serving_mesh(2, HKV)
+    with mesh:
+        two = jax.jit(
+            lambda *a: serving_cache_attention(*a, decode_attn="ragged",
+                                               tp=2)
+        )(q, k, v, base)
+    bitwise = bool(jnp.all(one == two))
+    assert bitwise, "tp=2 kernel diverged from tp=1"
+    return {"tp_kernel_bitwise": 1}
+
+
+def kernel_bench() -> dict:
+    out = {"workload": "kernel_bench"}
+    out.update(parity_bench())
+    out.update(autotune_smoke())
+    out.update(tp_dispatch_smoke())
+    return out
+
+
+def main() -> int:
+    print(json.dumps(kernel_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,147 @@
+"""Unified-kernel block/grid autotuner (the flash_tune methodology,
+extended to the ragged-paged kernel and persisted per device
+generation).
+
+``flash_tune`` sweeps the flash kernels' (block_q, block_k) space and
+persists winners so later runs pick them up. This workload does the
+same for the unified ragged-paged kernel (ops/ragged_paged_attention.py)
+— the serving decode/verify/prefill hot path — over its dense kv-block
+space, and writes winners into the PER-DEVICE-GENERATION tilings cache
+(ops/tunings.py) keyed like the roofline specs in device/topology.py:
+a sweep on a v5e tunes every later v5e run in the checkout and cannot
+mis-tune a v6e. Paged mode has no free block (the page IS the kv
+block), so the sweep covers the dense route; the paged route's win is
+the serve-bench ``decode_step_ms_kernel`` A/B's to report.
+
+Methodology matches flash_tune/matmul_mfu: the timed quantity is a
+jitted scalar whose fetch serializes the whole computation
+(relay-safe), scan-amortized with a carry that FEEDS the kernel input
+so LICM cannot hoist the kernel out of the loop, best-of-N.
+
+``interpret=True`` runs the same sweep through Pallas interpret mode on
+CPU — meaningless as a performance measurement, but it exercises the
+whole sweep/persist/reload path, which is what the CI smoke
+(``make bench-kernels``) asserts.
+
+Run: python -m k8s_gpu_device_plugin_tpu.benchmark.runner kernel_tune
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
+    _time_scalar_fn,
+)
+from k8s_gpu_device_plugin_tpu.ops import tunings
+from k8s_gpu_device_plugin_tpu.ops.kernel_support import fit_block
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    ragged_paged_attention,
+)
+
+#: per-mode query-window widths the sweep times (decode is the T=1 hot
+#: path; verify the speculative gamma window; prefill one chunk)
+MODE_T = {"decode": 1, "verify": 8, "prefill": 256}
+
+
+@dataclass(frozen=True)
+class KernelTuneResult:
+    generation: str       # tilings bucket the winners were recorded under
+    shape: tuple          # (B, S, Hq, Hkv, hd)
+    # mode -> {"<bk>": best-of-N ms | "error: <ExcName>"}
+    mode_ms: dict
+    best: dict            # mode -> winning block_k (0 = nothing timed)
+    tunings_path: str = ""  # "" when persist failed/disabled
+    recorded: dict = field(default_factory=dict)  # key -> [block_k]
+
+
+def kernel_tune(
+    batch: int = 8,
+    seq: int = 2048,
+    n_heads: int = 16,
+    n_kv_heads: int = 8,
+    head_dim: int = 128,
+    modes: tuple = ("decode", "verify", "prefill"),
+    blocks: tuple = (1024, 512, 256, 128, 64),
+    repeats: int = 5,
+    iters: int = 8,
+    interpret: bool = False,
+    persist: bool = True,
+    prefill_t: int = 0,  # 0 = MODE_T default, clamped to seq
+) -> KernelTuneResult:
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    k = jax.random.normal(kk, (batch, seq, n_kv_heads, head_dim),
+                          jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, seq, n_kv_heads, head_dim),
+                          jnp.bfloat16)
+    # ragged lengths spanning the cache: the realistic serving mix (an
+    # all-full batch would under-reward small blocks' DMA elision)
+    lengths = jnp.asarray(
+        [max(1, (i + 1) * seq // batch) for i in range(batch)], jnp.int32
+    )
+
+    mode_ms: dict[str, dict] = {}
+    best: dict[str, int] = {}
+    recorded: dict[str, list] = {}
+    for mode in modes:
+        t = MODE_T[mode]
+        if mode == "prefill":
+            t = min(prefill_t or t, seq)
+        q = jax.random.normal(kq, (batch, t, n_heads, head_dim),
+                              jnp.bfloat16)
+        base = jnp.maximum(lengths - t, 0)
+        ms: dict[str, object] = {}
+        for bk in blocks:
+            if fit_block(seq, bk) != bk:
+                continue  # not a clean tile at this seq: skip, not error
+
+            def scalar(q, k, v, base, _bk=bk, _t=t):
+                def body(c, _):
+                    qc = q + (c * 0).astype(q.dtype)  # defeat LICM
+                    o = ragged_paged_attention(
+                        qc, k, v, base, scale=head_dim ** -0.5,
+                        block_k=_bk, interpret=interpret,
+                    )
+                    return jnp.sum(o.astype(jnp.float32)) * 1e-9, None
+
+                c, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                    length=iters)
+                return c
+
+            label = str(bk)
+            # one rejected tiling (VMEM blow-up on the real backend)
+            # must not void the sweep — the flash_tune robustness rule
+            try:
+                ms[label] = _time_scalar_fn(
+                    jax.jit(scalar), (q, k, v, base), repeats
+                ) / iters * 1000
+            except Exception as e:  # noqa: BLE001 - sweep robustness
+                ms[label] = f"error: {type(e).__name__}"
+                print(f"kernel_tune: {mode} bk={bk} failed: {e}",
+                      file=sys.stderr)
+        mode_ms[mode] = ms
+        timed = {int(kk_) : v_ for kk_, v_ in ms.items()
+                 if isinstance(v_, float)}
+        best[mode] = min(timed, key=timed.get) if timed else 0
+        if best[mode]:
+            recorded[
+                f"rpa:{mode}:hkv{n_kv_heads}:hd{head_dim}:{seq}"
+            ] = [best[mode]]
+
+    path = ""
+    if persist and recorded:
+        path = tunings.record(recorded)
+        tunings.clear_cache()  # the very next dispatch resolves winners
+    return KernelTuneResult(
+        generation=tunings.device_generation(),
+        shape=(batch, seq, n_heads, n_kv_heads, head_dim),
+        mode_ms=mode_ms,
+        best=best,
+        tunings_path=path,
+        recorded=recorded,
+    )

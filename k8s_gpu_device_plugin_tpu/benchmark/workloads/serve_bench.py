@@ -197,6 +197,12 @@ class ServeBenchResult:
     kv_pages_peak_per_shard_tp: int = 0
     kv_shard_reserved_bytes_tp: int = 0
     tp_collective_overhead_pct: float = 0.0
+    # the kernel-vs-gather A/B at the tp sweep point: pure device step
+    # ms with decode_attn="ragged" (the unified ragged-paged kernel,
+    # shard_map-ed over the mesh) vs "xla" (the gather fallback the tp
+    # path used to be pinned to) — same sharded batch, same layout
+    decode_step_ms_kernel: float = 0.0
+    decode_step_ms_gather: float = 0.0
 
 
 class _PrefillRecorder:
@@ -599,9 +605,15 @@ def serve_bench(
     prompts = make_prompts()
 
     def make_batcher(depth: int, kv_layout: str = "dense",
-                     tp: int = 1, mfu=None) -> ContinuousBatcher:
+                     tp: int = 1, mfu=None,
+                     decode_attn: "str | None" = None) -> ContinuousBatcher:
+        from dataclasses import replace as _replace
+
+        bcfg = cfg if decode_attn is None else _replace(
+            cfg, decode_attn=decode_attn
+        )
         return ContinuousBatcher(
-            params, cfg, n_slots=n_slots, max_len=max_len,
+            params, bcfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
             pipeline_depth=depth, kv_layout=kv_layout,
             kv_page_size=kv_page_size if kv_layout == "paged" else None,
@@ -642,13 +654,16 @@ def serve_bench(
         return wall, step_ms, peak
 
     def device_only_ms(steps: int = 16, kv_layout: str = "dense",
-                       tp: int = 1) -> float:
+                       tp: int = 1,
+                       decode_attn: "str | None" = None) -> float:
         """Pure device compute per decode step: raw ``decode_step``
         dispatches over a primed full batch, NO host token processing.
         The batcher is discarded after (its host view desyncs). The tp
         arm dispatches under the mesh scope, so the timed steps include
-        exactly the collectives the serving loop pays."""
-        cb = make_batcher(0, kv_layout, tp)
+        exactly the collectives the serving loop pays — and, with
+        ``decode_attn`` set, the chosen attention backend (the
+        kernel-vs-gather A/B rides this knob)."""
+        cb = make_batcher(0, kv_layout, tp, decode_attn=decode_attn)
         # headroom so the device-side budget never deactivates a row
         # inside the timed window
         prime(cb, min(max_new + steps + 8, max_len - max(prompt_lens)))
@@ -922,6 +937,43 @@ def serve_bench(
                 device_ms if (decode_ab and tp_layout == "dense")
                 else device_only_ms(kv_layout=tp_layout)
             )
+            # kernel-vs-gather A/B AT the tp point: the same sharded
+            # batch stepped with decode_attn="ragged" (the unified
+            # Pallas kernel, shard_map-ed per KV head) vs "xla" (the
+            # gather fallback tp serving used to be stuck on) — the
+            # kernel win as a tracked number, not a claim. Gated on the
+            # static routing plan: when the bench model's geometry
+            # falls off the kernel's gates the "ragged" arm would just
+            # re-measure the gather and the near-equal pair would read
+            # as "kernel gives no win" — report zeros (with the reason
+            # on stderr) instead of a lie.
+            from k8s_gpu_device_plugin_tpu.ops.attention import (
+                attention_backend_plan,
+            )
+
+            k_plan = attention_backend_plan(
+                decode_attn="ragged", kv_layout=tp_layout,
+                max_len=max_len,
+                page_size=kv_page_size if tp_layout == "paged" else 0,
+                n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim, cache_quant=cfg.cache_quant,
+                tp=tp_degree,
+            )["decode"]
+            step_ms_kernel = step_ms_gather = 0.0
+            if k_plan["backend"] == "pallas":
+                step_ms_kernel = device_only_ms(
+                    kv_layout=tp_layout, tp=tp_degree,
+                    decode_attn="ragged",
+                )
+                step_ms_gather = device_only_ms(
+                    kv_layout=tp_layout, tp=tp_degree, decode_attn="xla"
+                )
+            else:
+                print(
+                    "serve_bench: kernel-vs-gather A/B skipped — "
+                    f"{k_plan['reason']}",
+                    file=sys.stderr,
+                )
             # one shard's static reservation, arithmetically (building a
             # probe batcher just to read kv_stats would re-shard the
             # whole weight tree and allocate a fourth KV pool): the
@@ -957,6 +1009,8 @@ def serve_bench(
                     max(0.0, dev_tp - dev_1) / dev_tp * 100.0
                     if dev_tp else 0.0
                 ),
+                "decode_step_ms_kernel": step_ms_kernel,
+                "decode_step_ms_gather": step_ms_gather,
             }
 
     total_new = n_requests * max_new  # eos disabled: every budget runs out

@@ -256,6 +256,37 @@ def wraparound_for(gen: TpuGeneration, bounds: tuple[int, ...]) -> tuple[bool, .
     return tuple(b % 4 == 0 for b in bounds)
 
 
+#: jax ``device_kind`` strings (libtpu's names) -> GENERATIONS key. The
+#: kernel-tilings cache (ops/tunings.py) keys its on-disk entries by
+#: generation exactly like the roofline/spec figures above — block/grid
+#: optima are a property of the chip generation (VMEM size, MXU/VPU
+#: ratios, HBM bandwidth), not of one host.
+_DEVICE_KIND_ALIASES = {
+    "tpuv4": "v4",
+    "tpuv4i": "v4",
+    "tpuv4lite": "v4",
+    "tpuv5": "v5p",
+    "tpuv5p": "v5p",
+    "tpuv5e": "v5e",
+    "tpuv5lite": "v5e",
+    "tpuv5litepod": "v5e",
+    "tpuv6e": "v6e",
+    "tpuv6lite": "v6e",
+    "tpuv6litepod": "v6e",
+}
+
+
+def generation_for_device_kind(kind: str) -> str | None:
+    """Map a jax ``device_kind`` string to a GENERATIONS key (None for
+    non-TPU kinds — callers fall back to the raw backend name, so CPU
+    interpret-mode tunings get their own cache bucket instead of
+    poisoning a TPU generation's)."""
+    k = re.sub(r"[^a-z0-9]", "", kind.lower())
+    if k in GENERATIONS:
+        return k
+    return _DEVICE_KIND_ALIASES.get(k)
+
+
 _TOPOLOGY_RE = re.compile(r"^(v\d+[a-z]*)-(\d+)$")
 _SHAPE_RE = re.compile(r"^(v\d+[a-z]*)-(\d+(?:x\d+)+)$")
 

@@ -165,6 +165,21 @@ class ServingMetrics:
             ["shard"],
             registry=registry,
         )
+        # Attention-backend routing (ops/attention.py's dispatcher):
+        # which backend each serving mode — decode / verify / prefill —
+        # routes through, as 1/0 per (mode, backend) pair. Fixed
+        # cardinality (3 modes x 2 backends); the signal whose absence
+        # made the PR-8 tp>1 kernel fallback silent: an alerting rule on
+        # decode_attn_backend{mode="decode",backend="xla"} == 1 with
+        # decode_attn=ragged configured catches the degradation.
+        self.decode_attn_backend = Gauge(
+            f"{prefix}_decode_attn_backend",
+            "Active attention backend per serving mode (1 = routed "
+            "there; pallas = the unified ragged-paged kernel, xla = "
+            "the gather fallback)",
+            ["mode", "backend"],
+            registry=registry,
+        )
         # Speculative decoding (models/spec_batching.py): rounds run,
         # tokens the draft proposed vs tokens the verify accepted (bonus
         # token included), and the per-slot-round acceptance-length
@@ -373,6 +388,7 @@ class ServingMetrics:
             self.kv_shard_reserved_bytes,
             self.kv_shard_pages_in_use,
             self.kv_shard_in_use_bytes,
+            self.decode_attn_backend,
             self.spec_rounds,
             self.spec_tokens_drafted,
             self.spec_tokens_accepted,
@@ -445,6 +461,19 @@ class ServingMetrics:
 
     def set_kv_reserved_bytes(self, nbytes: int) -> None:
         self.kv_reserved_bytes.set(nbytes)
+
+    def set_decode_attn_backend(self, plan: dict) -> None:
+        """Set the per-mode backend gauge from the batcher's startup
+        plan ({mode: {"backend": ..., "reason": ...}}); both backends
+        are written per mode (1 for the active one, 0 for the other) so
+        a backend FLIP is a visible 1->0 transition, not a vanished
+        series."""
+        for mode, d in plan.items():
+            active = d.get("backend", "xla")
+            for backend in ("pallas", "xla"):
+                self.decode_attn_backend.labels(mode, backend).set(
+                    1 if backend == active else 0
+                )
 
     def set_kv_shards(self, shards) -> None:
         """Per-shard KV residency under tensor-parallel serving: one

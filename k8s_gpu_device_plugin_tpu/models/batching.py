@@ -775,6 +775,48 @@ class ContinuousBatcher:
             if set_res is not None:
                 set_res(self.kv_stats()["reserved_bytes"])
             self._report_kv_gauges()
+        # Attention-backend visibility: the unified dispatcher's STATIC
+        # plan (ops/attention.attention_backend_plan) — which backend
+        # decode / verify / prefill route to and WHY. Logged at startup
+        # (an opted-in kernel falling back to the XLA gather used to be
+        # silent — the PR-8 tp>1 degradation), exported as the
+        # decode_attn_backend gauge, surfaced on /v1/health through
+        # attn_backend_stats().
+        from k8s_gpu_device_plugin_tpu.ops.attention import (
+            attention_backend_plan,
+        )
+
+        self.attn_plan = attention_backend_plan(
+            decode_attn=cfg.decode_attn, prefill_attn=cfg.prefill_attn,
+            kv_layout=cfg.kv_layout, max_len=max_len,
+            page_size=cfg.kv_page_size if cfg.kv_layout == "paged" else 0,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, cache_quant=cfg.cache_quant,
+            tp=cfg.tp, chunk=self.chunk,
+        )
+        log = get_logger()
+        for mode, plan in self.attn_plan.items():
+            wanted = (cfg.prefill_attn if mode == "prefill"
+                      else cfg.decode_attn)
+            # an explicit kernel request that fell back is a WARNING (the
+            # operator asked for speed they are not getting — the
+            # previously-silent degradation); routine routing is debug
+            emit = (
+                log.warning
+                if wanted == "ragged" and plan["backend"] != "pallas"
+                else log.debug
+            )
+            emit(
+                "attention backend: %s -> %s (%s)",
+                mode, plan["backend"], plan["reason"],
+                extra={"fields": {"mode": mode,
+                                  "backend": plan["backend"],
+                                  "reason": plan["reason"]}},
+            )
+        if metrics is not None:
+            set_attn = getattr(metrics, "set_decode_attn_backend", None)
+            if set_attn is not None:
+                set_attn(self.attn_plan)
         # cached (n_slots, 4) device array for the decode step; running-
         # set membership changes (admit/retire/cancel) invalidate it, so
         # steady-state decode pays no per-token host build + transfer
@@ -1684,6 +1726,14 @@ class ContinuousBatcher:
             "reserved_bytes": self.pool.n_pages * self.pool.page_size * tb,
             "in_use_bytes": cap_tokens * tb,
         })
+
+    def attn_backend_stats(self) -> dict:
+        """The static attention-backend plan for /v1/health — which of
+        decode / verify / prefill route to the Pallas kernel vs the XLA
+        gather, with the dispatch gate that decided it. A copy of the
+        startup plan (the plan itself never mutates; health serializers
+        may), so this is trivially safe cross-thread."""
+        return {m: dict(d) for m, d in self.attn_plan.items()}
 
     def _prefill_one_chunk(self) -> None:
         """Advance the oldest mid-prefill request by one chunk; on its

@@ -184,90 +184,39 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlin
     positions length..length+T-1). All-f32 softmax.
 
     With ``pages`` (B, n_slot_pages) the cache is a paged pool
-    (n_pages, page_size, Hkv, hd). T=1 with ``decode_attn="ragged"``
-    runs the paged Pallas kernel (ops/paged_attention.py: the DMA
-    indices go through the table, so HBM traffic scales with live
-    pages). Otherwise the XLA fallback GATHERS the slot's pages into the
-    same (B, S, Hkv, hd) view the dense layout stores directly and runs
-    the identical einsum — identical values in identical positions, so
-    the two layouts' outputs are bitwise equal (garbage rows differ but
-    sit behind exact-zero softmax weights in both)."""
+    (n_pages, page_size, Hkv, hd). The unified dispatcher
+    (ops/attention.serving_cache_attention) routes every opted-in shape
+    — decode T=1, the speculative verify window (the EXPLICIT ``verify``
+    flag, so a small prefill chunk can never ride the verify opt-in),
+    and prefill chunks under ``prefill_attn="ragged"`` — onto the
+    ragged-paged Pallas kernel, dense or paged, shard_map-ed over the
+    serving mesh's KV-head axis at tp>1 (each shard's heads bitwise the
+    tp=1 kernel's). Everything else falls through to the XLA path: the
+    paged branch GATHERS the slot's pages into the same (B, S, Hkv, hd)
+    view the dense layout stores directly and runs the identical einsum
+    — identical values in identical positions, so the two layouts'
+    gather outputs are bitwise equal (garbage rows differ but sit
+    behind exact-zero softmax weights in both)."""
     b, t, hq, hd = q.shape
-    # Tensor-parallel serving runs the XLA paths only: a pallas_call is
-    # an opaque custom call the SPMD partitioner cannot shard, so under
-    # tp>1 it would force the head-sharded cache replicated — undoing
-    # the KV win the sharding exists for. The XLA gather/einsum below is
-    # head-parallel and bitwise equal to the kernels' contract anyway;
-    # a tp-aware kernel (shard_map over the head axis) is future work.
-    kernels_ok = cfg.tp == 1
+    if cfg.decode_attn == "ragged" or cfg.prefill_attn == "ragged":
+        from k8s_gpu_device_plugin_tpu.ops.attention import (
+            serving_cache_attention,
+        )
+
+        out = serving_cache_attention(
+            q, k_cache, v_cache, length, pages=pages, verify=verify,
+            decode_attn=cfg.decode_attn, prefill_attn=cfg.prefill_attn,
+            window=cfg.sliding_window, tp=cfg.tp,
+            quantized=k_scale is not None,
+        )
+        if out is not None:
+            return out
     if pages is not None:
-        if (t == 1 and k_scale is None and cfg.decode_attn == "ragged"
-                and kernels_ok):
-            from k8s_gpu_device_plugin_tpu.ops import paged_attention
-
-            interpret = jax.default_backend() != "tpu"
-            if paged_attention.supports(
-                q, k_cache, pages, require_pltpu=not interpret
-            ):
-                lens = (
-                    jnp.full((b,), length, jnp.int32)
-                    if jnp.ndim(length) == 0
-                    else length.astype(jnp.int32)
-                ) + 1
-                return paged_attention.paged_decode_attention(
-                    q, k_cache, v_cache, pages, lens, scale=hd ** -0.5,
-                    window=cfg.sliding_window, interpret=interpret,
-                )
-        elif (verify and t > 1 and k_scale is None
-              and cfg.decode_attn == "ragged" and kernels_ok):
-            # the speculative verify window: T=gamma queries per slot at
-            # consecutive positions, page-table-routed DMA (the verify
-            # variant of the ragged kernel). Gated on the EXPLICIT
-            # ``verify`` flag, not just the shape: a small prefill chunk
-            # (t <= 16) would pass supports_verify too, and routing it
-            # through the flash kernel would break the dense-vs-paged
-            # bit-identity the gather below preserves.
-            from k8s_gpu_device_plugin_tpu.ops import paged_attention
-
-            interpret = jax.default_backend() != "tpu"
-            if paged_attention.supports_verify(
-                q, k_cache, pages, require_pltpu=not interpret
-            ):
-                bases = (
-                    jnp.full((b,), length, jnp.int32)
-                    if jnp.ndim(length) == 0
-                    else length.astype(jnp.int32)
-                )
-                return paged_attention.paged_verify_attention(
-                    q, k_cache, v_cache, pages, bases, scale=hd ** -0.5,
-                    window=cfg.sliding_window, interpret=interpret,
-                )
         k_cache = k_cache[pages].reshape(b, -1, *k_cache.shape[-2:])
         v_cache = v_cache[pages].reshape(b, -1, *v_cache.shape[-2:])
         pages = None  # below here the gathered view IS the dense cache
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
-    if (t == 1 and k_scale is None and cfg.decode_attn == "ragged"
-            and kernels_ok):
-        # Pallas ragged decode: stream only each row's live cache prefix
-        # (ops/ragged_decode.py); opt-in until a hardware window confirms
-        # the win. Live rows are positions <= length (the current token's
-        # row was just written at index `length`), hence the +1.
-        from k8s_gpu_device_plugin_tpu.ops import ragged_decode
-
-        interpret = jax.default_backend() != "tpu"
-        # interpret mode relaxes only the TPU-build check; the SHAPE
-        # gates still apply (unsupported shapes fall back to XLA)
-        if ragged_decode.supports(q, k_cache, require_pltpu=not interpret):
-            lens = (
-                jnp.full((b,), length, jnp.int32)
-                if jnp.ndim(length) == 0
-                else length.astype(jnp.int32)
-            ) + 1
-            return ragged_decode.ragged_decode_attention(
-                q, k_cache, v_cache, lens, scale=hd ** -0.5,
-                window=cfg.sliding_window, interpret=interpret,
-            )
     # bf16 operands + f32 accumulation (MXU native rate); the cache is
     # never upcast in HBM — decode is bandwidth-bound. int8 caches keep
     # the int8 arrays as the dot operands (a bare convert fuses into the

@@ -95,10 +95,19 @@ class LlamaConfig:
     remat_policy: str = "save_dots_attn"
     attn_impl: str = "auto"  # auto | full | ring | ulysses
     # decode-time cached attention: "auto"/"xla" = the fused XLA einsum
-    # path; "ragged" opts into the Pallas kernel that streams only live
-    # cache rows (ops/ragged_decode.py; bf16 caches, T=1) — flip the
-    # default once a hardware window confirms the win
+    # path; "ragged" opts decode (T=1) AND the speculative verify window
+    # onto the unified ragged-paged Pallas kernel
+    # (ops/ragged_paged_attention.py; bf16 caches; shard_map-ed per KV
+    # head under tp>1) — flip the default once a hardware window
+    # confirms the win
     decode_attn: str = "auto"
+    # prefill-chunk cached attention: "ragged" routes chunk windows
+    # (T <= MAX_PREFILL_T) through the SAME unified kernel. A separate
+    # knob because it changes prefill's low-bit numerics profile (online
+    # softmax vs the gather's plain softmax — different accumulation
+    # order, same masked positions); decode/verify keep their own
+    # opt-in unchanged
+    prefill_attn: str = "auto"
     # "int8" runs the block projection/MLP matmuls on the MXU's double-rate
     # int8 path (ops/quant.py: quantized fwd, bf16 bwd); "none" = pure bf16.
     quant: str = "none"
@@ -159,6 +168,11 @@ class LlamaConfig:
             raise ValueError(
                 f"decode_attn must be 'auto', 'xla' or 'ragged', got "
                 f"{self.decode_attn!r}"
+            )
+        if self.prefill_attn not in ("auto", "xla", "ragged"):
+            raise ValueError(
+                f"prefill_attn must be 'auto', 'xla' or 'ragged', got "
+                f"{self.prefill_attn!r}"
             )
         if self.remat_policy not in (
             "save_dots_attn", "save_dots", "save_nothing"

@@ -1,13 +1,32 @@
-"""Single-device attention: XLA reference now, Pallas flash kernel on TPU.
+"""Attention dispatch: the one routing seam for every attention shape.
 
-``mha_reference`` is the numerics oracle (f32 softmax, causal masking, GQA).
-``attention`` dispatches to the Pallas TPU flash-attention kernel
-(ops/flash_attention.py) when running on TPU with shapes it supports, else
-falls back to the reference — XLA's fusion already keeps the fallback
-respectable; the kernel exists to control VMEM blocking on long sequences.
+Two dispatchers live here:
+
+- :func:`attention` — the full-sequence (training / no-cache) entry:
+  Pallas flash kernel on TPU when ``supports()`` says the shapes are
+  kernel-friendly, else the ``mha_reference`` oracle (f32 softmax,
+  causal masking, GQA).
+- :func:`serving_cache_attention` — the SERVING cache entry every
+  ``models/generate._cached_attention`` call goes through: routes
+  decode (T=1), speculative verify (2..16) and prefill-chunk windows
+  onto the unified ragged-paged kernel
+  (ops/ragged_paged_attention.py), dense or paged, and — under
+  tensor-parallel serving — wraps the kernel in ``shard_map`` over the
+  serving mesh's KV-head axis so every shard keeps the kernel (a bare
+  ``pallas_call`` is an opaque custom call the SPMD partitioner would
+  force replicated, which is exactly the tp>1 fallback this dispatcher
+  retires). Returns None for any shape/config the kernel does not
+  cover; the caller's XLA gather is the always-correct fallback.
+
+:func:`attention_backend_plan` is the STATIC twin of the serving
+dispatcher — the same gates evaluated from config facts alone, so the
+batcher can report (log + gauge + /v1/health) which backend each mode
+will take at startup instead of degrading silently.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -78,3 +97,197 @@ def attention(
         except ImportError:
             pass
     return mha_reference(q, k, v, causal=causal, scale=scale, window=window)
+
+
+# --- the serving cache dispatcher ------------------------------------------
+
+
+def _route_mode(t: int, verify: bool) -> str:
+    return "decode" if t == 1 else ("verify" if verify else "prefill")
+
+
+def _mode_opted_in(mode: str, decode_attn: str, prefill_attn: str) -> bool:
+    """decode_attn='ragged' opts decode AND verify onto the kernel (the
+    pre-unification contract); prefill_attn='ragged' opts the chunk
+    windows in separately — prefill numerics move from the plain-softmax
+    gather to online-softmax accumulation, a changed (not degraded)
+    low-bit profile operators choose explicitly."""
+    if mode == "prefill":
+        return prefill_attn == "ragged"
+    return decode_attn == "ragged"
+
+
+def serving_cache_attention(  # graftlint: hot-path=traced
+    q: jax.Array,              # (B, T, Hq, hd)
+    k_cache: jax.Array,        # dense (B, S, Hkv, hd) | paged pool
+    v_cache: jax.Array,
+    length,                    # scalar or (B,) int32: first-query position
+    pages: "jax.Array | None" = None,   # (B, n_slot_pages) int32
+    verify: bool = False,
+    decode_attn: str = "auto",
+    prefill_attn: str = "auto",
+    window: int = 0,
+    tp: int = 1,
+    quantized: bool = False,
+) -> "jax.Array | None":
+    """Route one serving cache-attention call onto the unified kernel;
+    None = the caller runs its XLA gather (bitwise the pre-kernel path).
+
+    ``length`` is the position of the window's FIRST query — the
+    serving convention everywhere (_cached_attention's write position):
+    decode's single query sits at ``length``, verify/prefill rows at
+    ``length + r``. Traced inside the serving jits (registered as a
+    traced hot path: everything built here is a trace-time constant,
+    never a per-step transfer).
+
+    Under tp>1 the kernel runs per-shard via ``shard_map`` over the
+    ambient serving mesh: q/k/v are already head-sharded by the PR-8
+    recipe, attention never crosses a KV head, so each shard's heads
+    are bitwise the tp=1 kernel's — kernel speed without touching the
+    bit-identity pin. No ambient mesh (a tp>1 config traced outside the
+    batcher's dispatch scope) falls back like any other unsupported
+    case.
+    """
+    from k8s_gpu_device_plugin_tpu.ops import ragged_paged_attention as rpa
+
+    b, t, hq, hd = q.shape
+    if quantized:
+        return None  # bf16 caches only: scale planes stay on the gather
+    mode = _route_mode(t, verify)
+    if not _mode_opted_in(mode, decode_attn, prefill_attn):
+        return None
+    if mode == "verify" and not (2 <= t <= rpa.MAX_VERIFY_T):
+        return None
+    from k8s_gpu_device_plugin_tpu.ops.kernel_support import interpret_mode
+
+    interpret = interpret_mode()
+    if not rpa.supports(q, k_cache, pages, require_pltpu=not interpret):
+        return None
+    base = (
+        jnp.full((b,), length, jnp.int32) if jnp.ndim(length) == 0
+        else length.astype(jnp.int32)
+    )
+    # Resolve the tuned dense kv block HERE, from GLOBAL shapes and the
+    # TRUE mode: inside a tp shard_map the kernel would see the
+    # per-shard KV-head count (a different tunings key than the sweep
+    # recorded) and the T-inferred mode cannot tell a short prefill
+    # chunk from a verify window — the dispatcher knows both.
+    block_k = 0
+    if pages is None:
+        from k8s_gpu_device_plugin_tpu.ops import tunings
+
+        tuned = tunings.resolve(
+            f"rpa:{mode}:hkv{k_cache.shape[2]}:hd{hd}", k_cache.shape[1]
+        )
+        block_k = tuned[0] if tuned else rpa.DEFAULT_BLOCK_K
+    call = partial(
+        rpa.ragged_paged_attention,
+        scale=hd ** -0.5, window=window, block_k=block_k,
+        interpret=interpret,
+    )
+    if tp <= 1:
+        return call(q, k_cache, v_cache, base, pages)
+
+    # --- tensor-parallel: shard_map over the KV-head axis ---
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_gpu_device_plugin_tpu.parallel.mesh import AXIS_TP
+    from k8s_gpu_device_plugin_tpu.parallel.tp_serving import ambient_mesh
+
+    mesh = ambient_mesh()
+    if mesh is None or mesh.shape.get(AXIS_TP, 0) != tp:
+        return None
+    hkv = k_cache.shape[2]
+    if hq % tp or hkv % tp:
+        return None  # the mesh rule guarantees this; belt for odd heads
+    heads = P(None, None, AXIS_TP, None)  # q/kv/out all carry Hkv 3rd-last
+    if pages is None:
+        sharded = shard_map(
+            lambda sq, sk, sv, sb: call(sq, sk, sv, sb),
+            mesh=mesh,
+            in_specs=(heads, heads, heads, P()),
+            out_specs=heads,
+            check_rep=False,
+        )
+        return sharded(q, k_cache, v_cache, base)
+    sharded = shard_map(
+        lambda sq, sk, sv, sb, sp: call(sq, sk, sv, sb, sp),
+        mesh=mesh,
+        in_specs=(heads, heads, heads, P(), P()),
+        out_specs=heads,
+        check_rep=False,
+    )
+    return sharded(q, k_cache, v_cache, base, pages)
+
+
+def attention_backend_plan(
+    *,
+    decode_attn: str = "auto",
+    prefill_attn: str = "auto",
+    kv_layout: str = "dense",
+    max_len: int = 0,
+    page_size: int = 0,
+    n_heads: int = 0,
+    n_kv_heads: int = 0,
+    head_dim: int = 0,
+    cache_quant: str = "none",
+    tp: int = 1,
+    chunk: int = 0,
+) -> dict:
+    """The dispatcher's gates, evaluated STATICALLY per serving mode —
+    {"decode"|"verify"|"prefill": {"backend": "pallas"|"xla",
+    "reason": ...}} — so a server can say at startup which backend each
+    mode will route to and why, instead of the tp>1 (or odd-geometry)
+    degradation staying silent. The reasons mirror the dispatch gates
+    one-for-one; a shape this plan calls "pallas" can still fall back
+    per-call on constraints only visible at trace time (the plan is a
+    startup report, the dispatcher is the authority)."""
+    from k8s_gpu_device_plugin_tpu.ops import ragged_paged_attention as rpa
+    from k8s_gpu_device_plugin_tpu.ops.kernel_support import (
+        fit_block,
+        gqa_ok,
+        interpret_mode,
+        kernels_available,
+        lane_aligned,
+        sublane_ok,
+    )
+
+    def gate(mode: str) -> dict:
+        want = (prefill_attn if mode == "prefill" else decode_attn)
+        knob = "prefill_attn" if mode == "prefill" else "decode_attn"
+        if want != "ragged":
+            return {"backend": "xla", "reason":
+                    f"{knob}={want!r} (opt in with {knob}='ragged')"}
+        if cache_quant != "none":
+            return {"backend": "xla", "reason":
+                    f"cache_quant={cache_quant!r}: the kernel is "
+                    "bf16-only (scale planes stay on the gather)"}
+        if not kernels_available(require_pltpu=not interpret_mode()):
+            return {"backend": "xla", "reason":
+                    "no pallas TPU support in this jax build"}
+        if not lane_aligned(head_dim):
+            return {"backend": "xla", "reason":
+                    f"head_dim={head_dim} not lane-aligned (64/128)"}
+        if not gqa_ok(n_heads, n_kv_heads):
+            return {"backend": "xla", "reason":
+                    f"n_heads={n_heads} not a multiple of "
+                    f"n_kv_heads={n_kv_heads}"}
+        if kv_layout == "paged":
+            if not sublane_ok(page_size):
+                return {"backend": "xla", "reason":
+                        f"kv_page_size={page_size} not sublane-aligned "
+                        "(multiple of 8)"}
+        elif max_len > 0 and fit_block(max_len, max_len) is None:
+            return {"backend": "xla", "reason":
+                    f"no sublane-aligned block divides max_len={max_len}"}
+        if mode == "prefill" and chunk > rpa.MAX_PREFILL_T:
+            return {"backend": "xla", "reason":
+                    f"chunked_prefill={chunk} exceeds the kernel's "
+                    f"prefill window (MAX_PREFILL_T={rpa.MAX_PREFILL_T})"}
+        reason = "pallas ragged-paged kernel"
+        if tp > 1:
+            reason += f" (shard_map over the tp={tp} serving mesh)"
+        return {"backend": "pallas", "reason": reason}
+
+    return {m: gate(m) for m in ("decode", "verify", "prefill")}

@@ -35,12 +35,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu import fails on builds without TPU support
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    _HAS_PLTPU = False
+from k8s_gpu_device_plugin_tpu.ops.kernel_support import (
+    HAS_PLTPU as _HAS_PLTPU,
+    pltpu,
+)
 
 # Tuned on v5e (scan-amortized timing, S=2048 fwd): (1024, 1024) sustains
 # ~31 TF/s vs ~17 at (128, 512); VMEM at (1024, 1024, d=128) is ~6MB of
@@ -93,7 +91,15 @@ def _tuned_blocks() -> dict:
 def _resolve_blocks(direction: str, s: int) -> tuple[int, int] | None:
     """(bq, bk) measured for this direction at this exact seq len, else
     the nearest measured seq <= s (tilings grow with S; a shorter-seq
-    winner is a safe under-estimate), else None."""
+    winner is a safe under-estimate), else None. The per-device-
+    generation store (ops/tunings.py — shared with the unified
+    ragged-paged kernel's autotuner) outranks the legacy flat flash
+    file: a generation-keyed entry can never mis-tune another chip."""
+    from k8s_gpu_device_plugin_tpu.ops import tunings
+
+    gen_tuned = tunings.resolve(f"flash:{direction}", s)
+    if gen_tuned is not None and len(gen_tuned) == 2:
+        return (int(gen_tuned[0]), int(gen_tuned[1]))
     tuned = _tuned_blocks()
     exact = tuned.get(f"{direction}:{s}")
     if exact is not None:

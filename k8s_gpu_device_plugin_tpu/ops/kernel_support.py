@@ -1,0 +1,77 @@
+"""Shared Pallas-kernel scaffolding: the supports()/interpret pattern.
+
+Every serving kernel in ``ops/`` used to carry its own copy of the same
+three pieces of plumbing — a guarded ``pallas.tpu`` import, a
+``supports()`` shape gate with a ``require_pltpu`` escape hatch for
+interpret-mode tests, and the "interpret on CPU" default. Three copies
+drifted three ways (the ragged kernel's block fitter, the paged
+kernel's sublane check, flash's own ``_HAS_PLTPU``); this module is the
+ONE place the pattern lives, and :mod:`ops.ragged_paged_attention` (the
+unified kernel the dispatcher in ``ops/attention.py`` routes to) is its
+only production consumer — the legacy per-kernel modules delegate here.
+
+The gates themselves (lane-aligned head dims, sublane-aligned kv
+blocks, GQA divisibility) are facts about the TPU memory tiling, not
+about any one kernel, which is why they belong in a shared module: see
+the tiling-constraint table in the Pallas TPU guide (min tile is
+(sublane, 128); head_dim is the lane axis, the kv block length the
+sublane axis).
+"""
+
+from __future__ import annotations
+
+try:  # pltpu import fails on builds without TPU support
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    HAS_PLTPU = False
+
+import jax
+
+#: head dims the kernels tile cleanly: the head dim is the LANE axis of
+#: every q/k/v block, so it must fill whole 128-lanes (64 works via the
+#: packed sublane trick the mosaic lowering applies)
+LANE_ALIGNED_HEAD_DIMS = (64, 128)
+
+#: the sublane quantum: kv block lengths (and page sizes — the page IS
+#: the kv block in the paged layout) must be multiples of this
+SUBLANE = 8
+
+
+def interpret_mode() -> bool:
+    """True when the kernels should run in Pallas interpret mode (any
+    non-TPU backend — the CPU test suite runs every kernel this way)."""
+    return jax.default_backend() != "tpu"
+
+
+def kernels_available(require_pltpu: bool = True) -> bool:
+    """The build gate: ``require_pltpu=False`` relaxes ONLY this check
+    (interpret mode still needs every shape constraint to hold)."""
+    return HAS_PLTPU or not require_pltpu
+
+
+def lane_aligned(head_dim: int, hd_ok=LANE_ALIGNED_HEAD_DIMS) -> bool:
+    return head_dim in hd_ok
+
+
+def gqa_ok(n_q_heads: int, n_kv_heads: int) -> bool:
+    """q heads fold onto kv heads in whole groups (the no-expansion
+    GQA contract every kernel and the XLA gather share)."""
+    return n_kv_heads > 0 and n_q_heads % n_kv_heads == 0
+
+
+def sublane_ok(block: int) -> bool:
+    return block > 0 and block % SUBLANE == 0
+
+
+def fit_block(s: int, want: int) -> int | None:
+    """Largest sublane-aligned kv block <= ``want`` dividing the cache
+    length ``s`` (None if even SUBLANE does not divide — the kernel
+    cannot tile that cache). The one block fitter, shared by the
+    unified kernel's dense mode and the tunings resolver."""
+    for bk in (want, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if 0 < bk <= want and s % bk == 0 and bk % SUBLANE == 0:
+            return bk
+    return None

@@ -1,88 +1,64 @@
-"""Paged decode attention: the ragged Pallas kernel reading through a
-page table.
+"""Paged decode + multi-query verify: the page-table faces of the
+unified kernel.
 
-``ops/ragged_decode.py`` makes the dense serving cache's decode read
-ragged — HBM traffic scales with each slot's live prefix instead of
-``B * max_len``. The paged KV layout (models/batching.py) goes further:
-physical rows live in a shared ``(n_pages, page_size, Hkv, hd)`` pool
-and each slot's virtual positions map onto pages through a per-slot
-int32 table, so HBM RESIDENCY also scales with live tokens and prefix
-reuse is page aliasing. This kernel is the read side of that layout
-(the direction of "Ragged Paged Attention", PAPERS.md): the grid is
-(B, n_slot_pages) with one kv block per PAGE, the page table and the
-per-slot lengths ride as scalar prefetch, and the kv BlockSpec's index
-map resolves grid cell (b, j) to physical page ``table[b, j]`` —
-clamped into the row's live span so out-of-range cells re-map to a page
-that is loaded anyway and Pallas elides the duplicate DMA.
-
-The T=1 kernel BODY is ``ragged_decode._kernel`` unchanged
-(online-softmax flash accumulation, block size = page_size): masking
-only needs each block's virtual position, which is ``j * page_size`` in
-both layouts. Only the DMA routing differs — exactly the page-table
-indirection the layout adds.
-
-The **verify variant** (:func:`paged_verify_attention`) generalizes the
-body to a small multi-query window per slot — the speculative batcher's
-round scores ``gamma`` draft tokens in one target forward, so each slot
-carries T=gamma queries at consecutive positions ``base..base+T-1``
-with a causal stagger (query t sees keys <= base+t). The grid, DMA
-routing and scalar-prefetch shape are the T=1 kernel's; only the mask
-gains a per-query position row and the accumulators a T axis. This is
-exactly the multi-token shape the TPU paged-kernel literature verifies
-through page tables (arXiv:2604.15464); the XLA gather fallback in
-``models/generate._cached_attention`` stays the bit-identical
-reference on CPU.
-
-bf16 caches, GQA; same ``supports()``/interpret-mode pattern as the
-ragged kernel, so the CPU test suite runs it in interpret mode and the
-serving integration stays behind ``LlamaConfig(decode_attn="ragged")``.
+Both entry points here are grid specializations of the unified
+ragged-paged kernel (ops/ragged_paged_attention.py): ``T=1`` through
+the page-table DMA route is paged decode, ``2 <= T <= MAX_VERIFY_T`` is
+the speculative verify window (per-query causal stagger — query t of
+slot b sits at ``base[b] + t`` and keeps keys ``<= base + t``, the
+exact mask the dense verify einsum applies, so acceptance decisions
+cannot drift between layouts). The bodies that used to live here are
+gone; outputs are bitwise the old kernels' (pinned in
+tests/test_unified_attention.py). The serving path dispatches through
+``ops/attention.serving_cache_attention``; this module remains the
+op-level surface the speculative tests and direct callers use.
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-from k8s_gpu_device_plugin_tpu.ops.ragged_decode import (
-    _HAS_PLTPU,
-    _first_block,
-    _kernel,
-    _last_block,
+from k8s_gpu_device_plugin_tpu.ops.kernel_support import (
+    HAS_PLTPU as _HAS_PLTPU,  # noqa: F401  (legacy import surface)
 )
-
-if _HAS_PLTPU:  # pragma: no branch
-    from jax.experimental.pallas import tpu as pltpu
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    MAX_VERIFY_T,
+    ragged_paged_attention,
+)
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    supports as _rpa_supports,
+)
 
 
 def supports(
     q: jax.Array, k_pool: jax.Array, pages: jax.Array, hd_ok=(64, 128),
     require_pltpu: bool = True,
 ) -> bool:
-    """Shapes the kernel tiles cleanly: T==1 GQA, a lane-aligned head
-    dim, and a sublane-aligned page size (the page IS the kv block, so
-    it must be a clean VMEM tile). ``require_pltpu=False`` relaxes only
-    the TPU-build check (interpret mode still needs every SHAPE
-    constraint to hold)."""
-    if require_pltpu and not _HAS_PLTPU:
-        return False
+    """Shape gate for paged decode: T==1 GQA, a lane-aligned head dim,
+    and a sublane-aligned page size (the page IS the kv block)."""
     if q.ndim != 4 or q.shape[1] != 1:
         return False
-    b, _, hq, hd = q.shape
-    ps = k_pool.shape[1]
-    return (
-        hd in hd_ok
-        and hq % k_pool.shape[2] == 0
-        and ps % 8 == 0
-        and pages.shape[0] == b
-    )
+    if q.shape[3] not in hd_ok:
+        return False
+    return _rpa_supports(q, k_pool, pages, require_pltpu=require_pltpu,
+                         max_t=1)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("scale", "window", "interpret")
-)
+def supports_verify(
+    q: jax.Array, k_pool: jax.Array, pages: jax.Array, hd_ok=(64, 128),
+    require_pltpu: bool = True,
+) -> bool:
+    """Shape gate for the verify window: 2 <= T <= MAX_VERIFY_T over the
+    same clean tiles the T=1 kernel needs."""
+    if q.ndim != 4 or not (2 <= q.shape[1] <= MAX_VERIFY_T):
+        return False
+    if q.shape[3] not in hd_ok:
+        return False
+    return _rpa_supports(q, k_pool, pages, require_pltpu=require_pltpu,
+                         max_t=MAX_VERIFY_T)
+
+
 def paged_decode_attention(
     q: jax.Array,          # (B, 1, Hq, hd)
     k_pool: jax.Array,     # (n_pages, page_size, Hkv, hd) bf16
@@ -93,167 +69,15 @@ def paged_decode_attention(
     window: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
-    """(B, 1, Hq, hd) decode attention gathering pages through the table."""
-    b, t, hq, hd = q.shape
-    assert t == 1, "paged decode attention is a T=1 kernel"
-    ps = k_pool.shape[1]
-    hkv = k_pool.shape[2]
-    n_slot_pages = pages.shape[1]
-    lengths = lengths.astype(jnp.int32)
-    pages = pages.astype(jnp.int32)
-    group = hq // hkv
-
-    def kv_map(bi, j, lens, table):
-        # clamp into the live span FIRST (dead grid cells re-map to a
-        # live page -> consecutive identical indices elide the DMA),
-        # then resolve virtual page j to its physical pool page
-        lo = _first_block(lens[bi], window, ps)
-        hi = _last_block(lens[bi], ps)
-        return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, n_slot_pages),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, hq, hd), lambda bi, j, lens, table: (bi, 0, 0, 0)
-            ),
-            pl.BlockSpec((1, ps, hkv, hd), kv_map),
-            pl.BlockSpec((1, ps, hkv, hd), kv_map),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, hq, hd), lambda bi, j, lens, table: (bi, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((hkv, group, 1), jnp.float32),   # m
-            pltpu.VMEM((hkv, group, 1), jnp.float32),   # l
-            pltpu.VMEM((hkv, group, hd), jnp.float32),  # acc
-        ],
-    )
-
-    def kernel(lens_ref, table_ref, *refs):
-        # the table participates in DMA routing only; the masking body is
-        # the ragged kernel's, with page_size as the block size
-        _kernel(lens_ref, *refs, bk=ps, hq=hq, hkv=hkv, hd=hd,
-                scale=scale, window=window)
-
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hq, hd), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(lengths, pages, q, k_pool, v_pool)
-    return out[:, None]
-
-
-# --- the multi-query verify variant (speculative decoding) ------------------
-
-_NEG_BIG = -1e30
-#: widest verify window the kernel accepts: the T queries' accumulators
-#: all live in VMEM scratch at once, and a speculative gamma is small by
-#: construction (past ~8 the acceptance tail pays for itself) — larger
-#: windows (prefill chunks) stay on the XLA gather path
-MAX_VERIFY_T = 16
-
-
-def supports_verify(
-    q: jax.Array, k_pool: jax.Array, pages: jax.Array, hd_ok=(64, 128),
-    require_pltpu: bool = True,
-) -> bool:
-    """Shape gate for the verify kernel: a small multi-query window
-    (2 <= T <= MAX_VERIFY_T) over the same clean tiles the T=1 kernel
-    needs. ``require_pltpu=False`` relaxes only the TPU-build check."""
-    if require_pltpu and not _HAS_PLTPU:
-        return False
-    if q.ndim != 4 or not (2 <= q.shape[1] <= MAX_VERIFY_T):
-        return False
-    b, _, hq, hd = q.shape
-    ps = k_pool.shape[1]
-    return (
-        hd in hd_ok
-        and hq % k_pool.shape[2] == 0
-        and ps % 8 == 0
-        and pages.shape[0] == b
+    """(B, 1, Hq, hd) decode attention gathering pages through the
+    table — the unified kernel at T=1, ``base = lengths - 1``."""
+    assert q.shape[1] == 1, "paged decode attention is a T=1 kernel"
+    return ragged_paged_attention(
+        q, k_pool, v_pool, lengths.astype(jnp.int32) - 1, pages,
+        scale=scale, window=window, interpret=interpret,
     )
 
 
-def _verify_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                   acc_ref, *, bk: int, t: int, hq: int, hkv: int, hd: int,
-                   scale: float, window: int):
-    """The ragged flash body with a T axis: query row t sits at virtual
-    position ``base + t`` and keeps keys ``k_pos <= base + t`` (minus
-    the sliding-window floor) — the exact mask the dense verify einsum
-    applies, so acceptance decisions cannot drift between layouts."""
-    bi = pl.program_id(0)
-    j = pl.program_id(1)
-    nb = pl.num_programs(1)
-    base = base_ref[bi]
-    group = hq // hkv
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    # live kv span across ALL T queries: the earliest query's window
-    # floor up to the last query's position (base + t - 1, whose row the
-    # round's own write just filled — live rows = base + t)
-    live = (j >= _first_block(base + 1, window, bk)) & (
-        j <= _last_block(base + t, bk)
-    )
-
-    @pl.when(live)
-    def _block():
-        # (T, Hkv, g, hd) -> (Hkv, T*g, hd): T and g are both batch-like
-        # for the dots; the mask below re-separates them
-        q = (
-            q_ref[0].reshape(t, hkv, group, hd).transpose(1, 0, 2, 3)
-            .reshape(hkv, t * group, hd).astype(jnp.float32)
-        )
-        k = k_ref[0].astype(jnp.float32)      # (bk, Hkv, hd)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k.transpose(1, 2, 0),
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale                              # (Hkv, T*g, bk)
-        s = s.reshape(hkv, t, group, bk)
-        pos = j * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, 1, bk), 3
-        )
-        q_pos = base + jax.lax.broadcasted_iota(
-            jnp.int32, (1, t, 1, 1), 1
-        )
-        keep = pos <= q_pos
-        if window > 0:
-            keep &= q_pos - pos < window
-        s = jnp.where(keep, s, _NEG_BIG)
-        m_prev = m_ref[...]                    # (Hkv, T, g, 1)
-        l_prev = l_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                 # (Hkv, T, g, bk)
-        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        pv = jax.lax.dot_general(
-            p.reshape(hkv, t * group, bk), v.transpose(1, 0, 2),
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(hkv, t, group, hd)
-        acc_ref[...] = acc_ref[...] * alpha + pv
-
-    @pl.when(j == nb - 1)
-    def _emit():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (
-            out.transpose(1, 0, 2, 3).reshape(t, hq, hd).astype(o_ref.dtype)
-        )
-
-
-@functools.partial(
-    jax.jit, static_argnames=("scale", "window", "interpret")
-)
 def paged_verify_attention(
     q: jax.Array,          # (B, T, Hq, hd) — T = the verify window
     k_pool: jax.Array,     # (n_pages, page_size, Hkv, hd) bf16
@@ -265,54 +89,10 @@ def paged_verify_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """(B, T, Hq, hd) verify attention gathering pages through the
-    table: query t of slot b sits at position ``base[b] + t`` and
-    attends causally up to itself (the speculative round's gamma-token
-    verify window, one kernel launch for the whole batch)."""
-    b, t, hq, hd = q.shape
-    assert t >= 2, "use paged_decode_attention for T=1"
-    ps = k_pool.shape[1]
-    hkv = k_pool.shape[2]
-    n_slot_pages = pages.shape[1]
-    base = base.astype(jnp.int32)
-    pages = pages.astype(jnp.int32)
-    group = hq // hkv
-
-    def kv_map(bi, j, bases, table):
-        lo = _first_block(bases[bi] + 1, window, ps)
-        hi = _last_block(bases[bi] + t, ps)
-        return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, n_slot_pages),
-        in_specs=[
-            pl.BlockSpec(
-                (1, t, hq, hd), lambda bi, j, bases, table: (bi, 0, 0, 0)
-            ),
-            pl.BlockSpec((1, ps, hkv, hd), kv_map),
-            pl.BlockSpec((1, ps, hkv, hd), kv_map),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, t, hq, hd), lambda bi, j, bases, table: (bi, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # m
-            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # l
-            pltpu.VMEM((hkv, t, group, hd), jnp.float32),  # acc
-        ],
+    table: the speculative round's gamma-token window, one kernel
+    launch for the whole batch — the unified kernel at T=gamma."""
+    assert q.shape[1] >= 2, "use paged_decode_attention for T=1"
+    return ragged_paged_attention(
+        q, k_pool, v_pool, base, pages,
+        scale=scale, window=window, interpret=interpret,
     )
-    kernel = functools.partial(
-        _verify_kernel, bk=ps, t=t, hq=hq, hkv=hkv, hd=hd, scale=scale,
-        window=window,
-    )
-
-    def body(bases_ref, table_ref, *refs):
-        kernel(bases_ref, *refs)
-
-    out = pl.pallas_call(
-        body,
-        out_shape=jax.ShapeDtypeStruct((b, t, hq, hd), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(base, pages, q, k_pool, v_pool)
-    return out

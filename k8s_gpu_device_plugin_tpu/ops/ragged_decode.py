@@ -1,128 +1,49 @@
-"""Ragged decode attention: a Pallas TPU kernel that streams only the
-VALID cache prefix per batch row.
+"""Ragged decode attention: the T=1 dense face of the unified kernel.
 
-The serving decode step (models/generate._cached_attention, T=1) is
-HBM-bound on the KV cache, and the XLA einsum streams all ``max_len``
-rows for every slot regardless of how many are live — a slot 300 tokens
-into a 2048-token budget pays 7x its useful traffic. This kernel makes
-the cache read ragged (the direction of the TPU ragged-attention work;
-PAPERS.md entry "Ragged Paged Attention"): per-row ``lengths`` ride as
-scalar prefetch, and every kv-block PAST a row's live prefix — and,
-with a sliding window, BEFORE its window floor — maps its DMA index
-back to a block that is loaded anyway. Pallas elides the DMA when
-consecutive grid cells map the same block, so HBM traffic scales with
-``sum(min(length_b, window))`` instead of ``B * max_len``.
+Historically this module carried its own Pallas body (the first ragged
+kernel in the repo); the unified ragged-paged kernel
+(ops/ragged_paged_attention.py) subsumes it as the ``T=1`` grid
+specialization over the dense DMA route, bit-for-bit (the mask
+``pos <= base`` with ``base = length - 1`` IS the old ``pos < length``;
+pinned in tests/test_unified_attention.py). What remains here is the
+legacy public surface — ``supports()``/``ragged_decode_attention`` with
+the lengths-based calling convention — for direct op-level callers and
+the older tests; the serving path dispatches through
+``ops/attention.serving_cache_attention`` and never imports this
+module anymore.
 
-Grid: (B, max_len // block_k), kv-fastest. Online-softmax accumulators
-(m, l, acc) live in VMEM scratch across a row's kv blocks (the flash
-pattern at T=1); the GQA query block (Hq, hd) is tiny and rides VMEM
-whole. bf16 caches only — quantized caches dequantize per-block through
-scale planes the XLA path already fuses well; measure before porting.
-
-Opt-in via ``LlamaConfig(decode_attn="ragged")`` until a hardware
-window confirms the win (harvest workload ``decode_ragged``).
+Semantics (unchanged): per-row ``lengths`` ride as scalar prefetch, the
+query sits at position ``length - 1``, and every kv block past a row's
+live prefix — and, with a sliding window, before its window floor —
+re-maps its DMA index to a block that is loaded anyway, so HBM traffic
+scales with ``sum(min(length_b, window))`` instead of ``B * max_len``.
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-try:  # pltpu import fails on builds without TPU support
-    from jax.experimental.pallas import tpu as pltpu
+from k8s_gpu_device_plugin_tpu.ops.kernel_support import (
+    HAS_PLTPU as _HAS_PLTPU,  # noqa: F401  (legacy import surface)
+    fit_block as _fit_bk_impl,
+)
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    _first_block,  # noqa: F401  (legacy import surface)
+    _last_block,   # noqa: F401
+    ragged_paged_attention,
+)
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    supports as _rpa_supports,
+)
 
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    _HAS_PLTPU = False
-
-_NEG_BIG = -1e30
 DEFAULT_BLOCK_K = 256
-
-
-def _last_block(length: jax.Array, bk: int) -> jax.Array:
-    """Index of the final kv block holding live rows (>= 0 even for
-    empty rows: block 0 is read and fully masked, matching the XLA
-    path's compute-and-discard contract for inactive slots)."""
-    return jnp.maximum((length + bk - 1) // bk - 1, 0)
-
-
-def _first_block(length: jax.Array, window: int, bk: int) -> jax.Array:
-    """First kv block a windowed query can see (0 without a window)."""
-    if window <= 0:
-        return jnp.zeros_like(length)
-    lo = jnp.maximum(length - window, 0)
-    return lo // bk
-
-
-def _kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-            *, bk: int, hq: int, hkv: int, hd: int, scale: float,
-            window: int):
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    nb = pl.num_programs(1)
-    length = lens_ref[b]
-    group = hq // hkv
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    live = (j >= _first_block(length, window, bk)) & (
-        j <= _last_block(length, bk)
-    )
-
-    @pl.when(live)
-    def _block():
-        q = q_ref[0].reshape(hkv, group, hd).astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)      # (bk, Hkv, hd)
-        v = v_ref[0].astype(jnp.float32)
-        # batched over Hkv: (g, hd) x (hd, bk) -> scores (Hkv, g, bk)
-        s = jax.lax.dot_general(
-            q, k.transpose(1, 2, 0),
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bk), 2)
-        # the query sits at position length-1; clamp keeps one attended
-        # row for empty slots (XLA-path contract: defined, discarded)
-        hi = jnp.maximum(length, 1)
-        keep = pos < hi
-        if window > 0:
-            keep &= pos >= jnp.maximum(length - window, 0)
-        s = jnp.where(keep, s, _NEG_BIG)
-        m_prev = m_ref[...]                    # (Hkv, g, 1)
-        l_prev = l_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                 # (Hkv, g, bk)
-        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_ref[...] = m_new
-        # (Hkv, g, bk) x (bk, hd) batched over Hkv -> (Hkv, g, hd)
-        pv = jax.lax.dot_general(
-            p, v.transpose(1, 0, 2),
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-
-    @pl.when(j == nb - 1)
-    def _emit():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = out.reshape(hq, hd).astype(o_ref.dtype)
 
 
 def _fit_bk(s: int, want: int) -> int | None:
     """Largest sublane-aligned block <= ``want`` dividing the cache
-    length (None if even 8 does not divide — the kernel cannot tile)."""
-    for bk in (want, 512, 256, 128, 64, 32, 16, 8):
-        if bk <= want and s % bk == 0:
-            return bk
-    return None
+    length (delegates to the shared fitter in ops/kernel_support.py)."""
+    return _fit_bk_impl(s, want)
 
 
 def supports(
@@ -130,25 +51,15 @@ def supports(
     require_pltpu: bool = True,
 ) -> bool:
     """Shapes the kernel tiles cleanly: T==1 GQA with a lane-aligned head
-    dim and a cache length some sublane-aligned block divides.
-    ``require_pltpu=False`` relaxes only the TPU-build check (interpret
-    mode still needs every SHAPE constraint to hold)."""
-    if require_pltpu and not _HAS_PLTPU:
-        return False
+    dim and a cache length some sublane-aligned block divides (the
+    unified kernel's gate, narrowed to T==1)."""
     if q.ndim != 4 or q.shape[1] != 1:
         return False
-    b, _, hq, hd = q.shape
-    s = k_cache.shape[1]
-    return (
-        hd in hd_ok
-        and hq % k_cache.shape[2] == 0
-        and _fit_bk(s, DEFAULT_BLOCK_K) is not None
-    )
+    if q.shape[3] not in hd_ok:
+        return False
+    return _rpa_supports(q, k_cache, require_pltpu=require_pltpu, max_t=1)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("scale", "window", "block_k", "interpret")
-)
 def ragged_decode_attention(
     q: jax.Array,          # (B, 1, Hq, hd)
     k_cache: jax.Array,    # (B, S, Hkv, hd) bf16
@@ -159,52 +70,11 @@ def ragged_decode_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """(B, 1, Hq, hd) decode attention reading only live cache blocks."""
-    b, t, hq, hd = q.shape
-    assert t == 1, "ragged decode attention is a T=1 kernel"
-    s = k_cache.shape[1]
-    hkv = k_cache.shape[2]
-    bk = _fit_bk(s, min(block_k, s))
-    if bk is None:
-        raise ValueError(f"no sublane-aligned block divides cache len {s}")
-    lengths = lengths.astype(jnp.int32)
-    group = hq // hkv
-
-    def q_map(bi, j, lens):
-        return (bi, 0, 0)
-
-    def kv_map(bi, j, lens):
-        # out-of-range blocks re-map to an in-range one: consecutive
-        # grid cells with the same index elide the DMA, so dead blocks
-        # cost nothing on the wire
-        lo = _first_block(lens[bi], window, bk)
-        hi = _last_block(lens[bi], bk)
-        return (bi, jnp.clip(j, lo, hi), 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b, s // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, hq, hd), lambda bi, j, lens: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, bk, hkv, hd), kv_map),
-            pl.BlockSpec((1, bk, hkv, hd), kv_map),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, hq, hd), lambda bi, j, lens: (bi, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((hkv, group, 1), jnp.float32),   # m
-            pltpu.VMEM((hkv, group, 1), jnp.float32),   # l
-            pltpu.VMEM((hkv, group, hd), jnp.float32),  # acc
-        ],
+    """(B, 1, Hq, hd) decode attention reading only live cache blocks —
+    the unified kernel at T=1 with ``base = lengths - 1`` (empty rows
+    clamp to attending row 0, the compute-and-discard contract)."""
+    assert q.shape[1] == 1, "ragged decode attention is a T=1 kernel"
+    return ragged_paged_attention(
+        q, k_cache, v_cache, lengths.astype(jnp.int32) - 1,
+        scale=scale, window=window, block_k=block_k, interpret=interpret,
     )
-    kernel = functools.partial(
-        _kernel, bk=bk, hq=hq, hkv=hkv, hd=hd, scale=scale, window=window
-    )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hq, hd), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(lengths, q, k_cache, v_cache)
-    return out[:, None]

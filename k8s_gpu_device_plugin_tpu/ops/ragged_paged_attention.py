@@ -1,0 +1,366 @@
+"""The unified ragged-paged attention kernel: one Pallas body for every
+serving cache-attention shape, dense or paged, routed by the page table.
+
+``ops/`` grew four attention variants one PR at a time — flash prefill
+(ops/flash_attention.py), ragged decode (ops/ragged_decode.py), paged
+decode and the multi-query paged verify (ops/paged_attention.py) — each
+carrying its own copy of the DMA/scalar-prefetch scaffold, its own
+``supports()`` gate and its own masking algebra. They are all the SAME
+kernel (the Ragged Paged Attention design, arXiv:2604.15464): a batch
+of query windows, each sitting at a per-slot base position, attending a
+per-slot live span of the KV cache through online-softmax flash
+accumulation, with HBM traffic routed so only live blocks move. This
+module is that kernel, once:
+
+- **One body** (:func:`_rpa_kernel`): T query rows per slot at virtual
+  positions ``base + 0 .. base + T-1`` with the causal stagger mask
+  ``k_pos <= base + t`` (plus the sliding-window floor). T is a STATIC
+  grid specialization, not a separate kernel:
+
+  - ``T=1`` is decode — the mask degenerates to the ragged-decode
+    kernel's ``pos < length`` exactly (base = length-1), bit-for-bit;
+  - ``2 <= T <= 16`` is the speculative verify window — the old
+    ``paged_verify_attention`` body verbatim;
+  - larger T (up to :data:`MAX_PREFILL_T`) is a prefill chunk — the
+    whole window's accumulators ride VMEM scratch, so the chunk reads
+    each live kv block once instead of the gather's full-cache einsum.
+
+- **Two DMA routes, one index-map pattern**: dense caches clamp the kv
+  block index into the slot's live span (consecutive identical indices
+  elide the DMA — dead blocks cost nothing on the wire); paged pools
+  resolve the clamped VIRTUAL block through the scalar-prefetched page
+  table to a physical page (the page IS the kv block). The body never
+  knows which route loaded its block: masking only needs the block's
+  virtual position, ``j * block``, identical in both layouts.
+
+- **One support gate** (:func:`supports`), built from the shared
+  scaffold in ops/kernel_support.py — the three per-kernel copies of
+  the supports()/interpret pattern collapse here.
+
+The dense kv block size is tunable: the dispatcher (ops/attention.py)
+resolves it from the per-device-generation tilings cache
+(ops/tunings.py) the ``kernel_tune`` autotuner writes, so block choices
+are measured facts per chip generation, not hardcoded guesses (the
+TPU-pod methodology: tune per generation, not per deployment). Paged
+mode's block is pinned to the page size by the layout.
+
+Tensor parallelism: this kernel is deliberately head-local —
+``ops/attention.py`` wraps it in ``shard_map`` over the serving mesh's
+KV-head axis, and because no score, softmax or V-contraction ever
+crosses a head, each shard's output is bitwise the tp=1 kernel's head
+slice (the PR-8 bit-identity contract, now WITH the kernel instead of
+the XLA-gather fallback).
+
+bf16 caches, GQA-native (q heads fold onto their group at score time);
+interpret mode runs the identical logic on CPU for the test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from k8s_gpu_device_plugin_tpu.ops.kernel_support import (
+    HAS_PLTPU,
+    fit_block,
+    gqa_ok,
+    kernels_available,
+    lane_aligned,
+    pltpu,
+    sublane_ok,
+)
+
+_NEG_BIG = -1e30
+
+#: default dense kv block when the tilings cache has no measurement
+DEFAULT_BLOCK_K = 256
+
+#: widest verify window: the T accumulators all live in VMEM at once and
+#: a speculative gamma is small by construction (past ~8 the acceptance
+#: tail pays for itself)
+MAX_VERIFY_T = 16
+
+#: widest prefill-chunk window: (Hkv, T, group, hd) f32 accumulators
+#: plus the (T, Hq, hd) query block must fit VMEM alongside the kv
+#: blocks — at Hkv=8, T=256, group=4, hd=128 that is ~8 MB of
+#: accumulator, comfortable; doubling it is not. Longer chunks stay on
+#: the XLA gather (or shrink their chunk size).
+MAX_PREFILL_T = 256
+
+
+def _first_block(length: jax.Array, window: int, bk: int) -> jax.Array:
+    """First kv block a windowed query can see (0 without a window)."""
+    if window <= 0:
+        return jnp.zeros_like(length)
+    lo = jnp.maximum(length - window, 0)
+    return lo // bk
+
+
+def _last_block(length: jax.Array, bk: int) -> jax.Array:
+    """Index of the final kv block holding live rows (>= 0 even for
+    empty rows: block 0 is read and fully masked, matching the XLA
+    path's compute-and-discard contract for inactive slots)."""
+    return jnp.maximum((length + bk - 1) // bk - 1, 0)
+
+
+def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                acc_ref, *, bk: int, t: int, hq: int, hkv: int, hd: int,
+                scale: float, window: int):
+    """The one flash body: T queries per slot at positions ``base + r``,
+    online-softmax accumulation across this slot's kv blocks. Query row
+    r keeps keys ``k_pos <= base + r`` (minus the sliding-window floor)
+    — the exact mask the XLA gather einsum applies, so routing a shape
+    here can never change WHICH positions are attended, only how their
+    softmax is accumulated."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    base = base_ref[b]
+    group = hq // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # live kv span across ALL T queries: the earliest query's window
+    # floor up to the last query's position base + t - 1 (whose row the
+    # caller's own cache write just filled — live rows = base + t)
+    live = (j >= _first_block(base + 1, window, bk)) & (
+        j <= _last_block(base + t, bk)
+    )
+
+    @pl.when(live)
+    def _block():
+        # (T, Hkv, g, hd) -> (Hkv, T*g, hd): T and g are both batch-like
+        # for the dots; the mask below re-separates them
+        q = (
+            q_ref[0].reshape(t, hkv, group, hd).transpose(1, 0, 2, 3)
+            .reshape(hkv, t * group, hd).astype(jnp.float32)
+        )
+        k = k_ref[0].astype(jnp.float32)      # (bk, Hkv, hd)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k.transpose(1, 2, 0),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                              # (Hkv, T*g, bk)
+        s = s.reshape(hkv, t, group, bk)
+        pos = j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, bk), 3
+        )
+        # clamp keeps one attended row for empty slots (base = -1): the
+        # XLA-path contract — defined, discarded — and a no-op for every
+        # live slot (base >= 0)
+        q_pos = jnp.maximum(
+            base + jax.lax.broadcasted_iota(jnp.int32, (1, t, 1, 1), 1), 0
+        )
+        keep = pos <= q_pos
+        if window > 0:
+            keep &= q_pos - pos < window
+        s = jnp.where(keep, s, _NEG_BIG)
+        m_prev = m_ref[...]                    # (Hkv, T, g, 1)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                 # (Hkv, T, g, bk)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p.reshape(hkv, t * group, bk), v.transpose(1, 0, 2),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(hkv, t, group, hd)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (
+            out.transpose(1, 0, 2, 3).reshape(t, hq, hd).astype(o_ref.dtype)
+        )
+
+
+def supports(
+    q: jax.Array,
+    k: jax.Array,
+    pages: "jax.Array | None" = None,
+    block_k: int = 0,
+    require_pltpu: bool = True,
+    max_t: int = MAX_PREFILL_T,
+) -> bool:
+    """Shapes the unified kernel tiles cleanly: a (B, T, Hq, hd) query
+    window with 1 <= T <= ``max_t``, a lane-aligned head dim, whole GQA
+    groups, and a sublane-aligned kv block — dense caches need some
+    block dividing the cache length, paged pools need the page itself
+    aligned (the page IS the block). ``require_pltpu=False`` relaxes
+    only the TPU-build check (interpret mode still needs every SHAPE
+    constraint to hold) — the one supports()/interpret gate every
+    routed shape goes through."""
+    if not kernels_available(require_pltpu):
+        return False
+    if q.ndim != 4 or k.ndim != 4:
+        return False
+    b, t, hq, hd = q.shape
+    if not (1 <= t <= max_t):
+        return False
+    hkv = k.shape[2]
+    if not (lane_aligned(hd) and gqa_ok(hq, hkv) and k.shape[3] == hd):
+        return False
+    if pages is not None:
+        return sublane_ok(k.shape[1]) and pages.shape[0] == b
+    want = block_k if block_k > 0 else DEFAULT_BLOCK_K
+    return fit_block(k.shape[1], min(want, k.shape[1])) is not None
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "window", "block_k", "interpret")
+)
+def _rpa_call(q, k, v, base, pages, *, scale, window, block_k, interpret):
+    """The pallas_call builder (jitted so direct op-level callers get a
+    cached dispatch; inside an outer serving jit this is a no-op nest).
+    ``pages=None`` is the dense route, else the paged one — same grid
+    shape, same body, different index map."""
+    b, t, hq, hd = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    base = base.astype(jnp.int32)
+
+    if pages is None:
+        s = k.shape[1]
+        bk = block_k
+        grid = (b, s // bk)
+        num_prefetch = 1
+        prefetch_args = (base,)
+
+        def kv_map(bi, j, bases):
+            # clamp into the live span FIRST: dead grid cells re-map to
+            # a live block, and Pallas elides the DMA when consecutive
+            # cells map the same block — dead blocks cost nothing
+            lo = _first_block(bases[bi] + 1, window, bk)
+            hi = _last_block(bases[bi] + t, bk)
+            return (bi, jnp.clip(j, lo, hi), 0, 0)
+
+        def q_map(bi, j, bases):
+            return (bi, 0, 0, 0)
+
+        def o_map(bi, j, bases):
+            return (bi, 0, 0, 0)
+    else:
+        bk = k.shape[1]  # the page IS the kv block
+        pages = pages.astype(jnp.int32)
+        grid = (b, pages.shape[1])
+        num_prefetch = 2
+        prefetch_args = (base, pages)
+
+        def kv_map(bi, j, bases, table):
+            # clamp, THEN resolve the virtual block through the table to
+            # its physical pool page — the one indirection the paged
+            # layout adds to the dense route above
+            lo = _first_block(bases[bi] + 1, window, bk)
+            hi = _last_block(bases[bi] + t, bk)
+            return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
+
+        def q_map(bi, j, bases, table):
+            return (bi, 0, 0, 0)
+
+        def o_map(bi, j, bases, table):
+            return (bi, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, t, hq, hd), q_map),
+            pl.BlockSpec((1, bk, hkv, hd), kv_map),
+            pl.BlockSpec((1, bk, hkv, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, t, hq, hd), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # m
+            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # l
+            pltpu.VMEM((hkv, t, group, hd), jnp.float32),  # acc
+        ],
+    )
+    kernel = functools.partial(
+        _rpa_kernel, bk=bk, t=t, hq=hq, hkv=hkv, hd=hd, scale=scale,
+        window=window,
+    )
+
+    def body(*refs):
+        # the scalar-prefetch refs (base, and the table on the paged
+        # route) participate in DMA routing only; the body reads base
+        # for masking and never sees the table
+        kernel(refs[0], *refs[num_prefetch:])
+
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((b, t, hq, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*prefetch_args, q, k, v)
+
+
+def ragged_paged_attention(
+    q: jax.Array,            # (B, T, Hq, hd) — T queries per slot
+    k: jax.Array,            # dense (B, S, Hkv, hd) | paged (n_pages, ps, Hkv, hd)
+    v: jax.Array,            # same layout as k
+    base: jax.Array,         # (B,) int32: position of each slot's FIRST query
+    pages: "jax.Array | None" = None,  # (B, n_slot_pages) int32 page table
+    *,
+    scale: float,
+    window: int = 0,
+    block_k: int = 0,        # dense kv block; 0 = tunings cache / default
+    interpret: bool = False,
+) -> jax.Array:
+    """(B, T, Hq, hd) cache attention reading only live kv blocks.
+
+    Query r of slot b sits at virtual position ``base[b] + r`` and
+    attends causally up to itself; live cache rows are
+    ``base + T`` (the caller's write of the window precedes the read,
+    the serving contract). Dense mode tiles the cache at ``block_k``
+    (resolved from the per-generation tilings cache when 0); paged mode
+    reads whole pages through ``pages``."""
+    if pages is None:
+        s = k.shape[1]
+        if block_k <= 0:
+            # direct op-level callers only: the serving dispatcher
+            # always passes block_k explicitly, resolved from GLOBAL
+            # shapes and the true routing mode (T alone cannot tell a
+            # short prefill chunk from a verify window, and inside a tp
+            # shard_map the per-shard head count would miskey the store)
+            from k8s_gpu_device_plugin_tpu.ops import tunings
+
+            t = q.shape[1]
+            mode = ("decode" if t == 1
+                    else "verify" if t <= MAX_VERIFY_T else "prefill")
+            hkv, hd = k.shape[2], k.shape[3]
+            tuned = tunings.resolve(f"rpa:{mode}:hkv{hkv}:hd{hd}", s)
+            block_k = tuned[0] if tuned else DEFAULT_BLOCK_K
+        bk = fit_block(s, min(block_k, s))
+        if bk is None:
+            raise ValueError(
+                f"no sublane-aligned block divides cache len {s}; gate on "
+                "supports() (ops.attention dispatches with the gate)"
+            )
+        block_k = bk
+    else:
+        block_k = 0  # pinned to the page size inside _rpa_call
+    return _rpa_call(
+        q, k, v, base, pages,
+        scale=scale, window=window, block_k=block_k, interpret=interpret,
+    )
+
+
+__all__ = [
+    "DEFAULT_BLOCK_K",
+    "HAS_PLTPU",
+    "MAX_PREFILL_T",
+    "MAX_VERIFY_T",
+    "ragged_paged_attention",
+    "supports",
+]

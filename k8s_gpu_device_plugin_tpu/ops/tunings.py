@@ -1,0 +1,176 @@
+"""Per-device-generation kernel tilings cache (the autotuner's store).
+
+The flash kernels learned this lesson first (ops/flash_attention.py's
+``.flash_tilings.json``): measured block sizes beat guessed constants,
+but only if a sweep's winners persist so every later run picks them up
+without a human copying numbers around. This module generalizes that
+store for the unified ragged-paged kernel — and fixes the flash file's
+one design flaw: tilings were keyed by shape alone, so a file written
+on a v5e would silently mis-tune a v6e run in the same checkout. Here
+the top-level key is the DEVICE GENERATION (device/topology.py's
+``GENERATIONS`` vocabulary — the same per-generation keying the
+roofline/spec peaks use), detected from the running backend; non-TPU
+backends get their own bucket (``cpu``/``gpu``/...) so interpret-mode
+smoke sweeps can exercise the whole persist/reload path without
+poisoning hardware entries.
+
+Schema (JSON, human-diffable)::
+
+    {
+      "v5e": {
+        "rpa:decode:hkv8:hd128:2048": [256],
+        "rpa:prefill:hkv8:hd128:2048": [512],
+        "flash:fwd:2048": [1024, 1024]
+      },
+      "cpu": {...}
+    }
+
+Keys are ``<kernel>:<mode>:...:<seq>`` with the sequence length LAST:
+:func:`resolve` falls back to the nearest measured seq <= the query
+(tilings grow with S — a shorter-seq winner is a safe under-estimate,
+the flash resolver's rule). Values are block lists (``[block_k]`` for
+the unified kernel, ``[block_q, block_k]`` for flash).
+
+Override the path with ``KERNEL_TUNINGS_FILE``; explicit block
+arguments always win over the file (the flash contract).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+TUNINGS_FILE_ENV = "KERNEL_TUNINGS_FILE"
+_DEFAULT_TUNINGS_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".kernel_tilings.json",
+)
+
+
+def tunings_path() -> str:
+    return os.environ.get(TUNINGS_FILE_ENV) or _DEFAULT_TUNINGS_FILE
+
+
+@functools.lru_cache(maxsize=1)
+def _generation_cached() -> str:
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.device.topology import (
+        generation_for_device_kind,
+    )
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # backend init failure: still give a stable bucket
+        return "unknown"
+    kind = getattr(dev, "device_kind", "") or ""
+    gen = generation_for_device_kind(kind)
+    if gen is not None:
+        return gen
+    platform = getattr(dev, "platform", "unknown") or "unknown"
+    if platform != "tpu":
+        return platform  # cpu/gpu: one interpret-mode bucket each
+    # an unrecognized TPU kind gets its OWN bucket (the sanitized kind
+    # string): collapsing all unknown generations into one "tpu" bucket
+    # would reintroduce exactly the cross-generation mis-tuning the
+    # per-generation keying exists to prevent
+    import re as _re
+
+    slug = _re.sub(r"[^a-z0-9]+", "", kind.lower())
+    return slug or platform
+
+
+def device_generation() -> str:
+    """The running backend's tilings bucket: a ``GENERATIONS`` key on
+    TPU (``v5e``/``v6e``/...), else the backend platform name."""
+    return _generation_cached()
+
+
+@functools.lru_cache(maxsize=1)
+def _load() -> dict:
+    """The whole store, loaded once per process ({} when absent/bad);
+    malformed entries are dropped, not raised — a corrupt cache must
+    degrade to the defaults, never break serving startup."""
+    try:
+        with open(tunings_path()) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    out: dict[str, dict[str, tuple[int, ...]]] = {}
+    for gen, entries in data.items():
+        if not isinstance(entries, dict):
+            continue
+        bucket = {}
+        for key, val in entries.items():
+            if (
+                isinstance(val, (list, tuple)) and val
+                and all(isinstance(b, int) and b > 0 for b in val)
+            ):
+                bucket[key] = tuple(int(b) for b in val)
+        out[gen] = bucket
+    return out
+
+
+def clear_cache() -> None:
+    """Drop the in-process load caches (tests, post-record reload)."""
+    _load.cache_clear()
+    _generation_cached.cache_clear()
+
+
+def lookup(key: str, generation: str | None = None) -> "tuple[int, ...] | None":
+    """Exact-key lookup in one generation's bucket (None = current)."""
+    gen = generation or device_generation()
+    return _load().get(gen, {}).get(key)
+
+
+def resolve(prefix: str, s: int, generation: str | None = None
+            ) -> "tuple[int, ...] | None":
+    """Blocks measured for ``f"{prefix}:{s}"``, else the nearest
+    measured seq <= s under the same prefix, else None."""
+    gen = generation or device_generation()
+    bucket = _load().get(gen, {})
+    exact = bucket.get(f"{prefix}:{s}")
+    if exact is not None:
+        return exact
+    best_s, best = -1, None
+    want = prefix + ":"
+    for key, val in bucket.items():
+        if not key.startswith(want):
+            continue
+        ks = key[len(want):]
+        if not ks.isdigit():
+            continue
+        ks_i = int(ks)
+        if best_s < ks_i <= s:
+            best_s, best = ks_i, val
+    return best
+
+
+def record(entries: dict, generation: str | None = None) -> str:
+    """Merge ``{key: blocks}`` into the current (or named) generation's
+    bucket and persist; returns the path written, or "" when the write
+    failed — a failed persist must not void the sweep whose results it
+    records (the flash ``record_tuned_blocks`` contract)."""
+    gen = generation or device_generation()
+    path = tunings_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    bucket = data.setdefault(gen, {})
+    if not isinstance(bucket, dict):
+        bucket = data[gen] = {}
+    bucket.update({k: list(v) for k, v in entries.items()})
+    try:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+    except OSError:
+        return ""
+    _load.cache_clear()
+    return path

@@ -61,6 +61,25 @@ def serving_mesh(tp: int, n_kv_heads: int, devices: list | None = None
     return Mesh(np.asarray(devices[:tp]).reshape(tp), (AXIS_TP,))
 
 
+def ambient_mesh() -> "Mesh | None":
+    """The mesh whose scope the caller is tracing under (None outside
+    any ``with mesh:`` block). The batcher enters its serving mesh
+    around every device dispatch (``_dispatch_scope``), so kernel
+    dispatchers traced inside a step can recover the mesh here and
+    ``shard_map`` themselves over the tp axis — the seam that keeps the
+    Pallas kernels (opaque to the SPMD partitioner) running per-shard
+    instead of falling back to the XLA gather. Uses jax's thread-local
+    mesh resource (the same state ``with mesh:`` sets); wrapped so the
+    private-API touch lives in exactly one place."""
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):  # pragma: no cover - jax drift
+        return None
+    return None if m.empty else m
+
+
 def serving_param_specs(cfg) -> dict:
     """PartitionSpecs per serving parameter (see module docstring for
     why this is NOT training's ``param_specs``): column shards where a

@@ -330,6 +330,12 @@ class InferenceEngine:
             # fragmentation; speculative batchers fold the draft cache
             # in) — mirrored by the OpenAI façade's health
             out["kv"] = kv_stats()
+        attn_stats = getattr(self.cb, "attn_backend_stats", None)
+        if attn_stats is not None:
+            # which attention backend each serving mode routes through
+            # (pallas kernel vs xla gather) and the gate that decided
+            # it — the static startup plan, so no cross-thread hazard
+            out["decode_attn"] = attn_stats()
         spec_stats = getattr(self.cb, "spec_stats", None)
         if spec_stats is not None:
             # speculative acceptance (rounds, drafted/accepted tokens,
@@ -1167,6 +1173,22 @@ def _main(argv: list[str] | None = None) -> int:
                         help="KV-cache quantization: int8 halves decode's "
                         "cache HBM stream, int4 halves it again (coarser "
                         "codes; accuracy trade)")
+    parser.add_argument("--decodeAttn", default="auto",
+                        choices=["auto", "xla", "ragged"],
+                        help="decode/verify cached attention: 'ragged' "
+                        "routes T=1 decode and the speculative verify "
+                        "window through the unified ragged-paged Pallas "
+                        "kernel (shard_map-ed per KV head under --tp); "
+                        "auto/xla = the fused XLA gather. The chosen "
+                        "backend per mode is logged at startup and on "
+                        "/v1/health (decode_attn section)")
+    parser.add_argument("--prefillAttn", default="auto",
+                        choices=["auto", "xla", "ragged"],
+                        help="prefill-chunk cached attention: 'ragged' "
+                        "routes chunk windows through the same unified "
+                        "kernel (separate knob: prefill's low-bit "
+                        "numerics profile changes with the online-"
+                        "softmax accumulation order)")
     parser.add_argument("--checkpointDir", default="")
     parser.add_argument("--embeddings", action="store_true",
                         help="enable /v1/embeddings (mean-pooled final "
@@ -1314,6 +1336,11 @@ def _main(argv: list[str] | None = None) -> int:
         from dataclasses import replace as _replace
 
         cfg = _replace(cfg, cache_quant=args.cacheQuant)
+    if args.decodeAttn != "auto" or args.prefillAttn != "auto":
+        from dataclasses import replace as _replace
+
+        cfg = _replace(cfg, decode_attn=args.decodeAttn,
+                       prefill_attn=args.prefillAttn)
     if args.tp != 1:
         # fail BEFORE the (slow) weight load: the shared flag rule
         # (parallel/mesh.py MeshSpec.from_flags — the same validation

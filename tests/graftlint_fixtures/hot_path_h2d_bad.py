@@ -25,3 +25,13 @@ class Batcher:
         # device_put would re-transfer the whole table per token
         pages = jax.device_put(self._page_table_np, self._sharding)
         return self.step(pages)
+
+
+def serving_cache_attention(q, k, v, length, table):  # graftlint: hot-path=traced
+    # the unified-kernel dispatch seam is TRACED (it runs inside the
+    # serving jits), where constructors are trace-time constants — but
+    # an explicit H2D materializer is still wrong: a host-built table
+    # smuggled in here would re-enter the trace as a fresh constant on
+    # every shape and re-upload on every dispatch cache miss
+    table = jnp.asarray(table)          # BAD: H2D even in a traced seam
+    return q, k, v, length, table

@@ -32,3 +32,12 @@ def scatter_rows(cache, row, p):  # graftlint: hot-path=traced
     # constant here, not a per-step transfer
     idx = jnp.arange(p)
     return cache, row, idx
+
+
+def serving_cache_attention(q, k, v, length, pages):  # graftlint: hot-path=traced
+    # the unified-kernel dispatch seam (ops/attention.py): traced inside
+    # the serving jits, so broadcasting the base positions with a
+    # constructor is a trace-time constant — the kernel's scalar-
+    # prefetch operand, not a per-step upload
+    base = jnp.full((q.shape[0],), length)
+    return q, k, v, base, pages

@@ -86,6 +86,15 @@ def test_hot_path_flags_transfer_and_carry():
     # ...but a trace-time constant in jitted/traced hot paths: the ok
     # fixture's hot-path=traced function uses jnp.arange and stays
     # silent (covered by test_checker_silent_on_ok_fixture)
+    # the kernel-dispatch seam (serving_cache_attention, traced): an
+    # explicit H2D materializer fires even under the traced marker —
+    # pinned by the bad fixture's traced dispatch function carrying its
+    # own jnp.asarray (the ok twin's jnp.full stays silent)
+    traced_disp = [
+        v for v in _run_on(bad, [_checker("hot-path-h2d")])
+        if v.symbol == "serving_cache_attention"
+    ]
+    assert {v.key for v in traced_disp} == {"jnp.asarray"}
 
 
 def test_thread_ownership_allows_atomic_len():

@@ -1,0 +1,522 @@
+"""The unified ragged-paged attention kernel + its dispatcher
+(ops/ragged_paged_attention.py, ops/attention.serving_cache_attention).
+
+Four layers of claims:
+
+- **Kernel parity**: the one body matches the XLA gather's attention
+  semantics across all three grid specializations (decode T=1 / verify
+  / prefill-chunk) x dense/paged x GQA group sizes (interpret mode on
+  CPU, max-abs error vs an f32 reference), and is BITWISE the legacy
+  per-variant kernels it replaced (the shims cannot drift).
+- **shard_map bit-identity**: under tp=2/4 on the conftest-forced
+  8-device platform, the dispatcher keeps the kernel per-shard and the
+  output is bitwise the tp=1 kernel's — and end-to-end, batcher
+  token+logprob streams with ``decode_attn="ragged"`` are pinned
+  bit-identical across tp=1/2/4 for dense AND paged layouts (the PR-8
+  matrix, now WITH the kernel instead of the gather fallback).
+- **Dispatch gates**: every fallback is explicit — quantized caches,
+  unsupported geometry, missing mesh, opt-outs — and visible: the
+  startup plan names backend + reason, feeds the
+  ``decode_attn_backend`` gauge, and rides /v1/health.
+- **Autotuner cache**: winners persist per device generation
+  (ops/tunings.py), reload into block resolution, and the kernel's
+  block_k=0 path dispatches on them (pinned bitwise against the same
+  block passed explicitly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.ops import tunings
+from k8s_gpu_device_plugin_tpu.ops.attention import (
+    attention_backend_plan,
+    serving_cache_attention,
+)
+from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    MAX_PREFILL_T,
+    MAX_VERIFY_T,
+    ragged_paged_attention,
+    supports,
+)
+from k8s_gpu_device_plugin_tpu.parallel.tp_serving import serving_mesh
+
+HD = 64
+
+
+def _ref(q, k, v, base, scale, window=0):
+    """f32 plain-softmax oracle: the gather path's exact masking."""
+    b, t, hq, hd = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    s = k.shape[1]
+    qg = q.reshape(b, t, hkv, g, hd).astype(jnp.float32)
+    sc = jnp.einsum("btkgd,bskd->btkgs", qg, k.astype(jnp.float32)) * scale
+    q_pos = jnp.maximum(
+        base[:, None, None, None, None]
+        + jnp.arange(t)[None, :, None, None, None], 0
+    )
+    k_pos = jnp.arange(s)[None, None, None, None, :]
+    keep = k_pos <= q_pos
+    if window > 0:
+        keep &= q_pos - k_pos < window
+    sc = jnp.where(keep, sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum(
+        "btkgs,bskd->btkgd", p, v.astype(jnp.float32)
+    ).reshape(b, t, hq, hd)
+
+
+def _dense(b=3, s=128, hq=8, hkv=4):
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    k = jax.random.normal(kk, (b, s, hkv, HD), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, hkv, HD), jnp.bfloat16)
+    return kq, k, v
+
+
+def _paged(k, v, ps=16):
+    """Repack a dense cache into a pool + identity-permuted tables."""
+    b, s, hkv, hd = k.shape
+    n = b * (s // ps)
+    kp = jnp.concatenate(
+        [jnp.zeros((1, ps, hkv, hd), k.dtype), k.reshape(n, ps, hkv, hd)]
+    )
+    vp = jnp.concatenate(
+        [jnp.zeros((1, ps, hkv, hd), v.dtype), v.reshape(n, ps, hkv, hd)]
+    )
+    table = jnp.arange(1, n + 1, dtype=jnp.int32).reshape(b, s // ps)
+    return kp, vp, table
+
+
+# --- kernel parity ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 4), (8, 2)])
+@pytest.mark.parametrize("mode,t", [("decode", 1), ("verify", 4),
+                                    ("prefill", 32)])
+def test_kernel_matches_gather_reference(mode, t, hq, hkv):
+    kq, k, v = _dense(hq=hq, hkv=hkv)
+    kp, vp, table = _paged(k, v)
+    q = jax.random.normal(kq, (3, t, hq, HD), jnp.bfloat16)
+    base = jnp.asarray([1, 40, 128 - t], jnp.int32)
+    want = _ref(q, k, v, base, HD ** -0.5)
+    for pages, kk_, vv_ in ((None, k, v), (table, kp, vp)):
+        got = ragged_paged_attention(
+            q, kk_, vv_, base, pages, scale=HD ** -0.5, block_k=32,
+            interpret=True,
+        )
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+        assert err < 0.02, (mode, pages is not None, err)
+
+
+def test_kernel_windowed_matches_reference():
+    kq, k, v = _dense()
+    q = jax.random.normal(kq, (3, 8, 8, HD), jnp.bfloat16)
+    base = jnp.asarray([10, 60, 120], jnp.int32)
+    got = ragged_paged_attention(
+        q, k, v, base, scale=HD ** -0.5, window=24, block_k=16,
+        interpret=True,
+    )
+    want = _ref(q, k, v, base, HD ** -0.5, window=24)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+    assert err < 0.02, err
+
+
+def test_legacy_kernels_are_bitwise_the_unified_one():
+    """The compat shims (ops/ragged_decode, ops/paged_attention) must be
+    pure re-parameterizations: byte-equal outputs, so no stream pinned
+    on the old entry points can move."""
+    from k8s_gpu_device_plugin_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_verify_attention,
+    )
+    from k8s_gpu_device_plugin_tpu.ops.ragged_decode import (
+        ragged_decode_attention,
+    )
+
+    kq, k, v = _dense()
+    kp, vp, table = _paged(k, v)
+    q = jax.random.normal(kq, (3, 1, 8, HD), jnp.bfloat16)
+    lens = jnp.asarray([0, 33, 128], jnp.int32)  # empty slot included
+    old = ragged_decode_attention(q, k, v, lens, scale=HD ** -0.5,
+                                  block_k=32, interpret=True)
+    new = ragged_paged_attention(q, k, v, lens - 1, scale=HD ** -0.5,
+                                 block_k=32, interpret=True)
+    assert bool(jnp.all(old == new))
+    lens = jnp.asarray([5, 33, 128], jnp.int32)
+    oldp = paged_decode_attention(q, kp, vp, table, lens,
+                                  scale=HD ** -0.5, interpret=True)
+    newp = ragged_paged_attention(q, kp, vp, lens - 1, table,
+                                  scale=HD ** -0.5, interpret=True)
+    assert bool(jnp.all(oldp == newp))
+    qv = jax.random.normal(kq, (3, 4, 8, HD), jnp.bfloat16)
+    base = jnp.asarray([3, 50, 100], jnp.int32)
+    oldv = paged_verify_attention(qv, kp, vp, table, base,
+                                  scale=HD ** -0.5, interpret=True)
+    newv = ragged_paged_attention(qv, kp, vp, base, table,
+                                  scale=HD ** -0.5, interpret=True)
+    assert bool(jnp.all(oldv == newv))
+
+
+def test_supports_gates():
+    kq, k, v = _dense()
+    q = jax.random.normal(kq, (3, 1, 8, HD), jnp.bfloat16)
+    assert supports(q, k, require_pltpu=False)
+    # lane alignment
+    bad_hd = jax.random.normal(kq, (3, 1, 8, 16), jnp.bfloat16)
+    assert not supports(bad_hd, k[..., :16], require_pltpu=False)
+    # GQA divisibility
+    qg = jax.random.normal(kq, (3, 1, 6, HD), jnp.bfloat16)
+    assert not supports(qg, k, require_pltpu=False)
+    # window width caps
+    qt = jax.random.normal(kq, (3, MAX_PREFILL_T + 1, 8, HD), jnp.bfloat16)
+    assert not supports(qt, k, require_pltpu=False)
+    # paged: sublane-aligned page size required
+    kp, vp, table = _paged(k, v)
+    assert supports(q, kp, table, require_pltpu=False)
+    bad_ps = kp[:, :12]
+    assert not supports(q, bad_ps, table, require_pltpu=False)
+    # dense: some sublane block must divide the cache length
+    assert not supports(q, k[:, :100], require_pltpu=False)
+
+
+# --- dispatcher gates + shard_map bit-identity -----------------------------
+
+
+def test_dispatcher_gates_and_modes():
+    kq, k, v = _dense(b=2)
+    q = jax.random.normal(kq, (2, 1, 8, HD), jnp.bfloat16)
+    base = jnp.asarray([5, 99], jnp.int32)
+    # opt-outs and hard gates return None (the caller's gather runs)
+    assert serving_cache_attention(q, k, v, base) is None
+    assert serving_cache_attention(q, k, v, base, decode_attn="xla") is None
+    assert serving_cache_attention(
+        q, k, v, base, decode_attn="ragged", quantized=True
+    ) is None
+    # tp>1 with no ambient mesh: graceful fallback, not a crash
+    assert serving_cache_attention(
+        q, k, v, base, decode_attn="ragged", tp=2
+    ) is None
+    # decode routes; verify width bounds respected; prefill needs its
+    # own opt-in
+    assert serving_cache_attention(
+        q, k, v, base, decode_attn="ragged"
+    ) is not None
+    qv = jax.random.normal(kq, (2, MAX_VERIFY_T + 2, 8, HD), jnp.bfloat16)
+    assert serving_cache_attention(
+        qv, k, v, base - MAX_VERIFY_T, verify=True, decode_attn="ragged"
+    ) is None
+    qp = jax.random.normal(kq, (2, 16, 8, HD), jnp.bfloat16)
+    assert serving_cache_attention(
+        qp, k, v, base - 16, decode_attn="ragged"
+    ) is None
+    assert serving_cache_attention(
+        qp, k, v, base - 16, decode_attn="ragged", prefill_attn="ragged"
+    ) is not None
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_dispatcher_shard_map_bitwise(tp):
+    """The kernel under shard_map at tp=2/4 is bitwise the tp=1 kernel:
+    attention never crosses a KV head, so each shard's heads are the
+    tp=1 heads — the structural fact the serving stream pin rests on."""
+    kq, k, v = _dense(b=2)
+    kp, vp, table = _paged(k, v)
+    q = jax.random.normal(kq, (2, 1, 8, HD), jnp.bfloat16)
+    base = jnp.asarray([5, 99], jnp.int32)
+    one = serving_cache_attention(q, k, v, base, decode_attn="ragged")
+    mesh = serving_mesh(tp, k.shape[2])
+    with mesh:
+        many = jax.jit(
+            lambda *a: serving_cache_attention(*a, decode_attn="ragged",
+                                               tp=tp)
+        )(q, k, v, base)
+    assert bool(jnp.all(one == many))
+    # paged verify, the speculative window
+    qv = jax.random.normal(kq, (2, 4, 8, HD), jnp.bfloat16)
+    onev = serving_cache_attention(qv, kp, vp, base - 4, pages=table,
+                                   verify=True, decode_attn="ragged")
+    with mesh:
+        manyv = jax.jit(
+            lambda qq, kk_, vv_, bb, pp: serving_cache_attention(
+                qq, kk_, vv_, bb, pages=pp, verify=True,
+                decode_attn="ragged", tp=tp,
+            )
+        )(qv, kp, vp, base - 4, table)
+    assert bool(jnp.all(onev == manyv))
+
+
+# --- end-to-end serving streams --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kernel_setup():
+    # head_dim_override=64 puts the tiny config ON the kernel's gates
+    # (the stock tiny head_dim of 16 is exactly the documented fallback)
+    cfg = LlamaConfig.tiny(n_layers=2, head_dim_override=HD,
+                           decode_attn="ragged")
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _streams(params, cfg, tp, layout, depth=1):
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(8, 16, 32),
+        chunked_prefill=8, pipeline_depth=depth, kv_layout=layout,
+        kv_page_size=16 if layout == "paged" else None, tp=tp,
+    )
+    prompts = [
+        jax.random.randint(jax.random.key(40 + i), (n,), 1,
+                           cfg.vocab_size, jnp.int32).tolist()
+        for i, n in enumerate([5, 12, 3, 9])
+    ]
+    rids = [
+        cb.submit(p, max_new=6, seed=11 if i % 2 else None)
+        for i, p in enumerate(prompts)
+    ]
+    cb.cancel(rids[2])  # a cancel mid-queue rides the pin matrix
+    cb.run()
+    return {
+        r: (tuple(cb.done[r]),
+            tuple(round(x, 12) for x in cb.done_requests[r].out_logp))
+        for r in rids
+    }, cb
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_stream_bit_identity_tp_with_kernel(kernel_setup, layout):
+    """The acceptance pin: with decode_attn='ragged' ROUTING (plan says
+    pallas), token AND logprob streams are bit-identical across
+    tp=1/2/4 on both KV layouts — the PR-8 matrix with the kernel."""
+    cfg, params = kernel_setup
+    base, cb = _streams(params, cfg, 1, layout)
+    assert cb.attn_plan["decode"]["backend"] == "pallas"
+    assert cb.attn_plan["verify"]["backend"] == "pallas"
+    for tp in (2, 4):
+        got, cb_tp = _streams(params, cfg, tp, layout)
+        assert cb_tp.attn_plan["decode"]["backend"] == "pallas"
+        assert got == base, (layout, tp)
+
+
+def test_prefill_kernel_stream_tp_identity(kernel_setup):
+    """prefill_attn='ragged' (chunk windows through the kernel): the
+    same structural pin — tp=2 streams bitwise tp=1's, and the plan
+    reports the prefill route."""
+    cfg, params = kernel_setup
+    pcfg = replace(cfg, prefill_attn="ragged")
+    base, cb = _streams(params, pcfg, 1, "dense")
+    assert cb.attn_plan["prefill"]["backend"] == "pallas"
+    got, _ = _streams(params, pcfg, 2, "dense")
+    assert got == base
+
+
+def test_kernel_actually_traces_in_decode_step(kernel_setup, monkeypatch):
+    """Belt for the routing claim: the unified kernel is CALLED when the
+    decode step traces (a fresh cfg forces a fresh trace — the jit
+    cache would otherwise satisfy the step without re-entering the
+    dispatcher)."""
+    import k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention as rpa
+
+    cfg, _ = kernel_setup
+    cfg = replace(cfg, vocab_size=520)  # unique static cfg: fresh traces
+    params = init_params(jax.random.key(1), cfg)
+    calls = []
+    real = rpa.ragged_paged_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("block_k"))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(rpa, "ragged_paged_attention", spy)
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           prompt_buckets=(8, 16), chunked_prefill=8)
+    cb.submit([1, 2, 3], max_new=3)
+    cb.run()
+    assert calls, "decode step traced without entering the kernel"
+
+
+# --- fallback visibility ---------------------------------------------------
+
+
+def test_backend_plan_reasons():
+    common = dict(n_heads=8, n_kv_heads=4, head_dim=HD, max_len=64)
+    plan = attention_backend_plan(decode_attn="ragged", tp=2, **common)
+    assert plan["decode"]["backend"] == "pallas"
+    assert "shard_map" in plan["decode"]["reason"]
+    assert plan["prefill"]["backend"] == "xla"  # needs its own opt-in
+    plan = attention_backend_plan(decode_attn="ragged", cache_quant="int8",
+                                  **common)
+    assert plan["decode"]["backend"] == "xla"
+    assert "bf16" in plan["decode"]["reason"]
+    plan = attention_backend_plan(
+        decode_attn="ragged",
+        **{**common, "head_dim": 16},
+    )
+    assert "head_dim" in plan["decode"]["reason"]
+    plan = attention_backend_plan(decode_attn="ragged", kv_layout="paged",
+                                  page_size=12, **common)
+    assert "kv_page_size" in plan["decode"]["reason"]
+    plan = attention_backend_plan(decode_attn="ragged",
+                                  prefill_attn="ragged",
+                                  chunk=MAX_PREFILL_T + 1, **common)
+    assert plan["prefill"]["backend"] == "xla"
+    assert "MAX_PREFILL_T" in plan["prefill"]["reason"]
+    plan = attention_backend_plan(**common)
+    assert plan["decode"]["reason"].startswith("decode_attn=")
+
+
+def test_batcher_fallback_logs_and_gauge(kernel_setup, captured_log_records):
+    """An opted-in kernel that falls back WARNS with the reason (the
+    previously-silent degradation) and the gauge carries the per-mode
+    backend; attn_backend_stats() is the health payload."""
+    cfg, _ = kernel_setup
+    bad = replace(cfg, head_dim_override=0)  # tiny's hd=16: off the gates
+    params = init_params(jax.random.key(0), bad)
+
+    class Gauge:
+        def __init__(self):
+            self.plans = []
+
+        def set_decode_attn_backend(self, plan):
+            self.plans.append(plan)
+
+    g = Gauge()
+    cb = ContinuousBatcher(params, bad, n_slots=1, max_len=32,
+                           prompt_buckets=(8, 16), metrics=g)
+    warns = [r for r in captured_log_records
+             if r.levelname == "WARNING"
+             and "attention backend" in r.getMessage()]
+    assert warns, "fallback under an explicit opt-in must warn"
+    assert any("head_dim" in r.getMessage() for r in warns)
+    assert g.plans and g.plans[0]["decode"]["backend"] == "xla"
+    stats = cb.attn_backend_stats()
+    assert set(stats) == {"decode", "verify", "prefill"}
+    assert stats["decode"]["backend"] == "xla"
+    stats["decode"]["backend"] = "mutated"  # a copy: plan is immutable
+    assert cb.attn_plan["decode"]["backend"] == "xla"
+
+
+def test_serving_metrics_gauge_and_health_surface():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.set_decode_attn_backend({
+        "decode": {"backend": "pallas", "reason": "x"},
+        "verify": {"backend": "pallas", "reason": "x"},
+        "prefill": {"backend": "xla", "reason": "y"},
+    })
+    val = reg.get_sample_value(
+        "tpu_serving_decode_attn_backend",
+        {"mode": "decode", "backend": "pallas"},
+    )
+    assert val == 1
+    assert reg.get_sample_value(
+        "tpu_serving_decode_attn_backend",
+        {"mode": "prefill", "backend": "pallas"},
+    ) == 0
+    m.close()
+
+    # /v1/health carries the plan (engine stats() duck-types it)
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg = LlamaConfig.tiny(n_layers=1)
+    params = init_params(jax.random.key(0), cfg)
+    engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                             chunked_prefill=8)
+    try:
+        stats = engine.stats()
+        assert set(stats["decode_attn"]) == {"decode", "verify", "prefill"}
+        assert stats["decode_attn"]["decode"]["backend"] == "xla"
+    finally:
+        engine.shutdown()
+
+
+# --- autotuner cache -------------------------------------------------------
+
+
+def test_tunings_record_resolve_round_trip(tmp_path, monkeypatch):
+    path = tmp_path / "tilings.json"
+    monkeypatch.setenv(tunings.TUNINGS_FILE_ENV, str(path))
+    tunings.clear_cache()
+    try:
+        assert tunings.resolve("rpa:decode:hkv4:hd64", 128) is None
+        out = tunings.record({"rpa:decode:hkv4:hd64:128": (32,)},
+                             generation="v5e")
+        assert out == str(path)
+        # wrong generation sees nothing; the right one resolves exact
+        # and nearest-smaller seq
+        assert tunings.lookup("rpa:decode:hkv4:hd64:128",
+                              generation="v6e") is None
+        assert tunings.resolve("rpa:decode:hkv4:hd64", 128,
+                               generation="v5e") == (32,)
+        assert tunings.resolve("rpa:decode:hkv4:hd64", 512,
+                               generation="v5e") == (32,)
+        assert tunings.resolve("rpa:decode:hkv4:hd64", 64,
+                               generation="v5e") is None
+        # malformed entries degrade to nothing, never raise
+        path.write_text("{\"v5e\": {\"rpa:x:1\": [\"bad\"]}, \"y\": 3}")
+        tunings.clear_cache()
+        assert tunings.resolve("rpa:x", 1, generation="v5e") is None
+    finally:
+        tunings.clear_cache()
+
+
+def test_kernel_loads_tuned_block(tmp_path, monkeypatch):
+    """block_k=0 resolves through the cache: output is bitwise the same
+    block passed explicitly (proof the persisted winner is what the
+    kernel dispatches on)."""
+    path = tmp_path / "tilings.json"
+    monkeypatch.setenv(tunings.TUNINGS_FILE_ENV, str(path))
+    tunings.clear_cache()
+    try:
+        gen = tunings.device_generation()
+        tunings.record({"rpa:decode:hkv4:hd64:128": [16]}, generation=gen)
+        kq, k, v = _dense()
+        q = jax.random.normal(kq, (3, 1, 8, HD), jnp.bfloat16)
+        base = jnp.asarray([5, 40, 127], jnp.int32)
+        tuned = ragged_paged_attention(q, k, v, base, scale=HD ** -0.5,
+                                       interpret=True)
+        explicit = ragged_paged_attention(q, k, v, base, scale=HD ** -0.5,
+                                          block_k=16, interpret=True)
+        assert bool(jnp.all(tuned == explicit))
+    finally:
+        tunings.clear_cache()
+
+
+def test_generation_for_device_kind():
+    from k8s_gpu_device_plugin_tpu.device.topology import (
+        generation_for_device_kind,
+    )
+
+    assert generation_for_device_kind("TPU v4") == "v4"
+    assert generation_for_device_kind("TPU v5 lite") == "v5e"
+    assert generation_for_device_kind("TPU v5p") == "v5p"
+    assert generation_for_device_kind("TPU v6 lite") == "v6e"
+    assert generation_for_device_kind("gollychip 9000") is None
+    # the CPU test platform lands in its own bucket
+    assert tunings.device_generation() == "cpu"
+
+
+def test_fallback_streams_bitwise_equal_auto(kernel_setup):
+    """A ragged opt-in OFF the kernel's gates (hd=16) serves BITWISE the
+    auto path's streams — the documented graceful-fallback contract at
+    the stream level (the op-level pin lives in test_paged_kv)."""
+    cfg, _ = kernel_setup
+    bad = replace(cfg, head_dim_override=0, decode_attn="ragged")
+    auto = replace(bad, decode_attn="auto")
+    params = init_params(jax.random.key(2), bad)
+    got, _ = _streams(params, bad, 1, "dense")
+    want, _ = _streams(params, auto, 1, "dense")
+    assert got == want
