@@ -68,7 +68,7 @@ san-test:
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs bench-kernels
+	bench-obs bench-kernels bench-router
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -134,6 +134,17 @@ bench-tp:
 bench-kernels:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.kernel_bench
 
+# CPU-runnable smoke: the replica router (serving/router.py) — ring
+# candidate-resolution cost + consistent-hashing stability checks, a
+# miniature 2-replica in-process fleet A/B asserting the affinity arm's
+# aggregate prefix hit rate beats round-robin on a shared-prefix trace
+# with zero dropped streams, and a failover check that kills one
+# replica mid-trace and requires every request served by the survivor
+# (one JSON line with route_us, fleet_prefix_hit_rate_{affinity,rr},
+# fleet_failovers, failover_served).
+bench-router:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.router_bench
+
 # CPU-runnable microbench: the latency-attribution layer's two cost
 # claims — the disabled-path guard is nanoseconds (the whole hot-path
 # cost with attribution off) and the per-retired-request record path
@@ -149,7 +160,7 @@ clean:
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched bench-tp bench-obs bench-kernels clean watch
+	bench-sched bench-tp bench-obs bench-kernels bench-router clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

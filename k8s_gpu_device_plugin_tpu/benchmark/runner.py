@@ -492,7 +492,7 @@ def _run_serve() -> dict:
     # shard over (tp=2 is the first point of the scaling curve; deeper
     # sweeps ride the same field set via BENCH_TP)
     tp_degree = int(os.environ.get("BENCH_TP", 2))
-    r = serve_bench(cfg, spec_ab=True,
+    r = serve_bench(cfg, spec_ab=True, fleet_ab=True,
                     tp_ab=len(_jax.devices()) > 1, tp_degree=tp_degree)
     return {
         "workload": "serve",
@@ -560,7 +560,33 @@ def _run_serve() -> dict:
         "deadline_miss_pct_hi_slo": round(r.deadline_miss_pct_hi_slo, 1),
         "rejected_fifo": r.rejected_fifo,
         "rejected_slo": r.rejected_slo,
+        "retried_ok_fifo": r.retried_ok_fifo,
+        "retried_ok_slo": r.retried_ok_slo,
         "preemptions_slo": r.preemptions_slo,
+        # fleet A/B (serving/router.py + serving/fleet.py): ONE open-
+        # loop trace through a 2-replica in-process fleet, prefix-
+        # affinity vs round-robin routing — the aggregate prefix hit
+        # rate and shared-tenant TTFT p99 per arm (affinity partitions
+        # the shared prefixes across replica caches; rr re-prefills
+        # them everywhere), the router's failover count, and the
+        # rolling-drain cycle's wait (zero dropped streams expected)
+        "fleet_replicas": r.fleet_replicas,
+        "fleet_requests": r.fleet_requests,
+        "fleet_prefix_hit_rate_affinity": round(
+            r.fleet_prefix_hit_rate_affinity, 3
+        ),
+        "fleet_prefix_hit_rate_rr": round(r.fleet_prefix_hit_rate_rr, 3),
+        "fleet_ttft_p99_ms_affinity": round(
+            r.fleet_ttft_p99_ms_affinity, 1
+        ),
+        "fleet_ttft_p99_ms_rr": round(r.fleet_ttft_p99_ms_rr, 1),
+        "fleet_failovers": r.fleet_failovers,
+        "fleet_drain_seconds": round(r.fleet_drain_seconds, 3),
+        "fleet_dropped_streams": r.fleet_dropped_streams,
+        "fleet_drains_failed": r.fleet_drains_failed,
+        "fleet_affinity_hit_pct": round(r.fleet_affinity_hit_pct, 1),
+        "fleet_rejected_affinity": r.fleet_rejected_affinity,
+        "fleet_rejected_rr": r.fleet_rejected_rr,
         # live serving MFU/roofline accounting (metrics/roofline.py):
         # model-FLOPs utilization of the primary pipelined run vs the
         # generation's spec-sheet peak, the decode HBM-roofline

@@ -50,6 +50,17 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   the rejection/preemption counts — the numbers a millions-of-users
   operator actually runs on.
 
+- the fleet A/B (``fleet_ab=True``): ONE open-loop two-tenant trace
+  driven over HTTP through a REAL 2-replica in-process fleet — two
+  InferenceServers behind serving/router.py — under prefix-affinity
+  and round-robin routing arms, with a rolling drain cycle mid-trace
+  in both. Reported: the fleet-aggregate prefix hit rate and the
+  shared-prefix tenant's client-side TTFT p99 per arm (the affinity
+  win: each shared prefix has ONE cache home under affinity; rr
+  re-prefills it on every replica), the router's failover count, the
+  drain cycle's retirement wait, and the dropped-stream count (MUST
+  be zero). ``make bench-router`` is the CPU smoke twin.
+
 - the tensor-parallel sweep A/B (``tp_ab=True``): the same workload
   through a tp-sharded batcher (weights column-cut, KV on the head axis
   over a ``tp_degree``-device mesh — parallel/tp_serving.py), reporting
@@ -70,6 +81,7 @@ on a relayed chip.
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 from dataclasses import dataclass
@@ -151,7 +163,34 @@ class ServeBenchResult:
     deadline_miss_pct_hi_slo: float = 0.0
     rejected_fifo: int = 0
     rejected_slo: int = 0
+    # 429s that got in on a capped Retry-After retry (the harness
+    # client's retry policy — terminal drops stay in rejected_*)
+    retried_ok_fifo: int = 0
+    retried_ok_slo: int = 0
     preemptions_slo: int = 0
+    # fleet A/B (``fleet_ab=True``): the same open-loop methodology
+    # through a 2-replica in-process fleet behind serving/router.py,
+    # prefix-affinity vs round-robin routing over one trace whose gold
+    # tenant spreads across several distinct shared prefixes. Affinity
+    # partitions those prefixes across the replicas' caches (hit rate +
+    # shared-tenant TTFT win); both arms run one rolling drain cycle
+    # (drain each replica, wait for retirement, undrain) with zero
+    # dropped in-flight streams. All zero when fleet_ab=False.
+    fleet_replicas: int = 0
+    fleet_requests: int = 0
+    fleet_prefix_hit_rate_affinity: float = 0.0
+    fleet_prefix_hit_rate_rr: float = 0.0
+    fleet_ttft_p99_ms_affinity: float = 0.0
+    fleet_ttft_p99_ms_rr: float = 0.0
+    fleet_failovers: int = 0
+    fleet_drain_seconds: float = 0.0
+    fleet_dropped_streams: int = 0
+    # rolling-drain attempts that timed out (504 drained:false) across
+    # both arms — a broken drain path must not pass the bench silently
+    fleet_drains_failed: int = 0
+    fleet_affinity_hit_pct: float = 0.0
+    fleet_rejected_affinity: int = 0
+    fleet_rejected_rr: int = 0
     # tensor-parallel sweep A/B (``tp_ab=True``): the same mixed-length
     # workload through a tp-sharded batcher (weights column-cut, KV on
     # the head axis — parallel/tp_serving.py), against the tp=1 primary
@@ -263,16 +302,23 @@ def openloop_trace(
     max_new: int = 32,
     gold_deadline_ms: int = 1500,
     bronze_deadline_ms: int = 0,
+    n_prefix_groups: int = 1,
 ) -> list[dict]:
     """Open-loop arrival trace: Poisson arrivals at ``base_rps`` for
     ``base_s`` seconds, then ``overload_x`` times that for
     ``overload_s`` (the phase every closed-loop benchmark cannot see —
     arrivals do NOT wait for completions). Two tenants: ``gold``
     (priority 0, deadlined, ``shared_prefix_frac`` of its prompts lead
-    with one shared system prefix — the skew real multi-tenant traffic
+    with a shared system prefix — the skew real multi-tenant traffic
     has) and ``bronze`` (priority 2, bulk, random prompts). The trace is
     a plain list of dicts, so callers can also hand-build or replay one
-    (trace-driven mode)."""
+    (trace-driven mode).
+
+    ``n_prefix_groups`` > 1 spreads gold's shared prompts over that
+    many DISTINCT system prefixes (conversation groups) — the working
+    set the fleet A/B partitions across replicas by prefix affinity;
+    1 (the default) keeps the original single-prefix trace byte-stable
+    for the sched A/B."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
@@ -281,9 +327,10 @@ def openloop_trace(
     # gold prompts past the caller's capacity budget (prompt + max_new
     # <= max_len) and crash the submit
     sys_len = max(0, min(sys_len, prompt_len - 1))
-    sys_prefix = rng.integers(
-        1, cfg.vocab_size, size=sys_len, dtype=np.int32
-    ).tolist()
+    sys_prefixes = [
+        rng.integers(1, cfg.vocab_size, size=sys_len, dtype=np.int32).tolist()
+        for _ in range(max(1, n_prefix_groups))
+    ]
 
     def arrivals(t0: float, dur: float, rps: float, phase: str):
         t = t0
@@ -293,12 +340,20 @@ def openloop_trace(
             if t >= t0 + dur:
                 return out
             gold = bool(rng.random() < gold_frac)
+            group = None
             if gold and sys_len and rng.random() < shared_prefix_frac:
+                # only draw the group index when there IS a choice —
+                # n_prefix_groups=1 must not perturb the rng stream
+                # the existing sched-A/B traces come from
+                group = (
+                    int(rng.integers(len(sys_prefixes)))
+                    if len(sys_prefixes) > 1 else 0
+                )
                 tail = rng.integers(
                     1, cfg.vocab_size, size=prompt_len - sys_len,
                     dtype=np.int32,
                 ).tolist()
-                prompt = sys_prefix + tail
+                prompt = sys_prefixes[group] + tail
             else:
                 prompt = rng.integers(
                     1, cfg.vocab_size, size=prompt_len, dtype=np.int32
@@ -312,6 +367,7 @@ def openloop_trace(
                 "prompt": prompt,
                 "max_new": max_new,
                 "phase": phase,
+                "group": group,
             })
 
     trace = arrivals(0.0, base_s, base_rps, "base")
@@ -320,40 +376,73 @@ def openloop_trace(
     return trace
 
 
-def open_loop_run(cb, trace: list[dict]) -> dict:
+def open_loop_run(cb, trace: list[dict], retries: int = 1,
+                  max_retry_wait_s: float = 1.0) -> dict:
     """Drive one batcher through an open-loop trace in real time:
     arrivals submit at their clock instant whatever the queue looks
-    like; queue-full submissions count as rejections and are dropped
-    (what the HTTP plane's 429 does). Returns per-request facts plus
-    the scheduler's own counters."""
+    like. A queue-full rejection is NOT a terminal drop: the harness
+    honors the scheduler's ``Retry-After`` hint (capped at
+    ``max_retry_wait_s``) and re-submits up to ``retries`` times — what
+    a well-behaved HTTP client does with a 429 — so ``rejected`` counts
+    only requests that exhausted their retries, and ``retried_ok``
+    counts the ones a retry got in (``retries=0`` restores the old
+    drop-on-first-429 behavior). Returns per-request facts plus the
+    scheduler's own counters."""
     from k8s_gpu_device_plugin_tpu.serving.scheduler import (
         SchedulerOverloadError,
     )
 
     meta: dict[int, dict] = {}
     sync_rejected = 0
+    retried_ok = 0
+    retryq: list[tuple[float, int, dict]] = []  # (t_due, attempt, event)
     i = 0
     t0 = time.perf_counter()
-    while i < len(trace) or cb.pending or cb.prefilling or cb.running:
+
+    def submit(e: dict, attempt: int, now: float) -> None:
+        nonlocal sync_rejected, retried_ok
+        try:
+            rid = cb.submit(
+                e["prompt"], max_new=e["max_new"], tenant=e["tenant"],
+                priority=e["priority"], deadline_ms=e["deadline_ms"],
+            )
+        except SchedulerOverloadError as err:
+            if attempt < retries:
+                wait = min(float(err.retry_after), max_retry_wait_s)
+                retryq.append((now + wait, attempt + 1, e))
+                return
+            if cb.scheduler is not None:
+                cb.scheduler.count_sync_rejection(cb)
+            sync_rejected += 1
+            return
+        if attempt:
+            retried_ok += 1
+        meta[rid] = e
+
+    while (i < len(trace) or retryq
+           or cb.pending or cb.prefilling or cb.running):
         now = time.perf_counter() - t0
+        due = sorted(
+            (r for r in retryq if r[0] <= now), key=lambda r: r[0]
+        )
+        if due:
+            retryq = [r for r in retryq if r[0] > now]
+            for t_due, attempt, e in due:
+                submit(e, attempt, now)
         while i < len(trace) and trace[i]["t"] <= now:
             e = trace[i]
             i += 1
-            try:
-                rid = cb.submit(
-                    e["prompt"], max_new=e["max_new"], tenant=e["tenant"],
-                    priority=e["priority"], deadline_ms=e["deadline_ms"],
-                )
-            except SchedulerOverloadError:
-                if cb.scheduler is not None:
-                    cb.scheduler.count_sync_rejection(cb)
-                sync_rejected += 1
-                continue
-            meta[rid] = e
+            submit(e, 0, now)
         if cb.pending or cb.prefilling or cb.running:
             cb.step()
-        elif i < len(trace):
-            time.sleep(max(0.0, min(0.005, trace[i]["t"] - now)))
+        else:
+            waits = []
+            if i < len(trace):
+                waits.append(trace[i]["t"] - now)
+            if retryq:
+                waits.append(min(r[0] for r in retryq) - now)
+            if waits:
+                time.sleep(max(0.0, min(0.005, min(waits))))
     wall = time.perf_counter() - t0
 
     per_request = []
@@ -389,6 +478,7 @@ def open_loop_run(cb, trace: list[dict]) -> dict:
         "offered": len(trace),
         "submitted": len(meta),
         "rejected": sync_rejected + async_rejected,
+        "retried_ok": retried_ok,
         "preemptions": stats.get("preemptions", 0),
         "per_request": per_request,
         "sched_stats": stats,
@@ -514,6 +604,7 @@ def sched_openloop_ab(
                 ))
             ),
             "rejected": arm["rejected"],
+            "retried_ok": arm.get("retried_ok", 0),
             "preemptions": arm["preemptions"],
         }
 
@@ -548,7 +639,276 @@ def sched_openloop_ab(
         "deadline_miss_pct_hi_slo": s["miss_pct_hi"],
         "rejected_fifo": f["rejected"],
         "rejected_slo": s["rejected"],
+        "retried_ok_fifo": f["retried_ok"],
+        "retried_ok_slo": s["retried_ok"],
         "preemptions_slo": s["preemptions"],
+    }
+
+
+def fleet_openloop_ab(
+    cfg,
+    params,
+    *,
+    n_slots: int,
+    max_len: int,
+    prompt_buckets: tuple[int, ...],
+    chunked_prefill: int,
+    base_rps: float,
+    base_s: float = 4.0,
+    overload_x: float = 2.0,
+    overload_s: float = 4.0,
+    max_new: int = 32,
+    prompt_len: int = 96,
+    sys_len: "int | None" = None,
+    n_prefix_groups: int = 6,
+    gold_frac: float = 0.5,
+    shared_prefix_frac: float = 0.9,
+    gold_deadline_ms: int = 1500,
+    prefix_cache_mb: int = 64,
+    max_queue: int = 0,
+    load_factor: float = 2.0,
+    drain_cycle: bool = True,
+    seed: int = 0,
+    trace: "list[dict] | None" = None,
+) -> dict:
+    """The fleet A/B: ONE open-loop two-tenant trace driven over HTTP
+    through a 2-replica IN-PROCESS fleet (serving/router.py in front of
+    two real InferenceServers), once under prefix-affinity routing and
+    once under round-robin. What it measures:
+
+    - ``fleet_prefix_hit_rate_{affinity,rr}``: the fleet-aggregate
+      prefix-cache hit rate. Affinity partitions the gold tenant's
+      ``n_prefix_groups`` conversation prefixes across replicas (each
+      prefix always lands where its cache lives); rr scatters them, so
+      every replica re-prefills every prefix cold — the whole reason
+      placement is semantically load-bearing.
+    - ``fleet_ttft_p99_ms_{affinity,rr}``: TTFT p99 for the
+      shared-prefix gold requests, measured CLIENT-side from the
+      arrival instant (open-loop: queueing and the router both count).
+    - ``fleet_failovers``: ring-candidate retries the affinity arm's
+      router performed (429 spill under the overload phase, plus any
+      connection failures).
+    - ``fleet_drain_seconds`` / ``fleet_dropped_streams``: both arms
+      run one rolling drain cycle mid-trace (drain each replica in
+      turn, wait for retirement, undrain — the rolling-update
+      primitive); the drain wait is reported and every in-flight
+      stream must still deliver its done event (dropped == 0).
+
+    Each replica runs its own prefix cache and a queue-capped fifo
+    scheduler (the 429 path is what exercises failover). Client 429s
+    are retried once after the (capped) Retry-After, mirroring
+    ``open_loop_run``'s capped-retry policy."""
+    import asyncio
+
+    import aiohttp
+
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+    from k8s_gpu_device_plugin_tpu.serving.testing import inprocess_fleet
+
+    buckets = tuple(b for b in prompt_buckets if b <= max_len)
+    if sys_len is None:
+        # the shared prefix must COVER a prompt-bucket boundary, or
+        # neither the affinity key (bucket-aligned by construction) nor
+        # the prefix cache (boundary-promoted) can tell shared from
+        # random — default to the largest boundary that leaves a suffix
+        below = [b for b in buckets if b < prompt_len]
+        sys_len = max(below) if below else prompt_len // 2
+    if trace is None:
+        trace = openloop_trace(
+            cfg, seed=seed, base_s=base_s, overload_s=overload_s,
+            base_rps=base_rps, overload_x=overload_x,
+            prompt_len=prompt_len, sys_len=sys_len, max_new=max_new,
+            gold_frac=gold_frac, shared_prefix_frac=shared_prefix_frac,
+            gold_deadline_ms=gold_deadline_ms,
+            n_prefix_groups=n_prefix_groups,
+        )
+    if not max_queue:
+        max_queue = 4 * n_slots
+
+    async def drive(session, base, t0, e, results):
+        await asyncio.sleep(max(0.0, t0 + e["t"] - time.perf_counter()))
+        t_arrive = time.perf_counter()
+        body = {
+            "prompt": e["prompt"], "max_new": e["max_new"], "stream": True,
+            "tenant": e["tenant"], "priority": e["priority"],
+        }
+        if e["deadline_ms"]:
+            body["deadline_ms"] = e["deadline_ms"]
+        fact = {
+            "tenant": e["tenant"], "phase": e["phase"],
+            "shared": e.get("group") is not None,
+            "ttft_s": None, "done": False, "rejected": False,
+            "dropped": False, "retried": 0,
+        }
+        results.append(fact)
+        for attempt in range(2):  # capped 429 retry (open_loop_run's rule)
+            try:
+                async with session.post(
+                    f"{base}/v1/generate", json=body
+                ) as r:
+                    if r.status == 429:
+                        if attempt == 0:
+                            try:
+                                ra = float(r.headers.get("Retry-After", "1"))
+                            except ValueError:
+                                ra = 1.0
+                            fact["retried"] += 1
+                            await asyncio.sleep(min(ra, 1.0))
+                            continue
+                        fact["rejected"] = True
+                        return
+                    if r.status != 200:
+                        # a clean refusal (e.g. the router's 503 while
+                        # every replica drains): no stream ever started,
+                        # so this is a rejection, NOT a dropped stream
+                        fact["rejected"] = True
+                        return
+                    got_token = False
+                    async for line in r.content:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        evt = json.loads(line[len("data: "):])
+                        if "token" in evt and not got_token:
+                            got_token = True
+                            fact["ttft_s"] = time.perf_counter() - t_arrive
+                        if evt.get("done"):
+                            fact["done"] = True
+                            if evt.get("rejected") and not got_token:
+                                # queued-then-rejected rides the done
+                                # event on an SSE stream (a 200 that
+                                # produced nothing): overload, not a drop
+                                fact["rejected"] = True
+                                fact["done"] = False
+                            return
+                    fact["dropped"] = True  # stream ended without done
+                    return
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ConnectionResetError, OSError):
+                fact["dropped"] = True
+                return
+
+    async def rolling_drain(session, rbase, at_s, rids, out):
+        await asyncio.sleep(at_s)
+        total = 0.0
+        for rid in rids:
+            async with session.post(f"{rbase}/fleet/drain/{rid}") as r:
+                d = await r.json()
+                total += float(d.get("drain_seconds", 0.0))
+                out.setdefault("drained", []).append(
+                    bool(d.get("drained", False))
+                )
+            async with session.post(f"{rbase}/fleet/undrain/{rid}") as r:
+                await r.read()
+        out["drain_seconds"] = total
+
+    async def run_arm(policy: str) -> dict:
+        caches: list = []
+
+        def engine_factory(i: int):
+            pc = PrefixCache(cfg, buckets=buckets,
+                             budget_bytes=prefix_cache_mb << 20)
+            caches.append(pc)
+            return InferenceEngine(
+                params, cfg, n_slots=n_slots, max_len=max_len,
+                chunked_prefill=chunked_prefill, prompt_buckets=buckets,
+                prefix_cache=pc, scheduler=Scheduler(max_queue=max_queue),
+            )
+
+        results: list[dict] = []
+        dstate: dict = {}
+        async with inprocess_fleet(
+            params, cfg, n_replicas=2, engine_factory=engine_factory,
+            router_kw=dict(
+                policy=policy, prompt_buckets=buckets,
+                health_interval_s=0.2, drain_timeout_s=60.0,
+                load_factor=load_factor,
+            ),
+        ) as fl:
+            async with aiohttp.ClientSession() as session:
+                # warm each replica SEQUENTIALLY before any concurrency:
+                # all trace prompts share one bucket shape, so one
+                # direct request per replica compiles the chunk/finish/
+                # decode jits while this task is the only submitter
+                # (two engine threads compiling at once has segfaulted
+                # XLA:CPU — see serving/server.py's embedder note)
+                warm_prompt = [
+                    1 + (i % (cfg.vocab_size - 1)) for i in range(prompt_len)
+                ]
+                # ...and a shared-prefix twin, so the cache's promotion
+                # AND match/insert jits are compiled too (the first hit
+                # otherwise pays the insert compile mid-trace, spiking
+                # whichever arm runs first)
+                warm_hit = warm_prompt[:-1] + [1]
+                for i in range(2):
+                    for wp in (warm_prompt, warm_hit):
+                        async with session.post(
+                            f"{fl.replica_base(i)}/v1/generate",
+                            json={"prompt": wp, "max_new": max_new},
+                        ) as r:
+                            await r.read()
+                t0 = time.perf_counter()
+                aux = []
+                if drain_cycle:
+                    # mid-base-phase rolling drain: both arms pay it, so
+                    # the TTFT comparison stays fair
+                    aux.append(asyncio.ensure_future(rolling_drain(
+                        session, fl.base, 0.5 * base_s,
+                        [r.rid for r in fl.fleet.all()], dstate,
+                    )))
+                await asyncio.gather(*(
+                    drive(session, fl.base, t0, e, results) for e in trace
+                ))
+                for a in aux:
+                    await a
+                stats = fl.router.router_stats()
+        hits = sum(c.stats.as_dict()["hits"] for c in caches)
+        misses = sum(c.stats.as_dict()["misses"] for c in caches)
+        shared_ttfts = [
+            f["ttft_s"] for f in results
+            if f["shared"] and f["ttft_s"] is not None
+        ]
+        return {
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "ttft_p99_ms": _pct(shared_ttfts, 99) * 1000.0,
+            "failovers": stats["failovers"],
+            "affinity_hits": stats["affinity_hits"],
+            "requests": stats["requests"],
+            "dropped": sum(1 for f in results if f["dropped"]),
+            "rejected": sum(1 for f in results if f["rejected"]),
+            "retried": sum(f["retried"] for f in results),
+            "drain_seconds": float(dstate.get("drain_seconds", 0.0)),
+            "drained": list(dstate.get("drained", [])),
+        }
+
+    async def both() -> tuple[dict, dict]:
+        aff = await run_arm("affinity")
+        rr = await run_arm("rr")
+        return aff, rr
+
+    aff, rr = asyncio.run(both())
+    return {
+        "fleet_replicas": 2,
+        "fleet_requests": len(trace),
+        "fleet_prefix_hit_rate_affinity": aff["hit_rate"],
+        "fleet_prefix_hit_rate_rr": rr["hit_rate"],
+        "fleet_ttft_p99_ms_affinity": aff["ttft_p99_ms"],
+        "fleet_ttft_p99_ms_rr": rr["ttft_p99_ms"],
+        "fleet_failovers": aff["failovers"],
+        "fleet_drain_seconds": aff["drain_seconds"],
+        "fleet_dropped_streams": aff["dropped"] + rr["dropped"],
+        "fleet_drains_failed": (
+            sum(1 for ok in aff["drained"] if not ok)
+            + sum(1 for ok in rr["drained"] if not ok)
+        ),
+        "fleet_affinity_hit_pct": (
+            100.0 * aff["affinity_hits"] / aff["requests"]
+            if aff["requests"] else 0.0
+        ),
+        "fleet_rejected_affinity": aff["rejected"],
+        "fleet_rejected_rr": rr["rejected"],
     }
 
 
@@ -567,6 +927,7 @@ def serve_bench(
     paged_ab: bool = True,
     spec_ab: bool = False,
     sched_ab: bool = True,
+    fleet_ab: bool = False,
     tp_ab: bool = False,
     tp_degree: int = 2,
     sched_base_s: float = 4.0,
@@ -865,21 +1226,26 @@ def serve_bench(
             saved_pct = 100.0 * (1.0 - computed_cached / computed_cold)
 
     # --- slo-vs-fifo open-loop A/B: one trace, two schedulers ---
+    def measured_capacity_rps() -> float:
+        """Closed-loop capacity of ONE replica at this config — the
+        open-loop arms calibrate their offered rates against it (a
+        fixed rate would either idle a fast chip or bury a slow one,
+        and neither measures scheduling or routing)."""
+        if wall > 0:
+            return n_requests / wall
+        cal = make_batcher(1)
+        for p in prompts[: 2 * n_slots]:
+            cal.submit(p, max_new=max_new)
+        t0 = time.perf_counter()
+        cal.run()
+        return 2 * n_slots / (time.perf_counter() - t0)
+
     sched_fields: dict = {}
     if sched_ab and chunked_prefill:
         # offered load calibrated against this config's measured
         # closed-loop capacity: the base phase runs a touch under it,
-        # the overload phase at 2x — a fixed rate would either idle a
-        # fast chip or bury a slow one, and neither measures scheduling
-        if wall > 0:
-            capacity_rps = n_requests / wall
-        else:
-            cal = make_batcher(1)
-            for p in prompts[: 2 * n_slots]:
-                cal.submit(p, max_new=max_new)
-            t0 = time.perf_counter()
-            cal.run()
-            capacity_rps = 2 * n_slots / (time.perf_counter() - t0)
+        # the overload phase at 2x
+        capacity_rps = measured_capacity_rps()
         base_rps = max(0.5, 0.8 * capacity_rps)
         # gold's deadline: ~4x a request's unloaded service time, so a
         # well-scheduled overload phase can still meet it while a FIFO
@@ -898,6 +1264,34 @@ def serve_bench(
             sys_len=min(48, max_len // 4),
             gold_deadline_ms=gold_deadline_ms,
             max_queue=8 * n_slots,
+        )
+
+    # --- fleet A/B: one trace, 2-replica router, affinity vs rr ---
+    fleet_fields: dict = {}
+    if fleet_ab and chunked_prefill:
+        # base phase a touch under the FLEET's capacity (2 replicas):
+        # routing decides who eats the overload phase's spill
+        capacity_rps = measured_capacity_rps()
+        fleet_fields = fleet_openloop_ab(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            chunked_prefill=chunked_prefill,
+            base_rps=max(0.5, 1.5 * capacity_rps),
+            base_s=sched_base_s, overload_s=sched_overload_s,
+            max_new=max_new,
+            # one bucket boundary + headroom, so the shared prefixes
+            # cover a promotable/hashable boundary (sys_len defaults
+            # to the largest boundary below prompt_len)
+            prompt_len=min(
+                int(1.5 * min(prompt_buckets)), max_len - max_new - 1
+            ),
+            max_queue=4 * n_slots,
+        )
+    elif fleet_ab:
+        print(
+            "serve_bench: fleet A/B skipped — the fleet replicas "
+            "require chunked_prefill (the prefix cache's substrate)",
+            file=sys.stderr,
         )
 
     # --- tensor-parallel sweep A/B: the same workload tp-sharded ---
@@ -1054,5 +1448,6 @@ def serve_bench(
         goodput_tokens_per_tflop=good_per_tflop,
         mfu_generation=mfu_gen,
         **sched_fields,
+        **fleet_fields,
         **tp_fields,
     )

@@ -1,0 +1,260 @@
+"""Fleet replica registry: the bookkeeping half of the replica router.
+
+PRs 1-10 built a single-replica serving stack; serving/router.py makes
+N of those replicas act like one service. This module is the router's
+state — deliberately free of HTTP so the routing policy is testable as
+plain objects:
+
+- :class:`Replica`: one backend engine's registry entry — its base URL,
+  router-side in-flight count, drain flag, liveness bookkeeping (the
+  health poller and the proxy's connection failures both feed it), the
+  last ``/v1/health`` payload, and the 429 ``Retry-After`` cooldown.
+- :class:`FleetRegistry`: the replica set plus the aggregate
+  ``GET /fleet/health`` snapshot.
+- :class:`HashRing`: a consistent-hash ring over replica ids (virtual
+  nodes, stable byte hashing — NOT Python's salted ``hash()``), so the
+  same affinity key maps to the same replica across router restarts.
+- :func:`affinity_key`: the routing key — the request's
+  **bucket-aligned token-prefix path**, truncated at the largest
+  ``prompt_buckets`` boundary the prompt covers. These are exactly the
+  boundaries serving/prefix_cache.py promotes at, so two prompts that
+  can share a cached prefix hash to the same ring point and land where
+  that cache lives; bytes past the last boundary cannot be cached and
+  must not split the key.
+
+Thread model: everything here is event-loop state owned by the router's
+asyncio task (single-threaded, like the rest of the router) — no locks,
+no cross-thread readers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import time
+from urllib.parse import urlparse
+
+
+def _digest(data: bytes) -> int:
+    """Stable 64-bit hash (blake2b): Python's ``hash()`` is salted per
+    process, which would re-deal every tenant's cache home on restart."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big"
+    )
+
+
+def affinity_key(source, buckets: tuple[int, ...]) -> bytes | None:
+    """Request -> routing key bytes (None = no affinity; balance only).
+
+    ``source`` is whatever prefix-bearing field the surface carries:
+    a token-id list (native ``prompt`` / OpenAI id-list prompts), a
+    string (text prompts — byte length stands in for token length), or
+    any JSON-serializable structure (chat ``messages``). The key is the
+    prefix up to the largest ``buckets`` boundary the sequence reaches —
+    the prefix cache's promotion ladder — so requests sharing a
+    cacheable prefix share a key, and divergence past the last boundary
+    (uncacheable) does not scatter them."""
+    if source is None:
+        return None
+    if isinstance(source, (list, tuple)) and source and all(
+        isinstance(t, int) and not isinstance(t, bool) for t in source
+    ):
+        n = len(source)
+        cut = max((b for b in buckets if b <= n), default=n)
+        return ",".join(str(t) for t in source[:cut]).encode()
+    if isinstance(source, str):
+        if not source:
+            return None
+        raw = source.encode()
+        cut = max((b for b in buckets if b <= len(raw)), default=len(raw))
+        return raw[:cut]
+    try:
+        raw = json.dumps(source, sort_keys=True).encode()
+    except (TypeError, ValueError):
+        return None
+    cut = max((b for b in buckets if b <= len(raw)), default=len(raw))
+    return raw[:cut]
+
+
+class HashRing:
+    """Consistent hashing with virtual nodes. ``candidates(key)`` walks
+    the ring from the key's point and yields each distinct replica id
+    once — index 0 is the key's HOME (where its cache lives); the rest
+    are the failover/spill order, stable under membership changes in
+    the usual consistent-hashing way (adding a replica moves ~1/N of
+    the keyspace, not all of it)."""
+
+    def __init__(self, ids: list[str], vnodes: int = 64):
+        self._points: list[int] = []
+        self._owner: dict[int, str] = {}
+        self.ids = list(ids)
+        for rid in ids:
+            for v in range(vnodes):
+                p = _digest(f"{rid}#{v}".encode())
+                # a full 64-bit collision across ids is ~impossible;
+                # last-writer-wins keeps construction deterministic
+                self._owner[p] = rid
+                self._points.append(p)
+        self._points.sort()
+
+    def candidates(self, key: bytes) -> list[str]:
+        if not self._points:
+            return []
+        h = _digest(key)
+        i = bisect.bisect_right(self._points, h)
+        seen: list[str] = []
+        for j in range(len(self._points)):
+            rid = self._owner[self._points[(i + j) % len(self._points)]]
+            if rid not in seen:
+                seen.append(rid)
+                if len(seen) == len(self.ids):
+                    break
+        return seen
+
+
+class Replica:
+    """One backend's registry entry (event-loop state, router-owned)."""
+
+    __slots__ = (
+        "rid", "url", "draining", "alive", "consecutive_failures",
+        "health", "health_t", "inflight", "relayed", "cooldown_until",
+        "reported_id",
+    )
+
+    def __init__(self, rid: str, url: str):
+        self.rid = rid
+        self.url = url.rstrip("/")
+        self.draining = False
+        self.alive = True          # optimistic until dead_after failures
+        self.consecutive_failures = 0
+        self.health: dict | None = None   # last /v1/health payload
+        self.health_t = 0.0
+        self.inflight = 0          # router-side: requests being relayed
+        self.relayed = 0           # completed relays (any outcome)
+        self.cooldown_until = 0.0  # honor a 429's Retry-After
+        self.reported_id: str | None = None  # replica_id from /v1/health
+
+    def routable(self, now: float) -> bool:
+        return (
+            self.alive and not self.draining and now >= self.cooldown_until
+        )
+
+
+def _id_from_url(url: str) -> str:
+    p = urlparse(url if "//" in url else f"http://{url}")
+    host = p.hostname or url
+    return f"{host}:{p.port}" if p.port else host
+
+
+class FleetRegistry:
+    """The replica set + liveness bookkeeping + the aggregate snapshot."""
+
+    def __init__(self, replicas: list[Replica], dead_after: int = 3):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        ids = [r.rid for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self._replicas: dict[str, Replica] = {r.rid: r for r in replicas}
+        self.dead_after = int(dead_after)
+
+    @classmethod
+    def from_spec(cls, spec: str, dead_after: int = 3) -> "FleetRegistry":
+        """``--replicas`` value -> registry. Entries are
+        ``id=http://host:port`` or bare URLs (id defaults to the URL's
+        host:port — matching the replica's own ``--replicaId`` default
+        when replicas are addressed by hostname; fleets addressed by
+        IP/service DNS should name ids explicitly on both sides. The
+        health-reported id lands in ``reported_id`` either way, so a
+        mismatch shows on /fleet/health instead of hiding)."""
+        reps = []
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" in entry and not entry.split("=", 1)[0].startswith("http"):
+                rid, url = entry.split("=", 1)
+                rid = rid.strip()
+            else:
+                url, rid = entry, _id_from_url(entry)
+            url = url.strip()
+            if not rid or not url:
+                raise ValueError(f"--replicas entry {entry!r}: "
+                                 "expected [id=]http://host:port")
+            if "://" not in url:
+                # a scheme-less 'host:port' would raise InvalidURL on
+                # every request — a silently permanently-dead replica
+                url = f"http://{url}"
+            reps.append(Replica(rid, url))
+        return cls(reps, dead_after=dead_after)
+
+    def get(self, rid: str) -> Replica | None:
+        return self._replicas.get(rid)
+
+    def all(self) -> list[Replica]:
+        return list(self._replicas.values())
+
+    def ids(self) -> list[str]:
+        return list(self._replicas)
+
+    # --- liveness (fed by the health poller AND proxy failures) ---------
+
+    def note_success(self, rep: Replica, health: dict | None = None) -> None:
+        rep.consecutive_failures = 0
+        rep.alive = True
+        if health is not None:
+            rep.health = health
+            rep.health_t = time.monotonic()
+            rep.reported_id = health.get("replica_id", rep.reported_id)
+
+    def note_failure(self, rep: Replica) -> None:
+        rep.consecutive_failures += 1
+        if rep.consecutive_failures >= self.dead_after:
+            rep.alive = False
+
+    # --- views -----------------------------------------------------------
+
+    def any_draining(self) -> bool:
+        return any(r.draining for r in self._replicas.values())
+
+    def snapshot(self) -> dict:
+        """The ``GET /fleet/health`` aggregate: per-replica state plus
+        fleet-level tallies (plain copies; everything is loop-owned)."""
+        now = time.monotonic()
+        reps = {}
+        for r in self._replicas.values():
+            h = r.health or {}
+            reps[r.rid] = {
+                "url": r.url,
+                "alive": r.alive,
+                "draining": r.draining,
+                "inflight": r.inflight,
+                "relayed": r.relayed,
+                "consecutive_failures": r.consecutive_failures,
+                "cooldown_s": round(max(0.0, r.cooldown_until - now), 3),
+                "reported_id": r.reported_id,
+                "health_age_s": (
+                    round(now - r.health_t, 3) if r.health_t else None
+                ),
+                # the balancing-relevant slice of the replica's own
+                # health (queue depth, slot occupancy, kv pool pressure,
+                # scheduler rejections) — dashboards get the digest
+                # without a second scrape fan-out
+                "queued": h.get("queued"),
+                "active": h.get("active"),
+                "prefilling": h.get("prefilling"),
+                "uptime_s": h.get("uptime_s"),
+                "kv": h.get("kv"),
+                "sched_rejections": (h.get("sched") or {}).get("rejections"),
+            }
+        live = [r for r in self._replicas.values() if r.alive]
+        return {
+            "replicas": reps,
+            "total": len(self._replicas),
+            "live": len(live),
+            "draining": sum(
+                1 for r in self._replicas.values() if r.draining
+            ),
+            "inflight": sum(r.inflight for r in self._replicas.values()),
+        }

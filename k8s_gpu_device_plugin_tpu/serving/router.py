@@ -1,0 +1,845 @@
+"""Prefix-affinity replica router: N engines behaving like one service.
+
+Everything below the router is a single-replica stack (one
+``InferenceEngine`` per process, serving/server.py); this is the first
+scale-out tier (ROADMAP item 3a): an asyncio HTTP front end exposing
+the SAME native + OpenAI surfaces, fanning requests out to N replica
+backends. Three decisions per request, in order:
+
+1. **Affinity** (``--policy affinity``, the default): the request's
+   bucket-aligned token-prefix path (serving/fleet.py
+   :func:`~k8s_gpu_device_plugin_tpu.serving.fleet.affinity_key` — the
+   same ``prompt_buckets`` boundaries the prefix cache promotes at)
+   hashes onto a consistent-hash ring; the first ring candidate is the
+   key's HOME, where its cached prefix lives. Routing a shared-system-
+   prompt tenant anywhere else re-pays the whole prefill — placement is
+   semantically load-bearing, not just balancing. ``--policy rr``
+   round-robins instead (the A/B arm serve_bench measures against).
+2. **Bounded load**: a home drowning in work must spill — the classic
+   consistent-hashing-with-bounded-loads rule: a candidate already
+   carrying more than ``load_factor`` x the fleet's mean in-flight
+   count is skipped for the next ring candidate (so spill traffic is
+   deterministic too, not scattered).
+3. **Failover**: a connection failure or 429 moves to the next ring
+   candidate. 429s honor ``Retry-After`` — the replica is cooled down
+   for that long, so a whole burst doesn't re-probe a replica that
+   just said "not now". Only failures BEFORE response headers are
+   retried: once a stream has started, replaying it would duplicate
+   tokens the client already consumed, so a mid-stream death surfaces
+   as the stream closing (the client's retry is the safe one).
+
+Liveness comes from polling each replica's ``/v1/health`` (the queue
+depth / kv pool pressure / sched stats the engines already export):
+``dead_after`` consecutive failures (poll or proxy) mark a replica
+dead and routing skips it; any success revives it. Fleet operations:
+
+- ``POST /fleet/drain/{replica}``: stop NEW admissions to a replica
+  (the router is the fleet's admission seam, the same valve the
+  scheduler's queue cap rides inside one replica) and wait until its
+  in-flight streams retire — the rolling-update primitive. Returns
+  ``drain_seconds``; 504 with ``drained: false`` past
+  ``drain_timeout_s``.
+- ``POST /fleet/undrain/{replica}``: restore admission.
+- ``GET /fleet/health``: the aggregate (per-replica liveness, drain
+  state, in-flight, health digest) + the router's own counters.
+
+When NO replica can admit, submits are refused with a structured 503 —
+``{"code": "draining"}`` when drains caused it (both API surfaces:
+native top-level code, OpenAI error envelope), ``{"code":
+"no_replica"}`` when the fleet is dead. When every candidate answered
+429, the LAST 429 (body + Retry-After) is forwarded — overload is the
+backend's message to deliver, not the router's to invent.
+
+The proxy is byte-transparent: request bodies are forwarded exactly as
+received and response bodies/SSE frames are relayed unmodified, so
+token/logprob streams through the router are bit-identical to
+direct-to-replica submission (pinned in tests/test_router.py). Spans
+propagate via W3C ``traceparent`` — the router's proxy span becomes
+the remote parent of the replica's ``serving_http`` span, so one trace
+covers edge -> router -> replica -> engine.
+
+Event-loop discipline: the router is single-threaded asyncio end to
+end — backend I/O rides one shared aiohttp ClientSession, waits are
+``asyncio.sleep``, and the blocking-in-async graftlint checker keeps
+it that way (the firing fixture covers exactly this proxy shape).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import time
+
+import aiohttp
+from aiohttp import web
+
+from k8s_gpu_device_plugin_tpu.serving.fleet import (
+    FleetRegistry,
+    HashRing,
+    Replica,
+    affinity_key,
+)
+from k8s_gpu_device_plugin_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+log = get_logger()
+
+#: proxied POST surfaces (both APIs; the router adds nothing of its own
+#: to them — byte-transparent by contract)
+PROXY_POSTS = (
+    "/v1/generate", "/v1/completions", "/v1/chat/completions",
+    "/v1/embeddings",
+)
+
+
+class RouterMetrics:
+    """Prometheus mirror of the router's counters (optional — the plain
+    ``router_stats()`` snapshot always exists). Collector names are
+    fixed; call :meth:`close` before building a second instance on the
+    same registry (tests, restarts)."""
+
+    def __init__(self, registry=None, prefix: str = "tpu_router"):
+        from prometheus_client import REGISTRY, Counter, Gauge
+
+        self._registry = registry if registry is not None else REGISTRY
+        self.requests = Counter(
+            f"{prefix}_requests_total",
+            "Requests relayed, by replica and outcome",
+            ["replica", "outcome"],
+            registry=self._registry,
+        )
+        self.affinity_hits = Counter(
+            f"{prefix}_affinity_hits_total",
+            "Requests dispatched to their ring-home replica",
+            registry=self._registry,
+        )
+        self.failovers = Counter(
+            f"{prefix}_failovers_total",
+            "Dispatch attempts beyond the first candidate "
+            "(connection failure or 429 moved the request on)",
+            registry=self._registry,
+        )
+        self.inflight = Gauge(
+            f"{prefix}_inflight",
+            "Requests currently relayed to each replica",
+            ["replica"],
+            registry=self._registry,
+        )
+        self.replica_up = Gauge(
+            f"{prefix}_replica_up",
+            "1 = replica routable (alive, not draining, not cooling down)",
+            ["replica"],
+            registry=self._registry,
+        )
+
+    def close(self) -> None:
+        for c in (self.requests, self.affinity_hits, self.failovers,
+                  self.inflight, self.replica_up):
+            try:
+                self._registry.unregister(c)
+            except KeyError:
+                pass  # already unregistered
+
+
+class _Unreachable(Exception):
+    """Connection-level failure before response headers: safe to retry
+    the next ring candidate (no bytes reached the client)."""
+
+
+class _Overloaded(Exception):
+    """Backend answered 429: cool the replica down for Retry-After and
+    try the next candidate; forwarded verbatim if every candidate 429s."""
+
+    def __init__(self, body: bytes, retry_after: int, content_type: str):
+        super().__init__("backend overloaded")
+        self.body = body
+        self.retry_after = retry_after
+        self.content_type = content_type
+
+
+class ReplicaRouter:
+    """aiohttp app over a FleetRegistry (port 0 = ephemeral)."""
+
+    def __init__(
+        self,
+        fleet: FleetRegistry,
+        host: str = "0.0.0.0",
+        port: int = 8100,
+        policy: str = "affinity",
+        prompt_buckets: "tuple[int, ...] | None" = None,  # None = the
+        # batcher's DEFAULT_PROMPT_BUCKETS ladder — affinity keys are
+        # only load-bearing when they cut at the boundaries the
+        # replicas' prefix caches promote at, so a fleet whose replicas
+        # run custom buckets (or a small --maxLen trimming the ladder)
+        # must pass the same ladder here (--promptBuckets on the CLI)
+        load_factor: float = 1.25,
+        health_interval_s: float = 1.0,
+        drain_timeout_s: float = 120.0,
+        connect_timeout_s: float = 2.0,
+        header_timeout_s: float = 0.0,  # 0 = unbounded (see below)
+        registry=None,          # prometheus registry (None = no /metrics)
+        metrics: "RouterMetrics | None" = None,
+    ):
+        if policy not in ("affinity", "rr"):
+            raise ValueError(
+                f"unknown router policy {policy!r} "
+                "(expected 'affinity' or 'rr')"
+            )
+        if load_factor <= 1.0:
+            raise ValueError(
+                f"load_factor must be > 1.0, got {load_factor} "
+                "(1.0 would refuse the mean load itself)"
+            )
+        self.fleet = fleet
+        self.ring = HashRing(fleet.ids())
+        self.host = host
+        self.port = port
+        self.bound_port: int | None = None
+        self.policy = policy
+        if prompt_buckets is None:
+            from k8s_gpu_device_plugin_tpu.models.batching import (
+                DEFAULT_PROMPT_BUCKETS,
+            )
+
+            prompt_buckets = DEFAULT_PROMPT_BUCKETS
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.load_factor = float(load_factor)
+        self.health_interval_s = float(health_interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        # bound the HEADER phase of a dispatch (a wedged replica whose
+        # socket accepts but never answers should fail over like a
+        # connection failure). 0 disables: a non-streamed generate
+        # answers headers only when generation COMPLETES, which can
+        # legitimately take minutes on a cold compile — operators who
+        # stream (headers arrive at prepare time) can set this tight.
+        self.header_timeout_s = float(header_timeout_s)
+        self.registry = registry
+        self.metrics = metrics
+        self.tracer = get_tracer()
+        self._rr_next = 0
+        # plain counters (always on; RouterMetrics mirrors them): the
+        # serve-bench fleet A/B and /fleet/health read these
+        self._requests = 0
+        self._affinity_hits = 0
+        self._failovers = 0
+        self._refused: dict[str, int] = {}
+        self._outcomes: dict[str, int] = {}
+        self._session: aiohttp.ClientSession | None = None
+        self._poll_task: asyncio.Task | None = None
+        self.app = web.Application(middlewares=[self._trace_middleware])
+        for path in PROXY_POSTS:
+            self.app.router.add_post(path, self._proxy_post)
+        self.app.router.add_get("/v1/models", self._proxy_get)
+        self.app.router.add_get("/v1/health", self._health)
+        self.app.router.add_get("/fleet/health", self._fleet_health)
+        self.app.router.add_post("/fleet/drain/{replica}", self._drain)
+        self.app.router.add_post("/fleet/undrain/{replica}", self._undrain)
+        if registry is not None:
+            self.app.router.add_get("/metrics", self._metrics)
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def run(self, stop: asyncio.Event) -> None:
+        """Serve until ``stop`` is set (the InferenceServer idiom)."""
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=None, connect=self.connect_timeout_s,
+            )
+        )
+        runner = web.AppRunner(self.app)
+        try:
+            # everything past session creation is inside the try: a bind
+            # failure must not leak the session or a live poller into
+            # the embedding process (serving/testing.py fleets)
+            self._poll_task = asyncio.ensure_future(self._poll_loop())
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self.bound_port = (
+                runner.addresses[0][1] if runner.addresses else None
+            )
+            log.info(
+                "replica router listening",
+                extra={"fields": {
+                    "addr": f"{self.host}:{self.bound_port}",
+                    "policy": self.policy,
+                    "replicas": self.fleet.ids(),
+                }},
+            )
+            await stop.wait()
+        finally:
+            if self._poll_task is not None:
+                self._poll_task.cancel()
+                try:
+                    await self._poll_task
+                except asyncio.CancelledError:
+                    pass
+                self._poll_task = None
+            await runner.cleanup()
+            await self._session.close()
+            self._session = None
+
+    # --- health polling ---------------------------------------------------
+
+    async def _probe_health(self, rep: Replica) -> dict | None:
+        """One /v1/health contact, feeding the liveness ledger either
+        way: a 200 payload revives the replica, anything else (engine
+        dead behind a live socket, unreachable, garbage JSON) counts a
+        failure. The poll loop AND the drain wait share this."""
+        try:
+            async with self._session.get(
+                f"{rep.url}/v1/health",
+                timeout=aiohttp.ClientTimeout(total=self.connect_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    self.fleet.note_failure(rep)
+                    return None
+                # ValueError covers json.JSONDecodeError (a truncated
+                # body must count as a failed probe, not kill the poller)
+                health = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ValueError):
+            self.fleet.note_failure(rep)
+            return None
+        self.fleet.note_success(rep, health)
+        return health
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.gather(
+                    *(self._probe_health(r) for r in self.fleet.all())
+                )
+                if self.metrics is not None:
+                    now = time.monotonic()
+                    for r in self.fleet.all():
+                        self.metrics.replica_up.labels(r.rid).set(
+                            1 if r.routable(now) else 0
+                        )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a dead poller blinds routing
+                log.exception("router health poll pass failed")
+            await asyncio.sleep(self.health_interval_s)
+
+    # --- tracing ----------------------------------------------------------
+
+    @web.middleware
+    async def _trace_middleware(self, request: web.Request, handler):
+        if not self.tracer.enabled:
+            return await handler(request)
+        from k8s_gpu_device_plugin_tpu.obs.http import route_label
+
+        remote = parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        with self.tracer.span(
+            f"{request.method} {route_label(request)}",
+            component="router_http",
+            parent=remote, method=request.method, path=request.path,
+        ) as span:
+            try:
+                response = await handler(request)
+            except web.HTTPException as http_err:
+                span.set(status_code=http_err.status)
+                http_err.headers[TRACEPARENT_HEADER] = format_traceparent(span)
+                raise
+            span.set(status_code=response.status)
+            if not response.prepared:  # SSE relays already sent headers
+                response.headers[TRACEPARENT_HEADER] = format_traceparent(span)
+            return response
+
+    def _backend_headers(self, request: web.Request) -> dict:
+        headers = {
+            "Content-Type": request.headers.get(
+                "Content-Type", "application/json"
+            ),
+        }
+        if self.tracer.enabled:
+            from k8s_gpu_device_plugin_tpu.obs.trace import current_context
+
+            ctx = current_context()
+            if ctx is not None:
+                # the router span becomes the replica span's remote
+                # parent: one trace covers edge -> router -> engine
+                headers[TRACEPARENT_HEADER] = format_traceparent(ctx)
+        return headers
+
+    # --- routing ----------------------------------------------------------
+
+    def _affinity_source(self, path: str, body) -> object | None:
+        """The prefix-bearing field of each surface. Chat messages key
+        on the serialized message list — the system prompt + history
+        prefix is its head, which is exactly what the replica's prefix
+        cache will match after templating."""
+        if not isinstance(body, dict):
+            return None
+        if path == "/v1/generate":
+            return body.get("prompt") or body.get("text")
+        if path == "/v1/completions":
+            return body.get("prompt")
+        if path == "/v1/chat/completions":
+            return body.get("messages")
+        return None  # embeddings: no KV reuse — balance only
+
+    def _pick(
+        self, key: bytes | None
+    ) -> tuple[list[Replica], "Replica | None"]:
+        """-> (dispatch order, the key's ring HOME or None). Affinity
+        walks the ring from the key's point and applies the
+        bounded-load skip; rr (or a keyless request) rotates /
+        least-loads over the live set. An empty list means nobody can
+        admit right now."""
+        now = time.monotonic()
+        live = [r for r in self.fleet.all() if r.routable(now)]
+        if not live:
+            # cooldown is ADVICE, not refusal: with every candidate
+            # cooling down from a 429, the backend's own 429 (fresh
+            # Retry-After included) is the right answer — not a made-up
+            # 503. Draining/dead replicas stay excluded.
+            live = [
+                r for r in self.fleet.all()
+                if r.alive and not r.draining
+            ]
+        if not live:
+            return [], None
+        usable = set(id(r) for r in live)
+        if self.policy == "rr" or key is None:
+            self._rr_next += 1
+            i = self._rr_next % len(live)
+            return live[i:] + live[:i], None
+        ring_order = [
+            self.fleet.get(rid) for rid in self.ring.candidates(key)
+        ]
+        home = ring_order[0] if ring_order else None
+        order = [
+            r for r in ring_order if r is not None and id(r) in usable
+        ]
+        if not order:
+            return [], None
+        # bounded load: a candidate already past load_factor x the mean
+        # in-flight spills to the NEXT ring candidate (deterministic
+        # spill target), never to a random replica
+        cap = max(2.0, math.ceil(
+            self.load_factor * (sum(r.inflight for r in live) + 1)
+            / len(live)
+        ))
+        target = next((r for r in order if r.inflight < cap), None)
+        if target is None:
+            target = min(order, key=lambda r: r.inflight)
+        rest = [r for r in order if r is not target]
+        return [target] + rest, home
+
+    # --- refusals (per-surface shapes) ------------------------------------
+
+    def _refuse(self, path: str, code: str, message: str,
+                status: int = 503) -> web.Response:
+        self._refused[code] = self._refused.get(code, 0) + 1
+        if self.metrics is not None:
+            self.metrics.requests.labels("none", code).inc()
+        if path == "/v1/generate":
+            # the native structured-error shape (the 429 body's sibling)
+            return web.json_response(
+                {"error": message, "code": code}, status=status
+            )
+        # OpenAI envelope; 5xx reads as retryable server_error, which is
+        # what a drain IS from the client's side — retry lands post-drain
+        return web.json_response(
+            {"error": {"message": message, "type": "server_error",
+                       "code": code}},
+            status=status,
+        )
+
+    # --- the proxy --------------------------------------------------------
+
+    async def _proxy_post(self, request: web.Request) -> web.StreamResponse:
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            body = None  # the backend's 400 is the authoritative answer
+        key = affinity_key(
+            self._affinity_source(request.path, body), self.prompt_buckets
+        )
+        order, home = self._pick(key)
+        if not order:
+            if self.fleet.any_draining():
+                return self._refuse(
+                    request.path, "draining",
+                    "all replicas are draining; retry after the rolling "
+                    "update completes",
+                )
+            return self._refuse(
+                request.path, "no_replica",
+                "no live replica available",
+            )
+        self._requests += 1
+        headers = self._backend_headers(request)
+        last_429: _Overloaded | None = None
+        for attempt, rep in enumerate(order):
+            if attempt > 0:
+                self._failovers += 1
+                if self.metrics is not None:
+                    self.metrics.failovers.inc()
+            rep.inflight += 1
+            if self.metrics is not None:
+                self.metrics.inflight.labels(rep.rid).set(rep.inflight)
+            try:
+                resp = await self._relay(rep, request, raw, headers)
+            except _Unreachable:
+                self.fleet.note_failure(rep)
+                self._count(rep, "unreachable")
+                continue
+            except _Overloaded as e:
+                rep.cooldown_until = time.monotonic() + e.retry_after
+                self._count(rep, "overloaded")
+                last_429 = e
+                continue
+            finally:
+                rep.inflight -= 1
+                if self.metrics is not None:
+                    self.metrics.inflight.labels(rep.rid).set(rep.inflight)
+            if resp.status < 500:
+                # only app-level answers prove the engine alive; a 5xx
+                # (dead engine behind a live socket) must keep counting
+                # toward dead_after or steady traffic would reset the
+                # ledger faster than the poller can fail it
+                self.fleet.note_success(rep)
+            else:
+                self.fleet.note_failure(rep)
+            if rep is home:
+                # counted on the SERVING dispatch, not at plan time: a
+                # home that failed over is a miss for cache locality
+                self._affinity_hits += 1
+                if self.metrics is not None:
+                    self.metrics.affinity_hits.inc()
+            self._count(rep, self._outcome(resp.status))
+            return resp
+        if last_429 is not None:
+            # every candidate said "not now": deliver the backend's own
+            # overload message + Retry-After, don't invent a new one
+            return web.Response(
+                body=last_429.body, status=429,
+                content_type=last_429.content_type,
+                headers={"Retry-After": str(last_429.retry_after)},
+            )
+        return self._refuse(
+            request.path, "no_replica",
+            "every replica is unreachable",
+        )
+
+    @staticmethod
+    def _outcome(status: int) -> str:
+        if status < 400:
+            return "ok"
+        return "client_error" if status < 500 else "backend_error"
+
+    def _count(self, rep: Replica, outcome: str) -> None:
+        rep.relayed += 1
+        self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+        if self.metrics is not None:
+            self.metrics.requests.labels(rep.rid, outcome).inc()
+
+    async def _relay(self, rep: Replica, request: web.Request,
+                     raw: bytes, headers: dict) -> web.StreamResponse:
+        """One dispatch attempt: forward the body verbatim, relay the
+        response (SSE streamed frame-by-frame, JSON in one piece).
+        Raises _Unreachable/_Overloaded for the failover loop; anything
+        past response headers is final."""
+        url = f"{rep.url}{request.path}"
+        try:
+            post = self._session.post(url, data=raw, headers=headers)
+            if self.header_timeout_s > 0:
+                # session.post resolves when response HEADERS arrive, so
+                # this bounds exactly the header phase — the body/SSE
+                # relay stays unbounded (legitimate long generations)
+                resp = await asyncio.wait_for(post, self.header_timeout_s)
+            else:
+                resp = await post
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError, OSError) as e:
+            raise _Unreachable(str(e)) from None
+        try:
+            if resp.status == 429:
+                body = await resp.read()
+                try:
+                    ra = int(resp.headers.get("Retry-After", "1"))
+                except ValueError:
+                    ra = 1
+                raise _Overloaded(
+                    body, max(1, ra),
+                    resp.headers.get("Content-Type", "application/json")
+                    .split(";")[0],
+                )
+            ctype = resp.headers.get("Content-Type", "")
+            if ctype.startswith("text/event-stream"):
+                out = web.StreamResponse(headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                })
+                await out.prepare(request)
+                # byte-transparent relay: frames forwarded as received,
+                # so the stream is bit-identical to direct submission
+                async for chunk in resp.content.iter_any():
+                    await out.write(chunk)
+                await out.write_eof()
+                resp.release()
+                return out
+            body = await resp.read()
+            resp.release()
+            return web.Response(
+                body=body, status=resp.status,
+                content_type=ctype.split(";")[0] or "application/json",
+            )
+        except (_Overloaded, _Unreachable):
+            resp.release()
+            raise
+        except BaseException:
+            # client disconnect / cancellation mid-relay: close the
+            # backend connection HARD so the replica sees the disconnect
+            # and cancels the generation (release() would try to drain
+            # the rest of the stream first)
+            resp.close()
+            raise
+
+    async def _proxy_get(self, request: web.Request) -> web.Response:
+        """GET passthrough (/v1/models): any live replica's answer —
+        the fleet serves ONE model, so they all agree. Cooldown AND
+        drain are advisory here: both only gate new GENERATION
+        admissions, and a cooling or draining replica still serves
+        cheap metadata reads — model discovery must not fail for a
+        whole rolling-update window."""
+        now = time.monotonic()
+        candidates = [r for r in self.fleet.all() if r.routable(now)]
+        if not candidates:
+            candidates = [r for r in self.fleet.all() if r.alive]
+        for rep in candidates:
+            try:
+                async with self._session.get(
+                    f"{rep.url}{request.path}",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.connect_timeout_s
+                    ),
+                ) as resp:
+                    body = await resp.read()
+                    return web.Response(
+                        body=body, status=resp.status,
+                        content_type=(resp.headers.get("Content-Type", "")
+                                      .split(";")[0] or "application/json"),
+                    )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                self.fleet.note_failure(rep)
+                continue
+        return self._refuse(request.path, "no_replica",
+                            "no live replica available")
+
+    # --- fleet operations -------------------------------------------------
+
+    def router_stats(self) -> dict:
+        return {
+            "policy": self.policy,
+            "requests": self._requests,
+            "affinity_hits": self._affinity_hits,
+            "failovers": self._failovers,
+            "refused": dict(self._refused),
+            "outcomes": dict(self._outcomes),
+        }
+
+    async def _health(self, request: web.Request) -> web.Response:
+        """The router's own liveness (LB probes): up as long as at
+        least one replica can ADMIT (alive and not draining) — a fleet
+        mid-rolling-drain that refuses every submit must fail the
+        probe, not smile at it."""
+        snap = self.fleet.snapshot()
+        admitting = sum(
+            1 for r in self.fleet.all() if r.alive and not r.draining
+        )
+        return web.json_response(
+            {"router": True, "alive": admitting > 0,
+             "policy": self.policy,
+             "replicas": snap["total"], "live": snap["live"],
+             "admitting": admitting, "draining": snap["draining"]},
+            status=200 if admitting else 503,
+        )
+
+    async def _fleet_health(self, request: web.Request) -> web.Response:
+        snap = self.fleet.snapshot()
+        snap["router"] = self.router_stats()
+        return web.json_response(snap)
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        rid = request.match_info["replica"]
+        rep = self.fleet.get(rid)
+        if rep is None:
+            return web.json_response(
+                {"error": f"unknown replica {rid!r}",
+                 "replicas": self.fleet.ids()},
+                status=404,
+            )
+        rep.draining = True
+        t0 = time.monotonic()
+        log.info("draining replica", extra={"fields": {"replica": rid}})
+        while time.monotonic() - t0 < self.drain_timeout_s:
+            if rep.inflight == 0:
+                # the router-side count says nothing is being relayed;
+                # confirm with the replica itself that every admitted
+                # request retired (clients that submitted before the
+                # drain may still be decoding)
+                h = await self._probe_health(rep)
+                if h is not None and not (
+                    h.get("active", 0) or h.get("prefilling", 0)
+                    or h.get("queued", 0)
+                ):
+                    secs = time.monotonic() - t0
+                    log.info(
+                        "replica drained",
+                        extra={"fields": {"replica": rid,
+                                          "drain_seconds": round(secs, 3)}},
+                    )
+                    return web.json_response({
+                        "replica": rid, "draining": True, "drained": True,
+                        "drain_seconds": round(secs, 4),
+                    })
+                if h is None and not rep.alive:
+                    # nothing in flight and the replica is gone: as
+                    # drained as it will ever be (the restart case)
+                    return web.json_response({
+                        "replica": rid, "draining": True, "drained": True,
+                        "drain_seconds": round(time.monotonic() - t0, 4),
+                        "unreachable": True,
+                    })
+            await asyncio.sleep(0.05)
+        return web.json_response(
+            {"replica": rid, "draining": True, "drained": False,
+             "drain_seconds": round(time.monotonic() - t0, 4)},
+            status=504,
+        )
+
+    async def _undrain(self, request: web.Request) -> web.Response:
+        rid = request.match_info["replica"]
+        rep = self.fleet.get(rid)
+        if rep is None:
+            return web.json_response(
+                {"error": f"unknown replica {rid!r}",
+                 "replicas": self.fleet.ids()},
+                status=404,
+            )
+        rep.draining = False
+        log.info("undrained replica", extra={"fields": {"replica": rid}})
+        return web.json_response(
+            {"replica": rid, "draining": False}
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        from prometheus_client import generate_latest
+
+        return web.Response(
+            body=generate_latest(self.registry), content_type="text/plain"
+        )
+
+
+def _main(argv: list[str] | None = None) -> int:
+    """CLI: route two HTTP API surfaces across N replica backends."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="tpu-replica-router")
+    parser.add_argument("--replicas", required=True,
+                        help="comma list of replica backends: "
+                        "[id=]http://host:port,... (id defaults to "
+                        "host:port, matching the replica's own "
+                        "--replicaId default)")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--policy", default="affinity",
+                        choices=["affinity", "rr"],
+                        help="'affinity' (default) routes each request's "
+                        "bucket-aligned token-prefix path onto a "
+                        "consistent-hash ring with bounded-load spill, "
+                        "so shared-prefix tenants land where their "
+                        "prefix cache lives; 'rr' round-robins (the "
+                        "serve-bench A/B arm)")
+    parser.add_argument("--loadFactor", type=float, default=1.25,
+                        help="bounded-load spill: a ring candidate "
+                        "already carrying more than this times the "
+                        "fleet's mean in-flight count spills to the "
+                        "next candidate")
+    parser.add_argument("--healthIntervalS", type=float, default=1.0,
+                        help="replica /v1/health poll cadence")
+    parser.add_argument("--deadAfter", type=int, default=3,
+                        help="consecutive health/proxy failures before a "
+                        "replica is routed around (any success revives)")
+    parser.add_argument("--drainTimeoutS", type=float, default=120.0,
+                        help="POST /fleet/drain/{replica} gives up (504, "
+                        "drained:false) after this long")
+    parser.add_argument("--promptBuckets", default="",
+                        help="comma list of prompt-bucket boundaries "
+                        "for the affinity key (default: the batcher's "
+                        "DEFAULT_PROMPT_BUCKETS ladder). MUST match the "
+                        "replicas' effective ladder — custom buckets or "
+                        "a small --maxLen trimming it — or affinity "
+                        "keys cut where no cache ever promotes")
+    parser.add_argument("--headerTimeoutS", type=float, default=0.0,
+                        help="bound the header phase of a dispatch so a "
+                        "wedged replica (socket accepts, never answers) "
+                        "fails over like a connection failure; 0 (the "
+                        "default) disables — non-streamed generates "
+                        "answer headers only when generation completes, "
+                        "which can legitimately take minutes on a cold "
+                        "compile")
+    parser.add_argument("--tracing", action="store_true",
+                        help="span tracing: router spans propagate to "
+                        "the replicas via traceparent")
+    args = parser.parse_args(argv)
+
+    if args.tracing:
+        from k8s_gpu_device_plugin_tpu.obs.prom import SpanMetrics
+        from k8s_gpu_device_plugin_tpu.obs.trace import configure
+        from prometheus_client import REGISTRY as _SPAN_REGISTRY
+
+        SpanMetrics(registry=_SPAN_REGISTRY).install(configure(enabled=True))
+
+    from prometheus_client import REGISTRY
+
+    buckets = None
+    if args.promptBuckets:
+        try:
+            buckets = tuple(
+                int(b) for b in args.promptBuckets.split(",") if b.strip()
+            )
+        except ValueError:
+            raise SystemExit(
+                f"--promptBuckets {args.promptBuckets!r}: expected a "
+                "comma list of integers"
+            ) from None
+
+    fleet = FleetRegistry.from_spec(args.replicas, dead_after=args.deadAfter)
+    router = ReplicaRouter(
+        fleet, host=args.host, port=args.port, policy=args.policy,
+        prompt_buckets=buckets,
+        load_factor=args.loadFactor,
+        health_interval_s=args.healthIntervalS,
+        drain_timeout_s=args.drainTimeoutS,
+        header_timeout_s=args.headerTimeoutS,
+        registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
+    )
+
+    async def serve():
+        stop = asyncio.Event()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await router.run(stop)
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -70,7 +70,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import socket
 import threading
+import time
 
 from aiohttp import web
 
@@ -106,6 +108,7 @@ class InferenceEngine:
         sampler: Sampler | None = None,
         eos_id: int | None = None,
         chunked_prefill: int = 256,
+        prompt_buckets: "tuple[int, ...] | None" = None,  # None = default
         metrics=None,
         batcher: ContinuousBatcher | None = None,
         adapters=None,  # lora_serving.AdapterSet (multi-LoRA serving)
@@ -142,6 +145,13 @@ class InferenceEngine:
                 "constructor; silently ignoring it here would serve the "
                 "dense layout while reporting paged flags"
             )
+        if batcher is not None and prompt_buckets is not None:
+            raise ValueError(
+                "pass prompt_buckets to the injected batcher's own "
+                "constructor; silently ignoring them here would hash "
+                "router affinity keys at boundaries the engine never "
+                "promotes at"
+            )
         if batcher is not None and scheduler is not None:
             raise ValueError(
                 "pass the scheduler to the injected batcher's own "
@@ -166,11 +176,15 @@ class InferenceEngine:
         # edge" contract — the batcher itself never invents a deadline)
         self._default_priority = int(default_priority)
         self._default_deadline_ms = int(default_deadline_ms)
+        buckets_kw = (
+            {} if prompt_buckets is None
+            else {"prompt_buckets": tuple(prompt_buckets)}
+        )
         self.cb = batcher or ContinuousBatcher(
             params, cfg, n_slots=n_slots, max_len=max_len,
             sampler=sampler, eos_id=eos_id,
             chunked_prefill=min(chunked_prefill, max_len),
-            metrics=metrics, adapters=adapters,
+            metrics=metrics, adapters=adapters, **buckets_kw,
             pipeline_depth=pipeline_depth, trace_steps=trace_steps,
             prefix_cache=prefix_cache,
             kv_layout=kv_layout, kv_page_size=kv_page_size,
@@ -581,12 +595,22 @@ class InferenceServer:
 
     def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
                  port: int = 8000, registry=None, tokenizer=None,
-                 embedder=None, scorer=None):
+                 embedder=None, scorer=None, replica_id: str = ""):
         self.engine = engine
         self.host = host
         self.port = port
         self.bound_port: int | None = None
         self.registry = registry
+        # fleet identity (serving/fleet.py): a stable id the replica
+        # router's registry and dashboards tell replicas apart by —
+        # ``--replicaId`` pins it; empty defaults to hostname:port.
+        # NOTE: that matches FleetRegistry.from_spec's bare-URL id only
+        # when replicas are addressed BY hostname — fleets addressed by
+        # IP/service DNS should pin --replicaId (the registry surfaces
+        # the reported id either way, so a mismatch is visible, not
+        # silent)
+        self.replica_id = replica_id
+        self._t_start = time.monotonic()
         # Optional serving/embeddings.Embedder: enables /v1/embeddings
         self.embedder = embedder
         # Optional serving/scoring.Scorer: enables completions
@@ -747,6 +771,14 @@ class InferenceServer:
 
     async def _health(self, request: web.Request) -> web.Response:
         stats = self.engine.stats()
+        # fleet identity + age: the replica router's registry (and any
+        # dashboard aggregating N replicas) needs to tell replicas
+        # apart and spot restarts (uptime_s resetting = a new process
+        # behind the same address); schema pinned in tests/test_health.py
+        stats["replica_id"] = self.replica_id or (
+            f"{socket.gethostname()}:{self.bound_port or self.port}"
+        )
+        stats["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         # a dead engine must fail the readiness probe, not smile at it
         return web.json_response(stats, status=200 if stats["alive"] else 503)
 
@@ -1312,6 +1344,10 @@ def _main(argv: list[str] | None = None) -> int:
                         "misses always do; 0 adds automatic p99-of-"
                         "window triggering so the tail stays "
                         "explainable untuned)")
+    parser.add_argument("--replicaId", default="",
+                        help="stable fleet identity reported on "
+                        "/v1/health (serving/router.py's registry and "
+                        "dashboards key on it); empty = hostname:port")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -1564,7 +1600,8 @@ def _main(argv: list[str] | None = None) -> int:
 
     server = InferenceServer(engine, host=args.host, port=args.port,
                              registry=REGISTRY, tokenizer=tokenizer,
-                             embedder=embedder, scorer=scorer)
+                             embedder=embedder, scorer=scorer,
+                             replica_id=args.replicaId)
 
     async def serve():
         stop = asyncio.Event()

@@ -1,0 +1,114 @@
+"""In-process serving fleet (the integration seam for fleet tests/benches).
+
+N real InferenceServers on ephemeral ports behind a real ReplicaRouter,
+all on the caller's event loop — the harness tests/test_router.py, the
+``make bench-router`` smoke and serve_bench's fleet A/B all drive. Lives
+in the package (not tests/) for the same reason plugin/testing.py does:
+the shipped CPU benches spin fleets too, and three hand-rolled copies of
+the bring-up/teardown dance drifted apart the moment one grew a kwarg.
+
+Usage::
+
+    async with inprocess_fleet(params, cfg, n_replicas=2,
+                               engine_kw=dict(n_slots=2, max_len=64),
+                               router_kw=dict(policy="rr")) as fleet:
+        await client.post(f"{fleet.base}/v1/generate", ...)
+        await fleet.kill_replica(0)    # the crash path
+        fleet.router.router_stats()
+
+Per-replica state (a prefix cache, a scheduler — objects that must NOT
+be shared between engines) comes from ``engine_factory(i)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+from k8s_gpu_device_plugin_tpu.serving.fleet import FleetRegistry
+from k8s_gpu_device_plugin_tpu.serving.router import ReplicaRouter
+from k8s_gpu_device_plugin_tpu.serving.server import (
+    InferenceEngine,
+    InferenceServer,
+)
+
+
+async def _wait_bound(obj, task) -> None:
+    """Spin until ``obj.bound_port`` is set — or the serving task died,
+    in which case re-raise ITS error instead of hanging forever."""
+    while obj.bound_port is None:
+        if task.done():
+            exc = task.exception()
+            raise exc if exc is not None else RuntimeError(
+                "server task exited before binding a port"
+            )
+        await asyncio.sleep(0.01)
+
+
+class InprocessFleet:
+    """Handles for one running fleet (yielded by :func:`inprocess_fleet`)."""
+
+    def __init__(self):
+        self.servers: list[InferenceServer] = []
+        self.stops: list[asyncio.Event] = []
+        self.tasks: list[asyncio.Task] = []
+        self.fleet: FleetRegistry | None = None
+        self.router: ReplicaRouter | None = None
+        self.base: str = ""          # the router's http://host:port
+
+    def replica_base(self, i: int) -> str:
+        """Direct (router-bypassing) address of replica ``i``."""
+        return f"http://127.0.0.1:{self.servers[i].bound_port}"
+
+    async def kill_replica(self, i: int) -> None:
+        """Stop replica ``i`` abruptly (the crash path — no drain)."""
+        self.stops[i].set()
+        await asyncio.wait_for(self.tasks[i], 30)
+
+
+@contextlib.asynccontextmanager
+async def inprocess_fleet(
+    params,
+    cfg,
+    n_replicas: int = 2,
+    engine_kw: dict | None = None,
+    engine_factory=None,   # (i) -> InferenceEngine; overrides engine_kw
+    router_kw: dict | None = None,
+):
+    ctx = InprocessFleet()
+    rstop = asyncio.Event()
+    rtask = None
+    try:
+        for i in range(n_replicas):
+            if engine_factory is not None:
+                engine = engine_factory(i)
+            else:
+                engine = InferenceEngine(params, cfg, **(engine_kw or {}))
+            server = InferenceServer(
+                engine, host="127.0.0.1", port=0, replica_id=f"r{i}"
+            )
+            stop = asyncio.Event()
+            task = asyncio.create_task(server.run(stop))
+            ctx.stops.append(stop)
+            ctx.tasks.append(task)
+            await _wait_bound(server, task)
+            ctx.servers.append(server)
+        ctx.fleet = FleetRegistry.from_spec(",".join(
+            f"r{i}={ctx.replica_base(i)}" for i in range(n_replicas)
+        ))
+        ctx.router = ReplicaRouter(
+            ctx.fleet, host="127.0.0.1", port=0, **(router_kw or {})
+        )
+        rtask = asyncio.create_task(ctx.router.run(rstop))
+        await _wait_bound(ctx.router, rtask)
+        ctx.base = f"http://127.0.0.1:{ctx.router.bound_port}"
+        yield ctx
+    finally:
+        if rtask is not None and not rtask.done():
+            rstop.set()
+            await asyncio.wait_for(rtask, 30)
+        for stop in ctx.stops:
+            stop.set()
+        for task in ctx.tasks:
+            if not task.done():
+                await asyncio.wait_for(task, 30)

@@ -4,6 +4,7 @@ import subprocess
 import time
 
 import jax
+import requests
 
 
 async def handler(request):
@@ -15,3 +16,19 @@ async def handler(request):
     request.stop_event.wait()        # BAD: threading.Event wait
     with open("/tmp/x") as f:        # BAD: sync file I/O
         return f.read()
+
+
+async def proxy_handler(request, replica):
+    """The replica router's proxy shape (serving/router.py): a sync
+    HTTP client or a sync backoff wait in a fan-out handler stalls
+    EVERY stream the router is relaying, not just this request's."""
+    raw = await request.read()
+    resp = requests.post(            # BAD: sync HTTP to the backend
+        f"{replica.url}{request.path}", data=raw,
+    )
+    if resp.status_code == 429:
+        time.sleep(1.0)              # BAD: sync Retry-After backoff
+        resp = requests.post(        # BAD: the retry blocks too
+            f"{replica.url}{request.path}", data=raw,
+        )
+    return resp.content

@@ -10,3 +10,14 @@ async def handler(request, embedder, ids):
     item = await request.queue.get()
     await request.stop_event.wait()  # asyncio.Event: the awaited twin
     return vec, item
+
+
+async def proxy_handler(request, replica, session):
+    """The router proxy done right (serving/router.py): async client,
+    async backoff — the event loop keeps every other stream moving."""
+    raw = await request.read()
+    resp = await session.post(f"{replica.url}{request.path}", data=raw)
+    if resp.status == 429:
+        await asyncio.sleep(1.0)     # async Retry-After backoff
+        resp = await session.post(f"{replica.url}{request.path}", data=raw)
+    return await resp.read()

@@ -293,6 +293,66 @@ def test_manager_pushes_unknown_on_stale_runtime_endpoint(tmp_path):
         server.stop()
 
 
+def test_serving_health_reports_replica_identity():
+    """The serving plane's /v1/health carries a stable fleet identity:
+    ``replica_id`` (the --replicaId flag; hostname:port when unset) and
+    ``uptime_s`` — what serving/fleet.py's registry and dashboards tell
+    replicas (and restarts: uptime resetting) apart by. Schema pinned
+    here so the fleet layer can rely on it."""
+    import aiohttp
+    import jax
+
+    from k8s_gpu_device_plugin_tpu.models.llama import (
+        LlamaConfig,
+        init_params,
+    )
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+
+    async def probe(replica_id: str) -> dict:
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8)
+        server = InferenceServer(engine, host="127.0.0.1", port=0,
+                                 replica_id=replica_id)
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        while server.bound_port is None:
+            await asyncio.sleep(0.01)
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = f"http://127.0.0.1:{server.bound_port}/v1/health"
+                async with session.get(url) as r:
+                    assert r.status == 200
+                    first = await r.json()
+                await asyncio.sleep(0.05)
+                async with session.get(url) as r:
+                    second = await r.json()
+                return first, second, server.bound_port
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    # pinned schema: the engine surface plus the fleet identity fields
+    first, second, port = asyncio.run(probe("pod-7"))
+    for key in ("slots", "active", "prefilling", "queued", "alive",
+                "replica_id", "uptime_s"):
+        assert key in first, f"/v1/health missing {key}"
+    assert first["replica_id"] == "pod-7"
+    assert second["replica_id"] == "pod-7"  # stable across reads
+    assert 0.0 <= first["uptime_s"] <= second["uptime_s"]
+
+    # default identity: hostname:port (the FleetRegistry bare-URL rule)
+    import socket
+
+    first, _second, port = asyncio.run(probe(""))
+    assert first["replica_id"] == f"{socket.gethostname()}:{port}"
+
+
 def test_assessor_from_config_wiring():
     """Config knobs: default = staleness-only assessor; 'off' metrics +
     probe off = no assessor; probe 'on' = probe wired alongside the

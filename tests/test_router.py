@@ -1,0 +1,418 @@
+"""Replica router (serving/router.py + serving/fleet.py): affinity,
+drain semantics, failover bookkeeping, and byte-transparent proxying.
+
+Real fleets: two (or one) InferenceServers on ephemeral ports behind a
+ReplicaRouter, all in-process on the CPU backend — the assertions pin
+the fleet API contract AND token/logprob parity with direct-to-replica
+submission (the router must be invisible to outputs)."""
+
+import asyncio
+import json
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.serving.fleet import (
+    FleetRegistry,
+    HashRing,
+    affinity_key,
+)
+from k8s_gpu_device_plugin_tpu.serving.router import ReplicaRouter
+from k8s_gpu_device_plugin_tpu.serving.testing import inprocess_fleet
+
+BUCKETS = (8, 16, 32)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+async def _with_fleet(setup, body, n_replicas=2, policy="affinity",
+                      router_kw=None, engine_kw=None):
+    """Run ``body(session, router_base, fleet_ctx)`` against a real
+    in-process fleet (serving/testing.py — the same harness the CPU
+    benches use)."""
+    cfg, params = setup
+    async with inprocess_fleet(
+        params, cfg, n_replicas=n_replicas,
+        engine_kw=dict(
+            dict(n_slots=2, max_len=64, chunked_prefill=8),
+            **(engine_kw or {}),
+        ),
+        router_kw=dict(
+            dict(policy=policy, prompt_buckets=BUCKETS,
+                 health_interval_s=0.1, drain_timeout_s=30.0),
+            **(router_kw or {}),
+        ),
+    ) as ctx:
+        async with aiohttp.ClientSession() as session:
+            await body(session, ctx.base, ctx)
+
+
+async def _sse_events(resp) -> list[dict]:
+    events = []
+    async for line in resp.content:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            events.append(json.loads(line[len("data: "):]))
+    return events
+
+
+# --- pure routing state (no engines) --------------------------------------
+
+
+def test_affinity_key_bucket_alignment():
+    buckets = (8, 16, 32)
+    base = list(range(100, 116))  # 16 tokens: covers the 16 boundary
+    # divergence past the last covered boundary does not split the key
+    assert affinity_key(base + [1, 2], buckets) == \
+        affinity_key(base + [3, 4], buckets)
+    # divergence inside it does
+    assert affinity_key([0] + base[1:], buckets) != \
+        affinity_key(base, buckets)
+    # strings bucket on bytes; structures on canonical JSON
+    assert affinity_key("a" * 16 + "xx", buckets) == \
+        affinity_key("a" * 16 + "yy", buckets)
+    msgs = [{"role": "system", "content": "s" * 40}]
+    assert affinity_key(msgs, buckets) == affinity_key(list(msgs), buckets)
+    # no prefix-bearing field -> no key (balance-only routing)
+    assert affinity_key(None, buckets) is None
+    assert affinity_key("", buckets) is None
+
+
+def test_hash_ring_stable_and_spreads():
+    ring = HashRing(["a", "b", "c"])
+    keys = [affinity_key(list(range(i, i + 20)), BUCKETS)
+            for i in range(200)]
+    homes = [ring.candidates(k)[0] for k in keys]
+    # every candidate list is a permutation of the membership
+    for k in keys[:10]:
+        assert sorted(ring.candidates(k)) == ["a", "b", "c"]
+    # stable across rebuilds (hashlib, not the salted builtin hash)
+    ring2 = HashRing(["a", "b", "c"])
+    assert homes == [ring2.candidates(k)[0] for k in keys]
+    # no replica owns everything
+    assert len(set(homes)) == 3
+
+
+def test_fleet_registry_spec_and_duplicates():
+    fleet = FleetRegistry.from_spec(
+        "r0=http://127.0.0.1:8001, http://127.0.0.1:8002"
+    )
+    assert fleet.ids() == ["r0", "127.0.0.1:8002"]
+    with pytest.raises(ValueError):
+        FleetRegistry.from_spec("")
+    with pytest.raises(ValueError):
+        FleetRegistry.from_spec(
+            "x=http://h:1,x=http://h:2"
+        )
+    with pytest.raises(ValueError):
+        ReplicaRouter(fleet, policy="random")
+    with pytest.raises(ValueError):
+        ReplicaRouter(fleet, load_factor=1.0)
+
+
+# --- proxy parity ---------------------------------------------------------
+
+
+def test_streams_via_router_bit_identical(setup):
+    """Token AND logprob streams through the router equal direct-to-
+    replica submission (and the generate oracle) in both JSON and SSE
+    modes — the router is byte-transparent."""
+    cfg, params = setup
+    p = _prompt(310, 6, cfg)
+    oracle = _oracle(params, p, cfg, 5)
+
+    async def body(session, base, ctx):
+        direct = f"http://127.0.0.1:{ctx.servers[0].bound_port}"
+        payload = {"prompt": p, "max_new": 5, "logprobs": True}
+        async with session.post(f"{direct}/v1/generate", json=payload) as r:
+            assert r.status == 200
+            d_direct = await r.json()
+        async with session.post(f"{base}/v1/generate", json=payload) as r:
+            assert r.status == 200
+            d_routed = await r.json()
+        assert d_routed["tokens"] == d_direct["tokens"] == oracle
+        assert d_routed["logprobs"] == d_direct["logprobs"]
+
+        sse = dict(payload, stream=True)
+        async with session.post(f"{direct}/v1/generate", json=sse) as r:
+            ev_direct = await _sse_events(r)
+        async with session.post(f"{base}/v1/generate", json=sse) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            ev_routed = await _sse_events(r)
+        assert ev_routed == ev_direct
+        assert [e["token"] for e in ev_routed[:-1]] == oracle
+        assert ev_routed[-1]["done"] is True
+
+        # the OpenAI surface proxies identically (id-list prompt)
+        oai = {"prompt": p, "max_tokens": 4, "model": "tpu-serving"}
+        async with session.post(f"{direct}/v1/completions", json=oai) as r:
+            c_direct = await r.json()
+        async with session.post(f"{base}/v1/completions", json=oai) as r:
+            c_routed = await r.json()
+        assert c_routed["choices"][0] == c_direct["choices"][0]
+        assert c_routed["usage"] == c_direct["usage"]
+
+    run(_with_fleet(setup, body))
+
+
+def test_affinity_routes_shared_prefix_to_one_replica(setup):
+    """Six requests sharing a bucket-covering prefix (distinct tails)
+    must all land on ONE replica — the one holding their cache — and
+    count as affinity hits."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        shared = _prompt(320, 16, cfg)  # covers the 16 boundary
+        for i in range(6):
+            tail = _prompt(330 + i, 4, cfg)
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": shared + tail, "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        relayed = {rep.rid: rep.relayed for rep in ctx.fleet.all()}
+        assert sorted(relayed.values()) == [0, 6], relayed
+        stats = ctx.router.router_stats()
+        assert stats["affinity_hits"] == 6
+        assert stats["failovers"] == 0
+        # distinct prefixes spread: at least one of a handful of other
+        # prefixes hashes to the idle replica
+        for i in range(8):
+            q = _prompt(400 + i, 20, cfg)
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": q, "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        relayed2 = {rep.rid: rep.relayed for rep in ctx.fleet.all()}
+        assert all(v > 0 for v in relayed2.values()), relayed2
+
+    run(_with_fleet(setup, body))
+
+
+# --- drain semantics (the rolling-update satellite) -----------------------
+
+
+def test_drain_finishes_inflight_stream_and_refuses_new(setup):
+    """Drain mid-stream: the in-flight stream delivers EVERY token and
+    its done event; while draining, new submits answer a structured 503
+    {"code": "draining"} on BOTH API surfaces; un-drain restores
+    admission."""
+    cfg, params = setup
+    p = _prompt(340, 3, cfg)
+
+    async def body(session, base, ctx):
+        # (a) stream in flight, then drain: the stream must finish
+        resp = await session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 60, "stream": True,
+        })
+        assert resp.status == 200
+        first = None
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                first = json.loads(line[len("data: "):])
+                break
+        assert first is not None and "token" in first
+
+        async def _drain():
+            async with session.post(f"{base}/fleet/drain/r0") as r:
+                return r.status, await r.json()
+
+        drain = asyncio.create_task(_drain())
+        toks = [first["token"]]
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            evt = json.loads(line[len("data: "):])
+            if evt.get("done"):
+                break
+            toks.append(evt["token"])
+        assert len(toks) == 60  # zero dropped tokens across the drain
+        resp.release()
+        status, d = await drain
+        assert status == 200
+        assert d["drained"] is True and d["replica"] == "r0"
+        assert d["drain_seconds"] >= 0.0
+
+        # (b) still draining: both surfaces refuse with code=draining
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 2,
+        }) as r:
+            assert r.status == 503
+            refuse = await r.json()
+            assert refuse["code"] == "draining"
+        async with session.post(f"{base}/v1/completions", json={
+            "prompt": p, "max_tokens": 2,
+        }) as r:
+            assert r.status == 503
+            refuse = await r.json()
+            assert refuse["error"]["code"] == "draining"
+            assert refuse["error"]["type"] == "server_error"
+        async with session.post(f"{base}/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+        }) as r:
+            assert r.status == 503
+            assert (await r.json())["error"]["code"] == "draining"
+        # metadata reads survive the drain window: only new GENERATION
+        # admissions are refused
+        async with session.get(f"{base}/v1/models") as r:
+            assert r.status == 200
+
+        # (c) un-drain restores admission
+        async with session.post(f"{base}/fleet/undrain/r0") as r:
+            assert r.status == 200
+            assert (await r.json())["draining"] is False
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 3,
+        }) as r:
+            assert r.status == 200
+            assert (await r.json())["tokens"] == _oracle(params, p, cfg, 3)
+
+    run(_with_fleet(setup, body, n_replicas=1))
+
+
+def test_drain_spills_new_work_to_the_survivor(setup):
+    """With a second live replica, draining one refuses nothing: new
+    requests route to the survivor while the drained one empties."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        async with session.post(f"{base}/fleet/drain/r0") as r:
+            assert r.status == 200
+            assert (await r.json())["drained"] is True
+        for i in range(4):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(350 + i, 5, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        reps = {rep.rid: rep for rep in ctx.fleet.all()}
+        assert reps["r0"].relayed == 0
+        assert reps["r1"].relayed == 4
+        snap = ctx.fleet.snapshot()
+        assert snap["replicas"]["r0"]["draining"] is True
+        async with session.post(f"{base}/fleet/drain/nope") as r:
+            assert r.status == 404
+
+    run(_with_fleet(setup, body))
+
+
+# --- failover + fleet surfaces --------------------------------------------
+
+
+def test_dead_replica_fails_over_and_health_aggregates(setup):
+    """Killing a replica mid-service: requests keep succeeding via the
+    survivor (failovers counted), /fleet/health reports the death, and
+    /v1/models keeps answering."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        # both replicas warm + the poller has seen them
+        for i in range(4):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(360 + i, 12, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        await asyncio.sleep(0.25)
+        snap = ctx.fleet.snapshot()
+        assert snap["live"] == 2
+        # reported ids round-tripped from each replica's /v1/health
+        assert {v["reported_id"] for v in snap["replicas"].values()} == \
+            {"r0", "r1"}
+
+        await ctx.kill_replica(0)
+        served = 0
+        for i in range(8):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(370 + i, 12, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+                served += 1
+        assert served == 8
+        stats = ctx.router.router_stats()
+        assert stats["outcomes"].get("unreachable", 0) >= 1
+        assert stats["failovers"] >= 1
+        # the poller marks it dead shortly after
+        for _ in range(40):
+            if ctx.fleet.snapshot()["live"] == 1:
+                break
+            await asyncio.sleep(0.05)
+        snap = ctx.fleet.snapshot()
+        assert snap["live"] == 1
+        assert snap["replicas"]["r0"]["alive"] is False
+        async with session.get(f"{base}/fleet/health") as r:
+            agg = await r.json()
+            assert agg["live"] == 1 and agg["router"]["failovers"] >= 1
+        async with session.get(f"{base}/v1/models") as r:
+            assert r.status == 200
+            assert (await r.json())["data"][0]["id"] == "tpu-serving"
+        async with session.get(f"{base}/v1/health") as r:
+            assert r.status == 200
+            h = await r.json()
+            assert h["router"] is True and h["live"] == 1
+
+    run(_with_fleet(setup, body))
+
+
+def test_backend_429_forwarded_with_retry_after(setup):
+    """A single overloaded replica's 429 reaches the client verbatim
+    (body + Retry-After) instead of a router-invented 503 — and the
+    cooldown must not wedge the fleet afterwards."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+
+    cfg, params = setup
+    p = _prompt(380, 9, cfg)
+
+    async def body(session, base, ctx):
+        posts = [
+            session.post(f"{base}/v1/generate", json={
+                "prompt": list(p), "max_new": 40,
+            })
+            for _ in range(8)
+        ]
+        results = await asyncio.gather(*posts)
+        rejected = [r for r in results if r.status == 429]
+        served = [r for r in results if r.status == 200]
+        assert rejected and served
+        for r in rejected:
+            assert int(r.headers["Retry-After"]) >= 1
+            payload = await r.json()
+            assert payload["code"] == "overloaded"
+        for r in results:
+            await r.release()
+        # cooldown is advisory: the fleet still answers (the backend's
+        # own 429 or a 200, never a no_replica 503)
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": list(p), "max_new": 2,
+        }) as r:
+            assert r.status in (200, 429)
+
+    run(_with_fleet(
+        setup, body, n_replicas=1,
+        engine_kw={"scheduler": Scheduler(max_queue=1)},
+    ))

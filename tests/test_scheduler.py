@@ -565,6 +565,45 @@ def test_openloop_trace_clamps_shared_prefix(setup):
     assert {e["tenant"] for e in trace} == {"gold", "bronze"}
 
 
+def test_open_loop_run_retries_429_with_capped_backoff(setup):
+    """The open-loop harness client honors Retry-After on a queue-full
+    429 with a capped retry instead of a terminal drop: a burst over
+    the queue cap reports ``retried_ok`` for requests a retry got in,
+    and ``rejected`` only for retry-exhausted ones. ``retries=0``
+    restores the old drop-on-first-429 accounting."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        open_loop_run,
+    )
+
+    cfg, params = setup
+
+    def burst_trace(n):
+        return [
+            {"t": 0.0, "tenant": "t", "priority": 1, "deadline_ms": None,
+             "prompt": _prompt(700 + i, 9, cfg), "max_new": 2,
+             "phase": "base"}
+            for i in range(n)
+        ]
+
+    cb = _batcher(params, cfg, sched=Scheduler(max_queue=1), n_slots=1)
+    out = open_loop_run(cb, burst_trace(4), retries=3,
+                        max_retry_wait_s=0.1)
+    assert out["retried_ok"] >= 1, out
+    assert out["submitted"] + out["rejected"] == out["offered"] == 4
+    assert out["submitted"] >= 1 + out["retried_ok"]
+
+    # retries=0: every queue-full contact is a terminal drop (and the
+    # field is still reported, as 0)
+    cb0 = _batcher(params, cfg, sched=Scheduler(max_queue=1), n_slots=1)
+    out0 = open_loop_run(cb0, burst_trace(4), retries=0)
+    assert out0["retried_ok"] == 0
+    assert out0["rejected"] >= 1
+    assert out0["submitted"] + out0["rejected"] == 4
+    # the scheduler's own ledger counts the terminal drops
+    assert (out0["sched_stats"]["rejections"]["queue_full"]
+            == out0["rejected"])
+
+
 def test_returning_idle_tenant_refloors_vtime(setup):
     """A tenant that went idle while a peer kept admitting rejoins at
     the system virtual time instead of replaying banked credit (which
