@@ -68,7 +68,7 @@ san-test:
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs bench-kernels bench-router
+	bench-obs bench-kernels bench-router bench-chaos
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -145,6 +145,18 @@ bench-kernels:
 bench-router:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.router_bench
 
+# CPU-runnable chaos smoke: one open-loop trace through a seeded fault
+# schedule (serving/faults.py + serving/supervisor.py) — an induced
+# mid-decode engine crash recovered in place (dense AND paged, the
+# paged arm adding transient pool-alloc failures) with token+logprob
+# streams asserted bit-identical to a no-fault run, plus a 2-replica
+# fleet with one replica killed mid-trace; asserts zero dropped and
+# zero silently-truncated streams and bounded clean refusals (one JSON
+# line with the chaos_* serve-row fields + fault_guard_ns, the
+# disarmed-guard cost).
+bench-chaos:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.chaos_bench
+
 # CPU-runnable microbench: the latency-attribution layer's two cost
 # claims — the disabled-path guard is nanoseconds (the whole hot-path
 # cost with attribution off) and the per-retired-request record path
@@ -160,7 +172,8 @@ clean:
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched bench-tp bench-obs bench-kernels bench-router clean watch
+	bench-sched bench-tp bench-obs bench-kernels bench-router \
+	bench-chaos clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -72,7 +72,12 @@ def main() -> int:
     # 3. streaming with text on the closing event
     toks = []
     for evt in stream(gen, {"text": args.prompt, "max_new": args.max_new}):
-        if evt.get("done"):
+        if "error" in evt:
+            # abnormal close (engine died past its restart budget): a
+            # structured error event, never a silent short stream
+            print("stream ERROR ->", evt["error"]["code"],
+                  evt["error"]["message"])
+        elif evt.get("done"):
             print("stream done  ->", json.dumps(evt.get("text", ""),
                                                 ensure_ascii=False))
         else:

@@ -492,7 +492,7 @@ def _run_serve() -> dict:
     # shard over (tp=2 is the first point of the scaling curve; deeper
     # sweeps ride the same field set via BENCH_TP)
     tp_degree = int(os.environ.get("BENCH_TP", 2))
-    r = serve_bench(cfg, spec_ab=True, fleet_ab=True,
+    r = serve_bench(cfg, spec_ab=True, fleet_ab=True, chaos_ab=True,
                     tp_ab=len(_jax.devices()) > 1, tp_degree=tp_degree)
     return {
         "workload": "serve",
@@ -587,6 +587,29 @@ def _run_serve() -> dict:
         "fleet_affinity_hit_pct": round(r.fleet_affinity_hit_pct, 1),
         "fleet_rejected_affinity": r.fleet_rejected_affinity,
         "fleet_rejected_rr": r.fleet_rejected_rr,
+        # chaos arm (benchmark/workloads/chaos_bench.py): the recovery
+        # tier's contract, exercised — an induced engine crash
+        # (dense + paged, with transient pool-alloc faults) recovered
+        # by the supervisor, plus a replica kill behind the router.
+        # dropped/truncated are ASSERTED zero inside the workload;
+        # bitwise_identical pins the crash-straddling streams against
+        # a no-fault run of the same trace
+        "chaos_requests": r.chaos_requests,
+        "chaos_completed": r.chaos_completed,
+        "chaos_rejected": r.chaos_rejected,
+        "chaos_engine_restarts": r.chaos_engine_restarts,
+        "chaos_replayed": r.chaos_replayed,
+        "chaos_resumed": r.chaos_resumed,
+        "chaos_dropped_streams": r.chaos_dropped_streams,
+        "chaos_truncated_streams": r.chaos_truncated_streams,
+        "chaos_bitwise_identical": r.chaos_bitwise_identical,
+        "chaos_fleet_requests": r.chaos_fleet_requests,
+        "chaos_fleet_completed": r.chaos_fleet_completed,
+        "chaos_fleet_rejected": r.chaos_fleet_rejected,
+        "chaos_fleet_retries": r.chaos_fleet_retries,
+        "chaos_fleet_failovers": r.chaos_fleet_failovers,
+        "chaos_fleet_killed_replicas": r.chaos_fleet_killed_replicas,
+        "fault_guard_ns": round(r.fault_guard_ns, 2),
         # live serving MFU/roofline accounting (metrics/roofline.py):
         # model-FLOPs utilization of the primary pipelined run vs the
         # generation's spec-sheet peak, the decode HBM-roofline

@@ -242,6 +242,34 @@ class ServeBenchResult:
     # path used to be pinned to) — same sharded batch, same layout
     decode_step_ms_kernel: float = 0.0
     decode_step_ms_gather: float = 0.0
+    # chaos arm (``chaos_ab=True``; benchmark/workloads/chaos_bench.py):
+    # one open-loop trace through a seeded fault schedule — an induced
+    # engine crash mid-trace (dense + paged, the paged arm adding
+    # transient pool-alloc failures) with supervisor recovery, and a
+    # 2-replica fleet with one replica KILLED mid-trace. The dropped /
+    # truncated fields are ASSERTED zero inside the workload (the bench
+    # fails loudly, it never reports a broken recovery as numbers);
+    # ``chaos_bitwise_identical`` pins token+logprob streams across the
+    # induced crash against a no-fault run of the same trace. All zero
+    # when chaos_ab=False.
+    chaos_requests: int = 0
+    chaos_completed: int = 0
+    chaos_rejected: int = 0
+    chaos_engine_restarts: int = 0
+    chaos_replayed: int = 0
+    chaos_resumed: int = 0
+    chaos_dropped_streams: int = 0
+    chaos_truncated_streams: int = 0
+    chaos_bitwise_identical: int = 0
+    chaos_fleet_requests: int = 0
+    chaos_fleet_completed: int = 0
+    chaos_fleet_rejected: int = 0
+    chaos_fleet_retries: int = 0
+    chaos_fleet_failovers: int = 0
+    chaos_fleet_killed_replicas: int = 0
+    # disarmed fault-point guard cost (ns) — "the plane is free when
+    # off" as a measured number, the attribution noop-guard pattern
+    fault_guard_ns: float = 0.0
 
 
 class _PrefillRecorder:
@@ -387,7 +415,13 @@ def open_loop_run(cb, trace: list[dict], retries: int = 1,
     only requests that exhausted their retries, and ``retried_ok``
     counts the ones a retry got in (``retries=0`` restores the old
     drop-on-first-429 behavior). Returns per-request facts plus the
-    scheduler's own counters."""
+    scheduler's own counters. ``truncated`` counts submitted requests
+    that VANISHED — admitted but never retired with a disposition
+    (done/eos/budget/stop/cancelled/rejected) — separately from
+    ``rejected``/``retried_ok``: a clean refusal is the overload
+    contract working, a vanished stream is a dropped result, and
+    folding the two together is how silent truncation hides (the
+    chaos workload asserts this stays 0)."""
     from k8s_gpu_device_plugin_tpu.serving.scheduler import (
         SchedulerOverloadError,
     )
@@ -447,9 +481,11 @@ def open_loop_run(cb, trace: list[dict], retries: int = 1,
 
     per_request = []
     async_rejected = 0
+    truncated = 0
     for rid, e in meta.items():
         req = cb.done_requests.get(rid)
         if req is None:
+            truncated += 1
             continue
         rejected = req.reject_reason is not None
         if rejected:
@@ -479,6 +515,7 @@ def open_loop_run(cb, trace: list[dict], retries: int = 1,
         "submitted": len(meta),
         "rejected": sync_rejected + async_rejected,
         "retried_ok": retried_ok,
+        "truncated": truncated,
         "preemptions": stats.get("preemptions", 0),
         "per_request": per_request,
         "sched_stats": stats,
@@ -928,6 +965,7 @@ def serve_bench(
     spec_ab: bool = False,
     sched_ab: bool = True,
     fleet_ab: bool = False,
+    chaos_ab: bool = False,
     tp_ab: bool = False,
     tp_degree: int = 2,
     sched_base_s: float = 4.0,
@@ -1294,6 +1332,25 @@ def serve_bench(
             file=sys.stderr,
         )
 
+    # --- chaos arm: seeded fault schedule through the recovery tier ---
+    chaos_fields: dict = {}
+    if chaos_ab and chunked_prefill:
+        from k8s_gpu_device_plugin_tpu.benchmark.workloads.chaos_bench import (
+            chaos_ab as run_chaos_ab,
+        )
+
+        # deliberately a tiny sidecar workload (its own slots/lengths):
+        # what it measures is the RECOVERY CONTRACT — zero dropped, zero
+        # silently truncated, bit-identical across an induced crash —
+        # not throughput, so it must not scale with the bench config
+        chaos_fields = run_chaos_ab(cfg, params)
+    elif chaos_ab:
+        print(
+            "serve_bench: chaos arm skipped — the recovery resume path "
+            "requires chunked_prefill",
+            file=sys.stderr,
+        )
+
     # --- tensor-parallel sweep A/B: the same workload tp-sharded ---
     tp_fields: dict = {}
     if tp_ab and tp_degree > 1:
@@ -1449,5 +1506,6 @@ def serve_bench(
         mfu_generation=mfu_gen,
         **sched_fields,
         **fleet_fields,
+        **chaos_fields,
         **tp_fields,
     )

@@ -362,6 +362,30 @@ class ServingMetrics:
             "In-flight decode steps flushed early on membership changes",
             registry=registry,
         )
+        # Engine crash recovery (serving/supervisor.py): restarts of
+        # the batcher behind a live HTTP surface, and what each one
+        # carried over — queued requests replayed in admission order,
+        # in-flight requests resumed through the preemption fold. A
+        # nonzero restart rate is the first thing a fleet dashboard
+        # should alarm on (the replica recovered, but something crashed).
+        self.engine_restarts = Counter(
+            f"{prefix}_engine_restarts_total",
+            "Engine crash recoveries (the supervisor rebuilt the "
+            "batcher in place)",
+            registry=registry,
+        )
+        self.engine_replayed_requests = Counter(
+            f"{prefix}_engine_replayed_requests_total",
+            "Queued (not yet decoding) requests re-admitted across "
+            "engine restarts",
+            registry=registry,
+        )
+        self.engine_resumed_requests = Counter(
+            f"{prefix}_engine_resumed_requests_total",
+            "Mid-stream requests resumed bit-identically across "
+            "engine restarts",
+            registry=registry,
+        )
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
 
@@ -414,6 +438,9 @@ class ServingMetrics:
             self.decode_dispatch_seconds,
             self.decode_readback_seconds,
             self.pipeline_flushes,
+            self.engine_restarts,
+            self.engine_replayed_requests,
+            self.engine_resumed_requests,
         ):
             try:
                 self._registry.unregister(c)
@@ -600,6 +627,13 @@ class ServingMetrics:
 
     def observe_readback(self, seconds: float) -> None:
         self.decode_readback_seconds.observe(seconds)
+
+    def on_engine_restart(self, replayed: int, resumed: int) -> None:
+        self.engine_restarts.inc()
+        if replayed:
+            self.engine_replayed_requests.inc(replayed)
+        if resumed:
+            self.engine_resumed_requests.inc(resumed)
 
     def on_pipeline_flush(self) -> None:
         self.pipeline_flushes.inc()

@@ -432,6 +432,11 @@ class _Request:
     # emission — and seeded draw — number prefilled_out).
     prefilled_out: int = 0
     preemptions: int = 0
+    # engine restarts this request lived through MID-FLIGHT (the
+    # supervisor's crash-recovery resume, serving/supervisor.py): the
+    # flight recorder always retains these, and the scheduler treats
+    # the re-admission like a preemption resume (no re-charge)
+    restarts: int = 0
     # set when the scheduler rejects a queued request (defer budget):
     # surfaced through the stream info so the HTTP planes answer 429
     reject_reason: "str | None" = None
@@ -515,6 +520,7 @@ class ContinuousBatcher:
         tp: int | None = None,  # None = take cfg.tp (1 = single chip)
         attribution=None,  # obs.attribution.RequestAttributor (or None)
         mfu=None,  # metrics.roofline.MfuAccumulator (or None)
+        faults=None,  # serving.faults.FaultPlane (or None = disarmed)
     ):
         # the KV layout rides in the (static) cfg so every jitted step
         # branches on it at trace time; the explicit kwargs are sugar so
@@ -858,6 +864,22 @@ class ContinuousBatcher:
         # top of tracing (they are batch-scoped root traces — always-on
         # they would crowd the per-request trees out of the trace ring)
         self.trace_steps = bool(trace_steps)
+        # Seeded fault injection (serving/faults.py), duck-typed like
+        # metrics so this module keeps its no-serving-imports layering:
+        # each seam resolves its point ONCE here — None when disarmed,
+        # so the steady-state cost of the whole plane is one
+        # is-not-None compare per seam (microbenched in bench-chaos,
+        # the attribution-guard pattern). ``_fault_error`` hands the
+        # injected-exception TYPE over the same duck-typed seam (the
+        # pool.alloc site catches it without importing serving code).
+        point = faults.point if faults is not None else (lambda name: None)
+        self._flt_pool_alloc = point("pool.alloc")
+        self._flt_prefill = point("prefill.dispatch")
+        self._flt_decode = point("decode.apply")
+        self._flt_promote = point("prefix.promote")
+        self._fault_error = (
+            getattr(faults, "error", None) if faults is not None else None
+        )
 
     def validate(self, prompt_len: int, max_new: int) -> None:
         """Raise ValueError iff submit(prompt of this length) would.
@@ -1429,6 +1451,17 @@ class ContinuousBatcher:
         prefix pages are already pinned (match time), so only the COW
         tail and the fresh pages draw on the free list. False = defer
         (the request keeps its queue head; pages free as slots retire)."""
+        if self._flt_pool_alloc is not None:
+            try:
+                self._flt_pool_alloc.fire()
+            except self._fault_error:
+                # injected TRANSIENT pool pressure: defer head-of-line
+                # exactly like a real exhausted free list — the request
+                # retries next step and admits when the schedule relents
+                if not req.defer_counted:
+                    req.defer_counted = True
+                    self._count_kv_rejection("pool_pressure")
+                return False
         ps = self.pool.page_size
         # a resumed request's prompt already CONTAINS its pre-preemption
         # output (prefilled_out tokens), so only the remaining budget
@@ -1740,6 +1773,8 @@ class ContinuousBatcher:
         final chunk, sample the first token and move it to running."""
         if not self.prefilling:
             return
+        if self._flt_prefill is not None:
+            self._flt_prefill.fire()  # induced prefill-dispatch crash
         slot = next(iter(self.prefilling))
         req = self.prefilling[slot]
         start = self._prefill_pos[slot]
@@ -1832,6 +1867,8 @@ class ContinuousBatcher:
         came from a matched prefix."""
         if self.prefix_cache is None:
             return
+        if self._flt_promote is not None:
+            self._flt_promote.fire()  # induced promotion crash
         if self.pool is not None:
             # ZERO-COPY promotion: the boundary's rows already live in
             # the slot's pages — take a reference on each page the
@@ -2221,6 +2258,11 @@ class ContinuousBatcher:
     def _apply_decode_result(self, arrs) -> int:  # graftlint: hot-path
         """The host half: sync ``arrs`` (one host sync) and run the
         per-token work. Returns tokens emitted."""
+        if self._flt_decode is not None:
+            # BEFORE the readback: an induced mid-decode crash loses
+            # only device work that never reached ``req.out``, so the
+            # supervisor's resume can never double-emit
+            self._flt_decode.fire()
         emitted, logps = jax.device_get(arrs)
         return self._apply_emitted(emitted, logps)
 

@@ -192,12 +192,20 @@ class RequestAttributor:
                 },
             },
         }
+        restarts = getattr(req, "restarts", 0)
+        if restarts:
+            # the request lived through an engine crash-recovery
+            # restart (serving/supervisor.py) — on the record AND
+            # always flight-recorded below: a stream that survived a
+            # crash is precisely the tail the recorder exists for
+            record["restarts"] = restarts
         self._observe_phases(phases, tl.xid)
         p99 = self.window_p99_s() if self.slow_ms == 0 else None
         self._lat_window.append(total)
         slow = bool(
             (self.slow_ms > 0 and total * 1000.0 >= self.slow_ms)
             or deadline_missed
+            or restarts
             or (p99 is not None and total >= p99)
         )
         if slow:

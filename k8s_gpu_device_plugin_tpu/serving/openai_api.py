@@ -48,6 +48,7 @@ import uuid
 from aiohttp import web
 
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.serving.supervisor import StreamError
 from k8s_gpu_device_plugin_tpu.serving.tokenizer import (
     encode_stop_strings,
     trim_stop_suffix,
@@ -613,9 +614,14 @@ class _OpenAIRoutes:
             for eid, _ in subs:
                 self._server.engine.cancel(eid)
             raise
+        err = next((e for _, _, e in drained if e is not None), None)
+        if err is not None:
+            # engine death / exhausted restart budget mid-request: a
+            # retryable server_error, never a 200 with truncated text
+            return _oai_error(err.message, 503, code=err.code)
         cands = []
         completion_tokens = 0  # usage counts EVERYTHING sampled (best_of too)
-        for toks, lps in drained:
+        for toks, lps, _err in drained:
             # OpenAI: the matched stop sequence is never in the output
             kept = trim_stop_suffix(toks, c["stop"])
             klps = lps[:len(kept)]
@@ -764,6 +770,21 @@ class _OpenAIRoutes:
                 )
             while True:
                 item = await q.get()
+                if isinstance(item, StreamError):
+                    # abnormal close: the OpenAI stream-error envelope
+                    # (the shape SDKs surface as a retryable
+                    # server_error), then [DONE] — never a clean
+                    # finish_reason over a truncated stream
+                    err_evt = {"error": {
+                        "message": item.message,
+                        "type": "server_error",
+                        "code": item.code,
+                    }}
+                    await resp.write(
+                        f"data: {json.dumps(err_evt)}\n\n".encode()
+                    )
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
                 if item is None:
                     if not all_out:
                         info = self._server.engine.pop_request_info(rid)

@@ -354,6 +354,22 @@ class PrefixCache:
 
     # --- eviction ---
 
+    def reset(self) -> None:
+        """Drop every node and entry WITHOUT the release hook — the
+        crash-recovery path (serving/supervisor.py): a paged entry's
+        page ids index the pool of the batcher that promoted them, and
+        after an engine crash that pool no longer exists (running
+        ``release_entry`` against a fresh pool would decref pages it
+        never allocated). Cumulative counters (hits/misses/evictions)
+        survive; residency zeroes. The next batcher attach rebinds the
+        entry factory and hooks as usual."""
+        self._roots.clear()
+        self._lru.clear()
+        self.stats.nodes = 0
+        self.stats.entries = 0
+        self.stats.resident_bytes = 0
+        self._report_residency()
+
     def evict_one(self) -> bool:
         """Evict the least-recently-used entry; False when the cache is
         already empty. The paged batcher's pool-pressure relief valve:

@@ -74,6 +74,7 @@ import time
 import aiohttp
 from aiohttp import web
 
+from k8s_gpu_device_plugin_tpu.serving.faults import FaultError
 from k8s_gpu_device_plugin_tpu.serving.fleet import (
     FleetRegistry,
     HashRing,
@@ -182,9 +183,11 @@ class ReplicaRouter:
         health_interval_s: float = 1.0,
         drain_timeout_s: float = 120.0,
         connect_timeout_s: float = 2.0,
-        header_timeout_s: float = 0.0,  # 0 = unbounded (see below)
+        header_timeout_s: float = 300.0,  # finite: a wedged replica
+        # must fail over, not hang the client forever (0 = unbounded)
         registry=None,          # prometheus registry (None = no /metrics)
         metrics: "RouterMetrics | None" = None,
+        faults=None,            # serving.faults.FaultPlane (None = disarmed)
     ):
         if policy not in ("affinity", "rr"):
             raise ValueError(
@@ -214,12 +217,24 @@ class ReplicaRouter:
         self.drain_timeout_s = float(drain_timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         # bound the HEADER phase of a dispatch (a wedged replica whose
-        # socket accepts but never answers should fail over like a
-        # connection failure). 0 disables: a non-streamed generate
-        # answers headers only when generation COMPLETES, which can
-        # legitimately take minutes on a cold compile — operators who
-        # stream (headers arrive at prepare time) can set this tight.
+        # socket accepts but never answers must fail over like a
+        # connection failure, not hang the client forever — which is
+        # exactly what an unbounded default did). The default sits
+        # ABOVE the worst legitimate case — a non-streamed generate's
+        # headers arrive only when generation completes, minutes on a
+        # cold compile, so 5 minutes clears it; a premature timeout
+        # would cascade failovers across a healthy fleet. Operators
+        # who stream (headers arrive at prepare time) can set this
+        # tight; 0 restores unbounded.
         self.header_timeout_s = float(header_timeout_s)
+        # seeded fault injection (serving/faults.py): the two
+        # router-side seams — pre-dispatch connect and mid-SSE-relay
+        self._flt_connect = (
+            faults.point("router.connect") if faults is not None else None
+        )
+        self._flt_midstream = (
+            faults.point("router.midstream") if faults is not None else None
+        )
         self.registry = registry
         self.metrics = metrics
         self.tracer = get_tracer()
@@ -311,11 +326,28 @@ class ReplicaRouter:
         self.fleet.note_success(rep, health)
         return health
 
+    async def _poll_one(self, rep: Replica) -> None:
+        """One replica's probe, hardened: ANY unexpected exception (a
+        raising metrics callback, a pathological payload — anything
+        _probe_health's expected-failure net doesn't catch) counts a
+        liveness failure for THIS replica and never reaches the poll
+        loop — one bad replica must not blind routing to the rest."""
+        try:
+            await self._probe_health(rep)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - a dead poller blinds routing
+            log.exception(
+                "health probe failed unexpectedly",
+                extra={"fields": {"replica": rep.rid}},
+            )
+            self.fleet.note_failure(rep)
+
     async def _poll_loop(self) -> None:
         while True:
             try:
                 await asyncio.gather(
-                    *(self._probe_health(r) for r in self.fleet.all())
+                    *(self._poll_one(r) for r in self.fleet.all())
                 )
                 if self.metrics is not None:
                     now = time.monotonic()
@@ -552,6 +584,13 @@ class ReplicaRouter:
         Raises _Unreachable/_Overloaded for the failover loop; anything
         past response headers is final."""
         url = f"{rep.url}{request.path}"
+        if self._flt_connect is not None:
+            try:
+                self._flt_connect.fire()
+            except FaultError as e:
+                # injected connection failure: the failover loop moves
+                # to the next ring candidate, like a real refusal
+                raise _Unreachable(str(e)) from None
         try:
             post = self._session.post(url, data=raw, headers=headers)
             if self.header_timeout_s > 0:
@@ -587,6 +626,18 @@ class ReplicaRouter:
                 # so the stream is bit-identical to direct submission
                 async for chunk in resp.content.iter_any():
                     await out.write(chunk)
+                    if self._flt_midstream is not None:
+                        try:
+                            self._flt_midstream.fire()
+                        except FaultError:
+                            # injected mid-relay death: close the
+                            # backend HARD and end the client stream
+                            # without a done event — a VISIBLE
+                            # truncation, never retried (the client
+                            # already consumed bytes; replay would
+                            # duplicate them)
+                            resp.close()
+                            return out
                 await out.write_eof()
                 resp.release()
                 return out
@@ -783,14 +834,21 @@ def _main(argv: list[str] | None = None) -> int:
                         "replicas' effective ladder — custom buckets or "
                         "a small --maxLen trimming it — or affinity "
                         "keys cut where no cache ever promotes")
-    parser.add_argument("--headerTimeoutS", type=float, default=0.0,
+    parser.add_argument("--headerTimeoutS", type=float, default=300.0,
                         help="bound the header phase of a dispatch so a "
                         "wedged replica (socket accepts, never answers) "
-                        "fails over like a connection failure; 0 (the "
-                        "default) disables — non-streamed generates "
-                        "answer headers only when generation completes, "
-                        "which can legitimately take minutes on a cold "
-                        "compile")
+                        "fails over like a connection failure within "
+                        "the timeout instead of hanging the client "
+                        "forever; the default sits above a non-streamed "
+                        "generate's cold-compile worst case (headers "
+                        "arrive only at completion — minutes); 0 "
+                        "restores unbounded")
+    parser.add_argument("--faults", default="",
+                        help="seeded fault injection (serving/faults.py) "
+                        "for the router-side points router.connect / "
+                        "router.midstream, e.g. 'router.connect:nth=2'; "
+                        "also read from TPU_SERVING_FAULTS; empty = "
+                        "disarmed")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing: router spans propagate to "
                         "the replicas via traceparent")
@@ -817,6 +875,10 @@ def _main(argv: list[str] | None = None) -> int:
                 "comma list of integers"
             ) from None
 
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    fault_plane = FaultPlane.from_cli(args.faults)
+
     fleet = FleetRegistry.from_spec(args.replicas, dead_after=args.deadAfter)
     router = ReplicaRouter(
         fleet, host=args.host, port=args.port, policy=args.policy,
@@ -826,6 +888,7 @@ def _main(argv: list[str] | None = None) -> int:
         drain_timeout_s=args.drainTimeoutS,
         header_timeout_s=args.headerTimeoutS,
         registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
+        faults=fault_plane,
     )
 
     async def serve():

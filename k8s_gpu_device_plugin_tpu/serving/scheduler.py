@@ -343,11 +343,13 @@ class Scheduler:
         ts.refill(now)
         self._queued_cost.pop(req.rid, None)  # charge becomes final
         self._defer_t0.pop(req.rid, None)
-        if req.preemptions:
-            # a RESUMED request: its first admission already charged the
-            # full worst-case work and observed the queue wait —
-            # re-charging the (now output-inflated) prompt would demote
-            # preemption victims below their fair share
+        if req.preemptions or getattr(req, "restarts", 0):
+            # a RESUMED request (preemption eviction, or an engine-crash
+            # recovery resume — serving/supervisor.py): its first
+            # admission already charged the full worst-case work and
+            # observed the queue wait — re-charging the (now
+            # output-inflated) prompt would demote the victims below
+            # their fair share
             return
         ts.admitted += 1
         # WFQ virtual time advances by the admitted work / weight — the

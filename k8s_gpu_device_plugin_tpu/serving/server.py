@@ -53,6 +53,17 @@ report the reuse (``cached_tokens`` natively, OpenAI
 ``usage.prompt_tokens_details``), ``/v1/health`` carries live cache
 stats, and token/logprob streams are bit-identical cache on or off.
 
+Crash recovery (serving/supervisor.py; on by default): an engine-thread
+exception no longer kills the replica — within ``--restartBudget`` per
+rolling ``--restartWindowS`` the batcher is rebuilt in place, queued
+requests replay in admission order and in-flight streams resume
+bit-identically through the preemption fold; past the budget the
+replica degrades to dead with a STRUCTURED error frame on every stream
+(native SSE ``{"error": ...}`` event / OpenAI ``server_error``
+envelope / 503 bodies), never a silent clean EOS. ``/v1/health``
+carries a ``supervisor`` section, and ``--faults`` arms the seeded
+fault-injection plane (serving/faults.py) that rehearses all of this.
+
 Design notes: the engine thread is the batcher's sole owner, and
 handlers never wait on device work — submissions ride a small locked
 queue the engine drains between steps. The batcher's decode loop is
@@ -79,8 +90,13 @@ from aiohttp import web
 from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.serving.faults import FaultError
 from k8s_gpu_device_plugin_tpu.serving.scheduler import (
     SchedulerOverloadError,
+)
+from k8s_gpu_device_plugin_tpu.serving.supervisor import (
+    EngineSupervisor,
+    StreamError,
 )
 from k8s_gpu_device_plugin_tpu.obs.trace import (
     TRACEPARENT_HEADER,
@@ -124,6 +140,8 @@ class InferenceEngine:
         tp: int | None = None,  # None = take cfg.tp (1 = single chip)
         attribution=None,  # obs.attribution.RequestAttributor (or None)
         mfu=None,  # metrics.roofline.MfuAccumulator (or None)
+        supervisor: "EngineSupervisor | None" = None,  # None = default budget
+        faults=None,  # serving.faults.FaultPlane (or None = disarmed)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -171,6 +189,19 @@ class InferenceEngine:
                 "constructor; silently ignoring them here would serve "
                 "no timelines while reporting the layer enabled"
             )
+        if batcher is not None and faults is not None:
+            raise ValueError(
+                "pass the fault plane to the injected batcher's own "
+                "constructor; silently ignoring it here would leave "
+                "every armed engine-side fault point disarmed"
+            )
+        if batcher is not None and supervisor is not None:
+            raise ValueError(
+                "crash recovery requires the engine-built batcher: an "
+                "injected one carries no rebuild recipe (and the "
+                "speculative engine has no resume path for its draft "
+                "cache)"
+            )
         # request-edge SLO defaults: a request that names no tenant /
         # priority / deadline gets these (the "defaulted at the server
         # edge" contract — the batcher itself never invents a deadline)
@@ -180,17 +211,37 @@ class InferenceEngine:
             {} if prompt_buckets is None
             else {"prompt_buckets": tuple(prompt_buckets)}
         )
-        self.cb = batcher or ContinuousBatcher(
-            params, cfg, n_slots=n_slots, max_len=max_len,
-            sampler=sampler, eos_id=eos_id,
-            chunked_prefill=min(chunked_prefill, max_len),
-            metrics=metrics, adapters=adapters, **buckets_kw,
-            pipeline_depth=pipeline_depth, trace_steps=trace_steps,
-            prefix_cache=prefix_cache,
-            kv_layout=kv_layout, kv_page_size=kv_page_size,
-            kv_pages=kv_pages, scheduler=scheduler, tp=tp,
-            attribution=attribution, mfu=mfu,
-        )
+        if batcher is not None:
+            self.cb = batcher
+            self._make_batcher = None
+            self.supervisor: "EngineSupervisor | None" = None
+        else:
+            # the construction recipe is CAPTURED so the supervisor can
+            # rebuild a fresh batcher (new device state, new pools) after
+            # an engine-thread crash — same metrics/scheduler/attribution
+            # objects, whose ledgers live through the restart
+            def make_batcher() -> ContinuousBatcher:
+                return ContinuousBatcher(
+                    params, cfg, n_slots=n_slots, max_len=max_len,
+                    sampler=sampler, eos_id=eos_id,
+                    chunked_prefill=min(chunked_prefill, max_len),
+                    metrics=metrics, adapters=adapters, **buckets_kw,
+                    pipeline_depth=pipeline_depth, trace_steps=trace_steps,
+                    prefix_cache=prefix_cache,
+                    kv_layout=kv_layout, kv_page_size=kv_page_size,
+                    kv_pages=kv_pages, scheduler=scheduler, tp=tp,
+                    attribution=attribution, mfu=mfu, faults=faults,
+                )
+
+            self.cb = make_batcher()
+            self._make_batcher = make_batcher
+            # crash recovery is ON by default (the default rolling
+            # budget); EngineSupervisor(max_restarts=0) degrades every
+            # crash to the dead state — with structured error frames,
+            # never the old silent clean-EOS close
+            self.supervisor = (
+                supervisor if supervisor is not None else EngineSupervisor()
+            )
         # The engine thread is the ONLY toucher of self.cb — a device
         # step can take long, and a shared lock would let a submit
         # handler block the event loop behind it. Submissions go through
@@ -376,6 +427,11 @@ class InferenceEngine:
                 # counts only on health; the timelines themselves live
                 # on /debug/requests and /debug/slow
                 out["attribution"] = attr
+        if self.supervisor is not None:
+            # crash-recovery view (state, restart budget, replay/resume
+            # tallies, last crash) — the supervisor's own snapshot
+            # method, same thread contract as kv_stats/sched_stats
+            out["supervisor"] = self.supervisor.stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -416,6 +472,25 @@ class InferenceEngine:
                     }
                 if stream is not None:
                     loop, q = stream
+                    loop.call_soon_threadsafe(q.put_nowait, None)
+                continue
+            except Exception as e:  # noqa: BLE001 - one bad admission
+                # must kill neither the engine loop nor the other
+                # streams: close THIS stream with a structured error
+                # frame (the request-thread validation makes this path
+                # unreachable for well-formed requests, so anything
+                # here is a real defect worth the loud log)
+                log.exception("admission failed for eid=%s", eid)
+                with self._lock:
+                    stream = self._streams.pop(eid, None)
+                    self._published.pop(eid, None)
+                if stream is not None:
+                    loop, q = stream
+                    loop.call_soon_threadsafe(
+                        q.put_nowait,
+                        StreamError("submit_failed",
+                                    f"admission failed: {e}"),
+                    )
                     loop.call_soon_threadsafe(q.put_nowait, None)
                 continue
             self._rid_to_eid[rid] = eid
@@ -508,43 +583,96 @@ class InferenceEngine:
             self._published[eid] = len(out)
 
     def _loop(self) -> None:
+        """Crash boundary around the step loop: an engine-thread
+        exception recovers IN PLACE through the supervisor (fresh
+        batcher, queued work replayed in order, in-flight requests
+        resumed bit-identically via the preemption fold) while the
+        restart budget lasts; past it — or without a rebuild recipe —
+        the engine degrades to the dead state, closing every stream
+        with a structured error frame instead of a silent clean EOS."""
+        while True:
+            try:
+                self._loop_inner()
+                return  # clean shutdown (_stop set)
+            except Exception as exc:  # noqa: BLE001 - the crash boundary
+                log.exception("inference engine loop died")
+                if self._stop.is_set():
+                    # a crash racing shutdown(): the clean-exit path —
+                    # rebuilding a whole batcher just to observe _stop
+                    # would hold the joining thread through compiles
+                    return
+                sup = self.supervisor
+                if sup is not None:
+                    sup.on_crash(exc)
+                if sup is None or self._make_batcher is None \
+                        or not sup.allow_restart():
+                    detail = (
+                        " (restart budget exhausted)"
+                        if sup is not None and sup.max_restarts else ""
+                    )
+                    self._die(
+                        "engine_dead",
+                        f"inference engine died{detail}: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                    return
+                try:
+                    sup.recover(self)
+                except Exception:  # noqa: BLE001 - rebuild failed
+                    log.exception(
+                        "engine recovery failed; degrading to dead"
+                    )
+                    self._die(
+                        "engine_dead",
+                        "inference engine recovery failed (see logs)",
+                    )
+                    return
+
+    def _loop_inner(self) -> None:
         was_busy = False
-        try:
-            while not self._stop.is_set():
-                self._admit_submissions()
-                self._apply_cancellations()
-                busy = bool(
-                    self.cb.pending or self.cb.running or self.cb.prefilling
-                )
-                if busy:
-                    self.cb.step()
-                    self._publish()
-                else:
-                    if was_busy:
-                        # busy->idle transition: throughput gauge reads 0
-                        # while idle, not the last busy window's value.
-                        # getattr: metrics is duck-typed to the batcher
-                        # hooks only; on_idle is optional.
-                        on_idle = getattr(
-                            getattr(self.cb, "metrics", None), "on_idle", None
-                        )
-                        if on_idle is not None:
-                            on_idle()
-                        # same busy->idle zeroing for the MFU window
-                        mfu = getattr(self.cb, "mfu", None)
-                        if mfu is not None:
-                            mfu.on_idle()
-                    self._work.wait(timeout=0.05)
-                    self._work.clear()
-                was_busy = busy
-        except Exception:  # noqa: BLE001 - a dead loop must not hang clients
-            log.exception("inference engine loop died")
-            self._dead.set()
-            with self._lock:
-                streams, self._streams = self._streams, {}
-                self._published.clear()
-            for loop, q in streams.values():
-                loop.call_soon_threadsafe(q.put_nowait, None)
+        while not self._stop.is_set():
+            self._admit_submissions()
+            self._apply_cancellations()
+            busy = bool(
+                self.cb.pending or self.cb.running or self.cb.prefilling
+            )
+            if busy:
+                self.cb.step()
+                self._publish()
+            else:
+                if was_busy:
+                    # busy->idle transition: throughput gauge reads 0
+                    # while idle, not the last busy window's value.
+                    # getattr: metrics is duck-typed to the batcher
+                    # hooks only; on_idle is optional.
+                    on_idle = getattr(
+                        getattr(self.cb, "metrics", None), "on_idle", None
+                    )
+                    if on_idle is not None:
+                        on_idle()
+                    # same busy->idle zeroing for the MFU window
+                    mfu = getattr(self.cb, "mfu", None)
+                    if mfu is not None:
+                        mfu.on_idle()
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+            was_busy = busy
+
+    def _die(self, code: str, message: str) -> None:
+        """Degrade to the dead state: every open stream gets a
+        structured :class:`StreamError` frame and then end-of-stream —
+        a truncated stream must never read as a short completion
+        (both HTTP surfaces translate the frame; pinned in tests)."""
+        if self.supervisor is not None:
+            self.supervisor.mark_dead()
+        self._dead.set()
+        with self._lock:
+            streams, self._streams = self._streams, {}
+            self._published.clear()
+        err = StreamError(code, message)
+        for loop, q in streams.values():
+            loop.call_soon_threadsafe(q.put_nowait, err)
+            loop.call_soon_threadsafe(q.put_nowait, None)
 
 
 def _overload_response(message: str, reason: str,
@@ -577,15 +705,24 @@ def _parse_logit_bias(raw) -> dict | None:
         ) from None
 
 
-async def drain_queue(queue: asyncio.Queue) -> tuple[list[int], list[float]]:
-    """Collect one request's full (tokens, logprobs) off its stream queue
-    (None = end-of-stream). Shared by the native and OpenAI handlers."""
+async def drain_queue(
+    queue: asyncio.Queue,
+) -> "tuple[list[int], list[float], StreamError | None]":
+    """Collect one request's full (tokens, logprobs, error) off its
+    stream queue (None = end-of-stream; a StreamError frame before it
+    marks an abnormal close — engine death, exhausted restart budget).
+    Shared by the native and OpenAI handlers, which turn a non-None
+    error into a real error response instead of a silent truncation."""
     toks: list[int] = []
     lps: list[float] = []
+    err: "StreamError | None" = None
     while True:
         item = await queue.get()
         if item is None:
-            return toks, lps
+            return toks, lps, err
+        if isinstance(item, StreamError):
+            err = item
+            continue
         toks.append(item[0])
         lps.append(item[1])
 
@@ -595,8 +732,15 @@ class InferenceServer:
 
     def __init__(self, engine: InferenceEngine, host: str = "0.0.0.0",
                  port: int = 8000, registry=None, tokenizer=None,
-                 embedder=None, scorer=None, replica_id: str = ""):
+                 embedder=None, scorer=None, replica_id: str = "",
+                 faults=None):
         self.engine = engine
+        # seeded fault injection (serving/faults.py): the health
+        # handler's point — a live socket over a lying health surface,
+        # what the router's poller hardening is pinned against
+        self._flt_health = (
+            faults.point("health.handler") if faults is not None else None
+        )
         self.host = host
         self.port = port
         self.bound_port: int | None = None
@@ -770,6 +914,11 @@ class InferenceServer:
         return web.json_response(payload)
 
     async def _health(self, request: web.Request) -> web.Response:
+        if self._flt_health is not None:
+            try:
+                self._flt_health.fire()
+            except FaultError as e:
+                return web.json_response({"error": str(e)}, status=500)
         stats = self.engine.stats()
         # fleet identity + age: the replica router's registry (and any
         # dashboard aggregating N replicas) needs to tell replicas
@@ -924,6 +1073,14 @@ class InferenceServer:
                 for eid_, _ in subs:
                     self.engine.cancel(eid_)
                 raise
+            err = next((d[2] for d in drained if d[2] is not None), None)
+            if err is not None:
+                # the engine died (or exhausted its restart budget) under
+                # this request: a real error status, never a 200 carrying
+                # silently truncated tokens
+                return web.json_response(
+                    {"error": err.message, "code": err.code}, status=503
+                )
             infos = [self.engine.pop_request_info(eid_) for eid_, _ in subs]
             reject = next(
                 (i["reject_reason"] for i in infos
@@ -983,6 +1140,17 @@ class InferenceServer:
         try:
             while True:
                 item = await q.get()
+                if isinstance(item, StreamError):
+                    # abnormal close: a structured SSE error event, NOT
+                    # the done event — clients can tell a crashed stream
+                    # from a finished one (the old dead path closed with
+                    # a clean done, indistinguishable from success)
+                    evt = {"error": {"code": item.code,
+                                     "message": item.message}}
+                    await resp.write(
+                        f"data: {json.dumps(evt)}\n\n".encode()
+                    )
+                    break
                 if item is None:
                     # closing event carries the full decoded text
                     # (incremental per-token decode is wrong across
@@ -1348,6 +1516,25 @@ def _main(argv: list[str] | None = None) -> int:
                         help="stable fleet identity reported on "
                         "/v1/health (serving/router.py's registry and "
                         "dashboards key on it); empty = hostname:port")
+    parser.add_argument("--restartBudget", type=int, default=3,
+                        help="engine crash recoveries allowed per "
+                        "rolling --restartWindowS window (serving/"
+                        "supervisor.py): within budget a crashed "
+                        "engine rebuilds in place, replays its queue "
+                        "in order and resumes in-flight streams "
+                        "bit-identically; past it (or with 0) the "
+                        "replica degrades to dead and every stream "
+                        "closes with a structured error frame")
+    parser.add_argument("--restartWindowS", type=float, default=300.0,
+                        help="rolling window for --restartBudget")
+    parser.add_argument("--faults", default="",
+                        help="seeded fault injection (serving/"
+                        "faults.py): comma list of armed fault points "
+                        "with schedules, e.g. 'decode.apply:nth=40,"
+                        "pool.alloc:p=0.25:seed=3:times=6'; also read "
+                        "from TPU_SERVING_FAULTS; empty = disarmed "
+                        "(the production default — each point costs "
+                        "one is-not-None check)")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing (obs/): request span trees on "
                         "GET /debug/traces, trace ids in JSON logs, span-"
@@ -1546,6 +1733,10 @@ def _main(argv: list[str] | None = None) -> int:
             ServingCostModel.for_config(cfg, tp=args.tp), metrics=metrics
         )
 
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    fault_plane = FaultPlane.from_cli(args.faults)
+
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1576,6 +1767,7 @@ def _main(argv: list[str] | None = None) -> int:
             tp=args.tp,
             attribution=attribution,
             mfu=mfu,
+            faults=fault_plane,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1595,13 +1787,21 @@ def _main(argv: list[str] | None = None) -> int:
         tp=None if batcher is not None else args.tp,
         attribution=None if batcher is not None else attribution,
         mfu=None if batcher is not None else mfu,
+        # the speculative engine has no resume path (injected batcher:
+        # no rebuild recipe) — its crashes degrade to the dead state,
+        # now with structured error frames either way
+        supervisor=None if batcher is not None else EngineSupervisor(
+            max_restarts=args.restartBudget, window_s=args.restartWindowS,
+        ),
+        faults=None if batcher is not None else fault_plane,
     )
     from prometheus_client import REGISTRY
 
     server = InferenceServer(engine, host=args.host, port=args.port,
                              registry=REGISTRY, tokenizer=tokenizer,
                              embedder=embedder, scorer=scorer,
-                             replica_id=args.replicaId)
+                             replica_id=args.replicaId,
+                             faults=fault_plane)
 
     async def serve():
         stop = asyncio.Event()

@@ -1,0 +1,311 @@
+"""Engine supervisor: in-replica crash recovery for the serving engine.
+
+Before this module, a single engine-thread exception was terminal: the
+loop logged, set ``_dead``, and pushed a bare end-of-stream ``None`` to
+every client queue — the replica stayed dead until a process restart,
+and a truncated stream was indistinguishable from a clean finish
+(serving/server.py's old dead path). PR 11's router routes *around*
+dead replicas; this is the tier that recovers *inside* one.
+
+Recovery reuses the machinery the stack already trusts:
+
+- **Capture.** The crashed batcher's host-side ledgers are intact (the
+  engine thread is their sole owner, and it is the thread running this
+  code): queued submissions still sit in the engine's submit queue,
+  and every live request is a ``_Request`` in ``pending`` /
+  ``prefilling`` / ``running``. Committed-but-unpublished tokens are
+  pushed to their streams first — device work lost in flight was never
+  in ``req.out``, so nothing can double-emit.
+- **Rebuild.** A fresh batcher from the engine's own construction
+  recipe: new device state, new page pool, the SAME metrics /
+  scheduler / attribution / MFU objects (their ledgers are
+  engine-owned and survive). The prefix cache re-attaches as-is on the
+  dense layout (entries are standalone rows); on the paged layout it
+  is RESET — promoted entries hold page ids of the dead pool.
+- **Resume.** Each surviving request rides the PR-7 preemption-resume
+  fold: emitted tokens fold back into ``prompt`` as ``prefilled_out``,
+  so the re-prefill recomputes their K/V and the finish chunk samples
+  emission (and seeded draw) number ``prefilled_out`` — greedy and
+  seeded streams through an induced mid-decode crash are pinned
+  bit-identical to an uninterrupted run, and no token is ever
+  re-emitted (tests/test_supervisor.py). Requests keep their rids
+  (the new batcher's rid counter continues from the old one's), so
+  the engine's rid->stream map needs no surgery and clients only see
+  a latency blip.
+
+Restarts are **budgeted**: ``max_restarts`` per rolling ``window_s``.
+An exhausted budget degrades to the dead state — but streams then end
+with a structured :class:`StreamError` frame on both HTTP surfaces
+(native SSE error event / OpenAI ``server_error`` envelope), never a
+silent clean EOS.
+
+Thread model: every mutable ledger here is engine-thread-owned (the
+``recover``/``on_crash`` callers run in the crashed loop's except
+block); cross-thread readers — ``/v1/health``'s ``supervisor``
+section — go through the :meth:`EngineSupervisor.stats` snapshot, the
+same contract as ``kv_stats``/``sched_stats``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+log = get_logger()
+
+
+class StreamError:
+    """Terminal structured-error frame on a per-request stream queue.
+
+    The stream protocol items are ``(token, logprob)`` tuples closed by
+    ``None``; a stream that dies abnormally now carries one of these
+    BEFORE the closing ``None``, so both HTTP planes can emit a real
+    error (native SSE ``{"error": ...}`` event, OpenAI ``server_error``
+    envelope, 503 on the non-streamed paths) instead of a silent
+    truncation that reads exactly like a short completion.
+    """
+
+    __slots__ = ("code", "message")
+
+    def __init__(self, code: str, message: str):
+        self.code = code
+        self.message = message
+
+    def __repr__(self) -> str:  # readable in logs/test failures
+        return f"StreamError(code={self.code!r}, message={self.message!r})"
+
+
+class EngineSupervisor:
+    """Restart policy + recovery mechanics for one InferenceEngine.
+
+    ``max_restarts`` restarts are allowed per rolling ``window_s``
+    seconds; ``max_restarts=0`` disables recovery outright (every crash
+    degrades to the dead state — with the structured-error close, not
+    the old silent one).
+    """
+
+    def __init__(self, max_restarts: int = 3, window_s: float = 300.0):
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}"
+            )
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self._restart_times: list[float] = []  # owner: engine
+        self._state = "ok"                     # owner: engine
+        self._last_crash: dict | None = None   # owner: engine
+        self._crashes_total = 0                # owner: engine
+        self._restarts_total = 0               # owner: engine
+        self._replayed_total = 0               # owner: engine
+        self._resumed_total = 0                # owner: engine
+
+    # --- policy (engine thread) ------------------------------------------
+
+    def on_crash(self, exc: BaseException) -> None:
+        """Record one engine-loop crash (restart or not)."""
+        self._crashes_total += 1
+        self._last_crash = {
+            "t_wall": time.time(),
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.span(
+                "engine_crash", component="serving_engine",
+                error=f"{type(exc).__name__}: {exc}",
+                crashes=self._crashes_total,
+            ).end()
+
+    def allow_restart(self) -> bool:
+        """True while the rolling restart budget has room."""
+        now = time.monotonic()
+        self._restart_times = [
+            t for t in self._restart_times if now - t < self.window_s
+        ]
+        return len(self._restart_times) < self.max_restarts
+
+    def mark_dead(self) -> None:
+        self._state = "dead"
+
+    # --- recovery (engine thread, inside the crashed loop's except) ------
+
+    @staticmethod
+    def _live_requests(cb) -> list:
+        return (
+            list(cb.pending)
+            + list(cb.prefilling.values())
+            + list(cb.running.values())
+        )
+
+    @staticmethod
+    def _fallback_publish(engine, old) -> None:
+        """Defensive twin of ``engine._publish`` for when that raised
+        against the torn batcher: push every live request's committed
+        tokens, and CLOSE the streams of requests that retired between
+        the last publish and the crash — those rids never reach the
+        rebuilt batcher, so no later publish would ever end their
+        streams (the handler would await forever). Per-request
+        try/except: one bad entry must not strand the rest."""
+        for req in EngineSupervisor._live_requests(old):
+            try:
+                engine._push(req.rid, req.out, req.out_logp)
+            except Exception:  # noqa: BLE001
+                log.exception("fallback push failed for rid=%s", req.rid)
+        for rid, eid in list(engine._rid_to_eid.items()):
+            req = old.done_requests.pop(rid, None)
+            if req is None:
+                continue
+            try:
+                engine._push(rid, req.out, req.out_logp)
+            except Exception:  # noqa: BLE001
+                log.exception("fallback push failed for rid=%s", rid)
+            old.done.pop(rid, None)
+            # mirror _publish's wrap-up record: a request that retired
+            # REJECTED just before the crash must still surface as a
+            # 429/rejected disposition, never as a clean zero-token
+            # done (the silent-truncation shape this PR kills)
+            info: dict = {"cached_tokens": req.cached_tokens}
+            tl = getattr(req, "timeline", None)
+            if tl is not None and getattr(tl, "record", None) is not None:
+                info["timeline"] = tl.record
+            if req.reject_reason is not None:
+                info["reject_reason"] = req.reject_reason
+                sched = getattr(old, "scheduler", None)
+                info["retry_after"] = (
+                    sched.retry_after_s() if sched is not None else 1
+                )
+            with engine._lock:
+                stream = engine._streams.pop(eid, None)
+                engine._published.pop(eid, None)
+                engine._finished_info[eid] = info
+            del engine._rid_to_eid[rid]
+            if stream is not None:
+                loop, q = stream
+                loop.call_soon_threadsafe(q.put_nowait, None)
+
+    def recover(self, engine) -> None:
+        """Rebuild ``engine.cb`` in place and resume its work. Raises
+        if the rebuild itself fails (the caller then degrades to the
+        dead state)."""
+        old = engine.cb
+        # 1. deliver every committed token. A crash between the paired
+        # out/out_logp appends can leave one list a token long; trim to
+        # the committed pair so the publish below and the prompt fold
+        # agree on what was emitted.
+        for req in self._live_requests(old):
+            n = min(len(req.out), len(req.out_logp))
+            del req.out[n:]
+            del req.out_logp[n:]
+        try:
+            # the normal publish also closes streams of requests that
+            # retired between the last publish and the crash
+            engine._publish()
+        except Exception:  # noqa: BLE001 - torn batcher state
+            log.exception("post-crash publish failed; pushing live "
+                          "streams directly")
+            self._fallback_publish(engine, old)
+        survivors = sorted(self._live_requests(old), key=lambda r: r.rid)
+        # 2. the prefix cache: paged entries hold page ids of the DEAD
+        # pool — reset them (dense entries are standalone rows and
+        # re-attach as-is; the batcher ctor would refuse stale paged
+        # entries anyway, loudly)
+        pc = getattr(old, "prefix_cache", None)
+        if pc is not None and getattr(old, "pool", None) is not None:
+            reset = getattr(pc, "reset", None)
+            if reset is not None:
+                reset()
+        new = engine._make_batcher()
+        # rids stay unique AND stable across the restart: survivors
+        # keep theirs (the engine's rid->stream map needs no surgery)
+        # and fresh admissions continue the old sequence
+        new._next_rid = old._next_rid
+        now = time.perf_counter()
+        replayed = resumed = 0
+        for req in survivors:
+            was_admitted = req.slot >= 0
+            # the preemption fold (_preempt_slot's exact recipe): the
+            # resumed finish chunk samples emission — and seeded draw —
+            # number prefilled_out, so the continued stream is
+            # bit-identical and no token is re-emitted
+            req.prompt = list(req.prompt) + [
+                int(t) for t in req.out[req.prefilled_out:]
+            ]
+            req.prefilled_out = len(req.out)
+            req.slot = -1
+            req.matched = False
+            req.prefix = None
+            req._match_depth = None
+            req._pinned_pages = None   # pins belonged to the dead pool
+            req._new_pages = None
+            req._draft_new_pages = None
+            req.defer_counted = False
+            if req.out:
+                resumed += 1
+            else:
+                replayed += 1
+            if was_admitted or req.out:
+                # mid-stream survivor (decoding, prefilling, or parked
+                # in pending by a preemption with tokens already out):
+                # the flight recorder always retains these, and the
+                # scheduler skips re-charging its (now output-inflated)
+                # prompt
+                req.restarts += 1
+            if was_admitted:
+                if req.timeline is not None:
+                    # decode/prefill segment closes at the crash; a
+                    # fresh queue_wait opens (the resumed admission
+                    # closes it), keeping phase sums exact
+                    req.timeline.advance("queue_wait", now)
+            if req.decode_span is not None:
+                req.decode_span.set(tokens=len(req.out)).end()
+                req.decode_span = None
+            new.pending.append(req)
+        engine.cb = new
+        self._restart_times.append(time.monotonic())
+        self._restarts_total += 1
+        self._replayed_total += replayed
+        self._resumed_total += resumed
+        metrics = getattr(new, "metrics", None)
+        if metrics is not None:
+            on_restart = getattr(metrics, "on_engine_restart", None)
+            if on_restart is not None:
+                on_restart(replayed, resumed)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.span(
+                "engine_restart", component="serving_engine",
+                restart=self._restarts_total, replayed=replayed,
+                resumed=resumed,
+            ).end()
+        log.warning(
+            "inference engine restarted after crash",
+            extra={"fields": {
+                "restarts_total": self._restarts_total,
+                "replayed": replayed,
+                "resumed": resumed,
+                "last_crash": (self._last_crash or {}).get("error"),
+            }},
+        )
+
+    # --- cross-thread snapshot -------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``supervisor`` section of ``/v1/health`` (schema pinned
+        in tests/test_health.py): plain copies under the same
+        approximate-read contract as ``kv_stats``."""
+        return {
+            "state": self._state,
+            "max_restarts": self.max_restarts,
+            "window_s": self.window_s,
+            "crashes_total": self._crashes_total,
+            "restarts_total": self._restarts_total,
+            "replayed_total": self._replayed_total,
+            "resumed_total": self._resumed_total,
+            "last_crash": (
+                dict(self._last_crash) if self._last_crash else None
+            ),
+        }

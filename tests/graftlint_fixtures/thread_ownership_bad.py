@@ -36,11 +36,24 @@ class Recorder:
         return {"requests": [dict(r) for r in list(self._slow_ring)]}
 
 
+class Supervisor:
+    """The serving/supervisor.py shape: the crash-recovery ledgers are
+    engine-thread state; /v1/health must use the stats() snapshot."""
+
+    def __init__(self):
+        self._restart_times = []   # owner: engine
+        self._last_crash = None    # owner: engine
+
+    def stats(self):
+        return {"restarts": len(list(self._restart_times))}
+
+
 class Server:
-    def __init__(self, cb, sched, rec):
+    def __init__(self, cb, sched, rec, sup):
         self.cb = cb
         self.sched = sched
         self.rec = rec
+        self.sup = sup
 
     async def health(self, request):
         return {
@@ -48,6 +61,8 @@ class Server:
             "slots": list(self.cb.running.values()),  # BAD: iteration races
             "free": self.cb.pool.free_pages,          # BAD: pool internals
             "tenants": dict(self.sched._tenants),     # BAD: ledger copy races
+            "restarts": len(self.sup._restart_times),  # OK: atomic len
+            "crash": self.sup._last_crash,            # BAD: ledger read
         }
 
     async def slow(self, request):
@@ -58,3 +73,6 @@ class Server:
 
     def overload(self):  # graftlint: cross-thread
         return self.sched.rejections["queue_full"]  # BAD: ledger read
+
+    def crashes(self):  # graftlint: cross-thread
+        return list(self.sup._restart_times)  # BAD: ledger iteration races

@@ -39,17 +39,38 @@ class Recorder:
         return {"requests": [dict(r) for r in list(self._slow_ring)]}
 
 
+class Supervisor:
+    """The serving/supervisor.py shape: restart/crash ledgers are
+    engine-owned (recover() runs in the crashed loop's except block);
+    /v1/health crosses the boundary only through the stats() snapshot."""
+
+    def __init__(self):
+        self._restart_times = []   # owner: engine
+        self._last_crash = None    # owner: engine
+
+    def stats(self):
+        # engine-state snapshot: plain copies out
+        return {
+            "restarts": len(list(self._restart_times)),
+            "last_crash": (
+                dict(self._last_crash) if self._last_crash else None
+            ),
+        }
+
+
 class Server:
-    def __init__(self, cb, sched, rec):
+    def __init__(self, cb, sched, rec, sup):
         self.cb = cb
         self.sched = sched
         self.rec = rec
+        self.sup = sup
 
     async def health(self, request):
         return {
             "active": len(self.cb.running),  # atomic len: sanctioned
             "kv": self.cb.kv_stats(),        # the snapshot boundary
             "sched": self.sched.sched_stats(),  # ditto for the scheduler
+            "supervisor": self.sup.stats(),  # ditto for the supervisor
         }
 
     async def slow(self, request):

@@ -100,13 +100,16 @@ def test_hot_path_flags_transfer_and_carry():
 def test_thread_ownership_allows_atomic_len():
     bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
     found = _run_on(bad, [_checker("thread-ownership")])
-    # the len(self.cb.running) read on the same handler must NOT fire;
-    # the iteration/copy/pool reads must — the scheduler-shaped ledger
-    # reads (serving/scheduler.py state) and the flight-recorder ring
-    # (obs/attribution.py state) fire the same way
-    assert len(found) == 6
+    # the len(self.cb.running) and len(self.sup._restart_times) reads
+    # on the same handler must NOT fire; the iteration/copy/pool reads
+    # must — the scheduler-shaped ledger reads (serving/scheduler.py
+    # state), the flight-recorder ring (obs/attribution.py state) and
+    # the supervisor's crash-recovery ledgers (serving/supervisor.py
+    # state) fire the same way
+    assert len(found) == 8
     assert {v.key for v in found} == {
         "running", "pool", "_tenants", "rejections", "_slow_ring",
+        "_last_crash", "_restart_times",
     }
 
 

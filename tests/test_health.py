@@ -340,11 +340,24 @@ def test_serving_health_reports_replica_identity():
     # pinned schema: the engine surface plus the fleet identity fields
     first, second, port = asyncio.run(probe("pod-7"))
     for key in ("slots", "active", "prefilling", "queued", "alive",
-                "replica_id", "uptime_s"):
+                "replica_id", "uptime_s", "supervisor"):
         assert key in first, f"/v1/health missing {key}"
     assert first["replica_id"] == "pod-7"
     assert second["replica_id"] == "pod-7"  # stable across reads
     assert 0.0 <= first["uptime_s"] <= second["uptime_s"]
+    # the supervisor section (serving/supervisor.py crash recovery):
+    # schema pinned so fleet dashboards and the router's registry can
+    # rely on it — state, the rolling restart budget, replay/resume
+    # tallies, and the last crash (null until one happens)
+    sup = first["supervisor"]
+    for key in ("state", "max_restarts", "window_s", "crashes_total",
+                "restarts_total", "replayed_total", "resumed_total",
+                "last_crash"):
+        assert key in sup, f"supervisor section missing {key}"
+    assert sup["state"] == "ok"
+    assert sup["restarts_total"] == 0
+    assert sup["last_crash"] is None
+    assert sup["max_restarts"] >= 1  # recovery is ON by default
 
     # default identity: hostname:port (the FleetRegistry bare-URL rule)
     import socket

@@ -450,7 +450,7 @@ def test_engine_threaded_stress_with_pipeline(setup):
             if i in (5, 9):
                 await asyncio.sleep(0.01)
                 engine.cancel(eid)
-            toks, _ = await drain_queue(q)
+            toks, _, _err = await drain_queue(q)
             return i, toks
 
         return dict(await asyncio.gather(*(one(i) for i in range(12))))

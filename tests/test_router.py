@@ -416,3 +416,149 @@ def test_backend_429_forwarded_with_retry_after(setup):
         setup, body, n_replicas=1,
         engine_kw={"scheduler": Scheduler(max_queue=1)},
     ))
+
+
+# --- robustness: wedged replicas, hardened polling, injected faults -------
+
+
+def test_header_timeout_default_is_finite():
+    """A replica that accepts the connection but never answers headers
+    must not hang clients forever: the DEFAULT header timeout is
+    finite (0 = unbounded stays an explicit opt-out)."""
+    fleet = FleetRegistry.from_spec("r0=http://127.0.0.1:1")
+    router = ReplicaRouter(fleet)
+    assert router.header_timeout_s > 0
+
+
+def test_wedged_replica_fails_over_within_header_timeout():
+    """One wedged backend (socket accepts, never writes) + one healthy
+    stub: every request lands on the healthy one within the header
+    timeout, counted as a failover — the hang-forever satellite pin."""
+    from aiohttp import web
+
+    async def body():
+        # the wedge: accept and hold the connection open silently
+        async def wedge(reader, writer):
+            try:
+                await asyncio.sleep(3600)
+            except asyncio.CancelledError:
+                writer.close()
+                raise
+
+        wedged = await asyncio.start_server(wedge, "127.0.0.1", 0)
+        wedged_port = wedged.sockets[0].getsockname()[1]
+
+        # the healthy stub: the router proxies byte-transparently, so a
+        # canned JSON body stands in for a real engine
+        app = web.Application()
+
+        async def gen(request):
+            return web.json_response({"id": 0, "tokens": [1, 2]})
+
+        app.router.add_post("/v1/generate", gen)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        ok_port = runner.addresses[0][1]
+
+        fleet = FleetRegistry.from_spec(
+            f"w=http://127.0.0.1:{wedged_port},"
+            f"ok=http://127.0.0.1:{ok_port}"
+        )
+        # polling OFF the fast path (long interval): the PROXY's header
+        # timeout must do the failing over, not the health poller
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, policy="rr",
+            header_timeout_s=0.4, health_interval_s=60.0,
+        )
+        stop = asyncio.Event()
+        task = asyncio.create_task(router.run(stop))
+        while router.bound_port is None:
+            await asyncio.sleep(0.01)
+        try:
+            async with aiohttp.ClientSession() as session:
+                t0 = asyncio.get_event_loop().time()
+                for i in range(2):  # rr: one of these starts on the wedge
+                    async with session.post(
+                        f"http://127.0.0.1:{router.bound_port}/v1/generate",
+                        json={"prompt": [1, 2, 3], "max_new": 2},
+                    ) as r:
+                        assert r.status == 200
+                        assert (await r.json())["tokens"] == [1, 2]
+                elapsed = asyncio.get_event_loop().time() - t0
+            assert elapsed < 5.0  # bounded by the header timeout, not 3600
+            assert router.router_stats()["failovers"] >= 1
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+            wedged.close()
+            await wedged.wait_closed()
+            await runner.cleanup()
+
+    run(body())
+
+
+def test_poll_loop_survives_probe_exceptions_and_marks_down():
+    """The hardened poller: an exception inside one replica's probe
+    iteration must neither kill the poller task nor hide the replica —
+    it is marked down (note_failure toward dead_after) while the other
+    replica keeps being polled."""
+
+    async def body():
+        fleet = FleetRegistry.from_spec(
+            "bad=http://127.0.0.1:1,good=http://127.0.0.1:2",
+            dead_after=3,
+        )
+        router = ReplicaRouter(fleet, health_interval_s=0.02)
+        probed = {"good": 0}
+
+        async def fake_probe(rep):
+            if rep.rid == "bad":
+                raise RuntimeError("raised inside the poll iteration")
+            probed["good"] += 1
+            fleet.note_success(rep, {"alive": True})
+            return {"alive": True}
+
+        router._probe_health = fake_probe
+        task = asyncio.create_task(router._poll_loop())
+        try:
+            await asyncio.sleep(0.3)
+            assert not task.done()  # the poller survived every raise
+            bad = fleet.get("bad")
+            assert bad.consecutive_failures >= 3
+            assert bad.alive is False  # marked down, not forgotten
+            good = fleet.get("good")
+            assert good.alive is True and probed["good"] >= 3
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    run(body())
+
+
+def test_injected_router_connect_fault_fails_over(setup):
+    """The router.connect fault point: an injected pre-dispatch
+    connection failure moves the request to the next ring candidate
+    (counted), and the client still gets its answer."""
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        for i in range(3):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(400 + i, 12, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        stats = ctx.router.router_stats()
+        assert stats["failovers"] >= 1
+        assert stats["outcomes"].get("unreachable", 0) >= 1
+
+    run(_with_fleet(
+        setup, body,
+        router_kw={"faults": FaultPlane.from_spec("router.connect:nth=1")},
+    ))

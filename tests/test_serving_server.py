@@ -277,22 +277,63 @@ def test_load_params_from_train_checkpoint(tmp_path, setup):
 
 
 def test_dead_engine_fails_fast_not_forever(setup):
-    """If the engine loop dies, in-flight streams close, /v1/health goes
-    503, and new submits are rejected — nothing hangs."""
+    """With recovery OFF (restart budget 0), a dead engine loop closes
+    in-flight streams with a STRUCTURED error frame (never a bare
+    end-of-stream that reads as a clean short completion), /v1/health
+    goes 503, and new submits are rejected — nothing hangs."""
+    from k8s_gpu_device_plugin_tpu.serving.supervisor import (
+        EngineSupervisor,
+        StreamError,
+    )
+
     cfg, params = setup
+
+    async def body():
+        engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                                 chunked_prefill=8,
+                                 supervisor=EngineSupervisor(max_restarts=0))
+        try:
+            # sabotage the batcher so the next step raises inside the loop
+            _, q = engine.submit(_prompt(240, 5, cfg), 3)
+            engine.cb.step = None  # TypeError on next loop iteration
+            item = await asyncio.wait_for(q.get(), 60)
+            while not isinstance(item, StreamError):  # tokens may precede
+                assert item is not None, "bare EOS: silent truncation"
+                item = await asyncio.wait_for(q.get(), 60)
+            assert item.code == "engine_dead"
+            assert await asyncio.wait_for(q.get(), 60) is None  # then EOS
+            assert engine.stats()["alive"] is False
+            with pytest.raises(RuntimeError):
+                engine.submit(_prompt(241, 5, cfg), 3)
+        finally:
+            engine.shutdown()
+
+    run(body())
+
+
+def test_engine_recovers_from_sabotaged_step_by_default(setup):
+    """The same sabotage with the DEFAULT engine: the supervisor
+    rebuilds the batcher in place and the stream completes — an engine
+    crash is a latency blip, not an outage."""
+    cfg, params = setup
+    p = _prompt(242, 5, cfg)
+    oracle = _oracle(params, p, cfg, 3)
 
     async def body():
         engine = InferenceEngine(params, cfg, n_slots=1, max_len=32,
                                  chunked_prefill=8)
         try:
-            # sabotage the batcher so the next step raises inside the loop
-            _, q = engine.submit(_prompt(240, 5, cfg), 3)
+            _, q = engine.submit(p, 3)
             engine.cb.step = None  # TypeError on next loop iteration
-            tok = await asyncio.wait_for(q.get(), 60)
-            assert tok is None            # stream closed, not hung
-            assert engine.stats()["alive"] is False
-            with pytest.raises(RuntimeError):
-                engine.submit(_prompt(241, 5, cfg), 3)
+            toks = []
+            while True:
+                item = await asyncio.wait_for(q.get(), 120)
+                if item is None:
+                    break
+                toks.append(item[0])
+            assert toks == oracle  # recovered AND bit-identical
+            assert engine.stats()["alive"] is True
+            assert engine.stats()["supervisor"]["restarts_total"] == 1
         finally:
             engine.shutdown()
 

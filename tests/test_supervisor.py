@@ -1,0 +1,590 @@
+"""Engine supervisor: crash recovery with stream-true error reporting.
+
+The contract pinned here (serving/supervisor.py + the serving/server.py
+crash boundary):
+
+- an induced mid-decode engine crash with recovery enabled leaves
+  greedy AND seeded token + logprob streams bit-identical to an
+  uninterrupted run (dense and paged layouts), with zero re-emitted
+  tokens;
+- queued requests replay in their original admission order;
+- transient injected pool-alloc failures defer admissions, they never
+  kill the engine;
+- an exhausted restart budget degrades to the dead state, and every
+  stream then ends with a STRUCTURED error frame on both HTTP
+  surfaces — never the old bare end-of-stream None that read exactly
+  like a short, successful completion.
+"""
+
+import asyncio
+import json
+
+import jax
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+from k8s_gpu_device_plugin_tpu.serving.server import (
+    InferenceEngine,
+    InferenceServer,
+    drain_queue,
+)
+from k8s_gpu_device_plugin_tpu.serving.supervisor import (
+    EngineSupervisor,
+    StreamError,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _mk_engine(params, cfg, *, faults=None, supervisor=None,
+               kv_layout="dense", n_slots=2, prefix_cache=None,
+               **kw):
+    return InferenceEngine(
+        params, cfg, n_slots=n_slots, max_len=64, chunked_prefill=8,
+        kv_layout=kv_layout,
+        kv_page_size=8 if kv_layout == "paged" else None,
+        faults=faults, supervisor=supervisor, prefix_cache=prefix_cache,
+        **kw,
+    )
+
+
+def _requests(cfg, n=5, max_new=12):
+    """n mixed requests: greedy and per-request-seeded sampling —
+    the two stream classes the resume pin covers."""
+    out = []
+    for i in range(n):
+        prompt = [1 + (7 * i + j) % (cfg.vocab_size - 1) for j in range(5)]
+        sampled = i % 2 == 0
+        out.append(dict(
+            prompt=prompt, max_new=max_new,
+            sampler=Sampler(temperature=0.8) if sampled else None,
+            seed=(100 + i) if sampled else None,
+        ))
+    return out
+
+
+def _drain_all(engine, reqs):
+    async def body():
+        subs = [
+            engine.submit(r["prompt"], r["max_new"], sampler=r["sampler"],
+                          seed=r["seed"])
+            for r in reqs
+        ]
+        return [await drain_queue(q) for _, q in subs]
+
+    return run(body())
+
+
+@pytest.mark.parametrize("kv_layout", ["dense", "paged"])
+def test_mid_decode_crash_resumes_bit_identical(setup, kv_layout):
+    """The acceptance pin: crash mid-decode, recover, and every stream
+    (greedy + seeded, tokens AND logprobs) is bit-identical to an
+    uninterrupted run — nothing lost, nothing re-emitted."""
+    cfg, params = setup
+    reqs = _requests(cfg)
+
+    eng = _mk_engine(params, cfg, kv_layout=kv_layout)
+    try:
+        baseline = _drain_all(eng, reqs)
+    finally:
+        eng.shutdown()
+    assert all(e is None for _, _, e in baseline)
+
+    eng = _mk_engine(
+        params, cfg, kv_layout=kv_layout,
+        faults=FaultPlane.from_spec("decode.apply:nth=6"),
+        supervisor=EngineSupervisor(max_restarts=3, window_s=60.0),
+    )
+    try:
+        chaotic = _drain_all(eng, reqs)
+        sup = eng.supervisor.stats()
+    finally:
+        eng.shutdown()
+    assert sup["restarts_total"] == 1, sup
+    assert sup["state"] == "ok"
+    assert sup["resumed_total"] + sup["replayed_total"] >= 1
+    assert sup["last_crash"]["error"].startswith("FaultError")
+    for (bt, bl, be), (ct, cl, ce) in zip(baseline, chaotic):
+        assert be is None and ce is None
+        assert ct == bt          # token stream bit-identical
+        assert cl == bl          # logprob stream bit-identical
+        # zero re-emitted tokens: exact length, no duplicated prefix
+        assert len(ct) == len(bt)
+
+
+def test_queued_requests_replay_in_admission_order(setup):
+    """One slot, three queued requests, crash during the first: after
+    recovery every stream completes and the COMPLETION order matches
+    the submission order (the supervisor re-admits in rid order)."""
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg, n_slots=1,
+        faults=FaultPlane.from_spec("decode.apply:nth=4"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    reqs = _requests(cfg, n=3, max_new=6)
+    finish_order = []
+
+    async def body():
+        subs = [
+            eng.submit(r["prompt"], r["max_new"], sampler=r["sampler"],
+                       seed=r["seed"])
+            for r in reqs
+        ]
+
+        async def one(i, q):
+            toks, _, err = await drain_queue(q)
+            finish_order.append(i)
+            return toks, err
+
+        return await asyncio.gather(
+            *(one(i, q) for i, (_, q) in enumerate(subs))
+        )
+
+    try:
+        results = run(body())
+        sup = eng.supervisor.stats()
+    finally:
+        eng.shutdown()
+    assert sup["restarts_total"] == 1
+    for toks, err in results:
+        assert err is None
+        assert len(toks) == 6
+    assert finish_order == [0, 1, 2]
+
+
+def test_pool_alloc_faults_defer_instead_of_killing(setup):
+    """Injected transient page-allocation failures read as pool
+    pressure: admissions defer and retry, streams complete, and the
+    engine never restarts."""
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg, kv_layout="paged",
+        faults=FaultPlane.from_spec("pool.alloc:p=0.5:seed=11:times=6"),
+    )
+    try:
+        results = _drain_all(eng, _requests(cfg, n=6, max_new=6))
+        sup = eng.supervisor.stats()
+    finally:
+        eng.shutdown()
+    assert all(e is None and len(t) == 6 for t, _, e in results)
+    assert sup["restarts_total"] == 0
+    assert sup["crashes_total"] == 0
+
+
+def test_prefill_dispatch_crash_replays_unstarted_requests(setup):
+    """A crash in the chunked-prefill dispatch (no tokens emitted yet)
+    replays the request from scratch — streams still complete and
+    match the no-fault run."""
+    cfg, params = setup
+    reqs = _requests(cfg, n=3, max_new=6)
+    eng = _mk_engine(params, cfg)
+    try:
+        baseline = _drain_all(eng, reqs)
+    finally:
+        eng.shutdown()
+    eng = _mk_engine(
+        params, cfg,
+        faults=FaultPlane.from_spec("prefill.dispatch:nth=2"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    try:
+        chaotic = _drain_all(eng, reqs)
+        sup = eng.supervisor.stats()
+    finally:
+        eng.shutdown()
+    assert sup["restarts_total"] == 1
+    for (bt, _, _), (ct, _, ce) in zip(baseline, chaotic):
+        assert ce is None and ct == bt
+
+
+def test_paged_prefix_cache_resets_and_recovers(setup):
+    """On the paged layout the prefix cache's promoted entries hold
+    page ids of the DEAD pool: recovery resets the cache (no stale
+    aliasing), re-attaches it, and promotion works again after."""
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=(8, 16, 32), budget_bytes=64 << 20)
+    eng = _mk_engine(
+        params, cfg, kv_layout="paged", prefix_cache=pc,
+        prompt_buckets=(8, 16, 32),  # promotion boundaries the prompts cover
+        faults=FaultPlane.from_spec("decode.apply:nth=10"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    shared = [3] * 16  # covers a promotable bucket boundary
+    reqs = [dict(prompt=shared + [5 + i], max_new=6, sampler=None,
+                 seed=None) for i in range(4)]
+    try:
+        first = _drain_all(eng, reqs)
+        assert eng.supervisor.stats()["restarts_total"] == 1
+        assert all(e is None and len(t) == 6 for t, _, e in first)
+        # the cache survived as an OBJECT, reset, re-attached, and
+        # promotion still works on the rebuilt pool
+        assert eng.cb.prefix_cache is pc
+        second = _drain_all(eng, reqs)
+        assert all(e is None and len(t) == 6 for t, _, e in second)
+        assert pc.stats.entries > 0  # post-restart promotion happened
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_reset_drops_entries_without_release_hook():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    released = []
+    pc = PrefixCache(cfg, buckets=(8,), budget_bytes=64 << 20)
+    pc.release_entry = released.append
+    pc.on_prefill_done(list(range(1, 12)), -1, lambda p: ("entry", p))
+    assert pc.stats.entries == 1 and pc.stats.nodes > 0
+    hits_before = pc.stats.hits
+    pc.reset()
+    assert released == []  # the dead pool must NOT see decrefs
+    assert pc.stats.entries == 0
+    assert pc.stats.nodes == 0
+    assert pc.stats.resident_bytes == 0
+    assert pc.stats.hits == hits_before  # cumulative counters survive
+    assert pc.match(list(range(1, 12)), -1, count=False) is None
+
+
+def test_restart_budget_exhaustion_degrades_to_dead_with_error_frames(setup):
+    """Budget 1 + a fault that fires on every decode apply past the
+    threshold: the first crash recovers, the second exhausts the
+    budget — the engine dies, every stream carries a structured
+    StreamError frame (never a bare None), health flips to 503-dead,
+    and new submits are refused."""
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg,
+        faults=FaultPlane.from_spec("decode.apply:nth=4:times=1000"),
+        supervisor=EngineSupervisor(max_restarts=1, window_s=60.0),
+    )
+    try:
+        results = _drain_all(eng, _requests(cfg, n=3, max_new=8))
+        sup = eng.supervisor.stats()
+        stats = eng.stats()
+        with pytest.raises(RuntimeError, match="dead"):
+            run_submit_dead(eng)
+    finally:
+        eng.shutdown()
+    assert sup["restarts_total"] == 1
+    assert sup["state"] == "dead"
+    assert sup["crashes_total"] == 2
+    assert stats["alive"] is False
+    assert stats["supervisor"]["state"] == "dead"
+    errs = [e for _, _, e in results]
+    assert all(isinstance(e, StreamError) for e in errs), errs
+    assert all(e.code == "engine_dead" for e in errs)
+    assert any("restart budget exhausted" in e.message for e in errs)
+
+
+def run_submit_dead(eng):
+    async def body():
+        eng.submit([1, 2, 3], 4)
+
+    return run(body())
+
+
+def test_zero_budget_supervisor_dies_with_structured_error(setup):
+    """max_restarts=0 is the recovery-off switch — but the dead path
+    still reports structurally (the satellite fix stands alone)."""
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg,
+        faults=FaultPlane.from_spec("decode.apply:nth=3"),
+        supervisor=EngineSupervisor(max_restarts=0),
+    )
+    try:
+        results = _drain_all(eng, _requests(cfg, n=2, max_new=8))
+    finally:
+        eng.shutdown()
+    assert all(isinstance(e, StreamError) and e.code == "engine_dead"
+               for _, _, e in results)
+
+
+def test_metrics_count_restarts(setup):
+    """tpu_serving_engine_restarts_total (+ replay/resume twins) ride
+    ServingMetrics through a recovery."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params = setup
+    registry = CollectorRegistry()
+    metrics = ServingMetrics(registry=registry)
+    eng = _mk_engine(
+        params, cfg, metrics=metrics,
+        faults=FaultPlane.from_spec("decode.apply:nth=6"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    try:
+        _drain_all(eng, _requests(cfg, n=4, max_new=8))
+    finally:
+        eng.shutdown()
+    assert registry.get_sample_value(
+        "tpu_serving_engine_restarts_total") == 1.0
+    replayed = registry.get_sample_value(
+        "tpu_serving_engine_replayed_requests_total") or 0.0
+    resumed = registry.get_sample_value(
+        "tpu_serving_engine_resumed_requests_total") or 0.0
+    assert replayed + resumed >= 1.0
+    metrics.close()
+
+
+def test_flight_recorder_retains_restart_survivors(setup):
+    """The attribution layer always keeps requests that lived through
+    a restart in the flight-recorder ring, with the restart count on
+    the record."""
+    from k8s_gpu_device_plugin_tpu.obs.attribution import RequestAttributor
+
+    cfg, params = setup
+    att = RequestAttributor(slow_ms=60_000.0)  # a threshold nothing hits
+    eng = _mk_engine(
+        params, cfg, attribution=att,
+        faults=FaultPlane.from_spec("decode.apply:nth=6"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    try:
+        _drain_all(eng, _requests(cfg, n=4, max_new=8))
+    finally:
+        eng.shutdown()
+    slow = att.slow_stats()
+    assert slow["captured"] >= 1
+    assert any(r.get("restarts", 0) >= 1 for r in slow["requests"])
+    # mid-flight survivors only: nothing else tripped the 60s threshold
+    assert all(r.get("restarts", 0) >= 1 for r in slow["requests"])
+
+
+def _sse_lines(body: bytes) -> list[dict]:
+    events = []
+    for line in body.decode().split("\n"):
+        line = line.strip()
+        if line.startswith("data: ") and line != "data: [DONE]":
+            events.append(json.loads(line[len("data: "):]))
+    return events
+
+
+@pytest.mark.parametrize("surface", [
+    "native_stream", "native_json", "oai_json", "oai_stream",
+])
+def test_http_surfaces_deliver_structured_error_frames(setup, surface):
+    """The satellite pin: a mid-stream engine death reaches the client
+    as a structured error on BOTH surfaces — native SSE error event /
+    503 body, OpenAI server_error envelope (streamed and not) — never
+    a clean short completion."""
+    import aiohttp
+
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg,
+        faults=FaultPlane.from_spec("decode.apply:nth=3"),
+        supervisor=EngineSupervisor(max_restarts=0),
+    )
+    server = InferenceServer(eng, host="127.0.0.1", port=0)
+
+    async def body():
+        stop = asyncio.Event()
+        task = asyncio.create_task(server.run(stop))
+        while server.bound_port is None:
+            await asyncio.sleep(0.01)
+        base = f"http://127.0.0.1:{server.bound_port}"
+        prompt = [1, 5, 7, 11, 2]
+        try:
+            async with aiohttp.ClientSession() as s:
+                if surface == "native_stream":
+                    async with s.post(f"{base}/v1/generate", json={
+                        "prompt": prompt, "max_new": 10, "stream": True,
+                    }) as r:
+                        assert r.status == 200
+                        events = _sse_lines(await r.read())
+                    assert not any(e.get("done") for e in events)
+                    err = [e for e in events if "error" in e]
+                    assert err and err[-1]["error"]["code"] == "engine_dead"
+                elif surface == "native_json":
+                    async with s.post(f"{base}/v1/generate", json={
+                        "prompt": prompt, "max_new": 10,
+                    }) as r:
+                        assert r.status == 503
+                        out = await r.json()
+                    assert out["code"] == "engine_dead"
+                elif surface == "oai_json":
+                    async with s.post(f"{base}/v1/completions", json={
+                        "model": "tpu-serving", "prompt": prompt,
+                        "max_tokens": 10,
+                    }) as r:
+                        assert r.status == 503
+                        out = await r.json()
+                    assert out["error"]["type"] == "server_error"
+                    assert out["error"]["code"] == "engine_dead"
+                else:  # oai_stream
+                    async with s.post(f"{base}/v1/completions", json={
+                        "model": "tpu-serving", "prompt": prompt,
+                        "max_tokens": 10, "stream": True,
+                    }) as r:
+                        assert r.status == 200
+                        raw = await r.read()
+                        events = _sse_lines(raw)
+                    err = [e for e in events if "error" in e]
+                    assert err and err[-1]["error"]["code"] == "engine_dead"
+                    assert err[-1]["error"]["type"] == "server_error"
+                    assert raw.decode().rstrip().endswith("data: [DONE]")
+                    assert not any(
+                        c.get("finish_reason")
+                        for e in events for c in e.get("choices", [])
+                    )
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+
+    run(body())
+
+
+def test_fallback_publish_closes_retired_streams():
+    """When the normal post-crash publish raises against the torn
+    batcher, the fallback must still CLOSE the streams of requests
+    that retired between the last publish and the crash — their rids
+    never reach the rebuilt batcher, so nothing else ever would (a
+    handler awaiting that queue would hang forever)."""
+    import threading
+    from types import SimpleNamespace
+
+    class FakeEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._streams = {}
+            self._published = {}
+            self._rid_to_eid = {}
+            self._finished_info = {}
+            self.pushed = []
+
+        def _push(self, rid, out, logp):
+            self.pushed.append((rid, tuple(out)))
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        eng = FakeEngine()
+        live_q, done_q, rej_q = (asyncio.Queue() for _ in range(3))
+        live = SimpleNamespace(rid=4, out=[9], out_logp=[-0.5])
+        retired = SimpleNamespace(
+            rid=5, out=[1, 2], out_logp=[-0.1, -0.2], cached_tokens=3,
+            timeline=None, reject_reason=None,
+        )
+        rejected = SimpleNamespace(
+            rid=6, out=[], out_logp=[], cached_tokens=0, timeline=None,
+            reject_reason="pool_pressure",
+        )
+        old = SimpleNamespace(
+            pending=[], prefilling={}, running={0: live},
+            done_requests={5: retired, 6: rejected},
+            done={5: [1, 2], 6: []}, scheduler=None,
+        )
+        eng._rid_to_eid = {4: 70, 5: 77, 6: 78}
+        eng._streams = {70: (loop, live_q), 77: (loop, done_q),
+                        78: (loop, rej_q)}
+        eng._published = {70: 1, 77: 0, 78: 0}
+        EngineSupervisor._fallback_publish(eng, old)
+        await asyncio.sleep(0)  # drain call_soon_threadsafe callbacks
+        # retired stream: tokens pushed AND closed; maps cleaned; the
+        # wrap-up info recorded (cached_tokens)
+        assert (5, (1, 2)) in eng.pushed
+        assert done_q.get_nowait() is None
+        assert 77 not in eng._streams and 5 not in eng._rid_to_eid
+        assert eng._finished_info[77] == {"cached_tokens": 3}
+        # REJECTED-while-queued retiree: the rejection disposition must
+        # survive to the handler (429, not a clean zero-token done)
+        assert rej_q.get_nowait() is None
+        assert eng._finished_info[78]["reject_reason"] == "pool_pressure"
+        assert eng._finished_info[78]["retry_after"] == 1
+        assert old.done_requests == {} and old.done == {}
+        # live stream: pushed but NOT closed (it resumes on the rebuild)
+        assert (4, (9,)) in eng.pushed
+        assert 70 in eng._streams and 4 in eng._rid_to_eid
+        assert live_q.empty()
+
+    run(body())
+
+
+def test_injected_batcher_refuses_supervisor_and_faults(setup):
+    cfg, params = setup
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=32,
+                           chunked_prefill=8)
+    with pytest.raises(ValueError, match="rebuild recipe"):
+        InferenceEngine(params, cfg, batcher=cb,
+                        supervisor=EngineSupervisor())
+    with pytest.raises(ValueError, match="fault plane"):
+        InferenceEngine(params, cfg, batcher=cb,
+                        faults=FaultPlane.from_spec("decode.apply:nth=1"))
+    # no supervisor section on health for injected batchers (no recipe)
+    eng = InferenceEngine(params, cfg, batcher=cb)
+    try:
+        assert "supervisor" not in eng.stats()
+    finally:
+        eng.shutdown()
+
+
+def test_open_loop_run_counts_truncated_separately():
+    """The harness satellite: open_loop_run reports requests that
+    VANISHED (admitted, never retired) as ``truncated`` — a separate
+    bucket from rejected/retried_ok."""
+    from types import SimpleNamespace
+
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        open_loop_run,
+    )
+
+    class LossyCB:
+        """Completes every request except rid 1, which silently
+        vanishes — the failure shape the counter exists to expose."""
+
+        scheduler = None
+
+        def __init__(self):
+            self.pending = []
+            self.prefilling = {}
+            self.running = {}
+            self.done_requests = {}
+            self._n = 0
+
+        def submit(self, prompt, max_new, **kw):
+            rid = self._n
+            self._n += 1
+            self.pending.append(rid)
+            return rid
+
+        def step(self):
+            if not self.pending:
+                return
+            rid = self.pending.pop(0)
+            if rid == 1:
+                return  # vanished: no retirement, no disposition
+            self.done_requests[rid] = SimpleNamespace(
+                reject_reason=None, deadline=None, preemptions=0,
+                t_submit=0.0, t_first_tok=0.1, t_done=0.2,
+                out=[1, 2],
+            )
+
+    trace = [
+        {"t": 0.0, "tenant": "t", "priority": 1, "deadline_ms": None,
+         "prompt": [1, 2], "max_new": 2, "phase": "base"}
+        for _ in range(3)
+    ]
+    out = open_loop_run(LossyCB(), trace)
+    assert out["truncated"] == 1
+    assert out["rejected"] == 0
+    assert len(out["per_request"]) == 2
