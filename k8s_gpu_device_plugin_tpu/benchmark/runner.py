@@ -609,6 +609,10 @@ def _run_serve() -> dict:
         "chaos_fleet_retries": r.chaos_fleet_retries,
         "chaos_fleet_failovers": r.chaos_fleet_failovers,
         "chaos_fleet_killed_replicas": r.chaos_fleet_killed_replicas,
+        "chaos_fleet_resumed": r.chaos_fleet_resumed,
+        "chaos_fleet_promotions": r.chaos_fleet_promotions,
+        "chaos_fleet_stream_deaths": r.chaos_fleet_stream_deaths,
+        "chaos_fleet_bitwise_identical": r.chaos_fleet_bitwise_identical,
         "fault_guard_ns": round(r.fault_guard_ns, 2),
         # live serving MFU/roofline accounting (metrics/roofline.py):
         # model-FLOPs utilization of the primary pipelined run vs the

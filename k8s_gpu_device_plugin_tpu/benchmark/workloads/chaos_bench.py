@@ -15,12 +15,16 @@ serve row):
    trace — bit-identical token AND logprob streams for every request
    (greedy + per-request-seeded sampling), i.e. no token lost or
    re-emitted across the crash.
-2. **Fleet chaos**: a 2-replica in-process fleet behind the router;
-   one replica is KILLED mid-trace (the crash the router can only
-   route around). Clients follow the well-behaved policy: 429s honor
-   Retry-After, and a connection-level stream death (visible, never
-   silent) retries from scratch. Asserted: zero dropped streams after
-   retries, zero silent truncations, and bounded clean refusals.
+2. **Fleet chaos**: 2 active replicas + a warm spare behind the
+   router; one active replica is KILLED mid-trace. The router's
+   resume tier (cross-replica stream resume over the native
+   ``resume_out`` seam) must make the death INVISIBLE: asserted are
+   zero visible stream deaths (no error frames, no done-less closes,
+   no from-scratch retries of a died stream), bit-identical token AND
+   logprob streams (greedy + seeded) vs a no-kill baseline of the
+   same trace, at least one mid-stream resume, the warm spare
+   promoted into the ring, zero dropped / silently-truncated streams,
+   and bounded clean refusals.
 3. **Guard cost**: the disarmed fault point is an ``is-not-None``
    check — ``fault_guard_ns`` microbenches it (the PR-9 attribution
    noop-guard pattern) so "the plane is free when off" stays a
@@ -226,41 +230,52 @@ def chaos_engine_openloop(
 
 
 async def _drive_fleet(base: str, trace, *, attempts: int = 4,
-                       max_new: int) -> list[dict]:
-    """The well-behaved HTTP client over the router: 429s honor the
-    (capped) Retry-After; a connection-level stream death — a killed
-    replica — is VISIBLE and retried from scratch (partial tokens
-    discarded, so the client never splices two streams together)."""
+                       max_new: int,
+                       sampled_frac: float = 0.5) -> list[dict]:
+    """The well-behaved HTTP client over the router, now expecting the
+    fleet tier's RESUME guarantee: a mid-stream replica death must be
+    invisible (the router splices the continuation through the native
+    resume seam), so a stream that dies — no done event, an error
+    frame, or a connection-level reset — is counted as a
+    ``stream_death`` (the fleet arm asserts ZERO) and only then
+    retried from scratch. 429s honor the (capped) Retry-After —
+    delta-seconds or RFC 9110 HTTP-date. Every other request carries a
+    per-request temperature sampler + seed, so the kill pins SEEDED
+    continuations too; tokens AND logprobs are kept for the
+    bit-identity check against the no-kill baseline."""
     import aiohttp
+
+    from k8s_gpu_device_plugin_tpu.serving.fleet import parse_retry_after
 
     t0 = time.perf_counter()
     results: list[dict] = []
 
     async def one(session, i: int, e: dict) -> None:
         await asyncio.sleep(max(0.0, t0 + e["t"] - time.perf_counter()))
-        fact = {"i": i, "outcome": "dropped", "retries": 0}
+        sampled = (i % int(1 / sampled_frac)) == 0 if sampled_frac else False
+        body = {"prompt": e["prompt"], "max_new": e["max_new"],
+                "stream": True, "logprobs": True}
+        if sampled:
+            body["temperature"] = 0.8
+            body["seed"] = 1000 + i
+        fact = {"i": i, "outcome": "dropped", "retries": 0,
+                "stream_deaths": 0, "tokens": None, "logprobs": None}
         results.append(fact)
         for attempt in range(attempts):
             if attempt:
                 fact["retries"] += 1
             # every attempt restarts from 'dropped': an outcome is only
-            # final when THIS attempt delivers it — a transient clean
-            # refusal followed by connection-level failures must read
-            # as a drop, not as the overload contract working
+            # final when THIS attempt delivers it
             fact["outcome"] = "dropped"
             try:
                 async with session.post(
-                    f"{base}/v1/generate",
-                    json={"prompt": e["prompt"], "max_new": e["max_new"],
-                          "stream": True},
+                    f"{base}/v1/generate", json=body
                 ) as r:
                     if r.status == 429:
                         fact["outcome"] = "rejected"
-                        try:
-                            ra = float(r.headers.get("Retry-After", "1"))
-                        except ValueError:
-                            ra = 1.0
-                        await asyncio.sleep(min(ra, 0.5))
+                        await asyncio.sleep(min(parse_retry_after(
+                            r.headers.get("Retry-After"), default=1.0
+                        ), 0.5))
                         continue
                     if r.status != 200:
                         # clean refusal (503 while failing over): not a
@@ -268,7 +283,8 @@ async def _drive_fleet(base: str, trace, *, attempts: int = 4,
                         fact["outcome"] = "rejected"
                         await asyncio.sleep(0.2)
                         continue
-                    toks = 0
+                    toks: list[int] = []
+                    lps: list[float] = []
                     finished = False
                     async for line in r.content:
                         line = line.decode().strip()
@@ -276,26 +292,34 @@ async def _drive_fleet(base: str, trace, *, attempts: int = 4,
                             continue
                         evt = json.loads(line[len("data: "):])
                         if "token" in evt:
-                            toks += 1
+                            toks.append(int(evt["token"]))
+                            lps.append(float(evt.get("logprob", 0.0)))
                         if "error" in evt:
-                            # structured error frame: VISIBLE — discard
-                            # the partial stream and retry from scratch
+                            # structured error frame: a VISIBLE stream
+                            # death (the resume guarantee failed) —
+                            # discard and retry from scratch
+                            fact["stream_deaths"] += 1
                             break
                         if evt.get("done"):
                             finished = True
                             if evt.get("rejected"):
                                 fact["outcome"] = "rejected"
-                            elif toks == max_new:
+                            elif len(toks) == e["max_new"]:
                                 fact["outcome"] = "completed"
+                                fact["tokens"] = toks
+                                fact["logprobs"] = lps
                             else:
                                 fact["outcome"] = "truncated"
+                                fact["tokens"] = toks
                             return
                     if not finished:
-                        # stream died mid-flight (killed replica or an
-                        # error frame): visible; discard and retry
+                        # stream died without a done event: visible —
+                        # exactly what the resume path exists to prevent
+                        fact["stream_deaths"] += 1
                         continue
             except (aiohttp.ClientError, asyncio.TimeoutError,
                     ConnectionResetError, OSError):
+                fact["stream_deaths"] += 1
                 await asyncio.sleep(0.1)
                 continue
 
@@ -319,18 +343,25 @@ def chaos_fleet_openloop(
     base_s: float = 3.0,
     base_rps: float = 8.0,
     kill_at_frac: float = 0.3,
+    warm_spares: int = 1,
     seed: int = 1,
 ) -> dict:
-    """The fleet arm: 2 replicas behind the router, one killed
-    mid-trace. Every request must end accounted — completed on the
-    survivor (failover + client retry) or cleanly refused — with zero
-    dropped and zero silently-truncated streams."""
+    """The fleet arm: 2 active replicas (+ a warm spare) behind the
+    router, one active replica KILLED mid-trace. The resume tier's
+    contract, asserted: ZERO visible stream deaths (in-flight streams
+    splice onto the survivor through the native resume seam — no error
+    frames, no done-less closes, no from-scratch retries of a died
+    stream), every completed stream bit-identical in tokens AND
+    logprobs (greedy + seeded) to a no-kill baseline over the same
+    trace, the warm spare promoted into the ring, and refusals
+    bounded."""
     from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
     from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
     from k8s_gpu_device_plugin_tpu.serving.testing import inprocess_fleet
 
     trace = _chaos_trace(cfg, seed=seed, base_s=base_s, base_rps=base_rps,
                          prompt_len=prompt_len, max_new=max_new)
+    n_replicas = 2 + warm_spares
 
     def engine_factory(i: int):
         return InferenceEngine(
@@ -339,23 +370,26 @@ def chaos_fleet_openloop(
             scheduler=Scheduler(max_queue=8 * n_slots),
         )
 
-    async def run() -> tuple[list[dict], dict]:
+    async def run(kill: bool) -> tuple[list[dict], dict]:
         import aiohttp
 
         async with inprocess_fleet(
-            params, cfg, n_replicas=2, engine_factory=engine_factory,
-            # round-robin, so BOTH replicas carry traffic and the kill
-            # forces real ring failovers (affinity could home the whole
-            # shared-prefix trace on the survivor by luck of the hash)
+            params, cfg, n_replicas=n_replicas,
+            engine_factory=engine_factory,
+            # round-robin, so BOTH active replicas carry traffic and
+            # the kill lands on live relays (affinity could home the
+            # whole shared-prefix trace on the survivor by luck of the
+            # hash)
             router_kw=dict(
-                policy="rr", health_interval_s=0.2,
-                header_timeout_s=30.0,
+                policy="rr", health_interval_s=0.1,
+                header_timeout_s=30.0, warm_spares=warm_spares,
             ),
         ) as fl:
-            # sequential warm per replica (the XLA:CPU one-compiler rule
-            # the fleet A/B follows)
+            # sequential warm per replica — the SPARE too: it serves
+            # traffic the moment it is promoted (the XLA:CPU
+            # one-compiler rule the fleet A/B follows)
             async with aiohttp.ClientSession() as s:
-                for i in range(2):
+                for i in range(n_replicas):
                     async with s.post(
                         f"{fl.replica_base(i)}/v1/generate",
                         json={"prompt": trace[0]["prompt"],
@@ -365,20 +399,66 @@ def chaos_fleet_openloop(
 
             async def killer():
                 await asyncio.sleep(kill_at_frac * base_s)
+                # wait (bounded) until the victim is mid-relay, so the
+                # kill exercises the RESUME path, not just pre-dispatch
+                # failover
+                victim = fl.fleet.get("r0")
+                for _ in range(200):
+                    if victim.inflight > 0:
+                        break
+                    await asyncio.sleep(0.02)
                 await fl.kill_replica(0)
 
-            kill_task = asyncio.ensure_future(killer())
+            kill_task = None
+            if kill:
+                kill_task = asyncio.ensure_future(killer())
             results = await _drive_fleet(fl.base, trace, max_new=max_new)
-            await kill_task
+            if kill_task is not None:
+                await kill_task
+                # the poller needs a few intervals to mark the corpse
+                # dead and promote the spare
+                for _ in range(100):
+                    if fl.router.router_stats()["promotions"] >= 1:
+                        break
+                    await asyncio.sleep(0.05)
             stats = fl.router.router_stats()
         return results, stats
 
-    results, stats = asyncio.run(run())
+    base_results, _ = asyncio.run(run(False))
+    results, stats = asyncio.run(run(True))
     tally = _tally(results)
+    deaths = sum(f["stream_deaths"] for f in results)
     assert tally["dropped"] == 0, f"dropped streams: {tally}"
     assert tally["truncated"] == 0, f"silently truncated streams: {tally}"
-    # refusals are the overload/drain contract working, but they must
-    # stay BOUNDED: the surviving replica absorbs the trace
+    # THE fleet-resume pin: no client ever saw a stream die because a
+    # replica did — the router spliced every in-flight continuation
+    assert deaths == 0, (
+        f"{deaths} visible stream deaths across the replica kill"
+    )
+    assert stats["resumes"] >= 1, (
+        f"the kill never landed mid-stream (resume path unexercised): "
+        f"{stats}"
+    )
+    assert stats["promotions"] >= 1, (
+        f"the warm spare was never promoted: {stats}"
+    )
+    # bit-identity across the kill: every stream completed in BOTH runs
+    # carries identical tokens AND logprobs (greedy + seeded) — nothing
+    # lost, nothing re-emitted, seeded draws continued exactly
+    by_i = {f["i"]: f for f in base_results}
+    mismatched = compared = 0
+    for f in results:
+        b = by_i[f["i"]]
+        if f["outcome"] == "completed" and b["outcome"] == "completed":
+            compared += 1
+            if f["tokens"] != b["tokens"] or f["logprobs"] != b["logprobs"]:
+                mismatched += 1
+    assert compared >= 1, "no stream completed in both runs"
+    assert mismatched == 0, (
+        f"{mismatched}/{compared} streams diverged across the kill"
+    )
+    # refusals are the overload contract working, but they must stay
+    # BOUNDED: the surviving capacity absorbs the trace
     assert tally["rejected"] <= len(trace) // 2, (
         f"unbounded refusals: {tally} of {len(trace)}"
     )
@@ -387,6 +467,10 @@ def chaos_fleet_openloop(
         "completed": tally["completed"],
         "rejected": tally["rejected"],
         "retries": sum(f["retries"] for f in results),
+        "stream_deaths": deaths,
+        "resumed": stats["resumes"],
+        "promotions": stats["promotions"],
+        "bitwise_identical": 1 if mismatched == 0 else 0,
         "failovers": stats["failovers"],
         "killed_replicas": 1,
     }
@@ -445,6 +529,13 @@ def chaos_ab(
         "chaos_fleet_retries": fleet["retries"],
         "chaos_fleet_failovers": fleet["failovers"],
         "chaos_fleet_killed_replicas": fleet["killed_replicas"],
+        # the resume tier (this PR): mid-stream deaths spliced over /
+        # warm spares promoted / visible stream deaths (asserted 0) /
+        # token+logprob bit-identity vs the no-kill baseline
+        "chaos_fleet_resumed": fleet["resumed"],
+        "chaos_fleet_promotions": fleet["promotions"],
+        "chaos_fleet_stream_deaths": fleet["stream_deaths"],
+        "chaos_fleet_bitwise_identical": fleet["bitwise_identical"],
         "fault_guard_ns": round(fault_guard_ns(), 3),
     }
 

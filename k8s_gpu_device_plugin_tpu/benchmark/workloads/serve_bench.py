@@ -267,6 +267,14 @@ class ServeBenchResult:
     chaos_fleet_retries: int = 0
     chaos_fleet_failovers: int = 0
     chaos_fleet_killed_replicas: int = 0
+    # the fleet resume tier: mid-stream replica deaths spliced onto the
+    # next ring candidate (zero re-emitted tokens), warm spares
+    # promoted into the ring, visible stream deaths (asserted 0), and
+    # token+logprob bit-identity vs a no-kill baseline
+    chaos_fleet_resumed: int = 0
+    chaos_fleet_promotions: int = 0
+    chaos_fleet_stream_deaths: int = 0
+    chaos_fleet_bitwise_identical: int = 0
     # disarmed fault-point guard cost (ns) — "the plane is free when
     # off" as a measured number, the attribution noop-guard pattern
     fault_guard_ns: float = 0.0
@@ -739,6 +747,7 @@ def fleet_openloop_ab(
 
     import aiohttp
 
+    from k8s_gpu_device_plugin_tpu.serving.fleet import parse_retry_after
     from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
     from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
     from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
@@ -787,10 +796,11 @@ def fleet_openloop_ab(
                 ) as r:
                     if r.status == 429:
                         if attempt == 0:
-                            try:
-                                ra = float(r.headers.get("Retry-After", "1"))
-                            except ValueError:
-                                ra = 1.0
+                            # delta-seconds OR an RFC 9110 HTTP-date;
+                            # garbage falls back to a capped default
+                            ra = parse_retry_after(
+                                r.headers.get("Retry-After"), default=1.0
+                            )
                             fact["retried"] += 1
                             await asyncio.sleep(min(ra, 1.0))
                             continue

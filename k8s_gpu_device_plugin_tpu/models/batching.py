@@ -994,6 +994,54 @@ class ContinuousBatcher:
                 deadline_ms = None
         return tenant, priority, deadline_ms
 
+    def validate_resume(
+        self, resume_out, resume_logp, max_new: int, prefix=None,
+    ) -> "tuple[list[int], list[float]]":
+        """The resume half of the admission rule (shared with the
+        serving engine's request thread, like ``validate``): normalize
+        and validate the already-emitted token/logprob lists of a
+        cross-incarnation resume. Returns ``([], [])`` when no resume
+        was requested."""
+        toks = list(resume_out or ())
+        if not toks:
+            if resume_logp:
+                raise ValueError(
+                    "resume_logprobs without resume_out makes no sense"
+                )
+            return [], []
+        if not self.chunk:
+            raise ValueError(
+                "stream resume requires chunked_prefill=C (the chunk "
+                "scheduler is what re-prefills the folded output)"
+            )
+        if prefix is not None:
+            raise ValueError(
+                "resume_out composes with the AUTOMATIC prefix cache "
+                "(re-matched over the folded prompt), not with a manual "
+                "prefix"
+            )
+        if not all(
+            isinstance(t, int) and not isinstance(t, bool) for t in toks
+        ):
+            raise ValueError("resume_out must be a list of token ids")
+        if len(toks) >= max_new:
+            raise ValueError(
+                f"resume_out carries {len(toks)} tokens but max_new is "
+                f"{max_new}: nothing left to resume"
+            )
+        lps = [float(x) for x in (resume_logp or ())]
+        if lps and len(lps) != len(toks):
+            raise ValueError(
+                f"resume_logprobs length {len(lps)} != resume_out "
+                f"length {len(toks)}"
+            )
+        if not lps:
+            # the caller never saw logprobs (it didn't ask for them):
+            # placeholders keep out/out_logp paired — indices below
+            # prefilled_out are never re-published
+            lps = [0.0] * len(toks)
+        return toks, lps
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -1018,6 +1066,8 @@ class ContinuousBatcher:
         tenant: str = "default",
         priority: int = 1,
         deadline_ms: "int | None" = None,
+        resume_out: "list[int] | None" = None,
+        resume_logp: "list[float] | None" = None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -1037,7 +1087,20 @@ class ContinuousBatcher:
         off. Matching at admission rather than here means a queued burst
         behind one system prompt hits as soon as the first prefill
         promotes it, and nothing is counted for requests that are
-        rejected below or cancelled while still pending."""
+        rejected below or cancelled while still pending.
+
+        ``resume_out`` is the cross-incarnation RESUME seam (the fleet
+        router's mid-stream replica-death recovery, serving/router.py):
+        tokens this request already emitted somewhere else. They ride
+        the PR-7 preemption fold — folded into the prompt, pre-seeded
+        into ``out``/``out_logp`` with ``prefilled_out`` set — so the
+        finish chunk samples emission (and seeded draw) number
+        ``len(resume_out)`` against the REMAINING budget: greedy AND
+        seeded continuations are bit-identical to an uninterrupted run,
+        and stop-sequence matching spans the resume boundary.
+        ``resume_logp`` carries the already-emitted logprobs (zeros
+        when the caller never saw them — indices below ``prefilled_out``
+        are never re-published)."""
         if prefix is not None and not self.chunk:
             raise ValueError("prefix sharing requires chunked_prefill=C")
         if isinstance(prefix, PagedPrefixState):
@@ -1052,10 +1115,19 @@ class ContinuousBatcher:
                 "prefix entries are owned by the attached prefix cache "
                 "(manual prefixes carry dense rows from precompute_prefix)"
             )
-        total = len(prompt) + (len(prefix.tokens) if prefix else 0)
+        resume_out, resume_logp = self.validate_resume(
+            resume_out, resume_logp, max_new, prefix=prefix
+        )
+        total = (
+            len(prompt) + len(resume_out)
+            + (len(prefix.tokens) if prefix else 0)
+        )
         # reject here, not in _admit: a mid-run() failure would strand
-        # every in-flight neighbor
-        self.validate(total, max_new)
+        # every in-flight neighbor. A resumed request's folded tokens
+        # sit in the prompt AND count against max_new — validate the
+        # REMAINING budget so the row total matches the original
+        # request's worst case exactly (the _reserve_pages rule).
+        self.validate(total, max_new - len(resume_out))
         self.validate_adapter(adapter)
         bias = self.validate_bias(logit_bias)
         seed = self.validate_seed(seed)
@@ -1071,7 +1143,13 @@ class ContinuousBatcher:
             )
         rid = self._next_rid
         self._next_rid += 1
-        full = (list(prefix.tokens) if prefix else []) + list(prompt)
+        # the preemption fold, applied at the submit edge: emitted
+        # tokens become prompt rows, prefilled_out tells prefill_finish
+        # which emission (and seeded draw) comes next
+        full = (
+            (list(prefix.tokens) if prefix else [])
+            + list(prompt) + resume_out
+        )
         now = time.perf_counter()
         req = _Request(
             rid, full, max_new, prefix=prefix,
@@ -1092,6 +1170,14 @@ class ContinuousBatcher:
                 ) if prefix else 0
             ),
         )
+        if resume_out:
+            # exactly the shape _preempt_slot leaves behind: out holds
+            # every emitted token (stop matching spans the boundary),
+            # the fold above put them in the prompt, and retirement at
+            # len(out) >= max_new needs no special case
+            req.out = list(resume_out)
+            req.out_logp = list(resume_logp)
+            req.prefilled_out = len(resume_out)
         req.t_submit = now
         if self.scheduler is not None:
             # admission control (queue cap, quota charge) BEFORE the

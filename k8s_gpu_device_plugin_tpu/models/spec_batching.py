@@ -419,9 +419,27 @@ class SpeculativeBatcher(ContinuousBatcher):
     #: it just never evicts their slots (construct it with preempt=False)
     supports_preemption = False
 
+    def validate_resume(self, resume_out, resume_logp, max_new,
+                        prefix=None):
+        """The speculative engine has no resume path (the draft cache
+        cannot be reconstructed from emitted tokens, and rounds share
+        one sampler with no per-request draw index) — refuse at the
+        shared admission rule so the serving request thread 422s
+        instead of the engine thread crashing."""
+        if resume_out:
+            raise ValueError(
+                "stream resume (resume_out) is not supported with "
+                "speculative batching"
+            )
+        return super().validate_resume(resume_out, resume_logp, max_new,
+                                       prefix=prefix)
+
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
                adapter=-1, logit_bias=None, seed=None,
-               tenant="default", priority=1, deadline_ms=None):
+               tenant="default", priority=1, deadline_ms=None,
+               resume_out=None, resume_logp=None):
+        self.validate_resume(resume_out, resume_logp, max_new,
+                             prefix=prefix)
         if sampler is not None:
             raise ValueError(
                 "per-request samplers are not supported with speculative "

@@ -30,10 +30,12 @@ Fault points (the seams they live at):
 ``router.connect``  the router's dispatch, BEFORE the backend request:
                     reads as a connection failure — exercises ring
                     failover
-``router.midstream``  the router's SSE relay, mid-stream: the relay
-                    aborts after the first frame — the
-                    truncation-is-visible case (never retried: the
-                    client already consumed bytes)
+``router.midstream``  the router's SSE relay, mid-stream: reads as the
+                    backend dying under a live relay. On a journaled
+                    native stream this rehearses the cross-replica
+                    RESUME path (the continuation splices from the
+                    next ring candidate); on non-resumable streams the
+                    relay aborts — the truncation-is-visible case
 ==================  ====================================================
 
 Schedules (per point, all deterministic):

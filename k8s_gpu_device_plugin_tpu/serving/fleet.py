@@ -30,10 +30,58 @@ no cross-thread readers.
 from __future__ import annotations
 
 import bisect
+import email.utils
 import hashlib
 import json
 import time
 from urllib.parse import urlparse
+
+from k8s_gpu_device_plugin_tpu.serving.supervisor import RollingBudget
+
+
+def parse_retry_after(raw, *, default: float = 1.0,
+                      max_s: float = 3600.0) -> float:
+    """``Retry-After`` header value -> seconds to wait.
+
+    RFC 9110 allows BOTH shapes — delta-seconds (``"30"``) and an
+    HTTP-date (``"Tue, 04 Aug 2026 17:00:00 GMT"``); a proxy in front
+    of a replica may well rewrite one into the other. Garbage (or a
+    date in the past) falls back to ``default`` instead of raising —
+    a malformed header from an overloaded backend must slow the client
+    down, not crash it. The result is clamped to [0, ``max_s``]: a
+    backend asking for a year must not wedge a retry loop."""
+    if raw is None:
+        return float(default)
+    s = str(raw).strip()
+    if not s:
+        return float(default)
+    import math
+
+    try:
+        secs = float(s)
+    except ValueError:
+        import datetime
+
+        try:
+            when = email.utils.parsedate_to_datetime(s)
+        except (TypeError, ValueError):
+            return float(default)
+        if when is None:
+            return float(default)
+        if when.tzinfo is None:
+            # RFC 5322 dates without a zone are rare but parseable;
+            # treat them as UTC like every HTTP implementation does
+            when = when.replace(tzinfo=datetime.timezone.utc)
+        secs = (
+            when - datetime.datetime.now(datetime.timezone.utc)
+        ).total_seconds()
+        if secs < 0:
+            return float(default)  # already elapsed: retry now-ish
+    if not math.isfinite(secs) or secs < 0:
+        # NaN/inf are garbage too: NaN slips through < comparisons and
+        # min(), then poisons whatever arithmetic consumes the wait
+        return float(default)
+    return min(float(secs), float(max_s))
 
 
 def _digest(data: bytes) -> int:
@@ -75,6 +123,66 @@ def affinity_key(source, buckets: tuple[int, ...]) -> bytes | None:
         return None
     cut = max((b for b in buckets if b <= len(raw)), default=len(raw))
     return raw[:cut]
+
+
+def poll_phase(rid: str, interval_s: float) -> float:
+    """Deterministic per-replica health-poll phase offset in
+    ``[0, interval_s)``. An N-replica fleet polled on one shared timer
+    fires N probes in the same instant every ``--healthIntervalS`` tick
+    — a thundering herd the replicas all pay together. Hashing the
+    replica id (stable blake2b, like the ring) spreads the probes
+    across the interval identically on every router restart, so
+    dashboards comparing probe timestamps across restarts stay
+    comparable."""
+    if interval_s <= 0:
+        return 0.0
+    return (_digest(f"poll#{rid}".encode()) % 9973) / 9973.0 * interval_s
+
+
+class FleetRestartBudget:
+    """The fleet tier's twin of the engine supervisor's restart budget
+    (one :class:`~...serving.supervisor.RollingBudget` underneath):
+    ``max_restarts`` replica-death recoveries per rolling ``window_s``.
+
+    The unit is a replica DEATH, not a stream: one dead replica with N
+    in-flight streams charges ONE budget event — every stream of that
+    death resumes (or none does). ``charge(rep)`` keys on the replica's
+    death epoch (bumped on revival), so concurrent streams dying from
+    the same death share the charge, while a flapping replica burns one
+    unit per death. ``max_restarts=0`` disables cross-replica resume —
+    streams then end with the structured error frame, the same
+    degrade-loudly stance as the supervisor's budget-0 mode."""
+
+    def __init__(self, max_restarts: int = 3, window_s: float = 300.0):
+        self._budget = RollingBudget(max_restarts, window_s)
+        self.max_restarts = self._budget.max_events
+        self.window_s = self._budget.window_s
+        self._charged: set[tuple[str, int]] = set()
+        self.charged_total = 0
+
+    def charge(self, rep: Replica) -> bool:
+        """True iff resuming streams of this replica death is within
+        budget (charging it on first sight of the (replica, epoch))."""
+        key = (rep.rid, rep.epoch)
+        if key in self._charged:
+            return True
+        if not self._budget.allow():
+            return False
+        self._budget.record()
+        # one live epoch per replica: drop the stale keys so the set
+        # stays bounded by fleet size
+        self._charged = {k for k in self._charged if k[0] != rep.rid}
+        self._charged.add(key)
+        self.charged_total += 1
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "max_restarts": self.max_restarts,
+            "window_s": self.window_s,
+            "window_used": self._budget.used(),
+            "charged_total": self.charged_total,
+        }
 
 
 class HashRing:
@@ -119,7 +227,7 @@ class Replica:
     __slots__ = (
         "rid", "url", "draining", "alive", "consecutive_failures",
         "health", "health_t", "inflight", "relayed", "cooldown_until",
-        "reported_id",
+        "reported_id", "spare", "epoch",
     )
 
     def __init__(self, rid: str, url: str):
@@ -134,10 +242,21 @@ class Replica:
         self.relayed = 0           # completed relays (any outcome)
         self.cooldown_until = 0.0  # honor a 429's Retry-After
         self.reported_id: str | None = None  # replica_id from /v1/health
+        # warm-spare membership: a spare is registered and health-polled
+        # but NOT on the ring and never routed — it waits to be promoted
+        # when an active replica dies (a demoted ex-active that revives
+        # becomes a spare: its ring slot is taken)
+        self.spare = False
+        # death-generation counter: bumps every time a dead replica
+        # revives. The fleet restart budget charges ONE unit per
+        # (replica, epoch) — one replica death with N in-flight streams
+        # is one fleet event, not N
+        self.epoch = 0
 
     def routable(self, now: float) -> bool:
         return (
-            self.alive and not self.draining and now >= self.cooldown_until
+            self.alive and not self.draining and not self.spare
+            and now >= self.cooldown_until
         )
 
 
@@ -198,9 +317,57 @@ class FleetRegistry:
     def ids(self) -> list[str]:
         return list(self._replicas)
 
+    # --- warm spares ------------------------------------------------------
+
+    def mark_spares(self, n: int) -> None:
+        """Flag the LAST ``n`` registered replicas as warm spares
+        (registered, health-polled, unrouted until promoted). The tail
+        convention matches how an operator writes ``--replicas``: the
+        serving set first, the standbys after."""
+        reps = list(self._replicas.values())
+        if not (0 <= n < len(reps)):
+            raise ValueError(
+                f"warm_spares must leave at least one active replica: "
+                f"got {n} spares over {len(reps)} replicas"
+            )
+        for rep in reps[len(reps) - n:]:
+            rep.spare = True
+
+    def active(self) -> list[Replica]:
+        """The ring membership: every non-spare replica (dead ones
+        included — the ring is identity, liveness is routing)."""
+        return [r for r in self._replicas.values() if not r.spare]
+
+    def spares(self) -> list[Replica]:
+        return [r for r in self._replicas.values() if r.spare]
+
+    def promote_spare(self, dead: Replica) -> Replica | None:
+        """Swap a dead active replica for a live warm spare: the spare
+        joins the ring membership (the caller rebuilds the ring —
+        affinity keys remap in the usual consistent-hashing way), the
+        dead one becomes a spare so a later revival re-enters the pool
+        as a standby instead of double-claiming a ring slot. Returns
+        the promoted replica, or None when no live spare is idle."""
+        spare = next(
+            (r for r in self.spares() if r.alive and not r.draining),
+            None,
+        )
+        if spare is None:
+            return None
+        spare.spare = False
+        dead.spare = True
+        return spare
+
     # --- liveness (fed by the health poller AND proxy failures) ---------
 
     def note_success(self, rep: Replica, health: dict | None = None) -> None:
+        if not rep.alive or rep.consecutive_failures:
+            # recovery from ANY observed failure — full death or a flap
+            # that never reached dead_after — closes that death epoch:
+            # the next failure is a NEW fleet event for the restart
+            # budget (streams dying from one crash see no success in
+            # between, so they still share one charge)
+            rep.epoch += 1
         rep.consecutive_failures = 0
         rep.alive = True
         if health is not None:
@@ -228,6 +395,7 @@ class FleetRegistry:
             reps[r.rid] = {
                 "url": r.url,
                 "alive": r.alive,
+                "spare": r.spare,
                 "draining": r.draining,
                 "inflight": r.inflight,
                 "relayed": r.relayed,
@@ -253,6 +421,7 @@ class FleetRegistry:
             "replicas": reps,
             "total": len(self._replicas),
             "live": len(live),
+            "spares": len(self.spares()),
             "draining": sum(
                 1 for r in self._replicas.values() if r.draining
             ),

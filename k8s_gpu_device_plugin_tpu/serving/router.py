@@ -21,12 +21,38 @@ backends. Three decisions per request, in order:
    count is skipped for the next ring candidate (so spill traffic is
    deterministic too, not scattered).
 3. **Failover**: a connection failure or 429 moves to the next ring
-   candidate. 429s honor ``Retry-After`` — the replica is cooled down
-   for that long, so a whole burst doesn't re-probe a replica that
-   just said "not now". Only failures BEFORE response headers are
-   retried: once a stream has started, replaying it would duplicate
-   tokens the client already consumed, so a mid-stream death surfaces
-   as the stream closing (the client's retry is the safe one).
+   candidate. 429s honor ``Retry-After`` (delta-seconds AND RFC 9110
+   HTTP-dates) — the replica is cooled down for that long, so a whole
+   burst doesn't re-probe a replica that just said "not now". Failures
+   BEFORE response headers retry the next candidate; a mid-stream
+   replica death on a journaled native SSE stream RESUMES (below);
+   everything else surfaces as the stream closing visibly.
+
+**Cross-replica stream resume** — the fleet tier's recovery guarantee,
+mirroring what the engine supervisor gives one replica: no client-
+visible stream dies because a replica did. Each native token-id SSE
+stream carries a journal (body, sampling seed, every token/logprob
+relayed — single-writer, bounded); on a mid-stream replica death the
+router resubmits through the native ``resume_out`` seam (emitted
+tokens folded into the prompt via the preemption fold, so greedy AND
+seeded continuations are bit-identical) to the next ring candidate and
+splices the continuation into the SAME client response with zero
+re-emitted tokens. Resumes are budgeted per replica DEATH
+(``--fleetRestartBudget`` / ``--fleetRestartWindowS``, the
+supervisor's rolling-budget shape); past the budget the stream ends
+with the PR-12 structured error frame — never a silent truncation.
+
+**Warm spares** (``--warmSpares N``): the last N ``--replicas``
+entries stay registered and health-polled but OFF the ring; when an
+active replica is marked dead, a spare is promoted in its place (ring
+rebuilt, affinity keys remap the consistent-hashing way), surfaced on
+``/fleet/health`` and ``tpu_router_promotions_total``. A revived
+ex-active re-enters as a spare.
+
+**Rolling restart** (``POST /fleet/rolling-restart``): drain →
+restart-wait → undrain sequenced across the fleet, one replica at a
+time — the weight-update maintenance cycle with zero dropped and zero
+from-scratch-retried streams.
 
 Liveness comes from polling each replica's ``/v1/health`` (the queue
 depth / kv pool pressure / sched stats the engines already export):
@@ -77,9 +103,12 @@ from aiohttp import web
 from k8s_gpu_device_plugin_tpu.serving.faults import FaultError
 from k8s_gpu_device_plugin_tpu.serving.fleet import (
     FleetRegistry,
+    FleetRestartBudget,
     HashRing,
     Replica,
     affinity_key,
+    parse_retry_after,
+    poll_phase,
 )
 from k8s_gpu_device_plugin_tpu.obs.trace import (
     TRACEPARENT_HEADER,
@@ -126,6 +155,19 @@ class RouterMetrics:
             "(connection failure or 429 moved the request on)",
             registry=self._registry,
         )
+        self.promotions = Counter(
+            f"{prefix}_promotions_total",
+            "Warm spares promoted into the ring after an active "
+            "replica died",
+            registry=self._registry,
+        )
+        self.stream_resumes = Counter(
+            f"{prefix}_stream_resumes_total",
+            "Mid-stream replica deaths resumed onto another replica "
+            "(the client-visible stream continued, zero re-emitted "
+            "tokens)",
+            registry=self._registry,
+        )
         self.inflight = Gauge(
             f"{prefix}_inflight",
             "Requests currently relayed to each replica",
@@ -141,6 +183,7 @@ class RouterMetrics:
 
     def close(self) -> None:
         for c in (self.requests, self.affinity_hits, self.failovers,
+                  self.promotions, self.stream_resumes,
                   self.inflight, self.replica_up):
             try:
                 self._registry.unregister(c)
@@ -164,6 +207,67 @@ class _Overloaded(Exception):
         self.content_type = content_type
 
 
+class _StreamJournal:
+    """One in-flight resumable stream's recovery record: the original
+    request body plus every (token, logprob) relayed so far. Written by
+    exactly ONE task — the relay pumping that stream (the engine-owned
+    single-writer discipline, transplanted to the event loop) — and
+    bounded: tokens cannot outgrow the request's ``max_new``, and the
+    router caps how many streams are journaled at once
+    (``journal_limit`` — a stream past the cap serves normally, it just
+    isn't resumable, counted in ``router_stats``)."""
+
+    __slots__ = ("body", "key", "tokens", "logps", "closed")
+
+    def __init__(self, body: dict, key: "bytes | None"):
+        self.body = body                       # parsed original request
+        self.key = key                         # its ring affinity key
+        # pre-seed with a client-supplied resume: those tokens were
+        # already delivered by an EARLIER incarnation, so a death here
+        # must carry them forward too
+        self.tokens: list[int] = [
+            int(t) for t in (body.get("resume_out") or ())
+        ]
+        self.logps: list[float] = [
+            float(x) for x in (body.get("resume_logprobs") or ())
+        ]
+        if len(self.logps) < len(self.tokens):
+            self.logps += [0.0] * (len(self.tokens) - len(self.logps))
+        self.closed = False                    # done/error frame relayed
+
+    def observe(self, evt: dict) -> None:
+        if "token" in evt:
+            self.tokens.append(int(evt["token"]))
+            self.logps.append(float(evt.get("logprob", 0.0)))
+        elif "done" in evt or "error" in evt:
+            self.closed = True
+
+    def resume_body(self) -> bytes:
+        body = dict(self.body)
+        if self.tokens:
+            body["resume_out"] = list(self.tokens)
+            body["resume_logprobs"] = list(self.logps)
+        else:
+            # died before any token was relayed: a plain from-scratch
+            # resubmit IS the resume (there is nothing to fold)
+            body.pop("resume_out", None)
+            body.pop("resume_logprobs", None)
+        return json.dumps(body).encode()
+
+
+class _BackendLost(Exception):
+    """The backend died mid-SSE-relay (after headers, before the done
+    frame): the resume path's trigger. Carries nothing — the journal
+    has everything."""
+
+
+class _ClientGone(Exception):
+    """The CLIENT side of a relay vanished mid-stream. Distinct from
+    _BackendLost so a client disconnect cancels the upstream request
+    (close the backend connection hard — the replica sees the reset and
+    frees the slot) instead of triggering a pointless resume."""
+
+
 class ReplicaRouter:
     """aiohttp app over a FleetRegistry (port 0 = ephemeral)."""
 
@@ -185,9 +289,18 @@ class ReplicaRouter:
         connect_timeout_s: float = 2.0,
         header_timeout_s: float = 300.0,  # finite: a wedged replica
         # must fail over, not hang the client forever (0 = unbounded)
+        resume_timeout_s: float = 30.0,  # how long a mid-stream resume
+        # keeps retrying candidates (429s honored, promotions awaited)
+        # before the stream ends with the structured error frame
         registry=None,          # prometheus registry (None = no /metrics)
         metrics: "RouterMetrics | None" = None,
         faults=None,            # serving.faults.FaultPlane (None = disarmed)
+        warm_spares: int = 0,   # last N --replicas entries held OFF the
+        # ring as standbys, promoted when an active replica dies
+        fleet_restart_budget: int = 3,   # replica-death stream resumes
+        fleet_restart_window_s: float = 300.0,  # per rolling window
+        journal_limit: int = 1024,  # concurrent streams journaled for
+        # resume; streams past the cap serve normally, un-resumably
     ):
         if policy not in ("affinity", "rr"):
             raise ValueError(
@@ -200,7 +313,12 @@ class ReplicaRouter:
                 "(1.0 would refuse the mean load itself)"
             )
         self.fleet = fleet
-        self.ring = HashRing(fleet.ids())
+        if warm_spares:
+            fleet.mark_spares(warm_spares)
+        # the ring is the ACTIVE membership only: spares join (and dead
+        # actives leave) at promotion time, remapping affinity keys the
+        # consistent-hashing way (~1/N of the keyspace moves)
+        self.ring = HashRing([r.rid for r in fleet.active()])
         self.host = host
         self.port = port
         self.bound_port: int | None = None
@@ -227,6 +345,7 @@ class ReplicaRouter:
         # who stream (headers arrive at prepare time) can set this
         # tight; 0 restores unbounded.
         self.header_timeout_s = float(header_timeout_s)
+        self.resume_timeout_s = float(resume_timeout_s)
         # seeded fault injection (serving/faults.py): the two
         # router-side seams — pre-dispatch connect and mid-SSE-relay
         self._flt_connect = (
@@ -239,11 +358,23 @@ class ReplicaRouter:
         self.metrics = metrics
         self.tracer = get_tracer()
         self._rr_next = 0
+        # cross-replica stream resume (the fleet tier's recovery
+        # guarantee): budgeted like the supervisor's restarts, one
+        # charge per replica DEATH (not per stream)
+        self._fleet_budget = FleetRestartBudget(
+            fleet_restart_budget, fleet_restart_window_s
+        )
+        self.journal_limit = int(journal_limit)
+        self._journaled = 0       # streams currently carrying a journal
         # plain counters (always on; RouterMetrics mirrors them): the
         # serve-bench fleet A/B and /fleet/health read these
         self._requests = 0
         self._affinity_hits = 0
         self._failovers = 0
+        self._promotions = 0
+        self._resumes = 0          # mid-stream deaths spliced over
+        self._resume_failures = 0  # ended with the structured error frame
+        self._unjournaled = 0      # streams served past journal_limit
         self._refused: dict[str, int] = {}
         self._outcomes: dict[str, int] = {}
         self._session: aiohttp.ClientSession | None = None
@@ -256,6 +387,9 @@ class ReplicaRouter:
         self.app.router.add_get("/fleet/health", self._fleet_health)
         self.app.router.add_post("/fleet/drain/{replica}", self._drain)
         self.app.router.add_post("/fleet/undrain/{replica}", self._undrain)
+        self.app.router.add_post(
+            "/fleet/rolling-restart", self._rolling_restart
+        )
         if registry is not None:
             self.app.router.add_get("/metrics", self._metrics)
 
@@ -344,22 +478,67 @@ class ReplicaRouter:
             self.fleet.note_failure(rep)
 
     async def _poll_loop(self) -> None:
-        while True:
-            try:
-                await asyncio.gather(
-                    *(self._poll_one(r) for r in self.fleet.all())
-                )
-                if self.metrics is not None:
-                    now = time.monotonic()
-                    for r in self.fleet.all():
-                        self.metrics.replica_up.labels(r.rid).set(
-                            1 if r.routable(now) else 0
+        """One staggered probe loop per replica: each replica's probes
+        fire at a deterministic phase offset inside the interval
+        (serving/fleet.py ``poll_phase``), so an N-replica fleet does
+        not synchronize its health probes into a thundering herd on
+        every ``--healthIntervalS`` tick. Spares are polled too — a
+        promotion must hand traffic to a replica whose liveness is
+        current, not assumed."""
+
+        async def one(rep: Replica) -> None:
+            await asyncio.sleep(poll_phase(rep.rid, self.health_interval_s))
+            while True:
+                try:
+                    await self._poll_one(rep)
+                    self._maybe_promote()
+                    if self.metrics is not None:
+                        self.metrics.replica_up.labels(rep.rid).set(
+                            1 if rep.routable(time.monotonic()) else 0
                         )
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001 - a dead poller blinds routing
-                log.exception("router health poll pass failed")
-            await asyncio.sleep(self.health_interval_s)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - a dead poller blinds
+                    log.exception("router health poll pass failed")
+                await asyncio.sleep(self.health_interval_s)
+
+        tasks = [
+            asyncio.ensure_future(one(rep)) for rep in self.fleet.all()
+        ]
+        try:
+            await asyncio.gather(*tasks)
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    # --- warm spares ------------------------------------------------------
+
+    def _maybe_promote(self) -> None:
+        """Promote warm spares over dead active replicas (called from
+        the poll loop and from proxy-observed failures — wherever a
+        death becomes visible). Each promotion swaps ring membership
+        and rebuilds the ring once, remapping affinity keys; surfaced
+        on /fleet/health (``promotions``) and
+        ``tpu_router_promotions_total``."""
+        promoted = False
+        for rep in self.fleet.active():
+            if rep.alive:
+                continue
+            spare = self.fleet.promote_spare(rep)
+            if spare is None:
+                break  # no idle live spare; later deaths can't do better
+            promoted = True
+            self._promotions += 1
+            if self.metrics is not None:
+                self.metrics.promotions.inc()
+            log.warning(
+                "promoted warm spare into the ring",
+                extra={"fields": {"promoted": spare.rid,
+                                  "replaced": rep.rid,
+                                  "promotions": self._promotions}},
+            )
+        if promoted:
+            self.ring = HashRing([r.rid for r in self.fleet.active()])
 
     # --- tracing ----------------------------------------------------------
 
@@ -419,6 +598,52 @@ class ReplicaRouter:
             return body.get("messages")
         return None  # embeddings: no KV reuse — balance only
 
+    @staticmethod
+    def _resumable_body(path: str, body) -> bool:
+        """Which streams can carry a recovery journal: the native SSE
+        surface with a token-id prompt and n=1 — exactly what the
+        resume seam (``resume_out``) is defined over. Text prompts need
+        the replica's tokenizer (the router has none), n>1 has no
+        single stream to splice, and the OpenAI SSE framing carries no
+        raw token ids to journal; those streams serve exactly as
+        before (a mid-stream death stays a visible truncation the
+        client retries)."""
+        if path != "/v1/generate" or not isinstance(body, dict):
+            return False
+        if not body.get("stream"):
+            return False
+        prompt = body.get("prompt")
+        if (not isinstance(prompt, list) or not prompt or not all(
+            isinstance(t, int) and not isinstance(t, bool) for t in prompt
+        )):
+            return False
+        try:
+            if int(body.get("n", 1) or 1) != 1:
+                return False
+        except (TypeError, ValueError):
+            return False
+        # a client-supplied resume pre-seeds the journal: malformed
+        # fields must not be journaled (the int()/float() casts would
+        # 500 here) — forwarded unjournaled, the replica answers its
+        # clean 4xx
+        rout = body.get("resume_out")
+        if rout is not None and (
+            not isinstance(rout, list) or not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in rout
+            )
+        ):
+            return False
+        rlps = body.get("resume_logprobs")
+        if rlps is not None and (
+            not isinstance(rlps, list) or not all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in rlps
+            )
+        ):
+            return False
+        return True
+
     def _pick(
         self, key: bytes | None
     ) -> tuple[list[Replica], "Replica | None"]:
@@ -433,10 +658,10 @@ class ReplicaRouter:
             # cooldown is ADVICE, not refusal: with every candidate
             # cooling down from a 429, the backend's own 429 (fresh
             # Retry-After included) is the right answer — not a made-up
-            # 503. Draining/dead replicas stay excluded.
+            # 503. Draining/dead/spare replicas stay excluded.
             live = [
                 r for r in self.fleet.all()
-                if r.alive and not r.draining
+                if r.alive and not r.draining and not r.spare
             ]
         if not live:
             return [], None
@@ -512,6 +737,29 @@ class ReplicaRouter:
             )
         self._requests += 1
         headers = self._backend_headers(request)
+        # journal eligibility: native token-id SSE streams (n=1) carry
+        # a recovery journal so a mid-stream replica death resumes on
+        # another ring candidate instead of truncating the client
+        journal: "_StreamJournal | None" = None
+        if self._resumable_body(request.path, body):
+            if self._journaled < self.journal_limit:
+                journal = _StreamJournal(body, key)
+                self._journaled += 1
+            else:
+                self._unjournaled += 1
+        try:
+            return await self._dispatch(
+                request, raw, headers, order, home, journal
+            )
+        finally:
+            if journal is not None:
+                self._journaled -= 1
+
+    async def _dispatch(self, request: web.Request, raw: bytes,
+                        headers: dict, order: "list[Replica]",
+                        home: "Replica | None",
+                        journal: "_StreamJournal | None",
+                        ) -> web.StreamResponse:
         last_429: _Overloaded | None = None
         for attempt, rep in enumerate(order):
             if attempt > 0:
@@ -522,9 +770,11 @@ class ReplicaRouter:
             if self.metrics is not None:
                 self.metrics.inflight.labels(rep.rid).set(rep.inflight)
             try:
-                resp = await self._relay(rep, request, raw, headers)
+                resp = await self._relay(rep, request, raw, headers,
+                                         journal=journal)
             except _Unreachable:
                 self.fleet.note_failure(rep)
+                self._maybe_promote()
                 self._count(rep, "unreachable")
                 continue
             except _Overloaded as e:
@@ -536,21 +786,26 @@ class ReplicaRouter:
                 rep.inflight -= 1
                 if self.metrics is not None:
                     self.metrics.inflight.labels(rep.rid).set(rep.inflight)
-            if resp.status < 500:
-                # only app-level answers prove the engine alive; a 5xx
-                # (dead engine behind a live socket) must keep counting
-                # toward dead_after or steady traffic would reset the
-                # ledger faster than the poller can fail it
-                self.fleet.note_success(rep)
-            else:
-                self.fleet.note_failure(rep)
+            final = getattr(resp, "router_final_rep", rep)
+            if final is rep:
+                if resp.status < 500:
+                    # only app-level answers prove the engine alive; a
+                    # 5xx (dead engine behind a live socket) must keep
+                    # counting toward dead_after or steady traffic would
+                    # reset the ledger faster than the poller can fail it
+                    self.fleet.note_success(rep)
+                else:
+                    self.fleet.note_failure(rep)
+                self._count(rep, self._outcome(resp.status))
+            # else: the stream died under rep mid-relay and the resume
+            # path already fed the liveness ledger and outcome counters
+            # for both the dead replica and whoever finished the stream
             if rep is home:
                 # counted on the SERVING dispatch, not at plan time: a
                 # home that failed over is a miss for cache locality
                 self._affinity_hits += 1
                 if self.metrics is not None:
                     self.metrics.affinity_hits.inc()
-            self._count(rep, self._outcome(resp.status))
             return resp
         if last_429 is not None:
             # every candidate said "not now": deliver the backend's own
@@ -577,12 +832,310 @@ class ReplicaRouter:
         if self.metrics is not None:
             self.metrics.requests.labels(rep.rid, outcome).inc()
 
+    async def _open_backend(self, url: str, raw: bytes, headers: dict):
+        """POST to a backend, bounding the HEADER phase: session.post
+        resolves when response headers arrive, so the timeout covers
+        exactly the wedge window — the body/SSE relay stays unbounded
+        (legitimate long generations). Raises _Unreachable for the
+        failover loop."""
+        try:
+            post = self._session.post(url, data=raw, headers=headers)
+            if self.header_timeout_s > 0:
+                return await asyncio.wait_for(post, self.header_timeout_s)
+            return await post
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError, OSError) as e:
+            raise _Unreachable(str(e)) from None
+
+    @staticmethod
+    async def _client_write(out: web.StreamResponse, data: bytes) -> None:
+        """Write to the CLIENT side of the relay, renaming its failures:
+        a vanished client must read as _ClientGone (cancel upstream),
+        never as a backend loss the resume path would act on."""
+        try:
+            await out.write(data)
+        except (ConnectionResetError, OSError, RuntimeError) as e:
+            raise _ClientGone(str(e)) from None
+
+    async def _pump_sse(self, resp, out: web.StreamResponse,
+                        journal: "_StreamJournal | None") -> None:
+        """Relay one backend SSE body into the client stream.
+
+        Without a journal: the old byte-transparent chunk relay
+        (non-resumable streams — OpenAI SSE, text prompts, n>1); a
+        backend death propagates and the stream ends visibly truncated.
+
+        With a journal: frames are forwarded at event granularity (the
+        bytes of each complete frame pass unmodified, so the relay
+        stays byte-transparent for streams that finish) and every
+        token/logprob is journaled as it passes; a backend death —
+        or the armed ``router.midstream`` fault — raises _BackendLost,
+        the resume path's trigger. Buffering to frame boundaries is
+        what makes the splice clean: a death mid-frame discards the
+        partial frame instead of gluing half a JSON line to the
+        continuation."""
+        if journal is None:
+            async for chunk in resp.content.iter_any():
+                await self._client_write(out, chunk)
+                if self._flt_midstream is not None:
+                    try:
+                        self._flt_midstream.fire()
+                    except FaultError:
+                        # injected mid-relay death on a non-resumable
+                        # stream: close the backend HARD and end the
+                        # client stream without a done event — a
+                        # VISIBLE truncation, never retried
+                        resp.close()
+                        return
+            return
+        buf = b""
+        try:
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    await self._client_write(out, frame + b"\n\n")
+                    self._observe_frame(journal, frame)
+                if self._flt_midstream is not None and not journal.closed:
+                    try:
+                        self._flt_midstream.fire()
+                    except FaultError:
+                        raise _BackendLost() from None
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError, OSError) as e:
+            if journal.closed:
+                return  # every frame delivered; the EOF hiccup is moot
+            raise _BackendLost() from e
+        if not journal.closed:
+            # the body ended with no done/error frame: the backend gave
+            # up on this stream even if the socket closed politely —
+            # as dead, for the client's purposes, as a reset
+            raise _BackendLost()
+
+    @staticmethod
+    def _observe_frame(journal: _StreamJournal, frame: bytes) -> None:
+        """Feed one relayed SSE frame into the journal (single writer:
+        the task pumping this stream). A frame the replica emits that
+        we cannot parse is ignored — the journal then resumes with
+        fewer tokens than the client saw ONLY if the replica broke its
+        own framing contract, which the parse-everything stance below
+        makes loud in tests."""
+        for line in frame.split(b"\n"):
+            if not line.startswith(b"data: "):
+                continue
+            try:
+                evt = json.loads(line[len(b"data: "):])
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if isinstance(evt, dict):
+                journal.observe(evt)
+
+    async def _error_frame(self, out: web.StreamResponse, code: str,
+                           message: str) -> None:
+        """End a client stream with the PR-12 structured error frame
+        (the native SSE shape the replicas themselves emit on engine
+        death) — a resume that cannot happen must be VISIBLE, never a
+        silent truncation that reads like a short completion."""
+        evt = {"error": {"code": code, "message": message}}
+        try:
+            await self._client_write(
+                out, f"data: {json.dumps(evt)}\n\n".encode()
+            )
+        except _ClientGone:
+            pass  # nobody left to tell
+
+    async def _resume_stream(self, dead: Replica, request: web.Request,
+                             out: web.StreamResponse,
+                             journal: _StreamJournal,
+                             headers: dict) -> "Replica | None":
+        """The fleet tier's recovery guarantee: a replica died under a
+        journaled stream — resubmit the request through the native
+        resume seam (emitted tokens folded into the prompt;
+        ``prefilled_out`` keeps greedy AND seeded continuations
+        bit-identical) to the next ring candidate and splice the
+        continuation into the SAME client response, zero re-emitted
+        tokens. Chained deaths loop (each charges the fleet budget for
+        ITS replica); past the budget the stream ends with the
+        structured error frame. Returns the replica that finished the
+        stream, or None when no LIVE replica finished it (the error
+        frame, or a synthesized done after a tokens-complete death) —
+        the caller then leaves the liveness ledger to what this path
+        already recorded."""
+        try:
+            max_new = int(journal.body.get("max_new", 64) or 0)
+        except (TypeError, ValueError):
+            max_new = 0
+        while True:
+            self.fleet.note_failure(dead)
+            # the dead replica's relay gets its outcome recorded (once
+            # per death observation — chained deaths re-enter here with
+            # a new ``dead``): per-replica requests_total must not
+            # undercount exactly the replicas an operator is diagnosing
+            self._count(dead, "died_midstream")
+            self._maybe_promote()
+            if not self._fleet_budget.charge(dead):
+                self._resume_failures += 1
+                log.warning(
+                    "mid-stream replica death past the fleet restart "
+                    "budget; ending stream with an error frame",
+                    extra={"fields": {"replica": dead.rid,
+                                      **self._fleet_budget.stats()}},
+                )
+                await self._error_frame(
+                    out, "fleet_budget_exhausted",
+                    f"replica {dead.rid!r} died mid-stream and the "
+                    "fleet restart budget is exhausted; partial output "
+                    f"({len(journal.tokens)} tokens) was delivered",
+                )
+                return None
+            if max_new and len(journal.tokens) >= max_new:
+                # the death ate only the done frame — every budgeted
+                # token was already delivered. Synthesize a bare done
+                # instead of resubmitting an empty resume (id-surface
+                # caveat: the replica's closing event can carry decoded
+                # text/cached_tokens; those are unrecoverable without a
+                # tokenizer — the token/logprob stream itself is
+                # complete and exact).
+                self._resumes += 1
+                if self.metrics is not None:
+                    self.metrics.stream_resumes.inc()
+                try:
+                    await self._client_write(out, b'data: {"done": true}\n\n')
+                except _ClientGone:
+                    pass
+                # no live finisher: the corpse must NOT be handed back
+                # as this stream's final replica — _dispatch would mark
+                # it successful, cancelling the death it just caused
+                return None
+            raw = journal.resume_body()
+            resp = None
+            target = None
+            t_scan = time.monotonic()
+            refused: set[str] = set()
+            while resp is None:
+                # scan the ring candidates; a fully-refusing fleet is
+                # RETRIED within resume_timeout_s — the survivor may be
+                # momentarily overloaded (429) or a promotion may be a
+                # poll-tick away, and a long-lived stream is worth a
+                # short wait (the client is blocked on us either way)
+                wait = None
+                order, _ = self._pick(journal.key)
+                candidates = [r for r in order if r is not dead]
+                usable = [r for r in candidates if r.rid not in refused]
+                if candidates and not usable:
+                    # every reachable candidate REFUSED the resume at
+                    # the app level (an engine that can't fold — e.g.
+                    # speculative): deterministic, waiting can't help
+                    break
+                for rep in usable:
+                    self._failovers += 1
+                    if self.metrics is not None:
+                        self.metrics.failovers.inc()
+                    try:
+                        r = await self._open_backend(
+                            f"{rep.url}{request.path}", raw, headers
+                        )
+                    except _Unreachable:
+                        self.fleet.note_failure(rep)
+                        self._maybe_promote()
+                        continue
+                    if r.status == 429:
+                        # can't forward a status mid-stream: cool the
+                        # replica down and try the next candidate
+                        await r.read()
+                        r.release()
+                        ra = parse_retry_after(
+                            r.headers.get("Retry-After"), default=1.0
+                        )
+                        rep.cooldown_until = time.monotonic() + ra
+                        wait = ra if wait is None else min(wait, ra)
+                        continue
+                    ctype = r.headers.get("Content-Type", "")
+                    if r.status != 200 or not ctype.startswith(
+                        "text/event-stream"
+                    ):
+                        # a resume the replica refused: only a 5xx is
+                        # dead-engine evidence — a 4xx is an app-level
+                        # answer PROVING the engine alive (the dispatch
+                        # path's own rule), it just can't continue this
+                        # stream, ever (deterministic: skip it in later
+                        # scans instead of re-asking)
+                        await r.read()
+                        r.release()
+                        if r.status >= 500:
+                            self.fleet.note_failure(rep)
+                        else:
+                            self.fleet.note_success(rep)
+                            refused.add(rep.rid)
+                        continue
+                    resp, target = r, rep
+                    break
+                if resp is not None:
+                    break
+                if time.monotonic() - t_scan > self.resume_timeout_s:
+                    break
+                await asyncio.sleep(min(wait if wait is not None else 0.1,
+                                        1.0))
+            if resp is None:
+                self._resume_failures += 1
+                await self._error_frame(
+                    out, "resume_failed",
+                    f"replica {dead.rid!r} died mid-stream and no "
+                    "candidate could resume the request; partial output "
+                    f"({len(journal.tokens)} tokens) was delivered",
+                )
+                return None
+            self._count_resume(dead, target)
+            target.inflight += 1
+            if self.metrics is not None:
+                self.metrics.inflight.labels(target.rid).set(target.inflight)
+            try:
+                await self._pump_sse(resp, out, journal)
+            except _BackendLost:
+                # the continuation's replica died too: charge ITS death
+                # and loop — the journal kept growing, so the next
+                # resume starts exactly where this one ended
+                resp.close()
+                dead = target
+                continue
+            except _ClientGone:
+                # the client vanished mid-continuation: cancel upstream
+                # (hard close) and stop — nobody left to stream to
+                resp.close()
+                return target
+            except BaseException:
+                resp.close()
+                raise
+            finally:
+                target.inflight -= 1
+                if self.metrics is not None:
+                    self.metrics.inflight.labels(target.rid).set(
+                        target.inflight
+                    )
+            self.fleet.note_success(target)
+            self._count(target, "resumed")
+            resp.release()
+            return target
+
+    def _count_resume(self, dead: Replica, target: Replica) -> None:
+        self._resumes += 1
+        if self.metrics is not None:
+            self.metrics.stream_resumes.inc()
+        log.warning(
+            "resumed mid-stream after replica death",
+            extra={"fields": {"dead": dead.rid, "resumed_on": target.rid,
+                              "resumes": self._resumes}},
+        )
+
     async def _relay(self, rep: Replica, request: web.Request,
-                     raw: bytes, headers: dict) -> web.StreamResponse:
+                     raw: bytes, headers: dict,
+                     journal: "_StreamJournal | None" = None,
+                     ) -> web.StreamResponse:
         """One dispatch attempt: forward the body verbatim, relay the
         response (SSE streamed frame-by-frame, JSON in one piece).
         Raises _Unreachable/_Overloaded for the failover loop; anything
-        past response headers is final."""
+        past response headers is final — except a journaled stream's
+        mid-relay backend death, which the resume path splices over."""
         url = f"{rep.url}{request.path}"
         if self._flt_connect is not None:
             try:
@@ -591,27 +1144,15 @@ class ReplicaRouter:
                 # injected connection failure: the failover loop moves
                 # to the next ring candidate, like a real refusal
                 raise _Unreachable(str(e)) from None
-        try:
-            post = self._session.post(url, data=raw, headers=headers)
-            if self.header_timeout_s > 0:
-                # session.post resolves when response HEADERS arrive, so
-                # this bounds exactly the header phase — the body/SSE
-                # relay stays unbounded (legitimate long generations)
-                resp = await asyncio.wait_for(post, self.header_timeout_s)
-            else:
-                resp = await post
-        except (aiohttp.ClientError, asyncio.TimeoutError,
-                ConnectionResetError, OSError) as e:
-            raise _Unreachable(str(e)) from None
+        resp = await self._open_backend(url, raw, headers)
         try:
             if resp.status == 429:
                 body = await resp.read()
-                try:
-                    ra = int(resp.headers.get("Retry-After", "1"))
-                except ValueError:
-                    ra = 1
+                ra = parse_retry_after(
+                    resp.headers.get("Retry-After"), default=1.0
+                )
                 raise _Overloaded(
-                    body, max(1, ra),
+                    body, max(1, int(math.ceil(ra))),
                     resp.headers.get("Content-Type", "application/json")
                     .split(";")[0],
                 )
@@ -622,22 +1163,29 @@ class ReplicaRouter:
                     "Cache-Control": "no-cache",
                 })
                 await out.prepare(request)
-                # byte-transparent relay: frames forwarded as received,
-                # so the stream is bit-identical to direct submission
-                async for chunk in resp.content.iter_any():
-                    await out.write(chunk)
-                    if self._flt_midstream is not None:
-                        try:
-                            self._flt_midstream.fire()
-                        except FaultError:
-                            # injected mid-relay death: close the
-                            # backend HARD and end the client stream
-                            # without a done event — a VISIBLE
-                            # truncation, never retried (the client
-                            # already consumed bytes; replay would
-                            # duplicate them)
-                            resp.close()
-                            return out
+                # which replica fed the liveness ledger for this stream
+                # (the resume path may hand it to another replica; None
+                # = the stream ended on an error frame)
+                out.router_final_rep = rep
+                try:
+                    await self._pump_sse(resp, out, journal)
+                except _BackendLost:
+                    resp.close()
+                    out.router_final_rep = await self._resume_stream(
+                        rep, request, out, journal, headers
+                    )
+                    try:
+                        await out.write_eof()
+                    except (ConnectionResetError, OSError, RuntimeError):
+                        pass
+                    return out
+                except _ClientGone:
+                    # the CLIENT vanished mid-relay: close the backend
+                    # connection HARD so the replica sees the disconnect
+                    # and cancels the generation — no resume, no retry
+                    # (there is nobody left to stream to)
+                    resp.close()
+                    return out
                 await out.write_eof()
                 resp.release()
                 return out
@@ -697,6 +1245,12 @@ class ReplicaRouter:
             "requests": self._requests,
             "affinity_hits": self._affinity_hits,
             "failovers": self._failovers,
+            "promotions": self._promotions,
+            "resumes": self._resumes,
+            "resume_failures": self._resume_failures,
+            "journaled": self._journaled,
+            "unjournaled": self._unjournaled,
+            "fleet_budget": self._fleet_budget.stats(),
             "refused": dict(self._refused),
             "outcomes": dict(self._outcomes),
         }
@@ -708,7 +1262,7 @@ class ReplicaRouter:
         probe, not smile at it."""
         snap = self.fleet.snapshot()
         admitting = sum(
-            1 for r in self.fleet.all() if r.alive and not r.draining
+            1 for r in self.fleet.active() if r.alive and not r.draining
         )
         return web.json_response(
             {"router": True, "alive": admitting > 0,
@@ -723,6 +1277,37 @@ class ReplicaRouter:
         snap["router"] = self.router_stats()
         return web.json_response(snap)
 
+    async def _drain_wait(self, rep: Replica) -> dict:
+        """The drain wait shared by POST /fleet/drain and the rolling
+        restart: router-side in-flight zero AND the replica's own
+        health showing no admitted work (clients that submitted before
+        the drain may still be decoding). The caller has already set
+        ``rep.draining``."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < self.drain_timeout_s:
+            if rep.inflight == 0:
+                h = await self._probe_health(rep)
+                if h is not None and not (
+                    h.get("active", 0) or h.get("prefilling", 0)
+                    or h.get("queued", 0)
+                ):
+                    secs = time.monotonic() - t0
+                    log.info(
+                        "replica drained",
+                        extra={"fields": {"replica": rep.rid,
+                                          "drain_seconds": round(secs, 3)}},
+                    )
+                    return {"drained": True, "drain_seconds": round(secs, 4)}
+                if h is None and not rep.alive:
+                    # nothing in flight and the replica is gone: as
+                    # drained as it will ever be (the restart case)
+                    return {"drained": True, "unreachable": True,
+                            "drain_seconds": round(
+                                time.monotonic() - t0, 4)}
+            await asyncio.sleep(0.05)
+        return {"drained": False,
+                "drain_seconds": round(time.monotonic() - t0, 4)}
+
     async def _drain(self, request: web.Request) -> web.Response:
         rid = request.match_info["replica"]
         rep = self.fleet.get(rid)
@@ -733,42 +1318,78 @@ class ReplicaRouter:
                 status=404,
             )
         rep.draining = True
-        t0 = time.monotonic()
         log.info("draining replica", extra={"fields": {"replica": rid}})
-        while time.monotonic() - t0 < self.drain_timeout_s:
-            if rep.inflight == 0:
-                # the router-side count says nothing is being relayed;
-                # confirm with the replica itself that every admitted
-                # request retired (clients that submitted before the
-                # drain may still be decoding)
-                h = await self._probe_health(rep)
-                if h is not None and not (
-                    h.get("active", 0) or h.get("prefilling", 0)
-                    or h.get("queued", 0)
-                ):
-                    secs = time.monotonic() - t0
-                    log.info(
-                        "replica drained",
-                        extra={"fields": {"replica": rid,
-                                          "drain_seconds": round(secs, 3)}},
-                    )
-                    return web.json_response({
-                        "replica": rid, "draining": True, "drained": True,
-                        "drain_seconds": round(secs, 4),
-                    })
-                if h is None and not rep.alive:
-                    # nothing in flight and the replica is gone: as
-                    # drained as it will ever be (the restart case)
-                    return web.json_response({
-                        "replica": rid, "draining": True, "drained": True,
-                        "drain_seconds": round(time.monotonic() - t0, 4),
-                        "unreachable": True,
-                    })
-            await asyncio.sleep(0.05)
+        res = await self._drain_wait(rep)
         return web.json_response(
-            {"replica": rid, "draining": True, "drained": False,
-             "drain_seconds": round(time.monotonic() - t0, 4)},
-            status=504,
+            {"replica": rid, "draining": True, **res},
+            status=200 if res["drained"] else 504,
+        )
+
+    async def _wait_restart(self, rep: Replica, timeout_s: float) -> bool:
+        """Wait for a NEW process behind the replica's address:
+        ``uptime_s`` on /v1/health resetting below its pre-drain value
+        (the restart-detection contract the replicas export for
+        exactly this)."""
+        before = (rep.health or {}).get("uptime_s")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            h = await self._probe_health(rep)
+            if h is not None:
+                up = h.get("uptime_s")
+                if up is not None and (before is None or up < before):
+                    return True
+            await asyncio.sleep(min(0.2, max(self.health_interval_s, 0.02)))
+        return False
+
+    async def _rolling_restart(self, request: web.Request) -> web.Response:
+        """POST /fleet/rolling-restart: sequence drain → restart-wait →
+        undrain across every active replica, one at a time — the
+        weight-update maintenance cycle as ONE fleet operation. Each
+        replica stops taking new work (spilling it to the others),
+        finishes every in-flight stream (zero drops, zero from-scratch
+        retries — nothing ever dies, so nothing needs the resume path),
+        optionally waits for the operator's restart to show (a fresh
+        ``uptime_s``; ``wait_restart_s`` in the JSON body, default 0 =
+        don't wait), then resumes admission before the next replica
+        drains. 504 when any drain times out (that replica is
+        undrained and the cycle continues, so a wedge degrades to a
+        partial cycle, not a half-drained fleet)."""
+        body: dict = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "body must be JSON"}, status=400
+                )
+        try:
+            wait_restart_s = float(body.get("wait_restart_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "wait_restart_s must be a number"}, status=400
+            )
+        targets = [r for r in self.fleet.active() if r.alive]
+        log.info(
+            "rolling restart started",
+            extra={"fields": {"replicas": [r.rid for r in targets],
+                              "wait_restart_s": wait_restart_s}},
+        )
+        results: dict = {}
+        completed = True
+        for rep in targets:
+            rep.draining = True
+            res = await self._drain_wait(rep)
+            if res["drained"] and wait_restart_s > 0:
+                res["restarted"] = await self._wait_restart(
+                    rep, wait_restart_s
+                )
+                completed = completed and res["restarted"]
+            rep.draining = False
+            results[rep.rid] = res
+            completed = completed and res["drained"]
+        return web.json_response(
+            {"replicas": results, "completed": completed},
+            status=200 if completed else 504,
         )
 
     async def _undrain(self, request: web.Request) -> web.Response:
@@ -827,6 +1448,25 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--drainTimeoutS", type=float, default=120.0,
                         help="POST /fleet/drain/{replica} gives up (504, "
                         "drained:false) after this long")
+    parser.add_argument("--warmSpares", type=int, default=0,
+                        help="hold the LAST N --replicas entries off the "
+                        "ring as warm standbys: registered and health-"
+                        "polled but unrouted, promoted into the ring "
+                        "(affinity keys remapped) when an active "
+                        "replica is marked dead — surfaced on "
+                        "/fleet/health and tpu_router_promotions_total")
+    parser.add_argument("--fleetRestartBudget", type=int, default=3,
+                        help="mid-stream replica deaths the router may "
+                        "resume per rolling --fleetRestartWindowS (one "
+                        "charge per replica DEATH, however many streams "
+                        "it carried): within budget, journaled native "
+                        "SSE streams splice onto the next ring candidate "
+                        "through the resume seam with zero re-emitted "
+                        "tokens; past it (or with 0) streams end with "
+                        "the structured error frame — never a silent "
+                        "truncation")
+    parser.add_argument("--fleetRestartWindowS", type=float, default=300.0,
+                        help="rolling window for --fleetRestartBudget")
     parser.add_argument("--promptBuckets", default="",
                         help="comma list of prompt-bucket boundaries "
                         "for the affinity key (default: the batcher's "
@@ -887,6 +1527,9 @@ def _main(argv: list[str] | None = None) -> int:
         health_interval_s=args.healthIntervalS,
         drain_timeout_s=args.drainTimeoutS,
         header_timeout_s=args.headerTimeoutS,
+        warm_spares=args.warmSpares,
+        fleet_restart_budget=args.fleetRestartBudget,
+        fleet_restart_window_s=args.fleetRestartWindowS,
         registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
         faults=fault_plane,
     )

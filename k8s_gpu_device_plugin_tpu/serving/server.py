@@ -253,9 +253,10 @@ class InferenceEngine:
         self._dead = threading.Event()
         self._subq: list[
             tuple[int, list[int], int, tuple, "Sampler | None", int, tuple,
-                  int | None, object, str, int, "int | None"]
+                  int | None, object, str, int, "int | None", tuple]
         ] = []  # (eid, prompt, max_new, stop, sampler, adapter, bias,
-        #          seed, trace_parent, tenant, priority, deadline_ms)
+        #          seed, trace_parent, tenant, priority, deadline_ms,
+        #          (resume_out, resume_logp))
         self._cancelq: list[int] = []  # eids to cancel, drained per step
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
@@ -283,6 +284,8 @@ class InferenceEngine:
         tenant: str | None = None,
         priority: int | None = None,
         deadline_ms: int | None = None,
+        resume_out: list[int] | None = None,
+        resume_logp: list[float] | None = None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
@@ -292,10 +295,24 @@ class InferenceEngine:
         the loop and hang every stream. Scheduling identity defaults at
         THIS edge: tenant "default", the server's --defaultDeadlineMs,
         priority 1. Raises SchedulerOverloadError (-> HTTP 429) when the
-        scheduler's queue cap is already full."""
+        scheduler's queue cap is already full.
+
+        ``resume_out``/``resume_logp`` resume a stream another
+        incarnation (a dead replica) already partially served: the
+        emitted tokens fold into the prompt through the preemption fold
+        and — because they were already DELIVERED to the client by
+        whoever relayed the dead stream — the published cursor starts
+        past them, so this stream carries only the continuation (zero
+        re-emitted tokens)."""
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
-        self.cb.validate(len(prompt), max_new)  # the batcher's own rule
+        resume_out, resume_logp = self.cb.validate_resume(
+            resume_out, resume_logp, max_new
+        )
+        # the batcher's own rule, over the folded prompt + what is LEFT
+        # of the budget (the fold's row total is the original worst case)
+        self.cb.validate(len(prompt) + len(resume_out),
+                         max_new - len(resume_out))
         self.cb.validate_adapter(adapter)
         logit_bias = self.cb.validate_bias(logit_bias)
         if priority is None:
@@ -353,10 +370,14 @@ class InferenceEngine:
             self._subq.append(
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
                  adapter, logit_bias, seed, trace_parent,
-                 tenant, priority, deadline_ms)
+                 tenant, priority, deadline_ms,
+                 (resume_out, resume_logp))
             )
             self._streams[eid] = (loop, q)
-            self._published[eid] = 0
+            # the published cursor starts past the resumed tokens: they
+            # were delivered by the dead incarnation's relay — pushing
+            # them again would duplicate what the client already has
+            self._published[eid] = len(resume_out)
         self._work.set()
         return eid, q
 
@@ -445,7 +466,7 @@ class InferenceEngine:
         with self._lock:
             batch, self._subq = self._subq, []
         for (eid, prompt, max_new, stop, sampler, adapter, bias, seed,
-             trace_parent, tenant, priority, deadline_ms) in batch:
+             trace_parent, tenant, priority, deadline_ms, resume) in batch:
             try:
                 with attach(trace_parent):
                     rid = self.cb.submit(
@@ -454,6 +475,7 @@ class InferenceEngine:
                         sampler=sampler, adapter=adapter, logit_bias=bias,
                         seed=seed, tenant=tenant, priority=priority,
                         deadline_ms=deadline_ms,
+                        resume_out=resume[0], resume_logp=resume[1],
                     )
             except SchedulerOverloadError as e:
                 # the request-thread capacity gate raced a burst: close
@@ -996,6 +1018,33 @@ class InferenceServer:
             # (obs/attribution.py): phase breakdown of this request's
             # TTFT and wall time; requires the server-side layer
             want_timeline = bool(body.get("timeline", False))
+            # cross-replica stream resume (serving/router.py's seam):
+            # tokens another incarnation already emitted AND delivered —
+            # the engine folds them into the prompt (preemption fold)
+            # and this response carries only the continuation
+            resume_out = body.get("resume_out")
+            resume_lp = body.get("resume_logprobs")
+            if resume_out is not None:
+                if (not isinstance(resume_out, list) or not resume_out
+                        or not all(isinstance(t, int) for t in resume_out)):
+                    raise ValueError(
+                        "resume_out must be a non-empty list of token ids"
+                    )
+                if text is not None:
+                    raise ValueError(
+                        "resume_out requires a token-id 'prompt' "
+                        "(the fold is defined over ids, not text)"
+                    )
+                if n != 1:
+                    raise ValueError("resume supports n=1 only")
+                if resume_lp is not None and (
+                    not isinstance(resume_lp, list)
+                    or not all(isinstance(x, (int, float))
+                               for x in resume_lp)
+                ):
+                    raise ValueError(
+                        "resume_logprobs must be a list of numbers"
+                    )
             # per-request sampling: any knob present builds a full
             # Sampler (its own validation applies); absent fields default
             # to greedy/off, NOT to the server sampler — a request that
@@ -1048,6 +1097,7 @@ class InferenceServer:
                     seed=None if seed is None else (seed + i) % 2**31,
                     tenant=tenant, priority=priority,
                     deadline_ms=deadline_ms,
+                    resume_out=resume_out, resume_logp=resume_lp,
                 ))
         except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
@@ -1119,12 +1169,16 @@ class InferenceServer:
             if self.tokenizer is not None:
                 # detokenize phase of the request trace (the batcher owns
                 # admit/prefill/decode/retire; text assembly happens here
-                # at the HTTP boundary)
+                # at the HTTP boundary). A resumed request's text covers
+                # the WHOLE output — the resumed tokens plus the
+                # continuation — even though only the continuation was
+                # (re-)delivered on this response.
+                full_out = list(resume_out or []) + drained[0][0]
                 with self.tracer.span(
                     "detokenize", component="serving",
-                    tokens=len(drained[0][0]),
+                    tokens=len(full_out),
                 ):
-                    payload["text"] = self.tokenizer.decode(drained[0][0])
+                    payload["text"] = self.tokenizer.decode(full_out)
                     if n > 1:
                         payload["completions_text"] = [
                             self.tokenizer.decode(d[0]) for d in drained
@@ -1136,7 +1190,9 @@ class InferenceServer:
                      "Cache-Control": "no-cache"}
         )
         await resp.prepare(request)
-        streamed: list[int] = []
+        # a resumed stream's closing text must cover the whole output,
+        # resumed tokens included (only the continuation is re-streamed)
+        streamed: list[int] = list(resume_out or [])
         try:
             while True:
                 item = await q.get()
@@ -1196,6 +1252,11 @@ class InferenceServer:
 
     async def run(self, stop: asyncio.Event) -> None:
         runner = web.AppRunner(self.app)
+        # kept on self so the test/bench fleet harness can ABORT live
+        # connections (serving/testing.py kill_replica): a graceful
+        # cleanup waits for in-flight handlers, which is a drain — a
+        # process death is not
+        self._runner = runner
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)
         await site.start()

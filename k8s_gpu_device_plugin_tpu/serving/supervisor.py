@@ -56,6 +56,46 @@ from k8s_gpu_device_plugin_tpu.utils.log import get_logger
 log = get_logger()
 
 
+class RollingBudget:
+    """N events per rolling window — the ONE budget shape both recovery
+    tiers share: the engine supervisor's restart budget (crashes inside
+    one replica) and the router's fleet restart budget (replica deaths
+    across the fleet, serving/router.py). ``max_events=0`` means the
+    budget is always exhausted — the recovery-off switch at either tier.
+
+    Single-writer like every ledger around it: the supervisor's lives on
+    the engine thread, the router's on its event loop; neither is shared.
+    """
+
+    __slots__ = ("max_events", "window_s", "_times")
+
+    def __init__(self, max_events: int, window_s: float):
+        if max_events < 0:
+            raise ValueError(
+                f"max_events must be >= 0, got {max_events}"
+            )
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.max_events = int(max_events)
+        self.window_s = float(window_s)
+        self._times: list[float] = []
+
+    def allow(self) -> bool:
+        """True while the rolling budget has room (prunes the window)."""
+        now = time.monotonic()
+        self._times = [t for t in self._times if now - t < self.window_s]
+        return len(self._times) < self.max_events
+
+    def record(self) -> None:
+        """Charge one event against the window."""
+        self._times.append(time.monotonic())
+
+    def used(self) -> int:
+        now = time.monotonic()
+        self._times = [t for t in self._times if now - t < self.window_s]
+        return len(self._times)
+
+
 class StreamError:
     """Terminal structured-error frame on a per-request stream queue.
 
@@ -87,15 +127,9 @@ class EngineSupervisor:
     """
 
     def __init__(self, max_restarts: int = 3, window_s: float = 300.0):
-        if max_restarts < 0:
-            raise ValueError(
-                f"max_restarts must be >= 0, got {max_restarts}"
-            )
-        if window_s <= 0:
-            raise ValueError(f"window_s must be > 0, got {window_s}")
-        self.max_restarts = int(max_restarts)
-        self.window_s = float(window_s)
-        self._restart_times: list[float] = []  # owner: engine
+        self._budget = RollingBudget(max_restarts, window_s)  # owner: engine
+        self.max_restarts = self._budget.max_events
+        self.window_s = self._budget.window_s
         self._state = "ok"                     # owner: engine
         self._last_crash: dict | None = None   # owner: engine
         self._crashes_total = 0                # owner: engine
@@ -122,11 +156,7 @@ class EngineSupervisor:
 
     def allow_restart(self) -> bool:
         """True while the rolling restart budget has room."""
-        now = time.monotonic()
-        self._restart_times = [
-            t for t in self._restart_times if now - t < self.window_s
-        ]
-        return len(self._restart_times) < self.max_restarts
+        return self._budget.allow()
 
     def mark_dead(self) -> None:
         self._state = "dead"
@@ -265,7 +295,7 @@ class EngineSupervisor:
                 req.decode_span = None
             new.pending.append(req)
         engine.cb = new
-        self._restart_times.append(time.monotonic())
+        self._budget.record()
         self._restarts_total += 1
         self._replayed_total += replayed
         self._resumed_total += resumed

@@ -61,7 +61,20 @@ class InprocessFleet:
         return f"http://127.0.0.1:{self.servers[i].bound_port}"
 
     async def kill_replica(self, i: int) -> None:
-        """Stop replica ``i`` abruptly (the crash path — no drain)."""
+        """Stop replica ``i`` abruptly (the crash path — no drain).
+
+        Live connections are ABORTED first: a graceful aiohttp cleanup
+        waits for in-flight handlers to finish, which is a drain, not a
+        death — mid-stream relays must see the connection reset the way
+        they would when the process vanishes (what the router's resume
+        path recovers from)."""
+        runner = getattr(self.servers[i], "_runner", None)
+        server = getattr(runner, "server", None)
+        if server is not None:
+            for proto in list(getattr(server, "connections", ())):
+                transport = getattr(proto, "transport", None)
+                if transport is not None:
+                    transport.abort()
         self.stops[i].set()
         await asyncio.wait_for(self.tasks[i], 30)
 

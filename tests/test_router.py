@@ -540,6 +540,587 @@ def test_poll_loop_survives_probe_exceptions_and_marks_down():
     run(body())
 
 
+# --- cross-replica stream resume (the fleet recovery tentpole) ------------
+
+
+async def _read_stream(resp, on_token=None) -> list[dict]:
+    """Drain one SSE stream, invoking ``on_token(count)`` after each
+    token event (the mid-stream kill hook)."""
+    events = []
+    n = 0
+    async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        evt = json.loads(line[len("data: "):])
+        events.append(evt)
+        if "token" in evt:
+            n += 1
+            if on_token is not None:
+                await on_token(n)
+        if evt.get("done") or "error" in evt:
+            break
+    return events
+
+
+def _toks_lps(events):
+    return ([e["token"] for e in events if "token" in e],
+            [e.get("logprob") for e in events if "token" in e])
+
+
+@pytest.mark.parametrize("seeded", [False, True])
+def test_midstream_replica_kill_resumes_bit_identical(setup, seeded):
+    """THE acceptance pin: kill the replica serving a stream mid-relay;
+    the client-visible token AND logprob stream continues bit-identical
+    to an uninterrupted run (greedy + seeded), with zero re-emitted
+    tokens and a clean done event — the death is invisible."""
+    cfg, params = setup
+    p = _prompt(500 + int(seeded), 6, cfg)
+    body = {"prompt": p, "max_new": 24, "stream": True, "logprobs": True}
+    if seeded:
+        body.update(temperature=0.8, seed=77)
+
+    async def run_test(session, base, ctx):
+        # warm both replicas' compiles direct, then an uninterrupted
+        # baseline stream through the router
+        for i in range(2):
+            async with session.post(
+                f"{ctx.replica_base(i)}/v1/generate",
+                json=dict(body, stream=False),
+            ) as r:
+                assert r.status == 200
+        async with session.post(f"{base}/v1/generate", json=body) as r:
+            baseline = await _read_stream(r)
+        base_toks, base_lps = _toks_lps(baseline)
+        assert baseline[-1].get("done") and len(base_toks) == 24
+
+        killed = []
+
+        async def kill_at_3(n):
+            if n != 3 or killed:
+                return
+            serving = next(
+                i for i in range(2)
+                if ctx.fleet.get(f"r{i}").inflight > 0
+            )
+            killed.append(serving)
+            await ctx.kill_replica(serving)
+
+        resp = await session.post(f"{base}/v1/generate", json=body)
+        events = await _read_stream(resp, on_token=kill_at_3)
+        assert killed, "the kill hook never fired"
+        toks, lps = _toks_lps(events)
+        assert events[-1].get("done") is True          # no error frame
+        assert toks == base_toks                       # bit-identical...
+        assert lps == base_lps                         # ...logprobs too
+        assert len(toks) == 24                         # zero re-emitted
+        stats = ctx.router.router_stats()
+        assert stats["resumes"] == 1
+        assert stats["resume_failures"] == 0
+        assert stats["fleet_budget"]["charged_total"] == 1
+
+    run(_with_fleet(setup, run_test, policy="rr",
+                    router_kw={"health_interval_s": 0.05}))
+
+
+def test_resume_seam_http_continuation(setup):
+    """The native resume seam direct: POST resume_out = the first k
+    tokens of a finished run and get back EXACTLY the remaining
+    tokens/logprobs — greedy and seeded, streamed and not."""
+    cfg, params = setup
+    p = _prompt(520, 6, cfg)
+
+    async def body(session, base, ctx):
+        for seeded in (False, True):
+            req = {"prompt": p, "max_new": 10, "logprobs": True}
+            if seeded:
+                req.update(temperature=0.9, seed=11)
+            async with session.post(f"{base}/v1/generate", json=req) as r:
+                assert r.status == 200
+                full = await r.json()
+            for k in (1, 4, 9):
+                res = dict(req, resume_out=full["tokens"][:k],
+                           resume_logprobs=full["logprobs"][:k])
+                async with session.post(
+                    f"{base}/v1/generate", json=res
+                ) as r:
+                    assert r.status == 200
+                    cont = await r.json()
+                assert cont["tokens"] == full["tokens"][k:]
+                assert cont["logprobs"] == full["logprobs"][k:]
+                # streamed continuation: same tokens, then done
+                async with session.post(
+                    f"{base}/v1/generate", json=dict(res, stream=True)
+                ) as r:
+                    events = await _read_stream(r)
+                toks, lps = _toks_lps(events)
+                assert toks == full["tokens"][k:]
+                assert lps == full["logprobs"][k:]
+                assert events[-1].get("done") is True
+        # validation: resuming the whole budget is refused, not hung
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 3, "resume_out": [1, 2, 3],
+        }) as r:
+            assert r.status == 422
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 8, "resume_out": [1], "n": 2,
+        }) as r:
+            assert r.status == 400
+        # malformed resume fields through the ROUTER: not journaled
+        # (the journal's casts must never 500), forwarded, and the
+        # replica's clean 400 comes back
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 8, "stream": True,
+            "resume_out": ["x"],
+        }) as r:
+            assert r.status == 400
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 8, "stream": True,
+            "resume_out": 5,
+        }) as r:
+            assert r.status == 400
+
+    run(_with_fleet(setup, body, n_replicas=1))
+
+
+def test_resume_refusal_keeps_replica_alive_and_fails_fast():
+    """A candidate that answers a resume with a 4xx gave an APP-LEVEL
+    answer: it proves the engine alive (no liveness failure — one
+    journaled stream's death must never mark healthy replicas dead)
+    and the refusal is deterministic, so the resume fails fast with
+    the structured error frame instead of hammering it for the whole
+    resume window."""
+    from aiohttp import web as aweb
+
+    async def body():
+        # replica b: streams two tokens, then ends with no done frame
+        # (the mid-stream death shape); replica a: 422s every resume
+        async def gen_b(request):
+            resp = aweb.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(b'data: {"token": 1}\n\n')
+            await resp.write(b'data: {"token": 2}\n\n')
+            return resp  # no done event: the backend gave up
+
+        async def gen_a(request):
+            return aweb.json_response({"error": "no resume here"},
+                                      status=422)
+
+        apps = []
+        for handler in (gen_a, gen_b):
+            app = aweb.Application()
+            app.router.add_post("/v1/generate", handler)
+            runner = aweb.AppRunner(app)
+            await runner.setup()
+            site = aweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            apps.append((runner, runner.addresses[0][1]))
+        fleet = FleetRegistry.from_spec(
+            f"a=http://127.0.0.1:{apps[0][1]},"
+            f"b=http://127.0.0.1:{apps[1][1]}",
+            dead_after=3,
+        )
+        # rr's first pick is the SECOND replica (b) — deterministic
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               policy="rr", health_interval_s=60.0,
+                               resume_timeout_s=30.0)
+        stop = asyncio.Event()
+        task = asyncio.create_task(router.run(stop))
+        while router.bound_port is None:
+            await asyncio.sleep(0.01)
+        try:
+            t0 = asyncio.get_event_loop().time()
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{router.bound_port}/v1/generate",
+                    json={"prompt": [1, 2, 3], "max_new": 8,
+                          "stream": True},
+                ) as r:
+                    events = await _read_stream(r)
+            elapsed = asyncio.get_event_loop().time() - t0
+            # the stream ended on the structured frame, fast (the 422
+            # is deterministic — no 30s scan window burned)
+            assert events[-1]["error"]["code"] == "resume_failed"
+            assert elapsed < 5.0, elapsed
+            # and the refusing replica is still ALIVE with a clean
+            # failure ledger (the 4xx proved its engine up)
+            a = fleet.get("a")
+            assert a.alive is True
+            assert a.consecutive_failures == 0
+            assert router.router_stats()["resume_failures"] == 1
+        finally:
+            stop.set()
+            await asyncio.wait_for(task, 30)
+            for runner, _ in apps:
+                await runner.cleanup()
+
+    run(body())
+
+
+def test_injected_midstream_fault_resumes_on_other_replica(setup):
+    """The router.midstream fault point now rehearses the resume path:
+    an injected mid-relay death splices the continuation from another
+    replica — the client still sees every token exactly once."""
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    cfg, params = setup
+    p = _prompt(530, 6, cfg)
+    body = {"prompt": p, "max_new": 12, "stream": True, "logprobs": True}
+
+    async def run_test(session, base, ctx):
+        for i in range(2):
+            async with session.post(
+                f"{ctx.replica_base(i)}/v1/generate",
+                json=dict(body, stream=False),
+            ) as r:
+                assert r.status == 200
+                oracle = (await r.json())["tokens"]
+        async with session.post(f"{base}/v1/generate", json=body) as r:
+            events = await _read_stream(r)
+        toks, _ = _toks_lps(events)
+        assert events[-1].get("done") is True
+        assert toks == oracle and len(toks) == 12
+        assert ctx.router.router_stats()["resumes"] == 1
+
+    run(_with_fleet(
+        setup, run_test, policy="rr",
+        router_kw={"faults": FaultPlane.from_spec("router.midstream:nth=2")},
+    ))
+
+
+def test_fleet_budget_exhausted_ends_with_error_frame(setup):
+    """Budget 0 = cross-replica resume off: a mid-stream death then
+    ends the stream with the PR-12 structured error frame — visibly,
+    never as a clean short completion."""
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    cfg, params = setup
+    p = _prompt(540, 6, cfg)
+
+    async def run_test(session, base, ctx):
+        for i in range(2):
+            async with session.post(
+                f"{ctx.replica_base(i)}/v1/generate",
+                json={"prompt": p, "max_new": 2},
+            ) as r:
+                assert r.status == 200
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 12, "stream": True,
+        }) as r:
+            assert r.status == 200
+            events = await _read_stream(r)
+        assert not any(e.get("done") for e in events)
+        assert events[-1]["error"]["code"] == "fleet_budget_exhausted"
+        stats = ctx.router.router_stats()
+        assert stats["resumes"] == 0
+        assert stats["resume_failures"] == 1
+
+    run(_with_fleet(
+        setup, run_test, policy="rr",
+        router_kw={
+            "fleet_restart_budget": 0,
+            "faults": FaultPlane.from_spec("router.midstream:nth=2"),
+        },
+    ))
+
+
+def test_fleet_restart_budget_charges_per_death_not_per_stream():
+    from k8s_gpu_device_plugin_tpu.serving.fleet import (
+        FleetRestartBudget,
+        Replica,
+    )
+
+    budget = FleetRestartBudget(max_restarts=2, window_s=60.0)
+    r0, r1, r2 = (Replica(f"r{i}", f"http://h:{i}") for i in range(3))
+    # N streams dying from ONE replica death share one charge
+    assert all(budget.charge(r0) for _ in range(5))
+    assert budget.stats()["window_used"] == 1
+    assert budget.charge(r1)
+    # budget full: a third replica's death cannot resume...
+    assert not budget.charge(r2)
+    # ...but streams of the already-charged deaths still can
+    assert budget.charge(r0) and budget.charge(r1)
+    # a REVIVED replica's next death is a new event (epoch bump)
+    r0.epoch += 1
+    assert not budget.charge(r0)
+    with pytest.raises(ValueError):
+        FleetRestartBudget(max_restarts=-1)
+
+
+def test_flapping_replica_burns_budget_per_death():
+    """A replica that dies mid-stream, heals (a successful health poll
+    — WITHOUT ever reaching dead_after), and dies again must charge the
+    budget AGAIN: recovery from any observed failure closes the death
+    epoch, so --fleetRestartBudget actually bounds a flapper instead
+    of granting it unlimited resumes on the first epoch's charge."""
+    from k8s_gpu_device_plugin_tpu.serving.fleet import (
+        FleetRegistry,
+        FleetRestartBudget,
+    )
+
+    fleet = FleetRegistry.from_spec("a=http://h:1,b=http://h:2",
+                                    dead_after=3)
+    budget = FleetRestartBudget(max_restarts=1, window_s=60.0)
+    a = fleet.get("a")
+    # death 1: one proxy-observed failure (alive stays True), charged
+    fleet.note_failure(a)
+    assert a.alive is True
+    assert budget.charge(a)
+    # a successful poll heals the flap: the epoch closes
+    fleet.note_success(a, {"alive": True})
+    assert a.consecutive_failures == 0
+    # death 2 is a NEW event — the budget (1) is spent, resume refused
+    fleet.note_failure(a)
+    assert not budget.charge(a)
+    # repeated successes with a clean ledger do NOT churn the epoch
+    e = a.epoch
+    fleet.note_success(a, {"alive": True})
+    fleet.note_success(a, {"alive": True})
+    assert a.epoch == e + 1  # one bump for closing death 2, then stable
+
+
+# --- warm spares ----------------------------------------------------------
+
+
+def test_warm_spare_promotion(setup):
+    """--warmSpares: the spare is registered-but-unrouted until an
+    active replica dies, then promoted into the ring (affinity keys
+    remapped) — pinned via /fleet/health, router stats, and traffic
+    landing on the promoted spare."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        assert [r.rid for r in ctx.fleet.active()] == ["r0", "r1"]
+        assert [r.rid for r in ctx.fleet.spares()] == ["r2"]
+        # warm all three (the spare serves the moment it is promoted)
+        for i in range(3):
+            async with session.post(
+                f"{ctx.replica_base(i)}/v1/generate",
+                json={"prompt": _prompt(550, 8, cfg), "max_new": 2},
+            ) as r:
+                assert r.status == 200
+        # spares take no traffic while both actives live
+        for i in range(6):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(560 + i, 12, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        assert ctx.fleet.get("r2").relayed == 0
+
+        await ctx.kill_replica(0)
+        for _ in range(100):
+            if ctx.router.router_stats()["promotions"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        stats = ctx.router.router_stats()
+        assert stats["promotions"] == 1
+        assert {r.rid for r in ctx.fleet.active()} == {"r1", "r2"}
+        assert ctx.fleet.get("r0").spare  # demoted: revival re-enters as spare
+        # the ring now routes onto the promoted spare too
+        for i in range(8):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(570 + i, 12, cfg), "max_new": 2,
+            }) as r:
+                assert r.status == 200
+        assert ctx.fleet.get("r2").relayed > 0
+        async with session.get(f"{base}/fleet/health") as r:
+            snap = await r.json()
+        assert snap["router"]["promotions"] == 1
+        assert snap["replicas"]["r0"]["spare"] is True
+        assert snap["replicas"]["r2"]["spare"] is False
+        assert snap["spares"] == 1
+
+    run(_with_fleet(setup, body, n_replicas=3,
+                    router_kw={"warm_spares": 1,
+                               "health_interval_s": 0.05}))
+
+
+def test_mark_spares_must_leave_an_active_replica():
+    from k8s_gpu_device_plugin_tpu.serving.fleet import FleetRegistry
+
+    fleet = FleetRegistry.from_spec("a=http://h:1,b=http://h:2")
+    with pytest.raises(ValueError, match="active replica"):
+        fleet.mark_spares(2)
+
+
+# --- rolling restart ------------------------------------------------------
+
+
+def test_rolling_restart_zero_drops(setup):
+    """POST /fleet/rolling-restart drains -> undrains every active
+    replica in sequence while streams are in flight and new submits
+    keep arriving: zero dropped tokens, zero resumes (nothing ever
+    dies), admission restored everywhere."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        for i in range(2):
+            async with session.post(
+                f"{ctx.replica_base(i)}/v1/generate",
+                json={"prompt": _prompt(580, 5, cfg), "max_new": 2},
+            ) as r:
+                assert r.status == 200
+
+        async def stream_one(k):
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": _prompt(590 + k, 5, cfg), "max_new": 40,
+                "stream": True,
+            }) as r:
+                assert r.status == 200
+                events = await _read_stream(r)
+            toks, _ = _toks_lps(events)
+            return len(toks), bool(events[-1].get("done"))
+
+        streams = [asyncio.create_task(stream_one(k)) for k in range(4)]
+        await asyncio.sleep(0.2)  # streams mid-flight
+        async with session.post(f"{base}/fleet/rolling-restart") as r:
+            assert r.status == 200
+            cycle = await r.json()
+        assert cycle["completed"] is True
+        assert set(cycle["replicas"]) == {"r0", "r1"}
+        assert all(v["drained"] for v in cycle["replicas"].values())
+        # submits mid- and post-cycle keep succeeding
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": _prompt(599, 5, cfg), "max_new": 2,
+        }) as r:
+            assert r.status == 200
+        results = await asyncio.gather(*streams)
+        assert all(done and toks == 40 for toks, done in results), results
+        assert not any(rep.draining for rep in ctx.fleet.all())
+        stats = ctx.router.router_stats()
+        assert stats["resumes"] == 0  # a drain is not a death
+
+    run(_with_fleet(setup, body, policy="rr",
+                    router_kw={"health_interval_s": 0.05}))
+
+
+def test_rolling_restart_wait_restart_detects_new_process():
+    """wait_restart_s: the cycle recognizes a restarted replica by its
+    uptime_s resetting (probe-level unit test, no real restart)."""
+
+    async def body():
+        from k8s_gpu_device_plugin_tpu.serving.fleet import FleetRegistry
+
+        fleet = FleetRegistry.from_spec("a=http://127.0.0.1:1")
+        router = ReplicaRouter(fleet, health_interval_s=0.01)
+        rep = fleet.get("a")
+        rep.health = {"uptime_s": 120.0}
+        uptimes = [150.0, 3.0]  # old process, then the restarted one
+
+        async def fake_probe(r):
+            up = uptimes.pop(0) if uptimes else 4.0
+            return {"uptime_s": up}
+
+        router._probe_health = fake_probe
+        assert await router._wait_restart(rep, timeout_s=5.0) is True
+        # never restarts: times out False
+        router._probe_health = lambda r: _const({"uptime_s": 500.0})
+        rep.health = {"uptime_s": 120.0}
+        assert await router._wait_restart(rep, timeout_s=0.05) is False
+
+    async def _const(v):
+        return v
+
+    run(body())
+
+
+# --- satellite pins -------------------------------------------------------
+
+
+def test_client_disconnect_cancels_upstream(setup):
+    """A client that aborts its SSE stream mid-generation must free the
+    replica's slot: the router closes the backend connection hard and
+    the replica's active count returns to zero well before the token
+    budget would have drained."""
+    cfg, params = setup
+
+    async def body(session, base, ctx):
+        engine = ctx.servers[0].engine
+        async with session.post(
+            f"{ctx.replica_base(0)}/v1/generate",
+            json={"prompt": _prompt(600, 5, cfg), "max_new": 2},
+        ) as r:
+            assert r.status == 200
+        resp = await session.post(f"{base}/v1/generate", json={
+            "prompt": _prompt(601, 5, cfg), "max_new": 2000,
+            "stream": True,
+        })
+        assert resp.status == 200
+        # read a couple of tokens, then vanish
+        seen = 0
+        async for line in resp.content:
+            if line.decode().strip().startswith("data: "):
+                seen += 1
+                if seen >= 2:
+                    break
+        resp.close()  # the client-side abort
+        for _ in range(200):
+            st = engine.stats()
+            if st["active"] == 0 and st["queued"] == 0 \
+                    and st["prefilling"] == 0:
+                break
+            await asyncio.sleep(0.05)
+        st = engine.stats()
+        assert st["active"] == 0 and st["queued"] == 0, st
+
+    run(_with_fleet(setup, body, n_replicas=1,
+                    engine_kw={"max_len": 4096, "chunked_prefill": 8}))
+
+
+def test_parse_retry_after_accepts_http_dates():
+    import datetime
+
+    from k8s_gpu_device_plugin_tpu.serving.fleet import parse_retry_after
+
+    # delta-seconds (the common case)
+    assert parse_retry_after("30") == 30.0
+    assert parse_retry_after("0") == 0.0
+    # RFC 9110 HTTP-date, ~45s in the future
+    when = datetime.datetime.now(datetime.timezone.utc) \
+        + datetime.timedelta(seconds=45)
+    got = parse_retry_after(email_format_date(when))
+    assert 40.0 <= got <= 46.0
+    # a date in the past: retry now-ish (the default), never negative
+    past = datetime.datetime.now(datetime.timezone.utc) \
+        - datetime.timedelta(seconds=600)
+    assert parse_retry_after(email_format_date(past), default=2.0) == 2.0
+    # garbage falls back to the capped default instead of raising
+    assert parse_retry_after("soon", default=3.0) == 3.0
+    assert parse_retry_after("", default=1.0) == 1.0
+    assert parse_retry_after(None, default=1.0) == 1.0
+    # negative delta: default; giant delta: capped
+    assert parse_retry_after("-5", default=1.0) == 1.0
+    assert parse_retry_after("999999999", max_s=3600.0) == 3600.0
+    # NaN/inf parse as floats but are garbage: default, never poison
+    # the arithmetic downstream (cooldowns, ceil())
+    assert parse_retry_after("NaN", default=1.5) == 1.5
+    assert parse_retry_after("inf", default=1.5) == 1.5
+    assert parse_retry_after("-inf", default=1.5) == 1.5
+
+
+def email_format_date(dt):
+    import email.utils
+
+    return email.utils.format_datetime(dt, usegmt=True)
+
+
+def test_health_poll_phase_jitter_deterministic():
+    """Per-replica poll phases spread inside the interval and are
+    stable across router restarts (blake2b, not the salted hash)."""
+    from k8s_gpu_device_plugin_tpu.serving.fleet import poll_phase
+
+    interval = 1.0
+    phases = [poll_phase(f"replica-{i}", interval) for i in range(16)]
+    assert all(0.0 <= p < interval for p in phases)
+    assert len(set(phases)) > 8  # spread, not synchronized
+    assert phases == [poll_phase(f"replica-{i}", interval)
+                      for i in range(16)]  # deterministic
+    # phases scale with the interval; degenerate interval is safe
+    assert poll_phase("r0", 2.0) == 2.0 * poll_phase("r0", 1.0)
+    assert poll_phase("r0", 0.0) == 0.0
+
+
 def test_injected_router_connect_fault_fails_over(setup):
     """The router.connect fault point: an injected pre-dispatch
     connection failure moves the request to the next ring candidate

@@ -118,6 +118,11 @@ def test_spec_batching_guards(setup):
     )
     with pytest.raises(ValueError, match="gamma"):
         sb.submit(list(range(1, 21)), max_new=10)  # 20+10+4 > 32
+    with pytest.raises(ValueError, match="resume"):
+        # no resume path: rounds share one sampler with no per-request
+        # draw index (the router's cross-replica resume must 422, not
+        # crash the engine thread)
+        sb.submit([1, 2, 3], max_new=8, resume_out=[4, 5])
     # shared prefixes are SUPPORTED now (the target serves the cached
     # rows, the draft re-prefills them) — pinned end to end with the
     # oracle comparison in tests/test_spec_fastpath.py

@@ -538,6 +538,86 @@ def test_injected_batcher_refuses_supervisor_and_faults(setup):
         eng.shutdown()
 
 
+def test_recover_preserves_submit_anchored_deadline():
+    """Supervisor x scheduler interplay, white-box half: a restart
+    survivor keeps its ORIGINAL submit-anchored absolute deadline —
+    recovery folds the prompt and requeues, it never re-anchors the
+    SLO clock (queue wait across a crash still counts against the
+    deadline, exactly like queue wait across a preemption)."""
+    from types import SimpleNamespace
+
+    from k8s_gpu_device_plugin_tpu.models.batching import _Request
+
+    sup = EngineSupervisor(max_restarts=2, window_s=60.0)
+    req = _Request(rid=7, prompt=[1, 2, 3], max_new=8)
+    req.deadline = 123.456          # absolute perf_counter instant
+    req.t_submit = 100.0
+    req.out = [5, 6]
+    req.out_logp = [-0.1, -0.2]
+    req.slot = 0
+    old = SimpleNamespace(
+        pending=[], prefilling={}, running={0: req},
+        done_requests={}, done={}, prefix_cache=None, pool=None,
+        _next_rid=8,
+    )
+    new = SimpleNamespace(pending=[], _next_rid=0, metrics=None)
+    eng = SimpleNamespace(cb=old, _publish=lambda: None,
+                          _make_batcher=lambda: new)
+    sup.recover(eng)
+    assert eng.cb is new
+    survivor = new.pending[0]
+    assert survivor is req
+    assert survivor.deadline == 123.456      # NOT re-anchored
+    assert survivor.t_submit == 100.0        # the original clock
+    assert survivor.prompt == [1, 2, 3, 5, 6]  # the fold
+    assert survivor.prefilled_out == 2
+    assert survivor.restarts == 1
+    assert new._next_rid == 8
+
+
+def test_restart_survivors_count_deadline_miss_once(setup):
+    """Supervisor x scheduler interplay, integration half: requests
+    with a deadline that cannot be met crash mid-decode, resume, and
+    complete — each counts exactly ONE deadline miss (retirement-time
+    accounting; the resumed re-admission neither re-counts nor
+    re-charges), and a generous deadline across the same crash counts
+    zero."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+
+    cfg, params = setup
+    eng = _mk_engine(
+        params, cfg, scheduler=Scheduler(),
+        faults=FaultPlane.from_spec("decode.apply:nth=6"),
+        supervisor=EngineSupervisor(max_restarts=2, window_s=60.0),
+    )
+    reqs = _requests(cfg, n=4, max_new=8)
+
+    async def body():
+        subs = [
+            eng.submit(r["prompt"], r["max_new"], sampler=r["sampler"],
+                       seed=r["seed"],
+                       # 1ms: missed by construction; tenant "gold"
+                       # gets an hour (zero misses through the crash)
+                       tenant="gold" if i == 0 else None,
+                       deadline_ms=3_600_000 if i == 0 else 1)
+            for i, r in enumerate(reqs)
+        ]
+        return [await drain_queue(q) for _, q in subs]
+
+    try:
+        results = run(body())
+        sup = eng.supervisor.stats()
+        sched = eng.stats()["sched"]
+    finally:
+        eng.shutdown()
+    assert sup["restarts_total"] == 1
+    assert all(e is None and len(t) == 8 for t, _, e in results)
+    assert sched["tenants"]["default"]["deadline_misses"] == 3
+    assert sched["tenants"]["default"]["retired"] == 3
+    assert sched["tenants"]["gold"]["deadline_misses"] == 0
+    assert sched["tenants"]["gold"]["retired"] == 1
+
+
 def test_open_loop_run_counts_truncated_separately():
     """The harness satellite: open_loop_run reports requests that
     VANISHED (admitted, never retired) as ``truncated`` — a separate
