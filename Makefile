@@ -68,7 +68,7 @@ san-test:
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs bench-kernels bench-router bench-chaos
+	bench-obs bench-kernels bench-router bench-chaos bench-fleet-obs
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -167,13 +167,26 @@ bench-chaos:
 bench-obs:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.obs_bench
 
+# CPU-runnable smoke: the fleet observability plane (obs/fleet_obs.py)
+# — a miniature 2-replica fleet asserting /fleet/metrics federation
+# parses under BOTH content types (replica labels, exemplars, fleet
+# aggregates), a killed-and-resumed stream (seeded router.midstream
+# fault) yields ONE stitched Perfetto trace spanning both replicas and
+# the router with zero orphan fragments + exactly one journal resume
+# event + a router timeline whose integer-ns segments sum EXACTLY to
+# the observed wall time, two same-seed runs replay IDENTICAL journals,
+# and the disarmed timeline guard stays ~ns (one JSON line with
+# fleet_obs_* fields + timeline_guard_ns).
+bench-fleet-obs:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.fleet_obs_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
 	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-chaos clean watch
+	bench-chaos bench-fleet-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

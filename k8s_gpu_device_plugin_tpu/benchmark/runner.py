@@ -613,6 +613,11 @@ def _run_serve() -> dict:
         "chaos_fleet_promotions": r.chaos_fleet_promotions,
         "chaos_fleet_stream_deaths": r.chaos_fleet_stream_deaths,
         "chaos_fleet_bitwise_identical": r.chaos_fleet_bitwise_identical,
+        # fleet observability plane (obs/fleet_obs.py): resumed streams
+        # whose traces stitched across replica tracks (no orphans), and
+        # the p99 client-perceived resume gap off the router timelines
+        "fleet_stitched_traces": r.fleet_stitched_traces,
+        "fleet_resume_gap_ms_p99": round(r.fleet_resume_gap_ms_p99, 3),
         "fault_guard_ns": round(r.fault_guard_ns, 2),
         # live serving MFU/roofline accounting (metrics/roofline.py):
         # model-FLOPs utilization of the primary pipelined run vs the

@@ -370,7 +370,7 @@ def chaos_fleet_openloop(
             scheduler=Scheduler(max_queue=8 * n_slots),
         )
 
-    async def run(kill: bool) -> tuple[list[dict], dict]:
+    async def run(kill: bool) -> tuple[list[dict], dict, int, list]:
         import aiohttp
 
         async with inprocess_fleet(
@@ -422,10 +422,51 @@ def chaos_fleet_openloop(
                         break
                     await asyncio.sleep(0.05)
             stats = fl.router.router_stats()
-        return results, stats
+            # the PR-15 fleet observability plane, exercised on the
+            # REAL kill: every journal resume event's trace stitches
+            # across the reachable fragments (router ring + survivors —
+            # an in-process fleet shares one tracer, so the corpse's
+            # spans survive in the shared ring), and the router flight
+            # recorder prices the client-perceived resume gap
+            stitched = 0
+            gaps: list[float] = []
+            if kill:
+                events = fl.router.journal.events_payload()["events"]
+                async with aiohttp.ClientSession() as s:
+                    for e in events:
+                        if e["kind"] != "stream_resume" or not e["trace_id"]:
+                            continue
+                        async with s.get(
+                            f"{fl.base}/fleet/debug/traces/"
+                            f"{e['trace_id']}"
+                        ) as r:
+                            if r.status != 200:
+                                continue
+                            summ = (await r.json())["fleet"]
+                        if not summ["orphans"] and len(summ["tracks"]) >= 2:
+                            stitched += 1
+                rec = fl.router._recorder
+                if rec is not None:
+                    gaps = rec.resume_gap_ms()
+        return results, stats, stitched, gaps
 
-    base_results, _ = asyncio.run(run(False))
-    results, stats = asyncio.run(run(True))
+    from k8s_gpu_device_plugin_tpu.obs.trace import configure, get_tracer
+
+    # tracing ON for the fleet arm: the stitched-trace count needs
+    # trace ids on the resume events. The tracer is PROCESS-GLOBAL and
+    # the runner's _run_traced wrapper may already own it (live
+    # bench:serve root span, whole-run ring) — only flip/clear what
+    # this arm itself turned on, or the serve bench's trace artifact
+    # and every later arm's tracing die with our teardown
+    was_enabled = get_tracer().enabled
+    tracer = get_tracer() if was_enabled else configure(enabled=True)
+    try:
+        base_results, _, _, _ = asyncio.run(run(False))
+        results, stats, stitched, gaps = asyncio.run(run(True))
+    finally:
+        if not was_enabled:
+            configure(enabled=False)
+            tracer.clear()
     tally = _tally(results)
     deaths = sum(f["stream_deaths"] for f in results)
     assert tally["dropped"] == 0, f"dropped streams: {tally}"
@@ -462,6 +503,15 @@ def chaos_fleet_openloop(
     assert tally["rejected"] <= len(trace) // 2, (
         f"unbounded refusals: {tally} of {len(trace)}"
     )
+    # the observability plane saw what the clients could not: at least
+    # one resumed stream's trace stitched across replica tracks, and
+    # its router timeline priced the resume gap
+    assert stitched >= 1, (
+        f"no resumed stream's trace stitched ({stats['resumes']} resumes)"
+    )
+    assert gaps, "the flight recorder retained no resumed stream"
+    gaps.sort()
+    gap_p99 = gaps[min(len(gaps) - 1, int(round(0.99 * (len(gaps) - 1))))]
     return {
         "requests": len(trace),
         "completed": tally["completed"],
@@ -473,6 +523,8 @@ def chaos_fleet_openloop(
         "bitwise_identical": 1 if mismatched == 0 else 0,
         "failovers": stats["failovers"],
         "killed_replicas": 1,
+        "stitched_traces": stitched,
+        "resume_gap_ms_p99": round(gap_p99, 3),
     }
 
 
@@ -536,6 +588,12 @@ def chaos_ab(
         "chaos_fleet_promotions": fleet["promotions"],
         "chaos_fleet_stream_deaths": fleet["stream_deaths"],
         "chaos_fleet_bitwise_identical": fleet["bitwise_identical"],
+        # the fleet observability plane (PR 15, obs/fleet_obs.py): every
+        # resumed stream's trace stitched across replica tracks with no
+        # orphan fragments, and the router-timeline resume-gap tail —
+        # the client-perceived stall a mid-stream replica death costs
+        "fleet_stitched_traces": fleet["stitched_traces"],
+        "fleet_resume_gap_ms_p99": fleet["resume_gap_ms_p99"],
         "fault_guard_ns": round(fault_guard_ns(), 3),
     }
 

@@ -275,6 +275,14 @@ class ServeBenchResult:
     chaos_fleet_promotions: int = 0
     chaos_fleet_stream_deaths: int = 0
     chaos_fleet_bitwise_identical: int = 0
+    # the fleet observability plane (PR 15, obs/fleet_obs.py), measured
+    # on the chaos fleet arm's REAL replica kill: resumed streams whose
+    # traces stitched across replica tracks with zero orphan fragments,
+    # and the p99 router-timeline resume gap (the client-perceived
+    # stall between a mid-stream replica death and the continuation's
+    # first relayed byte)
+    fleet_stitched_traces: int = 0
+    fleet_resume_gap_ms_p99: float = 0.0
     # disarmed fault-point guard cost (ns) — "the plane is free when
     # off" as a measured number, the attribution noop-guard pattern
     fault_guard_ns: float = 0.0

@@ -14,9 +14,10 @@ import json
 import os
 
 
-def to_chrome_trace(spans: list[dict]) -> dict:
-    """Span records (Tracer.get_trace output) -> trace-event JSON dict."""
-    pid = os.getpid()
+def _span_events(spans: list[dict], pid: int) -> list[dict]:
+    """Span records -> complete events + thread_name metadata under one
+    Chrome 'process' (``pid``); components become that process's named
+    threads. Shared by the single-replica and fleet exporters."""
     components: dict[str, int] = {}
     events: list[dict] = []
     for span in spans:
@@ -53,6 +54,34 @@ def to_chrome_trace(spans: list[dict]) -> dict:
             "pid": pid,
             "tid": tid,
             "args": {"name": component},
+        })
+    return events
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Span records (Tracer.get_trace output) -> trace-event JSON dict."""
+    return {
+        "traceEvents": _span_events(spans, os.getpid()),
+        "displayTimeUnit": "ms",
+    }
+
+
+def to_fleet_chrome_trace(tracks: "list[tuple[str, list[dict]]]") -> dict:
+    """Stitched per-track span lists (obs/fleet_obs.stitch_spans) -> ONE
+    Perfetto-openable document: each track — the router, each replica —
+    renders as its own named process row (``process_name`` metadata,
+    ``pid`` = track index), with that track's components as threads
+    inside it. Timestamps are the spans' own wall-clock microseconds,
+    so rows from different replicas align on the shared clock the
+    ``traceparent`` propagation already rides."""
+    events: list[dict] = []
+    for pid, (track, spans) in enumerate(tracks, start=1):
+        events.extend(_span_events(spans, pid))
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": track},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

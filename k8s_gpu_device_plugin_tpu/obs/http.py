@@ -22,13 +22,36 @@ def route_label(request) -> str:
     return getattr(resource, "canonical", None) or "unmatched"
 
 
-def parse_trace_query(query) -> tuple["int | None", "int | None"]:
+#: telemetry READ paths: health probes, metric scrapes, trace/timeline
+#: fetches — on both serving planes and the router. A root span per
+#: read would churn the bounded finished-trace ring these endpoints
+#: (and the fleet stitcher) read, evicting the real request traces
+#: within ring-size x poll-interval seconds of steady observation.
+_OBSERVATION_PATHS = ("/v1/health", "/fleet/health", "/metrics",
+                      "/fleet/metrics", "/fleet/events")
+_OBSERVATION_PREFIXES = ("/debug/", "/fleet/debug/")
+
+
+def is_observation_path(path: str) -> bool:
+    """True for telemetry-read endpoints. The middlewares' rule: such a
+    request may JOIN a trace (incoming ``traceparent``) but never START
+    one — observing the system must not evict the observations."""
+    return path in _OBSERVATION_PATHS or any(
+        path.startswith(p) for p in _OBSERVATION_PREFIXES
+    )
+
+
+def parse_trace_query(query, since_desc: str = "start_us timestamp",
+                      ) -> tuple["int | None", "int | None"]:
     """Shared ``?limit=``/``?since=`` parsing for the trace endpoints
-    (both HTTP planes): ``limit`` caps the summary count, ``since`` (a
-    ``start_us`` microsecond timestamp) returns only traces that
-    STARTED after it — the incremental-poll idiom, so a long-running
-    server never has to ship the whole ring per poll. Raises ValueError
-    on malformed values (the planes answer 400)."""
+    (both HTTP planes) and the fleet event journal: ``limit`` caps the
+    page, ``since`` returns only entries past the cursor — the
+    incremental-poll idiom, so a long-running server never has to ship
+    the whole ring per poll. The cursor's meaning is the endpoint's
+    (``start_us`` microseconds on the trace planes, an event ``seq`` on
+    the journal) — ``since_desc`` names it in the 400 body so a caller
+    is told what to pass, not a wrong unit. Raises ValueError on
+    malformed values (the planes answer 400)."""
     limit = since = None
     raw = query.get("limit")
     if raw is not None:
@@ -45,7 +68,7 @@ def parse_trace_query(query) -> tuple["int | None", "int | None"]:
             since = int(raw)
         except ValueError:
             raise ValueError(
-                f"since must be an integer start_us timestamp, got {raw!r}"
+                f"since must be an integer {since_desc}, got {raw!r}"
             ) from None
     return limit, since
 

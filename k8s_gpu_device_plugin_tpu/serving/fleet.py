@@ -275,7 +275,14 @@ class FleetRegistry:
         ids = [r.rid for r in replicas]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate replica ids: {ids}")
-        self._replicas: dict[str, Replica] = {r.rid: r for r in replicas}
+        # the health poller and proxy-failure paths mutate the replica
+        # map's entries; handlers read it ONLY through the registry's
+        # own snapshot methods (ReplicaRouter.fleet_stats is the one
+        # health accessor) — the same ownership discipline the engine-
+        # side *_stats() snapshots follow, graftlint-pinned
+        self._replicas: dict[str, Replica] = {  # owner: engine
+            r.rid: r for r in replicas
+        }
         self.dead_after = int(dead_after)
 
     @classmethod

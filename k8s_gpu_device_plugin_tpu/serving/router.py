@@ -54,6 +54,22 @@ restart-wait → undrain sequenced across the fleet, one replica at a
 time — the weight-update maintenance cycle with zero dropped and zero
 from-scratch-retried streams.
 
+**Fleet observability plane** (obs/fleet_obs.py): the router is also
+the fleet's aggregation point — ``GET /fleet/debug/traces/{id}``
+stitches a trace's span fragments from every replica (plus the
+router's own ring, also served on ``GET /debug/traces`` with the
+shared ``?limit=``/``?since=`` surface) into ONE Perfetto document
+with a process row per replica; ``GET /fleet/metrics`` federates the
+replicas' ``/metrics`` under a ``replica`` label (OpenMetrics
+exemplars preserved) with fleet MFU/bandwidth/latency aggregates;
+``GET /fleet/events`` is the journal of every fleet operation
+(failover, cooldown, drain, promotion, stream resume, rolling-restart
+phases — deterministic under the seeded fault plane); and
+``GET /fleet/debug/requests`` serves per-stream router timelines whose
+route/relay/resume-gap segments sum EXACTLY to the client-observed
+wall time, retained for resumed/failed-over/SLO-breaching streams by
+a flight recorder.
+
 Liveness comes from polling each replica's ``/v1/health`` (the queue
 depth / kv pool pressure / sched stats the engines already export):
 ``dead_after`` consecutive failures (poll or proxy) mark a replica
@@ -110,8 +126,17 @@ from k8s_gpu_device_plugin_tpu.serving.fleet import (
     parse_retry_after,
     poll_phase,
 )
+from k8s_gpu_device_plugin_tpu.obs.fleet_obs import (
+    FleetEventJournal,
+    RouterFlightRecorder,
+    federate_metrics,
+    spans_from_chrome,
+    stitched_trace_payload,
+)
 from k8s_gpu_device_plugin_tpu.obs.trace import (
     TRACEPARENT_HEADER,
+    current_context,
+    current_trace_ids,
     format_traceparent,
     get_tracer,
     parse_traceparent,
@@ -301,6 +326,14 @@ class ReplicaRouter:
         fleet_restart_window_s: float = 300.0,  # per rolling window
         journal_limit: int = 1024,  # concurrent streams journaled for
         # resume; streams past the cap serve normally, un-resumably
+        journal_events: int = 1024,  # fleet event journal ring size
+        # (obs/fleet_obs.py; GET /fleet/events)
+        timelines: bool = True,  # router-side request timelines + the
+        # flight recorder (GET /fleet/debug/requests); False leaves the
+        # proxy hot path with is-not-None guards only
+        slow_stream_ms: float = 0.0,  # SLO-breach retention threshold
+        # for the router flight recorder (resumed/failed-over/error
+        # streams are always retained; 0 = only those)
     ):
         if policy not in ("affinity", "rr"):
             raise ValueError(
@@ -366,6 +399,16 @@ class ReplicaRouter:
         )
         self.journal_limit = int(journal_limit)
         self._journaled = 0       # streams currently carrying a journal
+        # fleet observability plane (obs/fleet_obs.py): the event
+        # journal (always on — it writes only on failure/control-plane
+        # paths, and rare kinds ride a ring request-rate failover/429
+        # noise cannot evict) and the per-stream timeline flight
+        # recorder (optional)
+        self.journal = FleetEventJournal(maxlen=journal_events)
+        self._recorder: "RouterFlightRecorder | None" = (
+            RouterFlightRecorder(slow_ms=slow_stream_ms)
+            if timelines else None
+        )
         # plain counters (always on; RouterMetrics mirrors them): the
         # serve-bench fleet A/B and /fleet/health read these
         self._requests = 0
@@ -389,6 +432,25 @@ class ReplicaRouter:
         self.app.router.add_post("/fleet/undrain/{replica}", self._undrain)
         self.app.router.add_post(
             "/fleet/rolling-restart", self._rolling_restart
+        )
+        # the fleet observability plane (obs/fleet_obs.py): the
+        # router's OWN trace ring (the third /debug/traces plane, same
+        # ?limit=/?since= surface), cross-replica stitching, federated
+        # metrics, the event journal and the stream timelines
+        self.app.router.add_get("/debug/traces", self._debug_traces)
+        self.app.router.add_get(
+            "/debug/traces/{trace_id}", self._debug_trace_one
+        )
+        self.app.router.add_get(
+            "/fleet/debug/traces/{trace_id}", self._fleet_trace_one
+        )
+        self.app.router.add_get("/fleet/metrics", self._fleet_metrics)
+        self.app.router.add_get("/fleet/events", self._fleet_events)
+        self.app.router.add_get(
+            "/fleet/debug/requests", self._fleet_requests
+        )
+        self.app.router.add_get(
+            "/fleet/debug/requests/{rid}", self._fleet_request_one
         )
         if registry is not None:
             self.app.router.add_get("/metrics", self._metrics)
@@ -531,9 +593,16 @@ class ReplicaRouter:
             self._promotions += 1
             if self.metrics is not None:
                 self.metrics.promotions.inc()
+            self.journal.emit("promote", promoted=spare.rid,
+                              replaced=rep.rid)
+            # "replica" is the log-correlation key dashboards slice on;
+            # trace_id rides in via the emit-time filter when a proxy-
+            # observed death triggered the promotion inside a request
+            # span (the poll loop has no ambient span)
             log.warning(
                 "promoted warm spare into the ring",
-                extra={"fields": {"promoted": spare.rid,
+                extra={"fields": {"replica": spare.rid,
+                                  "promoted": spare.rid,
                                   "replaced": rep.rid,
                                   "promotions": self._promotions}},
             )
@@ -546,9 +615,20 @@ class ReplicaRouter:
     async def _trace_middleware(self, request: web.Request, handler):
         if not self.tracer.enabled:
             return await handler(request)
-        from k8s_gpu_device_plugin_tpu.obs.http import route_label
+        from k8s_gpu_device_plugin_tpu.obs.http import (
+            is_observation_path,
+            route_label,
+        )
 
         remote = parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        if remote is None and is_observation_path(request.path):
+            # the replica middleware's rule, at the router seam:
+            # telemetry reads (LB health probes, federation scrapes,
+            # stitch fetches) may join a trace but never start one —
+            # root spans per observation would churn the router's own
+            # ring (the stitcher's "router" track source) out of the
+            # real request traces being observed
+            return await handler(request)
         with self.tracer.span(
             f"{request.method} {route_label(request)}",
             component="router_http",
@@ -701,20 +781,36 @@ class ReplicaRouter:
             self.metrics.requests.labels("none", code).inc()
         if path == "/v1/generate":
             # the native structured-error shape (the 429 body's sibling)
-            return web.json_response(
+            resp = web.json_response(
                 {"error": message, "code": code}, status=status
             )
-        # OpenAI envelope; 5xx reads as retryable server_error, which is
-        # what a drain IS from the client's side — retry lands post-drain
-        return web.json_response(
-            {"error": {"message": message, "type": "server_error",
-                       "code": code}},
-            status=status,
-        )
+        else:
+            # OpenAI envelope; 5xx reads as retryable server_error,
+            # which is what a drain IS from the client's side — retry
+            # lands post-drain
+            resp = web.json_response(
+                {"error": {"message": message, "type": "server_error",
+                           "code": code}},
+                status=status,
+            )
+        # the timeline outcome must tell a ROUTER refusal (this 503)
+        # apart from a relayed backend 5xx — both are >=500 by the time
+        # the flight recorder sees them
+        resp.router_refusal = code
+        return resp
 
     # --- the proxy --------------------------------------------------------
 
     async def _proxy_post(self, request: web.Request) -> web.StreamResponse:
+        # the stream timeline starts at request receipt: the segments
+        # below sum exactly to the wall time THIS seam observed — the
+        # PR-9 invariant, one tier up (obs/fleet_obs.RouterTimeline)
+        tl = None
+        if self._recorder is not None:
+            ids = current_trace_ids()
+            tl = self._recorder.start(
+                request.path, ids[0] if ids is not None else ""
+            )
         raw = await request.read()
         try:
             body = json.loads(raw) if raw else None
@@ -726,15 +822,19 @@ class ReplicaRouter:
         order, home = self._pick(key)
         if not order:
             if self.fleet.any_draining():
-                return self._refuse(
+                resp = self._refuse(
                     request.path, "draining",
                     "all replicas are draining; retry after the rolling "
                     "update completes",
                 )
-            return self._refuse(
-                request.path, "no_replica",
-                "no live replica available",
-            )
+            else:
+                resp = self._refuse(
+                    request.path, "no_replica",
+                    "no live replica available",
+                )
+            if tl is not None:
+                self._recorder.on_done(tl.finalize("refused", resp.status))
+            return resp
         self._requests += 1
         headers = self._backend_headers(request)
         # journal eligibility: native token-id SSE streams (n=1) carry
@@ -747,31 +847,85 @@ class ReplicaRouter:
                 self._journaled += 1
             else:
                 self._unjournaled += 1
+        resp = None
         try:
-            return await self._dispatch(
-                request, raw, headers, order, home, journal
+            resp = await self._dispatch(
+                request, raw, headers, order, home, journal, tl
             )
+            return resp
         finally:
             if journal is not None:
                 self._journaled -= 1
+            if tl is not None:
+                if journal is not None:
+                    tl.tokens = len(journal.tokens)
+                if resp is None:
+                    # the handler is unwinding (client disconnect /
+                    # cancellation): the wall time still closes exactly
+                    rec = tl.finalize("cancelled")
+                else:
+                    rec = tl.finalize(
+                        self._tl_outcome(tl, resp), resp.status
+                    )
+                self._recorder.on_done(rec)
+
+    @staticmethod
+    def _tl_outcome(tl, resp) -> str:
+        """Collapse a finished relay into the timeline's outcome label
+        (the flight recorder's retention key). Agrees with the
+        ``_outcome`` counter taxonomy: a relayed backend 5xx is
+        ``backend_error``; ``refused`` is reserved for the router's own
+        503s (``_refuse`` tags those)."""
+        if tl.error_code:
+            return tl.error_code    # fleet_budget_exhausted/resume_failed
+        if getattr(resp, "router_refusal", None) is not None:
+            return "refused"
+        status = resp.status
+        if status == 429:
+            return "overloaded"
+        if status >= 500:
+            return "backend_error"
+        if status >= 400:
+            return "client_error"
+        return "resumed" if tl.resumes else "ok"
 
     async def _dispatch(self, request: web.Request, raw: bytes,
                         headers: dict, order: "list[Replica]",
                         home: "Replica | None",
                         journal: "_StreamJournal | None",
+                        tl=None,
                         ) -> web.StreamResponse:
         last_429: _Overloaded | None = None
         for attempt, rep in enumerate(order):
             if attempt > 0:
                 self._failovers += 1
+                if tl is not None:
+                    tl.failovers += 1
                 if self.metrics is not None:
                     self.metrics.failovers.inc()
+                self.journal.emit(
+                    "failover", path=request.path,
+                    prev=order[attempt - 1].rid, replica=rep.rid,
+                    attempt=attempt,
+                )
             rep.inflight += 1
             if self.metrics is not None:
                 self.metrics.inflight.labels(rep.rid).set(rep.inflight)
+            if self.tracer.enabled:
+                # emit-time filter stamps trace_id/span_id (the
+                # middleware span is this task's ambient context)
+                log.debug(
+                    "request submitted to replica",
+                    extra={"fields": {
+                        "replica": rep.rid,
+                        "path": request.path,
+                        "affinity_hit": rep is home,
+                        "attempt": attempt,
+                    }},
+                )
             try:
                 resp = await self._relay(rep, request, raw, headers,
-                                         journal=journal)
+                                         journal=journal, tl=tl)
             except _Unreachable:
                 self.fleet.note_failure(rep)
                 self._maybe_promote()
@@ -780,6 +934,8 @@ class ReplicaRouter:
             except _Overloaded as e:
                 rep.cooldown_until = time.monotonic() + e.retry_after
                 self._count(rep, "overloaded")
+                self.journal.emit("cooldown_429", replica=rep.rid,
+                                  retry_after_s=e.retry_after)
                 last_429 = e
                 continue
             finally:
@@ -804,8 +960,28 @@ class ReplicaRouter:
                 # counted on the SERVING dispatch, not at plan time: a
                 # home that failed over is a miss for cache locality
                 self._affinity_hits += 1
+                if tl is not None:
+                    tl.affinity_hit = True
                 if self.metrics is not None:
                     self.metrics.affinity_hits.inc()
+            if self.tracer.enabled:
+                # the middleware span (the ambient context on this
+                # task) gains the routing decision: which replica
+                # served, whether the ring home took it, whether the
+                # resume path spliced it — the attrs a stitched trace
+                # is sliced by
+                span = current_context()
+                if span is not None and hasattr(span, "set"):
+                    # resumed means a live replica FINISHED the splice;
+                    # final=None (error frame / synthesized done) must
+                    # not read as a successful resume, and the replica
+                    # attr then names the last replica that relayed
+                    span.set(
+                        replica=(final.rid if final is not None
+                                 else rep.rid),
+                        affinity_hit=rep is home,
+                        resumed=(final is not None and final is not rep),
+                    )
             return resp
         if last_429 is not None:
             # every candidate said "not now": deliver the backend's own
@@ -896,11 +1072,18 @@ class ReplicaRouter:
                     frame, buf = buf.split(b"\n\n", 1)
                     await self._client_write(out, frame + b"\n\n")
                     self._observe_frame(journal, frame)
-                if self._flt_midstream is not None and not journal.closed:
-                    try:
-                        self._flt_midstream.fire()
-                    except FaultError:
-                        raise _BackendLost() from None
+                    # the fault advances per FRAME, not per network
+                    # chunk: TCP coalescing groups frames differently
+                    # run to run, and an nth=N schedule counted in
+                    # chunks would journal a different tokens_at_death
+                    # each time — the journal's replay-determinism
+                    # contract (obs/fleet_obs.py) pins frame counting
+                    if self._flt_midstream is not None \
+                            and not journal.closed:
+                        try:
+                            self._flt_midstream.fire()
+                        except FaultError:
+                            raise _BackendLost() from None
         except (aiohttp.ClientError, asyncio.TimeoutError,
                 ConnectionResetError, OSError) as e:
             if journal.closed:
@@ -947,7 +1130,7 @@ class ReplicaRouter:
     async def _resume_stream(self, dead: Replica, request: web.Request,
                              out: web.StreamResponse,
                              journal: _StreamJournal,
-                             headers: dict) -> "Replica | None":
+                             headers: dict, tl=None) -> "Replica | None":
         """The fleet tier's recovery guarantee: a replica died under a
         journaled stream — resubmit the request through the native
         resume seam (emitted tokens folded into the prompt;
@@ -966,6 +1149,7 @@ class ReplicaRouter:
         except (TypeError, ValueError):
             max_new = 0
         while True:
+            tokens_at_death = len(journal.tokens)
             self.fleet.note_failure(dead)
             # the dead replica's relay gets its outcome recorded (once
             # per death observation — chained deaths re-enter here with
@@ -975,6 +1159,10 @@ class ReplicaRouter:
             self._maybe_promote()
             if not self._fleet_budget.charge(dead):
                 self._resume_failures += 1
+                self.journal.emit("budget_exhausted", replica=dead.rid,
+                                  tokens_at_death=tokens_at_death)
+                if tl is not None:
+                    tl.error_code = "fleet_budget_exhausted"
                 log.warning(
                     "mid-stream replica death past the fleet restart "
                     "budget; ending stream with an error frame",
@@ -997,8 +1185,14 @@ class ReplicaRouter:
                 # tokenizer — the token/logprob stream itself is
                 # complete and exact).
                 self._resumes += 1
+                if tl is not None:
+                    tl.resumes += 1
                 if self.metrics is not None:
                     self.metrics.stream_resumes.inc()
+                self.journal.emit(
+                    "stream_resume", source=dead.rid, target=None,
+                    tokens_at_death=tokens_at_death, synthesized_done=True,
+                )
                 try:
                     await self._client_write(out, b'data: {"done": true}\n\n')
                 except _ClientGone:
@@ -1029,6 +1223,8 @@ class ReplicaRouter:
                     break
                 for rep in usable:
                     self._failovers += 1
+                    if tl is not None:
+                        tl.failovers += 1
                     if self.metrics is not None:
                         self.metrics.failovers.inc()
                     try:
@@ -1078,6 +1274,10 @@ class ReplicaRouter:
                                         1.0))
             if resp is None:
                 self._resume_failures += 1
+                self.journal.emit("resume_failed", replica=dead.rid,
+                                  tokens_at_death=tokens_at_death)
+                if tl is not None:
+                    tl.error_code = "resume_failed"
                 await self._error_frame(
                     out, "resume_failed",
                     f"replica {dead.rid!r} died mid-stream and no "
@@ -1086,6 +1286,14 @@ class ReplicaRouter:
                 )
                 return None
             self._count_resume(dead, target)
+            self.journal.emit("stream_resume", source=dead.rid,
+                              target=target.rid,
+                              tokens_at_death=tokens_at_death)
+            if tl is not None:
+                tl.resumes += 1
+                # the resume gap closes here: the continuation's bytes
+                # are about to flow from the new replica
+                tl.relay_on(target.rid)
             target.inflight += 1
             if self.metrics is not None:
                 self.metrics.inflight.labels(target.rid).set(target.inflight)
@@ -1096,6 +1304,8 @@ class ReplicaRouter:
                 # and loop — the journal kept growing, so the next
                 # resume starts exactly where this one ended
                 resp.close()
+                if tl is not None:
+                    tl.advance("resume_gap")
                 dead = target
                 continue
             except _ClientGone:
@@ -1121,15 +1331,21 @@ class ReplicaRouter:
         self._resumes += 1
         if self.metrics is not None:
             self.metrics.stream_resumes.inc()
+        # "replica" = the continuation's server (the correlation key);
+        # trace_id rides in via the emit-time filter — the resume runs
+        # inside the dying relay's handler task, whose ambient span is
+        # still the middleware's
         log.warning(
             "resumed mid-stream after replica death",
-            extra={"fields": {"dead": dead.rid, "resumed_on": target.rid,
+            extra={"fields": {"replica": target.rid, "dead": dead.rid,
+                              "resumed_on": target.rid,
                               "resumes": self._resumes}},
         )
 
     async def _relay(self, rep: Replica, request: web.Request,
                      raw: bytes, headers: dict,
                      journal: "_StreamJournal | None" = None,
+                     tl=None,
                      ) -> web.StreamResponse:
         """One dispatch attempt: forward the body verbatim, relay the
         response (SSE streamed frame-by-frame, JSON in one piece).
@@ -1157,6 +1373,10 @@ class ReplicaRouter:
                     .split(";")[0],
                 )
             ctype = resp.headers.get("Content-Type", "")
+            if tl is not None:
+                # headers arrived and the status is an answer (not a
+                # 429 hop): the candidate scan ends, relay bytes flow
+                tl.relay_on(rep.rid)
             if ctype.startswith("text/event-stream"):
                 out = web.StreamResponse(headers={
                     "Content-Type": "text/event-stream",
@@ -1171,8 +1391,12 @@ class ReplicaRouter:
                     await self._pump_sse(resp, out, journal)
                 except _BackendLost:
                     resp.close()
+                    if tl is not None:
+                        # the resume gap opens at the observed death
+                        # and closes when a continuation's relay starts
+                        tl.advance("resume_gap")
                     out.router_final_rep = await self._resume_stream(
-                        rep, request, out, journal, headers
+                        rep, request, out, journal, headers, tl=tl
                     )
                     try:
                         await out.write_eof()
@@ -1253,29 +1477,48 @@ class ReplicaRouter:
             "fleet_budget": self._fleet_budget.stats(),
             "refused": dict(self._refused),
             "outcomes": dict(self._outcomes),
+            "journal": self.journal.stats(),
+            "timelines": (
+                self._recorder.stats() if self._recorder is not None
+                else None
+            ),
         }
+
+    def fleet_stats(self, include_router: bool = True) -> dict:
+        """THE fleet-state snapshot: per-replica state, fleet tallies,
+        the admitting count and (by default) the router's own counters,
+        built in one pass. Both health handlers read through this
+        single accessor — the thread-ownership discipline the
+        engine-side ``*_stats()`` snapshots follow (and graftlint
+        pins): handlers never recompute per-replica state inline from
+        registry objects the health poller mutates.
+        ``include_router=False`` skips the router-counter block (dict
+        copies, budget/journal/recorder stats) for the LB liveness
+        probe, which only reads the snapshot tallies."""
+        snap = self.fleet.snapshot()
+        snap["admitting"] = sum(
+            1 for r in self.fleet.active() if r.alive and not r.draining
+        )
+        if include_router:
+            snap["router"] = self.router_stats()
+        return snap
 
     async def _health(self, request: web.Request) -> web.Response:
         """The router's own liveness (LB probes): up as long as at
         least one replica can ADMIT (alive and not draining) — a fleet
         mid-rolling-drain that refuses every submit must fail the
         probe, not smile at it."""
-        snap = self.fleet.snapshot()
-        admitting = sum(
-            1 for r in self.fleet.active() if r.alive and not r.draining
-        )
+        snap = self.fleet_stats(include_router=False)
         return web.json_response(
-            {"router": True, "alive": admitting > 0,
+            {"router": True, "alive": snap["admitting"] > 0,
              "policy": self.policy,
              "replicas": snap["total"], "live": snap["live"],
-             "admitting": admitting, "draining": snap["draining"]},
-            status=200 if admitting else 503,
+             "admitting": snap["admitting"], "draining": snap["draining"]},
+            status=200 if snap["admitting"] else 503,
         )
 
     async def _fleet_health(self, request: web.Request) -> web.Response:
-        snap = self.fleet.snapshot()
-        snap["router"] = self.router_stats()
-        return web.json_response(snap)
+        return web.json_response(self.fleet_stats())
 
     async def _drain_wait(self, rep: Replica) -> dict:
         """The drain wait shared by POST /fleet/drain and the rolling
@@ -1318,8 +1561,11 @@ class ReplicaRouter:
                 status=404,
             )
         rep.draining = True
+        self.journal.emit("drain", replica=rid)
         log.info("draining replica", extra={"fields": {"replica": rid}})
         res = await self._drain_wait(rep)
+        self.journal.emit("drain_done", replica=rid,
+                          drained=res["drained"])
         return web.json_response(
             {"replica": rid, "draining": True, **res},
             status=200 if res["drained"] else 504,
@@ -1374,10 +1620,13 @@ class ReplicaRouter:
             extra={"fields": {"replicas": [r.rid for r in targets],
                               "wait_restart_s": wait_restart_s}},
         )
+        self.journal.emit("rolling_restart",
+                          replicas=[r.rid for r in targets])
         results: dict = {}
         completed = True
         for rep in targets:
             rep.draining = True
+            self.journal.emit("rolling_drain", replica=rep.rid)
             res = await self._drain_wait(rep)
             if res["drained"] and wait_restart_s > 0:
                 res["restarted"] = await self._wait_restart(
@@ -1385,8 +1634,11 @@ class ReplicaRouter:
                 )
                 completed = completed and res["restarted"]
             rep.draining = False
+            self.journal.emit("rolling_undrain", replica=rep.rid,
+                              drained=res["drained"])
             results[rep.rid] = res
             completed = completed and res["drained"]
+        self.journal.emit("rolling_restart_done", completed=completed)
         return web.json_response(
             {"replicas": results, "completed": completed},
             status=200 if completed else 504,
@@ -1402,6 +1654,7 @@ class ReplicaRouter:
                 status=404,
             )
         rep.draining = False
+        self.journal.emit("undrain", replica=rid)
         log.info("undrained replica", extra={"fields": {"replica": rid}})
         return web.json_response(
             {"replica": rid, "draining": False}
@@ -1413,6 +1666,191 @@ class ReplicaRouter:
         return web.Response(
             body=generate_latest(self.registry), content_type="text/plain"
         )
+
+    # --- the fleet observability plane (obs/fleet_obs.py) ----------------
+
+    async def _fan_out_get(
+        self, path: str, headers: "dict | None" = None
+    ) -> "list[tuple[str, int | None, str | None]]":
+        """Concurrently GET ``path`` from every registered replica ->
+        ``[(rid, status, body_text)]`` in registry order. ``status``
+        None = network failure (timeout/refused). Concurrency is the
+        point: a fleet with several dead replicas must cost ONE
+        connect timeout per pass, not their sum — a sequential scrape
+        would blow a Prometheus scrape deadline on the survivors'
+        behalf."""
+
+        async def one(rep: Replica):
+            try:
+                async with self._session.get(
+                    f"{rep.url}{path}", headers=headers or {},
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.connect_timeout_s
+                    ),
+                ) as resp:
+                    return rep.rid, resp.status, await resp.text()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return rep.rid, None, None
+
+        return list(await asyncio.gather(
+            *(one(rep) for rep in self.fleet.all())
+        ))
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """The router's OWN trace ring — the third ``/debug/traces``
+        plane, accepting the same ``?limit=``/``?since=`` query surface
+        as the daemon's and the replicas' (shared
+        ``obs/http.parse_trace_query``; 400 on garbage, like them)."""
+        from k8s_gpu_device_plugin_tpu.obs.http import (
+            parse_trace_query,
+            traces_payload,
+        )
+
+        try:
+            limit, since = parse_trace_query(request.query)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            traces_payload(self.tracer, limit=limit, since_us=since)
+        )
+
+    async def _debug_trace_one(self, request: web.Request) -> web.Response:
+        from k8s_gpu_device_plugin_tpu.obs.http import trace_detail_payload
+
+        payload = trace_detail_payload(
+            self.tracer, request.match_info["trace_id"]
+        )
+        if payload is None:
+            return web.json_response({"error": "trace not in buffer"},
+                                     status=404)
+        return web.json_response(payload)
+
+    async def _fleet_trace_one(self, request: web.Request) -> web.Response:
+        """``GET /fleet/debug/traces/{id}``: pull the trace's span
+        fragments from every replica's ``/debug/traces/{id}`` plus the
+        router's own ring and stitch them into ONE Perfetto document —
+        one process row per replica, the merge summary (per-track span
+        counts, orphan fragments, unreachable replicas) under the
+        ``fleet`` key."""
+        tid = request.match_info["trace_id"]
+        fragments: list = []
+        own = self.tracer.get_trace(tid)
+        if own is not None:
+            fragments.append(("router", own))
+        unreachable: list[str] = []
+        for rid, status, text in await self._fan_out_get(
+            f"/debug/traces/{tid}"
+        ):
+            if status is None:
+                # a dead replica's fragments died with it: the stitch
+                # reports the hole instead of failing the whole fetch
+                unreachable.append(rid)
+                continue
+            if status == 404:
+                continue  # that replica never saw the trace
+            if status != 200:
+                # an ERRORING replica (500 behind a live socket, a 400)
+                # is a hole in the stitch like a dead one — reported,
+                # never a silently narrowed trace
+                unreachable.append(rid)
+                continue
+            try:
+                payload = json.loads(text)
+            except ValueError:
+                unreachable.append(rid)
+                continue
+            fragments.append((rid, spans_from_chrome(payload)))
+        stitched = stitched_trace_payload(fragments)
+        if stitched is None:
+            return web.json_response(
+                {"error": "trace not in any replica's buffer",
+                 "unreachable": unreachable},
+                status=404,
+            )
+        stitched["fleet"]["unreachable"] = unreachable
+        return web.json_response(stitched)
+
+    async def _fleet_metrics(self, request: web.Request) -> web.Response:
+        """``GET /fleet/metrics``: scrape every replica's ``/metrics``,
+        re-label each series with ``replica="<id>"``, and append the
+        fleet aggregates. Content negotiation forwards: an OpenMetrics
+        scraper gets OpenMetrics from the replicas (exemplars intact)
+        and back out; everyone else gets the classic text format."""
+        openmetrics = "application/openmetrics-text" in request.headers.get(
+            "Accept", ""
+        )
+        headers = (
+            {"Accept": "application/openmetrics-text; version=1.0.0"}
+            if openmetrics else {}
+        )
+        scrapes: list = []
+        errors: list[str] = []
+        for rid, status, text in await self._fan_out_get(
+            "/metrics", headers=headers
+        ):
+            if status != 200:
+                errors.append(rid)
+                continue
+            scrapes.append((rid, text))
+        body = federate_metrics(scrapes, openmetrics=openmetrics,
+                                scrape_errors=errors)
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                CONTENT_TYPE_LATEST,
+            )
+
+            return web.Response(
+                text=body, headers={"Content-Type": CONTENT_TYPE_LATEST}
+            )
+        return web.Response(text=body, content_type="text/plain")
+
+    async def _fleet_events(self, request: web.Request) -> web.Response:
+        """``GET /fleet/events``: the journal, oldest-first; ``?since=``
+        (a seq) + ``?limit=`` page it forward through the same
+        parse_trace_query surface as the trace planes (400 on
+        garbage)."""
+        from k8s_gpu_device_plugin_tpu.obs.http import parse_trace_query
+
+        try:
+            limit, since = parse_trace_query(
+                request.query, since_desc="event seq"
+            )
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            self.journal.events_payload(limit=limit, since=since)
+        )
+
+    async def _fleet_requests(self, request: web.Request) -> web.Response:
+        if self._recorder is None:
+            return web.json_response(
+                {"error": "router timelines disabled (start without "
+                          "--timelinesOff)"},
+                status=404,
+            )
+        return web.json_response(self._recorder.request_stats())
+
+    async def _fleet_request_one(
+        self, request: web.Request
+    ) -> web.Response:
+        if self._recorder is None:
+            return web.json_response(
+                {"error": "router timelines disabled (start without "
+                          "--timelinesOff)"},
+                status=404,
+            )
+        try:
+            rid = int(request.match_info["rid"])
+        except ValueError:
+            return web.json_response(
+                {"error": "rid must be an integer"}, status=400
+            )
+        record = self._recorder.get(rid)
+        if record is None:
+            return web.json_response(
+                {"error": "request not in the timeline buffer"}, status=404
+            )
+        return web.json_response(record)
 
 
 def _main(argv: list[str] | None = None) -> int:
@@ -1491,7 +1929,25 @@ def _main(argv: list[str] | None = None) -> int:
                         "disarmed")
     parser.add_argument("--tracing", action="store_true",
                         help="span tracing: router spans propagate to "
-                        "the replicas via traceparent")
+                        "the replicas via traceparent; the router's own "
+                        "ring serves GET /debug/traces and feeds the "
+                        "stitched GET /fleet/debug/traces/{id}")
+    parser.add_argument("--journalEvents", type=int, default=1024,
+                        help="fleet event journal ring size (GET "
+                        "/fleet/events: failover, 429 cooldown, drain/"
+                        "undrain, warm-spare promotion, stream resume, "
+                        "rolling-restart phases, budget exhaustion)")
+    parser.add_argument("--timelinesOff", action="store_true",
+                        help="disable router-side request timelines + "
+                        "the flight recorder (GET /fleet/debug/"
+                        "requests): the proxy hot path then pays only "
+                        "is-not-None guards")
+    parser.add_argument("--slowStreamMs", type=float, default=0.0,
+                        help="flight-recorder SLO threshold: streams "
+                        "whose router wall time reaches this are "
+                        "retained alongside the always-retained "
+                        "resumed/failed-over/error streams (0 = only "
+                        "those)")
     args = parser.parse_args(argv)
 
     if args.tracing:
@@ -1530,6 +1986,9 @@ def _main(argv: list[str] | None = None) -> int:
         warm_spares=args.warmSpares,
         fleet_restart_budget=args.fleetRestartBudget,
         fleet_restart_window_s=args.fleetRestartWindowS,
+        journal_events=args.journalEvents,
+        timelines=not args.timelinesOff,
+        slow_stream_ms=args.slowStreamMs,
         registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
         faults=fault_plane,
     )

@@ -776,6 +776,7 @@ class InferenceServer:
         # the reported id either way, so a mismatch is visible, not
         # silent)
         self.replica_id = replica_id
+        self._replica_label: str | None = None
         self._t_start = time.monotonic()
         # Optional serving/embeddings.Embedder: enables /v1/embeddings
         self.embedder = embedder
@@ -833,24 +834,59 @@ class InferenceServer:
                 f"{list(self.adapter_names) or '(none)'}"
             ) from None
 
+    def replica_label(self) -> str:
+        """This replica's stable fleet identity (``--replicaId``, or
+        hostname:port): the id /v1/health reports, the router's
+        registry keys on, and — stamped on every ``serving_http`` span
+        — the attribute the fleet trace stitcher assigns a span's
+        whole subtree to a replica track by. Cached once the ephemeral
+        port is bound (the middleware calls this per traced request;
+        gethostname() per request would tax the hot path)."""
+        if self._replica_label is not None:
+            return self._replica_label
+        label = self.replica_id or (
+            f"{socket.gethostname()}:{self.bound_port or self.port}"
+        )
+        if self.replica_id or self.bound_port is not None:
+            self._replica_label = label  # stable from here on
+        return label
+
     @web.middleware
     async def _trace_middleware(self, request: web.Request, handler):
         """Per-request span (component ``serving_http``), joining the
         caller's W3C ``traceparent`` and echoing one back. The span is
         the ambient parent for everything the handler does on this task
         — including ``engine.submit``, which carries it across the
-        engine-thread hop to the batcher's request tree."""
+        engine-thread hop to the batcher's request tree. The
+        ``replica`` attribute anchors the span's subtree to this
+        replica's track when the router stitches the trace fleet-wide
+        (obs/fleet_obs.py — an in-process test fleet shares ONE global
+        tracer, so the fragment's origin cannot identify the serving
+        replica; this attribute can)."""
         if not self.tracer.enabled:
             return await handler(request)
-        from k8s_gpu_device_plugin_tpu.obs.http import route_label
+        from k8s_gpu_device_plugin_tpu.obs.http import (
+            is_observation_path,
+            route_label,
+        )
 
         remote = parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        if remote is None and is_observation_path(request.path):
+            # telemetry reads — health probes, /metrics scrapes, trace
+            # fetches (the router's stitcher included) — may JOIN a
+            # trace (traceparent present) but never START one: the
+            # router polls every replica each --healthIntervalS and a
+            # root span per probe/scrape floods the bounded finished-
+            # trace ring, evicting the real request traces the fleet
+            # stitcher fetches within ring_size x interval seconds
+            return await handler(request)
         # canonical route in the span NAME (it becomes a histogram label
         # — raw paths would be unbounded); raw path as an attribute
         with self.tracer.span(
             f"{request.method} {route_label(request)}",
             component="serving_http",
             parent=remote, method=request.method, path=request.path,
+            replica=self.replica_label(),
         ) as span:
             try:
                 response = await handler(request)
@@ -946,9 +982,7 @@ class InferenceServer:
         # dashboard aggregating N replicas) needs to tell replicas
         # apart and spot restarts (uptime_s resetting = a new process
         # behind the same address); schema pinned in tests/test_health.py
-        stats["replica_id"] = self.replica_id or (
-            f"{socket.gethostname()}:{self.bound_port or self.port}"
-        )
+        stats["replica_id"] = self.replica_label()
         stats["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         # a dead engine must fail the readiness probe, not smile at it
         return web.json_response(stats, status=200 if stats["alive"] else 503)

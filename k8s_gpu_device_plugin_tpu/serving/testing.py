@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import json
+import time
 
 from k8s_gpu_device_plugin_tpu.serving.fleet import FleetRegistry
 from k8s_gpu_device_plugin_tpu.serving.router import ReplicaRouter
@@ -31,6 +33,65 @@ from k8s_gpu_device_plugin_tpu.serving.server import (
     InferenceEngine,
     InferenceServer,
 )
+
+
+def per_replica_registry_factories(
+    params, cfg, *, n_slots: int = 2, max_len: int = 64,
+    chunked_prefill: int = 8,
+):
+    """``(engine_factory, server_factory)`` giving every replica its
+    OWN prometheus ``CollectorRegistry`` (and the ServingMetrics bound
+    to it): ``/fleet/metrics`` federation needs N independently
+    scrapable replicas, and shared collector names would collide in one
+    process. The one copy tests/test_fleet_obs.py and the
+    ``make bench-fleet-obs`` smoke both drive their fleets through."""
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+    from prometheus_client import CollectorRegistry
+
+    def engine_factory(i: int) -> InferenceEngine:
+        return InferenceEngine(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            chunked_prefill=chunked_prefill,
+            metrics=ServingMetrics(registry=CollectorRegistry()),
+        )
+
+    def server_factory(i: int, engine: InferenceEngine) -> InferenceServer:
+        return InferenceServer(
+            engine, host="127.0.0.1", port=0, replica_id=f"r{i}",
+            registry=engine.cb.metrics._registry,
+        )
+
+    return engine_factory, server_factory
+
+
+async def stream_generate(session, base: str, *, prompt, max_new: int,
+                          logprobs: bool = True) -> dict:
+    """One streamed ``/v1/generate`` through ``base`` (a router or a
+    replica), drained frame by frame the way the fleet tests/benches
+    all do; returns ``{"tokens", "done", "wall_s"}`` with the
+    client-observed wall time."""
+    t0 = time.perf_counter()
+    toks: list[int] = []
+    done = False
+    async with session.post(
+        f"{base}/v1/generate",
+        json={"prompt": prompt, "max_new": max_new, "stream": True,
+              "logprobs": logprobs},
+    ) as r:
+        assert r.status == 200, await r.text()
+        async for line in r.content:
+            text = line.decode().strip()
+            if not text.startswith("data: "):
+                continue
+            evt = json.loads(text[len("data: "):])
+            if "token" in evt:
+                toks.append(int(evt["token"]))
+            if evt.get("done"):
+                done = True
+    return {"tokens": toks, "done": done,
+            "wall_s": time.perf_counter() - t0}
 
 
 async def _wait_bound(obj, task) -> None:
@@ -87,6 +148,13 @@ async def inprocess_fleet(
     engine_kw: dict | None = None,
     engine_factory=None,   # (i) -> InferenceEngine; overrides engine_kw
     router_kw: dict | None = None,
+    server_kw: dict | None = None,   # extra InferenceServer kwargs
+    server_factory=None,   # (i, engine) -> InferenceServer; overrides
+    # server_kw. Keep host="127.0.0.1", port=0, replica_id=f"r{i}" (the
+    # registry below keys on those) — the hook exists for per-replica
+    # state the shared kwargs cannot express, e.g. one prometheus
+    # CollectorRegistry PER replica so /fleet/metrics federation is
+    # testable in one process without collector-name collisions
 ):
     ctx = InprocessFleet()
     rstop = asyncio.Event()
@@ -97,9 +165,13 @@ async def inprocess_fleet(
                 engine = engine_factory(i)
             else:
                 engine = InferenceEngine(params, cfg, **(engine_kw or {}))
-            server = InferenceServer(
-                engine, host="127.0.0.1", port=0, replica_id=f"r{i}"
-            )
+            if server_factory is not None:
+                server = server_factory(i, engine)
+            else:
+                server = InferenceServer(
+                    engine, host="127.0.0.1", port=0, replica_id=f"r{i}",
+                    **(server_kw or {}),
+                )
             stop = asyncio.Event()
             task = asyncio.create_task(server.run(stop))
             ctx.stops.append(stop)

@@ -48,12 +48,27 @@ class Supervisor:
         return {"restarts": len(list(self._restart_times))}
 
 
+class FleetRegistry:
+    """The serving/fleet.py shape: the replica map is mutated by the
+    health poller and the proxy-failure paths; handlers must read it
+    through the fleet_stats() snapshot accessor, never recompute
+    per-replica state inline."""
+
+    def __init__(self):
+        self._replicas = {}  # owner: engine
+
+    def fleet_stats(self):
+        return {"replicas": {k: dict(v) for k, v in
+                             list(self._replicas.items())}}
+
+
 class Server:
-    def __init__(self, cb, sched, rec, sup):
+    def __init__(self, cb, sched, rec, sup, fleet):
         self.cb = cb
         self.sched = sched
         self.rec = rec
         self.sup = sup
+        self.fleet = fleet
 
     async def health(self, request):
         return {
@@ -63,6 +78,14 @@ class Server:
             "tenants": dict(self.sched._tenants),     # BAD: ledger copy races
             "restarts": len(self.sup._restart_times),  # OK: atomic len
             "crash": self.sup._last_crash,            # BAD: ledger read
+        }
+
+    async def fleet_health(self, request):
+        # BAD: recomputing per-replica state inline while the poller
+        # mutates the registry (the PR-15 /fleet/health fix's shape)
+        return {
+            "alive": [r for r in self.fleet._replicas.values()],
+            "total": len(self.fleet._replicas),  # OK: atomic len
         }
 
     async def slow(self, request):

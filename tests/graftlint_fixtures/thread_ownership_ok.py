@@ -58,12 +58,26 @@ class Supervisor:
         }
 
 
+class FleetRegistry:
+    """The serving/fleet.py shape: handlers cross into the replica map
+    only through the single fleet_stats() snapshot accessor."""
+
+    def __init__(self):
+        self._replicas = {}  # owner: engine
+
+    def fleet_stats(self):
+        # snapshot accessor: list() before iterating, plain copies out
+        return {"replicas": {k: dict(v) for k, v in
+                             list(self._replicas.items())}}
+
+
 class Server:
-    def __init__(self, cb, sched, rec, sup):
+    def __init__(self, cb, sched, rec, sup, fleet):
         self.cb = cb
         self.sched = sched
         self.rec = rec
         self.sup = sup
+        self.fleet = fleet
 
     async def health(self, request):
         return {
@@ -72,6 +86,11 @@ class Server:
             "sched": self.sched.sched_stats(),  # ditto for the scheduler
             "supervisor": self.sup.stats(),  # ditto for the supervisor
         }
+
+    async def fleet_health(self, request):
+        # the PR-15 discipline: ONE snapshot accessor for the whole
+        # fleet-health surface, no inline per-replica recomputation
+        return self.fleet.fleet_stats()
 
     async def slow(self, request):
         return self.rec.slow_stats()  # the flight-recorder boundary

@@ -1,0 +1,702 @@
+"""Fleet observability plane (obs/fleet_obs.py + serving/router.py):
+cross-replica trace stitching, federated metrics, the fleet event
+journal, and router-side request timelines that survive failover.
+
+Unit tests cover the pure pieces (relabeling, stitching, journal
+paging, the integer-ns timeline invariant); the integration tests run
+real 2-replica in-process fleets (serving/testing.py) and pin the HTTP
+contract — including the two PR-15 acceptance pins: journal ordering +
+determinism under a seeded ``router.midstream`` fault, and a stitched
+trace where every span lands in exactly one replica track with no
+orphan fragments."""
+
+import asyncio
+import logging
+
+import aiohttp
+import jax
+import pytest
+from prometheus_client import CollectorRegistry
+
+from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.obs.fleet_obs import (
+    FleetEventJournal,
+    RouterFlightRecorder,
+    RouterTimeline,
+    federate_metrics,
+    spans_from_chrome,
+    stitch_spans,
+    stitched_trace_payload,
+)
+from k8s_gpu_device_plugin_tpu.obs.trace import configure
+from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+from k8s_gpu_device_plugin_tpu.serving.testing import (
+    inprocess_fleet,
+    per_replica_registry_factories,
+    stream_generate,
+)
+from k8s_gpu_device_plugin_tpu.utils.log import get_logger
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture()
+def tracer():
+    t = configure(enabled=True)
+    t.clear()
+    yield t
+    configure(enabled=False)
+    t.clear()
+
+
+# --- federation (pure text transforms) -------------------------------------
+
+
+def test_relabel_inserts_replica_label_and_keeps_exemplars():
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.observe_ttft(0.05, "trace-abc")
+    m.on_finish("eos")
+    from prometheus_client.openmetrics.exposition import generate_latest
+
+    text = generate_latest(reg).decode()
+    merged = federate_metrics([("r0", text)], openmetrics=True)
+    # every sample line carries replica="r0" and exemplars survive
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families,
+    )
+
+    fams = {f.name: f for f in text_string_to_metric_families(merged)}
+    ttft = fams["tpu_serving_ttft_seconds"]
+    for s in ttft.samples:
+        assert s.labels.get("replica") == "r0"
+    exemplars = [s.exemplar for s in ttft.samples if s.exemplar]
+    assert exemplars and exemplars[0].labels["trace_id"] == "trace-abc"
+    # the label-bearing series keep their ORIGINAL labels too
+    fin = fams["tpu_serving_requests_finished"]
+    assert any(
+        s.labels.get("reason") == "eos" and s.labels.get("replica") == "r0"
+        for s in fin.samples
+    )
+
+
+def test_federate_escapes_gnarly_replica_ids():
+    text = "# TYPE x gauge\nx 1.0\n"
+    merged = federate_metrics([('we"ird\\id', text)])
+    from prometheus_client.parser import text_string_to_metric_families
+
+    fams = list(text_string_to_metric_families(merged))
+    sample = next(s for f in fams if f.name == "x" for s in f.samples)
+    assert sample.labels["replica"] == 'we"ird\\id'
+
+
+def test_federate_aggregates_weighted_mfu_and_summed_histograms():
+    def scrape(mfu, bw, tps, ttft_obs):
+        reg = CollectorRegistry()
+        m = ServingMetrics(registry=reg)
+        m.set_mfu(mfu, bw)
+        m.tokens_per_second.set(tps)
+        for x in ttft_obs:
+            m.observe_ttft(x)
+        from prometheus_client import generate_latest
+
+        return generate_latest(reg).decode()
+
+    merged = federate_metrics([
+        ("r0", scrape(40.0, 8.0, 100.0, [0.05, 0.2])),
+        ("r1", scrape(20.0, 4.0, 50.0, [0.05])),
+    ])
+    from prometheus_client.parser import text_string_to_metric_families
+
+    fams = {f.name: f for f in text_string_to_metric_families(merged)}
+    # busy-window weighting: (40*100 + 20*50) / 150
+    assert fams["tpu_fleet_mfu_pct"].samples[0].value == pytest.approx(
+        100.0 / 3.0
+    )
+    assert fams["tpu_fleet_hbm_bw_util_pct"].samples[0].value == \
+        pytest.approx(20.0 / 3.0)
+    ttft = fams["tpu_fleet_ttft_seconds"]
+    count = next(s for s in ttft.samples if s.name.endswith("_count"))
+    total = next(s for s in ttft.samples if s.name.endswith("_sum"))
+    assert count.value == 3
+    assert total.value == pytest.approx(0.3)
+    # bucket-wise: every per-replica bucket ladder entry summed
+    inf_bucket = next(
+        s for s in ttft.samples
+        if s.name.endswith("_bucket") and s.labels["le"] == "+Inf"
+    )
+    assert inf_bucket.value == 3
+    assert fams["tpu_fleet_replicas"].samples[0].value == 2
+
+
+def test_federate_idle_fleet_reports_zero_not_nan():
+    def idle_scrape():
+        reg = CollectorRegistry()
+        ServingMetrics(registry=reg)
+        from prometheus_client import generate_latest
+
+        return generate_latest(reg).decode()
+
+    merged = federate_metrics([("r0", idle_scrape())])
+    from prometheus_client.parser import text_string_to_metric_families
+
+    fams = {f.name: f for f in text_string_to_metric_families(merged)}
+    assert fams["tpu_fleet_mfu_pct"].samples[0].value == 0.0
+
+
+# --- stitching (pure) ------------------------------------------------------
+
+
+def _span(sid, parent, component="serving", replica=None, trace="t" * 32,
+          start=0, dur=5):
+    attrs = {}
+    if replica is not None:
+        attrs["replica"] = replica
+    return {
+        "name": f"s{sid}", "component": component, "trace_id": trace,
+        "span_id": sid, "parent_id": parent, "start_us": start,
+        "dur_us": dur, "status": "ok", "thread": "", "attrs": attrs,
+    }
+
+
+def test_stitch_assigns_subtrees_and_dedups():
+    router_root = _span("a1", None, component="router_http")
+    r0_http = _span("b1", "a1", component="serving_http", replica="r0")
+    r0_child = _span("b2", "b1")            # inherits r0 via parent chain
+    r1_http = _span("c1", "a1", component="serving_http", replica="r1")
+    r1_child = _span("c2", "c1")
+    all_spans = [router_root, r0_http, r0_child, r1_http, r1_child]
+    # every source returns every span (the shared in-process tracer)
+    tracks, summary = stitch_spans([
+        ("router", list(all_spans)),
+        ("r0", list(all_spans)),
+        ("r1", list(all_spans)),
+    ])
+    assert summary["n_spans"] == 5
+    assert summary["deduped"] == 10
+    assert summary["dropped"] == 0
+    assert summary["orphans"] == []
+    by_track = dict(tracks)
+    assert [s["span_id"] for s in by_track["router"]] == ["a1"]
+    assert {s["span_id"] for s in by_track["r0"]} == {"b1", "b2"}
+    assert {s["span_id"] for s in by_track["r1"]} == {"c1", "c2"}
+    # every span lands in exactly one track
+    assert sum(summary["tracks"].values()) == summary["n_spans"]
+
+
+def test_stitch_reports_orphans_and_router_attr_priority():
+    # a fragment whose parent lives in NO fragment is an orphan; a
+    # router span carrying a replica attr (the routing-decision attr)
+    # still lands on the router track
+    router = _span("a1", None, component="router_http", replica="r1")
+    orphan = _span("d9", "missing-parent")
+    tracks, summary = stitch_spans([("router", [router, orphan])])
+    assert summary["orphans"] == ["d9"]
+    # the orphan still renders (assigned to its fragment's source
+    # track) — reported, not dropped
+    assert dict(summary["tracks"]) == {"router": 2}
+
+
+def test_stitch_counts_idless_spans_as_dropped_not_deduped():
+    # a span with no span_id cannot be merged or parented: it is LOST,
+    # and the summary must say so instead of miscounting a duplicate
+    ok = _span("a1", None, component="router_http")
+    idless = dict(_span("", None), span_id="")
+    tracks, summary = stitch_spans([("router", [ok, idless, dict(idless)])])
+    assert summary["n_spans"] == 1
+    assert summary["dropped"] == 2
+    assert summary["deduped"] == 0
+
+
+def test_stitched_trace_payload_renders_process_per_track():
+    spans = [
+        _span("a1", None, component="router_http"),
+        _span("b1", "a1", component="serving_http", replica="r0"),
+    ]
+    payload = stitched_trace_payload([("router", spans)])
+    names = {
+        e["args"]["name"] for e in payload["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert names == {"router", "r0"}
+    # round-trips through the chrome-JSON span reconstruction
+    back = spans_from_chrome(payload)
+    assert {s["span_id"] for s in back} == {"a1", "b1"}
+    assert stitched_trace_payload([]) is None
+
+
+# --- journal (pure) --------------------------------------------------------
+
+
+def test_journal_sequencing_paging_and_replay():
+    j = FleetEventJournal(maxlen=4)
+    for i in range(6):
+        j.emit("failover", replica=f"r{i % 2}", attempt=1)
+    payload = j.events_payload()
+    # bounded: the ring kept the NEWEST 4, seqs stay monotonic
+    assert payload["total"] == 6
+    assert [e["seq"] for e in payload["events"]] == [3, 4, 5, 6]
+    # since pages forward; limit keeps the OLDEST of the remainder so
+    # consecutive polls walk the ring deterministically
+    page = j.events_payload(limit=2, since=3)
+    assert [e["seq"] for e in page["events"]] == [4, 5]
+    # replay strips exactly the nondeterministic fields
+    replay = FleetEventJournal.replay(payload["events"])
+    assert all("t" not in e and "trace_id" not in e for e in replay)
+    assert replay[0] == {"seq": 3, "kind": "failover", "replica": "r0",
+                         "attempt": 1}
+
+
+def test_journal_rare_events_survive_request_rate_floods():
+    """An overload storm (per-request failover/cooldown_429 events)
+    must not evict the rare control-plane history — the promotion and
+    resume record an operator reaches for minutes into an incident."""
+    j = FleetEventJournal(maxlen=8, rare_maxlen=4)
+    j.emit("promote", promoted="r2", replaced="r0")
+    j.emit("stream_resume", source="r0", target="r1", tokens_at_death=3)
+    for i in range(100):  # the storm: far past the main ring's bound
+        j.emit("cooldown_429", replica="r0", retry_after_s=1.0)
+        j.emit("failover", replica="r1", attempt=1)
+    payload = j.events_payload()
+    kinds = {e["kind"] for e in payload["events"]}
+    assert {"promote", "stream_resume"} <= kinds, kinds
+    # the merged view stays one ordered journal: monotonic seqs, the
+    # protected events first (they are oldest), paging still works
+    seqs = [e["seq"] for e in payload["events"]]
+    assert seqs == sorted(seqs) and seqs[:2] == [1, 2]
+    assert payload["total"] == 202
+    page = j.events_payload(since=1, limit=1)
+    assert [e["kind"] for e in page["events"]] == ["stream_resume"]
+    # a flood of RARE kinds still bounds the protected ring
+    for _ in range(10):
+        j.emit("drain", replica="r0")
+    assert j.stats()["resident"] <= 8 + 4
+
+
+# --- timelines (pure) ------------------------------------------------------
+
+
+def test_router_timeline_segments_sum_exactly():
+    tl = RouterTimeline(1, "/v1/generate", t0_ns=1000)
+    tl.relay_on("r0")
+    tl.advance("resume_gap")
+    tl.relay_on("r1")
+    tl.resumes = 1
+    rec = tl.finalize("resumed", 200)
+    # THE invariant: integer-ns segments sum to the observed wall ±0
+    assert sum(d for _, _, d in rec["segments"]) == rec["total_ns"]
+    assert sum(rec["phases"].values()) == rec["total_ns"]
+    assert rec["replicas"] == ["r0", "r1"]
+    assert rec["resume_gap_ns"] == rec["phases"]["resume_gap"]
+    # phase names: route -> relay:r0 -> resume_gap -> relay:r1 (the
+    # final advance CLOSES relay:r1 at the finalize instant)
+    assert [s[0] for s in rec["segments"]] == [
+        "route", "relay:r0", "resume_gap", "relay:r1",
+    ]
+
+
+def test_flight_recorder_retention_policy():
+    rec = RouterFlightRecorder(recent=8, ring=4, slow_ms=0.0)
+    fast = rec.start("/v1/generate").finalize("ok", 200)
+    rec.on_done(fast)
+    resumed_tl = rec.start("/v1/generate")
+    resumed_tl.resumes = 1
+    resumed = resumed_tl.finalize("resumed", 200)
+    rec.on_done(resumed)
+    stats = rec.request_stats()
+    assert stats["completed"] == 2 and stats["retained"] == 1
+    assert [r["rid"] for r in stats["retained_requests"]] == \
+        [resumed["rid"]]
+    # get() prefers the retained ring, falls back to recent
+    assert rec.get(fast["rid"])["outcome"] == "ok"
+    assert rec.get(resumed["rid"])["retained"] is True
+    assert rec.get(10_000) is None
+    assert rec.resume_gap_ms() == [resumed["resume_gap_ns"] / 1e6]
+
+
+# --- integration: real fleets ----------------------------------------------
+
+
+async def _drive_resumed_stream(setup, body, *, seed=1, max_new=8,
+                                tracing_fleet_kw=None):
+    """Run ``body(session, base, ctx, events)`` against a 2-replica
+    fleet where ONE streamed request dies mid-relay (seeded
+    ``router.midstream``) and resumes — the killed-and-resumed shape
+    every integration pin below starts from."""
+    cfg, params = setup
+    engine_factory, server_factory = per_replica_registry_factories(
+        params, cfg
+    )
+    prompt = [int(seed) + t for t in range(1, 9)]
+    async with inprocess_fleet(
+        params, cfg, n_replicas=2,
+        engine_factory=engine_factory, server_factory=server_factory,
+        router_kw=dict(
+            dict(policy="rr", health_interval_s=0.1,
+                 faults=FaultPlane.from_spec("router.midstream:nth=2")),
+            **(tracing_fleet_kw or {}),
+        ),
+    ) as ctx:
+        async with aiohttp.ClientSession() as session:
+            for i in range(2):
+                async with session.post(
+                    f"{ctx.replica_base(i)}/v1/generate",
+                    json={"prompt": prompt, "max_new": 2},
+                ) as r:
+                    assert r.status == 200
+            stream = await stream_generate(
+                session, ctx.base, prompt=prompt, max_new=max_new
+            )
+            assert stream["done"] and len(stream["tokens"]) == max_new
+            events = ctx.router.journal.events_payload()["events"]
+            await body(session, ctx.base, ctx, events)
+
+
+def test_fleet_events_schema_ordering_and_determinism(setup):
+    """/fleet/events acceptance pin: the journal of a seeded
+    router.midstream run has the pinned schema and ordering, and two
+    same-seed runs replay IDENTICAL journals (wall time and the random
+    trace id are the only divergence)."""
+    replays = []
+
+    async def body(session, base, ctx, events):
+        assert [e["seq"] for e in events] == \
+            list(range(1, len(events) + 1))
+        resumes = [e for e in events if e["kind"] == "stream_resume"]
+        assert len(resumes) == 1
+        evt = resumes[0]
+        # schema pin: the documented event shape
+        assert set(evt) >= {"seq", "kind", "t", "trace_id", "source",
+                            "target", "tokens_at_death"}
+        assert evt["source"] != evt["target"]
+        assert evt["tokens_at_death"] == 2  # nth=2: died on frame 2
+        # HTTP surface: paging + pinned 400-on-garbage (the shared
+        # parse_trace_query rule, like both /debug/traces planes)
+        async with session.get(f"{base}/fleet/events?limit=1") as r:
+            page = await r.json()
+        assert page["returned"] == 1 and page["total"] == len(events)
+        async with session.get(
+            f"{base}/fleet/events?since={evt['seq'] - 1}"
+        ) as r:
+            tail = await r.json()
+        assert tail["events"][0]["seq"] == evt["seq"]
+        for bad in ("limit=x", "limit=-1", "since=nope"):
+            async with session.get(f"{base}/fleet/events?{bad}") as r:
+                assert r.status == 400
+        replays.append(FleetEventJournal.replay(events))
+
+    run(_drive_resumed_stream(setup, body, seed=31))
+    run(_drive_resumed_stream(setup, body, seed=31))
+    assert replays[0] == replays[1]
+
+
+def test_stitched_trace_one_track_per_span_no_orphans(setup, tracer):
+    """The stitched-trace acceptance pin: after a killed-and-resumed
+    stream, GET /fleet/debug/traces/{id} returns ONE Perfetto document
+    where every span lands in exactly one replica track (both relaying
+    replicas AND the router present) with no orphan fragments."""
+
+    async def body(session, base, ctx, events):
+        resumes = [e for e in events if e["kind"] == "stream_resume"]
+        tid = resumes[0]["trace_id"]
+        assert tid  # the journal links the event to its trace
+        await asyncio.sleep(0.3)  # the span tree closes asynchronously
+        async with session.get(f"{base}/fleet/debug/traces/{tid}") as r:
+            assert r.status == 200
+            stitched = await r.json()
+        summ = stitched["fleet"]
+        assert summ["trace_id"] == tid
+        assert summ["orphans"] == []
+        assert {"router", "r0", "r1"} <= set(summ["tracks"])
+        # exactly-one-track: track counts partition the span set
+        assert sum(summ["tracks"].values()) == summ["n_spans"]
+        # and the rendered doc agrees: every complete event's pid maps
+        # to exactly one process_name row
+        pids = {e["pid"] for e in stitched["traceEvents"]
+                if e.get("ph") == "X"}
+        names = {e["pid"]: e["args"]["name"]
+                 for e in stitched["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert pids <= set(names)
+        # the relayed tokens' serving spans: each replica's request
+        # subtree (serving component spans) sits on that replica's own
+        # track, not the fetching source's
+        for evt in stitched["traceEvents"]:
+            if evt.get("ph") != "X":
+                continue
+            replica_attr = evt["args"].get("replica")
+            if evt["cat"] == "serving_http" and replica_attr:
+                assert names[evt["pid"]] == replica_attr
+        # unknown trace -> 404
+        async with session.get(
+            f"{base}/fleet/debug/traces/{'f' * 32}"
+        ) as r:
+            assert r.status == 404
+
+    run(_drive_resumed_stream(setup, body, seed=32))
+
+
+def test_router_timeline_http_surface_and_wall_sum(setup):
+    """The failover-aware timeline pin: the resumed stream's router
+    timeline is retained, served on /fleet/debug/requests/{rid}, and
+    its segments sum EXACTLY (±0 — integer ns) to the wall time the
+    router observed, resume gap included."""
+
+    async def body(session, base, ctx, events):
+        async with session.get(f"{base}/fleet/debug/requests") as r:
+            assert r.status == 200
+            stats = await r.json()
+        retained = [t for t in stats["retained_requests"] if t["resumes"]]
+        assert len(retained) == 1
+        tl = retained[0]
+        assert sum(d for _, _, d in tl["segments"]) == tl["total_ns"]
+        assert sum(tl["phases"].values()) == tl["total_ns"]
+        assert tl["resume_gap_ns"] > 0
+        assert tl["outcome"] == "resumed"
+        assert tl["replicas"] and len(set(tl["replicas"])) == 2
+        assert tl["tokens"] == 8
+        async with session.get(
+            f"{base}/fleet/debug/requests/{tl['rid']}"
+        ) as r:
+            assert r.status == 200
+            assert (await r.json())["rid"] == tl["rid"]
+        async with session.get(f"{base}/fleet/debug/requests/zz") as r:
+            assert r.status == 400
+        async with session.get(
+            f"{base}/fleet/debug/requests/999999"
+        ) as r:
+            assert r.status == 404
+
+    run(_drive_resumed_stream(setup, body, seed=33))
+
+
+def test_timelines_off_disables_surface(setup):
+    cfg, params = setup
+
+    async def body():
+        async with inprocess_fleet(
+            params, cfg, n_replicas=1,
+            engine_kw=dict(n_slots=2, max_len=64, chunked_prefill=8),
+            router_kw=dict(timelines=False, health_interval_s=0.2),
+        ) as ctx:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{ctx.base}/v1/generate",
+                    json={"prompt": [1, 2, 3, 4], "max_new": 2},
+                ) as r:
+                    assert r.status == 200
+                async with session.get(
+                    f"{ctx.base}/fleet/debug/requests"
+                ) as r:
+                    assert r.status == 404
+            assert ctx.router.router_stats()["timelines"] is None
+
+    run(body())
+
+
+def test_fleet_metrics_federation_over_http(setup):
+    """GET /fleet/metrics: parses under both content types, every
+    series replica-labeled, aggregates present, and a dead replica
+    surfaces as a scrape error instead of failing the pass."""
+    cfg, params = setup
+    engine_factory, server_factory = per_replica_registry_factories(
+        params, cfg
+    )
+
+    async def body():
+        async with inprocess_fleet(
+            params, cfg, n_replicas=2,
+            engine_factory=engine_factory, server_factory=server_factory,
+            router_kw=dict(health_interval_s=0.1),
+        ) as ctx:
+            async with aiohttp.ClientSession() as session:
+                for i in range(2):
+                    async with session.post(
+                        f"{ctx.replica_base(i)}/v1/generate",
+                        json={"prompt": [5, 6, 7, 8], "max_new": 2},
+                    ) as r:
+                        assert r.status == 200
+                async with session.get(f"{ctx.base}/fleet/metrics") as r:
+                    assert r.status == 200
+                    classic = await r.text()
+                async with session.get(
+                    f"{ctx.base}/fleet/metrics",
+                    headers={"Accept": "application/openmetrics-text"},
+                ) as r:
+                    assert "openmetrics" in r.headers["Content-Type"]
+                    om = await r.text()
+                from prometheus_client.openmetrics.parser import (
+                    text_string_to_metric_families as parse_om,
+                )
+                from prometheus_client.parser import (
+                    text_string_to_metric_families as parse_classic,
+                )
+
+                fams = {f.name: f for f in parse_classic(classic)}
+                tok = fams["tpu_serving_generated_tokens"]
+                assert {s.labels["replica"] for s in tok.samples} == \
+                    {"r0", "r1"}
+                assert "tpu_fleet_mfu_pct" in fams
+                assert "tpu_fleet_ttft_seconds" in fams
+                om_fams = {f.name for f in parse_om(om)}
+                assert "tpu_fleet_mfu_pct" in om_fams
+
+                # kill one replica: federation degrades visibly
+                await ctx.kill_replica(1)
+                async with session.get(f"{ctx.base}/fleet/metrics") as r:
+                    assert r.status == 200
+                    partial = await r.text()
+                fams = {f.name: f
+                        for f in parse_classic(partial)}
+                assert fams["tpu_fleet_scrape_errors"].samples[0].value \
+                    == 1
+                assert fams["tpu_fleet_replicas"].samples[0].value == 1
+
+    run(body())
+
+
+def test_router_debug_traces_plane_query_surface(setup, tracer):
+    """Satellite pin: the router's own /debug/traces accepts the same
+    ?limit=/?since= surface as the replica and daemon planes, 400 on
+    garbage included."""
+    cfg, params = setup
+
+    async def body():
+        async with inprocess_fleet(
+            params, cfg, n_replicas=1,
+            engine_kw=dict(n_slots=2, max_len=64, chunked_prefill=8),
+            router_kw=dict(health_interval_s=0.2),
+        ) as ctx:
+            async with aiohttp.ClientSession() as session:
+                for _ in range(2):
+                    async with session.post(
+                        f"{ctx.base}/v1/generate",
+                        json={"prompt": [1, 2, 3, 4], "max_new": 2},
+                    ) as r:
+                        assert r.status == 200
+                await asyncio.sleep(0.2)
+                async with session.get(f"{ctx.base}/debug/traces") as r:
+                    assert r.status == 200
+                    full = await r.json()
+                assert full["total"] >= 2
+                async with session.get(
+                    f"{ctx.base}/debug/traces?limit=1"
+                ) as r:
+                    page = await r.json()
+                assert page["returned"] == 1
+                # >=: the health poller's probe spans keep landing in
+                # the shared ring between the two reads
+                assert page["total"] >= full["total"]
+                cutoff = full["traces"][-1]["start_us"]
+                async with session.get(
+                    f"{ctx.base}/debug/traces?since={cutoff}"
+                ) as r:
+                    newer = await r.json()
+                assert all(
+                    t["start_us"] > cutoff for t in newer["traces"]
+                )
+                for bad in ("limit=x", "limit=-1", "since=nope"):
+                    async with session.get(
+                        f"{ctx.base}/debug/traces?{bad}"
+                    ) as r:
+                        assert r.status == 400
+                # the single-trace detail endpoint serves chrome JSON
+                tid = full["traces"][0]["trace_id"]
+                async with session.get(
+                    f"{ctx.base}/debug/traces/{tid}"
+                ) as r:
+                    assert r.status == 200
+                    assert "traceEvents" in await r.json()
+
+    run(body())
+
+
+def test_router_span_attrs_and_log_correlation(setup, tracer):
+    """Satellite pin: router spans carry replica/affinity_hit/resumed
+    attrs, and the submitted/resumed log lines carry trace_id (via the
+    emit-time filter) + a replica field."""
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=logging.DEBUG)
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        async def body(session, base, ctx, events):
+            await asyncio.sleep(0.2)
+            spans = [
+                s for t in ctx.router.tracer._finished
+                for s in t["spans"]
+                if s["component"] == "router_http"
+                and s["name"].startswith("POST /v1/generate")
+            ]
+            tagged = [s for s in spans if "replica" in s["attrs"]]
+            assert tagged, spans
+            resumed_span = next(
+                s for s in tagged if s["attrs"].get("resumed")
+            )
+            assert resumed_span["attrs"]["replica"] in ("r0", "r1")
+            assert "affinity_hit" in resumed_span["attrs"]
+
+        run(_drive_resumed_stream(setup, body, seed=34))
+    finally:
+        logger.removeHandler(handler)
+    submitted = [r for r in records
+                 if r.getMessage() == "request submitted to replica"]
+    assert submitted
+    assert all(getattr(r, "trace_id", None) for r in submitted)
+    assert all(r.fields["replica"] for r in submitted)
+    resumed_logs = [
+        r for r in records
+        if r.getMessage() == "resumed mid-stream after replica death"
+    ]
+    assert resumed_logs
+    assert getattr(resumed_logs[0], "trace_id", None)
+    assert resumed_logs[0].fields["replica"]
+
+
+def test_fleet_health_reads_through_fleet_stats(setup):
+    """Satellite pin: both health handlers read through the single
+    fleet_stats() accessor — the snapshot carries the admitting count
+    and the router counters (journal/timeline stats included)."""
+    cfg, params = setup
+
+    async def body():
+        async with inprocess_fleet(
+            params, cfg, n_replicas=2,
+            engine_kw=dict(n_slots=2, max_len=64, chunked_prefill=8),
+            router_kw=dict(health_interval_s=0.1),
+        ) as ctx:
+            snap = ctx.router.fleet_stats()
+            assert snap["admitting"] == 2
+            assert "journal" in snap["router"]
+            assert "timelines" in snap["router"]
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{ctx.base}/fleet/health") as r:
+                    fleet_health = await r.json()
+                assert fleet_health["admitting"] == 2
+                assert set(fleet_health["replicas"]) == {"r0", "r1"}
+                async with session.get(f"{ctx.base}/v1/health") as r:
+                    health = await r.json()
+                assert health["admitting"] == 2
+                # draining flips the admitting count through the same
+                # accessor on both surfaces
+                ctx.fleet.get("r0").draining = True
+                ctx.fleet.get("r1").draining = True
+                async with session.get(f"{ctx.base}/v1/health") as r:
+                    assert r.status == 503
+
+    run(body())

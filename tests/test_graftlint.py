@@ -100,16 +100,18 @@ def test_hot_path_flags_transfer_and_carry():
 def test_thread_ownership_allows_atomic_len():
     bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
     found = _run_on(bad, [_checker("thread-ownership")])
-    # the len(self.cb.running) and len(self.sup._restart_times) reads
-    # on the same handler must NOT fire; the iteration/copy/pool reads
-    # must — the scheduler-shaped ledger reads (serving/scheduler.py
-    # state), the flight-recorder ring (obs/attribution.py state) and
-    # the supervisor's crash-recovery ledgers (serving/supervisor.py
-    # state) fire the same way
-    assert len(found) == 8
+    # the len(self.cb.running), len(self.sup._restart_times) and
+    # len(self.fleet._replicas) reads on the handlers must NOT fire;
+    # the iteration/copy/pool reads must — the scheduler-shaped ledger
+    # reads (serving/scheduler.py state), the flight-recorder ring
+    # (obs/attribution.py state), the supervisor's crash-recovery
+    # ledgers (serving/supervisor.py state) and the fleet registry's
+    # replica map recomputed inline (serving/fleet.py state — the
+    # PR-15 /fleet/health fix) fire the same way
+    assert len(found) == 9
     assert {v.key for v in found} == {
         "running", "pool", "_tenants", "rejections", "_slow_ring",
-        "_last_crash", "_restart_times",
+        "_last_crash", "_restart_times", "_replicas",
     }
 
 
