@@ -68,7 +68,8 @@ san-test:
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs bench-kernels bench-router bench-chaos bench-fleet-obs
+	bench-obs bench-kernels bench-router bench-chaos bench-fleet-obs \
+	bench-chip-obs
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -180,13 +181,24 @@ bench-obs:
 bench-fleet-obs:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.fleet_obs_bench
 
+# CPU-runnable smoke: the chip observability plane (plugin/journal.py +
+# device/allocation.py) — two same-seed fake-backend runs (Allocate +
+# a chip-2 health flap) replay IDENTICAL allocation journals with
+# exactly two stream-true health transitions, the node's REAL classic
+# /metrics scrape federates with a replica scrape and parses under
+# BOTH content types (strict OpenMetrics pinned, node labels + fleet
+# chip aggregates asserted), and the disarmed device-attribution guard
+# stays ~ns (one JSON line with chip_obs_* fields + device_guard_ns).
+bench-chip-obs:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.chip_obs_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
 	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-chaos bench-fleet-obs clean watch
+	bench-chaos bench-fleet-obs bench-chip-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

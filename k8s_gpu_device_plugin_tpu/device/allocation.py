@@ -1,0 +1,112 @@
+"""The serving half of request->chip attribution.
+
+The plugin stamps its allocation decision into the container environment
+(`TPU_VISIBLE_CHIPS` — which physical chips; `TPU_ALLOCATION_ID` — the
+journal's deterministic ``alloc-N`` id; `TPU_ACCELERATOR_TYPE` — the
+generation spec). :class:`AllocatedDevices` reads that contract back so
+the serving engine knows which silicon it is running on, and every span,
+request timeline, and kv-shard gauge can name the physical chips — the
+join key that ties a stitched fleet trace to a ``/debug/allocations``
+journal entry on the node that served it.
+
+Explicit specs (the ``--devices`` flag) exist for environments without
+the plugin (bare-metal dev boxes, tests): ``alloc-1:0,1,2,3`` or just
+``0,1,2,3``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class AllocatedDevices:
+    """The device set a serving process was allocated.
+
+    ``chips`` are physical chip indices in ascending order — the same
+    numbering the plugin's journal, ``/debug/topology``, and the
+    ``tpu_plugin_chip_*`` gauges use.
+    """
+
+    allocation_id: str = ""
+    chips: tuple[int, ...] = ()
+    coords: tuple[tuple[int, ...], ...] = ()
+    generation: str = ""
+    #: where this came from: "env" (plugin contract), "spec" (flag), ""
+    source: str = field(default="", compare=False)
+
+    @staticmethod
+    def from_env(environ=None) -> "AllocatedDevices | None":
+        """Read the plugin's container env contract; None when absent
+        (not running under the device plugin)."""
+        env = os.environ if environ is None else environ
+        visible = env.get("TPU_VISIBLE_CHIPS", "").strip()
+        if not visible:
+            return None
+        try:
+            chips = tuple(sorted(int(c) for c in visible.split(",") if c.strip()))
+        except ValueError:
+            return None
+        if not chips:
+            return None
+        return AllocatedDevices(
+            allocation_id=env.get("TPU_ALLOCATION_ID", ""),
+            chips=chips,
+            generation=env.get("TPU_ACCELERATOR_TYPE", "").split("-")[0],
+            source="env",
+        )
+
+    @staticmethod
+    def from_spec(spec: str) -> "AllocatedDevices":
+        """Parse an explicit ``[alloc-id:]chip,chip,...`` flag value.
+
+        Raises ValueError on garbage — a mistyped flag must fail loudly
+        at startup, not attribute requests to the wrong silicon.
+        """
+        spec = spec.strip()
+        alloc_id = ""
+        if ":" in spec:
+            alloc_id, _, spec = spec.partition(":")
+            alloc_id = alloc_id.strip()
+        try:
+            # no empty-segment leniency here: "1,,2" is a typo, and a
+            # typed flag that half-parses would attribute requests to
+            # the wrong silicon
+            chips = tuple(sorted(int(c) for c in spec.split(",")))
+        except ValueError:
+            raise ValueError(
+                f"devices spec must be '[alloc-id:]chip,chip,...', got {spec!r}"
+            ) from None
+        if not chips:
+            raise ValueError("devices spec names no chips")
+        return AllocatedDevices(
+            allocation_id=alloc_id, chips=chips, source="spec"
+        )
+
+    def chips_label(self) -> str:
+        """Compact ``"0,1,2,3"`` form for span/timeline attrs (attrs are
+        scalars; a list would stringify differently per producer)."""
+        return ",".join(str(c) for c in self.chips)
+
+    def shard_chip(self, shard: int) -> "int | None":
+        """Physical chip behind tensor-parallel shard ``shard``.
+
+        Shards map onto the allocated chips in order (JAX device order
+        within a process follows TPU_VISIBLE_CHIPS order, which the
+        plugin emits ascending). More shards than chips (tp on fewer
+        devices than shards is refused upstream) returns None rather
+        than inventing silicon.
+        """
+        if 0 <= shard < len(self.chips):
+            return self.chips[shard]
+        return None
+
+    def as_dict(self) -> dict:
+        """Stats/health payload form."""
+        return {
+            "allocation_id": self.allocation_id,
+            "chips": list(self.chips),
+            "generation": self.generation,
+            "source": self.source,
+        }

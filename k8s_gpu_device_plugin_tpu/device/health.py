@@ -100,6 +100,11 @@ class HealthAssessor:
         self._last_seen: dict[int, float] = {}
         self._last_probe_t: float | None = None
         self._last_probe_ok = True
+        # per-chip reason behind the latest verdict ("ok" /
+        # "node_unhealthy" / "stale_gauges" / "probe_failed") — what the
+        # allocation journal's health_transition events carry, so an
+        # Unknown chip says WHICH liveness source demoted it
+        self.last_reasons: dict[int, str] = {}
 
     def _scrape(self, now: float) -> tuple[set[int], bool]:
         """Refresh gauge liveness; returns (devices seen, endpoint absent).
@@ -154,9 +159,11 @@ class HealthAssessor:
         )
 
         verdicts: dict[int, str] = {}
+        reasons: dict[int, str] = {}
         for idx, ok in node_health.items():
             if not ok:
                 verdicts[idx] = UNHEALTHY
+                reasons[idx] = "node_unhealthy"
                 continue
             seen = self._last_seen.get(idx)
             if seen is not None and idx not in live and now - seen > self._stale_after:
@@ -164,8 +171,10 @@ class HealthAssessor:
                 # silent while the node still looks fine: the
                 # wedged-but-present signature
                 verdicts[idx] = UNKNOWN
+                reasons[idx] = "stale_gauges"
                 continue
             verdicts[idx] = HEALTHY
+            reasons[idx] = "ok"
 
         if live:
             # gauges flowing = chips demonstrably alive; retire any stale
@@ -196,6 +205,8 @@ class HealthAssessor:
                 for idx, v in verdicts.items():
                     if v == HEALTHY:
                         verdicts[idx] = UNKNOWN
+                        reasons[idx] = "probe_failed"
+        self.last_reasons = reasons
         return verdicts
 
 

@@ -53,6 +53,10 @@ class DeviceMetrics:
 
     def __init__(self, usage_reader: UsageReader | None = None, registry=REGISTRY) -> None:
         self._usage_reader = usage_reader or NullUsageReader()
+        # kept for consumers that need to scrape THIS instance's series
+        # (per-registry test/bench stacks expose it the way
+        # ServingMetrics._registry is exposed serving-side)
+        self._registry = registry
         self._usage_chips: set[int] = set()  # chips with live usage series
         # update_usage may run on executor threads (server offloads the
         # blocking gRPC scrape); serialize scrapes so concurrent /metrics

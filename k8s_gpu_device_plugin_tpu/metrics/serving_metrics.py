@@ -165,6 +165,19 @@ class ServingMetrics:
             ["shard"],
             registry=registry,
         )
+        # shard -> physical chip mapping (info-style, value always 1):
+        # a NEW series rather than a chip label on the gauges above, so
+        # their label sets — pinned byte-comparable in the tp tests —
+        # never change. Written only when the engine knows its allocated
+        # device set (device/allocation.py).
+        self.kv_shard_chip = Gauge(
+            f"{prefix}_kv_shard_chip",
+            "Physical TPU chip behind one tensor-parallel shard "
+            "(1 = mapped; chip indices match the plugin's "
+            "tpu_plugin_chip_* gauges and /debug/topology)",
+            ["shard", "chip"],
+            registry=registry,
+        )
         # Attention-backend routing (ops/attention.py's dispatcher):
         # which backend each serving mode — decode / verify / prefill —
         # routes through, as 1/0 per (mode, backend) pair. Fixed
@@ -412,6 +425,7 @@ class ServingMetrics:
             self.kv_shard_reserved_bytes,
             self.kv_shard_pages_in_use,
             self.kv_shard_in_use_bytes,
+            self.kv_shard_chip,
             self.decode_attn_backend,
             self.spec_rounds,
             self.spec_tokens_drafted,
@@ -520,6 +534,10 @@ class ServingMetrics:
                 self.kv_shard_in_use_bytes.labels(shard=label).set(
                     s["in_use_bytes"]
                 )
+            if "chip" in s:
+                self.kv_shard_chip.labels(
+                    shard=label, chip=str(s["chip"])
+                ).set(1)
 
     # --- scheduler hooks (serving/scheduler.py) ---
 

@@ -521,6 +521,7 @@ class ContinuousBatcher:
         attribution=None,  # obs.attribution.RequestAttributor (or None)
         mfu=None,  # metrics.roofline.MfuAccumulator (or None)
         faults=None,  # serving.faults.FaultPlane (or None = disarmed)
+        devices=None,  # device.allocation.AllocatedDevices (or None)
     ):
         # the KV layout rides in the (static) cfg so every jitted step
         # branches on it at trace time; the explicit kwargs are sugar so
@@ -770,6 +771,12 @@ class ContinuousBatcher:
         # engine pops from BOTH maps per request to keep memory bounded
         self.done_requests: dict[int, "_Request"] = {}  # owner: engine
         self._next_rid = 0
+        # Chip attribution (device/allocation.py): the physical chips
+        # this batcher's arrays live on, frozen at startup. Immutable
+        # (a frozen dataclass), so cross-thread reads are safe without
+        # a snapshot method. Set before metrics: the startup KV gauge
+        # report below already renders the per-shard chip mapping.
+        self.devices = devices
         # optional metrics.ServingMetrics (or anything with its hooks);
         # None = zero overhead, no prometheus dependency on this path
         self.metrics = metrics
@@ -857,6 +864,12 @@ class ContinuousBatcher:
         # attribution_stats()/mfu_stats() snapshot methods.
         self.attribution = attribution
         self.mfu = mfu
+        # duck-typed handoff of the chip set to the attributor so
+        # retired-request timelines name their silicon
+        if devices is not None and attribution is not None:
+            set_devices = getattr(attribution, "set_devices", None)
+            if set_devices is not None:
+                set_devices(devices)
         # process-global tracer: every site below guards on .enabled, so
         # the default-off path is one attribute read per potential span
         self.tracer = get_tracer()
@@ -1787,6 +1800,13 @@ class ContinuousBatcher:
         shards = []
         for i in range(self.cfg.tp):
             s: dict = {"shard": i}
+            if self.devices is not None:
+                # shard -> physical chip (device/allocation.py): names
+                # the silicon behind each tp slice on /v1/health and the
+                # kv_shard chip-mapping gauge
+                chip = self.devices.shard_chip(i)
+                if chip is not None:
+                    s["chip"] = chip
             if self.pool is None:
                 s["reserved_bytes"] = self.n_slots * self.max_len * per
             else:

@@ -133,6 +133,15 @@ class RequestAttributor:
         self.window_min = int(window_min)
         self._n_retired = 0   # owner: engine
         self._n_slow = 0      # owner: engine
+        # chip attribution (device/allocation.py): set once by the
+        # batcher at startup (an immutable AllocatedDevices), stamped on
+        # every retired record so a timeline names its silicon
+        self._devices = None  # owner: engine
+
+    def set_devices(self, devices) -> None:
+        """Batcher handoff of the allocated device set (duck-typed —
+        anything with ``chips_label()``/``allocation_id``)."""
+        self._devices = devices
 
     # --- batcher hooks (engine thread) -----------------------------------
 
@@ -192,6 +201,11 @@ class RequestAttributor:
                 },
             },
         }
+        if self._devices is not None:
+            # which physical chips served this request — the join key
+            # against the plugin's /debug/allocations journal entry
+            record["chips"] = self._devices.chips_label()
+            record["allocation_id"] = self._devices.allocation_id
         restarts = getattr(req, "restarts", 0)
         if restarts:
             # the request lived through an engine crash-recovery

@@ -277,12 +277,16 @@ def _split_sample(line: str) -> "tuple[str, str | None, str] | None":
     return line[:space], None, line[space:]
 
 
-def _relabel(line: str, replica: str) -> str:
+def _relabel(line: str, value: str, key: str = "replica") -> str:
+    """Prefix the sample's label set with ``key="value"`` — ``replica``
+    for serving scrapes, ``node`` for plugin scrapes (two planes, two
+    identity namespaces; a plugin node and a replica may share a
+    hostname without their series colliding)."""
     parts = _split_sample(line)
     if parts is None:
         return line
     name, labels, rest = parts
-    tag = f'replica="{_escape_label_value(replica)}"'
+    tag = f'{key}="{_escape_label_value(value)}"'
     merged = f"{tag},{labels}" if labels else tag
     return f"{name}{{{merged}}}{rest}"
 
@@ -342,11 +346,50 @@ class _Family:
         self.samples: list[str] = []
 
 
+def _classic_to_om(text: str) -> str:
+    """Make a CLASSIC-format exposition mergeable into an OpenMetrics
+    document (the device plugin's /metrics serves classic only):
+    counter families lose the ``_total`` suffix from their HELP/TYPE
+    metadata — OpenMetrics names the family bare while the samples keep
+    ``_total`` — and the ``*_created`` pseudo-families classic renders
+    for creation timestamps are dropped, since OpenMetrics reserves
+    that suffix INSIDE the real family and a second family with the
+    name fails the strict parser."""
+    counters = set()
+    for line in text.splitlines():
+        parts = line.split(None, 3)
+        if (len(parts) >= 4 and parts[0] == "#" and parts[1] == "TYPE"
+                and parts[3].strip() == "counter"
+                and parts[2].endswith("_total")):
+            counters.add(parts[2])
+    out: list[str] = []
+    in_created = False
+    for raw in text.splitlines():
+        line = raw.rstrip("\r")
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE", "UNIT"):
+                in_created = parts[2].endswith("_created")
+                if in_created:
+                    continue
+                if parts[2] in counters:
+                    parts[2] = parts[2][: -len("_total")]
+                    line = " ".join(parts)
+            out.append(line)
+            continue
+        if in_created:
+            continue  # sample lines of a dropped _created family
+        out.append(line)
+    return "\n".join(out) + "\n"
+
+
 def federate_metrics(
     scrapes: "list[tuple[str, str]]",
     *,
     openmetrics: bool = False,
     scrape_errors: "list[str] | None" = None,
+    plugin_scrapes: "list[tuple[str, str]] | None" = None,
+    plugin_scrape_errors: "list[str] | None" = None,
 ) -> str:
     """Merge replica expositions into ONE parseable fleet exposition.
 
@@ -358,7 +401,14 @@ def federate_metrics(
     valid under the STRICT OpenMetrics parser (interleaved family
     blocks are not). The fleet-aggregate block appends at the end;
     ``scrape_errors`` (unreachable replicas) surface as a gauge so a
-    partial federation pass is visible, not silent."""
+    partial federation pass is visible, not silent.
+
+    ``plugin_scrapes`` federates each node's device-plugin ``/metrics``
+    alongside the replicas — same relabeling rules with a ``node=``
+    label (its own identity namespace), plus fleet chip aggregates:
+    ``tpu_fleet_chips{state}``, HBM headroom, duty-cycle-weighted
+    tensorcore utilization. ``None`` (no plugins configured) keeps the
+    output byte-identical to the replica-only federation."""
     families: dict[str, _Family] = {}
     # per-replica parsed values for the aggregates
     mfu: list[tuple[float, float, float]] = []  # (mfu, bw, weight)
@@ -368,10 +418,9 @@ def federate_metrics(
         for fam, _, _ in _AGG_HISTOGRAMS
     }
 
-    for replica, text in scrapes:
+    def ingest(identity: str, key: str, text: str, on_sample) -> None:
         current: "_Family | None" = None
         fresh: set[str] = set()  # families THIS scrape introduced
-        vals: dict[str, float] = {}
         for raw in text.splitlines():
             line = raw.rstrip("\r")
             if not line.strip():
@@ -384,8 +433,8 @@ def federate_metrics(
                         fam = families[parts[2]] = _Family(parts[2])
                         fresh.add(parts[2])
                     if parts[2] in fresh:
-                        # first replica naming a family defines its
-                        # metadata; later replicas repeat it (one build
+                        # first scrape naming a family defines its
+                        # metadata; later scrapes repeat it (one build
                         # fleet-wide) and a second copy would be
                         # invalid OpenMetrics
                         fam.meta.append(line)
@@ -399,10 +448,16 @@ def federate_metrics(
                 current = families.get(name)
                 if current is None:
                     current = families[name] = _Family(name)
-            current.samples.append(_relabel(line, replica))
+            current.samples.append(_relabel(line, identity, key))
             value = _sample_value(rest)
             if value is None:
                 continue
+            on_sample(name, labels, value)
+
+    for replica, text in scrapes:
+        vals: dict[str, float] = {}
+
+        def on_serving_sample(name, labels, value, vals=vals):
             if name in (_MFU_GAUGE, _BW_GAUGE, _TPS_GAUGE):
                 vals[name] = value
             for fam, _, _ in _AGG_HISTOGRAMS:
@@ -421,12 +476,44 @@ def federate_metrics(
                     h["sum"] += value
                 elif name == f"{fam}_count":
                     h["count"] += value
+
+        ingest(replica, "replica", text, on_serving_sample)
         if _MFU_GAUGE in vals:
             mfu.append((
                 vals.get(_MFU_GAUGE, 0.0),
                 vals.get(_BW_GAUGE, 0.0),
                 max(0.0, vals.get(_TPS_GAUGE, 0.0)),
             ))
+
+    # plugin-plane aggregates (only collected when plugins are wired)
+    chips_by_state: dict[str, float] = {}
+    hbm = {"total": 0.0, "used": 0.0}
+    duty: dict[tuple[str, str], float] = {}   # (node, chip) -> duty %
+    tc_util: dict[tuple[str, str], float] = {}  # (node, chip) -> util %
+    for node, text in (plugin_scrapes or ()):
+        def on_plugin_sample(name, labels, value, node=node):
+            if name == "tpu_plugin_chips":
+                state = _parse_labels(labels).get("state")
+                if state is not None:
+                    chips_by_state[state] = (
+                        chips_by_state.get(state, 0.0) + value
+                    )
+            elif name == "tpu_plugin_chip_hbm_total_bytes":
+                hbm["total"] += value
+            elif name == "tpu_plugin_chip_hbm_used_bytes":
+                hbm["used"] += value
+            elif name == "tpu_plugin_chip_duty_cycle_percent":
+                chip = _parse_labels(labels).get("chip")
+                if chip is not None:
+                    duty[(node, chip)] = value
+            elif name == "tpu_plugin_chip_tensorcore_utilization":
+                chip = _parse_labels(labels).get("chip")
+                if chip is not None:
+                    tc_util[(node, chip)] = value
+
+        ingest(node, "node",
+               _classic_to_om(text) if openmetrics else text,
+               on_plugin_sample)
 
     out: list[str] = []
     for fam in families.values():
@@ -458,6 +545,42 @@ def federate_metrics(
         "like tpu_fleet_mfu_pct",
         sum(b * w for _, b, w in mfu) / weight_total if weight_total else 0.0,
     )
+    if plugin_scrapes is not None:
+        # plugin-plane aggregates — emitted only when plugins are wired,
+        # so the replica-only federation stays byte-identical
+        gauge("tpu_fleet_plugin_nodes",
+              "Plugin nodes merged into this federation pass",
+              len(plugin_scrapes))
+        gauge("tpu_fleet_plugin_scrape_errors",
+              "Plugin nodes whose /metrics scrape failed this pass",
+              len(plugin_scrape_errors or ()))
+        out.append("# HELP tpu_fleet_chips Fleet-wide TPU chips per "
+                   "tri-state health verdict (tpu_plugin_chips summed "
+                   "across nodes)")
+        out.append("# TYPE tpu_fleet_chips gauge")
+        for state in ("healthy", "unknown", "unhealthy"):
+            out.append(
+                f'tpu_fleet_chips{{state="{state}"}} '
+                f'{_fmt(chips_by_state.get(state, 0.0))}'
+            )
+        gauge(
+            "tpu_fleet_hbm_headroom_bytes",
+            "Fleet HBM headroom: total minus used across every node's "
+            "chips (the capacity the autoscaler schedules against)",
+            max(0.0, hbm["total"] - hbm["used"]),
+        )
+        duty_total = sum(duty.values())
+        gauge(
+            "tpu_fleet_tensorcore_util_pct",
+            "Fleet TensorCore utilization, duty-cycle weighted per chip "
+            "(an idle chip weighs zero; a busy chip weighs its duty "
+            "cycle)",
+            (
+                sum(tc_util.get(k, 0.0) * d for k, d in duty.items())
+                / duty_total
+                if duty_total else 0.0
+            ),
+        )
     for fam, fleet_fam, help_ in _AGG_HISTOGRAMS:
         h = hist[fam]
         if not h["seen"]:

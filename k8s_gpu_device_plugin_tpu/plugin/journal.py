@@ -1,0 +1,151 @@
+"""Allocation journal: the plugin half of the chip observability plane.
+
+The fleet side (obs/fleet_obs.py, PR 15) made router operations — failover,
+promotion, stream resume — a bounded, monotonically-sequenced event ring an
+operator can replay. The plugin's own control-plane history stayed log-only:
+*which* chips an `Allocate` handed out, *what* the preferred-allocation
+scorer picked from what pool, and *when* a chip's tri-state health verdict
+flipped (and why — the wedged-but-present reason from device/health.py) all
+scrolled away with the log buffer. This module is the same journal
+discipline, one plane down:
+
+- every ``Allocate`` container response becomes an ``allocate`` event
+  carrying the deterministic allocation id (``alloc-N`` — a counter, not a
+  uuid, so same-seed fake-backend runs replay identically), the kubelet
+  device ids, physical chip indices, and topology coordinates;
+- every ``GetPreferredAllocation`` decision becomes a
+  ``preferred_allocation`` event (requested size, pool, verdict);
+- every per-chip health flip from the manager's health loop becomes a
+  ``health_transition`` event with the assessor's reason
+  (``stale_gauges`` / ``probe_failed`` / ``node_unhealthy`` /
+  ``recovered``).
+
+Served on ``GET /debug/allocations`` (the shared ``?limit=``/``?since=``
+query surface) and federated into the router's ``GET /fleet/events`` with a
+``plane="plugin"`` discriminator — so "what did the fleet look like when
+chip 3 went Unknown" is one merged, ordered journal.
+
+Retention is two-tier like the fleet journal's, with the tiers swapped to
+this plane's noise profile: a FLAPPING chip emits ``health_transition`` at
+health-poll rate and must not evict the rare allocation history an operator
+reaches for later; ``allocate``/``preferred_allocation`` ride the protected
+ring.
+
+Thread model: single writer — the manager's event loop (gRPC handlers and
+the health loop both run on it). HTTP readers go through
+``events_payload()``/``owners()``/``stats()`` snapshots, the same
+thread-ownership contract graftlint pins engine-side.
+
+Determinism contract: same-seed fake-backend runs (including chaos runs
+with injected chip-health flaps) produce identical :meth:`replay` views —
+only the wall timestamp and the (random) trace id vary, and ``replay``
+strips exactly those two fields. Pinned in ``make bench-chip-obs``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from k8s_gpu_device_plugin_tpu.obs.trace import current_trace_ids
+
+
+class AllocationJournal:
+    """Bounded ring of plugin control-plane events (allocations,
+    preferred-allocation decisions, chip-health transitions), plus the
+    live chip-ownership table ``/debug/topology`` renders."""
+
+    #: fields excluded from the determinism comparison: wall time and
+    #: the (secrets-random) trace id — same contract as the fleet journal
+    NONDETERMINISTIC_FIELDS = ("t", "trace_id")
+
+    #: kinds that can fire at health-poll rate (a flapping chip emits one
+    #: per poll); every other kind is rare allocation history and ALSO
+    #: rides the protected ring so flap noise cannot evict it
+    FREQUENT_KINDS = frozenset({"health_transition"})
+
+    def __init__(self, maxlen: int = 1024, rare_maxlen: int = 256):
+        self._events: deque[dict] = deque(maxlen=maxlen)  # owner: engine
+        self._rare: deque[dict] = deque(maxlen=rare_maxlen)  # owner: engine
+        self._seq = 0             # owner: engine
+        self._next_alloc = 0      # owner: engine
+        # live ownership: physical chip index -> the allocation that most
+        # recently took it (the kubelet offers no deallocate callback, so
+        # "owner" means last-allocated — exactly what an operator tracing
+        # a request back to silicon wants)
+        self._owners: dict[int, dict] = {}  # owner: engine
+
+    def next_allocation_id(self) -> str:
+        """Deterministic ``alloc-N`` ids: a per-journal counter, never a
+        uuid — the replay determinism pin compares them across runs."""
+        self._next_alloc += 1
+        return f"alloc-{self._next_alloc}"
+
+    def emit(self, kind: str, **fields) -> dict:
+        self._seq += 1
+        ids = current_trace_ids()
+        event = {
+            "seq": self._seq,
+            "kind": kind,
+            "t": round(time.time(), 6),
+            "trace_id": ids[0] if ids is not None else "",
+            **fields,
+        }
+        self._events.append(event)
+        if kind not in self.FREQUENT_KINDS:
+            self._rare.append(event)
+        if kind == "allocate":
+            for idx in fields.get("chips", ()):
+                self._owners[idx] = {
+                    "allocation_id": fields.get("allocation_id", ""),
+                    "resource": fields.get("resource", ""),
+                    "devices": list(fields.get("devices", ())),
+                }
+        return event
+
+    # --- snapshots --------------------------------------------------------
+
+    def events_payload(self, limit: "int | None" = None,
+                       since: "int | None" = None) -> dict:
+        """``GET /debug/allocations``: oldest-first (replay order),
+        ``since`` returns only events with ``seq > since``, ``limit``
+        caps the page at its OLDEST entries — the fleet journal's exact
+        paging contract, so one poller idiom covers both planes."""
+        merged: dict[int, dict] = {}
+        for ring in (self._rare, self._events):
+            for e in ring:
+                if since is None or e["seq"] > since:
+                    merged[e["seq"]] = e
+        seqs = sorted(merged)
+        if limit is not None:
+            seqs = seqs[:limit]
+        events = [dict(merged[seq]) for seq in seqs]
+        return {
+            "total": self._seq,
+            "returned": len(events),
+            "events": events,
+        }
+
+    def owners(self) -> dict:
+        """Chip index -> last-allocated owner, for ``/debug/topology``
+        (plain copies out: HTTP handlers read this cross-context)."""
+        return {idx: dict(o) for idx, o in list(self._owners.items())}
+
+    @staticmethod
+    def replay(events: "list[dict]") -> list[dict]:
+        """The deterministic view: events minus wall time + trace id.
+        Two same-seed fake-backend runs must produce EQUAL replays."""
+        return [
+            {k: v for k, v in e.items()
+             if k not in AllocationJournal.NONDETERMINISTIC_FIELDS}
+            for e in events
+        ]
+
+    def stats(self) -> dict:
+        merged = {e["seq"] for e in self._events}
+        merged.update(e["seq"] for e in self._rare)
+        return {
+            "emitted": self._seq,
+            "resident": len(merged),
+            "allocations": self._next_alloc,
+        }

@@ -40,6 +40,7 @@ from k8s_gpu_device_plugin_tpu.device.factory import make_backend
 from k8s_gpu_device_plugin_tpu.device.topology import as_slice_member
 from k8s_gpu_device_plugin_tpu.obs.trace import get_tracer
 from k8s_gpu_device_plugin_tpu.plugin import api
+from k8s_gpu_device_plugin_tpu.plugin.journal import AllocationJournal
 from k8s_gpu_device_plugin_tpu.plugin.plugin import SliceMembership, TpuDevicePlugin
 from k8s_gpu_device_plugin_tpu.resource.resources import discover_resources
 from k8s_gpu_device_plugin_tpu.utils.latch import Latch
@@ -100,6 +101,12 @@ class PluginManager:
             else health_assessor
         )
         self._chip_health: dict[int, str] = {}
+        # Chip observability plane (plugin/journal.py): every Allocate /
+        # preferred-allocation decision / health transition becomes a
+        # sequenced event on GET /debug/allocations. Manager-owned (one
+        # seq space, one alloc-N counter) so kubelet flaps, which rebuild
+        # plugin objects, cannot reset allocation ids or drop history.
+        self.journal = AllocationJournal()
         # Crash-loop guard state: rolling start timestamps per resource name.
         # Lives here (not in the plugin) so kubelet flaps, which rebuild
         # plugin objects, cannot reset the budget (cf. plugin.go:111-127).
@@ -223,6 +230,7 @@ class PluginManager:
                 libtpu_path=self.cfg.libtpu_path,
                 logger=self.log,
                 membership=membership,
+                journal=self.journal,
             )
             for name, chips in sorted(self.chip_map.items())
         ]
@@ -252,6 +260,23 @@ class PluginManager:
         return {
             i: HEALTHY if ok else UNHEALTHY for i, ok in node_health.items()
         }
+
+    def _health_reason(self, idx: int, state: str) -> str:
+        """Why a chip's verdict is what it is: the assessor's per-chip
+        reason when one is configured (``stale_gauges`` /
+        ``probe_failed`` / ``node_unhealthy``), else derived from the
+        state alone. ``ok`` reads as ``recovered`` here — this is only
+        called on a TRANSITION, where a Healthy verdict means the chip
+        came back."""
+        if self._assessor is not None:
+            r = getattr(self._assessor, "last_reasons", {}).get(idx)
+            if r is not None:
+                return "recovered" if r == "ok" else r
+        if state == HEALTHY:
+            return "recovered"
+        if state == UNHEALTHY:
+            return "node_unhealthy"
+        return "unknown"
 
     def _with_health(self, chips: Chips) -> Chips:
         """Apply current per-chip verdicts; the worst member state wins
@@ -400,17 +425,48 @@ class PluginManager:
                 continue
             if health == self._chip_health:
                 continue
-            self.log.warning(
-                "chip health changed",
-                extra={"fields": {
-                    "unhealthy": sorted(
-                        i for i, s in health.items() if s == UNHEALTHY
-                    ),
-                    "unknown": sorted(
-                        i for i, s in health.items() if s == UNKNOWN
-                    ),
-                }},
+            old = self._chip_health
+            changed = sorted(
+                idx for idx in set(old) | set(health)
+                if old.get(idx) != health.get(idx)
             )
+            # One span per changed poll cycle: the per-chip journal
+            # events and warning lines below emit inside it, so the
+            # emit-time TraceContextFilter stamps each log line with the
+            # cycle's trace_id — an operator pivots from one flapping
+            # chip's line to the whole transition trace.
+            with get_tracer().span(
+                "health_transition", component="plugin",
+                chips=len(changed),
+            ):
+                for idx in changed:
+                    new_state = health.get(idx, UNHEALTHY)
+                    reason = self._health_reason(idx, new_state)
+                    self.journal.emit(
+                        "health_transition", chip=idx,
+                        old=old.get(idx, ""), new=new_state,
+                        reason=reason,
+                    )
+                    self.log.warning(
+                        "chip health transition",
+                        extra={"fields": {
+                            "chip": idx,
+                            "old": old.get(idx, ""),
+                            "new": new_state,
+                            "reason": reason,
+                        }},
+                    )
+                self.log.warning(
+                    "chip health changed",
+                    extra={"fields": {
+                        "unhealthy": sorted(
+                            i for i, s in health.items() if s == UNHEALTHY
+                        ),
+                        "unknown": sorted(
+                            i for i, s in health.items() if s == UNKNOWN
+                        ),
+                    }},
+                )
             self._chip_health = health
             for plugin in self.plugins:
                 chips = self.chip_map.get(plugin.resource_name)

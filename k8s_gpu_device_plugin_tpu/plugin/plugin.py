@@ -75,11 +75,17 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         libtpu_path: str = "/lib/libtpu.so",
         logger: logging.Logger | None = None,
         membership: SliceMembership | None = None,
+        journal=None,  # plugin.journal.AllocationJournal (or None)
     ) -> None:
         self.resource_name = resource_name
         self.chips = chips
         self.topology = topology
         self.membership = membership or SliceMembership()
+        # the manager's allocation journal: Allocate / preferred-
+        # allocation decisions become sequenced events, and allocations
+        # get deterministic alloc-N ids stamped into the container env
+        # (TPU_ALLOCATION_ID — what request->chip attribution joins on)
+        self.journal = journal
         self.socket_dir = socket_dir
         self.libtpu_path = libtpu_path
         self.log = logger or get_logger()
@@ -242,10 +248,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     "preferred": ids,
                 }},
             )
+            if self.journal is not None:
+                self.journal.emit(
+                    "preferred_allocation",
+                    resource=self.resource_name,
+                    size=int(creq.allocation_size),
+                    available=len(creq.available_deviceIDs),
+                    must_include=list(creq.must_include_deviceIDs),
+                    preferred=ids,
+                )
             responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
         return pb.PreferredAllocationResponse(container_responses=responses)
 
-    def _container_allocate(self, ids: list[str]) -> pb.ContainerAllocateResponse:
+    def _container_allocate(
+        self, ids: list[str], allocation_id: str = ""
+    ) -> pb.ContainerAllocateResponse:
         """Build the full container wiring for one allocation.
 
         The env contract is what libtpu/JAX read inside the pod:
@@ -276,6 +293,20 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         response = pb.ContainerAllocateResponse()
         response.envs["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in phys_indices)
         response.envs["TPU_SKIP_MDS_QUERY"] = "true"
+        if allocation_id:
+            # the request->chip attribution join key: the serving engine
+            # reads this back (device/allocation.py) and stamps it on
+            # spans/timelines, tying a trace to this journal entry
+            response.envs["TPU_ALLOCATION_ID"] = allocation_id
+        if self.journal is not None:
+            self.journal.emit(
+                "allocate",
+                allocation_id=allocation_id,
+                resource=self.resource_name,
+                devices=ids,
+                chips=phys_indices,
+                coords=[list(c) for c in coords],
+            )
         # Worker identity makes sense only for a whole-host allocation that is
         # part of a distributed job — a multi-host slice, or one slice of a
         # multislice run (where a single-host slice still needs its rank).
@@ -379,14 +410,18 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     f"invalid allocation request for {self.resource_name}: "
                     f"unknown device IDs {missing}",
                 )
+            allocation_id = (
+                self.journal.next_allocation_id() if self.journal else ""
+            )
             self.log.info(
                 "Allocate",
                 extra={"fields": {
                     "resource": self.resource_name,
                     "devices": ids,
+                    "allocation_id": allocation_id,
                 }},
             )
-            responses.append(self._container_allocate(ids))
+            responses.append(self._container_allocate(ids, allocation_id))
         return pb.AllocateResponse(container_responses=responses)
 
     async def PreStartContainer(self, request, context):

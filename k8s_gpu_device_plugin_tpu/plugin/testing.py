@@ -101,6 +101,55 @@ async def start_stack(socket_dir, topology: str = "v5e-4", **cfg_kwargs):
     return kubelet, manager, task, backend
 
 
+def per_registry_device_metrics(usage_reader=None):
+    """A ``DeviceMetrics`` bound to its OWN ``CollectorRegistry`` (the
+    serving plane's per-replica-registry pattern, plugin-side): plugin
+    /metrics federation is testable with N plugin stacks in one process
+    — shared collector names on the global REGISTRY would collide."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.device_metrics import DeviceMetrics
+
+    return DeviceMetrics(
+        usage_reader=usage_reader, registry=CollectorRegistry()
+    )
+
+
+async def start_http_stack(socket_dir, topology: str = "v5e-4",
+                           **cfg_kwargs):
+    """``start_stack`` plus the HTTP control plane on an ephemeral port
+    with a per-stack registry; returns ``(kubelet, manager, task,
+    backend, server, http_task, stop, base_url)``. The chip-observability
+    tests and ``make bench-chip-obs`` both boot their plugin nodes here
+    — /debug/allocations, /debug/topology and /metrics all live."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.server.server import Server
+
+    cfg_kwargs.setdefault("web_listen_address", "127.0.0.1:0")
+    kubelet, manager, task, backend = await start_stack(
+        socket_dir, topology, **cfg_kwargs
+    )
+    server = Server(
+        manager.cfg, manager, manager.ready,
+        registry=CollectorRegistry(),
+    )
+    stop = asyncio.Event()
+    http_task = asyncio.create_task(server.run(stop))
+    while server.port is None:
+        if http_task.done():
+            await http_task  # already done: surface the bind failure
+        await asyncio.sleep(0.01)
+    base = f"http://127.0.0.1:{server.port}"
+    return kubelet, manager, task, backend, server, http_task, stop, base
+
+
+async def stop_http_stack(kubelet, manager, task, http_task, stop) -> None:
+    stop.set()
+    await asyncio.wait_for(http_task, 10)
+    await stop_stack(kubelet, manager, task)
+
+
 async def stop_stack(kubelet, manager, task) -> None:
     await manager.stop()
     await asyncio.wait_for(task, 10)

@@ -95,6 +95,7 @@ class Server:
         self.routes = {
             "/", "/health", "/metrics", "/restart",
             "/debug/traces", "/debug/traces/{trace_id}", "/debug/profile",
+            "/debug/allocations", "/debug/topology",
         }
         self.app = self._build_app()
         self._runner: web.AppRunner | None = None
@@ -118,6 +119,8 @@ class Server:
         app.router.add_get("/debug/traces", self._debug_traces)
         app.router.add_get("/debug/traces/{trace_id}", self._debug_trace_one)
         app.router.add_get("/debug/profile", self._debug_profile)
+        app.router.add_get("/debug/allocations", self._debug_allocations)
+        app.router.add_get("/debug/topology", self._debug_topology)
         return app
 
     # --- handlers (≙ router/api.go) ---
@@ -181,6 +184,71 @@ class Server:
                 status=404,
             )
         return web.json_response(success(payload))
+
+    async def _debug_allocations(self, request: web.Request) -> web.Response:
+        """The allocation journal (plugin/journal.py): every Allocate,
+        preferred-allocation decision, and chip-health transition as a
+        sequenced event. Shares the ``?limit=``/``?since=`` surface with
+        /debug/traces — here ``since`` means event seq."""
+        from k8s_gpu_device_plugin_tpu.obs.http import parse_trace_query
+
+        try:
+            limit, since = parse_trace_query(
+                request.query, since_desc="event seq"
+            )
+        except ValueError as e:
+            return web.json_response(failed(str(e)), status=400)
+        return web.json_response(
+            success(self.manager.journal.events_payload(limit=limit, since=since))
+        )
+
+    async def _debug_topology(self, request: web.Request) -> web.Response:
+        """Chip map + ICI links + ownership: the physical grid this host
+        advertises, which device (and which live allocation) owns each
+        chip, and the torus edges between them."""
+        topo = self.manager.backend.host_topology()
+        owners = self.manager.journal.owners()
+        # health + device membership from the live (health-applied) sets
+        chip_health: dict[int, str] = {}
+        chip_device: dict[int, dict] = {}
+        devices: dict[str, list] = {}
+        for resource, chips in sorted(self.manager.live_chip_map().items()):
+            rows = []
+            for chip in chips.iter_sorted():
+                rows.append({
+                    "id": chip.id,
+                    "health": chip.health,
+                    "chip_indices": list(chip.chip_indices),
+                    "coords": [list(c) for c in chip.coords],
+                })
+                for idx in chip.chip_indices:
+                    chip_health[idx] = chip.health
+                    chip_device[idx] = {"resource": resource, "id": chip.id}
+            devices[resource] = rows
+        coords = topo.coords()
+        links: set = set()
+        for coord in coords:
+            a = topo.index_of(coord)
+            for n in topo.neighbors(coord):
+                b = topo.index_of(n)
+                links.add((min(a, b), max(a, b)))
+        return web.json_response(success({
+            "generation": topo.generation.name,
+            "bounds": list(topo.bounds),
+            "num_chips": topo.num_chips,
+            "chips": [
+                {
+                    "index": topo.index_of(coord),
+                    "coord": list(coord),
+                    "health": chip_health.get(topo.index_of(coord), ""),
+                    "device": chip_device.get(topo.index_of(coord)),
+                    "owner": owners.get(topo.index_of(coord)),
+                }
+                for coord in coords
+            ],
+            "links": [list(pair) for pair in sorted(links)],
+            "devices": devices,
+        }))
 
     # --- middleware (≙ echo Recover + request logger, server/server.go:40-43) ---
 

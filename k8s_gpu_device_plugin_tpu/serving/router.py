@@ -334,6 +334,12 @@ class ReplicaRouter:
         slow_stream_ms: float = 0.0,  # SLO-breach retention threshold
         # for the router flight recorder (resumed/failed-over/error
         # streams are always retained; 0 = only those)
+        plugins: "list[tuple[str, str]] | None" = None,  # device-plugin
+        # control planes to federate: [(node_id, base_url)]. Their
+        # /metrics joins /fleet/metrics (node= relabeling + fleet chip
+        # aggregates) and their /debug/allocations journals join
+        # /fleet/events with plane="plugin". None/empty leaves both
+        # surfaces byte-identical to the replica-only fleet.
     ):
         if policy not in ("affinity", "rr"):
             raise ValueError(
@@ -420,6 +426,7 @@ class ReplicaRouter:
         self._unjournaled = 0      # streams served past journal_limit
         self._refused: dict[str, int] = {}
         self._outcomes: dict[str, int] = {}
+        self.plugins: "list[tuple[str, str]]" = list(plugins or [])
         self._session: aiohttp.ClientSession | None = None
         self._poll_task: asyncio.Task | None = None
         self.app = web.Application(middlewares=[self._trace_middleware])
@@ -1696,6 +1703,28 @@ class ReplicaRouter:
             *(one(rep) for rep in self.fleet.all())
         ))
 
+    async def _plugin_fan_out_get(
+        self, path: str
+    ) -> "list[tuple[str, int | None, str | None]]":
+        """``_fan_out_get`` over the configured device-plugin control
+        planes -> ``[(node_id, status, body_text)]`` in spec order."""
+
+        async def one(node: str, base: str):
+            try:
+                async with self._session.get(
+                    f"{base}{path}",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.connect_timeout_s
+                    ),
+                ) as resp:
+                    return node, resp.status, await resp.text()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return node, None, None
+
+        return list(await asyncio.gather(
+            *(one(node, base) for node, base in self.plugins)
+        ))
+
     async def _debug_traces(self, request: web.Request) -> web.Response:
         """The router's OWN trace ring — the third ``/debug/traces``
         plane, accepting the same ``?limit=``/``?since=`` query surface
@@ -1792,8 +1821,24 @@ class ReplicaRouter:
                 errors.append(rid)
                 continue
             scrapes.append((rid, text))
+        plugin_scrapes: "list | None" = None
+        plugin_errors: "list[str] | None" = None
+        if self.plugins:
+            # the plugin plane federates alongside: its /metrics serves
+            # the classic format (no exemplars plane-side), which the
+            # relabeler merges into either output format
+            plugin_scrapes, plugin_errors = [], []
+            for node, status, text in await self._plugin_fan_out_get(
+                "/metrics"
+            ):
+                if status != 200 or text is None:
+                    plugin_errors.append(node)
+                    continue
+                plugin_scrapes.append((node, text))
         body = federate_metrics(scrapes, openmetrics=openmetrics,
-                                scrape_errors=errors)
+                                scrape_errors=errors,
+                                plugin_scrapes=plugin_scrapes,
+                                plugin_scrape_errors=plugin_errors)
         if openmetrics:
             from prometheus_client.openmetrics.exposition import (
                 CONTENT_TYPE_LATEST,
@@ -1817,9 +1862,45 @@ class ReplicaRouter:
             )
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
-        return web.json_response(
-            self.journal.events_payload(limit=limit, since=since)
-        )
+        payload = self.journal.events_payload(limit=limit, since=since)
+        if not self.plugins:
+            return web.json_response(payload)
+        # merge the plugin allocation journals in: fleet events first
+        # (by their seq), then each node's events in spec order (by
+        # that node's own seq) — deterministic, never wall-clock. The
+        # ``plane`` discriminator is stamped at merge time so neither
+        # journal stores a field only this endpoint needs; ``since`` /
+        # ``limit`` forward to each plugin journal (their own seq
+        # spaces — one cursor idiom, per-plane cursors).
+        for e in payload["events"]:
+            e["plane"] = "fleet"
+        query = []
+        if limit is not None:
+            query.append(f"limit={limit}")
+        if since is not None:
+            query.append(f"since={since}")
+        qs = ("?" + "&".join(query)) if query else ""
+        plugin_errors: list[str] = []
+        for node, status, text in await self._plugin_fan_out_get(
+            f"/debug/allocations{qs}"
+        ):
+            if status != 200 or text is None:
+                plugin_errors.append(node)
+                continue
+            try:
+                data = json.loads(text).get("data") or {}
+            except (ValueError, AttributeError):
+                plugin_errors.append(node)
+                continue
+            for e in data.get("events", ()):
+                e["plane"] = "plugin"
+                e["node"] = node
+                payload["events"].append(e)
+        payload["returned"] = len(payload["events"])
+        payload["plugin_nodes"] = [node for node, _ in self.plugins]
+        if plugin_errors:
+            payload["plugin_errors"] = plugin_errors
+        return web.json_response(payload)
 
     async def _fleet_requests(self, request: web.Request) -> web.Response:
         if self._recorder is None:
@@ -1942,6 +2023,14 @@ def _main(argv: list[str] | None = None) -> int:
                         "the flight recorder (GET /fleet/debug/"
                         "requests): the proxy hot path then pays only "
                         "is-not-None guards")
+    parser.add_argument("--plugins", default="",
+                        help="device-plugin control planes to federate: "
+                        "comma list of [id=]http://host:port (id "
+                        "defaults to host:port). Their /metrics joins "
+                        "GET /fleet/metrics with node= relabeling plus "
+                        "fleet chip aggregates, and their allocation "
+                        "journals join GET /fleet/events with "
+                        "plane=\"plugin\"; empty = replica-only fleet")
     parser.add_argument("--slowStreamMs", type=float, default=0.0,
                         help="flight-recorder SLO threshold: streams "
                         "whose router wall time reaches this are "
@@ -1975,6 +2064,17 @@ def _main(argv: list[str] | None = None) -> int:
 
     fault_plane = FaultPlane.from_cli(args.faults)
 
+    plugins: "list[tuple[str, str]]" = []
+    for entry in (e.strip() for e in args.plugins.split(",")):
+        if not entry:
+            continue
+        if "=" in entry:
+            node, _, url = entry.partition("=")
+        else:
+            url = entry
+            node = url.split("://", 1)[-1].rstrip("/")
+        plugins.append((node.strip(), url.strip().rstrip("/")))
+
     fleet = FleetRegistry.from_spec(args.replicas, dead_after=args.deadAfter)
     router = ReplicaRouter(
         fleet, host=args.host, port=args.port, policy=args.policy,
@@ -1991,6 +2091,7 @@ def _main(argv: list[str] | None = None) -> int:
         slow_stream_ms=args.slowStreamMs,
         registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
         faults=fault_plane,
+        plugins=plugins,
     )
 
     async def serve():

@@ -142,6 +142,7 @@ class InferenceEngine:
         mfu=None,  # metrics.roofline.MfuAccumulator (or None)
         supervisor: "EngineSupervisor | None" = None,  # None = default budget
         faults=None,  # serving.faults.FaultPlane (or None = disarmed)
+        devices=None,  # device.allocation.AllocatedDevices (or None)
     ):
         # ``batcher`` injects a pre-built engine (e.g. a
         # SpeculativeBatcher); the scheduling/stream logic is identical
@@ -195,6 +196,12 @@ class InferenceEngine:
                 "constructor; silently ignoring it here would leave "
                 "every armed engine-side fault point disarmed"
             )
+        if batcher is not None and devices is not None:
+            raise ValueError(
+                "pass devices to the injected batcher's own constructor; "
+                "silently ignoring them here would attribute every "
+                "request to no silicon while reporting chips allocated"
+            )
         if batcher is not None and supervisor is not None:
             raise ValueError(
                 "crash recovery requires the engine-built batcher: an "
@@ -231,6 +238,7 @@ class InferenceEngine:
                     kv_layout=kv_layout, kv_page_size=kv_page_size,
                     kv_pages=kv_pages, scheduler=scheduler, tp=tp,
                     attribution=attribution, mfu=mfu, faults=faults,
+                    devices=devices,
                 )
 
             self.cb = make_batcher()
@@ -453,6 +461,12 @@ class InferenceEngine:
             # tallies, last crash) — the supervisor's own snapshot
             # method, same thread contract as kv_stats/sched_stats
             out["supervisor"] = self.supervisor.stats()
+        devices = getattr(self.cb, "devices", None)
+        if devices is not None:
+            # the physical chips under this engine (device/allocation.py):
+            # allocation id + chip indices, frozen at startup — the
+            # request->chip attribution join key on /v1/health
+            out["devices"] = devices.as_dict()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -793,6 +807,15 @@ class InferenceServer:
             getattr(engine.cb, "adapter_names", ())
         )
         self.tracer = get_tracer()
+        # chip attribution (device/allocation.py): frozen at startup, so
+        # the extra span attrs are a precomputed dict — {} costs the hot
+        # path one empty **splat when no devices are known
+        devices = getattr(engine.cb, "devices", None)
+        self._device_attrs = (
+            {"chips": devices.chips_label(),
+             "allocation_id": devices.allocation_id}
+            if devices is not None else {}
+        )
         self.app = web.Application(middlewares=[self._trace_middleware])
         self.app.router.add_post("/v1/generate", self._generate)
         self.app.router.add_get("/v1/health", self._health)
@@ -886,7 +909,7 @@ class InferenceServer:
             f"{request.method} {route_label(request)}",
             component="serving_http",
             parent=remote, method=request.method, path=request.path,
-            replica=self.replica_label(),
+            replica=self.replica_label(), **self._device_attrs,
         ) as span:
             try:
                 response = await handler(request)
@@ -1611,6 +1634,16 @@ def _main(argv: list[str] | None = None) -> int:
                         help="stable fleet identity reported on "
                         "/v1/health (serving/router.py's registry and "
                         "dashboards key on it); empty = hostname:port")
+    parser.add_argument("--devices", default="auto",
+                        help="request->chip attribution (device/"
+                        "allocation.py): 'auto' reads the device "
+                        "plugin's container env contract "
+                        "(TPU_VISIBLE_CHIPS + TPU_ALLOCATION_ID), "
+                        "'off' disables it, or an explicit "
+                        "'[alloc-id:]chip,chip,...' spec pins it — "
+                        "spans, timelines, /v1/health and the "
+                        "kv_shard_chip gauge then name the physical "
+                        "chips under this replica")
     parser.add_argument("--restartBudget", type=int, default=3,
                         help="engine crash recoveries allowed per "
                         "rolling --restartWindowS window (serving/"
@@ -1832,6 +1865,18 @@ def _main(argv: list[str] | None = None) -> int:
 
     fault_plane = FaultPlane.from_cli(args.faults)
 
+    # Request->chip attribution (device/allocation.py): under the device
+    # plugin the container env names the allocated chips; 'auto' quietly
+    # yields None elsewhere (dev boxes), an explicit spec fails loudly.
+    from k8s_gpu_device_plugin_tpu.device.allocation import AllocatedDevices
+
+    if args.devices == "auto":
+        devices = AllocatedDevices.from_env()
+    elif args.devices == "off":
+        devices = None
+    else:
+        devices = AllocatedDevices.from_spec(args.devices)
+
     batcher = None
     if args.draftPreset:
         from k8s_gpu_device_plugin_tpu.models.spec_batching import (
@@ -1863,6 +1908,7 @@ def _main(argv: list[str] | None = None) -> int:
             attribution=attribution,
             mfu=mfu,
             faults=fault_plane,
+            devices=devices,
         )
     engine = InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.maxLen,
@@ -1889,6 +1935,7 @@ def _main(argv: list[str] | None = None) -> int:
             max_restarts=args.restartBudget, window_s=args.restartWindowS,
         ),
         faults=None if batcher is not None else fault_plane,
+        devices=None if batcher is not None else devices,
     )
     from prometheus_client import REGISTRY
 

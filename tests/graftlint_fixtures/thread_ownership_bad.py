@@ -62,13 +62,28 @@ class FleetRegistry:
                              list(self._replicas.items())}}
 
 
+class Journal:
+    """The plugin/journal.py shape: the two-tier event rings and the
+    live-ownership table are manager-loop state; the HTTP handlers
+    (/debug/allocations, /debug/topology) must go through the
+    events_payload()/owners() snapshots."""
+
+    def __init__(self):
+        self._events = []  # owner: engine
+        self._owners = {}  # owner: engine
+
+    def events_payload(self):
+        return {"events": [dict(e) for e in list(self._events)]}
+
+
 class Server:
-    def __init__(self, cb, sched, rec, sup, fleet):
+    def __init__(self, cb, sched, rec, sup, fleet, journal):
         self.cb = cb
         self.sched = sched
         self.rec = rec
         self.sup = sup
         self.fleet = fleet
+        self.journal = journal
 
     async def health(self, request):
         return {
@@ -86,6 +101,13 @@ class Server:
         return {
             "alive": [r for r in self.fleet._replicas.values()],
             "total": len(self.fleet._replicas),  # OK: atomic len
+        }
+
+    async def allocations(self, request):
+        return {
+            "resident": len(self.journal._events),     # OK: atomic len
+            "events": list(self.journal._events),      # BAD: ring iteration races
+            "owners": dict(self.journal._owners),      # BAD: table copy races
         }
 
     async def slow(self, request):

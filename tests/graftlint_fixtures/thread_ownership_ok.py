@@ -71,13 +71,32 @@ class FleetRegistry:
                              list(self._replicas.items())}}
 
 
+class Journal:
+    """The plugin/journal.py shape: the event rings and the ownership
+    table cross out of the manager loop only through the
+    events_payload()/owners() snapshot accessors."""
+
+    def __init__(self):
+        self._events = []  # owner: engine
+        self._owners = {}  # owner: engine
+
+    def events_payload(self):
+        # manager-state snapshot: list() before iterating, copies out
+        return {
+            "total": len(list(self._events)),
+            "events": [dict(e) for e in list(self._events)],
+            "owners": {k: dict(v) for k, v in list(self._owners.items())},
+        }
+
+
 class Server:
-    def __init__(self, cb, sched, rec, sup, fleet):
+    def __init__(self, cb, sched, rec, sup, fleet, journal):
         self.cb = cb
         self.sched = sched
         self.rec = rec
         self.sup = sup
         self.fleet = fleet
+        self.journal = journal
 
     async def health(self, request):
         return {
@@ -91,6 +110,12 @@ class Server:
         # the PR-15 discipline: ONE snapshot accessor for the whole
         # fleet-health surface, no inline per-replica recomputation
         return self.fleet.fleet_stats()
+
+    async def allocations(self, request):
+        return {
+            "resident": len(self.journal._events),  # atomic len: sanctioned
+            **self.journal.events_payload(),        # the journal boundary
+        }
 
     async def slow(self, request):
         return self.rec.slow_stats()  # the flight-recorder boundary

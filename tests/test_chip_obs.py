@@ -1,0 +1,456 @@
+"""Chip-level observability plane (plugin/journal.py +
+device/allocation.py + the federation/attribution seams).
+
+The acceptance pin this file exists for: one ``Allocate`` through the
+fake-backend plugin stack, an engine started with the resulting env
+contract, and one served request yield a ``/debug/allocations`` entry,
+a serving request timeline, and a stitched trace that all name the
+SAME physical chip ids — and ``/fleet/metrics`` including the plugin
+series parses under the strict OpenMetrics parser. Unit tests cover
+the pure pieces (AllocatedDevices parsing, the journal's two-tier
+ring + deterministic replay, the tp shard→chip mapping)."""
+
+import asyncio
+
+import aiohttp
+import jax
+import pytest
+from prometheus_client import CollectorRegistry
+
+from k8s_gpu_device_plugin_tpu.device.allocation import AllocatedDevices
+from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.obs.trace import configure
+from k8s_gpu_device_plugin_tpu.plugin import api
+from k8s_gpu_device_plugin_tpu.plugin.api import pb
+from k8s_gpu_device_plugin_tpu.plugin.journal import AllocationJournal
+from k8s_gpu_device_plugin_tpu.plugin.testing import (
+    start_http_stack,
+    stop_http_stack,
+)
+from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+from k8s_gpu_device_plugin_tpu.serving.testing import (
+    inprocess_fleet,
+    stream_generate,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture()
+def tracer():
+    t = configure(enabled=True)
+    t.clear()
+    yield t
+    configure(enabled=False)
+    t.clear()
+
+
+# --- AllocatedDevices (pure) ------------------------------------------------
+
+
+def test_allocated_devices_env_and_spec_parsing():
+    env = {
+        "TPU_VISIBLE_CHIPS": "2,0,1",
+        "TPU_ALLOCATION_ID": "alloc-7",
+        "TPU_ACCELERATOR_TYPE": "v5e-3",
+    }
+    d = AllocatedDevices.from_env(env)
+    assert d is not None
+    assert d.chips == (0, 1, 2)          # sorted
+    assert d.allocation_id == "alloc-7"
+    assert d.generation == "v5e"
+    assert d.source == "env"
+    assert d.chips_label() == "0,1,2"
+    assert d.shard_chip(0) == 0 and d.shard_chip(2) == 2
+    assert d.shard_chip(3) is None and d.shard_chip(-1) is None
+    assert d.as_dict()["chips"] == [0, 1, 2]
+
+    # absent / garbage env -> None (attribution silently off, the
+    # engine must still serve)
+    assert AllocatedDevices.from_env({}) is None
+    assert AllocatedDevices.from_env({"TPU_VISIBLE_CHIPS": "x,y"}) is None
+
+    # explicit spec: with and without the alloc-id prefix
+    s = AllocatedDevices.from_spec("job-1:4,5")
+    assert s.allocation_id == "job-1" and s.chips == (4, 5)
+    bare = AllocatedDevices.from_spec("0,1")
+    assert bare.allocation_id == "" and bare.chips == (0, 1)
+    for garbage in ("", "a,b", "1,,2", "id:"):
+        with pytest.raises(ValueError):
+            AllocatedDevices.from_spec(garbage)
+
+
+# --- AllocationJournal (pure) -----------------------------------------------
+
+
+def test_allocation_journal_two_tier_paging_and_replay():
+    j = AllocationJournal(maxlen=8, rare_maxlen=4)
+    aid = j.next_allocation_id()
+    assert aid == "alloc-1"
+    j.emit("allocate", allocation_id=aid, resource="google.com/tpu",
+           devices=["d0"], chips=[0, 1], coords=[[0, 0], [1, 0]])
+    j.emit("preferred_allocation", resource="google.com/tpu", size=2,
+           available=4, must_include=[], preferred=["d0"])
+    # the storm: a flapping chip's transitions are the FREQUENT tier
+    # here (inverted vs the fleet journal) — they must not evict the
+    # allocation history
+    for i in range(100):
+        j.emit("health_transition", chip=i % 4, old="Healthy",
+               new="Unknown", reason="stale_gauges")
+    payload = j.events_payload()
+    kinds = {e["kind"] for e in payload["events"]}
+    assert {"allocate", "preferred_allocation"} <= kinds
+    seqs = [e["seq"] for e in payload["events"]]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert payload["total"] == 102
+    # paging: since walks forward, limit keeps the OLDEST of the rest
+    page = j.events_payload(limit=1, since=1)
+    assert [e["seq"] for e in page["events"]] == [2]
+    assert page["events"][0]["kind"] == "preferred_allocation"
+    # ownership: last-allocated wins per chip
+    assert j.owners()[0]["allocation_id"] == aid
+    assert j.owners()[1]["devices"] == ["d0"]
+    # replay strips exactly the nondeterministic fields
+    replay = AllocationJournal.replay(payload["events"])
+    assert all("t" not in e and "trace_id" not in e for e in replay)
+    assert replay[0] == {
+        "seq": 1, "kind": "allocate", "allocation_id": "alloc-1",
+        "resource": "google.com/tpu", "devices": ["d0"],
+        "chips": [0, 1], "coords": [[0, 0], [1, 0]],
+    }
+    # allocation ids stay monotonic across emits
+    assert j.next_allocation_id() == "alloc-2"
+    assert j.stats()["allocations"] == 2
+
+
+# --- engine wiring (refusal + tp shard→chip) --------------------------------
+
+
+def test_injected_batcher_refuses_engine_level_devices(setup):
+    cfg, params = setup
+    donor = InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            chunked_prefill=8)
+    try:
+        with pytest.raises(ValueError, match="injected batcher"):
+            InferenceEngine(
+                params, cfg, batcher=donor.cb,
+                devices=AllocatedDevices.from_spec("0,1"),
+            )
+    finally:
+        donor.shutdown()
+
+
+def test_tp_shards_carry_chip_mapping(setup):
+    """Under tp>1 each kv shard names its physical chip on /v1/health's
+    kv view and the ``tpu_serving_kv_shard_chip`` gauge (shard i ->
+    chips[i], the plugin's own chip indices)."""
+    cfg, params = setup
+    reg = CollectorRegistry()
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        kv_layout="paged", kv_page_size=8, tp=2,
+        metrics=ServingMetrics(registry=reg),
+        devices=AllocatedDevices.from_spec("alloc-9:4,6"),
+    )
+    try:
+        kv = engine.cb.kv_stats()
+        assert [s["chip"] for s in kv["shards"]] == [4, 6]
+        assert engine.stats()["devices"]["allocation_id"] == "alloc-9"
+        sample = reg.get_sample_value(
+            "tpu_serving_kv_shard_chip", {"shard": "0", "chip": "4"}
+        )
+        assert sample == 1.0
+    finally:
+        engine.shutdown()
+
+
+# --- E2E: the acceptance pin ------------------------------------------------
+
+
+async def _allocate_whole_host(kubelet, manager):
+    """Allocate every chip of the booted stack's one plugin; returns
+    the env contract the container would see."""
+    await kubelet.wait_for_registrations(1)
+    reg = kubelet.registrations[0]
+    chips = manager.plugins[0].chips
+    async with kubelet.plugin_channel(reg.endpoint) as channel:
+        stub = api.DevicePluginStub(channel)
+        resp = await stub.Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=chips.ids())
+            ])
+        )
+    return dict(resp.container_responses[0].envs)
+
+
+def test_chip_attribution_end_to_end(setup, tracer, tmp_path):
+    """Allocate -> engine startup -> one served request: the journal
+    entry, the request timeline, and the stitched trace all name the
+    SAME chip ids; /fleet/metrics with the plugin series parses under
+    the strict OpenMetrics parser; /debug/topology maps ownership."""
+    cfg, params = setup
+
+    async def body():
+        stack = await start_http_stack(tmp_path, "v5e-4")
+        kubelet, manager, task, backend, server, http_task, stop, base = \
+            stack
+        try:
+            envs = await _allocate_whole_host(kubelet, manager)
+            devices = AllocatedDevices.from_env(envs)
+            assert devices is not None
+            chip_ids = list(devices.chips)
+            assert chip_ids == [0, 1, 2, 3]
+            assert devices.allocation_id  # the plugin stamped the key
+
+            def engine_factory(i):
+                from k8s_gpu_device_plugin_tpu.obs.attribution import (
+                    RequestAttributor,
+                )
+
+                return InferenceEngine(
+                    params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+                    metrics=ServingMetrics(registry=CollectorRegistry()),
+                    attribution=RequestAttributor(),
+                    devices=devices,
+                )
+
+            def server_factory(i, engine):
+                from k8s_gpu_device_plugin_tpu.serving.server import (
+                    InferenceServer,
+                )
+
+                return InferenceServer(
+                    engine, host="127.0.0.1", port=0, replica_id=f"r{i}",
+                    registry=engine.cb.metrics._registry,
+                )
+
+            async with inprocess_fleet(
+                params, cfg, n_replicas=1,
+                engine_factory=engine_factory,
+                server_factory=server_factory,
+                router_kw=dict(health_interval_s=0.1,
+                               plugins=[("node0", base)]),
+            ) as ctx:
+                async with aiohttp.ClientSession() as session:
+                    stream = await stream_generate(
+                        session, ctx.base, prompt=[5, 6, 7, 8], max_new=4,
+                    )
+                    assert stream["done"] and len(stream["tokens"]) == 4
+
+                    # 1) the journal entry (plugin plane)
+                    async with session.get(
+                        f"{base}/debug/allocations"
+                    ) as r:
+                        assert r.status == 200
+                        alloc_page = (await r.json())["data"]
+                    allocs = [e for e in alloc_page["events"]
+                              if e["kind"] == "allocate"]
+                    assert len(allocs) == 1
+                    assert allocs[0]["chips"] == chip_ids
+                    assert allocs[0]["allocation_id"] == \
+                        devices.allocation_id
+                    assert len(allocs[0]["coords"]) == len(chip_ids)
+
+                    # shared query surface: paging + 400-on-garbage
+                    async with session.get(
+                        f"{base}/debug/allocations?limit=1"
+                    ) as r:
+                        page = (await r.json())["data"]
+                    assert page["returned"] == 1
+                    assert page["total"] == alloc_page["total"]
+                    last_seq = alloc_page["events"][-1]["seq"]
+                    async with session.get(
+                        f"{base}/debug/allocations?since={last_seq}"
+                    ) as r:
+                        assert (await r.json())["data"]["events"] == []
+                    for bad in ("limit=x", "limit=-1", "since=nope"):
+                        async with session.get(
+                            f"{base}/debug/allocations?{bad}"
+                        ) as r:
+                            assert r.status == 400
+
+                    # 2) the request timeline (serving plane)
+                    async with session.get(
+                        f"{ctx.replica_base(0)}/debug/requests"
+                    ) as r:
+                        reqs = (await r.json())["requests"]
+                    assert reqs
+                    record = reqs[0]
+                    assert record["chips"] == devices.chips_label()
+                    assert record["allocation_id"] == \
+                        devices.allocation_id
+                    tid = record["trace_id"]
+                    assert tid
+
+                    # /v1/health carries the frozen device set
+                    async with session.get(
+                        f"{ctx.replica_base(0)}/v1/health"
+                    ) as r:
+                        health = await r.json()
+                    assert health["devices"]["chips"] == chip_ids
+                    assert health["devices"]["allocation_id"] == \
+                        devices.allocation_id
+
+                    # 3) the stitched trace names the same chips
+                    await asyncio.sleep(0.3)  # span tree closes async
+                    async with session.get(
+                        f"{ctx.base}/fleet/debug/traces/{tid}"
+                    ) as r:
+                        assert r.status == 200
+                        stitched = await r.json()
+                    chip_spans = [
+                        e for e in stitched["traceEvents"]
+                        if e.get("ph") == "X"
+                        and e["args"].get("chips")
+                    ]
+                    assert chip_spans
+                    assert {e["args"]["chips"] for e in chip_spans} == \
+                        {devices.chips_label()}
+                    assert {e["args"]["allocation_id"]
+                            for e in chip_spans} == \
+                        {devices.allocation_id}
+
+                    # 4) /fleet/events merges the plugin journal in
+                    async with session.get(
+                        f"{ctx.base}/fleet/events"
+                    ) as r:
+                        events = await r.json()
+                    assert events["plugin_nodes"] == ["node0"]
+                    plugin_events = [e for e in events["events"]
+                                     if e.get("plane") == "plugin"]
+                    assert plugin_events
+                    assert {e["node"] for e in plugin_events} == {"node0"}
+                    merged_alloc = next(
+                        e for e in plugin_events if e["kind"] == "allocate"
+                    )
+                    assert merged_alloc["chips"] == chip_ids
+                    assert all(e.get("plane") == "fleet"
+                               for e in events["events"]
+                               if "node" not in e)
+
+                    # 5) federation: plugin series + chip aggregates
+                    # parse under BOTH parsers (strict OpenMetrics pinned)
+                    async with session.get(
+                        f"{ctx.base}/fleet/metrics"
+                    ) as r:
+                        assert r.status == 200
+                        classic = await r.text()
+                    async with session.get(
+                        f"{ctx.base}/fleet/metrics",
+                        headers={
+                            "Accept": "application/openmetrics-text"
+                        },
+                    ) as r:
+                        assert "openmetrics" in r.headers["Content-Type"]
+                        om = await r.text()
+                    from prometheus_client.openmetrics.parser import (
+                        text_string_to_metric_families as parse_om,
+                    )
+                    from prometheus_client.parser import (
+                        text_string_to_metric_families as parse_classic,
+                    )
+
+                    for fams in (
+                        {f.name: f for f in parse_classic(classic)},
+                        {f.name: f for f in parse_om(om)},
+                    ):
+                        chips_fam = fams["tpu_plugin_chips"]
+                        assert all(
+                            s.labels.get("node") == "node0"
+                            for s in chips_fam.samples
+                        )
+                        healthy = next(
+                            s for s in fams["tpu_fleet_chips"].samples
+                            if s.labels["state"] == "healthy"
+                        )
+                        assert healthy.value == 4
+                        assert fams["tpu_fleet_plugin_nodes"] \
+                            .samples[0].value == 1
+                        assert fams["tpu_fleet_plugin_scrape_errors"] \
+                            .samples[0].value == 0
+                        # serving series still replica-labeled alongside
+                        tok = fams["tpu_serving_generated_tokens"]
+                        assert {s.labels["replica"]
+                                for s in tok.samples} == {"r0"}
+
+                    # 6) /debug/topology: grid + links + ownership
+                    async with session.get(f"{base}/debug/topology") as r:
+                        assert r.status == 200
+                        topo = (await r.json())["data"]
+                    assert topo["num_chips"] == 4
+                    assert len(topo["chips"]) == 4
+                    for chip in topo["chips"]:
+                        assert chip["health"] == "Healthy"
+                        assert chip["owner"]["allocation_id"] == \
+                            devices.allocation_id
+                        assert chip["device"]["resource"]
+                    assert topo["links"]  # a v5e-4 grid has ICI edges
+                    assert all(
+                        0 <= a < 4 and 0 <= b < 4
+                        for a, b in topo["links"]
+                    )
+        finally:
+            await stop_http_stack(kubelet, manager, task, http_task, stop)
+
+    run(body())
+
+
+# --- replay determinism (the fleet journal pin, plugin plane) ---------------
+
+
+def test_plugin_journal_replay_determinism_under_health_flap(tmp_path):
+    """Two same-seed runs — Allocate, chip 2 dies, chip 2 recovers —
+    replay IDENTICAL plugin journals (wall time and trace ids are the
+    only divergence), the fleet journal's determinism contract extended
+    to the plugin plane."""
+
+    async def one_run(socket_dir):
+        stack = await start_http_stack(socket_dir, "v5e-4",
+                                       health_interval=0.05)
+        kubelet, manager, task, backend, server, http_task, stop, base = \
+            stack
+        try:
+            await _allocate_whole_host(kubelet, manager)
+
+            async def wait_health(idx, state):
+                for _ in range(100):
+                    await asyncio.sleep(0.05)
+                    chips = manager.plugins[0].chips
+                    by_idx = {
+                        i: c.health for c in chips.values()
+                        for i in c.chip_indices
+                    }
+                    if by_idx.get(idx) == state:
+                        return
+                raise AssertionError(
+                    f"chip {idx} never reached {state}"
+                )
+
+            backend.set_unhealthy(2)
+            await wait_health(2, "Unhealthy")
+            backend.set_healthy(2)
+            await wait_health(2, "Healthy")
+            return manager.journal.events_payload()["events"]
+        finally:
+            await stop_http_stack(kubelet, manager, task, http_task, stop)
+
+    events_a = run(one_run(tmp_path / "a"))
+    events_b = run(one_run(tmp_path / "b"))
+    replay_a = AllocationJournal.replay(events_a)
+    replay_b = AllocationJournal.replay(events_b)
+    assert replay_a == replay_b
+    kinds = [e["kind"] for e in replay_a]
+    assert kinds.count("health_transition") == 2
+    flips = [e for e in replay_a if e["kind"] == "health_transition"]
+    assert [e["reason"] for e in flips] == ["node_unhealthy", "recovered"]
+    assert all(e["chip"] == 2 for e in flips)

@@ -100,18 +100,22 @@ def test_hot_path_flags_transfer_and_carry():
 def test_thread_ownership_allows_atomic_len():
     bad = os.path.join(FIXTURES, "thread_ownership_bad.py")
     found = _run_on(bad, [_checker("thread-ownership")])
-    # the len(self.cb.running), len(self.sup._restart_times) and
-    # len(self.fleet._replicas) reads on the handlers must NOT fire;
-    # the iteration/copy/pool reads must — the scheduler-shaped ledger
-    # reads (serving/scheduler.py state), the flight-recorder ring
-    # (obs/attribution.py state), the supervisor's crash-recovery
-    # ledgers (serving/supervisor.py state) and the fleet registry's
-    # replica map recomputed inline (serving/fleet.py state — the
-    # PR-15 /fleet/health fix) fire the same way
-    assert len(found) == 9
+    # the len(self.cb.running), len(self.sup._restart_times),
+    # len(self.fleet._replicas) and len(self.journal._events) reads on
+    # the handlers must NOT fire; the iteration/copy/pool reads must —
+    # the scheduler-shaped ledger reads (serving/scheduler.py state),
+    # the flight-recorder ring (obs/attribution.py state), the
+    # supervisor's crash-recovery ledgers (serving/supervisor.py
+    # state), the fleet registry's replica map recomputed inline
+    # (serving/fleet.py state — the PR-15 /fleet/health fix) and the
+    # allocation journal's event ring + ownership table
+    # (plugin/journal.py state — the PR-16 /debug/allocations surface)
+    # fire the same way
+    assert len(found) == 11
     assert {v.key for v in found} == {
         "running", "pool", "_tenants", "rejections", "_slow_ring",
-        "_last_crash", "_restart_times", "_replicas",
+        "_last_crash", "_restart_times", "_replicas", "_events",
+        "_owners",
     }
 
 

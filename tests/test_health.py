@@ -293,6 +293,133 @@ def test_manager_pushes_unknown_on_stale_runtime_endpoint(tmp_path):
         server.stop()
 
 
+def test_assessor_reasons_and_scrape_failure_branch():
+    """``last_reasons`` names WHY each verdict is what it is — and a
+    scrape that raises (the best-effort branch: warning, empty live set,
+    liveness history kept) lets previously-seen chips go stale against
+    it instead of wedging the assessor."""
+
+    class _FlakyReader:
+        def __init__(self):
+            self.n = 0
+
+        def read_status(self):
+            self.n += 1
+            if self.n == 1:
+                return {0: object(), 1: object()}, "data"
+            raise RuntimeError("scrape exploded")
+
+    clock = _Clock()
+    a = HealthAssessor(reader=_FlakyReader(), stale_after=30.0, clock=clock)
+    assert a.assess({0: True, 1: True, 2: False}) == {
+        0: HEALTHY, 1: HEALTHY, 2: UNHEALTHY,
+    }
+    assert a.last_reasons == {
+        0: "ok", 1: "ok", 2: "node_unhealthy",
+    }
+    # every later scrape raises; history is KEPT, so the seen chips go
+    # stale once the window passes — the scrape-failure branch must not
+    # read as a clean workload exit
+    clock.t = 10.0
+    assert a.assess({0: True, 1: True, 2: False})[0] == HEALTHY
+    clock.t = 45.0
+    assert a.assess({0: True, 1: True, 2: False}) == {
+        0: UNKNOWN, 1: UNKNOWN, 2: UNHEALTHY,
+    }
+    assert a.last_reasons == {
+        0: "stale_gauges", 1: "stale_gauges", 2: "node_unhealthy",
+    }
+
+    # probe-demotion reason
+    clock2 = _Clock()
+    a2 = HealthAssessor(
+        reader=_FakeReader([({}, "absent")]), stale_after=30.0,
+        probe=lambda: False, probe_interval=600.0, clock=clock2,
+    )
+    assert a2.assess({0: True}) == {0: UNKNOWN}
+    assert a2.last_reasons == {0: "probe_failed"}
+
+
+def test_manager_health_recovery_and_allocation_journal(tmp_path):
+    """The full flap under the fake backend: gauges stop (Unknown,
+    reason stale_gauges) then flow again (Healthy) — and the manager's
+    allocation journal carries one ``health_transition`` event per chip
+    per flip, with the assessor's reason (``recovered`` on the way
+    back)."""
+    from k8s_gpu_device_plugin_tpu.plugin.manager import PluginManager
+    from k8s_gpu_device_plugin_tpu.plugin.testing import FakeKubelet
+    from k8s_gpu_device_plugin_tpu.utils.latch import Latch
+
+    server = FakeRuntimeMetricsServer({HBM_USAGE: {i: 1024 for i in range(4)}})
+    port = server.start()
+    clock = _Clock()
+    assessor = HealthAssessor(
+        reader=LibtpuUsageReader(ports=[port], timeout_seconds=2.0),
+        stale_after=5.0,
+        clock=clock,
+    )
+
+    async def body():
+        kubelet = FakeKubelet(str(tmp_path))
+        await kubelet.start()
+        cfg = Config(kubelet_socket_dir=str(tmp_path), libtpu_path="")
+        manager = PluginManager(
+            cfg, Latch(), backend=FakeBackend("v5e-4"),
+            health_interval=0.05, health_assessor=assessor,
+        )
+        task = asyncio.create_task(manager.start())
+        try:
+            await kubelet.wait_for_registrations(1)
+            plugin = manager.plugins[0]
+
+            async def states() -> set[str]:
+                return {c.health for c in plugin.chips.values()}
+
+            async def wait_for(state: str) -> None:
+                for _ in range(100):
+                    await asyncio.sleep(0.05)
+                    if await states() == {state}:
+                        return
+                assert await states() == {state}
+
+            await asyncio.sleep(0.3)
+            assert await states() == {HEALTHY}
+
+            # demote: endpoint reachable but silent past stale_after
+            server.values.clear()
+            clock.t = 60.0
+            await wait_for(UNKNOWN)
+
+            # recover: gauges flow again
+            server.values.update({HBM_USAGE: {i: 1024 for i in range(4)}})
+            clock.t = 61.0
+            await wait_for(HEALTHY)
+
+            events = manager.journal.events_payload()["events"]
+            flips = [e for e in events if e["kind"] == "health_transition"]
+            down = [e for e in flips if e["new"] == UNKNOWN]
+            up = [e for e in flips if e["new"] == HEALTHY]
+            # one event per chip per flip, carrying chip id + reason
+            assert {e["chip"] for e in down} == {0, 1, 2, 3}
+            assert {e["reason"] for e in down} == {"stale_gauges"}
+            assert {e["old"] for e in down} == {HEALTHY}
+            assert {e["chip"] for e in up} == {0, 1, 2, 3}
+            assert {e["reason"] for e in up} == {"recovered"}
+            assert {e["old"] for e in up} == {UNKNOWN}
+            # seqs are monotonic and unique journal-wide
+            seqs = [e["seq"] for e in events]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        finally:
+            await manager.stop()
+            await asyncio.wait_for(task, 10)
+            await kubelet.stop()
+
+    try:
+        asyncio.run(body())
+    finally:
+        server.stop()
+
+
 def test_serving_health_reports_replica_identity():
     """The serving plane's /v1/health carries a stable fleet identity:
     ``replica_id`` (the --replicaId flag; hostname:port when unset) and
