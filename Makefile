@@ -67,9 +67,9 @@ san-test:
 # analyze runs right after lint — fail fast on invariant regressions
 # BEFORE the (slow) native builds and CPU benches burn their minutes.
 ci: lint analyze native native-test san-test bench-host-overhead \
-	bench-prefix-cache bench-paged-kv bench-spec bench-sched bench-tp \
-	bench-obs bench-kernels bench-router bench-chaos bench-fleet-obs \
-	bench-chip-obs
+	bench-prefix-cache bench-paged-kv bench-quant-paged bench-spec \
+	bench-sched bench-tp bench-obs bench-kernels bench-router \
+	bench-chaos bench-fleet-obs bench-chip-obs
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -95,6 +95,16 @@ bench-prefix-cache:
 # decode_step_ms_{dense,paged}, gather_overhead_pct, kv_hbm_saved_pct).
 bench-paged-kv:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.paged_kv_bench
+
+# CPU-runnable smoke: quantized KV caches ON the page pool — asserts a
+# kernel-shaped int8+paged config plans onto the pallas backend (no
+# silent XLA fallback) with a dense-identical stream, then runs the
+# bf16-vs-int8-vs-int4 paged serve A/B and asserts the capacity
+# multipliers (one JSON line with tokens_per_second_paged_{int8,int4},
+# kv_bytes_per_slot_*, prefix_entries_per_gb_*, kv_capacity_x_* — the
+# int8 multiplier is asserted >= 2x).
+bench-quant-paged:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.quant_paged_bench
 
 # CPU-runnable microbench: speculative decoding on the fast path —
 # draft-loop dispatch overhead per accepted token (spec round vs plain
@@ -196,9 +206,10 @@ clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
-	bench-host-overhead bench-prefix-cache bench-paged-kv bench-spec \
-	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-chaos bench-fleet-obs bench-chip-obs clean watch
+	bench-host-overhead bench-prefix-cache bench-paged-kv \
+	bench-quant-paged bench-spec bench-sched bench-tp bench-obs \
+	bench-kernels bench-router bench-chaos bench-fleet-obs \
+	bench-chip-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

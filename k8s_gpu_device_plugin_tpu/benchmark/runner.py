@@ -492,8 +492,9 @@ def _run_serve() -> dict:
     # shard over (tp=2 is the first point of the scaling curve; deeper
     # sweeps ride the same field set via BENCH_TP)
     tp_degree = int(os.environ.get("BENCH_TP", 2))
-    r = serve_bench(cfg, spec_ab=True, fleet_ab=True, chaos_ab=True,
-                    tp_ab=len(_jax.devices()) > 1, tp_degree=tp_degree)
+    r = serve_bench(cfg, quant_ab=True, spec_ab=True, fleet_ab=True,
+                    chaos_ab=True, tp_ab=len(_jax.devices()) > 1,
+                    tp_degree=tp_degree)
     return {
         "workload": "serve",
         "tokens_per_second": round(r.tokens_per_second, 1),
@@ -525,6 +526,27 @@ def _run_serve() -> dict:
         "decode_step_ms_paged": round(r.decode_step_ms_paged, 2),
         "kv_pages_peak": r.kv_pages_peak,
         "kv_hbm_saved_pct": round(r.kv_hbm_saved_pct, 1),
+        # quantized-paged A/B: int8/int4 codes + scale planes through
+        # the same page pool (in-kernel dequant on the pallas path) —
+        # throughput per variant, one slot's KV footprint, resident
+        # prefix entries per GiB, and the capacity multipliers vs the
+        # unquantized cache ("base" = cfg.dtype)
+        "tokens_per_second_paged_int8": round(
+            r.tokens_per_second_paged_int8, 1
+        ),
+        "tokens_per_second_paged_int4": round(
+            r.tokens_per_second_paged_int4, 1
+        ),
+        "decode_step_ms_paged_int8": round(r.decode_step_ms_paged_int8, 2),
+        "decode_step_ms_paged_int4": round(r.decode_step_ms_paged_int4, 2),
+        "kv_bytes_per_slot_base": r.kv_bytes_per_slot_base,
+        "kv_bytes_per_slot_int8": r.kv_bytes_per_slot_int8,
+        "kv_bytes_per_slot_int4": r.kv_bytes_per_slot_int4,
+        "prefix_entries_per_gb_base": r.prefix_entries_per_gb_base,
+        "prefix_entries_per_gb_int8": r.prefix_entries_per_gb_int8,
+        "prefix_entries_per_gb_int4": r.prefix_entries_per_gb_int4,
+        "kv_capacity_x_int8": round(r.kv_capacity_x_int8, 2),
+        "kv_capacity_x_int4": round(r.kv_capacity_x_int4, 2),
         # spec-vs-plain A/B: acceptance quality and the per-accepted-
         # token cost of the draft+verify round against the plain
         # pipelined numbers above (random-weight draft: machinery cost)

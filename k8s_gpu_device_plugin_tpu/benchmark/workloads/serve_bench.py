@@ -131,6 +131,32 @@ class ServeBenchResult:
     decode_step_ms_paged: float = 0.0
     kv_pages_peak: int = 0
     kv_hbm_saved_pct: float = 0.0
+    # quantized-paged A/B (``quant_ab=True``): the SAME workload through
+    # the page pool with int8/int4 KV codes plus their paged f32 scale
+    # planes (in-kernel dequant where the kernel gates admit the shape).
+    # ``kv_bytes_per_slot_*`` prices one full max_len slot via
+    # kv_token_bytes (codes + scales — the number the pool reservation
+    # and OOM math use); ``prefix_entries_per_gb_*`` is how many
+    # max(prompt_lens)-token prefix-cache entries one GiB holds at that
+    # footprint (prefix_kv_bytes, page-rounded); ``kv_capacity_x_*`` is
+    # the headline bytes-per-token multiplier vs the unquantized cache
+    # ("base" = cfg.dtype: bf16 in serving configs, f32 in the CPU CI
+    # smoke — the RATIO is the portable number). All zero when
+    # quant_ab=False or max_len is not page-aligned (skip printed).
+    wall_seconds_paged_int8: float = 0.0
+    tokens_per_second_paged_int8: float = 0.0
+    decode_step_ms_paged_int8: float = 0.0
+    wall_seconds_paged_int4: float = 0.0
+    tokens_per_second_paged_int4: float = 0.0
+    decode_step_ms_paged_int4: float = 0.0
+    kv_bytes_per_slot_base: int = 0
+    kv_bytes_per_slot_int8: int = 0
+    kv_bytes_per_slot_int4: int = 0
+    prefix_entries_per_gb_base: int = 0
+    prefix_entries_per_gb_int8: int = 0
+    prefix_entries_per_gb_int4: int = 0
+    kv_capacity_x_int8: float = 0.0
+    kv_capacity_x_int4: float = 0.0
     # speculative A/B (the same workload through a SpeculativeBatcher;
     # all zero when spec_ab=False or chunked prefill is off)
     wall_seconds_spec: float = 0.0
@@ -980,6 +1006,7 @@ def serve_bench(
     decode_ab: bool = True,
     prefix_ab: bool = True,
     paged_ab: bool = True,
+    quant_ab: bool = False,
     spec_ab: bool = False,
     sched_ab: bool = True,
     fleet_ab: bool = False,
@@ -1023,12 +1050,15 @@ def serve_bench(
 
     def make_batcher(depth: int, kv_layout: str = "dense",
                      tp: int = 1, mfu=None,
-                     decode_attn: "str | None" = None) -> ContinuousBatcher:
+                     decode_attn: "str | None" = None,
+                     cache_quant: "str | None" = None) -> ContinuousBatcher:
         from dataclasses import replace as _replace
 
         bcfg = cfg if decode_attn is None else _replace(
             cfg, decode_attn=decode_attn
         )
+        if cache_quant is not None:
+            bcfg = _replace(bcfg, cache_quant=cache_quant)
         return ContinuousBatcher(
             params, bcfg, n_slots=n_slots, max_len=max_len,
             prompt_buckets=prompt_buckets, chunked_prefill=chunked_prefill,
@@ -1051,8 +1081,11 @@ def serve_bench(
             assert guard < 10_000, "priming never converged"
 
     def run_once(depth: int, kv_layout: str = "dense", tp: int = 1,
-                 mfu=None) -> tuple[float, float, int]:
-        cb = make_batcher(depth, kv_layout, tp, mfu=mfu)
+                 mfu=None,
+                 cache_quant: "str | None" = None
+                 ) -> tuple[float, float, int]:
+        cb = make_batcher(depth, kv_layout, tp, mfu=mfu,
+                          cache_quant=cache_quant)
         for p in prompts:
             cb.submit(p, max_new=max_new)
         t0 = time.perf_counter()
@@ -1061,7 +1094,7 @@ def serve_bench(
         peak = cb.pool.peak_in_use if cb.pool is not None else 0
         # per-step latency with every slot busy, measured separately so
         # admission prefills don't pollute it
-        cb2 = make_batcher(depth, kv_layout, tp)
+        cb2 = make_batcher(depth, kv_layout, tp, cache_quant=cache_quant)
         prime(cb2, max_new)
         t1 = time.perf_counter()
         steps = 16
@@ -1152,6 +1185,55 @@ def serve_bench(
             peak_bytes = pages_peak * kv_page_size * kv_token_bytes(cfg)
             if dense_bytes:
                 saved_hbm_pct = 100.0 * (1.0 - peak_bytes / dense_bytes)
+
+    # --- quantized-paged A/B: int8/int4 codes + scale planes ride the
+    # same page pool (in-kernel dequant where the unified kernel's gates
+    # admit the shape; the XLA gather twin everywhere else) ---
+    quant_fields: dict = {}
+    if quant_ab:
+        if max_len % kv_page_size:
+            print(
+                f"serve_bench: quant A/B skipped — max_len={max_len} is "
+                f"not a multiple of kv_page_size={kv_page_size}",
+                file=sys.stderr,
+            )
+        else:
+            from dataclasses import replace as _replace
+
+            from k8s_gpu_device_plugin_tpu.models.paging import (
+                kv_token_bytes,
+            )
+            from k8s_gpu_device_plugin_tpu.serving.prefix_cache import (
+                prefix_kv_bytes,
+            )
+
+            for q in ("int8", "int4"):
+                run_once(1, "paged", cache_quant=q)  # compile pass
+                w, s, _ = run_once(1, "paged", cache_quant=q)
+                quant_fields[f"wall_seconds_paged_{q}"] = w
+                quant_fields[f"tokens_per_second_paged_{q}"] = (
+                    n_requests * max_new / w if w else 0.0
+                )
+                quant_fields[f"decode_step_ms_paged_{q}"] = s
+            # the capacity columns are arithmetic, not timed: the same
+            # kv_token_bytes / prefix_kv_bytes every pool reservation and
+            # prefix-cache byte budget is denominated in, so the bench
+            # rows and a live server's gauges can never disagree
+            plen = max(prompt_lens)
+            bpt = {}
+            for q in ("none", "int8", "int4"):
+                qcfg = _replace(cfg, cache_quant=q, kv_layout="paged",
+                                kv_page_size=kv_page_size)
+                name = "base" if q == "none" else q
+                bpt[name] = kv_token_bytes(qcfg)
+                quant_fields[f"kv_bytes_per_slot_{name}"] = (
+                    max_len * bpt[name]
+                )
+                quant_fields[f"prefix_entries_per_gb_{name}"] = int(
+                    (1 << 30) // prefix_kv_bytes(qcfg, plen)
+                )
+            quant_fields["kv_capacity_x_int8"] = bpt["base"] / bpt["int8"]
+            quant_fields["kv_capacity_x_int4"] = bpt["base"] / bpt["int4"]
 
     # --- spec-vs-plain A/B: the same workload through a draft+verify ---
     wall_spec = spec_rate = spec_per_round = spec_ms_acc = 0.0
@@ -1522,6 +1604,7 @@ def serve_bench(
         hbm_bw_util_pct=bw_pct,
         goodput_tokens_per_tflop=good_per_tflop,
         mfu_generation=mfu_gen,
+        **quant_fields,
         **sched_fields,
         **fleet_fields,
         **chaos_fields,

@@ -93,17 +93,22 @@ class KVCache:
         """Paged-layout pool: (L, n_pages, page_size, Hkv, hd). Slots map
         virtual positions onto pages through ``BatchState.pages`` tables
         (models/batching.py); page 0 is the reserved trap page
-        (models/paging.py). bf16 only — the quantized caches' scale
-        planes are not paged, and the serving layer refuses the combo
-        with a clear error before ever reaching here."""
-        if cfg.cache_quant != "none":
-            raise NotImplementedError(
-                "paged KV layout supports bf16 caches only "
-                f"(cache_quant={cfg.cache_quant!r}); serve the quantized "
-                "cache with kv_layout='dense'"
-            )
+        (models/paging.py). With ``cfg.cache_quant`` the pool holds
+        int8/int4 codes and the per-(position, head) f32 scale planes
+        ride the SAME page geometry — (L, n_pages, page_size, Hkv, 1) —
+        so one table lookup addresses a page's codes and its scale rows
+        alike (the quantized-paged design: every write/alias/COW path
+        tree-maps over all four leaves with one page index)."""
         shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
                  cfg.head_dim)
+        if cfg.cache_quant in ("int8", "int4"):
+            qdtype = jnp.int8 if cfg.cache_quant == "int8" else jnp.int4
+            sshape = shape[:-1] + (1,)
+            return KVCache(
+                k=jnp.zeros(shape, qdtype), v=jnp.zeros(shape, qdtype),
+                k_scale=jnp.zeros(sshape, jnp.float32),
+                v_scale=jnp.zeros(sshape, jnp.float32),
+            )
         return KVCache(
             k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
         )
@@ -161,8 +166,12 @@ def _cache_write(cache, scale, x, length, pages=None, page_size=0):  # graftlint
         pos = jnp.clip(pos, 0, pages.shape[1] * page_size - 1)
         pidx = jnp.take_along_axis(pages, pos // page_size, axis=1)
         off = pos % page_size
-        assert scale is None, "paged KV layout is bf16-only"
-        return cache.at[pidx, off].set(x.astype(cache.dtype)), None
+        if scale is None:
+            return cache.at[pidx, off].set(x.astype(cache.dtype)), None
+        # quantized pool: codes and their scale rows scatter through the
+        # SAME (page, offset) pair — the scale planes are paged too
+        q, s = _quantize_kv(x, cache.dtype)
+        return cache.at[pidx, off].set(q), scale.at[pidx, off].set(s)
 
     def write(c, val, l):
         if jnp.ndim(l) == 0:
@@ -207,13 +216,19 @@ def _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,  # graftlin
             q, k_cache, v_cache, length, pages=pages, verify=verify,
             decode_attn=cfg.decode_attn, prefill_attn=cfg.prefill_attn,
             window=cfg.sliding_window, tp=cfg.tp,
-            quantized=k_scale is not None,
+            k_scale=k_scale, v_scale=v_scale,
         )
         if out is not None:
             return out
     if pages is not None:
         k_cache = k_cache[pages].reshape(b, -1, *k_cache.shape[-2:])
         v_cache = v_cache[pages].reshape(b, -1, *v_cache.shape[-2:])
+        if k_scale is not None:
+            # quantized pool: the scale planes ride the same page
+            # geometry, so the identical gather rebuilds the dense
+            # (B, S, Hkv, 1) view the einsums below expect
+            k_scale = k_scale[pages].reshape(b, -1, *k_scale.shape[-2:])
+            v_scale = v_scale[pages].reshape(b, -1, *v_scale.shape[-2:])
         pages = None  # below here the gathered view IS the dense cache
     max_len = k_cache.shape[1]
     group = hq // cfg.n_kv_heads
@@ -353,9 +368,11 @@ def _mlp_out(x, layer, cfg, sel=None):
     ).astype(x.dtype)
     up = _qm_lora(h, layer, "w3", sel)
     hidden = gate * up
-    if cfg.tp > 1:
+    if cfg.tp > 1 and not cfg.tp_allow_psum:
         # same no-psum rule as wo: gather the (column-sharded) hidden
-        # activation and run the replicated w2 contraction whole
+        # activation and run the replicated w2 contraction whole.
+        # tp_allow_psum drops the gather — w2 row-shards on d_ff and the
+        # partitioner psums the partials (bit-identity opt-out)
         hidden = constrain(hidden, REPLICATED)
     return _qm_lora(hidden, layer, "w2", sel)
 
@@ -379,12 +396,14 @@ def _decode_block(x, layer, k_cache, v_cache, k_scale, v_scale, length,
 
     attn = _cached_attention(q, k_cache, v_cache, k_scale, v_scale, length,
                              cfg, pages=pages, verify=verify)
-    if cfg.tp > 1:
+    if cfg.tp > 1 and not cfg.tp_allow_psum:
         # gather the head-sharded attention output to replicated BEFORE
         # the wo contraction: wo stays replicated and the matmul runs
         # whole on every shard — identical bits, where a row-sharded wo
         # + psum would split the f32 accumulation (the one thing that
-        # breaks the tp=1-vs-tp=N stream pin)
+        # breaks the tp=1-vs-tp=N stream pin). tp_allow_psum is the
+        # EXPLICIT opt-out: the head-sharded activation feeds a
+        # row-sharded wo and the partitioner inserts the psum
         attn = constrain(attn, REPLICATED)
     x = x + _qm_lora(
         attn.reshape(b, t, cfg.n_heads * cfg.head_dim), layer, "wo", sel

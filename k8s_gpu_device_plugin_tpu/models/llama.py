@@ -120,8 +120,10 @@ class LlamaConfig:
     # positions onto a shared (n_pages, kv_page_size) page pool through a
     # per-slot page table (models/paging.py) — HBM scales with LIVE
     # tokens, and prefix-cache reuse becomes page-table aliasing instead
-    # of row copies. bf16 caches only; token/logprob streams are
-    # bit-identical between the two layouts (test-pinned).
+    # of row copies. Composes with cache_quant: int8/int4 codes AND
+    # their f32 scale planes ride the page pool (the scale planes share
+    # the page geometry, one table lookup addresses both). Token/logprob
+    # streams are bit-identical between the two layouts (test-pinned).
     kv_layout: str = "dense"
     # token rows per physical page when kv_layout == "paged"; must divide
     # the batcher's max_len, and multiples of 8 keep the Pallas paged
@@ -139,6 +141,18 @@ class LlamaConfig:
     # Must divide n_kv_heads (and therefore n_heads); validated at mesh
     # construction with an actionable error.
     tp: int = 1
+    # EXPLICIT bit-identity opt-out for tp>1 (the PR-8 follow-up): True
+    # row-shards wo/w2 on their contraction axes and lets the SPMD
+    # partitioner psum the partial products instead of gathering the
+    # activation to replicated first. That removes the two all-gathers
+    # the bit-safe recipe pays per layer, but a psum splits an f32
+    # reduction into per-shard partials whose summation order differs
+    # from the single-chip contraction (~1e-5 bf16 drift — enough to
+    # flip a near-tie argmax), so tp>1 streams are no longer pinned
+    # bit-identical to tp=1. Off (the default) is exactly the PR-8
+    # recipe; flip it only when throughput beats exactness
+    # (--tpPsum on the server).
+    tp_allow_psum: bool = False
     # Fused lm_head+cross-entropy (ops/fused_ce.py): never materializes the
     # (B,S,V) logits. Training-loss only (no logits output, no accuracy);
     # requires the vocab axis unsharded (tp == 1) — loss_fn falls back

@@ -133,9 +133,12 @@ def kv_token_bytes(cfg) -> int:
     """HBM bytes one cached token row costs (K + V across all layers,
     scale planes included on the quantized-cache paths) — the
     denominator both layouts' resident-bytes gauges share, so the dense
-    reservation and the paged pool are comparable on /metrics. The paged
-    layout itself refuses quantized caches (their scale planes are not
-    paged); the quant arms here keep the DENSE gauge honest.
+    reservation, the paged pool, and ``--prefixCacheMB`` all mean the
+    same bytes for bf16/int8/int4 alike. The paged layout pages the
+    scale planes on the same (page, offset) geometry as the codes
+    (generate.KVCache.init_paged), so the quant arms price BOTH layouts:
+    a paged quantized token is its code bytes plus its two f32 scale
+    rows, exactly like a dense one.
 
     This is the AGGREGATE across tensor-parallel shards: the cache
     shards on the KV-head axis (parallel/tp_serving.py), so a page id

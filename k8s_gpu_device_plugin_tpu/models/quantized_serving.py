@@ -28,23 +28,46 @@ import jax.numpy as jnp
 
 from k8s_gpu_device_plugin_tpu.ops.quant import quantize_int8
 
+#: cache_quant values whose paged-scatter probe already passed — the
+#: probe is a startup check, not a per-batcher cost
+_PROBED_OK: set = set()
+
+#: the probe scatter, jitted once per process — the compiled path is the
+#: one _cache_write takes, so the probe must go through jit too
+_probe_scatter = jax.jit(lambda p, x: p.at[1, 0].set(x))
+
 
 def check_cache_quant_kv_layout(cfg) -> None:
-    """The ONE definition of the quantized-cache / paged-KV exclusion
+    """The ONE capability check for the quantized-cache / paged-KV combo
     (the admission-rule pattern: the batcher raises through this, tests
-    pin it here). The int8/int4 serving caches store per-(position,
-    head) f32 scale planes alongside the code arrays; the paged layout
-    pages only the K/V codes — paging the scales too would double every
-    table lookup and the dequant-fusion contract in _cached_attention
-    has never been measured through a gather. Refuse loudly rather than
-    silently serving a dense cache."""
-    if cfg.cache_quant != "none" and cfg.kv_layout == "paged":
+    pin it here). The combo itself is SUPPORTED now — scale planes ride
+    the page pool on the same page geometry as the codes, and the
+    unified kernel dequantizes in its DMA'd blocks — so this probes the
+    one genuine backend requirement left: the runtime must be able to
+    scatter-write the narrow code dtype into a paged pool (int4 storage
+    is packed 2-per-byte; a jax build whose backend can't update int4
+    arrays in place fails here, at startup, instead of inside the first
+    prefill trace). Anything else (kernel tile alignment, interpret
+    mode) degrades to the XLA gather per-mode and is REPORTED, not
+    refused — the attention_backend_plan gauge names the reason."""
+    if cfg.cache_quant == "none" or cfg.kv_layout != "paged":
+        return
+    if cfg.cache_quant in _PROBED_OK:  # probe once per process per dtype
+        return
+    qdtype = jnp.int8 if cfg.cache_quant == "int8" else jnp.int4
+    try:
+        # a two-page miniature of exactly the scatter _cache_write does:
+        # codes and scale rows through one (page, offset) pair
+        pool = jnp.zeros((2, 8, 1, 8), qdtype)
+        _probe_scatter(pool, jnp.ones((1, 8), qdtype)).block_until_ready()
+    except Exception as e:  # pragma: no cover - backend-dependent
         raise ValueError(
-            "kv_layout='paged' supports bf16 caches only: the "
-            f"quantized-serving KV cache (cache_quant={cfg.cache_quant!r}) "
-            "stores scale planes that are not paged — serve it with "
-            "kv_layout='dense'"
-        )
+            f"cache_quant={cfg.cache_quant!r} with kv_layout='paged' "
+            f"needs in-place {jnp.dtype(qdtype).name} scatter support, "
+            f"which this jax backend lacks ({type(e).__name__}: {e}) — "
+            "serve with kv_layout='dense' or cache_quant='none'"
+        ) from e
+    _PROBED_OK.add(cfg.cache_quant)
 
 # weight leaves quantized per layer (contraction axis is axis -2 for all)
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")

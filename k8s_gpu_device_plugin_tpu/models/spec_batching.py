@@ -303,12 +303,8 @@ class SpeculativeBatcher(ContinuousBatcher):
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
         # the draft rides the SAME layout as the target (self.cfg is the
         # post-kwarg config): mismatched layouts would desynchronize the
-        # two caches' write plumbing
-        if self.cfg.kv_layout == "paged" and draft_cfg.cache_quant != "none":
-            raise ValueError(
-                "the draft cache cannot be quantized under "
-                "kv_layout='paged' (scale planes are not paged)"
-            )
+        # two caches' write plumbing. Quantized drafts page fine — their
+        # scale planes ride the pool like the target's.
         if self.cfg.tp > 1 and draft_cfg.n_kv_heads % self.cfg.tp:
             # the draft cache shards on the SAME tp mesh as the target;
             # a draft whose KV heads don't divide would trace unsharded

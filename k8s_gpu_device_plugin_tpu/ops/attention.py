@@ -128,7 +128,8 @@ def serving_cache_attention(  # graftlint: hot-path=traced
     prefill_attn: str = "auto",
     window: int = 0,
     tp: int = 1,
-    quantized: bool = False,
+    k_scale: "jax.Array | None" = None,
+    v_scale: "jax.Array | None" = None,
 ) -> "jax.Array | None":
     """Route one serving cache-attention call onto the unified kernel;
     None = the caller runs its XLA gather (bitwise the pre-kernel path).
@@ -140,19 +141,24 @@ def serving_cache_attention(  # graftlint: hot-path=traced
     traced hot path: everything built here is a trace-time constant,
     never a per-step transfer).
 
+    Quantized caches pass int8/int4 codes as the caches plus their f32
+    ``k_scale``/``v_scale`` planes (cache layout, trailing dim 1): the
+    kernel DMA's scale rows alongside code blocks and dequantizes in
+    VMEM. bf16 callers pass neither and trace the exact pre-quant path.
+
     Under tp>1 the kernel runs per-shard via ``shard_map`` over the
     ambient serving mesh: q/k/v are already head-sharded by the PR-8
-    recipe, attention never crosses a KV head, so each shard's heads
-    are bitwise the tp=1 kernel's — kernel speed without touching the
-    bit-identity pin. No ambient mesh (a tp>1 config traced outside the
-    batcher's dispatch scope) falls back like any other unsupported
-    case.
+    recipe — and the scale planes carry Hkv in the same third-from-last
+    slot, so they ride the same head spec — attention never crosses a
+    KV head, so each shard's heads are bitwise the tp=1 kernel's —
+    kernel speed without touching the bit-identity pin. No ambient mesh
+    (a tp>1 config traced outside the batcher's dispatch scope) falls
+    back like any other unsupported case.
     """
     from k8s_gpu_device_plugin_tpu.ops import ragged_paged_attention as rpa
 
     b, t, hq, hd = q.shape
-    if quantized:
-        return None  # bf16 caches only: scale planes stay on the gather
+    quantized = k_scale is not None
     mode = _route_mode(t, verify)
     if not _mode_opted_in(mode, decode_attn, prefill_attn):
         return None
@@ -161,7 +167,8 @@ def serving_cache_attention(  # graftlint: hot-path=traced
     from k8s_gpu_device_plugin_tpu.ops.kernel_support import interpret_mode
 
     interpret = interpret_mode()
-    if not rpa.supports(q, k_cache, pages, require_pltpu=not interpret):
+    if not rpa.supports(q, k_cache, pages, require_pltpu=not interpret,
+                        quantized=quantized):
         return None
     base = (
         jnp.full((b,), length, jnp.int32) if jnp.ndim(length) == 0
@@ -185,7 +192,13 @@ def serving_cache_attention(  # graftlint: hot-path=traced
         scale=hd ** -0.5, window=window, block_k=block_k,
         interpret=interpret,
     )
+    # quantized caches append their scale planes as trailing operands;
+    # bf16 appends nothing, so its call graph is the pre-quant one
+    extra = () if not quantized else (k_scale, v_scale)
     if tp <= 1:
+        if quantized:
+            return call(q, k_cache, v_cache, base, pages,
+                        k_scale=k_scale, v_scale=v_scale)
         return call(q, k_cache, v_cache, base, pages)
 
     # --- tensor-parallel: shard_map over the KV-head axis ---
@@ -202,23 +215,36 @@ def serving_cache_attention(  # graftlint: hot-path=traced
     if hq % tp or hkv % tp:
         return None  # the mesh rule guarantees this; belt for odd heads
     heads = P(None, None, AXIS_TP, None)  # q/kv/out all carry Hkv 3rd-last
+    # the scale planes are (…, Hkv, 1): head axis third-from-last, the
+    # exact slot the cache spec shards — one spec serves codes + scales
+    scale_specs = () if not quantized else (heads, heads)
     if pages is None:
+
+        def dense_fn(sq, sk, sv, sb, *sc):
+            ks, vs = sc if sc else (None, None)
+            return call(sq, sk, sv, sb, k_scale=ks, v_scale=vs)
+
         sharded = shard_map(
-            lambda sq, sk, sv, sb: call(sq, sk, sv, sb),
+            dense_fn,
             mesh=mesh,
-            in_specs=(heads, heads, heads, P()),
+            in_specs=(heads, heads, heads, P(), *scale_specs),
             out_specs=heads,
             check_rep=False,
         )
-        return sharded(q, k_cache, v_cache, base)
+        return sharded(q, k_cache, v_cache, base, *extra)
+
+    def paged_fn(sq, sk, sv, sb, sp, *sc):
+        ks, vs = sc if sc else (None, None)
+        return call(sq, sk, sv, sb, sp, k_scale=ks, v_scale=vs)
+
     sharded = shard_map(
-        lambda sq, sk, sv, sb, sp: call(sq, sk, sv, sb, sp),
+        paged_fn,
         mesh=mesh,
-        in_specs=(heads, heads, heads, P(), P()),
+        in_specs=(heads, heads, heads, P(), P(), *scale_specs),
         out_specs=heads,
         check_rep=False,
     )
-    return sharded(q, k_cache, v_cache, base, pages)
+    return sharded(q, k_cache, v_cache, base, pages, *extra)
 
 
 def attention_backend_plan(
@@ -259,10 +285,6 @@ def attention_backend_plan(
         if want != "ragged":
             return {"backend": "xla", "reason":
                     f"{knob}={want!r} (opt in with {knob}='ragged')"}
-        if cache_quant != "none":
-            return {"backend": "xla", "reason":
-                    f"cache_quant={cache_quant!r}: the kernel is "
-                    "bf16-only (scale planes stay on the gather)"}
         if not kernels_available(require_pltpu=not interpret_mode()):
             return {"backend": "xla", "reason":
                     "no pallas TPU support in this jax build"}
@@ -273,14 +295,35 @@ def attention_backend_plan(
             return {"backend": "xla", "reason":
                     f"n_heads={n_heads} not a multiple of "
                     f"n_kv_heads={n_kv_heads}"}
+        # quantized caches route through the SAME kernel (in-kernel
+        # dequant) — the only extra gate is the narrow-dtype tile: on
+        # real TPUs int8/int4 blocks tile at 32 sublanes, so the page /
+        # kv block must be a 32-multiple (interpret mode has no tiling)
+        qsub = (rpa.QUANT_SUBLANE
+                if cache_quant != "none" and not interpret_mode() else 1)
         if kv_layout == "paged":
             if not sublane_ok(page_size):
                 return {"backend": "xla", "reason":
                         f"kv_page_size={page_size} not sublane-aligned "
                         "(multiple of 8)"}
-        elif max_len > 0 and fit_block(max_len, max_len) is None:
-            return {"backend": "xla", "reason":
-                    f"no sublane-aligned block divides max_len={max_len}"}
+            if page_size % qsub:
+                return {"backend": "xla", "reason":
+                        f"kv_page_size={page_size} not a "
+                        f"{rpa.QUANT_SUBLANE}-multiple: "
+                        f"cache_quant={cache_quant!r} tiles at "
+                        f"{rpa.QUANT_SUBLANE} sublanes on TPU"}
+        elif max_len > 0:
+            bk = fit_block(max_len, max_len)
+            if bk is None:
+                return {"backend": "xla", "reason":
+                        f"no sublane-aligned block divides max_len="
+                        f"{max_len}"}
+            if bk % qsub:
+                return {"backend": "xla", "reason":
+                        f"no {rpa.QUANT_SUBLANE}-aligned block divides "
+                        f"max_len={max_len}: cache_quant="
+                        f"{cache_quant!r} tiles at {rpa.QUANT_SUBLANE} "
+                        "sublanes on TPU"}
         if mode == "prefill" and chunk > rpa.MAX_PREFILL_T:
             return {"backend": "xla", "reason":
                     f"chunked_prefill={chunk} exceeds the kernel's "

@@ -51,8 +51,17 @@ crosses a head, each shard's output is bitwise the tp=1 kernel's head
 slice (the PR-8 bit-identity contract, now WITH the kernel instead of
 the XLA-gather fallback).
 
-bf16 caches, GQA-native (q heads fold onto their group at score time);
-interpret mode runs the identical logic on CPU for the test suite.
+Quantized caches (int8/int4 codes + per-(position, head) f32 scale
+planes) ride the SAME body: the dispatcher passes the scale planes as
+two extra inputs whose BlockSpecs reuse the kv index maps — a code
+page's scale rows arrive in the same DMA'd block step — and the body
+widens codes to f32 and multiplies the scale row in VMEM before the
+dots (in-kernel dequant; no dequantized cache copy ever touches HBM).
+The bf16 route passes no scale operands, so its trace is byte-for-byte
+the pre-quantization kernel.
+
+GQA-native (q heads fold onto their group at score time); interpret
+mode runs the identical logic on CPU for the test suite.
 """
 
 from __future__ import annotations
@@ -106,15 +115,26 @@ def _last_block(length: jax.Array, bk: int) -> jax.Array:
     return jnp.maximum((length + bk - 1) // bk - 1, 0)
 
 
-def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                acc_ref, *, bk: int, t: int, hq: int, hkv: int, hd: int,
-                scale: float, window: int):
+def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, *refs, bk: int, t: int,
+                hq: int, hkv: int, hd: int, scale: float, window: int,
+                quantized: bool = False):
     """The one flash body: T queries per slot at positions ``base + r``,
     online-softmax accumulation across this slot's kv blocks. Query row
     r keeps keys ``k_pos <= base + r`` (minus the sliding-window floor)
     — the exact mask the XLA gather einsum applies, so routing a shape
     here can never change WHICH positions are attended, only how their
-    softmax is accumulated."""
+    softmax is accumulated.
+
+    ``quantized`` (a STATIC specialization, like T) inserts two scale
+    refs — (bk, Hkv, 1) f32 rows riding the same index maps as the kv
+    blocks — and the block step dequantizes the int8/int4 codes in VMEM
+    (widen, multiply the scale row) before the dots. False passes no
+    scale refs at all, so the bf16 trace is byte-for-byte unchanged."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -144,6 +164,11 @@ def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         )
         k = k_ref[0].astype(jnp.float32)      # (bk, Hkv, hd)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # in-kernel dequant: the (bk, Hkv, 1) scale rows broadcast
+            # over hd — codes widen once, in VMEM, never in HBM
+            k = k * ks_ref[0].astype(jnp.float32)
+            v = v * vs_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k.transpose(1, 2, 0),
             (((2,), (1,)), ((0,), (0,))),
@@ -185,6 +210,13 @@ def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         )
 
 
+#: sublane quantum for int8/int4 code blocks on REAL TPUs: narrow
+#: dtypes tile at (32, 128) (the Pallas TPU tiling table), so quantized
+#: kv blocks/pages must be 32-row multiples on hardware; interpret mode
+#: has no tiling and keeps the plain SUBLANE=8 rule
+QUANT_SUBLANE = 32
+
+
 def supports(
     q: jax.Array,
     k: jax.Array,
@@ -192,15 +224,18 @@ def supports(
     block_k: int = 0,
     require_pltpu: bool = True,
     max_t: int = MAX_PREFILL_T,
+    quantized: bool = False,
 ) -> bool:
     """Shapes the unified kernel tiles cleanly: a (B, T, Hq, hd) query
     window with 1 <= T <= ``max_t``, a lane-aligned head dim, whole GQA
     groups, and a sublane-aligned kv block — dense caches need some
     block dividing the cache length, paged pools need the page itself
-    aligned (the page IS the block). ``require_pltpu=False`` relaxes
-    only the TPU-build check (interpret mode still needs every SHAPE
-    constraint to hold) — the one supports()/interpret gate every
-    routed shape goes through."""
+    aligned (the page IS the block). ``quantized`` (int8/int4 codes +
+    scale-plane inputs) tightens the block/page alignment to
+    :data:`QUANT_SUBLANE` on real TPUs — narrow dtypes tile at 32
+    sublanes. ``require_pltpu=False`` relaxes only the TPU-build check
+    (interpret mode still needs every SHAPE constraint to hold) — the
+    one supports()/interpret gate every routed shape goes through."""
     if not kernels_available(require_pltpu):
         return False
     if q.ndim != 4 or k.ndim != 4:
@@ -211,24 +246,34 @@ def supports(
     hkv = k.shape[2]
     if not (lane_aligned(hd) and gqa_ok(hq, hkv) and k.shape[3] == hd):
         return False
+    qsub = QUANT_SUBLANE if (quantized and require_pltpu) else 1
     if pages is not None:
-        return sublane_ok(k.shape[1]) and pages.shape[0] == b
+        return (sublane_ok(k.shape[1]) and k.shape[1] % qsub == 0
+                and pages.shape[0] == b)
     want = block_k if block_k > 0 else DEFAULT_BLOCK_K
-    return fit_block(k.shape[1], min(want, k.shape[1])) is not None
+    bk = fit_block(k.shape[1], min(want, k.shape[1]))
+    return bk is not None and bk % qsub == 0
 
 
 @functools.partial(
     jax.jit, static_argnames=("scale", "window", "block_k", "interpret")
 )
-def _rpa_call(q, k, v, base, pages, *, scale, window, block_k, interpret):
+def _rpa_call(q, k, v, base, pages, k_scale, v_scale, *, scale, window,
+              block_k, interpret):
     """The pallas_call builder (jitted so direct op-level callers get a
     cached dispatch; inside an outer serving jit this is a no-op nest).
     ``pages=None`` is the dense route, else the paged one — same grid
-    shape, same body, different index map."""
+    shape, same body, different index map. ``k_scale``/``v_scale``
+    (None for bf16 caches) are the quantized pools' f32 scale planes,
+    shaped like k/v with a trailing dim of 1: they ride the SAME kv
+    index maps as two extra inputs, so a code block's scale rows land in
+    the same grid step. The bf16 route appends no operands and no specs
+    — its trace is byte-for-byte the pre-quantization kernel."""
     b, t, hq, hd = q.shape
     hkv = k.shape[2]
     group = hq // hkv
     base = base.astype(jnp.int32)
+    quantized = k_scale is not None
 
     if pages is None:
         s = k.shape[1]
@@ -271,14 +316,24 @@ def _rpa_call(q, k, v, base, pages, *, scale, window, block_k, interpret):
         def o_map(bi, j, bases, table):
             return (bi, 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, t, hq, hd), q_map),
+        pl.BlockSpec((1, bk, hkv, hd), kv_map),
+        pl.BlockSpec((1, bk, hkv, hd), kv_map),
+    ]
+    operands = (q, k, v)
+    if quantized:
+        # the scale planes reuse kv_map verbatim: one clamp/table
+        # resolution addresses a code block AND its scale rows
+        in_specs += [
+            pl.BlockSpec((1, bk, hkv, 1), kv_map),
+            pl.BlockSpec((1, bk, hkv, 1), kv_map),
+        ]
+        operands += (k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=num_prefetch,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, t, hq, hd), q_map),
-            pl.BlockSpec((1, bk, hkv, hd), kv_map),
-            pl.BlockSpec((1, bk, hkv, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, t, hq, hd), o_map),
         scratch_shapes=[
             pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # m
@@ -288,7 +343,7 @@ def _rpa_call(q, k, v, base, pages, *, scale, window, block_k, interpret):
     )
     kernel = functools.partial(
         _rpa_kernel, bk=bk, t=t, hq=hq, hkv=hkv, hd=hd, scale=scale,
-        window=window,
+        window=window, quantized=quantized,
     )
 
     def body(*refs):
@@ -302,7 +357,7 @@ def _rpa_call(q, k, v, base, pages, *, scale, window, block_k, interpret):
         out_shape=jax.ShapeDtypeStruct((b, t, hq, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(*prefetch_args, q, k, v)
+    )(*prefetch_args, *operands)
 
 
 def ragged_paged_attention(
@@ -316,6 +371,8 @@ def ragged_paged_attention(
     window: int = 0,
     block_k: int = 0,        # dense kv block; 0 = tunings cache / default
     interpret: bool = False,
+    k_scale: "jax.Array | None" = None,  # f32 scale plane, k shape w/ hd=1
+    v_scale: "jax.Array | None" = None,
 ) -> jax.Array:
     """(B, T, Hq, hd) cache attention reading only live kv blocks.
 
@@ -324,7 +381,12 @@ def ragged_paged_attention(
     ``base + T`` (the caller's write of the window precedes the read,
     the serving contract). Dense mode tiles the cache at ``block_k``
     (resolved from the per-generation tilings cache when 0); paged mode
-    reads whole pages through ``pages``."""
+    reads whole pages through ``pages``. Quantized caches pass int8/int4
+    codes as k/v plus their f32 ``k_scale``/``v_scale`` planes (same
+    layout, trailing dim 1): the body dequantizes per DMA'd block in
+    VMEM. Both scales or neither."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
     if pages is None:
         s = k.shape[1]
         if block_k <= 0:
@@ -351,7 +413,7 @@ def ragged_paged_attention(
     else:
         block_k = 0  # pinned to the page size inside _rpa_call
     return _rpa_call(
-        q, k, v, base, pages,
+        q, k, v, base, pages, k_scale, v_scale,
         scale=scale, window=window, block_k=block_k, interpret=interpret,
     )
 
@@ -361,6 +423,7 @@ __all__ = [
     "HAS_PLTPU",
     "MAX_PREFILL_T",
     "MAX_VERIFY_T",
+    "QUANT_SUBLANE",
     "ragged_paged_attention",
     "supports",
 ]

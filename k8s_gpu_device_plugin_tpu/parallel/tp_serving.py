@@ -29,6 +29,11 @@ shards only what stays bitwise exact:
 LITERALLY today's graphs — no mesh, no constraints, nothing to pin.
 The constraints in models/generate.py bind only when the dispatch is
 traced under the mesh scope the batcher enters around ``step()``.
+
+``cfg.tp_allow_psum`` is the EXPLICIT opt-out: wo/w2 row-shard on their
+contraction axes (the megatron pairing) and the partitioner psums the
+partials — one collective fewer per layer, at the price of the
+bit-identity pin (the operator trades exactness for the last gather).
 """
 
 from __future__ import annotations
@@ -88,15 +93,23 @@ def serving_param_specs(cfg) -> dict:
     replicated (correct, just unsharded) — only the KV-head divisibility
     is a hard startup requirement."""
     col = P(None, None, AXIS_TP)
+    row = P(None, AXIS_TP, None)
     rep2 = P(None, None)
     ff_ok = cfg.d_ff % cfg.tp == 0
+    # the explicit bit-identity opt-out (cfg.tp_allow_psum): wo/w2
+    # row-shard on their contraction axes — the megatron pairing of the
+    # column cuts above — and the partitioner psums the partials instead
+    # of gathering the activation first. One collective fewer per layer,
+    # but the split f32 reduction ends the tp=1 stream pin.
+    psum_ok = bool(getattr(cfg, "tp_allow_psum", False))
     layers = {
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),
         # q/k/v columns are head-aligned (tp | n_kv_heads | n_heads)
         "wq": col, "wk": col, "wv": col,
-        # wo contracts over heads: replicated (the no-psum rule)
-        "wo": rep2,
+        # wo contracts over heads: replicated (the no-psum rule), or
+        # row-sharded under the explicit opt-out
+        "wo": row if psum_ok else rep2,
     }
     if cfg.attn_bias:
         layers.update({
@@ -115,7 +128,9 @@ def serving_param_specs(cfg) -> dict:
         layers.update({
             "w1": col if ff_ok else rep2,
             "w3": col if ff_ok else rep2,
-            "w2": rep2,  # contracts over d_ff: replicated
+            # contracts over d_ff: replicated, or row-sharded (psum)
+            # under the opt-out — only when the column cuts engaged too
+            "w2": row if (psum_ok and ff_ok) else rep2,
         })
     out = {
         "embed": P(None, None),  # token gather: replicated lookup

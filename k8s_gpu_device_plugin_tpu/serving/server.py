@@ -1465,6 +1465,13 @@ def _main(argv: list[str] | None = None) -> int:
                         "the visible device count and the model's "
                         "n_kv_heads (validated at startup); token/"
                         "logprob streams are bit-identical to --tp 1")
+    parser.add_argument("--tpPsum", action="store_true",
+                        help="with --tp > 1: row-shard the wo/w2 "
+                        "contraction axes and let the partitioner psum "
+                        "the partials — one collective fewer per layer, "
+                        "at the price of the bit-identity pin (the "
+                        "split f32 reduction drifts ~1e-5 from --tp 1; "
+                        "explicit opt-out, off by default)")
     def _eos_arg(value: str):
         """'none' or a negative int -> EOS stopping OFF; an id -> that id.
         Keeps argparse's clean usage error for garbage like '1.5'."""
@@ -1572,8 +1579,10 @@ def _main(argv: list[str] | None = None) -> int:
                         "maxLen rows per slot; 'paged' maps slots onto a "
                         "shared page pool (HBM scales with live tokens, "
                         "prefix-cache hits alias pages with zero copies; "
-                        "bf16 caches only — token/logprob streams are "
-                        "bit-identical either way)")
+                        "composes with --cacheQuant — int8/int4 codes "
+                        "AND their scale planes ride the pool — and "
+                        "token/logprob streams are bit-identical either "
+                        "way)")
     parser.add_argument("--kvPageSize", type=int, default=64,
                         help="token rows per KV page with --kvLayout "
                         "paged; must divide --maxLen (multiples of 8 "
@@ -1704,6 +1713,13 @@ def _main(argv: list[str] | None = None) -> int:
                                 exact=True)
         except ValueError as e:
             raise SystemExit(str(e)) from None
+    if args.tpPsum:
+        if args.tp == 1:
+            raise SystemExit("--tpPsum needs --tp > 1: there is no "
+                             "collective to save on one shard")
+        from dataclasses import replace as _replace
+
+        cfg = _replace(cfg, tp_allow_psum=True)
     params = load_params(cfg, args.checkpointDir)
 
     sampler = Sampler(temperature=args.temperature, top_k=args.topK,
@@ -1798,17 +1814,12 @@ def _main(argv: list[str] | None = None) -> int:
                 min_hits=args.prefixCacheMinHits,
                 metrics=metrics,
             )
-    if args.kvLayout == "paged" and args.cacheQuant != "none":
-        raise SystemExit(
-            "--kvLayout paged is unsupported with --cacheQuant: the "
-            "quantized cache's scale planes are not paged; drop one flag"
-        )
     if args.kvLayout == "dense" and (
         args.kvPages or args.kvPageSize != 64
     ):
         # silently serving the full static reservation when the operator
-        # asked for a sized pool would mislead exactly like the combos
-        # refused above (64 is the --kvPageSize default, the one value
+        # asked for a sized pool would mislead exactly like the combo
+        # refused below (64 is the --kvPageSize default, the one value
         # that cannot be told apart from "not passed")
         raise SystemExit(
             "--kvPages/--kvPageSize have no effect under --kvLayout "

@@ -163,6 +163,70 @@ def test_per_request_knobs_compose_or_refuse_with_speculative(
     assert getattr(sb, flag) is False
 
 
+# --- quantized x paged x tp x pipelined ------------------------------------
+
+
+KERNEL_CFG = LlamaConfig.tiny(n_layers=2, head_dim_override=64,
+                              decode_attn="ragged")
+
+
+@pytest.fixture(scope="module")
+def kernel_params():
+    # head_dim_override=64 puts the tiny config ON the unified kernel's
+    # gates (the stock tiny head_dim of 16 is the documented fallback)
+    return init_params(jax.random.key(0), KERNEL_CFG)
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+@pytest.mark.parametrize("cache_quant", ["int8", "int4"])
+def test_quantized_paged_composes_on_kernel(cache_quant, tp, kernel_params):
+    """The quantized-paged composition matrix: {int8,int4} x paged x
+    {tp=1,tp>1} x pipelined decode all serve through the unified
+    ragged-paged kernel — the fallback-visibility gauge stays at ZERO
+    on the xla arm (no silent XLA-gather fallback), and the stream is
+    bit-identical to the dense twin of the same quantized config."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+
+    cfg = replace(KERNEL_CFG, cache_quant=cache_quant)
+    reg = CollectorRegistry()
+    metrics = ServingMetrics(registry=reg)
+    prompts = [list(range(1, 6)), list(range(3, 15))]
+    try:
+        cb = ContinuousBatcher(
+            kernel_params, cfg, n_slots=2, max_len=64,
+            prompt_buckets=(8, 16, 32), chunked_prefill=8,
+            pipeline_depth=1, kv_layout="paged", kv_page_size=16, tp=tp,
+            metrics=metrics,
+        )
+        assert cb.attn_plan["decode"]["backend"] == "pallas"
+        assert cb.attn_plan["verify"]["backend"] == "pallas"
+        # the fallback-visibility gauge: xla arm pinned at zero
+        assert reg.get_sample_value(
+            "tpu_serving_decode_attn_backend",
+            {"mode": "decode", "backend": "xla"},
+        ) == 0
+        assert reg.get_sample_value(
+            "tpu_serving_decode_attn_backend",
+            {"mode": "decode", "backend": "pallas"},
+        ) == 1
+        rids = [cb.submit(p, max_new=4) for p in prompts]
+        got = cb.run()
+    finally:
+        metrics.close()
+    dense = ContinuousBatcher(
+        kernel_params, cfg, n_slots=2, max_len=64,
+        prompt_buckets=(8, 16, 32), chunked_prefill=8, pipeline_depth=1,
+    )
+    rids_d = [dense.submit(p, max_new=4) for p in prompts]
+    want = dense.run()
+    assert [got[r] for r in rids] == [want[r] for r in rids_d]
+
+
 def test_speculative_composition_matrix(base_params):
     """The docs/serving.md composition matrix, pinned: repetition
     penalty refuses at construction (actionable, not silent), while the

@@ -494,17 +494,34 @@ def test_request_outsizing_pool_is_refused(setup):
 
 
 @pytest.mark.parametrize("cache_quant", ["int8", "int4"])
-def test_quantized_cache_refuses_paged(setup, cache_quant):
-    """The quantized-serving caches store scale planes the paged pool
-    does not carry: the combination must fail loudly at construction
-    (one pinned test per code width)."""
+def test_quantized_cache_pages_scale_planes(setup, cache_quant):
+    """The old refusal is GONE: int8/int4 caches ride the page pool.
+    The codes quantize into the pool's narrow dtype and the f32 scale
+    planes ride the SAME page geometry — (L, n_pages, page_size, Hkv, 1)
+    — so one table lookup addresses a page's codes and its scale rows
+    alike, and the stream matches the dense quantized batcher
+    token-for-token (one pinned test per code width)."""
     cfg, params = setup
     cfg_q = LlamaConfig.tiny(n_layers=2, cache_quant=cache_quant)
-    with pytest.raises(ValueError, match="bf16 caches only"):
-        ContinuousBatcher(
-            params, cfg_q, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
-            kv_layout="paged", kv_page_size=PS,
-        )
+    cb = ContinuousBatcher(
+        params, cfg_q, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="paged", kv_page_size=PS,
+    )
+    qdtype = jnp.int8 if cache_quant == "int8" else jnp.int4
+    cache = cb.state.cache
+    assert cache.k.dtype == qdtype and cache.v.dtype == qdtype
+    assert cache.k_scale is not None and cache.v_scale is not None
+    assert cache.k_scale.dtype == jnp.float32
+    # scale planes share the page geometry with a scalar trailing dim
+    assert cache.k_scale.shape == cache.k.shape[:-1] + (1,)
+    p = _prompt(91, 9, cfg_q)
+    rid = cb.submit(p, max_new=4)
+    dense = ContinuousBatcher(
+        params, cfg_q, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8,
+    )
+    rid_d = dense.submit(p, max_new=4)
+    assert cb.run()[rid] == dense.run()[rid_d]
 
 
 def test_speculative_batcher_supports_paged(setup):

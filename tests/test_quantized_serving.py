@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from k8s_gpu_device_plugin_tpu.models.generate import KVCache, generate, prefill
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
@@ -226,6 +227,74 @@ def test_q4_composes_with_decode_features():
     got = cb.run()[rid]
     base = generate(qp, prompt, cfg_c, max_new=4)
     assert got == np.asarray(base)[0].tolist()
+
+
+# ---------------- quantized caches on the page pool ----------------
+
+
+def test_cache_write_same_codes_same_scales_across_layouts():
+    """The unit-level half of the paged-quant pin: `_cache_write`
+    produces bitwise-identical int8 codes and f32 scales whether the
+    destination is a dense cache row or a paged pool — the quantize
+    happens BEFORE the scatter, so the layout can only move bytes,
+    never change them."""
+    from k8s_gpu_device_plugin_tpu.models.generate import _cache_write
+
+    B, T, H, hd, ps = 2, 4, 2, 16, 8
+    x = jax.random.normal(jax.random.key(7), (B, T, H, hd), jnp.float32)
+    length = jnp.asarray([0, 8], jnp.int32)
+
+    dense_c = jnp.zeros((B, 32, H, hd), jnp.int8)
+    dense_s = jnp.zeros((B, 32, H, 1), jnp.float32)
+    dc, ds = _cache_write(dense_c, dense_s, x, length)
+
+    # page table: slot 0 -> pages [1, 2], slot 1 -> pages [3, 4]
+    pages = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    pool_c = jnp.zeros((6, ps, H, hd), jnp.int8)
+    pool_s = jnp.zeros((6, ps, H, 1), jnp.float32)
+    pc, psc = _cache_write(pool_c, pool_s, x, length, pages=pages,
+                           page_size=ps)
+    # gather the paged view back through the table: bitwise the dense one
+    gat_c = pc[pages].reshape(B, -1, H, hd)[:, :32]
+    gat_s = psc[pages].reshape(B, -1, H, 1)[:, :32]
+    np.testing.assert_array_equal(np.asarray(dc), np.asarray(gat_c))
+    np.testing.assert_array_equal(np.asarray(ds), np.asarray(gat_s))
+
+
+@pytest.mark.parametrize("cache_quant", ["int8", "int4"])
+def test_quantized_paged_decode_bit_identical_to_dense(cache_quant):
+    """The acceptance pin: int8-paged decode is bit-identical to
+    int8-dense decode — same codes, same scales, so the same tokens AND
+    the same logprobs, greedy and seeded alike (int4 rides the same
+    assertion)."""
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+
+    cfg, params = _setup()
+    cfg_q = replace(cfg, cache_quant=cache_quant)
+
+    def streams(layout):
+        cb = ContinuousBatcher(
+            params, cfg_q, n_slots=2, max_len=64,
+            prompt_buckets=(8, 16, 32), chunked_prefill=8,
+            pipeline_depth=1, kv_layout=layout,
+            kv_page_size=16 if layout == "paged" else None,
+        )
+        prompts = [
+            jax.random.randint(jax.random.key(60 + i), (n,), 1,
+                               cfg.vocab_size, jnp.int32).tolist()
+            for i, n in enumerate([5, 12, 3, 9])
+        ]
+        rids = [cb.submit(p, max_new=6, seed=13 if i % 2 else None)
+                for i, p in enumerate(prompts)]
+        cb.run()
+        return [
+            (tuple(cb.done[r]), tuple(cb.done_requests[r].out_logp))
+            for r in rids
+        ]
+
+    assert streams("paged") == streams("dense")
 
 
 def test_q4_moe_decode_close_to_float():

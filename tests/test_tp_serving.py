@@ -278,6 +278,43 @@ def test_weights_and_state_carry_the_intended_shardings(setup):
     assert cb.state.lengths.sharding.spec == P()
 
 
+def test_tp_psum_opt_out_row_shards_and_serves(setup):
+    """The explicit bit-identity opt-out (cfg.tp_allow_psum / --tpPsum):
+    wo and w2 row-shard on their contraction axes — the megatron pairing
+    of the column cuts — and the batcher still serves valid streams.
+    The DEFAULT (False) keeps the replicated no-psum recipe, pinned by
+    the sharding assertions in the test above; here the opt-out's specs
+    and its end-to-end viability are pinned (NOT stream bit-identity —
+    the psum's split f32 reduction is exactly what the flag trades
+    away)."""
+    from dataclasses import replace
+
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_gpu_device_plugin_tpu.parallel.tp_serving import (
+        serving_param_specs,
+    )
+
+    cfg, params = setup
+    cfg_p = replace(cfg, tp=2, tp_allow_psum=True)
+    specs = serving_param_specs(cfg_p)["layers"]
+    assert specs["wo"] == P(None, AXIS_TP, None)
+    assert specs["w2"] == P(None, AXIS_TP, None)
+    # the default recipe is untouched: replicated reduction weights
+    specs_def = serving_param_specs(replace(cfg, tp=2))["layers"]
+    assert specs_def["wo"] == P(None, None)
+
+    cb = _batcher(params, cfg_p, 2, "paged")
+    assert cb.params["layers"]["wo"].sharding.spec == P(
+        None, AXIS_TP, None
+    )
+    p = _prompt(77, 9, cfg)
+    rid = cb.submit(p, max_new=5)
+    got = cb.run()[rid]
+    assert len(got) == 5
+    assert all(0 <= t < cfg.vocab_size for t in got)
+
+
 def test_steady_state_args_are_committed_mesh_residents(setup):
     """The zero-per-step-H2D contract under tp: every decode-dispatch
     argument the batcher caches is COMMITTED on the tp mesh (an

@@ -14,10 +14,13 @@ Four layers of claims:
   token+logprob streams with ``decode_attn="ragged"`` are pinned
   bit-identical across tp=1/2/4 for dense AND paged layouts (the PR-8
   matrix, now WITH the kernel instead of the gather fallback).
-- **Dispatch gates**: every fallback is explicit — quantized caches,
-  unsupported geometry, missing mesh, opt-outs — and visible: the
-  startup plan names backend + reason, feeds the
-  ``decode_attn_backend`` gauge, and rides /v1/health.
+- **Dispatch gates**: every fallback is explicit — unsupported
+  geometry, missing mesh, opt-outs — and visible: the startup plan
+  names backend + reason, feeds the ``decode_attn_backend`` gauge, and
+  rides /v1/health. Quantized caches are NOT a fallback anymore: their
+  scale planes ride extra block operands and the one body dequantizes
+  in its DMA'd blocks (parity + routing pinned below; streams in
+  tests/test_quantized_serving.py).
 - **Autotuner cache**: winners persist per device generation
   (ops/tunings.py), reload into block resolution, and the kernel's
   block_k=0 path dispatches on them (pinned bitwise against the same
@@ -129,6 +132,37 @@ def test_kernel_windowed_matches_reference():
     assert err < 0.02, err
 
 
+@pytest.mark.parametrize("qdtype", [jnp.int8, jnp.int4])
+def test_kernel_dequantizes_codes_in_block(qdtype):
+    """The quantized specialization: int8/int4 codes + per-(token, head)
+    f32 scale planes through the SAME kernel body match the f32
+    reference on the manually dequantized cache — dense and paged, the
+    decode and verify grids."""
+    from k8s_gpu_device_plugin_tpu.models.generate import _quantize_kv
+
+    kq, k, v = _dense()
+    kc, ks = _quantize_kv(k, qdtype)
+    vc, vs = _quantize_kv(v, qdtype)
+    k_deq = kc.astype(jnp.float32) * ks
+    v_deq = vc.astype(jnp.float32) * vs
+    kcp, vcp, table = _paged(kc, vc)
+    ksp, vsp, _ = _paged(ks, vs)
+    for t in (1, 4):
+        q = jax.random.normal(kq, (3, t, 8, HD), jnp.bfloat16)
+        base = jnp.asarray([1, 40, 128 - t], jnp.int32)
+        want = _ref(q, k_deq, v_deq, base, HD ** -0.5)
+        for pages, kk_, vv_, ks_, vs_ in (
+            (None, kc, vc, ks, vs),
+            (table, kcp, vcp, ksp, vsp),
+        ):
+            got = ragged_paged_attention(
+                q, kk_, vv_, base, pages, scale=HD ** -0.5, block_k=32,
+                interpret=True, k_scale=ks_, v_scale=vs_,
+            )
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+            assert err < 0.02, (t, pages is not None, err)
+
+
 def test_legacy_kernels_are_bitwise_the_unified_one():
     """The compat shims (ops/ragged_decode, ops/paged_attention) must be
     pure re-parameterizations: byte-equal outputs, so no stream pinned
@@ -197,9 +231,18 @@ def test_dispatcher_gates_and_modes():
     # opt-outs and hard gates return None (the caller's gather runs)
     assert serving_cache_attention(q, k, v, base) is None
     assert serving_cache_attention(q, k, v, base, decode_attn="xla") is None
+    # quantized caches ROUTE now: scale operands ride along instead of
+    # forcing the gather (one per K and V, or the call is malformed)
+    ks = jnp.ones(k.shape[:-1] + (1,), jnp.float32)
     assert serving_cache_attention(
-        q, k, v, base, decode_attn="ragged", quantized=True
-    ) is None
+        q, k.astype(jnp.int8), v.astype(jnp.int8), base,
+        decode_attn="ragged", k_scale=ks, v_scale=ks,
+    ) is not None
+    with pytest.raises(ValueError, match="k_scale"):
+        serving_cache_attention(
+            q, k.astype(jnp.int8), v.astype(jnp.int8), base,
+            decode_attn="ragged", k_scale=ks,
+        )
     # tp>1 with no ambient mesh: graceful fallback, not a crash
     assert serving_cache_attention(
         q, k, v, base, decode_attn="ragged", tp=2
@@ -351,10 +394,12 @@ def test_backend_plan_reasons():
     assert plan["decode"]["backend"] == "pallas"
     assert "shard_map" in plan["decode"]["reason"]
     assert plan["prefill"]["backend"] == "xla"  # needs its own opt-in
+    # a quantized cache is no longer a fallback: it plans onto the same
+    # kernel (in-kernel dequant); only the narrow-dtype tile can gate it
+    # on hardware (interpret mode has no tiling — qsub is 1 here)
     plan = attention_backend_plan(decode_attn="ragged", cache_quant="int8",
                                   **common)
-    assert plan["decode"]["backend"] == "xla"
-    assert "bf16" in plan["decode"]["reason"]
+    assert plan["decode"]["backend"] == "pallas"
     plan = attention_backend_plan(
         decode_attn="ragged",
         **{**common, "head_dim": 16},
