@@ -69,7 +69,7 @@ san-test:
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-quant-paged bench-spec \
 	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-chaos bench-fleet-obs bench-chip-obs
+	bench-disagg bench-chaos bench-fleet-obs bench-chip-obs
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -156,6 +156,19 @@ bench-kernels:
 bench-router:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.router_bench
 
+# CPU-runnable smoke: disaggregated prefill/decode serving — one
+# open-loop mixed long-prompt/short-decode trace through a 3-replica
+# in-process fleet, colocated vs role-split (--roles prefill=r0
+# decode=r1,r2; long prompts prefill on r0, KV pages transfer to a
+# decode worker over /v1/kv/export, streams splice across the hop) —
+# asserts the short streams' steady-state inter-token p99 is strictly
+# lower role-split (decode workers never step a wide prefill chunk),
+# every long prompt actually took the hop, and zero streams dropped
+# (one JSON line with the disagg_* serve-row fields +
+# kv_transfer_ms_p50/p99, kv_transferred_pages_total).
+bench-disagg:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.disagg_bench
+
 # CPU-runnable chaos smoke: one open-loop trace through a seeded fault
 # schedule (serving/faults.py + serving/supervisor.py) — an induced
 # mid-decode engine crash recovered in place (dense AND paged, the
@@ -208,8 +221,8 @@ clean:
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv \
 	bench-quant-paged bench-spec bench-sched bench-tp bench-obs \
-	bench-kernels bench-router bench-chaos bench-fleet-obs \
-	bench-chip-obs clean watch
+	bench-kernels bench-router bench-disagg bench-chaos \
+	bench-fleet-obs bench-chip-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

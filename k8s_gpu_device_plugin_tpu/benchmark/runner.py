@@ -493,7 +493,8 @@ def _run_serve() -> dict:
     # sweeps ride the same field set via BENCH_TP)
     tp_degree = int(os.environ.get("BENCH_TP", 2))
     r = serve_bench(cfg, quant_ab=True, spec_ab=True, fleet_ab=True,
-                    chaos_ab=True, tp_ab=len(_jax.devices()) > 1,
+                    chaos_ab=True, disagg_ab=True,
+                    tp_ab=len(_jax.devices()) > 1,
                     tp_degree=tp_degree)
     return {
         "workload": "serve",
@@ -609,6 +610,29 @@ def _run_serve() -> dict:
         "fleet_affinity_hit_pct": round(r.fleet_affinity_hit_pct, 1),
         "fleet_rejected_affinity": r.fleet_rejected_affinity,
         "fleet_rejected_rr": r.fleet_rejected_rr,
+        # disaggregated prefill/decode A/B (serving/router.py roles +
+        # /v1/kv/export): one mixed long-prompt/short-decode open-loop
+        # trace through a 3-replica fleet, colocated vs role-split —
+        # the short streams' steady-state inter-token p50/p99 per arm
+        # (decode workers never step a wide prefill chunk), TTFT p99
+        # per arm (the hop's first-token cost), and the KV-transfer
+        # hop itself (latency percentiles + pages moved). Dropped
+        # streams are asserted zero inside the workload.
+        "disagg_replicas": r.disagg_replicas,
+        "disagg_requests": r.disagg_requests,
+        "disagg_transfers": r.disagg_transfers,
+        "disagg_itl_p50_ms_colo": round(r.disagg_itl_p50_ms_colo, 2),
+        "disagg_itl_p50_ms_disagg": round(r.disagg_itl_p50_ms_disagg, 2),
+        "disagg_itl_p99_ms_colo": round(r.disagg_itl_p99_ms_colo, 2),
+        "disagg_itl_p99_ms_disagg": round(r.disagg_itl_p99_ms_disagg, 2),
+        "disagg_ttft_p99_ms_colo": round(r.disagg_ttft_p99_ms_colo, 1),
+        "disagg_ttft_p99_ms_disagg": round(
+            r.disagg_ttft_p99_ms_disagg, 1
+        ),
+        "kv_transfer_ms_p50": round(r.kv_transfer_ms_p50, 2),
+        "kv_transfer_ms_p99": round(r.kv_transfer_ms_p99, 2),
+        "kv_transferred_pages_total": r.kv_transferred_pages_total,
+        "disagg_dropped_streams": r.disagg_dropped_streams,
         # chaos arm (benchmark/workloads/chaos_bench.py): the recovery
         # tier's contract, exercised — an induced engine crash
         # (dense + paged, with transient pool-alloc faults) recovered

@@ -40,6 +40,7 @@ from k8s_gpu_device_plugin_tpu.benchmark.workloads.step_breakdown import (
 from k8s_gpu_device_plugin_tpu.ops import tunings
 from k8s_gpu_device_plugin_tpu.ops.kernel_support import fit_block
 from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+    MAX_PREFILL_T,
     ragged_paged_attention,
 )
 
@@ -47,16 +48,23 @@ from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
 #: path; verify the speculative gamma window; prefill one chunk)
 MODE_T = {"decode": 1, "verify": 8, "prefill": 256}
 
+#: T tiles the prefill sweep crosses with block_k when the chunk is
+#: wider than one kernel window (``prefill_t > MAX_PREFILL_T``): the
+#: tile trades accumulator VMEM against re-sweeping the slot's live kv
+#: blocks once per tile — a measured fact, not a guessable one
+PREFILL_TILES = (256, 128, 64)
+
 
 @dataclass(frozen=True)
 class KernelTuneResult:
     generation: str       # tilings bucket the winners were recorded under
     shape: tuple          # (B, S, Hq, Hkv, hd)
-    # mode -> {"<bk>": best-of-N ms | "error: <ExcName>"}
+    # mode -> {"<bk>[/t<bt>]": best-of-N ms | "error: <ExcName>"}
     mode_ms: dict
     best: dict            # mode -> winning block_k (0 = nothing timed)
     tunings_path: str = ""  # "" when persist failed/disabled
-    recorded: dict = field(default_factory=dict)  # key -> [block_k]
+    # key -> [block_k] ([block_k, block_t] for tiled prefill chunks)
+    recorded: dict = field(default_factory=dict)
 
 
 def kernel_tune(
@@ -95,43 +103,56 @@ def kernel_tune(
         q = jax.random.normal(kq, (batch, t, n_heads, head_dim),
                               jnp.bfloat16)
         base = jnp.maximum(lengths - t, 0)
+        # wide prefill chunks cross block_k with the T tile; every
+        # other shape is a single tile (bt = t), labelled by bk alone
+        if mode == "prefill" and t > MAX_PREFILL_T:
+            tiles = [bt for bt in PREFILL_TILES if t % bt == 0] or [0]
+        else:
+            tiles = [t]
         ms: dict[str, object] = {}
         for bk in blocks:
             if fit_block(seq, bk) != bk:
                 continue  # not a clean tile at this seq: skip, not error
+            for bt in tiles:
 
-            def scalar(q, k, v, base, _bk=bk, _t=t):
-                def body(c, _):
-                    qc = q + (c * 0).astype(q.dtype)  # defeat LICM
-                    o = ragged_paged_attention(
-                        qc, k, v, base, scale=head_dim ** -0.5,
-                        block_k=_bk, interpret=interpret,
-                    )
-                    return jnp.sum(o.astype(jnp.float32)) * 1e-9, None
+                def scalar(q, k, v, base, _bk=bk, _bt=bt):
+                    def body(c, _):
+                        qc = q + (c * 0).astype(q.dtype)  # defeat LICM
+                        o = ragged_paged_attention(
+                            qc, k, v, base, scale=head_dim ** -0.5,
+                            block_k=_bk, block_t=_bt,
+                            interpret=interpret,
+                        )
+                        return jnp.sum(o.astype(jnp.float32)) * 1e-9, None
 
-                c, _ = jax.lax.scan(body, jnp.float32(0), None,
-                                    length=iters)
-                return c
+                    c, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                        length=iters)
+                    return c
 
-            label = str(bk)
-            # one rejected tiling (VMEM blow-up on the real backend)
-            # must not void the sweep — the flash_tune robustness rule
-            try:
-                ms[label] = _time_scalar_fn(
-                    jax.jit(scalar), (q, k, v, base), repeats
-                ) / iters * 1000
-            except Exception as e:  # noqa: BLE001 - sweep robustness
-                ms[label] = f"error: {type(e).__name__}"
-                print(f"kernel_tune: {mode} bk={bk} failed: {e}",
-                      file=sys.stderr)
+                label = str(bk) if bt == t else f"{bk}/t{bt}"
+                # one rejected tiling (VMEM blow-up on the real backend)
+                # must not void the sweep — the flash_tune rule
+                try:
+                    ms[label] = _time_scalar_fn(
+                        jax.jit(scalar), (q, k, v, base), repeats
+                    ) / iters * 1000
+                except Exception as e:  # noqa: BLE001 - sweep robustness
+                    ms[label] = f"error: {type(e).__name__}"
+                    print(f"kernel_tune: {mode} {label} failed: {e}",
+                          file=sys.stderr)
         mode_ms[mode] = ms
-        timed = {int(kk_) : v_ for kk_, v_ in ms.items()
+        timed = {kk_: v_ for kk_, v_ in ms.items()
                  if isinstance(v_, float)}
-        best[mode] = min(timed, key=timed.get) if timed else 0
-        if best[mode]:
+        if timed:
+            win = min(timed, key=timed.get)
+            bk_s, _, bt_s = win.partition("/t")
+            best[mode] = int(bk_s)
+            row = [int(bk_s)] + ([int(bt_s)] if bt_s else [])
             recorded[
                 f"rpa:{mode}:hkv{n_kv_heads}:hd{head_dim}:{seq}"
-            ] = [best[mode]]
+            ] = row
+        else:
+            best[mode] = 0
 
     path = ""
     if persist and recorded:

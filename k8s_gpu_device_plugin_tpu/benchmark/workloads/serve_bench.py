@@ -61,6 +61,18 @@ admission prefills, EOS retirements and slot reuse. Reported numbers:
   drain cycle's retirement wait, and the dropped-stream count (MUST
   be zero). ``make bench-router`` is the CPU smoke twin.
 
+- the disaggregation A/B (``disagg_ab=True``): one open-loop mixed
+  long-prompt/short-decode trace through a 3-replica in-process fleet,
+  colocated vs role-split (prefill=r0, decode=r1,r2 — long prompts
+  prefill on r0 and their KV pages transfer to a decode worker over
+  ``/v1/kv/export``, the stream splicing across the hop). Reported:
+  client-side inter-token p50/p99 per arm (decode workers that never
+  step a wide prefill chunk stop stalling live streams — the claim),
+  TTFT p99 per arm (what the hop costs at first token), and the
+  ``kv_transfer_ms`` percentiles + page total from the router's
+  transfer ring. Zero dropped streams asserted in both arms.
+  ``make bench-disagg`` is the CPU smoke twin.
+
 - the tensor-parallel sweep A/B (``tp_ab=True``): the same workload
   through a tp-sharded batcher (weights column-cut, KV on the head axis
   over a ``tp_degree``-device mesh — parallel/tp_serving.py), reporting
@@ -217,6 +229,31 @@ class ServeBenchResult:
     fleet_affinity_hit_pct: float = 0.0
     fleet_rejected_affinity: int = 0
     fleet_rejected_rr: int = 0
+    # disaggregated prefill/decode A/B (``disagg_ab=True``): one mixed
+    # long-prompt/short-decode open-loop trace through a 3-replica
+    # in-process fleet, colocated (unroled) vs role-split (prefill=r0,
+    # decode=r1,r2 — long prompts prefill on r0, their KV pages ship to
+    # a decode worker over /v1/kv/export, the stream splices across the
+    # hop). The client-side inter-token p99 is the claim: decode
+    # workers that never run wide prefill chunks stop stalling live
+    # streams. TTFT per arm keeps the cost honest (the disagg hop adds
+    # transfer latency to first token), and the kv_transfer_ms
+    # percentiles + page total size the hop itself. Dropped streams
+    # are ASSERTED zero in both arms inside the workload. All zero
+    # when disagg_ab=False.
+    disagg_replicas: int = 0
+    disagg_requests: int = 0
+    disagg_transfers: int = 0
+    disagg_itl_p50_ms_colo: float = 0.0
+    disagg_itl_p50_ms_disagg: float = 0.0
+    disagg_itl_p99_ms_colo: float = 0.0
+    disagg_itl_p99_ms_disagg: float = 0.0
+    disagg_ttft_p99_ms_colo: float = 0.0
+    disagg_ttft_p99_ms_disagg: float = 0.0
+    kv_transfer_ms_p50: float = 0.0
+    kv_transfer_ms_p99: float = 0.0
+    kv_transferred_pages_total: int = 0
+    disagg_dropped_streams: int = 0
     # tensor-parallel sweep A/B (``tp_ab=True``): the same mixed-length
     # workload through a tp-sharded batcher (weights column-cut, KV on
     # the head axis — parallel/tp_serving.py), against the tp=1 primary
@@ -993,6 +1030,232 @@ def fleet_openloop_ab(
     }
 
 
+def disagg_openloop_ab(
+    cfg,
+    params,
+    *,
+    n_slots: int,
+    max_len: int,
+    prompt_buckets: tuple[int, ...],
+    chunked_prefill: int,
+    kv_page_size: int,
+    n_requests: int = 12,
+    long_len: "int | None" = None,
+    short_len: "int | None" = None,
+    max_new: int = 16,
+    long_new: int = 8,
+    gap_s: float = 0.05,
+    seed: int = 0,
+) -> dict:
+    """The disaggregation A/B: one open-loop trace of interleaved
+    long-prompt and short-prompt streams through a 3-replica in-process
+    fleet, once colocated (every replica prefills and decodes) and once
+    role-split (``--roles prefill=r0 decode=r1,r2``: long prompts
+    prefill on r0, their KV pages transfer to a decode worker and the
+    stream splices across the hop). Same trace, same replicas, same
+    round-robin spread — roles are the only variable.
+
+    What it measures, all CLIENT-side from SSE frame arrival times:
+
+    - ``disagg_itl_p{50,99}_ms_{colo,disagg}``: STEADY-STATE
+      inter-token gaps of the SHORT-prompt decode streams — the
+      latency-sensitive tenant disaggregation exists to protect — over
+      each stream's last ``max_new // 2`` gaps, in BOTH arms. The
+      long-prompt streams are the interference source (wide prompts,
+      small ``long_new`` decode budget): colocated, their multi-chunk
+      prefills land on the same engines that are decoding the shorts
+      and stall them; role-split, every wide prefill happens on r0 and
+      the decode workers only ever step decode + the hop's narrow
+      finish chunk, so the shorts' tail collapses — the perf claim.
+      The head of every stream is excluded because the disagg hop's
+      one-time transfer gap rides between the earliest tokens (it is
+      TTFT-adjacent spend, reported separately as ``kv_transfer_ms``).
+    - ``disagg_ttft_p99_ms_{colo,disagg}``: what the hop costs at
+      first token (export + transfer + install ride before the
+      decode worker's first frame relays).
+    - ``kv_transfer_ms_p{50,99}`` / ``kv_transferred_pages_total``:
+      the hop itself, from the router's transfer ring.
+
+    Every stream must deliver its done event in both arms — a dropped
+    stream raises instead of reporting (the splice is correctness
+    machinery; a bench that benchmarks a broken splice would lie)."""
+    import asyncio
+
+    import aiohttp
+    import numpy as np
+
+    from k8s_gpu_device_plugin_tpu.serving.testing import inprocess_fleet
+
+    buckets = tuple(b for b in prompt_buckets if b <= max_len)
+    if long_len is None:
+        # the long prompts must clear several prefill chunks (the colo
+        # arm's stall source) and still leave decode headroom
+        long_len = min(max(buckets), max_len - max_new - 1)
+    if short_len is None:
+        short_len = max(2, min(buckets) // 2)
+    rng = np.random.default_rng(seed)
+    trace = []
+    for i in range(n_requests):
+        # 2:1 long:short — enough wide prefills in flight that every
+        # colocated short decodes next to at least one
+        long = i % 3 != 2
+        trace.append({
+            "t": i * gap_s,
+            "prompt": rng.integers(
+                1, cfg.vocab_size, size=long_len if long else short_len
+            ).tolist(),
+            "max_new": long_new if long else max_new,
+            "long": long,
+        })
+
+    async def drive(session, base, t0, e, facts):
+        await asyncio.sleep(max(0.0, t0 + e["t"] - time.perf_counter()))
+        t_arrive = time.perf_counter()
+        fact = {"ttft_s": None, "gaps_s": [], "done": False,
+                "long": e["long"]}
+        facts.append(fact)
+        try:
+            async with session.post(f"{base}/v1/generate", json={
+                "prompt": e["prompt"], "max_new": e["max_new"],
+                "stream": True,
+            }) as r:
+                if r.status != 200:
+                    return
+                t_prev = None
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    evt = json.loads(line[len("data: "):])
+                    if "token" in evt:
+                        now = time.perf_counter()
+                        if t_prev is None:
+                            fact["ttft_s"] = now - t_arrive
+                        else:
+                            fact["gaps_s"].append(now - t_prev)
+                        t_prev = now
+                    if evt.get("done"):
+                        fact["done"] = True
+                        return
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError, OSError):
+            return
+
+    async def run_arm(roled: bool) -> tuple[list, dict]:
+        router_kw = dict(policy="rr", health_interval_s=0.2)
+        if roled:
+            # every long prompt takes the hop; shorts stay colocated
+            # on a decode worker
+            router_kw.update(
+                roles="prefill=r0 decode=r1,r2",
+                disagg_min_prompt=long_len,
+            )
+        facts: list = []
+        async with inprocess_fleet(
+            params, cfg, n_replicas=3,
+            engine_kw=dict(
+                n_slots=n_slots, max_len=max_len,
+                prompt_buckets=buckets,
+                chunked_prefill=chunked_prefill,
+                kv_layout="paged", kv_page_size=kv_page_size,
+            ),
+            router_kw=router_kw,
+        ) as fl:
+            async with aiohttp.ClientSession() as session:
+                # warm every replica SEQUENTIALLY (both bucket shapes):
+                # two engine threads compiling at once has segfaulted
+                # XLA:CPU — see fleet_openloop_ab's note
+                for i in range(3):
+                    for wp_len in (long_len, short_len):
+                        wp = [1 + (j % (cfg.vocab_size - 1))
+                              for j in range(wp_len)]
+                        async with session.post(
+                            f"{fl.replica_base(i)}/v1/generate",
+                            json={"prompt": wp, "max_new": 2},
+                        ) as r:
+                            await r.read()
+                # ...then THROUGH the router, still sequentially: the
+                # roled arm's first transfers otherwise compile the
+                # fold/install/finish-chunk shapes mid-trace on the
+                # decode workers, stalling every live stream there
+                # (four passes so the rr decode pick touches both
+                # workers); the colo arm runs the same warm so neither
+                # arm starts colder than the other
+                wp = [1 + (j % (cfg.vocab_size - 1))
+                      for j in range(long_len)]
+                for _ in range(4):
+                    async with session.post(
+                        f"{fl.base}/v1/generate",
+                        json={"prompt": wp, "max_new": 4,
+                              "stream": True},
+                    ) as r:
+                        await r.read()
+                stats0 = fl.router.router_stats()
+                t0 = time.perf_counter()
+                await asyncio.gather(*(
+                    drive(session, fl.base, t0, e, facts) for e in trace
+                ))
+                stats = fl.router.router_stats()
+        # report the TRACE's transfers only: the warm pass's hops paid
+        # the compile cost on purpose and would pollute the ring
+        stats["kv_transfers"] = {
+            k: v - stats0["kv_transfers"].get(k, 0)
+            for k, v in stats["kv_transfers"].items()
+        }
+        stats["kv_transfer_ms"] = stats["kv_transfer_ms"][
+            len(stats0["kv_transfer_ms"]):
+        ]
+        stats["kv_transferred_pages"] -= stats0["kv_transferred_pages"]
+        return facts, stats
+
+    async def both():
+        colo = await run_arm(False)
+        disagg = await run_arm(True)
+        return colo, disagg
+
+    (colo, colo_stats), (dis, dis_stats) = asyncio.run(both())
+    for arm, facts in (("colo", colo), ("disagg", dis)):
+        undone = sum(1 for f in facts if not f["done"])
+        if undone:
+            raise RuntimeError(
+                f"disagg A/B: {undone} dropped stream(s) in the {arm} "
+                "arm — refusing to report latencies over a broken splice"
+            )
+    transfers = dis_stats["kv_transfers"].get("ok", 0)
+    expect = sum(1 for e in trace if e["long"])
+    if transfers < expect:
+        raise RuntimeError(
+            f"disagg A/B: only {transfers}/{expect} long prompts took "
+            f"the KV-transfer hop ({dis_stats['kv_transfers']}) — the "
+            "roled arm measured the colocated path"
+        )
+
+    def itl(facts, tail: int = max(1, max_new // 2)):
+        return [g * 1000.0 for f in facts if not f["long"]
+                for g in f["gaps_s"][-tail:]]
+
+    def ttft(facts):
+        return [f["ttft_s"] * 1000.0 for f in facts
+                if f["ttft_s"] is not None]
+
+    t_ms = dis_stats["kv_transfer_ms"]
+    return {
+        "disagg_replicas": 3,
+        "disagg_requests": n_requests,
+        "disagg_transfers": transfers,
+        "disagg_itl_p50_ms_colo": _pct(itl(colo), 50),
+        "disagg_itl_p50_ms_disagg": _pct(itl(dis), 50),
+        "disagg_itl_p99_ms_colo": _pct(itl(colo), 99),
+        "disagg_itl_p99_ms_disagg": _pct(itl(dis), 99),
+        "disagg_ttft_p99_ms_colo": _pct(ttft(colo), 99),
+        "disagg_ttft_p99_ms_disagg": _pct(ttft(dis), 99),
+        "kv_transfer_ms_p50": _pct(t_ms, 50),
+        "kv_transfer_ms_p99": _pct(t_ms, 99),
+        "kv_transferred_pages_total": dis_stats["kv_transferred_pages"],
+        "disagg_dropped_streams": 0,  # asserted above, both arms
+    }
+
+
 def serve_bench(
     cfg: LlamaConfig,
     n_slots: int = 8,
@@ -1011,6 +1274,7 @@ def serve_bench(
     sched_ab: bool = True,
     fleet_ab: bool = False,
     chaos_ab: bool = False,
+    disagg_ab: bool = False,
     tp_ab: bool = False,
     tp_degree: int = 2,
     sched_base_s: float = 4.0,
@@ -1432,6 +1696,23 @@ def serve_bench(
             file=sys.stderr,
         )
 
+    # --- disagg A/B: colocated vs prefill/decode role-split fleet ---
+    disagg_fields: dict = {}
+    if disagg_ab and chunked_prefill and max_len % kv_page_size == 0:
+        disagg_fields = disagg_openloop_ab(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            prompt_buckets=prompt_buckets,
+            chunked_prefill=chunked_prefill,
+            kv_page_size=kv_page_size, max_new=max_new,
+        )
+    elif disagg_ab:
+        print(
+            "serve_bench: disagg A/B skipped — the KV-transfer hop "
+            "requires chunked_prefill and a paged-compatible max_len "
+            f"(max_len={max_len} % kv_page_size={kv_page_size} == 0)",
+            file=sys.stderr,
+        )
+
     # --- chaos arm: seeded fault schedule through the recovery tier ---
     chaos_fields: dict = {}
     if chaos_ab and chunked_prefill:
@@ -1607,6 +1888,7 @@ def serve_bench(
         **quant_fields,
         **sched_fields,
         **fleet_fields,
+        **disagg_fields,
         **chaos_fields,
         **tp_fields,
     )

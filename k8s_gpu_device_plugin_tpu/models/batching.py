@@ -76,6 +76,8 @@ from k8s_gpu_device_plugin_tpu.models.paging import (
     PagePool,
     kv_shard_token_bytes,
     kv_token_bytes,
+    pack_kv_wire,
+    unpack_kv_wire,
 )
 from k8s_gpu_device_plugin_tpu.models.sampling import (
     Sampler,
@@ -454,6 +456,11 @@ class _Request:
     # rejected while queued or cancelled mid-prefill must be charged
     # for what it computed, not its whole prompt
     prefill_computed: int = 0
+    # KV-transfer install (disaggregated prefill/decode): the decoded
+    # ``(meta, planes)`` of a kv_pages wire blob riding a resume
+    # submission. Consumed (and cleared) by ``install_kv_pages`` at
+    # admission; a request that never reaches install just drops it.
+    _kv_wire: "tuple | None" = None
 
 
 
@@ -1055,6 +1062,109 @@ class ContinuousBatcher:
             lps = [0.0] * len(toks)
         return toks, lps
 
+    def validate_kv_pages(
+        self, kv_pages, prompt_len: int, resume_len: int,
+    ) -> "tuple | None":
+        """The KV-transfer half of the admission rule (shared with the
+        serving engine's request thread, like ``validate``): decode a
+        :func:`~.paging.pack_kv_wire` blob and check it against THIS
+        batcher's pool geometry and cache planes. Returns the decoded
+        ``(meta, planes)`` pair that ``install_kv_pages`` consumes, or
+        None when no blob was passed."""
+        if kv_pages is None:
+            return None
+        if self.pool is None:
+            raise ValueError(
+                "kv_pages requires the paged KV layout on the receiving "
+                "replica (kv_layout='paged' / --kvLayout paged); this "
+                "batcher serves the dense layout — resubmit without "
+                "kv_pages to re-prefill instead"
+            )
+        if not resume_len:
+            raise ValueError(
+                "kv_pages without resume_out: pages are exported after "
+                "the first emitted token, so an install always resumes "
+                "at least one token"
+            )
+        if isinstance(kv_pages, tuple):
+            # already decoded (the serving engine validates on the
+            # request thread and hands the decoded pair through the
+            # submit queue — no second base64 pass on the engine thread)
+            meta, planes = kv_pages
+        else:
+            meta, planes = unpack_kv_wire(kv_pages)
+        if int(meta["page_size"]) != self.pool.page_size:
+            raise ValueError(
+                f"kv wire blob uses page_size={meta['page_size']} but "
+                f"this pool uses {self.pool.page_size}: pages only "
+                "transfer between identically paged replicas"
+            )
+        if meta.get("cache_quant") != self.cfg.cache_quant:
+            raise ValueError(
+                f"kv wire blob was exported from a "
+                f"cache_quant={meta.get('cache_quant')!r} pool; this "
+                f"batcher serves cache_quant={self.cfg.cache_quant!r}"
+            )
+        want = {
+            name: leaf
+            for name, leaf in (
+                ("k", self.state.cache.k), ("v", self.state.cache.v),
+                ("k_scale", self.state.cache.k_scale),
+                ("v_scale", self.state.cache.v_scale),
+            )
+            if leaf is not None
+        }
+        if set(planes) != set(want):
+            raise ValueError(
+                f"kv wire blob carries planes {sorted(planes)} but this "
+                f"pool holds {sorted(want)} (quantization mismatch?)"
+            )
+        for name, arr in planes.items():
+            leaf = want[name]
+            ref = (leaf.shape[0],) + tuple(leaf.shape[2:])
+            got = (arr.shape[0],) + tuple(arr.shape[2:])
+            if got != ref or str(arr.dtype) != str(leaf.dtype):
+                raise ValueError(
+                    f"kv wire plane {name!r} is {tuple(arr.shape)} "
+                    f"{arr.dtype}; this pool's rows are "
+                    f"(L={leaf.shape[0]}, n, {leaf.shape[2]}, "
+                    f"{leaf.shape[3]}, {leaf.shape[4]}) {leaf.dtype}"
+                )
+        valid = int(meta["tokens"])
+        folded = prompt_len + resume_len
+        if valid != folded - 1:
+            raise ValueError(
+                f"kv wire blob covers {valid} cache rows but the folded "
+                f"prompt ({prompt_len} prompt + {resume_len} resumed "
+                f"tokens) needs {folded - 1} (the newest resumed "
+                "token's row is written by the finish chunk)"
+            )
+        if int(meta["n_pages"]) != self.pool.pages_for_tokens(valid):
+            raise ValueError(
+                f"kv wire blob ships {meta['n_pages']} pages for "
+                f"{valid} rows; page_size {self.pool.page_size} needs "
+                f"{self.pool.pages_for_tokens(valid)}"
+            )
+        return meta, planes
+
+    def kv_install_headroom(
+        self, prompt_len: int, max_new: int,
+    ) -> "tuple[int, int]":
+        """``(pages needed, pages free)`` for an incoming KV-page
+        install — the submit-time pressure gate on the transfer seam.
+        Cross-thread safe by the thread-ownership contract:
+        ``pages_for_tokens`` is pure arithmetic on immutable pool
+        geometry and ``free_pages`` is one GIL-atomic ``len()`` of the
+        free list (the same approximate-read contract as ``stats()``);
+        the engine-thread reservation in ``_reserve_pages`` stays
+        authoritative if a burst races past this read."""
+        if self.pool is None:
+            return (0, 0)
+        need = self.pool.pages_for_tokens(
+            self._kv_need_tokens(prompt_len, max_new)
+        )
+        return need, self.pool.free_pages
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -1081,6 +1191,7 @@ class ContinuousBatcher:
         deadline_ms: "int | None" = None,
         resume_out: "list[int] | None" = None,
         resume_logp: "list[float] | None" = None,
+        kv_pages=None,
     ) -> int:
         """Queue a request. ``prefix`` (precompute_prefix) prepends a
         SHARED prefilled prefix: its rows are copied into the slot at
@@ -1113,7 +1224,18 @@ class ContinuousBatcher:
         and stop-sequence matching spans the resume boundary.
         ``resume_logp`` carries the already-emitted logprobs (zeros
         when the caller never saw them — indices below ``prefilled_out``
-        are never re-published)."""
+        are never re-published).
+
+        ``kv_pages`` upgrades a resume from "re-prefill the folded
+        prompt" to "install the transferred pages" (disaggregated
+        prefill/decode): a :func:`~.paging.pack_kv_wire` blob exported
+        by another replica's ``export_kv_pages`` is scattered into
+        freshly allocated pages at admission, and the chunk scheduler
+        starts at the finish chunk instead of position 0 — same
+        emissions, same seeded draws, bit-identical streams, without
+        recomputing the prompt's K/V. Requires ``resume_out`` (pages
+        export only after the first emitted token) and the paged layout
+        on this batcher."""
         if prefix is not None and not self.chunk:
             raise ValueError("prefix sharing requires chunked_prefill=C")
         if isinstance(prefix, PagedPrefixState):
@@ -1141,6 +1263,9 @@ class ContinuousBatcher:
         # REMAINING budget so the row total matches the original
         # request's worst case exactly (the _reserve_pages rule).
         self.validate(total, max_new - len(resume_out))
+        kv_wire = self.validate_kv_pages(
+            kv_pages, len(prompt), len(resume_out)
+        )
         self.validate_adapter(adapter)
         bias = self.validate_bias(logit_bias)
         seed = self.validate_seed(seed)
@@ -1191,6 +1316,7 @@ class ContinuousBatcher:
             req.out = list(resume_out)
             req.out_logp = list(resume_logp)
             req.prefilled_out = len(resume_out)
+            req._kv_wire = kv_wire
         req.t_submit = now
         if self.scheduler is not None:
             # admission control (queue cap, quota charge) BEFORE the
@@ -1397,7 +1523,12 @@ class ContinuousBatcher:
             req = self.pending[0]
             if (self.chunk and req.prefix is None
                     and self.prefix_cache is not None
-                    and len(req.prompt) > 1 and not req.matched):
+                    and len(req.prompt) > 1 and not req.matched
+                    and req._kv_wire is None):
+                # (a kv-transfer install skips matching outright: its
+                # rows arrive materialized, so aliasing cached pages
+                # under them would be pure bookkeeping with nothing to
+                # save — and the install path owns the slot's presence)
                 # THE automatic match site: at admission the request
                 # is past validation and sees every prefix promoted
                 # since it queued (a whole burst behind one system
@@ -1484,7 +1615,21 @@ class ContinuousBatcher:
                     )
             if self.chunk:
                 start = 0
-                if req.prefix is not None:
+                if req._kv_wire is not None:
+                    # disaggregated transfer: scatter the shipped pages
+                    # into the fresh allocation and jump the chunk
+                    # scheduler to the finish chunk — the only prefill
+                    # dispatch this admission makes
+                    t_inst = (
+                        time.perf_counter()
+                        if req.timeline is not None else 0.0
+                    )
+                    start = self.install_kv_pages(req, slot)
+                    if req.timeline is not None:
+                        req.timeline.page_alloc_s += (
+                            time.perf_counter() - t_inst
+                        )
+                elif req.prefix is not None:
                     if self.pool is None:
                         # copy the shared rows + presence; suffix chunks
                         # continue from the prefix boundary (the paged
@@ -1723,6 +1868,115 @@ class ContinuousBatcher:
                     }},
                 )
         self._report_kv_gauges()
+
+    # --- KV page transfer (disaggregated prefill/decode) ---
+
+    def export_kv_pages(self, rid: int) -> "tuple[dict, list, list]":
+        """Export a decoding request's materialized cache pages as a
+        self-describing wire blob (the prefill replica's half of a
+        disaggregated transfer, serving/router.py). Returns
+        ``(blob, out, out_logp)``: the blob plus a CONSISTENT snapshot
+        of the tokens emitted so far — exactly the ``resume_out`` /
+        ``resume_logprobs`` the resubmission needs. The request keeps
+        decoding here until the caller cancels it (the serving engine's
+        export op does snapshot + cancel back-to-back on the engine
+        thread, so nothing can interleave).
+
+        Only pages holding VALID rows ship: ``lengths[slot]`` rows =
+        folded prompt + emitted - 1. The newest emitted token's K/V row
+        does not exist yet (the next decode step would write it) — it
+        rides ``out`` instead, becoming the last resumed token, whose
+        row the importer's finish chunk writes. Raises KeyError for an
+        unknown/finished rid, ValueError for the dense layout or a
+        request still prefilling."""
+        if self.pool is None:
+            raise ValueError(
+                "KV page export requires the paged layout "
+                "(kv_layout='paged' / --kvLayout paged); this replica "
+                "serves dense KV — resume with re-prefill instead"
+            )
+        if self._inflight is not None:
+            # the snapshot must include every dispatched emission, or
+            # the blob's row count and ``out`` would disagree
+            self._flush_inflight()
+        req = None
+        for slot, r in self.running.items():
+            if r.rid == rid:
+                req = r
+                break
+        if req is None:
+            waiting = [r.rid for r in self.pending] + [
+                r.rid for r in self.prefilling.values()
+            ]
+            if rid in waiting:
+                raise ValueError(
+                    f"request {rid} has not finished prefill; KV pages "
+                    "export only after the first emitted token"
+                )
+            raise KeyError(f"unknown or finished request {rid}")
+        valid = len(req.prompt) + len(req.out) - req.prefilled_out - 1
+        n = self.pool.pages_for_tokens(valid)
+        ids = jnp.asarray(np.asarray(self._slot_pages[slot][:n], np.int32))
+        planes = {}
+        with self._dispatch_scope():
+            for name in ("k", "v", "k_scale", "v_scale"):
+                leaf = getattr(self.state.cache, name)
+                if leaf is not None:
+                    planes[name] = np.asarray(jax.device_get(leaf[:, ids]))
+        blob = pack_kv_wire(
+            planes, page_size=self.pool.page_size,
+            cache_quant=self.cfg.cache_quant, tokens=valid,
+        )
+        if self.tracer.enabled and req.span is not None:
+            self.tracer.span(
+                "kv_export", component="serving", parent=req.span,
+                pages=n, tokens=valid,
+            ).end()
+        return blob, list(req.out), list(req.out_logp)
+
+    def install_kv_pages(self, req: _Request, slot: int) -> int:
+        """Install a transferred wire blob into ``slot``'s freshly
+        allocated pages (the decode replica's half of a disaggregated
+        transfer; ``_admit`` calls this right after ``_install_pages``).
+        The pages are brand-new allocations at refcount 1 — scattering
+        rows into them can touch neither shared pages nor the trap
+        page, so refcount/COW/trap semantics are exactly the cold
+        admission's. Presence is seeded host-side from the folded
+        prompt's token ids (a pure function of them — identical to what
+        the skipped chunks would have accumulated). Returns the chunk
+        scheduler's start position: the finish chunk becomes the ONLY
+        prefill dispatch — it rewrites its overlap window (identical
+        K/V, the standing chunk-overlap argument), writes the one row
+        the export could not carry, and samples emission number
+        ``prefilled_out`` exactly like a PR-14 re-prefill resume, so
+        greedy and seeded streams stay bit-identical to single-replica
+        serving."""
+        meta, wire_planes = req._kv_wire
+        req._kv_wire = None
+        n = int(meta["n_pages"])
+        ids = jnp.asarray(np.asarray(self._slot_pages[slot][:n], np.int32))
+        wire = KVCache(
+            k=wire_planes["k"], v=wire_planes["v"],
+            k_scale=wire_planes.get("k_scale"),
+            v_scale=wire_planes.get("v_scale"),
+        )
+        seen = np.zeros((self.state.presence.shape[1],), bool)
+        seen[list(set(req.prompt))] = True
+        self.state = _install_wire_pages(
+            self.state, wire, ids, jnp.asarray(seen), jnp.int32(slot)
+        )
+        plen = len(req.prompt)
+        start = max(0, plen - self.chunk)
+        # rows the transfer served in place of prefill compute: a new
+        # provenance label beside computed/prefix_reused (the finish
+        # chunk's window still counts as computed — it really runs)
+        self._count_prefill_tokens(start, "kv_installed")
+        if self.tracer.enabled and req.span is not None:
+            self.tracer.span(
+                "kv_install", component="serving", parent=req.span,
+                pages=n, tokens=int(meta["tokens"]), start=start,
+            ).end()
+        return start
 
     def _release_slot_pages(self, slot: int, req: "_Request | None" = None
                             ) -> None:
@@ -2956,6 +3210,32 @@ def _copy_page(state: BatchState, src, dst) -> BatchState:
         lengths=state.lengths, last_token=state.last_token,
         active=state.active, presence=state.presence, key=state.key,
         budget=state.budget, draws=state.draws, pages=state.pages,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _install_wire_pages(
+    state: BatchState, wire: KVCache, ids, presence: jax.Array, slot
+) -> BatchState:
+    """Scatter transferred pool pages (a decoded KV wire blob — jit
+    device_puts the host arrays) into the pool at the freshly allocated
+    ``ids``, and seed the slot's presence mask — the import half of the
+    disaggregated KV transfer. Donated: in-place on the pool buffers.
+    Retraces per shipped-page count, which the prompt buckets bound,
+    and runs once per installed admission — never per step."""
+    def ins(full, part):
+        if full is None:
+            return None
+        return full.at[:, ids].set(part)
+
+    return BatchState(
+        cache=jax.tree.map(ins, state.cache, wire,
+                           is_leaf=lambda x: x is None),
+        lengths=state.lengths, last_token=state.last_token,
+        active=state.active,
+        presence=state.presence.at[jnp.int32(slot)].set(presence),
+        key=state.key, budget=state.budget, draws=state.draws,
+        pages=state.pages,
     )
 
 

@@ -28,6 +28,10 @@ happens on the engine thread.
 
 from __future__ import annotations
 
+import base64
+
+import numpy as np
+
 
 class PagePool:
     """Free-list page allocator with reference counts.
@@ -165,3 +169,109 @@ def kv_shard_token_bytes(cfg) -> int:
     is exact (the mesh validation guarantees tp | n_kv_heads). tp=1
     degenerates to :func:`kv_token_bytes`."""
     return kv_token_bytes(cfg) // max(1, getattr(cfg, "tp", 1))
+
+
+# ---------------- KV page transfer wire format ----------------
+#
+# Disaggregated prefill/decode ships a request's finished cache rows
+# from a prefill replica to a decode replica (serving/router.py drives
+# export -> transfer -> resubmit). The unit of transfer is the POOL
+# PAGE: the exporter gathers the pages its page-table row references —
+# codes AND quantized scale planes, so bf16/int8/int4 all transfer the
+# same way — and the importer scatters them into freshly allocated
+# pages of its own pool. The blob is self-describing (geometry, quant
+# mode, per-plane shape/dtype) so a mismatched receiver refuses with an
+# actionable error instead of corrupting KV, and it is JSON-safe
+# (base64 payloads) so it rides the same HTTP surface as the PR-14
+# resume seam. Pages are GLOBAL arrays regardless of tensor-parallel
+# degree — a page id names the same rows on every shard — so a blob
+# exported under tp=1 installs under tp=2 and vice versa.
+
+KV_WIRE_VERSION = 1
+
+
+def _wire_dtype(name: str):
+    """Resolve a wire dtype name, including the ml_dtypes extension
+    types (bfloat16) that plain numpy cannot name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    dt = getattr(ml_dtypes, name, None)
+    if dt is None:
+        raise ValueError(f"kv wire blob names unknown dtype {name!r}")
+    return np.dtype(dt)
+
+
+def pack_kv_wire(planes: dict, *, page_size: int, cache_quant,
+                 tokens: int) -> dict:
+    """Serialize exported pool pages into a self-describing, JSON-safe
+    wire blob. ``planes`` maps cache plane names (k/v and, quantized,
+    k_scale/v_scale) to host arrays of shape
+    ``(L, n_pages, page_size, Hkv, d)``; ``tokens`` is the count of
+    VALID leading rows (the importer's consistency check against the
+    folded prompt it is asked to install under)."""
+    n_pages = 0
+    out = {}
+    for name, arr in planes.items():
+        arr = np.ascontiguousarray(arr)
+        n_pages = int(arr.shape[1])
+        out[name] = {
+            "shape": [int(d) for d in arr.shape],
+            "dtype": arr.dtype.name,
+            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    return {
+        "version": KV_WIRE_VERSION,
+        "layout": "paged",
+        "page_size": int(page_size),
+        "cache_quant": cache_quant,
+        "tokens": int(tokens),
+        "n_pages": n_pages,
+        "planes": out,
+    }
+
+
+def unpack_kv_wire(blob) -> "tuple[dict, dict]":
+    """Decode a :func:`pack_kv_wire` blob into ``(meta, planes)`` with
+    numpy arrays, validating internal consistency (version, layout,
+    payload sizes against the declared shapes/dtypes). Compatibility
+    with a RECEIVING pool (page size, quant mode, plane geometry) is
+    the batcher's job — it knows its own cache."""
+    if not isinstance(blob, dict) or "planes" not in blob:
+        raise ValueError(
+            "kv_pages is not a KV wire blob (expected the dict "
+            "pack_kv_wire builds, with a 'planes' mapping)"
+        )
+    if blob.get("version") != KV_WIRE_VERSION:
+        raise ValueError(
+            f"unsupported KV wire version {blob.get('version')!r} "
+            f"(this build speaks version {KV_WIRE_VERSION})"
+        )
+    if blob.get("layout") != "paged":
+        raise ValueError(
+            f"KV wire layout {blob.get('layout')!r} is not 'paged': "
+            "only paged pools export/import pages"
+        )
+    n_pages = int(blob.get("n_pages", 0))
+    planes = {}
+    for name, p in blob["planes"].items():
+        dt = _wire_dtype(p["dtype"])
+        shape = tuple(int(d) for d in p["shape"])
+        if len(shape) != 5 or shape[1] != n_pages:
+            raise ValueError(
+                f"kv wire plane {name!r} has shape {shape}; expected "
+                f"5-d (L, n_pages={n_pages}, page_size, Hkv, d)"
+            )
+        raw = base64.b64decode(p["data"])
+        want = dt.itemsize * int(np.prod(shape))
+        if len(raw) != want:
+            raise ValueError(
+                f"kv wire plane {name!r}: payload is {len(raw)} bytes "
+                f"but shape {shape} / dtype {dt.name} needs {want}"
+            )
+        planes[name] = np.frombuffer(raw, dtype=dt).reshape(shape)
+    meta = {k: v for k, v in blob.items() if k != "planes"}
+    return meta, planes

@@ -433,9 +433,18 @@ class SpeculativeBatcher(ContinuousBatcher):
     def submit(self, prompt, max_new, prefix=None, stop=None, sampler=None,
                adapter=-1, logit_bias=None, seed=None,
                tenant="default", priority=1, deadline_ms=None,
-               resume_out=None, resume_logp=None):
+               resume_out=None, resume_logp=None, kv_pages=None):
         self.validate_resume(resume_out, resume_logp, max_new,
                              prefix=prefix)
+        if kv_pages is not None:
+            # unreachable through the serving engine (validate_resume
+            # already refuses the resume an install rides on), but the
+            # batcher API is public
+            raise ValueError(
+                "kv_pages install is not supported with speculative "
+                "batching (a KV transfer resumes a stream, and "
+                "speculative batching does not resume)"
+            )
         if sampler is not None:
             raise ValueError(
                 "per-request samplers are not supported with speculative "

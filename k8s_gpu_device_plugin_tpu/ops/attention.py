@@ -180,6 +180,7 @@ def serving_cache_attention(  # graftlint: hot-path=traced
     # recorded) and the T-inferred mode cannot tell a short prefill
     # chunk from a verify window — the dispatcher knows both.
     block_k = 0
+    block_t = 0
     if pages is None:
         from k8s_gpu_device_plugin_tpu.ops import tunings
 
@@ -187,10 +188,14 @@ def serving_cache_attention(  # graftlint: hot-path=traced
             f"rpa:{mode}:hkv{k_cache.shape[2]}:hd{hd}", k_cache.shape[1]
         )
         block_k = tuned[0] if tuned else rpa.DEFAULT_BLOCK_K
+        # prefill rows may carry a measured T tile as a second block
+        # (wide chunks tile the query axis); decode/verify never tile
+        if mode == "prefill" and tuned and len(tuned) > 1:
+            block_t = tuned[1]
     call = partial(
         rpa.ragged_paged_attention,
         scale=hd ** -0.5, window=window, block_k=block_k,
-        interpret=interpret,
+        block_t=block_t, interpret=interpret,
     )
     # quantized caches append their scale planes as trailing operands;
     # bf16 appends nothing, so its call graph is the pre-quant one
@@ -324,10 +329,13 @@ def attention_backend_plan(
                         f"max_len={max_len}: cache_quant="
                         f"{cache_quant!r} tiles at {rpa.QUANT_SUBLANE} "
                         "sublanes on TPU"}
-        if mode == "prefill" and chunk > rpa.MAX_PREFILL_T:
+        if (mode == "prefill" and chunk > 0
+                and rpa.fit_prefill_tile(chunk) is None):
             return {"backend": "xla", "reason":
-                    f"chunked_prefill={chunk} exceeds the kernel's "
-                    f"prefill window (MAX_PREFILL_T={rpa.MAX_PREFILL_T})"}
+                    f"chunked_prefill={chunk} has no T-tile divisor in "
+                    f"[MIN_PREFILL_TILE={rpa.MIN_PREFILL_TILE}, "
+                    f"MAX_PREFILL_T={rpa.MAX_PREFILL_T}]: pick a chunk "
+                    "divisible into kernel windows"}
         reason = "pallas ragged-paged kernel"
         if tp > 1:
             reason += f" (shard_map over the tp={tp} serving mesh)"

@@ -92,12 +92,36 @@ DEFAULT_BLOCK_K = 256
 #: tail pays for itself)
 MAX_VERIFY_T = 16
 
-#: widest prefill-chunk window: (Hkv, T, group, hd) f32 accumulators
+#: widest prefill-chunk TILE: (Hkv, T, group, hd) f32 accumulators
 #: plus the (T, Hq, hd) query block must fit VMEM alongside the kv
 #: blocks — at Hkv=8, T=256, group=4, hd=128 that is ~8 MB of
-#: accumulator, comfortable; doubling it is not. Longer chunks stay on
-#: the XLA gather (or shrink their chunk size).
+#: accumulator, comfortable; doubling it is not. Wider chunks tile the
+#: T axis (a third grid dimension, :func:`fit_prefill_tile`): each tile
+#: re-sweeps the slot's live kv blocks with its own VMEM accumulators.
 MAX_PREFILL_T = 256
+
+#: narrowest useful T tile: below this a wide chunk degenerates into a
+#: decode-like block-per-few-rows sweep that re-reads the cache more
+#: than the XLA gather would — shapes with only degenerate divisors
+#: stay on the gather
+MIN_PREFILL_TILE = 32
+
+
+def fit_prefill_tile(t: int, max_t: int = MAX_PREFILL_T) -> "int | None":
+    """Widest T tile for a T-row query window: T itself when the whole
+    window's accumulators fit VMEM (``t <= max_t``), else the largest
+    divisor of T at most ``max_t`` — the grid's third dimension then
+    sweeps ``t // tile`` tiles, each at query base ``base + i * tile``.
+    None when every divisor is degenerate (< :data:`MIN_PREFILL_TILE`,
+    e.g. a near-prime chunk): the caller's gather is the better route."""
+    if t < 1:
+        return None
+    if t <= max_t:
+        return t
+    for bt in range(max_t, MIN_PREFILL_TILE - 1, -1):
+        if t % bt == 0:
+            return bt
+    return None
 
 
 def _first_block(length: jax.Array, window: int, bk: int) -> jax.Array:
@@ -125,6 +149,13 @@ def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, *refs, bk: int, t: int,
     here can never change WHICH positions are attended, only how their
     softmax is accumulated.
 
+    The grid is (slot, T tile, kv block): ``t`` here is the TILE width,
+    and tile ``it`` shifts this instance's query base by ``it * t`` —
+    one-tile windows (every decode/verify call, prefill chunks up to
+    MAX_PREFILL_T) run exactly the pre-tiling body at ``it = 0``. Each
+    row's live kv blocks arrive in the same ascending order whatever
+    the tiling, so accumulation per row is bitwise tiling-invariant.
+
     ``quantized`` (a STATIC specialization, like T) inserts two scale
     refs — (bk, Hkv, 1) f32 rows riding the same index maps as the kv
     blocks — and the block step dequantizes the int8/int4 codes in VMEM
@@ -136,9 +167,10 @@ def _rpa_kernel(base_ref, q_ref, k_ref, v_ref, *refs, bk: int, t: int,
         o_ref, m_ref, l_ref, acc_ref = refs
         ks_ref = vs_ref = None
     b = pl.program_id(0)
-    j = pl.program_id(1)
-    nb = pl.num_programs(1)
-    base = base_ref[b]
+    it = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    base = base_ref[b] + it * t
     group = hq // hkv
 
     @pl.when(j == 0)
@@ -227,7 +259,9 @@ def supports(
     quantized: bool = False,
 ) -> bool:
     """Shapes the unified kernel tiles cleanly: a (B, T, Hq, hd) query
-    window with 1 <= T <= ``max_t``, a lane-aligned head dim, whole GQA
+    window whose T axis tiles into windows of at most ``max_t`` rows
+    (T itself when it fits; else :func:`fit_prefill_tile` must find a
+    non-degenerate divisor), a lane-aligned head dim, whole GQA
     groups, and a sublane-aligned kv block — dense caches need some
     block dividing the cache length, paged pools need the page itself
     aligned (the page IS the block). ``quantized`` (int8/int4 codes +
@@ -241,7 +275,7 @@ def supports(
     if q.ndim != 4 or k.ndim != 4:
         return False
     b, t, hq, hd = q.shape
-    if not (1 <= t <= max_t):
+    if fit_prefill_tile(t, max_t) is None:
         return False
     hkv = k.shape[2]
     if not (lane_aligned(hd) and gqa_ok(hq, hkv) and k.shape[3] == hd):
@@ -256,68 +290,77 @@ def supports(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "window", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("scale", "window", "block_k", "block_t", "interpret"),
 )
 def _rpa_call(q, k, v, base, pages, k_scale, v_scale, *, scale, window,
-              block_k, interpret):
+              block_k, block_t, interpret):
     """The pallas_call builder (jitted so direct op-level callers get a
     cached dispatch; inside an outer serving jit this is a no-op nest).
     ``pages=None`` is the dense route, else the paged one — same grid
-    shape, same body, different index map. ``k_scale``/``v_scale``
-    (None for bf16 caches) are the quantized pools' f32 scale planes,
-    shaped like k/v with a trailing dim of 1: they ride the SAME kv
-    index maps as two extra inputs, so a code block's scale rows land in
-    the same grid step. The bf16 route appends no operands and no specs
-    — its trace is byte-for-byte the pre-quantization kernel."""
+    shape, same body, different index map. The grid is (slot, T tile,
+    kv block): ``block_t`` tiles the query window (T itself for every
+    decode/verify call and any chunk up to MAX_PREFILL_T — a
+    single-tile middle dimension), and each tile's index maps shift the
+    live kv span by the tile's query offset, so an early tile of a long
+    chunk never DMAs the blocks only later tiles can see.
+    ``k_scale``/``v_scale`` (None for bf16 caches) are the quantized
+    pools' f32 scale planes, shaped like k/v with a trailing dim of 1:
+    they ride the SAME kv index maps as two extra inputs, so a code
+    block's scale rows land in the same grid step. The bf16 route
+    appends no operands and no specs — its trace is byte-for-byte the
+    pre-quantization kernel."""
     b, t, hq, hd = q.shape
     hkv = k.shape[2]
     group = hq // hkv
     base = base.astype(jnp.int32)
     quantized = k_scale is not None
+    bt = block_t
+    nt = t // bt
 
     if pages is None:
         s = k.shape[1]
         bk = block_k
-        grid = (b, s // bk)
+        grid = (b, nt, s // bk)
         num_prefetch = 1
         prefetch_args = (base,)
 
-        def kv_map(bi, j, bases):
+        def kv_map(bi, ti, j, bases):
             # clamp into the live span FIRST: dead grid cells re-map to
             # a live block, and Pallas elides the DMA when consecutive
             # cells map the same block — dead blocks cost nothing
-            lo = _first_block(bases[bi] + 1, window, bk)
-            hi = _last_block(bases[bi] + t, bk)
+            lo = _first_block(bases[bi] + ti * bt + 1, window, bk)
+            hi = _last_block(bases[bi] + ti * bt + bt, bk)
             return (bi, jnp.clip(j, lo, hi), 0, 0)
 
-        def q_map(bi, j, bases):
-            return (bi, 0, 0, 0)
+        def q_map(bi, ti, j, bases):
+            return (bi, ti, 0, 0)
 
-        def o_map(bi, j, bases):
-            return (bi, 0, 0, 0)
+        def o_map(bi, ti, j, bases):
+            return (bi, ti, 0, 0)
     else:
         bk = k.shape[1]  # the page IS the kv block
         pages = pages.astype(jnp.int32)
-        grid = (b, pages.shape[1])
+        grid = (b, nt, pages.shape[1])
         num_prefetch = 2
         prefetch_args = (base, pages)
 
-        def kv_map(bi, j, bases, table):
+        def kv_map(bi, ti, j, bases, table):
             # clamp, THEN resolve the virtual block through the table to
             # its physical pool page — the one indirection the paged
             # layout adds to the dense route above
-            lo = _first_block(bases[bi] + 1, window, bk)
-            hi = _last_block(bases[bi] + t, bk)
+            lo = _first_block(bases[bi] + ti * bt + 1, window, bk)
+            hi = _last_block(bases[bi] + ti * bt + bt, bk)
             return (table[bi, jnp.clip(j, lo, hi)], 0, 0, 0)
 
-        def q_map(bi, j, bases, table):
-            return (bi, 0, 0, 0)
+        def q_map(bi, ti, j, bases, table):
+            return (bi, ti, 0, 0)
 
-        def o_map(bi, j, bases, table):
-            return (bi, 0, 0, 0)
+        def o_map(bi, ti, j, bases, table):
+            return (bi, ti, 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, t, hq, hd), q_map),
+        pl.BlockSpec((1, bt, hq, hd), q_map),
         pl.BlockSpec((1, bk, hkv, hd), kv_map),
         pl.BlockSpec((1, bk, hkv, hd), kv_map),
     ]
@@ -334,15 +377,15 @@ def _rpa_call(q, k, v, base, pages, k_scale, v_scale, *, scale, window,
         num_scalar_prefetch=num_prefetch,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, t, hq, hd), o_map),
+        out_specs=pl.BlockSpec((1, bt, hq, hd), o_map),
         scratch_shapes=[
-            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # m
-            pltpu.VMEM((hkv, t, group, 1), jnp.float32),   # l
-            pltpu.VMEM((hkv, t, group, hd), jnp.float32),  # acc
+            pltpu.VMEM((hkv, bt, group, 1), jnp.float32),   # m
+            pltpu.VMEM((hkv, bt, group, 1), jnp.float32),   # l
+            pltpu.VMEM((hkv, bt, group, hd), jnp.float32),  # acc
         ],
     )
     kernel = functools.partial(
-        _rpa_kernel, bk=bk, t=t, hq=hq, hkv=hkv, hd=hd, scale=scale,
+        _rpa_kernel, bk=bk, t=bt, hq=hq, hkv=hkv, hd=hd, scale=scale,
         window=window, quantized=quantized,
     )
 
@@ -370,6 +413,7 @@ def ragged_paged_attention(
     scale: float,
     window: int = 0,
     block_k: int = 0,        # dense kv block; 0 = tunings cache / default
+    block_t: int = 0,        # T tile; 0 = tunings cache / widest divisor
     interpret: bool = False,
     k_scale: "jax.Array | None" = None,  # f32 scale plane, k shape w/ hd=1
     v_scale: "jax.Array | None" = None,
@@ -381,28 +425,37 @@ def ragged_paged_attention(
     ``base + T`` (the caller's write of the window precedes the read,
     the serving contract). Dense mode tiles the cache at ``block_k``
     (resolved from the per-generation tilings cache when 0); paged mode
-    reads whole pages through ``pages``. Quantized caches pass int8/int4
-    codes as k/v plus their f32 ``k_scale``/``v_scale`` planes (same
-    layout, trailing dim 1): the body dequantizes per DMA'd block in
-    VMEM. Both scales or neither."""
+    reads whole pages through ``pages``. ``block_t`` tiles the T axis
+    for chunks wider than :data:`MAX_PREFILL_T` (0 resolves the widest
+    divisor, or the tunings row's measured tile); a tile that does not
+    divide T or exceeds the VMEM cap — a stale tunings row — degrades
+    to the widest clean divisor. Per query row the accumulation
+    order is tiling-invariant, so block_t is a pure performance knob —
+    never a numerics one. Quantized caches pass int8/int4 codes as k/v
+    plus their f32 ``k_scale``/``v_scale`` planes (same layout,
+    trailing dim 1): the body dequantizes per DMA'd block in VMEM.
+    Both scales or neither."""
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be passed together")
+    t = q.shape[1]
     if pages is None:
         s = k.shape[1]
-        if block_k <= 0:
+        if block_k <= 0 or (block_t <= 0 and t > MAX_PREFILL_T):
             # direct op-level callers only: the serving dispatcher
-            # always passes block_k explicitly, resolved from GLOBAL
+            # always passes blocks explicitly, resolved from GLOBAL
             # shapes and the true routing mode (T alone cannot tell a
             # short prefill chunk from a verify window, and inside a tp
             # shard_map the per-shard head count would miskey the store)
             from k8s_gpu_device_plugin_tpu.ops import tunings
 
-            t = q.shape[1]
             mode = ("decode" if t == 1
                     else "verify" if t <= MAX_VERIFY_T else "prefill")
             hkv, hd = k.shape[2], k.shape[3]
             tuned = tunings.resolve(f"rpa:{mode}:hkv{hkv}:hd{hd}", s)
-            block_k = tuned[0] if tuned else DEFAULT_BLOCK_K
+            if block_k <= 0:
+                block_k = tuned[0] if tuned else DEFAULT_BLOCK_K
+            if block_t <= 0 and tuned and len(tuned) > 1:
+                block_t = tuned[1]
         bk = fit_block(s, min(block_k, s))
         if bk is None:
             raise ValueError(
@@ -412,9 +465,20 @@ def ragged_paged_attention(
         block_k = bk
     else:
         block_k = 0  # pinned to the page size inside _rpa_call
+    if block_t <= 0 or t % block_t or block_t > MAX_PREFILL_T:
+        # a stale tunings row (or no row) must degrade to the widest
+        # clean divisor, never to a shape error
+        block_t = fit_prefill_tile(t)
+    if block_t is None:
+        raise ValueError(
+            f"T={t} has no tile divisor in "
+            f"[{MIN_PREFILL_TILE}, {MAX_PREFILL_T}]; gate on supports() "
+            "(ops.attention dispatches with the gate)"
+        )
     return _rpa_call(
         q, k, v, base, pages, k_scale, v_scale,
-        scale=scale, window=window, block_k=block_k, interpret=interpret,
+        scale=scale, window=window, block_k=block_k, block_t=block_t,
+        interpret=interpret,
     )
 
 
@@ -423,7 +487,9 @@ __all__ = [
     "HAS_PLTPU",
     "MAX_PREFILL_T",
     "MAX_VERIFY_T",
+    "MIN_PREFILL_TILE",
     "QUANT_SUBLANE",
+    "fit_prefill_tile",
     "ragged_paged_attention",
     "supports",
 ]

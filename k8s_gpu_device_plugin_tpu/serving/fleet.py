@@ -227,7 +227,7 @@ class Replica:
     __slots__ = (
         "rid", "url", "draining", "alive", "consecutive_failures",
         "health", "health_t", "inflight", "relayed", "cooldown_until",
-        "reported_id", "spare", "epoch",
+        "reported_id", "spare", "epoch", "role",
     )
 
     def __init__(self, rid: str, url: str):
@@ -252,6 +252,12 @@ class Replica:
         # (replica, epoch) — one replica death with N in-flight streams
         # is one fleet event, not N
         self.epoch = 0
+        # disaggregated prefill/decode specialization (--roles):
+        # "prefill" replicas take long-prompt prefill legs, "decode"
+        # replicas take short prompts and transferred continuations,
+        # "any" (the default) serves both — an unroled fleet routes
+        # byte-identically to before roles existed
+        self.role = "any"
 
     def routable(self, now: float) -> bool:
         return (
@@ -315,6 +321,74 @@ class FleetRegistry:
             reps.append(Replica(rid, url))
         return cls(reps, dead_after=dead_after)
 
+    # --- roles (disaggregated prefill/decode) ----------------------------
+
+    REPLICA_ROLES = ("prefill", "decode", "any")
+
+    def assign_roles(self, spec: str) -> None:
+        """Apply a ``--roles`` spec: whitespace/semicolon-separated
+        ``role=id,id`` groups, e.g. ``prefill=r0 decode=r1,r2``.
+        Unlisted replicas keep role ``"any"`` (they serve both sides).
+        Unknown roles and unknown replica ids are refused — a typo must
+        not silently leave a fleet colocated."""
+        for group in (spec or "").replace(";", " ").split():
+            role, _, ids = group.partition("=")
+            role = role.strip()
+            if role not in ("prefill", "decode"):
+                raise ValueError(
+                    f"--roles group {group!r}: unknown role {role!r} "
+                    "(expected prefill=... or decode=...; unlisted "
+                    "replicas default to 'any')"
+                )
+            for rid in (r.strip() for r in ids.split(",")):
+                if not rid:
+                    continue
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    raise ValueError(
+                        f"--roles names unknown replica {rid!r}; "
+                        f"registered: {self.ids()}"
+                    )
+                rep.role = role
+
+    def roles_configured(self) -> bool:
+        """True when any replica is specialized — the gate every
+        disaggregation code path sits behind (an unroled fleet must
+        behave byte-identically to a build without roles)."""
+        return any(r.role != "any" for r in self._replicas.values())
+
+    def role_capable(self, role: str) -> "list[Replica]":
+        """Replicas that can serve ``role`` work: exact matches plus
+        the unspecialized ``"any"`` generalists."""
+        return [
+            r for r in self._replicas.values()
+            if r.role == role or r.role == "any"
+        ]
+
+    def removal_empties_role(self, rep: Replica) -> "str | None":
+        """Would taking ``rep`` out of service leave a configured role
+        unservable? Returns the actionable refusal message (for the
+        drain/promote surfaces), or None when the swap is safe. A
+        specialized replica is covered by its exact peers and by
+        ``"any"`` generalists; an ``"any"`` replica may itself be the
+        last cover for BOTH specialized roles."""
+        if not self.roles_configured():
+            return None
+        covered = ("prefill", "decode") if rep.role == "any" \
+            else (rep.role,)
+        for role in covered:
+            if not any(
+                r is not rep and not r.spare and r.alive and not r.draining
+                for r in self.role_capable(role)
+            ):
+                return (
+                    f"replica {rep.rid!r} (role {rep.role!r}) is the "
+                    f"last in-service cover for the {role!r} role; "
+                    "undrain or add a replica with that role (or "
+                    "'any') first"
+                )
+        return None
+
     def get(self, rid: str) -> Replica | None:
         return self._replicas.get(rid)
 
@@ -354,13 +428,23 @@ class FleetRegistry:
         affinity keys remap in the usual consistent-hashing way), the
         dead one becomes a spare so a later revival re-enters the pool
         as a standby instead of double-claiming a ring slot. Returns
-        the promoted replica, or None when no live spare is idle."""
+        the promoted replica, or None when no live spare is idle.
+
+        Role-aware (disaggregated fleets): the spare must be able to
+        cover the dead replica's role — its exact role or ``"any"`` —
+        and an ``"any"`` spare ADOPTS the dead replica's specialization
+        so the swap never leaves a role empty; a spare specialized the
+        other way is skipped (refusing the role-emptying swap)."""
         spare = next(
-            (r for r in self.spares() if r.alive and not r.draining),
+            (r for r in self.spares()
+             if r.alive and not r.draining
+             and r.role in ("any", dead.role)),
             None,
         )
         if spare is None:
             return None
+        if spare.role == "any" and dead.role != "any":
+            spare.role = dead.role
         spare.spare = False
         dead.spare = True
         return spare
@@ -403,6 +487,7 @@ class FleetRegistry:
                 "url": r.url,
                 "alive": r.alive,
                 "spare": r.spare,
+                "role": r.role,
                 "draining": r.draining,
                 "inflight": r.inflight,
                 "relayed": r.relayed,
@@ -424,11 +509,23 @@ class FleetRegistry:
                 "sched_rejections": (h.get("sched") or {}).get("rejections"),
             }
         live = [r for r in self._replicas.values() if r.alive]
+        # per-role membership + in-flight (disaggregated fleets): "any"
+        # rolls up separately so dashboards can tell generalist slack
+        # from specialized capacity; an unroled fleet reads all-"any"
+        roles: dict[str, dict] = {}
+        for r in self._replicas.values():
+            agg = roles.setdefault(
+                r.role, {"replicas": 0, "live": 0, "inflight": 0}
+            )
+            agg["replicas"] += 1
+            agg["live"] += 1 if r.alive else 0
+            agg["inflight"] += r.inflight
         return {
             "replicas": reps,
             "total": len(self._replicas),
             "live": len(live),
             "spares": len(self.spares()),
+            "roles": roles,
             "draining": sum(
                 1 for r in self._replicas.values() if r.draining
             ),

@@ -193,6 +193,15 @@ class RouterMetrics:
             "tokens)",
             registry=self._registry,
         )
+        self.kv_transfers = Counter(
+            f"{prefix}_kv_transfers_total",
+            "Disaggregated prefill->decode KV-page transfers, by "
+            "outcome (ok; fallback = degraded to re-prefill; "
+            "completed_on_prefill = the stream finished before any "
+            "transfer was needed)",
+            ["outcome"],
+            registry=self._registry,
+        )
         self.inflight = Gauge(
             f"{prefix}_inflight",
             "Requests currently relayed to each replica",
@@ -208,7 +217,7 @@ class RouterMetrics:
 
     def close(self) -> None:
         for c in (self.requests, self.affinity_hits, self.failovers,
-                  self.promotions, self.stream_resumes,
+                  self.promotions, self.stream_resumes, self.kv_transfers,
                   self.inflight, self.replica_up):
             try:
                 self._registry.unregister(c)
@@ -334,6 +343,14 @@ class ReplicaRouter:
         slow_stream_ms: float = 0.0,  # SLO-breach retention threshold
         # for the router flight recorder (resumed/failed-over/error
         # streams are always retained; 0 = only those)
+        roles: "str | None" = None,  # disaggregated prefill/decode:
+        # a --roles spec ("prefill=idA,idB decode=idC"; unlisted
+        # replicas stay 'any'). None/empty leaves routing byte-
+        # identical to an unroled fleet
+        disagg_min_prompt: int = 64,  # prompts at least this many
+        # tokens (journaled native SSE streams only) take the
+        # prefill-worker -> KV-transfer -> decode-worker path; shorter
+        # ones go straight to a decode-capable replica
         plugins: "list[tuple[str, str]] | None" = None,  # device-plugin
         # control planes to federate: [(node_id, base_url)]. Their
         # /metrics joins /fleet/metrics (node= relabeling + fleet chip
@@ -352,6 +369,12 @@ class ReplicaRouter:
                 "(1.0 would refuse the mean load itself)"
             )
         self.fleet = fleet
+        if roles:
+            fleet.assign_roles(roles)
+        # computed ONCE: every disaggregation branch below gates on
+        # this, so an unroled fleet runs the exact pre-roles code paths
+        self._roles_on = fleet.roles_configured()
+        self.disagg_min_prompt = int(disagg_min_prompt)
         if warm_spares:
             fleet.mark_spares(warm_spares)
         # the ring is the ACTIVE membership only: spares join (and dead
@@ -423,6 +446,12 @@ class ReplicaRouter:
         self._promotions = 0
         self._resumes = 0          # mid-stream deaths spliced over
         self._resume_failures = 0  # ended with the structured error frame
+        # disaggregated prefill/decode bookkeeping: transfers by
+        # outcome, pages shipped, and a bounded wall-time sample ring
+        # (serve_bench reads these for kv_transfer_ms percentiles)
+        self._kv_transfers: dict[str, int] = {}
+        self._kv_transfer_pages = 0
+        self._kv_transfer_ms: list[float] = []
         self._unjournaled = 0      # streams served past journal_limit
         self._refused: dict[str, int] = {}
         self._outcomes: dict[str, int] = {}
@@ -732,13 +761,19 @@ class ReplicaRouter:
         return True
 
     def _pick(
-        self, key: bytes | None
+        self, key: bytes | None, role: "str | None" = None
     ) -> tuple[list[Replica], "Replica | None"]:
         """-> (dispatch order, the key's ring HOME or None). Affinity
         walks the ring from the key's point and applies the
         bounded-load skip; rr (or a keyless request) rotates /
         least-loads over the live set. An empty list means nobody can
-        admit right now."""
+        admit right now.
+
+        ``role`` narrows the candidates to replicas that can serve that
+        side of a disaggregated fleet (exact role or ``"any"``); when
+        NO live replica covers the role, the filter falls away — a
+        specialized fleet degraded to one surviving generalist must
+        keep serving, not refuse on principle."""
         now = time.monotonic()
         live = [r for r in self.fleet.all() if r.routable(now)]
         if not live:
@@ -750,6 +785,10 @@ class ReplicaRouter:
                 r for r in self.fleet.all()
                 if r.alive and not r.draining and not r.spare
             ]
+        if role is not None and self._roles_on:
+            roled = [r for r in live if r.role in (role, "any")]
+            if roled:
+                live = roled
         if not live:
             return [], None
         usable = set(id(r) for r in live)
@@ -826,7 +865,23 @@ class ReplicaRouter:
         key = affinity_key(
             self._affinity_source(request.path, body), self.prompt_buckets
         )
-        order, home = self._pick(key)
+        # disaggregated prefill/decode (--roles): journaled long-prompt
+        # streams take the prefill-worker leg (relay until the first
+        # token, export the KV pages, resubmit to a decode worker);
+        # everything else routes to decode-capable replicas so prefill
+        # workers stay clear for prefill bursts. All of it is inert on
+        # an unroled fleet (role=None -> the pre-roles code paths).
+        wants_disagg = (
+            self._roles_on
+            and self._resumable_body(request.path, body)
+            and body.get("kv_pages") is None
+            and not body.get("resume_out")
+            and len(body.get("prompt") or ()) >= self.disagg_min_prompt
+        )
+        role = None
+        if self._roles_on and request.path != "/v1/embeddings":
+            role = "prefill" if wants_disagg else "decode"
+        order, home = self._pick(key, role=role)
         if not order:
             if self.fleet.any_draining():
                 resp = self._refuse(
@@ -854,10 +909,17 @@ class ReplicaRouter:
                 self._journaled += 1
             else:
                 self._unjournaled += 1
+        disagg = wants_disagg and journal is not None
+        if wants_disagg and journal is None:
+            # past the journal cap there is no token record to drive a
+            # transfer: serve colocated on a decode-capable replica
+            # instead of stranding a decoding stream on a prefill one
+            order, home = self._pick(key, role="decode")
         resp = None
         try:
             resp = await self._dispatch(
-                request, raw, headers, order, home, journal, tl
+                request, raw, headers, order, home, journal, tl,
+                relay=self._relay_disagg if disagg else None,
             )
             return resp
         finally:
@@ -901,7 +963,12 @@ class ReplicaRouter:
                         home: "Replica | None",
                         journal: "_StreamJournal | None",
                         tl=None,
+                        relay=None,  # per-attempt relay (default
+                        # self._relay; the disagg path substitutes
+                        # _relay_disagg and rides the same failover
+                        # loop, cooldown handling, and postlude)
                         ) -> web.StreamResponse:
+        relay_fn = relay if relay is not None else self._relay
         last_429: _Overloaded | None = None
         for attempt, rep in enumerate(order):
             if attempt > 0:
@@ -931,8 +998,8 @@ class ReplicaRouter:
                     }},
                 )
             try:
-                resp = await self._relay(rep, request, raw, headers,
-                                         journal=journal, tl=tl)
+                resp = await relay_fn(rep, request, raw, headers,
+                                      journal=journal, tl=tl)
             except _Unreachable:
                 self.fleet.note_failure(rep)
                 self._maybe_promote()
@@ -1437,6 +1504,398 @@ class ReplicaRouter:
             resp.close()
             raise
 
+    # --- disaggregated prefill/decode (KV-page transfer) ------------------
+
+    def _count_kv_transfer(self, outcome: str, pages: int,
+                           ms: "float | None") -> None:
+        self._kv_transfers[outcome] = (
+            self._kv_transfers.get(outcome, 0) + 1
+        )
+        self._kv_transfer_pages += int(pages)
+        if ms is not None:
+            # only attempts that MOVED (or tried to move) pages feed
+            # the latency record; completed_on_prefill never transfers
+            self._kv_transfer_ms.append(round(float(ms), 3))
+        if len(self._kv_transfer_ms) > 4096:
+            # keep the recent half: serve_bench reads percentiles of a
+            # run's own transfers, not the process's whole history
+            del self._kv_transfer_ms[:2048]
+        if self.metrics is not None:
+            self.metrics.kv_transfers.labels(outcome).inc()
+
+    async def _pump_first_token(self, resp, out: web.StreamResponse,
+                                journal: _StreamJournal) -> None:
+        """Relay the prefill leg until a token frame proves the request
+        is decoding (export is only defined past prefill). Every
+        COMPLETE frame in the triggering network chunk is relayed and
+        journaled exactly like _pump_sse; a partial trailing frame is
+        abandoned with the connection — its token is inside the
+        export's atomic snapshot and the gap synthesis re-emits it.
+        Raises _BackendLost when the body ends before a token or a
+        close frame (the normal resume trigger)."""
+        buf = b""
+        try:
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    await self._client_write(out, frame + b"\n\n")
+                    self._observe_frame(journal, frame)
+                if journal.tokens or journal.closed:
+                    return
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError, OSError) as e:
+            if journal.closed:
+                return
+            raise _BackendLost() from e
+        if not journal.closed:
+            raise _BackendLost()
+
+    async def _synthesize_gap(self, out: web.StreamResponse,
+                              journal: _StreamJournal, exp: dict) -> None:
+        """The export's engine-thread snapshot can surface tokens the
+        relay never read (the engine flushes in-flight pipelined decode
+        before snapshotting, and the relay stops at the first token):
+        those tokens are part of the stream the client was promised.
+        Emit their frames exactly as the replica would have — same JSON
+        shape, logprob field only when the request asked for it — and
+        journal them so any later resume starts from the full record."""
+        toks = exp.get("resume_out") or []
+        lps = exp.get("resume_logprobs") or []
+        want_lp = bool(journal.body.get("logprobs"))
+        for i in range(len(journal.tokens), len(toks)):
+            t = int(toks[i])
+            lp = float(lps[i]) if i < len(lps) else 0.0
+            evt = {"token": t, "logprob": lp}
+            journal.observe(evt)
+            wire = evt if want_lp else {"token": t}
+            await self._client_write(
+                out, f"data: {json.dumps(wire)}\n\n".encode()
+            )
+
+    async def _pump_leg(self, rep: Replica, resp, request: web.Request,
+                        out: web.StreamResponse,
+                        journal: _StreamJournal, headers: dict,
+                        tl=None) -> "Replica | None":
+        """Pump one continuation leg (the decode worker, or a plain-
+        resume fallback target) to completion, with the full recovery
+        story: a mid-leg backend death hands off to _resume_stream
+        (a REAL death — it charges the fleet budget and feeds the
+        liveness ledger like any other). Returns the replica that
+        finished the stream, or None when the error-frame path ended
+        it."""
+        if tl is not None:
+            tl.relay_on(rep.rid)
+        rep.inflight += 1
+        if self.metrics is not None:
+            self.metrics.inflight.labels(rep.rid).set(rep.inflight)
+        try:
+            await self._pump_sse(resp, out, journal)
+        except _BackendLost:
+            resp.close()
+            if tl is not None:
+                tl.advance("resume_gap")
+            return await self._resume_stream(
+                rep, request, out, journal, headers, tl=tl
+            )
+        except _ClientGone:
+            resp.close()
+            return rep
+        except BaseException:
+            resp.close()
+            raise
+        finally:
+            rep.inflight -= 1
+            if self.metrics is not None:
+                self.metrics.inflight.labels(rep.rid).set(rep.inflight)
+        self.fleet.note_success(rep)
+        self._count(rep, "ok")
+        resp.release()
+        return rep
+
+    async def _splice_resume(self, request: web.Request,
+                             out: web.StreamResponse,
+                             journal: _StreamJournal, headers: dict,
+                             tl=None) -> "Replica | None":
+        """The transfer-failure degrade: resubmit the journal's PLAIN
+        resume body (no kv_pages — the target re-prefills through the
+        PR-14 fold, bit-identically) and splice the continuation. One
+        pass over the decode-capable candidates; if none answers, the
+        stream ends with the structured error frame — a failed transfer
+        must be a performance event, never a dropped stream."""
+        try:
+            max_new = int(journal.body.get("max_new", 64) or 0)
+        except (TypeError, ValueError):
+            max_new = 0
+        if max_new and len(journal.tokens) >= max_new:
+            # the export surfaced every budgeted token: close the
+            # stream here (the _resume_stream synthesized-done rule)
+            try:
+                await self._client_write(out, b'data: {"done": true}\n\n')
+            except _ClientGone:
+                pass
+            return None
+        raw = journal.resume_body()
+        # role="decode" prefers decode-capable replicas, but the filter
+        # falls away when none is live — then even the SOURCE prefill
+        # replica is a valid target (it retired/cancelled the original,
+        # and a re-prefill resume is admissible anywhere)
+        order, _ = self._pick(journal.key, role="decode")
+        for rep in order:
+            try:
+                r = await self._open_backend(
+                    f"{rep.url}{request.path}", raw, headers
+                )
+            except _Unreachable:
+                self.fleet.note_failure(rep)
+                self._maybe_promote()
+                continue
+            if r.status == 429:
+                await r.read()
+                r.release()
+                ra = parse_retry_after(
+                    r.headers.get("Retry-After"), default=1.0
+                )
+                rep.cooldown_until = time.monotonic() + ra
+                continue
+            ctype = r.headers.get("Content-Type", "")
+            if r.status != 200 or not ctype.startswith("text/event-stream"):
+                await r.read()
+                r.release()
+                if r.status >= 500:
+                    self.fleet.note_failure(rep)
+                else:
+                    self.fleet.note_success(rep)
+                continue
+            return await self._pump_leg(
+                rep, r, request, out, journal, headers, tl=tl
+            )
+        self._resume_failures += 1
+        self.journal.emit("resume_failed", replica=None,
+                          tokens_at_death=len(journal.tokens))
+        if tl is not None:
+            tl.error_code = "resume_failed"
+        await self._error_frame(
+            out, "resume_failed",
+            "KV transfer failed and no candidate could resume the "
+            f"request; partial output ({len(journal.tokens)} tokens) "
+            "was delivered",
+        )
+        return None
+
+    async def _kv_handoff(self, rep: Replica, src_resp,
+                          request: web.Request, out: web.StreamResponse,
+                          journal: _StreamJournal, headers: dict,
+                          tl=None) -> "Replica | None":
+        """The transfer itself: export the request's KV pages off the
+        prefill replica (which atomically retires it), synthesize any
+        tokens the snapshot surfaced past the relay, and resubmit
+        resume_out + kv_pages to a decode worker, splicing its stream
+        into the same client response. ANY failure — export refused,
+        worker unreachable, pool pressure (429 kv_pool_pressure) —
+        degrades to the plain re-prefill resume; the page blob is sized
+        for this exact moment, so waiting out a 429 would only stale
+        it. Returns the finishing replica (None = error frame)."""
+        t0 = time.monotonic()
+        if tl is not None:
+            # the client-perceived stall between the prefill leg's last
+            # relayed byte and the decode leg's first — the disagg twin
+            # of resume_gap, summed into the timeline's phases
+            tl.advance("transfer_gap")
+        tokens_at = len(journal.tokens)
+        eid = src_resp.headers.get("X-Request-Id")
+        exp = None
+        with self.tracer.span(
+            "kv_transfer", component="router", source=rep.rid,
+            tokens_at_transfer=tokens_at,
+        ) as span:
+            if eid is not None:
+                try:
+                    r = await self._session.post(
+                        f"{rep.url}/v1/kv/export/{eid}", headers=headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=max(30.0, self.connect_timeout_s)
+                        ),
+                    )
+                    try:
+                        if r.status == 200:
+                            exp = await r.json()
+                        else:
+                            await r.read()
+                    finally:
+                        r.release()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError, ValueError):
+                    exp = None
+            # the export retired the request (or failed — then this
+            # disconnect cancels it server-side and the fallback
+            # re-prefills); either way the source stream is finished.
+            # Closed only AFTER the export returns: closing first would
+            # race the disconnect-cancel against the snapshot.
+            src_resp.close()
+            if exp is not None and not (
+                isinstance(exp.get("resume_out"), list)
+                and exp["resume_out"]
+                and isinstance(exp.get("kv_pages"), dict)
+            ):
+                exp = None
+            target = None
+            if exp is not None:
+                try:
+                    await self._synthesize_gap(out, journal, exp)
+                except _ClientGone:
+                    return None
+                body2 = dict(journal.body)
+                body2["resume_out"] = [int(t) for t in exp["resume_out"]]
+                body2["resume_logprobs"] = [
+                    float(x) for x in (exp.get("resume_logprobs") or ())
+                ]
+                body2["kv_pages"] = exp["kv_pages"]
+                raw2 = json.dumps(body2).encode()
+                order, _ = self._pick(journal.key, role="decode")
+                for rep2 in order:
+                    if rep2 is rep:
+                        continue  # the source just dropped these pages
+                    try:
+                        r2 = await self._open_backend(
+                            f"{rep2.url}{request.path}", raw2, headers
+                        )
+                    except _Unreachable:
+                        self.fleet.note_failure(rep2)
+                        self._maybe_promote()
+                        continue
+                    ctype = r2.headers.get("Content-Type", "")
+                    if r2.status != 200 or not ctype.startswith(
+                        "text/event-stream"
+                    ):
+                        await r2.read()
+                        r2.release()
+                        if r2.status == 429:
+                            rep2.cooldown_until = (
+                                time.monotonic() + parse_retry_after(
+                                    r2.headers.get("Retry-After"),
+                                    default=1.0,
+                                )
+                            )
+                        elif r2.status >= 500:
+                            self.fleet.note_failure(rep2)
+                        else:
+                            self.fleet.note_success(rep2)
+                        continue
+                    target = rep2
+                    break
+            pages = int((exp or {}).get("kv_pages", {}).get("n_pages", 0))
+            ms = (time.monotonic() - t0) * 1e3
+            if target is None:
+                self._count_kv_transfer("fallback", 0, ms)
+                self.journal.emit(
+                    "kv_transfer", source=rep.rid, target=None,
+                    outcome="fallback", tokens_at_transfer=tokens_at,
+                )
+                if hasattr(span, "set"):
+                    span.set(outcome="fallback")
+                log.warning(
+                    "kv transfer failed; degrading to re-prefill resume",
+                    extra={"fields": {"replica": rep.rid,
+                                      "tokens_at_transfer": tokens_at}},
+                )
+                return await self._splice_resume(
+                    request, out, journal, headers, tl=tl
+                )
+            self._count_kv_transfer("ok", pages, ms)
+            self.journal.emit(
+                "kv_transfer", source=rep.rid, target=target.rid,
+                outcome="ok", pages=pages, tokens_at_transfer=tokens_at,
+            )
+            if hasattr(span, "set"):
+                span.set(outcome="ok", target=target.rid, pages=pages)
+        return await self._pump_leg(
+            target, r2, request, out, journal, headers, tl=tl
+        )
+
+    async def _relay_disagg(self, rep: Replica, request: web.Request,
+                            raw: bytes, headers: dict,
+                            journal: "_StreamJournal | None" = None,
+                            tl=None) -> web.StreamResponse:
+        """One disaggregated dispatch attempt (the _relay substitute
+        the role-aware dispatch loop drives): relay the prefill leg
+        until the first token, then hand the stream to _kv_handoff.
+        Pre-header failures raise _Unreachable/_Overloaded for the
+        failover loop exactly like _relay; a prefill-leg death falls
+        back to the normal resume path (re-prefill elsewhere)."""
+        url = f"{rep.url}{request.path}"
+        if self._flt_connect is not None:
+            try:
+                self._flt_connect.fire()
+            except FaultError as e:
+                raise _Unreachable(str(e)) from None
+        resp = await self._open_backend(url, raw, headers)
+        try:
+            if resp.status == 429:
+                body = await resp.read()
+                ra = parse_retry_after(
+                    resp.headers.get("Retry-After"), default=1.0
+                )
+                raise _Overloaded(
+                    body, max(1, int(math.ceil(ra))),
+                    resp.headers.get("Content-Type", "application/json")
+                    .split(";")[0],
+                )
+            ctype = resp.headers.get("Content-Type", "")
+            if tl is not None:
+                tl.relay_on(rep.rid)
+            if not ctype.startswith("text/event-stream"):
+                # an app-level answer (4xx validation, 5xx): final —
+                # relayed verbatim, the dispatch postlude counts it
+                body = await resp.read()
+                resp.release()
+                return web.Response(
+                    body=body, status=resp.status,
+                    content_type=ctype.split(";")[0] or "application/json",
+                )
+            out = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            })
+            await out.prepare(request)
+            out.router_final_rep = rep
+            try:
+                await self._pump_first_token(resp, out, journal)
+            except _BackendLost:
+                resp.close()
+                if tl is not None:
+                    tl.advance("resume_gap")
+                out.router_final_rep = await self._resume_stream(
+                    rep, request, out, journal, headers, tl=tl
+                )
+            except _ClientGone:
+                resp.close()
+                return out
+            else:
+                if journal.closed:
+                    # the whole stream fit before the first transfer
+                    # point (tiny max_new / instant stop hit): done —
+                    # nothing to move
+                    self._count_kv_transfer("completed_on_prefill", 0, None)
+                    resp.release()
+                else:
+                    # the prefill replica did its half; the handoff
+                    # owns src_resp from here (export-then-close)
+                    self.fleet.note_success(rep)
+                    out.router_final_rep = await self._kv_handoff(
+                        rep, resp, request, out, journal, headers, tl=tl
+                    )
+            try:
+                await out.write_eof()
+            except (ConnectionResetError, OSError, RuntimeError):
+                pass
+            return out
+        except (_Overloaded, _Unreachable):
+            resp.release()
+            raise
+        except BaseException:
+            resp.close()
+            raise
     async def _proxy_get(self, request: web.Request) -> web.Response:
         """GET passthrough (/v1/models): any live replica's answer —
         the fleet serves ONE model, so they all agree. Cooldown AND
@@ -1485,6 +1944,13 @@ class ReplicaRouter:
             "refused": dict(self._refused),
             "outcomes": dict(self._outcomes),
             "journal": self.journal.stats(),
+            "kv_transfers": dict(self._kv_transfers),
+            "kv_transferred_pages": self._kv_transfer_pages,
+            "kv_transfer_ms": list(self._kv_transfer_ms),
+            "roles": (
+                {r.rid: r.role for r in self.fleet.all()}
+                if self._roles_on else {}
+            ),
             "timelines": (
                 self._recorder.stats() if self._recorder is not None
                 else None
@@ -1567,6 +2033,15 @@ class ReplicaRouter:
                  "replicas": self.fleet.ids()},
                 status=404,
             )
+        refusal = self.fleet.removal_empties_role(rep)
+        if refusal is not None:
+            # a specialized fleet must never drain itself into a state
+            # where one side of the prefill/decode split has no server
+            self.journal.emit("drain_refused", replica=rid,
+                              reason="role_empty")
+            return web.json_response(
+                {"error": refusal, "code": "role_empty"}, status=409
+            )
         rep.draining = True
         self.journal.emit("drain", replica=rid)
         log.info("draining replica", extra={"fields": {"replica": rid}})
@@ -1632,6 +2107,18 @@ class ReplicaRouter:
         results: dict = {}
         completed = True
         for rep in targets:
+            refusal = self.fleet.removal_empties_role(rep)
+            if refusal is not None:
+                # a disaggregated fleet too small to cover a role
+                # one-down skips that replica instead of serving a
+                # role-less window mid-cycle — the partial-cycle
+                # degrade, same stance as a drain timeout
+                self.journal.emit("drain_refused", replica=rep.rid,
+                                  reason="role_empty")
+                results[rep.rid] = {"drained": False,
+                                    "refused": "role_empty"}
+                completed = False
+                continue
             rep.draining = True
             self.journal.emit("rolling_drain", replica=rep.rid)
             res = await self._drain_wait(rep)
@@ -2037,6 +2524,21 @@ def _main(argv: list[str] | None = None) -> int:
                         "retained alongside the always-retained "
                         "resumed/failed-over/error streams (0 = only "
                         "those)")
+    parser.add_argument("--roles", default="",
+                        help="disaggregated serving roles: whitespace/"
+                        "semicolon-separated 'role=id,id' groups over "
+                        "the --replicas ids, e.g. "
+                        "'prefill=r0,r1 decode=r2'. Unlisted replicas "
+                        "stay 'any' (serve both). When any role is "
+                        "assigned, long prompts prefill on a prefill-"
+                        "capable replica and their KV pages transfer "
+                        "to a decode worker at the first token")
+    parser.add_argument("--disaggMinPrompt", type=int, default=64,
+                        help="prompts at least this many tokens long "
+                        "take the disaggregated prefill->transfer->"
+                        "decode path (shorter ones route straight to "
+                        "decode-capable replicas); only meaningful "
+                        "with --roles")
     args = parser.parse_args(argv)
 
     if args.tracing:
@@ -2091,6 +2593,8 @@ def _main(argv: list[str] | None = None) -> int:
         slow_stream_ms=args.slowStreamMs,
         registry=REGISTRY, metrics=RouterMetrics(registry=REGISTRY),
         faults=fault_plane,
+        roles=args.roles or None,
+        disagg_min_prompt=args.disaggMinPrompt,
         plugins=plugins,
     )
 

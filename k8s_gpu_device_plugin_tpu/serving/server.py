@@ -266,6 +266,10 @@ class InferenceEngine:
         #          seed, trace_parent, tenant, priority, deadline_ms,
         #          (resume_out, resume_logp))
         self._cancelq: list[int] = []  # eids to cancel, drained per step
+        # KV-export ops (disaggregated prefill/decode): (eid, loop, fut)
+        # drained per step; the engine thread snapshots pages + emitted
+        # tokens and retires the request in one indivisible pass
+        self._exportq: list[tuple[int, object, object]] = []
         self._streams: dict[int, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._published: dict[int, int] = {}   # eid -> tokens already pushed
         self._rid_to_eid: dict[int, int] = {}
@@ -294,6 +298,7 @@ class InferenceEngine:
         deadline_ms: int | None = None,
         resume_out: list[int] | None = None,
         resume_logp: list[float] | None = None,
+        kv_pages=None,
     ) -> tuple[int, asyncio.Queue]:
         """Register a request; returns (eid, queue of tokens then None).
 
@@ -311,7 +316,17 @@ class InferenceEngine:
         and — because they were already DELIVERED to the client by
         whoever relayed the dead stream — the published cursor starts
         past them, so this stream carries only the continuation (zero
-        re-emitted tokens)."""
+        re-emitted tokens).
+
+        ``kv_pages`` (a wire blob from another replica's
+        ``/v1/kv/export``) upgrades the resume to a KV-page install:
+        the folded prompt admits onto the transferred pages and only
+        the finish chunk runs. Pool pressure at THIS edge raises
+        SchedulerOverloadError (-> 429 kv_pool_pressure) instead of
+        deferring: the caller is a router holding a live stream, and
+        its re-prefill fallback beats queueing a blob behind a full
+        pool (the engine-thread reservation still defers if a burst
+        races past this approximate check)."""
         if self._dead.is_set():
             raise RuntimeError("inference engine is dead (see logs)")
         resume_out, resume_logp = self.cb.validate_resume(
@@ -321,6 +336,23 @@ class InferenceEngine:
         # of the budget (the fold's row total is the original worst case)
         self.cb.validate(len(prompt) + len(resume_out),
                          max_new - len(resume_out))
+        kv_wire = None
+        if kv_pages is not None:
+            kv_wire = self.cb.validate_kv_pages(
+                kv_pages, len(prompt), len(resume_out)
+            )
+            need, free = self.cb.kv_install_headroom(
+                len(prompt) + len(resume_out),
+                max_new - len(resume_out),
+            )
+            if need > free:  # approximate cross-thread read
+                raise SchedulerOverloadError(
+                    f"KV transfer needs {need} pages, "
+                    f"{free} free: install would defer "
+                    "behind pool pressure — re-prefill elsewhere or "
+                    "retry",
+                    reason="kv_pool_pressure", retry_after=1,
+                )
         self.cb.validate_adapter(adapter)
         logit_bias = self.cb.validate_bias(logit_bias)
         if priority is None:
@@ -379,7 +411,7 @@ class InferenceEngine:
                 (eid, list(prompt), max_new, tuple(stop or ()), sampler,
                  adapter, logit_bias, seed, trace_parent,
                  tenant, priority, deadline_ms,
-                 (resume_out, resume_logp))
+                 (resume_out, resume_logp, kv_wire))
             )
             self._streams[eid] = (loop, q)
             # the published cursor starts past the resumed tokens: they
@@ -490,6 +522,7 @@ class InferenceEngine:
                         seed=seed, tenant=tenant, priority=priority,
                         deadline_ms=deadline_ms,
                         resume_out=resume[0], resume_logp=resume[1],
+                        kv_pages=resume[2],
                     )
             except SchedulerOverloadError as e:
                 # the request-thread capacity gate raced a burst: close
@@ -560,6 +593,75 @@ class InferenceEngine:
                 # flush now: the batcher may have just gone idle, in which
                 # case the step-loop publish would never run again
                 self._publish()
+
+    async def export_kv(self, eid: int, timeout: float = 30.0) -> dict:
+        """Snapshot a running request's KV pages and retire it, in one
+        engine-thread pass (disaggregated prefill/decode: the router
+        calls this on the prefill replica, then resubmits the result to
+        a decode replica as ``resume_out``+``kv_pages``). Atomicity
+        matters: export, cancel, and the final publish happen
+        back-to-back on the engine thread, so the stream cannot emit a
+        token AFTER the snapshot was taken — the returned ``resume_out``
+        is exactly the tokens the stream delivered (or will deliver
+        before its end-of-stream), never a prefix of them.
+
+        Raises KeyError (unknown/finished eid), ValueError (not yet
+        admitted or still prefilling — the caller should wait for the
+        first token), RuntimeError (dense layout / dead engine), or
+        asyncio.TimeoutError."""
+        if self._dead.is_set():
+            raise RuntimeError("inference engine is dead (see logs)")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        with self._lock:
+            self._exportq.append((eid, loop, fut))
+        self._work.set()
+        return await asyncio.wait_for(fut, timeout)
+
+    def _apply_exports(self) -> None:
+        """Engine thread: drain queued KV-export ops. Each op snapshots
+        the request's pages + emitted tokens (flushing any in-flight
+        pipelined decode first, inside export_kv_pages), cancels the
+        request, and publishes — so the source stream closes having
+        delivered a PREFIX of the returned ``resume_out`` (the flush can
+        surface tokens the relay never read; the router synthesizes
+        those frames from the export result, never from the stream)."""
+        with self._lock:
+            ops, self._exportq = self._exportq, []
+        for eid, loop, fut in ops:
+            try:
+                rid = next(
+                    (r for r, e in self._rid_to_eid.items() if e == eid),
+                    None,
+                )
+                if rid is None:
+                    with self._lock:
+                        queued = any(s[0] == eid for s in self._subq)
+                    if queued:
+                        raise ValueError(
+                            f"request {eid} has not been admitted yet: "
+                            "wait for its first token before exporting"
+                        )
+                    raise KeyError(
+                        f"unknown or finished request {eid}"
+                    )
+                blob, out, lps = self.cb.export_kv_pages(rid)
+                self.cb.cancel(rid)
+                self._publish()
+                res = {
+                    "kv_pages": blob,
+                    "resume_out": out,
+                    "resume_logprobs": lps,
+                }
+            except Exception as e:  # noqa: BLE001 - surfaced to caller
+                err = e
+                loop.call_soon_threadsafe(
+                    lambda f=fut, x=err: f.done() or f.set_exception(x)
+                )
+                continue
+            loop.call_soon_threadsafe(
+                lambda f=fut, r=res: f.done() or f.set_result(r)
+            )
 
     def _publish(self) -> None:
         """Push newly generated (token, logprob) pairs to their queues."""
@@ -669,6 +771,7 @@ class InferenceEngine:
         while not self._stop.is_set():
             self._admit_submissions()
             self._apply_cancellations()
+            self._apply_exports()
             busy = bool(
                 self.cb.pending or self.cb.running or self.cb.prefilling
             )
@@ -818,6 +921,10 @@ class InferenceServer:
         )
         self.app = web.Application(middlewares=[self._trace_middleware])
         self.app.router.add_post("/v1/generate", self._generate)
+        # disaggregated prefill/decode: snapshot a running request's KV
+        # pages + emitted tokens and retire it (the router resubmits the
+        # result to a decode replica as resume_out + kv_pages)
+        self.app.router.add_post("/v1/kv/export/{rid}", self._kv_export)
         self.app.router.add_get("/v1/health", self._health)
         self.app.router.add_get("/debug/traces", self._debug_traces)
         self.app.router.add_get(
@@ -1036,6 +1143,33 @@ class InferenceServer:
             content_type="text/plain",
         )
 
+    async def _kv_export(self, request: web.Request) -> web.Response:
+        """POST /v1/kv/export/{rid}: snapshot the request's KV pages and
+        retire it (its stream closes with the tokens delivered so far).
+        The body is a resubmittable triple — ``kv_pages`` wire blob,
+        ``resume_out``, ``resume_logprobs`` — for /v1/generate on a
+        decode replica. Status mapping mirrors the cancel surface:
+        400 malformed id, 404 unknown/finished, 409 not exportable yet
+        (still queued or prefilling — retry after the first token),
+        503 dense layout / dead engine / engine-thread timeout."""
+        try:
+            eid = int(request.match_info["rid"])
+        except ValueError:
+            return web.json_response(
+                {"error": "request id must be an integer"}, status=400
+            )
+        try:
+            res = await self.engine.export_kv(eid)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except ValueError as e:  # not admitted / prefilling: retryable
+            return web.json_response({"error": str(e)}, status=409)
+        except (RuntimeError, asyncio.TimeoutError) as e:
+            return web.json_response({"error": str(e) or "export timed out"},
+                                     status=503)
+        res["id"] = eid
+        return web.json_response(res)
+
     async def _generate(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -1102,6 +1236,21 @@ class InferenceServer:
                     raise ValueError(
                         "resume_logprobs must be a list of numbers"
                     )
+            # disaggregated prefill/decode: KV pages exported from the
+            # prefill replica ride the resume seam — the engine installs
+            # them instead of recomputing the prefill chunks
+            kv_pages = body.get("kv_pages")
+            if kv_pages is not None:
+                if resume_out is None:
+                    raise ValueError(
+                        "kv_pages requires resume_out (the transferred "
+                        "pages cover the folded prompt's rows)"
+                    )
+                if not isinstance(kv_pages, dict):
+                    raise ValueError(
+                        "kv_pages must be a KV wire blob object "
+                        "(see /v1/kv/export)"
+                    )
             # per-request sampling: any knob present builds a full
             # Sampler (its own validation applies); absent fields default
             # to greedy/off, NOT to the server sampler — a request that
@@ -1155,6 +1304,7 @@ class InferenceServer:
                     tenant=tenant, priority=priority,
                     deadline_ms=deadline_ms,
                     resume_out=resume_out, resume_logp=resume_lp,
+                    kv_pages=kv_pages,
                 ))
         except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
@@ -1244,7 +1394,11 @@ class InferenceServer:
 
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"}
+                     "Cache-Control": "no-cache",
+                     # the engine id this stream serves: what a router
+                     # targets at POST /v1/kv/export/{rid} to lift the
+                     # request off this replica mid-stream
+                     "X-Request-Id": str(rid)}
         )
         await resp.prepare(request)
         # a resumed stream's closing text must cover the whole output,

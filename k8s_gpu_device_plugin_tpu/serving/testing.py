@@ -67,19 +67,22 @@ def per_replica_registry_factories(
 
 
 async def stream_generate(session, base: str, *, prompt, max_new: int,
-                          logprobs: bool = True) -> dict:
+                          logprobs: bool = True, seed=None) -> dict:
     """One streamed ``/v1/generate`` through ``base`` (a router or a
     replica), drained frame by frame the way the fleet tests/benches
-    all do; returns ``{"tokens", "done", "wall_s"}`` with the
-    client-observed wall time."""
+    all do; returns ``{"tokens", "logprobs", "done", "error",
+    "wall_s"}`` with the client-observed wall time (``error`` is the
+    structured error frame's payload, or None)."""
     t0 = time.perf_counter()
     toks: list[int] = []
+    logps: list[float] = []
     done = False
-    async with session.post(
-        f"{base}/v1/generate",
-        json={"prompt": prompt, "max_new": max_new, "stream": True,
-              "logprobs": logprobs},
-    ) as r:
+    error = None
+    body = {"prompt": prompt, "max_new": max_new, "stream": True,
+            "logprobs": logprobs}
+    if seed is not None:
+        body["seed"] = seed
+    async with session.post(f"{base}/v1/generate", json=body) as r:
         assert r.status == 200, await r.text()
         async for line in r.content:
             text = line.decode().strip()
@@ -88,10 +91,14 @@ async def stream_generate(session, base: str, *, prompt, max_new: int,
             evt = json.loads(text[len("data: "):])
             if "token" in evt:
                 toks.append(int(evt["token"]))
+                if "logprob" in evt:
+                    logps.append(float(evt["logprob"]))
             if evt.get("done"):
                 done = True
-    return {"tokens": toks, "done": done,
-            "wall_s": time.perf_counter() - t0}
+            if evt.get("error"):
+                error = evt["error"]
+    return {"tokens": toks, "logprobs": logps, "done": done,
+            "error": error, "wall_s": time.perf_counter() - t0}
 
 
 async def _wait_bound(obj, task) -> None:

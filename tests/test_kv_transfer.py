@@ -1,0 +1,468 @@
+"""Disaggregated prefill/decode: KV-page transfer between replicas
+(models/batching.py export/install + the /v1/kv/export HTTP seam +
+the role-aware router's prefill->decode splice).
+
+Three layers of claims:
+
+- **Bit-exactness**: a stream exported after a few emitted tokens and
+  resumed on a DIFFERENT batcher with the transferred pages produces
+  tokens AND logprobs identical to an uninterrupted single-replica
+  run, across {bf16, int8, int4} caches x tp{1, 2}, greedy and
+  seeded; the router's disaggregated splice (and its re-prefill
+  fallback when every decode worker is dead) is held to the same pin
+  end-to-end over HTTP.
+- **Wire fidelity**: re-exporting an installed stream reproduces the
+  original blob's valid page bytes (codes AND scale planes) — the
+  transfer is a copy, not a re-encode.
+- **Pool discipline**: export leaves the source accountable for its
+  pages until the cancel lands (then drains to zero), install pays for
+  its pages like a cold admission (cancel mid-decode drains to zero),
+  and a target without room answers 429 kv_pool_pressure instead of
+  parking a live stream behind a full pool.
+"""
+
+import asyncio
+import dataclasses
+import json
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models import paging
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.serving.testing import (
+    inprocess_fleet,
+    stream_generate,
+)
+
+BUCKETS = (8, 16, 32)
+PS = 16  # page size: divides max_len=64 (the test_paged_kv geometry)
+
+ENGINE_KW = dict(
+    n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+    chunked_prefill=8, kv_layout="paged", kv_page_size=PS,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # same tiny config as the neighboring serving modules so shared
+    # compiles are reused; quant/tp twins compile once here
+    cfg = LlamaConfig.tiny(n_layers=2)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _batcher(params, cfg, tp=1, **kw):
+    return ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, pipeline_depth=1,
+        kv_layout="paged", kv_page_size=PS, tp=tp, **kw,
+    )
+
+
+def _step_until_tokens(cb, rid, n):
+    """Step until the request has emitted >= n tokens (still running)."""
+    for _ in range(200):
+        for req in cb.running.values():
+            if req.rid == rid and len(req.out) >= n:
+                return
+        assert rid not in cb.done, "finished before export point"
+        cb.step()
+    raise AssertionError(f"request {rid} never reached {n} tokens")
+
+
+def _finish(cb, rid):
+    while rid not in cb.done:
+        cb.step()
+    return list(cb.done[rid]), list(cb.done_requests[rid].out_logp)
+
+
+# --- batcher-level round trip ----------------------------------------------
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_export_install_roundtrip_bit_identity(setup, quant, tp):
+    cfg0, params = setup
+    cfg = dataclasses.replace(cfg0, cache_quant=quant)
+    prompt = _prompt(5, 20, cfg)
+
+    # reference: uninterrupted run (same tp — tp=1==tp=2 equality is
+    # test_tp_serving's pin; here the axis is the mid-stream handoff)
+    ref = _batcher(params, cfg, tp=tp)
+    want = _finish(ref, ref.submit(prompt, max_new=8, seed=123))
+
+    # source: decode 3 tokens, export, cancel — pages drain to zero
+    src = _batcher(params, cfg, tp=tp)
+    rid = src.submit(prompt, max_new=8, seed=123)
+    _step_until_tokens(src, rid, 3)
+    blob, out, lps = src.export_kv_pages(rid)
+    assert blob["cache_quant"] == quant and blob["n_pages"] > 0
+    assert len(out) >= 3 and len(lps) == len(out)
+    assert src.pool.in_use > 0  # export does NOT release the source
+    src.cancel(rid)
+    src.run()
+    src.pool.check()
+    assert src.pool.in_use == 0
+
+    # target: install + continue; the combined stream is the reference
+    dst = _batcher(params, cfg, tp=tp)
+    rid2 = dst.submit(prompt, max_new=8, seed=123,
+                      resume_out=out, resume_logp=lps, kv_pages=blob)
+    got = _finish(dst, rid2)
+    assert got[0] == want[0], (quant, tp, got[0], want[0])
+    assert got[1] == want[1], (quant, tp)  # logprobs bitwise, not approx
+    dst.pool.check()
+    assert dst.pool.in_use == 0
+
+
+def test_wire_blob_survives_reinstall_bitwise(setup):
+    """Re-exporting an installed stream reproduces the original blob's
+    valid page bytes for EVERY plane (codes and scales): the transfer
+    copies rows, it never re-encodes them."""
+    cfg0, params = setup
+    cfg = dataclasses.replace(cfg0, cache_quant="int8")
+    prompt = _prompt(5, 20, cfg)
+    src = _batcher(params, cfg)
+    rid = src.submit(prompt, max_new=8, seed=123)
+    _step_until_tokens(src, rid, 3)
+    blob, out, lps = src.export_kv_pages(rid)
+    src.cancel(rid)
+
+    dst = _batcher(params, cfg)
+    rid2 = dst.submit(prompt, max_new=8, seed=123,
+                      resume_out=out, resume_logp=lps, kv_pages=blob)
+    _step_until_tokens(dst, rid2, len(out) + 1)
+    blob2, out2, _ = dst.export_kv_pages(rid2)
+    dst.cancel(rid2)
+    assert out2[:len(out)] == out
+    _, p1 = paging.unpack_kv_wire(blob)
+    _, p2 = paging.unpack_kv_wire(blob2)
+    assert set(p1) == set(p2)
+    # rows past the exported valid count were rewritten by the finish
+    # chunk; the FULL pages below it must match byte-for-byte
+    full = blob["tokens"] // PS
+    assert full >= 1  # the comparison must actually cover pages
+    for name in p1:
+        a = np.asarray(p1[name][:, :full]).view(np.uint8)
+        b = np.asarray(p2[name][:, :blob["n_pages"]][:, :full]).view(
+            np.uint8)
+        assert np.array_equal(a, b), f"plane {name} re-encoded in flight"
+
+
+def test_export_refuses_dense_and_unknown(setup):
+    cfg, params = setup
+    dense = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8, kv_layout="dense",
+    )
+    rid = dense.submit(_prompt(6, 10, cfg), max_new=4)
+    with pytest.raises(ValueError, match="paged"):
+        dense.export_kv_pages(rid)
+    dense.run()
+
+    cb = _batcher(params, cfg)
+    with pytest.raises(KeyError):
+        cb.export_kv_pages(999)  # never submitted
+    rid = cb.submit(_prompt(6, 20, cfg), max_new=4)
+    with pytest.raises(ValueError, match="prefill"):
+        cb.export_kv_pages(rid)  # still prefilling: no pages to ship
+    cb.run()
+
+
+def test_cancel_mid_transfer_returns_pool_to_baseline(setup):
+    """The leak pin: a target that admits transferred pages and is
+    cancelled mid-decode must drain back to the empty-pool baseline —
+    installed pages retire exactly like cold-admitted ones."""
+    cfg, params = setup
+    prompt = _prompt(5, 20, cfg)
+    src = _batcher(params, cfg)
+    rid = src.submit(prompt, max_new=8, seed=123)
+    _step_until_tokens(src, rid, 3)
+    blob, out, lps = src.export_kv_pages(rid)
+    src.cancel(rid)
+    src.run()
+    assert src.pool.in_use == 0
+
+    dst = _batcher(params, cfg)
+    rid2 = dst.submit(prompt, max_new=8, seed=123,
+                      resume_out=out, resume_logp=lps, kv_pages=blob)
+    _step_until_tokens(dst, rid2, len(out) + 1)  # install happened
+    assert dst.pool.in_use >= blob["n_pages"]
+    dst.cancel(rid2)
+    dst.run()
+    dst.pool.check()
+    assert dst.pool.in_use == 0
+
+
+# --- the HTTP seam ----------------------------------------------------------
+
+
+def test_kv_export_http_seam(setup):
+    """Replica-to-replica over HTTP, no router: stream on A, export
+    mid-stream via X-Request-Id, resubmit on B with the pages; the
+    combined stream is bit-identical to an uninterrupted run."""
+    cfg, params = setup
+    prompt = _prompt(5, 20, cfg)
+
+    async def body():
+        async with inprocess_fleet(params, cfg, n_replicas=2,
+                                   engine_kw=ENGINE_KW) as fleet:
+            a, b = fleet.replica_base(0), fleet.replica_base(1)
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{b}/v1/generate", json={
+                    "prompt": prompt, "max_new": 8, "seed": 123,
+                    "logprobs": True,
+                }) as r:
+                    assert r.status == 200, await r.text()
+                    ref = await r.json()
+
+                got = []
+                async with s.post(f"{a}/v1/generate", json={
+                    "prompt": prompt, "max_new": 8, "seed": 123,
+                    "stream": True, "logprobs": True,
+                }) as r:
+                    assert r.status == 200, await r.text()
+                    eid = int(r.headers["X-Request-Id"])
+                    exp = None
+                    async for line in r.content:
+                        t = line.decode().strip()
+                        if not t.startswith("data: "):
+                            continue
+                        evt = json.loads(t[len("data: "):])
+                        if "token" in evt:
+                            got.append(evt["token"])
+                        if len(got) == 3 and exp is None:
+                            async with s.post(
+                                f"{a}/v1/kv/export/{eid}"
+                            ) as ex:
+                                assert ex.status == 200, await ex.text()
+                                exp = await ex.json()
+                        if evt.get("done") or evt.get("error"):
+                            break
+                # the export snapshot is a superset of what we streamed
+                assert exp["resume_out"][:len(got)] == got
+                assert len(exp["resume_out"]) >= 3
+
+                async with s.post(f"{b}/v1/generate", json={
+                    "prompt": prompt, "max_new": 8, "seed": 123,
+                    "logprobs": True,
+                    "resume_out": exp["resume_out"],
+                    "resume_logprobs": exp["resume_logprobs"],
+                    "kv_pages": exp["kv_pages"],
+                }) as r:
+                    assert r.status == 200, await r.text()
+                    cont = await r.json()
+                assert exp["resume_out"] + cont["tokens"] == ref["tokens"]
+                assert (exp["resume_logprobs"] + cont["logprobs"]
+                        == ref["logprobs"])
+
+                # 404 once finished/cancelled; 400 on a garbage id
+                async with s.post(f"{a}/v1/kv/export/{eid}") as r:
+                    assert r.status == 404
+                async with s.post(f"{a}/v1/kv/export/zzz") as r:
+                    assert r.status == 400
+
+                for srv in fleet.servers:
+                    srv.engine.cb.pool.check()
+                    assert srv.engine.cb.pool.in_use == 0
+
+    run(body())
+
+
+def test_kv_install_pool_pressure_answers_429(setup):
+    """A target whose pool FITS the folded stream but cannot hold it
+    right now fast-fails the kv_pages submit with kv_pool_pressure
+    (-> HTTP 429, the router's cue to re-prefill elsewhere) instead of
+    deferring a live stream behind the full pool."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        SchedulerOverloadError,
+    )
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    prompt = _prompt(5, 20, cfg)
+    src = _batcher(params, cfg)
+    rid = src.submit(prompt, max_new=8, seed=123)
+    _step_until_tokens(src, rid, 3)
+    blob, out, lps = src.export_kv_pages(rid)
+    src.cancel(rid)
+
+    async def body():
+        engine = InferenceEngine(params, cfg, **ENGINE_KW)
+        try:
+            pool = engine.cb.pool
+            # leave one free page: the stream fits the pool's CAPACITY
+            # (no 422 refusal) but not what is free right now
+            held = pool.alloc(pool.free_pages - 1)
+            try:
+                with pytest.raises(SchedulerOverloadError) as ei:
+                    engine.submit(prompt, max_new=8, seed=123,
+                                  resume_out=out, resume_logp=lps,
+                                  kv_pages=blob)
+                assert ei.value.reason == "kv_pool_pressure"
+                assert ei.value.retry_after == 1
+            finally:
+                pool.decref(held)
+            pool.check()
+            # with the pressure gone the same submit is admitted
+            _, q = engine.submit(prompt, max_new=8, seed=123,
+                                 resume_out=out, resume_logp=lps,
+                                 kv_pages=blob)
+            toks = []
+            while True:
+                t = await asyncio.wait_for(q.get(), 60)
+                if t is None:
+                    break
+                toks.append(t)
+            # only the continuation streams: the resumed prefix was
+            # already delivered by whoever relayed the source stream
+            assert len(toks) == 8 - len(out)
+        finally:
+            engine.shutdown()
+
+    run(body())
+
+
+# --- the role-aware router -------------------------------------------------
+
+
+DISAGG_KW = dict(roles="prefill=r0 decode=r1,r2", disagg_min_prompt=8)
+
+
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_disagg_streams_bit_identical(setup, quant):
+    """The end-to-end pin: a long-prompt stream through a roled fleet
+    (prefill on r0, KV pages shipped to a decode worker, the stream
+    spliced across the hop) is bit-identical — tokens AND logprobs,
+    greedy AND seeded — to an unroled colocated run."""
+    cfg0, params = setup
+    cfg = dataclasses.replace(cfg0, cache_quant=quant)
+    prompt = _prompt(5, 20, cfg)
+    short = prompt[:5]
+
+    async def body():
+        async with inprocess_fleet(params, cfg, n_replicas=1,
+                                   engine_kw=ENGINE_KW) as colo:
+            async with aiohttp.ClientSession() as s:
+                refs = {}
+                for seed in (None, 123):  # sequential: XLA:CPU compile
+                    refs[seed] = await stream_generate(
+                        s, colo.base, prompt=prompt, max_new=8, seed=seed,
+                    )
+
+        async with inprocess_fleet(
+            params, cfg, n_replicas=3, engine_kw=ENGINE_KW,
+            router_kw=dict(DISAGG_KW),
+        ) as fleet:
+            async with aiohttp.ClientSession() as s:
+                for i, seed in enumerate((None, 123)):
+                    got = await stream_generate(
+                        s, fleet.base, prompt=prompt, max_new=8, seed=seed,
+                    )
+                    assert not got.get("error"), got
+                    assert got["tokens"] == refs[seed]["tokens"], (
+                        quant, seed, got["tokens"], refs[seed]["tokens"])
+                    assert got["logprobs"] == refs[seed]["logprobs"], (
+                        quant, seed)
+                    st = fleet.router.router_stats()
+                    assert st["kv_transfers"].get("ok", 0) == i + 1, (
+                        st["kv_transfers"])
+                st = fleet.router.router_stats()
+                assert st["kv_transferred_pages"] > 0
+                assert len(st["kv_transfer_ms"]) == 2
+                assert st["roles"] == {"r0": "prefill", "r1": "decode",
+                                       "r2": "decode"}
+
+                # short prompts skip the hop: colocated on a decode
+                # worker, no new transfer counted
+                sgot = await stream_generate(s, fleet.base, prompt=short,
+                                             max_new=6)
+                assert len(sgot["tokens"]) == 6 and not sgot.get("error")
+                st = fleet.router.router_stats()
+                assert st["kv_transfers"].get("ok", 0) == 2
+
+                # the prefill replica never holds pages past the hop
+                assert fleet.servers[0].engine.cb.pool.in_use == 0
+                for srv in fleet.servers:
+                    srv.engine.cb.pool.check()
+
+                if quant == "none":
+                    # /fleet/health surfaces roles
+                    async with s.get(f"{fleet.base}/fleet/health") as r:
+                        snap = await r.json()
+                    roles = {rid: rep["role"]
+                             for rid, rep in snap["replicas"].items()}
+                    assert roles == {"r0": "prefill", "r1": "decode",
+                                     "r2": "decode"}
+                    assert snap["roles"]["prefill"]["replicas"] == 1
+                    # draining the only prefill-capable replica is
+                    # refused; draining one of two decode workers is not
+                    async with s.post(f"{fleet.base}/fleet/drain/r0") as r:
+                        assert r.status == 409
+                        assert (await r.json()).get("code") == "role_empty"
+                    async with s.post(f"{fleet.base}/fleet/drain/r1") as r:
+                        assert r.status == 200, await r.text()
+                    async with s.post(
+                        f"{fleet.base}/fleet/undrain/r1"
+                    ) as r:
+                        assert r.status == 200
+
+    run(body())
+
+
+def test_disagg_transfer_failure_falls_back_bit_identical(setup):
+    """Kill every decode worker: the transfer leg finds no target and
+    the router degrades to a re-prefill resume on the prefill replica —
+    same stream, zero drops, fallback counted (not charged as a
+    replica death)."""
+    cfg, params = setup
+    prompt = _prompt(5, 20, cfg)
+
+    async def body():
+        async with inprocess_fleet(params, cfg, n_replicas=1,
+                                   engine_kw=ENGINE_KW) as colo:
+            async with aiohttp.ClientSession() as s:
+                ref = await stream_generate(
+                    s, colo.base, prompt=prompt, max_new=8,
+                )
+
+        async with inprocess_fleet(
+            params, cfg, n_replicas=3, engine_kw=ENGINE_KW,
+            router_kw=dict(DISAGG_KW),
+        ) as fleet:
+            await fleet.kill_replica(1)
+            await fleet.kill_replica(2)
+            for _ in range(100):  # let the health poller notice
+                if sum(1 for r in fleet.fleet.all() if r.alive) == 1:
+                    break
+                await asyncio.sleep(0.1)
+            async with aiohttp.ClientSession() as s:
+                got = await stream_generate(s, fleet.base, prompt=prompt,
+                                            max_new=8)
+                assert not got.get("error"), got
+                assert got["tokens"] == ref["tokens"], (
+                    got["tokens"], ref["tokens"])
+                assert got["logprobs"] == ref["logprobs"]
+                st = fleet.router.router_stats()
+                assert st["kv_transfers"].get("fallback", 0) >= 1, (
+                    st["kv_transfers"])
+                assert fleet.servers[0].engine.cb.pool.in_use == 0
+                fleet.servers[0].engine.cb.pool.check()
+
+    run(body())

@@ -132,6 +132,45 @@ def test_kernel_windowed_matches_reference():
     assert err < 0.02, err
 
 
+def test_prefill_t_tiling_past_max_window():
+    """Chunks wider than MAX_PREFILL_T route through the T-tile grid:
+    gather parity holds at t=512, the tile width is bitwise
+    output-invariant (each query row meets its live kv blocks in the
+    same ascending order whatever the tiling), and the paged route
+    tiles identically."""
+    from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+        fit_prefill_tile,
+    )
+
+    assert fit_prefill_tile(512) == 256
+    assert fit_prefill_tile(320) == 160
+    assert fit_prefill_tile(64) == 64          # fits: no tiling
+    assert fit_prefill_tile(MAX_PREFILL_T + 1) is None  # prime chunk
+
+    kq, k, v = _dense(b=2, s=1024, hq=8, hkv=4)
+    t = 512
+    q = jax.random.normal(kq, (2, t, 8, HD), jnp.bfloat16)
+    base = jnp.asarray([0, 1024 - t], jnp.int32)
+    assert supports(q, k, require_pltpu=False)
+    want = _ref(q, k, v, base, HD ** -0.5)
+    got = ragged_paged_attention(q, k, v, base, scale=HD ** -0.5,
+                                 block_k=128, interpret=True)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+    assert err < 0.02, err
+    # the tile width is a pure performance knob, never a numerics one
+    for bt in (128, 64):
+        alt = ragged_paged_attention(q, k, v, base, scale=HD ** -0.5,
+                                     block_k=128, block_t=bt,
+                                     interpret=True)
+        assert bool(jnp.all(alt == got)), bt
+    # paged pool: same tiled grid, page-table indirection
+    kp, vp, table = _paged(k, v)
+    gotp = ragged_paged_attention(q, kp, vp, base, table,
+                                  scale=HD ** -0.5, interpret=True)
+    errp = float(jnp.max(jnp.abs(gotp.astype(jnp.float32) - want)))
+    assert errp < 0.02, errp
+
+
 @pytest.mark.parametrize("qdtype", [jnp.int8, jnp.int4])
 def test_kernel_dequantizes_codes_in_block(qdtype):
     """The quantized specialization: int8/int4 codes + per-(token, head)
@@ -413,6 +452,11 @@ def test_backend_plan_reasons():
                                   chunk=MAX_PREFILL_T + 1, **common)
     assert plan["prefill"]["backend"] == "xla"
     assert "MAX_PREFILL_T" in plan["prefill"]["reason"]
+    # a chunk that TILES cleanly past the window plans onto the kernel
+    plan = attention_backend_plan(decode_attn="ragged",
+                                  prefill_attn="ragged",
+                                  chunk=2 * MAX_PREFILL_T, **common)
+    assert plan["prefill"]["backend"] == "pallas"
     plan = attention_backend_plan(**common)
     assert plan["decode"]["reason"].startswith("decode_attn=")
 
@@ -536,6 +580,19 @@ def test_kernel_loads_tuned_block(tmp_path, monkeypatch):
         explicit = ragged_paged_attention(q, k, v, base, scale=HD ** -0.5,
                                           block_k=16, interpret=True)
         assert bool(jnp.all(tuned == explicit))
+        # a two-element prefill row carries the measured T tile too
+        tunings.record({"rpa:prefill:hkv4:hd64:128": [16, 32]},
+                       generation=gen)
+        tunings.clear_cache()
+        qp = jax.random.normal(kq, (3, 64, 8, HD), jnp.bfloat16)
+        basep = jnp.asarray([0, 32, 64], jnp.int32)
+        tunedp = ragged_paged_attention(qp, k, v, basep, scale=HD ** -0.5,
+                                        interpret=True)
+        explicitp = ragged_paged_attention(
+            qp, k, v, basep, scale=HD ** -0.5, block_k=16, block_t=32,
+            interpret=True,
+        )
+        assert bool(jnp.all(tunedp == explicitp))
     finally:
         tunings.clear_cache()
 
