@@ -69,7 +69,7 @@ san-test:
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-quant-paged bench-spec \
 	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-disagg bench-chaos bench-fleet-obs bench-chip-obs
+	bench-adapters bench-disagg bench-chaos bench-fleet-obs bench-chip-obs
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -156,6 +156,18 @@ bench-kernels:
 bench-router:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.router_bench
 
+# CPU-runnable smoke: adapter-dense serving (models/lora_serving.py) —
+# per-step decode cost at N=1 vs 64 vs 256 registered adapters (K
+# resident compact slots) asserting N=256 stays within 1.5x of N=1 (the
+# O(active) claim: the registry never enters the per-step contraction),
+# plus a 2-replica fleet A/B asserting adapter-affinity routing strictly
+# beats adapter-blind routing on the aggregate prefix hit rate with zero
+# failed requests (one JSON line with adapters_registered/resident,
+# tokens_per_second_adapters, adapter_gather_overhead_pct,
+# adapter_upload_ms_p99, adapter_affinity_hit_pct).
+bench-adapters:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.adapter_bench
+
 # CPU-runnable smoke: disaggregated prefill/decode serving — one
 # open-loop mixed long-prompt/short-decode trace through a 3-replica
 # in-process fleet, colocated vs role-split (--roles prefill=r0
@@ -221,7 +233,7 @@ clean:
 .PHONY: all native native-test proto lint analyze san-test ci test bench \
 	bench-host-overhead bench-prefix-cache bench-paged-kv \
 	bench-quant-paged bench-spec bench-sched bench-tp bench-obs \
-	bench-kernels bench-router bench-disagg bench-chaos \
+	bench-kernels bench-router bench-adapters bench-disagg bench-chaos \
 	bench-fleet-obs bench-chip-obs clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest

@@ -1030,6 +1030,177 @@ def fleet_openloop_ab(
     }
 
 
+def adapter_fleet_ab(
+    cfg,
+    params,
+    adapters,            # lora_serving.AdapterSet: the per-replica registry
+    *,
+    n_slots: int,
+    max_len: int,
+    prompt_buckets: tuple[int, ...],
+    chunked_prefill: int,
+    n_per_adapter: int = 10,
+    rps: float = 16.0,
+    max_new: int = 6,
+    sys_len: "int | None" = None,
+    suffix_len: int = 12,
+    max_queue: int = 8,
+    load_factor: float = 3.0,
+    seed: int = 0,
+) -> dict:
+    """The adapter-affinity A/B: one open-loop multi-adapter trace
+    through a 2-replica in-process fleet, once with the router folding
+    the request's adapter into the affinity key (``--adapterNames``)
+    and once adapter-BLIND (rr). Every adapter's requests share ONE
+    system prefix — token-identical across adapters — so plain prompt
+    affinity cannot tell them apart: only the adapter fold separates
+    their keys. Prefix-cache roots are per-adapter, which is what makes
+    placement load-bearing: under the fold each adapter pays ONE cold
+    prefill fleet-wide (its roots concentrate on its home replica);
+    blind routing scatters each adapter across both replicas, so the
+    fleet pays ~2x the cold prefills and the aggregate hit rate drops.
+
+    Returns the ``adapter_*`` serve-row fields; the hard asserts
+    (strict hit-rate win, zero failures) live in adapter_bench."""
+    import asyncio
+    import random
+
+    import aiohttp
+
+    from k8s_gpu_device_plugin_tpu.serving.fleet import parse_retry_after
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import Scheduler
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+    from k8s_gpu_device_plugin_tpu.serving.testing import inprocess_fleet
+
+    buckets = tuple(b for b in prompt_buckets if b <= max_len)
+    names = tuple(adapters.names)
+    if sys_len is None:
+        below = [b for b in buckets if b < buckets[-1]]
+        sys_len = max(below) if below else buckets[0]
+    rng = random.Random(seed)
+    sys_prefix = [1 + rng.randrange(cfg.vocab_size - 1)
+                  for _ in range(sys_len)]
+    trace = []
+    for g, name in enumerate(names):
+        for _ in range(n_per_adapter):
+            trace.append({
+                "adapter": name,
+                "prompt": sys_prefix + [
+                    1 + rng.randrange(cfg.vocab_size - 1)
+                    for _ in range(suffix_len)
+                ],
+            })
+    rng.shuffle(trace)
+    for i, e in enumerate(trace):
+        e["t"] = i / rps
+
+    async def drive(session, base, t0, e, facts):
+        await asyncio.sleep(max(0.0, t0 + e["t"] - time.perf_counter()))
+        body = {"prompt": e["prompt"], "max_new": max_new,
+                "adapter": e["adapter"]}
+        for attempt in range(2):  # fleet_openloop_ab's capped 429 retry
+            try:
+                async with session.post(
+                    f"{base}/v1/generate", json=body
+                ) as r:
+                    if r.status == 429 and attempt == 0:
+                        ra = parse_retry_after(
+                            r.headers.get("Retry-After"), default=1.0
+                        )
+                        await asyncio.sleep(min(ra, 1.0))
+                        continue
+                    if r.status != 200:
+                        facts["failed"] += 1
+                        return
+                    await r.read()
+                    facts["served"] += 1
+                    return
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ConnectionResetError, OSError):
+                facts["failed"] += 1
+                return
+
+    async def run_arm(policy: str, fold: bool) -> dict:
+        caches: list = []
+
+        def engine_factory(i: int):
+            pc = PrefixCache(cfg, buckets=buckets, budget_bytes=64 << 20)
+            caches.append(pc)
+            return InferenceEngine(
+                params, cfg, n_slots=n_slots, max_len=max_len,
+                chunked_prefill=chunked_prefill, prompt_buckets=buckets,
+                prefix_cache=pc, adapters=adapters,
+                scheduler=Scheduler(max_queue=max_queue),
+            )
+
+        facts = {"served": 0, "failed": 0}
+        async with inprocess_fleet(
+            params, cfg, n_replicas=2, engine_factory=engine_factory,
+            router_kw=dict(
+                policy=policy, prompt_buckets=buckets,
+                health_interval_s=0.2, load_factor=load_factor,
+                adapter_names=names if fold else None,
+            ),
+        ) as fl:
+            async with aiohttp.ClientSession() as session:
+                # sequential per-replica warm-up (the one-compiler-at-a-
+                # time rule — see fleet_openloop_ab): a base request
+                # compiles the chunk/finish/decode jits, an adapter twin
+                # compiles the gathered dispatch, a shared-prefix twin
+                # the cache match/insert jits. Warm prompts use a prefix
+                # DISJOINT from the trace's so its roots never collide.
+                warm = [2 + (i % (cfg.vocab_size - 2))
+                        for i in range(sys_len + suffix_len)]
+                warm_hit = warm[:-1] + [1]
+                for i in range(2):
+                    for body in (
+                        {"prompt": warm, "max_new": max_new},
+                        {"prompt": warm_hit, "max_new": max_new,
+                         "adapter": names[0]},
+                    ):
+                        async with session.post(
+                            f"{fl.replica_base(i)}/v1/generate", json=body
+                        ) as r:
+                            await r.read()
+                t0 = time.perf_counter()
+                await asyncio.gather(*(
+                    drive(session, fl.base, t0, e, facts) for e in trace
+                ))
+                stats = fl.router.router_stats()
+        hits = sum(c.stats.as_dict()["hits"] for c in caches)
+        misses = sum(c.stats.as_dict()["misses"] for c in caches)
+        return {
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "served": facts["served"],
+            "failed": facts["failed"],
+            "affinity_hits": stats["affinity_hits"],
+            "requests": stats["requests"],
+            "adapter_requests": sum(
+                stats.get("adapter_requests", {}).values()
+            ),
+        }
+
+    async def both():
+        aff = await run_arm("affinity", fold=True)
+        blind = await run_arm("rr", fold=False)
+        return aff, blind
+
+    aff, blind = asyncio.run(both())
+    return {
+        "adapter_fleet_requests": len(trace),
+        "adapter_prefix_hit_rate_affinity": aff["hit_rate"],
+        "adapter_prefix_hit_rate_blind": blind["hit_rate"],
+        "adapter_affinity_hit_pct": (
+            100.0 * aff["affinity_hits"] / aff["requests"]
+            if aff["requests"] else 0.0
+        ),
+        "adapter_folded_requests": aff["adapter_requests"],
+        "adapter_fleet_failed": aff["failed"] + blind["failed"],
+        "adapter_fleet_served": aff["served"] + blind["served"],
+    }
+
+
 def disagg_openloop_ab(
     cfg,
     params,

@@ -399,6 +399,48 @@ class ServingMetrics:
             "engine restarts",
             registry=registry,
         )
+        # Multi-LoRA adapter residency (models/lora_serving.AdapterStore)
+        # and the gathered O(active) compute path: registered vs HBM-
+        # resident counts, upload latency, and the admission deferrals
+        # an adapter miss or K-overflow causes (the adapter analogue of
+        # kv_admission_rejected).
+        self.adapters_registered = Gauge(
+            f"{prefix}_adapters_registered",
+            "LoRA adapters registered host-side (tombstones excluded)",
+            registry=registry,
+        )
+        self.adapters_resident = Gauge(
+            f"{prefix}_adapters_resident",
+            "LoRA adapters currently resident in device HBM",
+            registry=registry,
+        )
+        self.adapter_resident_bytes = Gauge(
+            f"{prefix}_adapter_resident_bytes",
+            "Device bytes held by HBM-resident LoRA adapter stacks",
+            registry=registry,
+        )
+        self.adapter_uploads = Counter(
+            f"{prefix}_adapter_uploads_total",
+            "Host-to-device LoRA adapter block uploads",
+            registry=registry,
+        )
+        self.adapter_upload_seconds = Histogram(
+            f"{prefix}_adapter_upload_seconds",
+            "LoRA adapter H2D upload latency (seconds)",
+            buckets=LATENCY_BUCKETS,
+            registry=registry,
+        )
+        self.adapter_deferred = Counter(
+            f"{prefix}_adapter_deferred_total",
+            "Admissions deferred head-of-line on adapter residency",
+            ["reason"],  # adapter_miss | adapter_slots
+            registry=registry,
+        )
+        self.adapter_gathers = Counter(
+            f"{prefix}_adapter_gathers_total",
+            "Compact-stack regathers (batch active-adapter set changed)",
+            registry=registry,
+        )
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
 
@@ -455,6 +497,13 @@ class ServingMetrics:
             self.engine_restarts,
             self.engine_replayed_requests,
             self.engine_resumed_requests,
+            self.adapters_registered,
+            self.adapters_resident,
+            self.adapter_resident_bytes,
+            self.adapter_uploads,
+            self.adapter_upload_seconds,
+            self.adapter_deferred,
+            self.adapter_gathers,
         ):
             try:
                 self._registry.unregister(c)
@@ -558,6 +607,26 @@ class ServingMetrics:
 
     def on_sched_rejected(self, reason: str) -> None:
         self.sched_rejected.labels(reason=reason).inc()
+
+    # --- multi-LoRA adapter hooks (models/lora_serving.AdapterStore,
+    #     models/batching.py gathered path) ---
+
+    def set_adapter_residency(
+        self, registered: int, resident: int, resident_bytes: int
+    ) -> None:
+        self.adapters_registered.set(registered)
+        self.adapters_resident.set(resident)
+        self.adapter_resident_bytes.set(resident_bytes)
+
+    def on_adapter_upload(self, seconds: float) -> None:
+        self.adapter_uploads.inc()
+        self.adapter_upload_seconds.observe(seconds)
+
+    def on_adapter_deferred(self, reason: str) -> None:
+        self.adapter_deferred.labels(reason=reason).inc()
+
+    def on_adapter_gather(self) -> None:
+        self.adapter_gathers.inc()
 
     # --- speculative-decoding hook (models/spec_batching.py) ---
 

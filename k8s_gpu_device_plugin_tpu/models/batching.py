@@ -516,7 +516,10 @@ class ContinuousBatcher:
         chunked_prefill: int = 0,
         seed: int = 0,
         metrics=None,
-        adapters=None,  # lora_serving.AdapterSet: multi-LoRA serving
+        adapters=None,  # lora_serving.AdapterSet | AdapterStore: multi-LoRA
+        lora_slots: int | None = None,  # K compact adapter slots; None =
+        #   n_slots (gathered O(active) serving); 0 = legacy dense-N stacks
+        adapter_cache_mb: int = 0,  # AdapterStore HBM budget; 0 = unlimited
         pipeline_depth: int = 1,
         trace_steps: bool = False,
         prefix_cache=None,  # serving.prefix_cache.PrefixCache (or None)
@@ -576,16 +579,72 @@ class ContinuousBatcher:
                     f"max_len={max_len}: the page table's virtual extent "
                     "is exactly the slot capacity"
                 )
+        # Multi-LoRA: two serving modes behind one `adapters` kwarg.
+        # GATHERED (the default, lora_slots=None or K>0): an AdapterStore
+        # is the HBM-residency source and params carry compact (L, K, ...)
+        # stacks holding only the batch-active adapters — per-step LoRA
+        # cost scales with the active set, never the registry
+        # (lora_serving.py, "N-vs-K cost model"). DENSE-N (lora_slots=0):
+        # the full (L, N, ...) stacks attach once — the bit-identity
+        # oracle and the tiny-N fallback.
+        self.adapter_store = None   # lora_serving.AdapterStore | None
+        self.lora_slots = 0         # K: compact stack width (0 = dense-N)
+        self._lora_active: tuple[int, ...] = ()  # registry ids behind K slots
+        self._adapter_names_static: tuple[str, ...] = ()
+        self._gather_count = 0      # owner: engine (adapter_stats)
+        self._gather_s = 0.0        # owner: engine
+        if lora_slots is not None and lora_slots < 0:
+            raise ValueError(f"lora_slots must be >= 0, got {lora_slots}")
         if adapters is not None:
             from k8s_gpu_device_plugin_tpu.models.lora_serving import (
+                AdapterStore,
                 attach_adapters,
             )
 
-            params = attach_adapters(params, adapters)
-            self.adapter_names: tuple[str, ...] = adapters.names
-        else:
-            self.adapter_names = ()
-        self.n_adapters = len(self.adapter_names)
+            store = None
+            if isinstance(adapters, AdapterStore):
+                if lora_slots == 0:
+                    raise ValueError(
+                        "lora_slots=0 (the dense-N path) needs a static "
+                        "AdapterSet: an AdapterStore's registry can "
+                        "outgrow any dense stack"
+                    )
+                store = adapters
+            elif lora_slots == 0:
+                params = attach_adapters(params, adapters)
+                self._adapter_names_static = adapters.names
+            else:
+                store = AdapterStore.from_set(
+                    cfg, adapters,
+                    cache_bytes=int(adapter_cache_mb) << 20,
+                )
+            if store is not None:
+                if store.rank_cap is None:
+                    raise ValueError(
+                        "the AdapterStore holds no registered adapters; "
+                        "register at least one before serving (the "
+                        "compact stacks' shape freezes at first "
+                        "registration)"
+                    )
+                # K defaults to the slot count: a batch can never hold
+                # more DISTINCT adapters than slots. An explicit K may
+                # exceed today's registry (sized for later registrations)
+                # but never needs to exceed n_slots.
+                self.lora_slots = max(1, min(
+                    n_slots if lora_slots is None else int(lora_slots),
+                    n_slots,
+                ))
+                self.adapter_store = store
+                store.bind(self._dev, metrics)
+                params = {**params, "layers": {
+                    **params["layers"],
+                    **store.gather((), self.lora_slots),
+                }}
+        elif adapter_cache_mb:
+            raise ValueError(
+                "adapter_cache_mb is an AdapterStore budget; it needs "
+                "adapters"
+            )
         self._sel_cache: jax.Array | None = None  # (n_slots, N), like knobs; owner: engine
         self._bias_cache: jax.Array | None = None  # (n_slots, V), like knobs; owner: engine
         if self.mesh is not None:
@@ -897,6 +956,8 @@ class ContinuousBatcher:
         self._flt_prefill = point("prefill.dispatch")
         self._flt_decode = point("decode.apply")
         self._flt_promote = point("prefix.promote")
+        self._flt_adapter_upload = point("adapter.upload")
+        self._adapter_deferrals: dict[str, int] = {}  # owner: engine
         self._fault_error = (
             getattr(faults, "error", None) if faults is not None else None
         )
@@ -1165,6 +1226,20 @@ class ContinuousBatcher:
         )
         return need, self.pool.free_pages
 
+    @property
+    def adapter_names(self) -> tuple:
+        """Positional adapter names (the index requests select by).
+        Frozen for a static AdapterSet; DYNAMIC under an AdapterStore
+        (registration appends, unregistration leaves a "" tombstone so
+        live indices never shift)."""
+        if self.adapter_store is not None:
+            return self.adapter_store.names_tuple
+        return self._adapter_names_static
+
+    @property
+    def n_adapters(self) -> int:
+        return len(self.adapter_names)
+
     def validate_adapter(self, adapter: int) -> None:
         """The adapter half of the admission rule (shared with the
         serving engine's request thread, like ``validate``)."""
@@ -1174,6 +1249,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"adapter index {adapter} out of range: this batcher "
                 f"serves {self.n_adapters} adapter(s)"
+            )
+        if (self.adapter_store is not None
+                and not self.adapter_store.is_registered(adapter)):
+            raise ValueError(
+                f"adapter index {adapter} was unregistered"
             )
 
     def submit(
@@ -1464,41 +1544,160 @@ class ContinuousBatcher:
         """Drop every per-slot device-array cache (knobs, adapter
         one-hots, bias planes, membership mask, seeds). The ONE
         invalidation point for running-set membership changes — a new
-        cache added here can't miss a site."""
+        cache added here can't miss a site. The GATHERED compact adapter
+        stacks ride this lifecycle one level down: the sel rebuild that
+        follows an invalidation runs ``_ensure_gathered``, which
+        re-gathers only if the membership change actually changed the
+        batch's ACTIVE ADAPTER set — steady-state decode touches none
+        of it (zero per-step H2D either way)."""
         self._knobs_cache = None
         self._sel_cache = None
         self._bias_cache = None
         self._allowed_cache = None
         self._seeds_cache = None
 
+    def _active_adapters(self, extra: int = -1) -> tuple:
+        """The distinct adapter indices live in the batch (running +
+        mid-prefill), ascending, optionally plus one about-to-dispatch
+        request's — the set the compact stacks must cover."""
+        s = {r.adapter for r in self.running.values() if r.adapter >= 0}
+        s.update(
+            r.adapter for r in self.prefilling.values() if r.adapter >= 0
+        )
+        if extra >= 0:
+            s.add(extra)
+        return tuple(sorted(s))
+
+    def _ensure_gathered(self, extra: int = -1) -> None:
+        """Swap fresh compact (L, K, ...) adapter stacks under
+        ``params["layers"]`` iff the batch's active set changed since
+        the last gather. Pure device-to-device below the store (resident
+        blocks are already in HBM); params keeps one static pytree
+        structure, so no recompile — and since params is a jit ARGUMENT
+        (never donated), an in-flight pipelined step still reads the
+        stacks it dispatched with. Runs only from the invalidation-gated
+        sel rebuilds, never per decode step."""
+        active = self._active_adapters(extra)
+        if active == self._lora_active:
+            return
+        t0 = time.perf_counter()
+        leaves = self.adapter_store.gather(active, self.lora_slots)
+        if self.mesh is not None:
+            leaves = {k: self._dev(v) for k, v in leaves.items()}
+        self.params = {
+            **self.params,
+            "layers": {**self.params["layers"], **leaves},
+        }
+        self._lora_active = active
+        self._sel_cache = None  # positions remapped with the stacks
+        self._gather_count += 1
+        self._gather_s += time.perf_counter() - t0
+        if self.metrics is not None:
+            count = getattr(self.metrics, "on_adapter_gather", None)
+            if count is not None:
+                count()
+
     def _req_sel(self, req: _Request) -> "jax.Array | None":
-        """(1, N) adapter one-hot for one request's prefill dispatches
-        (None when this batcher serves no adapters)."""
-        if not self.n_adapters:
+        """(1, K|N) adapter one-hot for one request's prefill dispatches
+        (None when this batcher serves no adapters). Gathered mode first
+        ensures the compact stacks cover this request's adapter, then
+        selects its COMPACT position — the dense path selects the
+        registry index directly."""
+        if self.adapter_store is None and not self.n_adapters:
             return None
         from k8s_gpu_device_plugin_tpu.models.lora_serving import one_hot_sel
 
-        return self._dev(
-            jnp.asarray(one_hot_sel(req.adapter, self.n_adapters))[None, :]
-        )
+        if self.adapter_store is not None:
+            self._ensure_gathered(extra=req.adapter)
+            n = self.lora_slots
+            pos = (
+                self._lora_active.index(req.adapter)
+                if req.adapter >= 0 else -1
+            )
+        else:
+            n, pos = self.n_adapters, req.adapter
+        return self._dev(jnp.asarray(one_hot_sel(pos, n))[None, :])
 
     def _batch_sel(self) -> "jax.Array | None":
-        """(n_slots, N) per-slot adapter one-hots for the decode step;
+        """(n_slots, K|N) per-slot adapter one-hots for the decode step;
         cached until the running set changes (invalidated alongside
         ``_knobs_cache`` — same sites, same lifecycle). Empty slots read
         base-model zeros; their outputs are discarded anyway."""
-        if not self.n_adapters:
+        if self.adapter_store is None and not self.n_adapters:
             return None
         if self._sel_cache is None:
             from k8s_gpu_device_plugin_tpu.models.lora_serving import (
                 one_hot_sel,
             )
 
-            arr = np.zeros((self.n_slots, self.n_adapters), np.float32)
-            for slot, req in self.running.items():
-                arr[slot] = one_hot_sel(req.adapter, self.n_adapters)
+            if self.adapter_store is not None:
+                self._ensure_gathered()
+                pos = {a: i for i, a in enumerate(self._lora_active)}
+                arr = np.zeros((self.n_slots, self.lora_slots), np.float32)
+                for slot, req in self.running.items():
+                    if req.adapter >= 0:
+                        arr[slot, pos[req.adapter]] = 1.0
+            else:
+                arr = np.zeros(
+                    (self.n_slots, self.n_adapters), np.float32
+                )
+                for slot, req in self.running.items():
+                    arr[slot] = one_hot_sel(req.adapter, self.n_adapters)
             self._sel_cache = self._dev(arr)
         return self._sel_cache
+
+    def _count_adapter_deferral(self, reason: str) -> None:
+        """adapter_miss (HBM residency upload in flight) or
+        adapter_slots (more distinct adapters than K compact slots) —
+        the adapter twins of ``pool_pressure``."""
+        self._adapter_deferrals[reason] = (
+            self._adapter_deferrals.get(reason, 0) + 1
+        )
+        if self.metrics is not None:
+            count = getattr(self.metrics, "on_adapter_deferred", None)
+            if count is not None:
+                count(reason)
+
+    def _admit_adapter(self, req: _Request) -> bool:
+        """Adapter gate for one admission, the residency twin of
+        ``_reserve_pages``: False defers the request at the queue head.
+        Two transient causes: the compact stacks have no slot for a NEW
+        distinct adapter (frees as its current holders retire), or the
+        adapter is registered but not HBM-resident — the store starts
+        the upload on a daemon thread and this admission pass moves on
+        (the hot loop NEVER blocks on an adapter H2D; the request
+        admits a pass or two later when the upload lands). Deferral
+        counting dedupes per episode through ``defer_counted``, the
+        same flag the scheduler's defer-budget expiry watches — an
+        adapter-deferred request ages out into a 429 exactly like a
+        pool-starved one."""
+        if self.adapter_store is None or req.adapter < 0:
+            return True
+        if self._flt_adapter_upload is not None:
+            try:
+                self._flt_adapter_upload.fire()
+            except self._fault_error:
+                # injected residency miss: defer head-of-line exactly
+                # like a real in-flight upload — admits when the
+                # schedule relents
+                if not req.defer_counted:
+                    req.defer_counted = True
+                    self._count_adapter_deferral("adapter_miss")
+                return False
+        active = self._active_adapters()
+        if (req.adapter not in active
+                and len(active) >= self.lora_slots):
+            if not req.defer_counted:
+                req.defer_counted = True
+                self._count_adapter_deferral("adapter_slots")
+            return False
+        if not self.adapter_store.ensure_resident(req.adapter):
+            if not req.defer_counted:
+                req.defer_counted = True
+                self._count_adapter_deferral("adapter_miss")
+            return False
+        req.defer_counted = False
+        return True
 
     def _admit(self) -> None:
         if self.scheduler is not None and (self.pending or self.running):
@@ -1567,6 +1766,14 @@ class ContinuousBatcher:
                         pin = list(req.prefix.page_ids)
                         self.pool.incref(pin)
                         req._pinned_pages = pin
+            if not self._admit_adapter(req):
+                # head-of-line wait, the pool-pressure twin: the compact
+                # stacks gain a slot as adapters retire, or the miss's
+                # background upload lands — either way the next admission
+                # pass re-polls. Runs BEFORE the page reservation so a
+                # deferred request holds no fresh pages (match-time pins
+                # stay; cancel releases them).
+                break
             if self.pool is not None:
                 t_pages = (
                     time.perf_counter() if req.timeline is not None else 0.0
@@ -1661,11 +1868,16 @@ class ContinuousBatcher:
                     bucket=bucket, prompt_len=len(req.prompt),
                 )
             try:
+                # sel BEFORE params: the gathered-LoRA sel build may swap
+                # fresh compact stacks under self.params, and Python
+                # evaluates call arguments left to right — reading params
+                # first would dispatch against the pre-gather tree
+                sel = self._req_sel(req)
                 self.state, tok, logp = prefill_insert(
                     self.params, self.state, padded,
                     jnp.int32(len(req.prompt)), jnp.int32(slot),
                     self.cfg, self._req_knobs(req),
-                    jnp.int32(req.max_new), sel=self._req_sel(req),
+                    jnp.int32(req.max_new), sel=sel,
                     bias=self._req_bias(req), seed=self._req_seed(req),
                 )
                 req.out.append(int(tok))  # device sync: prefill really done
@@ -2128,6 +2340,104 @@ class ContinuousBatcher:
         may), so this is trivially safe cross-thread."""
         return {m: dict(d) for m, d in self.attn_plan.items()}
 
+    # --- adapter registry (gathered multi-LoRA; engine thread) -----------
+
+    def register_adapter(self, name: str, lora_params, lora_cfg) -> int:
+        """Dynamically add an adapter to the store (engine thread — the
+        serving engine routes control-plane calls through its admission
+        queue). Returns the new registry index. Residency follows the
+        store's budget policy: room (or no budget) uploads now,
+        otherwise first use pays one deferred admission."""
+        if self.adapter_store is None:
+            raise ValueError(
+                "this batcher serves a static AdapterSet (or none); "
+                "dynamic registration needs gathered mode (an "
+                "AdapterStore, lora_slots > 0)"
+            )
+        return self.adapter_store.register(name, lora_params, lora_cfg)
+
+    def unregister_adapter(self, name: str) -> int:
+        """Remove ``name`` from the registry AND evict its prefix-cache
+        root: an unregistered adapter can never match again, so its
+        cached K/V (pages under the paged layout) is dead weight that
+        would otherwise linger until LRU pressure. Refuses while
+        requests for it are live (queued, prefilling, or decoding) —
+        the compact stacks a dispatch is using must stay truthful."""
+        if self.adapter_store is None:
+            raise ValueError(
+                "this batcher serves a static AdapterSet (or none); "
+                "unregistration needs gathered mode"
+            )
+        idx = self.adapter_store.index_of(name)
+        live = idx in self._active_adapters() or any(
+            r.adapter == idx for r in self.pending
+        )
+        if live:
+            raise ValueError(
+                f"adapter {name!r} has live requests; drain them first"
+            )
+        # refresh the gather (and the store's protected set) to the true
+        # active set — it may be stale if every holder retired and no
+        # dispatch has rebuilt sel since
+        self._ensure_gathered()
+        self.adapter_store.unregister(name)
+        if self.prefix_cache is not None:
+            evict = getattr(self.prefix_cache, "evict_adapter", None)
+            if evict is not None:
+                evict(idx)
+        return idx
+
+    def adapter_stats(self) -> "dict | None":
+        """Adapter-serving snapshot for /v1/health and the serve row
+        (None when this batcher serves no adapters). Cross-thread safe:
+        the store snapshots under its lock; the gather counters ride
+        the kv_stats approximate-read contract."""
+        if self.adapter_store is None:
+            if not self.n_adapters:
+                return None
+            return {
+                "mode": "dense",
+                "registered": self.n_adapters,
+                "resident": self.n_adapters,
+            }
+        out = self.adapter_store.stats()
+        out.update(
+            mode="gathered",
+            lora_slots=self.lora_slots,
+            active=len(self._lora_active),
+            gathers=self._gather_count,
+            gather_ms_total=round(self._gather_s * 1e3, 3),
+            deferrals=dict(self._adapter_deferrals),
+        )
+        return out
+
+    def precompute_shared_prefix(self, tokens, adapter: int = -1):
+        """:func:`precompute_prefix` against THIS batcher's params — the
+        only safe entry under gathered serving, where the module
+        function's ``adapter`` (a registry id) is not the position
+        inside the compact stacks: this method makes the adapter
+        resident (a SYNC upload — control-plane work, not the admission
+        path), gathers it in, and passes the remapped ``sel_index``.
+        Dense/static batchers just forward."""
+        if adapter >= 0:
+            self.validate_adapter(adapter)
+        buckets = self.buckets or DEFAULT_PROMPT_BUCKETS
+        if self.adapter_store is None:
+            return precompute_prefix(
+                self.params, tokens, self.cfg, adapter=adapter,
+                n_adapters=self.n_adapters, prompt_buckets=buckets,
+            )
+        pos = None
+        if adapter >= 0:
+            self.adapter_store.make_resident(adapter)
+            self._ensure_gathered(extra=adapter)
+            pos = self._lora_active.index(adapter)
+        return precompute_prefix(
+            self.params, tokens, self.cfg, adapter=adapter,
+            n_adapters=self.lora_slots, prompt_buckets=buckets,
+            sel_index=pos,
+        )
+
     def _prefill_one_chunk(self) -> None:
         """Advance the oldest mid-prefill request by one chunk; on its
         final chunk, sample the first token and move it to running."""
@@ -2325,10 +2635,13 @@ class ContinuousBatcher:
         the draft model, so the draft cheaply re-prefills them."""
 
     def _apply_prefill_chunk(self, chunk, start: int, slot: int) -> None:
+        # sel before params: the gathered-LoRA sel build may swap the
+        # compact stacks under self.params (argument-evaluation order)
+        sel = self._req_sel(self.prefilling[slot])
         self.state = prefill_chunk(
             self.params, self.state, chunk,
             jnp.int32(start), jnp.int32(slot), self.cfg,
-            sel=self._req_sel(self.prefilling[slot]),
+            sel=sel,
         )
 
     def _apply_prefill_finish(self, chunk, fstart: int, plen: int,
@@ -2339,12 +2652,15 @@ class ContinuousBatcher:
         # finish chunk samples emission number prefilled_out (the same
         # seeded draw index the dropped decode would have used) against
         # the REMAINING budget; prefilled_out == 0 keeps today's trace
+        # sel before params: the gathered-LoRA sel build may swap the
+        # compact stacks under self.params (argument-evaluation order)
+        sel = self._req_sel(req)
         self.state, tok, logp = prefill_finish(
             self.params, self.state, chunk, jnp.int32(fstart),
             jnp.int32(plen), jnp.int32(slot),
             self.cfg, self._req_knobs(req),
             jnp.int32(req.max_new - req.prefilled_out),
-            sel=self._req_sel(req),
+            sel=sel,
             bias=self._req_bias(req),
             seed=self._req_seed(req),
             draw0=(
@@ -2608,9 +2924,12 @@ class ContinuousBatcher:
         result tuple carries per-slot acceptance counts too). Both
         halves must stay purely functional over ``self.state`` so the
         pipelined loop can hold one dispatch in flight."""
+        # sel before params: the gathered-LoRA sel rebuild may swap the
+        # compact stacks under self.params (argument-evaluation order)
+        sel = self._batch_sel()
         self.state, emitted, logps = decode_step(
             self.params, self.state, allowed, self._eos_dev,
-            self.cfg, self._batch_knobs(), sel=self._batch_sel(),
+            self.cfg, self._batch_knobs(), sel=sel,
             bias=self._batch_bias(), seeds=self._batch_seeds(),
         )
         return (emitted, logps)
@@ -3000,14 +3319,20 @@ def precompute_prefix(
     params, tokens: list[int], cfg: LlamaConfig,
     adapter: int = -1, n_adapters: int = 0,
     prompt_buckets: tuple[int, ...] = DEFAULT_PROMPT_BUCKETS,
+    sel_index: "int | None" = None,
 ) -> PrefixState:
     """Prefill a shared prefix once. The forward pads to the next
     ``prompt_buckets`` boundary so similar-length prefixes share a
     compile (one trace per bucket, not per length); the returned rows
     are sliced back to the exact token count, so ``PrefixState`` and
     ``_insert_prefix`` semantics are unchanged. ``params`` must already
-    carry stacked adapters (attach_adapters) when ``adapter`` >= 0 —
-    pass the batcher's own ``.params``."""
+    carry stacked adapters when ``adapter`` >= 0 — pass the batcher's
+    own ``.params``. Under GATHERED serving the stack position differs
+    from the registry index: ``sel_index`` is the position inside the
+    compact stacks (``n_adapters`` is then K) while ``adapter`` stays
+    the registry id the PrefixState is labeled with — callers should
+    use ``ContinuousBatcher.precompute_shared_prefix``, which derives
+    both."""
     n = len(tokens)
     pad = next((b for b in sorted(prompt_buckets) if b >= n), n)
     arr = jnp.asarray(list(tokens) + [0] * (pad - n), jnp.int32)
@@ -3032,7 +3357,9 @@ def precompute_prefix(
                 "params carry no stacked LoRA leaves; pass the batcher's "
                 "own .params (attach_adapters output), not the base tree"
             )
-        sel = jnp.asarray(one_hot_sel(adapter, n_adapters))[None, :]
+        sel = jnp.asarray(one_hot_sel(
+            adapter if sel_index is None else sel_index, n_adapters
+        ))[None, :]
     scope = nullcontext()
     if cfg.tp > 1:
         # trace under the serving mesh so the tp constraints bind (the

@@ -330,8 +330,11 @@ def _project_qkv(x, layer, positions, cfg, sel=None):
     here and the ring cache in models/rolling.py — one implementation so
     the rolling oracle's token-exactness can never drift). Weight leaves
     may be int8 {"q", "s"} serving leaves (models/quantized_serving.py);
-    qmatmul dispatches. ``sel`` (B, N) selects per-row stacked LoRA
-    adapters (multi-LoRA serving)."""
+    qmatmul dispatches. ``sel`` (B, S) selects per-row stacked LoRA
+    adapters (multi-LoRA serving); S is whatever stack the params carry
+    — all N registered adapters on the dense path, the ≤K batch-active
+    ones on the gathered path (models/lora_serving.py "N-vs-K cost
+    model"), with the one-hot over stack POSITIONS either way."""
     b, t, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, cfg.norm_offset)

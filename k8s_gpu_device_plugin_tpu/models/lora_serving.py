@@ -17,15 +17,41 @@ GEMV kernels; XLA wants static shapes and no data-dependent gathers):
   Mixed ranks zero-pad to the max R (zero A columns x zero B rows add
   exactly nothing); a target an adapter doesn't carry is a zero block;
   each adapter's ``alpha / rank`` scale is baked into its B stack.
-- Every row computes ALL N deltas and keeps its own via a one-hot
-  ``sel (B, N)`` — for serving-realistic N (a handful) the skinny
-  matmuls are noise next to the base projection (2·d_in·R·N MACs/token
-  vs d_in·d_out), and there is no gather, no recompile, no dynamic
-  shape. Base-model rows are the all-zeros one-hot.
+- Each row keeps its own delta via a one-hot ``sel`` over the stack
+  axis; folding ``sel`` into BOTH factor stacks first (``lora_delta``)
+  means the contraction runs once per row, not once per adapter-row
+  pair — and stays gather-free, recompile-free, static-shaped.
 - The stacks ride ``params["layers"]`` as extra pytree leaves
   (``lora_wq_a``, ...), so the cache/attention/quantization machinery of
   the decode path needs no signature change — only ``sel`` threads
   through (models/generate.py), exactly like the per-slot sampler knobs.
+
+The N-vs-K cost model — why the batcher serves a GATHERED stack:
+
+Per token per target, the sel-fold costs ``2·d_in·R·S + 2·R·d_out·S``
+MACs for a stack of size S (the two einsums that compress the stacks to
+this row's factors), plus ``2·d_in·R + 2·R·d_out`` for the delta itself;
+the base projection costs ``2·d_in·d_out``. With S = N (every REGISTERED
+adapter) that fold scales with the registry: at N=256, R=16,
+d_in=d_out=4096 the fold alone is ~4x the base matmul — and the full
+``(L, N, d_in, R)`` stacks occupy HBM the paged KV pool just freed. But
+a batch can only ever reference ``n_slots`` DISTINCT adapters at once,
+so the batcher gathers the ≤K batch-active adapters into compact
+``(L, K, d_in, R)`` device stacks (K static, default ``n_slots``) and
+remaps ``sel`` to ``(B, K)``: per-step cost scales with the ACTIVE set,
+never the registry, and XLA sees the same static shapes — the TPU-native
+analogue of S-LoRA/Punica's grouped-GEMV dispatch. One-hot selection
+makes the two paths BIT-identical: every non-selected term of the fold
+is an exact ±0.0 product, so the K-term contraction and the N-term
+contraction accumulate the same values in the same per-row order.
+
+:class:`AdapterStore` is the gather source: hundreds of adapters
+register HOST-side (padded, pre-scaled numpy blocks); an LRU-resident
+subset lives in HBM under a byte budget; the batcher re-gathers only
+when admission/retirement changes the active set (models/batching.py
+``_ensure_gathered`` — steady-state decode keeps zero per-step H2D),
+and a residency miss uploads off the engine thread while admission
+defers, exactly like paged-pool pressure.
 
 The reference daemon has no serving stack (SURVEY §2); this extends the
 framework's serving surface (models/batching.py, serving/server.py).
@@ -33,6 +59,9 @@ framework's serving surface (models/batching.py, serving/server.py).
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -132,6 +161,418 @@ def attach_adapters(params: dict, adapters: AdapterSet) -> dict:
     """Base params + stacked adapters -> serving params (new layers dict;
     the base pytree is not mutated)."""
     return {**params, "layers": {**params["layers"], **adapters.leaves}}
+
+
+def _pad_factor_blocks(cfg, t, ab, scale, rank_cap):
+    """One adapter's training-shaped factors for target ``t`` -> the
+    padded, pre-scaled (L, d_in, rank_cap)/(L, rank_cap, d_out) host
+    blocks — the SAME ops (jnp dtype casts, f32 scale bake, zero pad)
+    stack_adapters runs per adapter, so a store-registered adapter's
+    blocks are bitwise the dense stack's slice for that index."""
+    r = ab["a"].shape[-1]
+    if r > rank_cap:
+        raise ValueError(
+            f"adapter rank {r} exceeds the store's rank cap {rank_cap} "
+            f"(fixed by the first registration; compact stacks are "
+            f"static-shaped)"
+        )
+    a = jnp.asarray(ab["a"], cfg.dtype)
+    b = (jnp.asarray(ab["b"], jnp.float32) * scale).astype(cfg.dtype)
+    if r < rank_cap:
+        a = jnp.pad(a, ((0, 0), (0, 0), (0, rank_cap - r)))
+        b = jnp.pad(b, ((0, 0), (0, rank_cap - r), (0, 0)))
+    return np.asarray(a), np.asarray(b)
+
+
+class AdapterStore:
+    """Host-side adapter registry + LRU HBM residency: the gather source
+    for O(active) batched LoRA (module docstring, "N-vs-K cost model").
+
+    Registered adapters live as padded, pre-scaled numpy blocks —
+    ``(L, d_in, rank_cap)`` / ``(L, rank_cap, d_out)`` per target, the
+    per-index slices of what :func:`stack_adapters` would build — so the
+    registry scales with host RAM, not HBM. A subset is RESIDENT on
+    device under ``cache_bytes`` (0 = unlimited: everything uploads at
+    bind/register time), LRU-ordered by use; the batcher's admission
+    gate calls :meth:`ensure_resident`, and a miss starts the upload on
+    a daemon thread (``device_put`` releases the GIL) while the request
+    defers at the queue head — the engine hot loop never blocks on H2D.
+
+    Registry indices are STABLE: :meth:`unregister` tombstones (the
+    name frees, the index never remaps), because live prefix-cache
+    entries, router counts and in-flight requests all key on the index.
+    Target set and rank cap freeze at the first registration — the
+    compact device stacks the batcher swaps under ``params`` must keep
+    one static shape, or every active-set change would recompile.
+
+    Thread model: the engine thread owns registration and gathering;
+    upload threads touch only ``_resident``/``_inflight``/counters under
+    ``_lock``; :meth:`stats` snapshots for HTTP readers.
+    """
+
+    #: bounded upload-latency ring for the p99 the serve row reports
+    _UPLOAD_RING = 512
+
+    def __init__(self, cfg: LlamaConfig, *, cache_bytes: int = 0):
+        if cache_bytes < 0:
+            raise ValueError(f"cache_bytes must be >= 0, got {cache_bytes}")
+        self.cfg = cfg
+        self.cache_bytes = int(cache_bytes)
+        self.rank_cap: "int | None" = None     # frozen at first register
+        self._targets: "tuple[str, ...] | None" = None
+        self._dims: dict[str, tuple[int, int]] = {}  # target -> (d_in, d_out)
+        self.adapter_bytes = 0        # per-adapter HBM cost (uniform: padded)
+        self._names: list = []        # index -> name | None (tombstone)
+        self._index: dict[str, int] = {}
+        self._host: dict[int, dict[str, np.ndarray]] = {}  # owner: engine
+        self._resident: "OrderedDict[int, dict]" = OrderedDict()
+        self._inflight: set[int] = set()
+        self._protected: frozenset = frozenset()  # batch-active: never evict
+        self._lock = threading.Lock()
+        self._dev = None              # device placement fn, bound by batcher
+        self._zero_dev: "dict | None" = None   # K-padding blocks, lazy
+        self.metrics = None
+        # counters (under _lock where the upload thread writes them)
+        self.uploads = 0
+        self.evictions = 0
+        self.misses = 0
+        self.unregistered = 0
+        self.over_budget_events = 0
+        self._upload_ms: list[float] = []
+
+    # --- registry (engine thread) -----------------------------------------
+
+    @property
+    def n_registered(self) -> int:
+        return sum(1 for n in self._names if n is not None)
+
+    @property
+    def names_tuple(self) -> tuple:
+        """Positional names for the batcher's ``adapter_names`` surface:
+        tombstones render as "" so live indices never shift (and a
+        server-side name lookup can never resolve to a dead slot)."""
+        return tuple(n if n is not None else "" for n in self._names)
+
+    def index_of(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            raise KeyError(
+                f"unknown adapter {name!r}; registered: "
+                f"{[n for n in self._names if n is not None]}"
+            )
+        return idx
+
+    def is_registered(self, idx: int) -> bool:
+        return 0 <= idx < len(self._names) and self._names[idx] is not None
+
+    def register(self, name: str, lora_params: dict, lcfg) -> int:
+        """Add one adapter (training-shaped factors) -> its index.
+        First registration freezes the target set, rank cap and dims;
+        later adapters must fit inside them (absent targets become zero
+        blocks, lower ranks zero-pad — exactly stack_adapters' rules)."""
+        if not name:
+            raise ValueError("adapter name must be non-empty")
+        if name in self._index:
+            raise ValueError(f"adapter {name!r} is already registered")
+        if self.cfg.is_moe:
+            bad = sorted(set(lora_params) & {"w1", "w2", "w3"})
+            if bad:
+                raise ValueError(
+                    f"adapter {name!r} targets MoE mlp projections {bad}; "
+                    "serving-side LoRA on MoE is attention-only"
+                )
+        if self._targets is None:
+            self._targets = tuple(sorted(
+                lora_params, key=_ALL_TARGETS.index
+            ))
+            if not self._targets:
+                raise ValueError(f"adapter {name!r} carries no targets")
+            self.rank_cap = max(
+                int(ab["a"].shape[-1]) for ab in lora_params.values()
+            )
+            for t in self._targets:
+                ab = lora_params[t]
+                self._dims[t] = (int(ab["a"].shape[1]),
+                                 int(ab["b"].shape[2]))
+        else:
+            extra = sorted(set(lora_params) - set(self._targets))
+            if extra:
+                raise ValueError(
+                    f"adapter {name!r} targets {extra} outside the "
+                    f"store's frozen set {list(self._targets)} (fixed at "
+                    "first registration; the compact device stacks are "
+                    "static-shaped)"
+                )
+        blocks: dict[str, np.ndarray] = {}
+        L = self.cfg.n_layers
+        for t in self._targets:
+            d_in, d_out = self._dims[t]
+            ab = lora_params.get(t)
+            if ab is None:
+                a = np.zeros((L, d_in, self.rank_cap),
+                             np.asarray(jnp.zeros((), self.cfg.dtype)).dtype)
+                b = np.zeros((L, self.rank_cap, d_out), a.dtype)
+            else:
+                if (int(ab["a"].shape[1]), int(ab["b"].shape[2])) != \
+                        (d_in, d_out):
+                    raise ValueError(
+                        f"adapter {name!r} target {t!r} dims "
+                        f"{ab['a'].shape[1]}x{ab['b'].shape[2]} != the "
+                        f"store's {d_in}x{d_out}"
+                    )
+                a, b = _pad_factor_blocks(self.cfg, t, ab, lcfg.scale,
+                                          self.rank_cap)
+            blocks[f"lora_{t}_a"] = a
+            blocks[f"lora_{t}_b"] = b
+        return self._register_blocks(name, blocks)
+
+    def _register_blocks(self, name: str, blocks: dict) -> int:
+        if not self.adapter_bytes:
+            self.adapter_bytes = sum(a.nbytes for a in blocks.values())
+        idx = len(self._names)
+        self._names.append(name)
+        self._index[name] = idx
+        self._host[idx] = blocks
+        # unlimited budget (or room to spare) + a bound device: resident
+        # immediately — a sync upload at REGISTER time is control-plane
+        # work, not hot-path work
+        if self._dev is not None and (
+            self.cache_bytes == 0
+            or (len(self._resident) + 1) * self.adapter_bytes
+            <= self.cache_bytes
+        ):
+            self.make_resident(idx)
+        self._report_residency()
+        return idx
+
+    @classmethod
+    def from_set(cls, cfg: LlamaConfig, adapters: AdapterSet,
+                 *, cache_bytes: int = 0) -> "AdapterStore":
+        """An AdapterSet's per-index slices -> a store (bitwise the same
+        blocks the dense stacks hold, so gathered-vs-dense bit-identity
+        holds by construction)."""
+        store = cls(cfg, cache_bytes=cache_bytes)
+        leaves = {k: np.asarray(v) for k, v in adapters.leaves.items()}
+        targets = tuple(sorted(
+            {k[len("lora_"):-2] for k in leaves},
+            key=_ALL_TARGETS.index,
+        ))
+        store._targets = targets
+        store.rank_cap = int(leaves[f"lora_{targets[0]}_a"].shape[-1])
+        for t in targets:
+            store._dims[t] = (
+                int(leaves[f"lora_{t}_a"].shape[2]),
+                int(leaves[f"lora_{t}_b"].shape[3]),
+            )
+        for i, name in enumerate(adapters.names):
+            store._register_blocks(
+                name, {k: v[:, i] for k, v in leaves.items()}
+            )
+        return store
+
+    def unregister(self, name: str) -> int:
+        """Tombstone ``name``: host blocks and any device residency drop,
+        the index stays burned (stable ids — see class docstring). The
+        batcher wraps this to also evict the adapter's prefix-cache
+        root and refuse while requests for it are live."""
+        idx = self.index_of(name)
+        self._names[idx] = None
+        del self._index[name]
+        self._host.pop(idx, None)
+        with self._lock:
+            if idx in self._protected:
+                raise RuntimeError(
+                    f"adapter {name!r} is batch-active; the batcher gate "
+                    "should have refused this unregister"
+                )
+            self._resident.pop(idx, None)
+            self.unregistered += 1
+        self._report_residency()
+        return idx
+
+    # --- residency --------------------------------------------------------
+
+    def bind(self, dev, metrics=None) -> None:
+        """The consuming batcher hands over its device-placement fn
+        (``_dev``: jnp.asarray at tp=1, mesh replication at tp>1) and
+        metrics sink, then the store warms: uploads in registration
+        order until the budget (or the registry) is exhausted."""
+        self._dev = dev
+        self.metrics = metrics
+        budget = (self.cache_bytes // self.adapter_bytes
+                  if self.cache_bytes and self.adapter_bytes
+                  else len(self._names))
+        for idx, name in enumerate(self._names):
+            if name is None or len(self._resident) >= budget:
+                continue
+            self.make_resident(idx)
+        self._report_residency()
+
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._resident)
+
+    def is_resident(self, idx: int) -> bool:
+        with self._lock:
+            return idx in self._resident
+
+    def make_resident(self, idx: int) -> None:
+        """SYNCHRONOUS upload — register/bind/control-plane only (the
+        admission path goes through :meth:`ensure_resident`)."""
+        host = self._host.get(idx)
+        if host is None:
+            raise KeyError(f"adapter index {idx} is not registered")
+        with self._lock:
+            if idx in self._resident:
+                self._resident.move_to_end(idx)
+                return
+        self._upload(idx, host)
+
+    def ensure_resident(self, idx: int) -> bool:
+        """Admission gate: True = resident (touched), False = a miss —
+        the upload is now in flight on a daemon thread and the caller
+        should DEFER the request (re-polling next pass), never wait."""
+        host = self._host.get(idx)
+        if host is None:
+            raise KeyError(f"adapter index {idx} is not registered")
+        with self._lock:
+            if idx in self._resident:
+                self._resident.move_to_end(idx)
+                return True
+            if idx in self._inflight:
+                return False
+            self._inflight.add(idx)
+            self.misses += 1
+        threading.Thread(
+            target=self._upload, args=(idx, host, True),
+            name=f"adapter-upload-{idx}", daemon=True,
+        ).start()
+        return False
+
+    def _upload(self, idx: int, host: dict, async_: bool = False) -> None:
+        try:
+            t0 = time.perf_counter()
+            blocks = {k: self._dev(jnp.asarray(v)) for k, v in host.items()}
+            jax.block_until_ready(list(blocks.values()))
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self._inflight.discard(idx)
+                self._resident[idx] = blocks
+                self._resident.move_to_end(idx)
+                self.uploads += 1
+                self._upload_ms.append(ms)
+                del self._upload_ms[:-self._UPLOAD_RING]
+                self._evict_to_budget_locked(keep=idx)
+            if self.metrics is not None:
+                hook = getattr(self.metrics, "on_adapter_upload", None)
+                if hook is not None:
+                    hook(ms / 1e3)
+            self._report_residency()
+        except BaseException:
+            with self._lock:
+                self._inflight.discard(idx)
+            if async_:
+                # a failed async upload surfaces as the request deferring
+                # again (the next ensure_resident retries); swallowing the
+                # raise keeps the daemon thread from killing the process
+                import traceback
+                traceback.print_exc()
+            else:
+                raise
+
+    def _evict_to_budget_locked(self, keep: int) -> None:
+        """LRU-evict residents until the byte budget holds; batch-active
+        (protected) adapters and ``keep`` are exempt. If the exempt set
+        ALONE overflows the budget, residency soft-exceeds (counted) —
+        evicting an adapter the batch is decoding with would stall it."""
+        if not self.cache_bytes or not self.adapter_bytes:
+            return
+        cap = max(1, self.cache_bytes // self.adapter_bytes)
+        while len(self._resident) > cap:
+            victim = next(
+                (i for i in self._resident
+                 if i != keep and i not in self._protected),
+                None,
+            )
+            if victim is None:
+                self.over_budget_events += 1
+                return
+            del self._resident[victim]
+            self.evictions += 1
+
+    # --- gather (engine thread) -------------------------------------------
+
+    def gather(self, active: tuple, k: int) -> dict:
+        """Compact ``(L, K, ...)`` device stacks holding ``active``'s
+        adapters in tuple order, zero-padded to ``k`` slots — the leaves
+        the batcher swaps under ``params["layers"]``. Every adapter in
+        ``active`` must already be resident (the admission gate
+        guarantees it). Marks ``active`` protected from LRU eviction."""
+        if len(active) > k:
+            raise ValueError(
+                f"{len(active)} active adapters exceed lora_slots={k}"
+            )
+        if self._zero_dev is None:
+            zeros: dict = {}
+            L = self.cfg.n_layers
+            for t in self._targets:
+                d_in, d_out = self._dims[t]
+                zeros[f"lora_{t}_a"] = self._dev(
+                    jnp.zeros((L, d_in, self.rank_cap), self.cfg.dtype)
+                )
+                zeros[f"lora_{t}_b"] = self._dev(
+                    jnp.zeros((L, self.rank_cap, d_out), self.cfg.dtype)
+                )
+            self._zero_dev = zeros
+        with self._lock:
+            missing = [i for i in active if i not in self._resident]
+            if missing:
+                raise RuntimeError(
+                    f"gather of non-resident adapters {missing}: the "
+                    "admission gate must ensure_resident first"
+                )
+            rows = [self._resident[i] for i in active]
+            for i in active:
+                self._resident.move_to_end(i)
+            self._protected = frozenset(active)
+        leaves = {}
+        for name, zero in self._zero_dev.items():
+            blocks = [r[name] for r in rows]
+            blocks.extend([zero] * (k - len(blocks)))
+            leaves[name] = jnp.stack(blocks, axis=1)
+        return leaves
+
+    # --- observability ----------------------------------------------------
+
+    def _report_residency(self) -> None:
+        if self.metrics is None:
+            return
+        hook = getattr(self.metrics, "set_adapter_residency", None)
+        if hook is not None:
+            with self._lock:
+                resident = len(self._resident)
+            hook(self.n_registered, resident,
+                 resident * self.adapter_bytes)
+
+    def stats(self) -> dict:
+        """Snapshot for /v1/health and the serve row (cross-thread
+        safe: plain numbers copied under the lock)."""
+        with self._lock:
+            ms = sorted(self._upload_ms)
+            p99 = ms[max(0, int(len(ms) * 0.99) - 1)] if ms else 0.0
+            return {
+                "registered": self.n_registered,
+                "resident": len(self._resident),
+                "resident_bytes": len(self._resident) * self.adapter_bytes,
+                "cache_bytes": self.cache_bytes,
+                "adapter_bytes": self.adapter_bytes,
+                "uploads": self.uploads,
+                "upload_ms_p99": round(p99, 3),
+                "evictions": self.evictions,
+                "misses": self.misses,
+                "unregistered": self.unregistered,
+                "over_budget_events": self.over_budget_events,
+            }
 
 
 def one_hot_sel(adapter: int, n: int) -> np.ndarray:

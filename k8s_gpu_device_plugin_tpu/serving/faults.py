@@ -24,6 +24,11 @@ Fault points (the seams they live at):
                     thread — the canonical mid-decode engine crash
 ``prefix.promote``  prefix-cache promotion (``_maybe_promote_prefix``):
                     raises on the engine thread after a finished prefill
+``adapter.upload``  the adapter-residency admission gate
+                    (``_admit_adapter``): a fired fault reads as an
+                    adapter HBM upload still in flight — the admission
+                    defers head-of-line exactly like a real residency
+                    miss, and retries next step
 ``health.handler``  the replica's ``GET /v1/health``: answers 500 — a
                     live socket over a lying health surface (what the
                     router's poller must survive)
@@ -87,6 +92,7 @@ KNOWN_POINTS = (
     "prefill.dispatch",
     "decode.apply",
     "prefix.promote",
+    "adapter.upload",
     "health.handler",
     "router.connect",
     "router.midstream",

@@ -374,7 +374,11 @@ class _OpenAIRoutes:
         )
 
     async def models(self, request: web.Request) -> web.Response:
-        ids = (MODEL_ID,) + self._server.adapter_names
+        # tombstoned (unregistered) adapter slots render "" — dead
+        # indices stay stable, but a dead name must not be listed
+        ids = (MODEL_ID,) + tuple(
+            n for n in self._server.adapter_names if n
+        )
         return web.json_response({
             "object": "list",
             "data": [{

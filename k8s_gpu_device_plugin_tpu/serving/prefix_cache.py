@@ -370,6 +370,49 @@ class PrefixCache:
         self.stats.resident_bytes = 0
         self._report_residency()
 
+    def evict_adapter(self, adapter: int) -> int:
+        """Drop one adapter's ENTIRE root — every entry and every
+        hit-counting node under it — returning the entry count evicted.
+        The unregister path (``ContinuousBatcher.unregister_adapter``):
+        an unregistered adapter's index can never match again, so its
+        cached K/V is dead weight that would otherwise LEAK until LRU
+        pressure happened to reach it. Entries release through the same
+        hook/accounting as LRU eviction (paged entries' pages return to
+        the pool); a no-op (0) when the adapter never promoted."""
+        root = self._roots.pop(adapter, None)
+        if root is None:
+            return 0
+        evicted = nodes = 0
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            nodes += 1
+            stack.extend(node.children.values())
+            if node.entry is None:
+                continue
+            freed = node.entry_bytes
+            if self.release_entry is not None:
+                self.release_entry(node.entry)
+            node.entry = None
+            node.entry_bytes = 0
+            self._lru.pop(node, None)
+            evicted += 1
+            self.stats.evictions += 1
+            self.stats.entries -= 1
+            self.stats.resident_bytes -= freed
+            if self.metrics is not None:
+                on_evict = getattr(self.metrics, "on_prefix_evict", None)
+                if on_evict is not None:
+                    on_evict(freed)
+        self.stats.nodes -= nodes
+        self._report_residency()
+        if self._tracer.enabled:
+            self._tracer.span(
+                "prefix_evict_adapter", component="prefix_cache",
+                adapter=adapter, entries=evicted, nodes=nodes,
+            ).end()
+        return evicted
+
     def evict_one(self) -> bool:
         """Evict the least-recently-used entry; False when the cache is
         already empty. The paged batcher's pool-pressure relief valve:

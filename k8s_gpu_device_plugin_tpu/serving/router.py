@@ -357,6 +357,13 @@ class ReplicaRouter:
         # aggregates) and their /debug/allocations journals join
         # /fleet/events with plane="plugin". None/empty leaves both
         # surfaces byte-identical to the replica-only fleet.
+        adapter_names: "tuple[str, ...] | None" = None,  # LoRA adapters
+        # the replicas serve (--loraAdapters there, --adapterNames
+        # here): a request selecting a LISTED adapter folds its name
+        # into the affinity key, so one adapter's traffic concentrates
+        # on the replica(s) already holding its stacks HBM-resident —
+        # prefix affinity one level up. Unlisted/base requests keep the
+        # pre-adapter key byte-identical.
     ):
         if policy not in ("affinity", "rr"):
             raise ValueError(
@@ -392,6 +399,8 @@ class ReplicaRouter:
 
             prompt_buckets = DEFAULT_PROMPT_BUCKETS
         self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.adapter_names = frozenset(adapter_names or ())
+        self._adapter_requests: dict[str, int] = {}  # listed-name tally
         self.load_factor = float(load_factor)
         self.health_interval_s = float(health_interval_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -715,6 +724,43 @@ class ReplicaRouter:
         return None  # embeddings: no KV reuse — balance only
 
     @staticmethod
+    def _adapter_source(path: str, body) -> "str | None":
+        """The adapter-selecting field of each surface: the native
+        ``"adapter"`` name, or the OpenAI ``"model"`` when it names
+        something other than the base model. None = base-model request
+        (or a surface with no adapter notion — embeddings)."""
+        if not isinstance(body, dict):
+            return None
+        if path == "/v1/generate":
+            name = body.get("adapter")
+            return name if isinstance(name, str) and name else None
+        if path in ("/v1/completions", "/v1/chat/completions"):
+            from k8s_gpu_device_plugin_tpu.serving.openai_api import MODEL_ID
+
+            name = body.get("model")
+            if isinstance(name, str) and name and name != MODEL_ID:
+                return name
+        return None
+
+    def _fold_adapter(self, path: str, body, key: "bytes | None"):
+        """Prefold a LISTED adapter's name onto the affinity key: the
+        adapter's stacks are HBM state exactly like a promoted prefix,
+        so its traffic should concentrate where they already live (a
+        gather on every other replica re-uploads nothing, but a MISS
+        costs an H2D upload + a head-of-line deferral). The fold
+        prefixes rather than replaces — requests on one adapter still
+        spread by prompt prefix once they share a home neighborhood.
+        Unlisted or base-model requests return ``key`` unchanged (the
+        byte-identical pin the fleet A/B rests on)."""
+        name = self._adapter_source(path, body)
+        if name is None or name not in self.adapter_names:
+            return key
+        self._adapter_requests[name] = (
+            self._adapter_requests.get(name, 0) + 1
+        )
+        return b"a:" + name.encode() + b"\x00" + (key or b"")
+
+    @staticmethod
     def _resumable_body(path: str, body) -> bool:
         """Which streams can carry a recovery journal: the native SSE
         surface with a token-id prompt and n=1 — exactly what the
@@ -865,6 +911,7 @@ class ReplicaRouter:
         key = affinity_key(
             self._affinity_source(request.path, body), self.prompt_buckets
         )
+        key = self._fold_adapter(request.path, body, key)
         # disaggregated prefill/decode (--roles): journaled long-prompt
         # streams take the prefill-worker leg (relay until the first
         # token, export the KV pages, resubmit to a decode worker);
@@ -1934,6 +1981,7 @@ class ReplicaRouter:
             "policy": self.policy,
             "requests": self._requests,
             "affinity_hits": self._affinity_hits,
+            "adapter_requests": dict(self._adapter_requests),
             "failovers": self._failovers,
             "promotions": self._promotions,
             "resumes": self._resumes,
@@ -2480,6 +2528,14 @@ def _main(argv: list[str] | None = None) -> int:
                         "replicas' effective ladder — custom buckets or "
                         "a small --maxLen trimming it — or affinity "
                         "keys cut where no cache ever promotes")
+    parser.add_argument("--adapterNames", default="",
+                        help="comma list of LoRA adapter names the "
+                        "replicas serve (--loraAdapters there): a "
+                        "request selecting a listed adapter folds it "
+                        "into the affinity key so the adapter's "
+                        "traffic lands where its stacks are already "
+                        "HBM-resident; unlisted/base requests route "
+                        "exactly as without this flag")
     parser.add_argument("--headerTimeoutS", type=float, default=300.0,
                         help="bound the header phase of a dispatch so a "
                         "wedged replica (socket accepts, never answers) "
@@ -2596,6 +2652,9 @@ def _main(argv: list[str] | None = None) -> int:
         roles=args.roles or None,
         disagg_min_prompt=args.disaggMinPrompt,
         plugins=plugins,
+        adapter_names=tuple(
+            n.strip() for n in args.adapterNames.split(",") if n.strip()
+        ),
     )
 
     async def serve():

@@ -76,7 +76,7 @@ class SchedulerOverloadError(RuntimeError):
 
     def __init__(self, message: str, reason: str, retry_after: int):
         super().__init__(message)
-        self.reason = reason          # queue_full | defer_budget
+        self.reason = reason   # queue_full | defer_budget | adapter_quota
         self.retry_after = int(retry_after)
 
 
@@ -145,6 +145,81 @@ def parse_tenant_quotas(spec: str) -> dict[str, TenantQuota]:
     return out
 
 
+def parse_adapter_quotas(spec: str) -> dict[str, TenantQuota]:
+    """``--adapterQuota`` value -> {adapter name: TenantQuota}.
+
+    Syntax: ``name=rate[:burst=B],...`` — rate in tokens/s charged per
+    request (prompt + budgeted output, the same cost model as tenant
+    quotas), burst defaults to 4x rate. Unlike tenant quotas these are
+    HARD limits enforced at submit under every policy (fifo included):
+    an adapter is a model variant, not a payer — there is no fairness
+    ledger to demote against, so over-quota is a 429, not a demotion.
+    Weight is not accepted: adapters never join the WFQ ordering."""
+    out: dict[str, TenantQuota] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"--adapterQuota entry {entry!r}: expected "
+                "name=rate[:burst=B]"
+            )
+        name, rest = entry.split("=", 1)
+        name = name.strip()
+        if not name:
+            raise ValueError(
+                f"--adapterQuota entry {entry!r}: empty adapter name"
+            )
+        parts = rest.split(":")
+        try:
+            rate = float(parts[0])
+        except ValueError:
+            raise ValueError(
+                f"--adapterQuota entry {entry!r}: rate must be a number"
+            ) from None
+        burst = None
+        for p in parts[1:]:
+            if p.startswith("burst="):
+                burst = float(p[len("burst="):])
+            else:
+                raise ValueError(
+                    f"--adapterQuota entry {entry!r}: unknown option {p!r}"
+                )
+        if rate <= 0 or (burst is not None and burst < 0):
+            raise ValueError(
+                f"--adapterQuota entry {entry!r}: rate must be > 0 and "
+                "burst >= 0 (omit the entry to leave an adapter unmetered)"
+            )
+        out[name] = TenantQuota(
+            rate=rate,
+            burst=burst if burst is not None else 4.0 * rate,
+        )
+    return out
+
+
+class _AdapterState:
+    """Per-adapter token bucket: the hard-reject ledger. Slimmer than
+    ``_TenantState`` on purpose — adapters carry no WFQ identity, no
+    deadlines, no goodput; just a bucket and the submit/reject tally."""
+
+    __slots__ = ("quota", "level", "last_refill", "submitted", "rejected")
+
+    def __init__(self, quota: TenantQuota, now: float):
+        self.quota = quota
+        self.level = quota.burst        # bucket starts full
+        self.last_refill = now
+        self.submitted = 0
+        self.rejected = 0
+
+    def refill(self, now: float) -> None:
+        self.level = min(
+            self.quota.burst,
+            self.level + (now - self.last_refill) * self.quota.rate,
+        )
+        self.last_refill = now
+
+
 class _TenantState:
     """Per-tenant ledger: token bucket, WFQ virtual time, tallies."""
 
@@ -199,6 +274,7 @@ class Scheduler:
         max_queue: int = 0,
         defer_budget_ms: int = 0,
         quotas: "dict[str, TenantQuota] | None" = None,
+        adapter_quotas: "dict[str, TenantQuota] | None" = None,
     ):
         if max_queue < 0:
             raise ValueError(f"max_queue must be >= 0, got {max_queue}")
@@ -209,6 +285,14 @@ class Scheduler:
         self.max_queue = int(max_queue)          # immutable after init
         self.defer_budget_s = defer_budget_ms / 1000.0  # immutable
         self.quotas = dict(quotas or {})         # immutable after init
+        # hard per-adapter rate limits (parse_adapter_quotas); enforced
+        # under EVERY policy — an adapter quota is capacity protection,
+        # not fairness, so fifo enforces it too
+        self.adapter_quotas = dict(adapter_quotas or {})  # immutable
+        self._adapters: dict[str, _AdapterState] = {}  # owner: engine
+        # rid -> (adapter name, cost) charged but not yet admitted
+        # (refunded if the request dies while still queued)
+        self._adapter_queued_cost: dict[int, tuple] = {}  # owner: engine
         self._tenants: dict[str, _TenantState] = {}  # owner: engine
         # rid -> quota tokens charged but not yet admitted (refunded if
         # the request dies while still queued)
@@ -229,7 +313,9 @@ class Scheduler:
         # 429 bursts from losing increments. defer_budget increments
         # ride the engine thread but share the dict, so they lock too.
         self._rej_lock = threading.Lock()
-        self.rejections = {"queue_full": 0, "defer_budget": 0}
+        self.rejections = {
+            "queue_full": 0, "defer_budget": 0, "adapter_quota": 0,
+        }
         self._tracer = get_tracer()
 
     # --- shared helpers ---------------------------------------------------
@@ -286,11 +372,46 @@ class Scheduler:
 
     # --- engine-thread seam (called by ContinuousBatcher) -----------------
 
+    def _charge_adapter(self, req, cb, now: float) -> None:
+        """Hard per-adapter token-bucket gate: raises 429 when the
+        request's adapter is quota'd and its bucket cannot cover the
+        cost. Runs BEFORE the tenant charge so a rejected request never
+        touches the tenant ledger (nothing to refund)."""
+        if not self.adapter_quotas or getattr(req, "adapter", -1) < 0:
+            return
+        names = getattr(cb, "adapter_names", ())
+        name = names[req.adapter] if req.adapter < len(names) else ""
+        quota = self.adapter_quotas.get(name) if name else None
+        if quota is None:
+            return
+        st = self._adapters.get(name)
+        if st is None:
+            st = self._adapters[name] = _AdapterState(quota, now)
+        st.refill(now)
+        st.submitted += 1
+        cost = self.request_cost(req)
+        if st.level < cost:
+            st.rejected += 1
+            with self._rej_lock:
+                self.rejections["adapter_quota"] += 1
+            if cb.metrics is not None:
+                count = getattr(cb.metrics, "on_sched_rejected", None)
+                if count is not None:
+                    count("adapter_quota")
+            raise SchedulerOverloadError(
+                f"adapter {name!r} is over its request-rate quota "
+                f"({quota.rate:g} tokens/s); retry later",
+                reason="adapter_quota", retry_after=self.retry_after_s(),
+            )
+        st.level -= cost
+        self._adapter_queued_cost[req.rid] = (name, cost)
+
     def on_submit(self, req, cb) -> None:
         """Admission control + quota charge at enqueue time. Raising
         here leaves the batcher untouched (the request never queues)."""
         self.check_capacity(len(cb.pending))
         now = time.perf_counter()
+        self._charge_adapter(req, cb, now)
         ts = self._tenant(req.tenant, now)
         ts.refill(now)
         self._refloor_vtime(ts)
@@ -342,6 +463,7 @@ class Scheduler:
         ts = self._tenant(req.tenant, now)
         ts.refill(now)
         self._queued_cost.pop(req.rid, None)  # charge becomes final
+        self._adapter_queued_cost.pop(req.rid, None)  # ditto
         self._defer_t0.pop(req.rid, None)
         if req.preemptions or getattr(req, "restarts", 0):
             # a RESUMED request (preemption eviction, or an engine-crash
@@ -385,6 +507,13 @@ class Scheduler:
             # the charged work never ran — give it back
             ts.refill(now)
             ts.level = min(ts.quota.burst, ts.level + cost)
+        acharge = self._adapter_queued_cost.pop(req.rid, None)
+        if acharge is not None:
+            aname, acost = acharge
+            ast = self._adapters.get(aname)
+            if ast is not None:
+                ast.refill(now)
+                ast.level = min(ast.quota.burst, ast.level + acost)
         if reason == "rejected":
             ts.rejected += 1
             with self._rej_lock:
@@ -452,6 +581,14 @@ class Scheduler:
                 "quota_level": round(ts.level, 1),
                 "weight": ts.quota.weight,
             }
+        adapters = {}
+        for name, ast in list(self._adapters.items()):
+            adapters[name] = {
+                "submitted": ast.submitted,
+                "rejected": ast.rejected,
+                "quota_rate": ast.quota.rate,
+                "quota_level": round(ast.level, 1),
+            }
         with self._rej_lock:
             rejections = dict(self.rejections)
         return {
@@ -462,6 +599,7 @@ class Scheduler:
             "rejections": rejections,
             "step_ewma_ms": round(self._ewma_step_s * 1000.0, 3),
             "tenants": tenants,
+            "adapters": adapters,
         }
 
 
@@ -478,9 +616,10 @@ class SloScheduler(Scheduler):
         defer_budget_ms: int = 0,
         quotas: "dict[str, TenantQuota] | None" = None,
         preempt: bool = True,
+        adapter_quotas: "dict[str, TenantQuota] | None" = None,
     ):
         super().__init__(max_queue=max_queue, defer_budget_ms=defer_budget_ms,
-                         quotas=quotas)
+                         quotas=quotas, adapter_quotas=adapter_quotas)
         self.preempt_enabled = bool(preempt)  # immutable after init
 
     def plan(self, cb, now: float) -> tuple[list, "int | None"]:
@@ -548,10 +687,14 @@ def make_scheduler(
     defer_budget_ms: int = 0,
     tenant_quota: str = "",
     preempt: bool = True,
+    adapter_quota: str = "",
 ) -> Scheduler:
     """``--schedPolicy`` & friends -> a Scheduler (the server edge's one
     construction site; bench and tests may build policies directly)."""
     quotas = parse_tenant_quotas(tenant_quota)
+    # adapter quotas are hard limits, not ordering — every policy
+    # enforces them (unlike --tenantQuota, which fifo refuses)
+    aquotas = parse_adapter_quotas(adapter_quota)
     if policy == "fifo":
         if quotas:
             raise ValueError(
@@ -560,10 +703,12 @@ def make_scheduler(
                 "would look like enforcement)"
             )
         return Scheduler(max_queue=max_queue,
-                         defer_budget_ms=defer_budget_ms)
+                         defer_budget_ms=defer_budget_ms,
+                         adapter_quotas=aquotas)
     if policy == "slo":
         return SloScheduler(max_queue=max_queue,
                             defer_budget_ms=defer_budget_ms,
-                            quotas=quotas, preempt=preempt)
+                            quotas=quotas, preempt=preempt,
+                            adapter_quotas=aquotas)
     raise ValueError(f"unknown scheduling policy {policy!r} "
                      "(expected 'fifo' or 'slo')")

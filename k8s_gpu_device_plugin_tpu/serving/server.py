@@ -127,7 +127,10 @@ class InferenceEngine:
         prompt_buckets: "tuple[int, ...] | None" = None,  # None = default
         metrics=None,
         batcher: ContinuousBatcher | None = None,
-        adapters=None,  # lora_serving.AdapterSet (multi-LoRA serving)
+        adapters=None,  # lora_serving.AdapterSet | AdapterStore
+        adapter_cache_mb: int = 0,  # >0 = gathered multi-LoRA with an
+        # LRU HBM residency budget (lora_serving.AdapterStore); 0 with
+        # an AdapterSet keeps unlimited residency (all adapters resident)
         pipeline_depth: int = 1,
         trace_steps: bool = False,
         prefix_cache=None,  # serving.prefix_cache.PrefixCache (or None)
@@ -150,6 +153,12 @@ class InferenceEngine:
             raise ValueError(
                 "pass adapters to the injected batcher's own constructor; "
                 "silently ignoring them here would 404 every adapter request"
+            )
+        if batcher is not None and adapter_cache_mb:
+            raise ValueError(
+                "pass adapter_cache_mb to the injected batcher's own "
+                "constructor; silently ignoring it here would hold every "
+                "adapter resident while reporting an LRU budget"
             )
         if batcher is not None and prefix_cache is not None:
             raise ValueError(
@@ -232,7 +241,8 @@ class InferenceEngine:
                     params, cfg, n_slots=n_slots, max_len=max_len,
                     sampler=sampler, eos_id=eos_id,
                     chunked_prefill=min(chunked_prefill, max_len),
-                    metrics=metrics, adapters=adapters, **buckets_kw,
+                    metrics=metrics, adapters=adapters,
+                    adapter_cache_mb=adapter_cache_mb, **buckets_kw,
                     pipeline_depth=pipeline_depth, trace_steps=trace_steps,
                     prefix_cache=prefix_cache,
                     kv_layout=kv_layout, kv_page_size=kv_page_size,
@@ -467,6 +477,12 @@ class InferenceEngine:
             # speculative acceptance (rounds, drafted/accepted tokens,
             # acceptance rate) — the production view of gamma's health
             out["spec"] = spec_stats()
+        adapter_stats = getattr(self.cb, "adapter_stats", None)
+        if adapter_stats is not None and getattr(self.cb, "n_adapters", 0):
+            # multi-LoRA residency view (registered vs HBM-resident,
+            # gathers, deferrals, upload p99) — snapshot-built by the
+            # batcher/store, same contract as kv_stats
+            out["adapters"] = adapter_stats()
         sched = getattr(self.cb, "scheduler", None)
         if sched is not None:
             # queue + per-tenant SLO view (policy, quota levels,
@@ -904,11 +920,6 @@ class InferenceServer:
         # encode(str)->ids / decode(ids)->str. The engine itself stays
         # token-ids only; text is translated at the HTTP boundary.
         self.tokenizer = tokenizer
-        # adapter name -> stacked index (multi-LoRA serving); both APIs
-        # resolve names here and submit indices
-        self.adapter_names: tuple[str, ...] = tuple(
-            getattr(engine.cb, "adapter_names", ())
-        )
         self.tracer = get_tracer()
         # chip attribution (device/allocation.py): frozen at startup, so
         # the extra span attrs are a precomputed dict — {} costs the hot
@@ -948,6 +959,15 @@ class InferenceServer:
 
         add_openai_routes(self)
 
+    @property
+    def adapter_names(self) -> tuple:
+        """Adapter name -> stacked index (multi-LoRA serving); both
+        APIs resolve names here and submit indices. A LIVE read of the
+        batcher's registry — dynamic registration (AdapterStore) must
+        surface new names without a server restart; tombstoned slots
+        render "" and resolve nowhere."""
+        return tuple(getattr(self.engine.cb, "adapter_names", ()))
+
     def resolve_adapter(self, name) -> int:
         """Adapter name -> index; None/empty -> base (-1). Raises
         ValueError for unknown names (the request is malformed, not a
@@ -956,12 +976,13 @@ class InferenceServer:
             return -1
         if not isinstance(name, str):
             raise ValueError("adapter must be a string name")
+        names = self.adapter_names
         try:
-            return self.adapter_names.index(name)
+            return names.index(name)
         except ValueError:
             raise ValueError(
                 f"unknown adapter {name!r}; serving: "
-                f"{list(self.adapter_names) or '(none)'}"
+                f"{[n for n in names if n] or '(none)'}"
             ) from None
 
     def replica_label(self) -> str:
@@ -1684,6 +1705,18 @@ def _main(argv: list[str] | None = None) -> int:
                         help="multi-LoRA serving: name=ckptdir[:alpha=X]"
                         ",... — requests select by name ('adapter' field "
                         "on /v1/generate; 'model' on the OpenAI API)")
+    parser.add_argument("--adapterCacheMB", type=int, default=0,
+                        help="multi-LoRA HBM residency budget in MB "
+                        "(models/lora_serving.AdapterStore): adapters "
+                        "past the budget stay host-side and upload on "
+                        "demand, LRU-evicting idle ones; 0 = every "
+                        "registered adapter stays resident")
+    parser.add_argument("--adapterQuota", default="",
+                        help="per-adapter hard rate limits: "
+                        "name=rate[:burst=B],... (tokens/s of prompt + "
+                        "budgeted output; burst defaults to 4x rate). "
+                        "Enforced under every --schedPolicy — over-"
+                        "quota submits 429 with Retry-After")
     parser.add_argument("--tokenizer", default="",
                         help="text seam: 'byte' (UTF-8 bytes, lossless) or "
                         "a local HF tokenizer directory; empty = token-id "
@@ -1912,6 +1945,13 @@ def _main(argv: list[str] | None = None) -> int:
                 "draft model has no adapter stacks to mirror the target's"
             )
         adapters = load_adapters(cfg, args.loraAdapters)
+    if args.adapterCacheMB and not args.loraAdapters:
+        raise SystemExit(
+            "--adapterCacheMB needs --loraAdapters: an HBM residency "
+            "budget with no adapters to hold would silently do nothing"
+        )
+    if args.adapterCacheMB < 0:
+        raise SystemExit("--adapterCacheMB must be >= 0")
 
     # /v1/embeddings: the hidden-state forward is the training-path
     # matmul, incompatible with decode-path quantized weight leaves.
@@ -1999,6 +2039,7 @@ def _main(argv: list[str] | None = None) -> int:
             # slo policy still orders/quotas it (documented, not silent:
             # the health endpoint reports the policy either way)
             preempt=not args.draftPreset,
+            adapter_quota=args.adapterQuota,
         )
     except ValueError as e:
         raise SystemExit(str(e)) from None
@@ -2080,6 +2121,7 @@ def _main(argv: list[str] | None = None) -> int:
         sampler=sampler, eos_id=eos_id,
         chunked_prefill=args.chunkedPrefill, metrics=metrics,
         batcher=batcher, adapters=adapters,
+        adapter_cache_mb=args.adapterCacheMB,
         pipeline_depth=args.pipelineDepth,
         trace_steps=args.traceSteps and args.tracing,
         prefix_cache=None if batcher is not None else prefix_cache,

@@ -26,6 +26,16 @@ class Batcher:
         pages = jax.device_put(self._page_table_np, self._sharding)
         return self.step(pages)
 
+    def _gather_adapters_step(self, sel):  # graftlint: hot-path
+        # BAD: re-uploading the gathered (L, K, d_in, R) LoRA stacks
+        # per decode step — the gathered multi-LoRA path commits the
+        # compact stacks at admission time (the sel-rebuild seam,
+        # _ensure_gathered) and steady-state decode reads the cached
+        # device residents; a per-step upload of the adapter blocks
+        # would dwarf the step dispatch itself
+        stacks = jax.device_put(self._adapter_host_blocks)
+        return self.step(stacks, sel)
+
 
 def serving_cache_attention(q, k, v, length, table):  # graftlint: hot-path=traced
     # the unified-kernel dispatch seam is TRACED (it runs inside the

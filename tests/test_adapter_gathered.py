@@ -1,0 +1,449 @@
+"""Gathered O(active) multi-LoRA serving (ROADMAP 3b): the compact
+(L, K, ...) stacks, AdapterStore residency, and the admission gate.
+
+The load-bearing pin: the GATHERED path (K compact slots, sel remapped
+to stack positions) produces token AND logprob streams bit-identical to
+the dense-N path (every adapter resident, sel over registry indices) —
+the gather is an exact copy and the one-hot contraction makes
+non-selected fold terms exact ±0.0, so K-vs-N is a cost choice, never a
+numerics choice. Pinned across the serving composition matrix (paged
+KV, int8 cache, tensor parallel, pipelined decode) and under seeded
+sampling, not just greedy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.lora import (
+    LoraConfig,
+    init_lora_params,
+    merge_lora,
+)
+from k8s_gpu_device_plugin_tpu.models.lora_serving import (
+    AdapterStore,
+    stack_adapters,
+)
+from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
+
+
+def _rand_b(lp, seed):
+    out = {}
+    for i, (t, ab) in enumerate(sorted(lp.items())):
+        k = jax.random.fold_in(jax.random.key(seed), i)
+        out[t] = {
+            "a": ab["a"],
+            "b": 0.3 * jax.random.normal(k, ab["b"].shape, ab["b"].dtype),
+        }
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    lcs = [
+        LoraConfig(rank=4, alpha=8.0, targets=("wq", "wo", "w2")),
+        LoraConfig(rank=8, alpha=16.0),
+        LoraConfig(rank=2, alpha=4.0, targets=("wq", "wk")),
+    ]
+    lps = [
+        _rand_b(init_lora_params(jax.random.key(i + 1), cfg, lc), 20 + i)
+        for i, lc in enumerate(lcs)
+    ]
+    entries = [(f"ad{i}", lp, lc) for i, (lp, lc) in enumerate(zip(lps, lcs))]
+    aset = stack_adapters(cfg, entries)
+    merged = {-1: params}
+    for i, (lp, lc) in enumerate(zip(lps, lcs)):
+        merged[i] = merge_lora(params, lp, lc)
+    return cfg, params, aset, merged, entries
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+# --- the composition matrix: gathered ≡ dense-N, bitwise -----------------
+
+
+MATRIX = [
+    # (kv_layout, cache_quant, tp, pipeline_depth).  The tier-1 gate runs
+    # the rows that together touch every axis value (dense/paged,
+    # bf16/int8, tp 1/2, pipeline 0/1); the remaining cross-combos carry
+    # the slow mark so the full matrix still runs outside -m 'not slow'
+    # without blowing the gate's wall-clock budget on duplicate compiles.
+    ("dense", None, 1, 1),
+    ("dense", None, 1, 0),
+    pytest.param("paged", None, 1, 1, marks=pytest.mark.slow),
+    pytest.param("paged", "int8", 1, 1, marks=pytest.mark.slow),
+    pytest.param("dense", None, 2, 1, marks=pytest.mark.slow),
+    ("paged", "int8", 2, 0),
+]
+
+
+def _mk(params, cfg, aset, *, gathered, kv_layout, tp, pipeline):
+    return ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+        adapters=aset, pipeline_depth=pipeline, tp=tp,
+        kv_layout=kv_layout,
+        kv_page_size=16 if kv_layout == "paged" else None,
+        # lora_slots=0 keeps the legacy dense-N attach (every adapter in
+        # the stacks, sel over registry indices) — the baseline arm
+        lora_slots=None if gathered else 0,
+    )
+
+
+@pytest.mark.parametrize("kv_layout,cache_quant,tp,pipeline", MATRIX)
+def test_gathered_matches_dense_across_matrix(
+    setup, kv_layout, cache_quant, tp, pipeline
+):
+    """Both arms serve the same mixed batch — greedy adapter rows, a
+    SEEDED sampled adapter row, and a base row — and the token + logprob
+    streams must match bitwise, combo by combo."""
+    from dataclasses import replace
+
+    cfg, params, aset, merged, _ = setup
+    if cache_quant:
+        cfg = replace(cfg, cache_quant=cache_quant)
+    streams = {}
+    for arm in ("dense", "gathered"):
+        cb = _mk(params, cfg, aset, gathered=arm == "gathered",
+                 kv_layout=kv_layout, tp=tp, pipeline=pipeline)
+        rids = {}
+        # 3 requests over 2 slots: the queued third admits as a slot
+        # frees, changing the active set mid-run (a re-gather on the
+        # gathered arm — the dense arm never re-gathers; identity must
+        # survive the swap)
+        rids["a0"] = cb.submit(_prompt(300, 6, cfg), max_new=8, adapter=0)
+        rids["a1s"] = cb.submit(
+            _prompt(301, 5, cfg), max_new=6, adapter=1,
+            sampler=Sampler(temperature=0.9, top_k=12), seed=7,
+        )
+        rids["base"] = cb.submit(_prompt(302, 4, cfg), max_new=5)
+        done = cb.run()
+        streams[arm] = {
+            k: (done[r], cb.done_requests[r].out_logp)
+            for k, r in rids.items()
+        }
+        if arm == "gathered":
+            st = cb.adapter_stats()
+            assert st["mode"] == "gathered"
+            assert st["gathers"] >= 1
+    for k in streams["dense"]:
+        dtoks, dlogp = streams["dense"][k]
+        gtoks, glogp = streams["gathered"][k]
+        assert gtoks == dtoks, f"{k}: token stream diverged"
+        assert glogp == dlogp, f"{k}: logprob stream diverged"
+    # oracle anchor (the dense arm is itself pinned elsewhere, but keep
+    # the matrix honest against merged weights on the greedy row)
+    if tp == 1:
+        assert streams["gathered"]["a0"][0] == _oracle(
+            merged[0], _prompt(300, 6, cfg), cfg, 8
+        )
+
+
+# --- K-overflow: more distinct adapters than compact slots ----------------
+
+
+def test_k_overflow_defers_then_serves_exactly(setup):
+    """3 distinct adapters over K=2 compact slots: the third request
+    defers head-of-line (adapter_slots) until a holder retires, then
+    serves bit-exact — nothing is dropped, nothing is wrong."""
+    cfg, params, aset, merged, _ = setup
+    cb = ContinuousBatcher(params, cfg, n_slots=3, max_len=64,
+                           chunked_prefill=8, adapters=aset, lora_slots=2)
+    want, rids = {}, {}
+    for a, seed in ((0, 310), (1, 311), (2, 312)):
+        p = _prompt(seed, 5, cfg)
+        rids[a] = cb.submit(p, max_new=6, adapter=a)
+        want[a] = _oracle(merged[a], p, cfg, 6)
+    done = cb.run()
+    for a, rid in rids.items():
+        assert done[rid] == want[a], f"adapter {a}"
+    st = cb.adapter_stats()
+    assert st["deferrals"].get("adapter_slots", 0) >= 1
+    assert st["lora_slots"] == 2 and st["registered"] == 3
+
+
+# --- residency-miss deferral (fault-injected) + cancel-while-deferred ----
+
+
+def test_residency_miss_defers_and_stream_is_baseline_exact(setup):
+    """An injected adapter.upload fault reads as an in-flight HBM
+    upload: the admission defers once, retries next pass, and the
+    stream is bit-identical to the unfaulted baseline."""
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    cfg, params, aset, merged, _ = setup
+    p = _prompt(320, 6, cfg)
+    base = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                             chunked_prefill=8, adapters=aset, lora_slots=2)
+    rid = base.submit(p, max_new=6, adapter=1)
+    want = base.run()[rid]
+    assert want == _oracle(merged[1], p, cfg, 6)
+
+    plane = FaultPlane.from_spec("adapter.upload:nth=1")
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           chunked_prefill=8, adapters=aset, lora_slots=2,
+                           faults=plane)
+    rid = cb.submit(p, max_new=6, adapter=1)
+    done = cb.run()
+    assert done[rid] == want
+    st = cb.adapter_stats()
+    assert st["deferrals"].get("adapter_miss", 0) == 1
+    assert plane.point("adapter.upload").fired == 1
+
+
+def test_cancel_while_deferred_leaves_store_clean(setup):
+    """A request cancelled while adapter-deferred (holding NO pages, NO
+    slot) must vanish without a trace: the next request's stream is
+    bit-identical to a batcher that never saw the cancelled one."""
+    from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+    cfg, params, aset, merged, _ = setup
+    p2 = _prompt(331, 5, cfg)
+    base = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                             chunked_prefill=8, adapters=aset, lora_slots=2)
+    base_rid = base.submit(p2, max_new=6, adapter=0)
+    want = base.run()[base_rid]
+
+    # every hit fires for a while: the first request stays deferred
+    plane = FaultPlane.from_spec("adapter.upload:p=1.0:seed=1:times=4")
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           chunked_prefill=8, adapters=aset, lora_slots=2,
+                           faults=plane)
+    rid1 = cb.submit(_prompt(330, 5, cfg), max_new=6, adapter=2)
+    cb.run(max_steps=2)
+    assert rid1 in {r.rid for r in cb.pending}  # still deferred, queued
+    assert cb.adapter_stats()["deferrals"].get("adapter_miss", 0) == 1
+    assert cb.cancel(rid1)
+    rid2 = cb.submit(p2, max_new=6, adapter=0)
+    done = cb.run()
+    assert done[rid2] == want == _oracle(merged[0], p2, cfg, 6)
+    assert not cb.pending and not cb.running and not cb.prefilling
+
+
+# --- AdapterStore: LRU residency under a budget ---------------------------
+
+
+def test_lru_residency_budget_evicts_and_stays_exact(setup):
+    """A budget of ONE adapter's bytes: serving 0 -> 1 -> 2 serially
+    uploads on miss and LRU-evicts idle adapters; every stream stays
+    oracle-exact (residency is a cost knob, not a numerics knob)."""
+    cfg, params, aset, merged, entries = setup
+    store = AdapterStore.from_set(cfg, aset, cache_bytes=1)
+    # cache_bytes=1 < adapter_bytes: the soft-floor budget keeps exactly
+    # the batch-protected + newest adapter resident
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=64,
+                           chunked_prefill=8, adapters=store, lora_slots=1)
+    for a, seed in ((0, 340), (1, 341), (2, 342)):
+        p = _prompt(seed, 5, cfg)
+        rid = cb.submit(p, max_new=6, adapter=a)
+        done = cb.run()
+        assert done[rid] == _oracle(merged[a], p, cfg, 6), f"adapter {a}"
+    st = cb.adapter_stats()
+    assert st["evictions"] >= 1
+    assert st["uploads"] >= 3
+    assert st["resident"] <= 2  # protected + at most the newest upload
+    assert st["deferrals"].get("adapter_miss", 0) >= 1  # async upload wait
+
+
+# --- dynamic registration / unregistration --------------------------------
+
+
+def test_dynamic_register_serve_unregister(setup):
+    """Register at runtime, serve oracle-exact, unregister: the index
+    tombstones (submit rejects it loudly), /v1/models-style name lists
+    drop it, and re-registration appends a fresh index."""
+    cfg, params, _, merged, entries = setup
+    # the store's target set freezes at FIRST registration (the compact
+    # stacks are static-shaped): seed it with the widest adapter (ad1's
+    # default wq/wk/wv/wo) so narrower ones (ad2: wq/wk) nest
+    name1, lp1, lc1 = entries[1]
+    name2, lp2, lc2 = entries[2]
+    store = AdapterStore(cfg)
+    store.register(name1, lp1, lc1)
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           chunked_prefill=8, adapters=store, lora_slots=2)
+    idx2 = cb.register_adapter(name2, lp2, lc2)
+    assert idx2 == 1 and cb.adapter_names == (name1, name2)
+    p = _prompt(350, 5, cfg)
+    rid = cb.submit(p, max_new=6, adapter=idx2)
+    assert cb.run()[rid] == _oracle(merged[2], p, cfg, 6)
+
+    assert cb.unregister_adapter(name2) == idx2
+    assert cb.adapter_names == (name1, "")  # tombstone renders ""
+    with pytest.raises(ValueError, match="unregistered"):
+        cb.submit(p, max_new=4, adapter=idx2)
+    # indices are stable forever: a new adapter appends, never reuses
+    lc9 = LoraConfig(rank=2, alpha=4.0, targets=("wv",))
+    lp9 = _rand_b(init_lora_params(jax.random.key(9), cfg, lc9), 99)
+    assert cb.register_adapter("ad9", lp9, lc9) == 2
+
+
+def test_unregister_refuses_live_and_evicts_prefix_root(setup):
+    """Unregistering an adapter with live requests refuses; after they
+    drain, unregistration evicts the adapter's whole prefix-cache
+    subtree (its rows can never match again)."""
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    cfg, params, aset, merged, entries = setup
+    pc = PrefixCache(cfg, buckets=(8, 16), budget_bytes=1 << 26)
+    store = AdapterStore.from_set(cfg, aset)
+    cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                           prompt_buckets=(8, 16), chunked_prefill=8,
+                           adapters=store, lora_slots=2, prefix_cache=pc)
+    sys_prompt = _prompt(360, 12, cfg)
+    rid = cb.submit(sys_prompt + _prompt(361, 4, cfg), max_new=4, adapter=2)
+    with pytest.raises(ValueError, match="live requests"):
+        cb.unregister_adapter("ad2")
+    cb.run()
+    assert rid in cb.done
+    base_entries = pc.stats.as_dict()["entries"]
+    assert base_entries >= 1  # the finished prefill promoted rows
+    cb.unregister_adapter("ad2")
+    after = pc.stats.as_dict()
+    assert after["entries"] < base_entries  # adapter-2 subtree gone
+    assert after["evictions"] >= 1
+
+
+# --- per-adapter hard quotas (serving/scheduler.py) -----------------------
+
+
+def test_adapter_quota_hard_rejects_and_refunds():
+    """The --adapterQuota token bucket: over-quota submits raise the
+    429-mapped overload error under BOTH policies; a queued death
+    refunds its charge; base/unmetered adapters never touch a bucket."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import (
+        SchedulerOverloadError,
+        make_scheduler,
+        parse_adapter_quotas,
+    )
+
+    assert parse_adapter_quotas("") == {}
+    q = parse_adapter_quotas("fr=100,de=50:burst=60")
+    assert q["fr"].burst == 400.0 and q["de"].burst == 60.0
+    for bad in ("noeq", "x=0", "x=1:weight=2", "=5"):
+        with pytest.raises(ValueError):
+            parse_adapter_quotas(bad)
+    with pytest.raises(ValueError):
+        make_scheduler("fifo", tenant_quota="t=5")  # fifo refuses tenant
+    # ...but adapter quotas are capacity protection: fifo enforces them
+
+    class Req:
+        def __init__(self, rid, adapter, n=10):
+            self.rid, self.adapter = rid, adapter
+            self.prompt = [1] * n
+            self.max_new = 10
+            self.tenant, self.priority = "default", 1
+            self.deadline, self.out = None, []
+            self.preemptions, self.t_submit, self.span = 0, 0.0, None
+
+    class CB:
+        pending: list = []
+        metrics = None
+        adapter_names = ("fr", "de")
+
+    import time as _time
+
+    for policy in ("fifo", "slo"):
+        s = make_scheduler(policy, adapter_quota="fr=1:burst=30")
+        cb = CB()
+        s.on_submit(Req(1, 0), cb)  # cost 20 of burst 30
+        with pytest.raises(SchedulerOverloadError) as ei:
+            s.on_submit(Req(2, 0), cb)
+        assert ei.value.reason == "adapter_quota"
+        assert ei.value.retry_after >= 1
+        s.on_submit(Req(3, -1), cb)   # base: no bucket
+        s.on_submit(Req(4, 1), cb)    # unmetered adapter: no bucket
+        # queued death refunds -> the next submit passes again
+        s.on_retired(Req(1, 0), cb, "cancelled", _time.perf_counter())
+        s.on_submit(Req(5, 0), cb)
+        st = s.sched_stats()
+        assert st["adapters"]["fr"]["rejected"] == 1
+        assert st["adapters"]["fr"]["submitted"] == 3
+        assert st["rejections"]["adapter_quota"] == 1
+
+
+# --- router affinity fold --------------------------------------------------
+
+
+def test_router_folds_listed_adapters_only():
+    """Both surfaces extract the adapter; LISTED names prefix-fold the
+    affinity key (and count on /fleet/health); unlisted/base requests
+    keep the pre-adapter key byte-identical."""
+    from k8s_gpu_device_plugin_tpu.serving.fleet import (
+        FleetRegistry,
+        affinity_key,
+    )
+    from k8s_gpu_device_plugin_tpu.serving.router import ReplicaRouter
+
+    fleet = FleetRegistry.from_spec("http://a:1,http://b:2")
+    r = ReplicaRouter(fleet, adapter_names=("fr", "de"))
+    bk = affinity_key([1] * 40, r.prompt_buckets)
+
+    assert r._fold_adapter(
+        "/v1/generate", {"prompt": [1] * 40, "adapter": "fr"}, bk
+    ) == b"a:fr\x00" + bk
+    assert r._fold_adapter(
+        "/v1/chat/completions", {"model": "de", "messages": []}, bk
+    ) == b"a:de\x00" + bk
+    # keyless adapter request still concentrates on a home
+    assert r._fold_adapter("/v1/generate", {"adapter": "fr"}, None) \
+        == b"a:fr\x00"
+    # byte-identical pins: unlisted name, base model id, bare request
+    for body in ({"prompt": [1] * 40, "adapter": "xx"},
+                 {"prompt": [1] * 40},
+                 {"model": "tpu-serving"}):
+        assert r._fold_adapter("/v1/generate", body, bk) == bk
+    assert r.router_stats()["adapter_requests"] == {"fr": 2, "de": 1}
+
+    # a router constructed without names is a no-op on every request
+    r2 = ReplicaRouter(FleetRegistry.from_spec("http://a:1"))
+    assert r2._fold_adapter(
+        "/v1/generate", {"prompt": [1] * 40, "adapter": "fr"}, bk
+    ) == bk
+    assert r2.router_stats()["adapter_requests"] == {}
+
+
+# --- metrics hooks ---------------------------------------------------------
+
+
+def test_adapter_metrics_surface(setup):
+    """The ServingMetrics adapter section: residency gauges track the
+    store, deferral/upload counters fire through the duck-typed hooks
+    the batcher and store call."""
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    cfg, params, aset, merged, _ = setup
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    try:
+        cb = ContinuousBatcher(params, cfg, n_slots=2, max_len=64,
+                               chunked_prefill=8, adapters=aset,
+                               lora_slots=2, metrics=m)
+        p = _prompt(370, 5, cfg)
+        rid = cb.submit(p, max_new=5, adapter=0)
+        assert cb.run()[rid] == _oracle(merged[0], p, cfg, 5)
+        assert reg.get_sample_value("tpu_serving_adapters_registered") == 3
+        assert reg.get_sample_value("tpu_serving_adapters_resident") == 3
+        assert reg.get_sample_value("tpu_serving_adapter_resident_bytes") > 0
+        assert reg.get_sample_value("tpu_serving_adapter_gathers_total") >= 1
+    finally:
+        m.close()

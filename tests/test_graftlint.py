@@ -95,6 +95,15 @@ def test_hot_path_flags_transfer_and_carry():
         if v.symbol == "serving_cache_attention"
     ]
     assert {v.key for v in traced_disp} == {"jnp.asarray"}
+    # the adapter-gather seam: a per-step upload of the compact LoRA
+    # stacks inside a registered hot path fires (the ok twin's cached-
+    # resident read + unmarked _ensure_gathered regather stay silent,
+    # covered by test_checker_silent_on_ok_fixture)
+    gather = [
+        v for v in _run_on(bad, [_checker("hot-path-h2d")])
+        if v.symbol.endswith("_gather_adapters_step")
+    ]
+    assert {v.key for v in gather} == {"jax.device_put"}
 
 
 def test_thread_ownership_allows_atomic_len():

@@ -109,9 +109,11 @@ def test_adapter_prefix_compatibility(setup):
                            chunked_prefill=8, adapters=aset)
     sys_prompt = _prompt(70, 9, cfg)
     suffix = _prompt(71, 4, cfg)
-    # prefix prefilled UNDER adapter 0 (the batcher's params carry stacks)
-    prefix = precompute_prefix(cb.params, sys_prompt, cfg,
-                               adapter=0, n_adapters=aset.n)
+    # prefix prefilled UNDER adapter 0: the batcher method gathers the
+    # adapter into the compact stacks and remaps sel (under gathered
+    # serving cb.params' stack POSITION differs from the registry index,
+    # so the module-level call would prefill the wrong rows)
+    prefix = cb.precompute_shared_prefix(sys_prompt, adapter=0)
     rid = cb.submit(suffix, max_new=6, prefix=prefix, adapter=0)
     done = cb.run()
     assert done[rid] == _oracle(merged[0], sys_prompt + suffix, cfg, 6)
