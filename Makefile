@@ -69,7 +69,8 @@ san-test:
 ci: lint analyze native native-test san-test bench-host-overhead \
 	bench-prefix-cache bench-paged-kv bench-quant-paged bench-spec \
 	bench-sched bench-tp bench-obs bench-kernels bench-router \
-	bench-adapters bench-disagg bench-chaos bench-fleet-obs bench-chip-obs
+	bench-adapters bench-disagg bench-chaos bench-fleet-obs bench-chip-obs \
+	bench-longctx
 	python -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -227,6 +228,17 @@ bench-fleet-obs:
 bench-chip-obs:
 	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.chip_obs_bench
 
+# CPU-runnable smoke: long-context serving (sliding-window attention +
+# streaming chunk-prefill over the page pool) — the windowed unified
+# kernel (dense AND paged, decode and prefill-chunk T) pinned against
+# the plain-softmax gather oracle in interpret mode, an O(window)
+# footprint assertion (windowed peak pages obey the admission bound and
+# undercut the full-causal twin, with out-of-window pages recycled),
+# and the serve_bench longctx_ab arm end to end (one JSON line with
+# window_parity_max_err_* + the longctx_* serve-row fields).
+bench-longctx:
+	JAX_PLATFORMS=cpu python -m k8s_gpu_device_plugin_tpu.benchmark.workloads.longctx_bench
+
 clean:
 	$(MAKE) -C $(NATIVE_DIR) clean
 
@@ -234,7 +246,7 @@ clean:
 	bench-host-overhead bench-prefix-cache bench-paged-kv \
 	bench-quant-paged bench-spec bench-sched bench-tp bench-obs \
 	bench-kernels bench-router bench-adapters bench-disagg bench-chaos \
-	bench-fleet-obs bench-chip-obs clean watch
+	bench-fleet-obs bench-chip-obs bench-longctx clean watch
 
 # unattended hardware-window capture: probe on a loop, drain the harvest
 # queue the moment the chip answers (tools/watchdog.py; stop with

@@ -1,0 +1,213 @@
+"""Long-context serving microbench (CPU-runnable; ``make bench-longctx``).
+
+Long prompts change the serving cost model twice over (ISSUE 20 /
+ROADMAP 5(b)): sliding-window attention bounds the KV span every query
+reads (arXiv:2310.06825), and streaming chunk-prefill bounds the pages
+a prompt HOLDS while it prefills — reservation grows with the cursor
+and out-of-window pages recycle, so a windowed row's steady-state
+footprint is O(window), not O(prompt). Three CPU-checkable claims:
+
+- **kernel parity**: the unified ragged-paged kernel's windowed
+  DMA-clamped path (dense AND paged mode, decode and prefill-chunk T)
+  matches the plain-softmax gather oracle in interpret mode;
+- **O(window) footprint**: a long windowed prompt's peak page usage
+  stays under the admission bound (``_windowed_peak_tokens``) — the
+  assertion FAILS loudly if recycling or incremental reservation
+  regress, it never reports a broken footprint as a number;
+- **the serve A/B**: the same long prompt through the windowed pool vs
+  the full-causal full-reservation twin — TTFT, tokens/s, and the
+  peak-pages pair the serve row reports as ``longctx_*`` fields.
+
+Prints one JSON line, like the host_overhead/paged_kv twins.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
+
+
+def kernel_window_parity(window: int = 24) -> dict:
+    """Windowed kernel (interpret mode) vs the gather oracle: dense and
+    paged mode, decode (T=1) and a prefill chunk (T=8)."""
+    from k8s_gpu_device_plugin_tpu.ops.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    hd, hq, hkv, s, ps = 64, 8, 4, 128, 16
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    k = jax.random.normal(kk, (3, s, hkv, hd), jnp.bfloat16)
+    v = jax.random.normal(kv, (3, s, hkv, hd), jnp.bfloat16)
+    n = 3 * (s // ps)
+    kp = jnp.concatenate(
+        [jnp.zeros((1, ps, hkv, hd), k.dtype), k.reshape(n, ps, hkv, hd)]
+    )
+    vp = jnp.concatenate(
+        [jnp.zeros((1, ps, hkv, hd), v.dtype), v.reshape(n, ps, hkv, hd)]
+    )
+    table = jnp.arange(1, n + 1, dtype=jnp.int32).reshape(3, s // ps)
+
+    def oracle(q, base):
+        b, t = q.shape[:2]
+        g = hq // hkv
+        qg = q.reshape(b, t, hkv, g, hd).astype(jnp.float32)
+        sc = jnp.einsum(
+            "btkgd,bskd->btkgs", qg, k.astype(jnp.float32)
+        ) * hd ** -0.5
+        q_pos = jnp.maximum(
+            base[:, None, None, None, None]
+            + jnp.arange(t)[None, :, None, None, None], 0
+        )
+        k_pos = jnp.arange(s)[None, None, None, None, :]
+        keep = (k_pos <= q_pos) & (q_pos - k_pos < window)
+        p = jax.nn.softmax(jnp.where(keep, sc, -1e30), axis=-1)
+        return jnp.einsum(
+            "btkgs,bskd->btkgd", p, v.astype(jnp.float32)
+        ).reshape(b, t, hq, hd)
+
+    out = {}
+    for mode, t in (("decode", 1), ("prefill", 8)):
+        q = jax.random.normal(jax.random.fold_in(kq, t),
+                              (3, t, hq, hd), jnp.bfloat16)
+        base = jnp.asarray([10, 60, s - t], jnp.int32)
+        want = oracle(q, base)
+        worst = 0.0
+        for pages, kk_, vv_ in ((None, k, v), (table, kp, vp)):
+            got = ragged_paged_attention(
+                q, kk_, vv_, base, pages, scale=hd ** -0.5,
+                window=window, block_k=16, interpret=True,
+            )
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+            assert err < 0.02, (mode, pages is not None, err)
+            worst = max(worst, err)
+        out[f"window_parity_max_err_{mode}"] = round(worst, 5)
+    return out
+
+
+def longctx_serve_ab(
+    cfg: LlamaConfig,
+    params,
+    *,
+    prompt_len: int,
+    window: int,
+    max_new: int = 16,
+    chunk: int = 16,
+    page_size: int = 16,
+    reserve_chunks: int = 2,
+) -> dict:
+    """ONE long prompt served twice through the paged pool: windowed
+    (incremental reservation + recycling) vs the full-causal twin with
+    the classic up-front reservation. Returns the ``longctx_*`` serve
+    row fields; the O(window) footprint claim is ASSERTED here."""
+    from dataclasses import replace
+
+    from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+
+    max_len = -(-(prompt_len + max_new) // page_size) * page_size
+    n_pages = -(-(prompt_len + max_new) // page_size) + 2
+    prompt = jax.random.randint(
+        jax.random.key(7), (prompt_len,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+    def run(sliding_window: int) -> dict:
+        cb = ContinuousBatcher(
+            params, replace(cfg, sliding_window=sliding_window),
+            n_slots=1, max_len=max_len, chunked_prefill=chunk,
+            kv_layout="paged", kv_page_size=page_size, kv_pages=n_pages,
+            prefill_reserve_chunks=reserve_chunks,
+        )
+        t0 = time.perf_counter()
+        rid = cb.submit(prompt, max_new=max_new)
+        ttft = 0.0
+        steps = 0
+        while rid not in cb.done_requests:
+            cb.step()
+            steps += 1
+            if not ttft and any(
+                r.rid == rid and r.out for r in cb.running.values()
+            ):
+                ttft = (time.perf_counter() - t0) * 1000.0
+            assert steps < 100_000, "longctx serve A/B did not converge"
+        wall = time.perf_counter() - t0
+        assert len(cb.done_requests[rid].out) == max_new
+        cb.pool.check()
+        return {
+            "ttft_ms": ttft or wall * 1000.0,
+            "tps": max_new / wall if wall else 0.0,
+            "peak": cb.pool.peak_in_use,
+            "recycled": cb.pool.recycled_total,
+            "bound_pages": (
+                cb.pool.pages_for_tokens(cb._windowed_peak_tokens(max_new))
+                if sliding_window else 0
+            ),
+        }
+
+    w = run(window)
+    f = run(0)
+    # the tentpole's perf claim, asserted: the windowed peak obeys the
+    # admission bound (O(window + chunk)) and undercuts the full twin
+    assert w["peak"] <= w["bound_pages"], (w["peak"], w["bound_pages"])
+    assert w["peak"] < f["peak"], (w["peak"], f["peak"])
+    assert w["recycled"] > 0, "no out-of-window page ever recycled"
+    return {
+        "longctx_prompt_tokens": prompt_len,
+        "longctx_window": window,
+        "longctx_ttft_ms_windowed": round(w["ttft_ms"], 3),
+        "longctx_ttft_ms_full": round(f["ttft_ms"], 3),
+        "longctx_tokens_per_second_windowed": round(w["tps"], 2),
+        "longctx_tokens_per_second_full": round(f["tps"], 2),
+        "longctx_kv_pages_peak_windowed": w["peak"],
+        "longctx_kv_pages_peak_full": f["peak"],
+        "longctx_kv_saved_pct": round(
+            100.0 * (1.0 - w["peak"] / f["peak"]) if f["peak"] else 0.0, 1
+        ),
+        "longctx_pages_recycled": w["recycled"],
+    }
+
+
+def serve_row_smoke() -> dict:
+    """Exercise the serve_bench integration end to end (the CI canary
+    half): a tiny long-prompt A/B through the ``longctx_ab=True`` arm,
+    reading back the ``longctx_*`` row fields."""
+    from k8s_gpu_device_plugin_tpu.benchmark.workloads.serve_bench import (
+        serve_bench,
+    )
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    r = serve_bench(
+        cfg, n_slots=2, n_requests=2, max_len=128, prompt_lens=(8, 17),
+        max_new=4, prompt_buckets=(16, 32, 64), chunked_prefill=16,
+        decode_ab=False, prefix_ab=False, paged_ab=False, sched_ab=False,
+        kv_page_size=16, longctx_ab=True, longctx_prompt_len=192,
+        longctx_window=32,
+    )
+    assert r.longctx_kv_pages_peak_windowed > 0, "longctx arm did not run"
+    return {
+        "longctx_prompt_tokens": r.longctx_prompt_tokens,
+        "longctx_ttft_ms_windowed": r.longctx_ttft_ms_windowed,
+        "longctx_kv_pages_peak_windowed": r.longctx_kv_pages_peak_windowed,
+        "longctx_kv_pages_peak_full": r.longctx_kv_pages_peak_full,
+        "longctx_kv_saved_pct": r.longctx_kv_saved_pct,
+        "longctx_pages_recycled": r.longctx_pages_recycled,
+    }
+
+
+def longctx_bench() -> dict:
+    out = {"workload": "longctx"}
+    out.update(kernel_window_parity())
+    out.update(serve_row_smoke())
+    return out
+
+
+def main() -> int:
+    print(json.dumps(longctx_bench()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

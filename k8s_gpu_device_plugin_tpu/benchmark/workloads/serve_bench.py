@@ -328,6 +328,26 @@ class ServeBenchResult:
     chaos_fleet_completed: int = 0
     chaos_fleet_rejected: int = 0
     chaos_fleet_retries: int = 0
+    # long-context A/B (``longctx_ab=True``; benchmark/workloads/
+    # longctx_bench.py): ONE prompt of ``longctx_prompt_len`` tokens
+    # served through the paged pool twice — sliding-window
+    # (``longctx_window``; incremental reservation + out-of-window
+    # recycling) vs the full-causal twin with the classic up-front
+    # reservation. TTFT is submit -> first emitted token; the peak pair
+    # is the pool's high-water mark. The O(window) footprint claim is
+    # ASSERTED inside the workload (the bench fails loudly rather than
+    # report a broken footprint as numbers). All zero when
+    # longctx_ab=False.
+    longctx_prompt_tokens: int = 0
+    longctx_window: int = 0
+    longctx_ttft_ms_windowed: float = 0.0
+    longctx_ttft_ms_full: float = 0.0
+    longctx_tokens_per_second_windowed: float = 0.0
+    longctx_tokens_per_second_full: float = 0.0
+    longctx_kv_pages_peak_windowed: int = 0
+    longctx_kv_pages_peak_full: int = 0
+    longctx_kv_saved_pct: float = 0.0
+    longctx_pages_recycled: int = 0
     chaos_fleet_failovers: int = 0
     chaos_fleet_killed_replicas: int = 0
     # the fleet resume tier: mid-stream replica deaths spliced onto the
@@ -1447,6 +1467,9 @@ def serve_bench(
     chaos_ab: bool = False,
     disagg_ab: bool = False,
     tp_ab: bool = False,
+    longctx_ab: bool = False,
+    longctx_prompt_len: int = 32768,
+    longctx_window: int = 4096,
     tp_degree: int = 2,
     sched_base_s: float = 4.0,
     sched_overload_s: float = 4.0,
@@ -1903,6 +1926,29 @@ def serve_bench(
             file=sys.stderr,
         )
 
+    # --- long-context A/B: windowed streaming prefill vs full causal ---
+    longctx_fields: dict = {}
+    if longctx_ab and chunked_prefill:
+        from k8s_gpu_device_plugin_tpu.benchmark.workloads.longctx_bench import (  # noqa: E501
+            longctx_serve_ab,
+        )
+
+        # a sidecar workload like the chaos arm (its own slot/pool):
+        # what it measures is ONE long prompt's admission, TTFT, and
+        # footprint under each attention regime — mixing it into the
+        # main batch would blur the peak-pages attribution
+        longctx_fields = longctx_serve_ab(
+            cfg, params, prompt_len=longctx_prompt_len,
+            window=longctx_window, max_new=max_new,
+            chunk=chunked_prefill, page_size=kv_page_size,
+        )
+    elif longctx_ab:
+        print(
+            "serve_bench: longctx A/B skipped — streaming chunk-prefill "
+            "requires chunked_prefill",
+            file=sys.stderr,
+        )
+
     # --- tensor-parallel sweep A/B: the same workload tp-sharded ---
     tp_fields: dict = {}
     if tp_ab and tp_degree > 1:
@@ -2061,5 +2107,6 @@ def serve_bench(
         **fleet_fields,
         **disagg_fields,
         **chaos_fields,
+        **longctx_fields,
         **tp_fields,
     )

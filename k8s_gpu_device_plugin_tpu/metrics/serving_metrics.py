@@ -134,6 +134,21 @@ class ServingMetrics:
             ["reason"],  # pool_pressure | request_too_large
             registry=registry,
         )
+        self.kv_pages_recycled = Counter(
+            f"{prefix}_kv_pages_recycled_total",
+            "Out-of-window KV pages returned to the pool mid-request "
+            "(sliding-window serving: a page every live row's window "
+            "has slid past frees without waiting for retirement)",
+            registry=registry,
+        )
+        self.prefill_chunks_deferred = Counter(
+            f"{prefix}_prefill_chunks_deferred_total",
+            "Prefill chunks postponed mid-prompt, by reason (incremental "
+            "reservation: pool pressure defers the next chunk, never "
+            "the request)",
+            ["reason"],  # pool_pressure
+            registry=registry,
+        )
         self.kv_reserved_bytes = Gauge(
             f"{prefix}_kv_reserved_bytes",
             "Static HBM held by the KV cache arrays (both layouts)",
@@ -463,6 +478,8 @@ class ServingMetrics:
             self.kv_pages_in_use,
             self.kv_page_fragmentation_pct,
             self.kv_admission_rejected,
+            self.kv_pages_recycled,
+            self.prefill_chunks_deferred,
             self.kv_reserved_bytes,
             self.kv_shard_reserved_bytes,
             self.kv_shard_pages_in_use,
@@ -548,6 +565,12 @@ class ServingMetrics:
 
     def on_kv_admission_rejected(self, reason: str) -> None:
         self.kv_admission_rejected.labels(reason=reason).inc()
+
+    def on_kv_pages_recycled(self, n: int) -> None:
+        self.kv_pages_recycled.inc(n)
+
+    def on_prefill_chunk_deferred(self, reason: str) -> None:
+        self.prefill_chunks_deferred.labels(reason=reason).inc()
 
     def set_kv_reserved_bytes(self, nbytes: int) -> None:
         self.kv_reserved_bytes.set(nbytes)

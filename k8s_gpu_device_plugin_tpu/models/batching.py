@@ -362,6 +362,30 @@ def effective_prefix_reuse(matched: int, prompt_len: int, chunk: int) -> int:
     return (n_chunks(0) - n_chunks(matched)) * chunk
 
 
+class RequestTooLargeError(ValueError):
+    """A request no amount of deferral can ever admit: its worst case
+    outsizes the slot row or the whole page pool. Carries the numbers
+    the refusal was computed from so both HTTP surfaces can serialize a
+    structured ``request_too_large`` body (``{prompt_tokens, max_new,
+    limit}`` — ``limit`` in TOKENS: the largest ``prompt + max_new``
+    this server could ever hold) instead of a bare message."""
+
+    def __init__(self, message: str, *, prompt_tokens: int, max_new: int,
+                 limit: int):
+        super().__init__(message)
+        self.prompt_tokens = int(prompt_tokens)
+        self.max_new = int(max_new)
+        self.limit = int(limit)
+
+    def body(self) -> dict:
+        """The structured fields, serializer-ready."""
+        return {
+            "prompt_tokens": self.prompt_tokens,
+            "max_new": self.max_new,
+            "limit": self.limit,
+        }
+
+
 @dataclass
 class _Request:
     rid: int
@@ -526,6 +550,8 @@ class ContinuousBatcher:
         kv_layout: str | None = None,   # None = take cfg.kv_layout
         kv_page_size: int | None = None,  # None = take cfg.kv_page_size
         kv_pages: int = 0,  # paged pool size; 0 = dense-equivalent HBM
+        prefill_reserve_chunks: int = 2,  # windowed admission: chunks of
+        #   prompt the initial page tranche covers (--prefillReserveChunks)
         scheduler=None,  # serving.scheduler.Scheduler (or None = FIFO)
         tp: int | None = None,  # None = take cfg.tp (1 = single chip)
         attribution=None,  # obs.attribution.RequestAttributor (or None)
@@ -794,6 +820,28 @@ class ContinuousBatcher:
             per_slot = max_len // cfg.kv_page_size
             n_pages = int(kv_pages) if kv_pages > 0 else n_slots * per_slot + 1
             self.pool = PagePool(n_pages, cfg.kv_page_size)
+        # Sliding-window serving (long-context): attn_window > 0 bounds
+        # every row's LIVE cache to its trailing window, so the paged
+        # layout can admit prompts far past the pool's worst-case wall —
+        # admission reserves only the first chunks, _prefill_one_chunk
+        # grows the reservation as the cursor advances, and pages that
+        # fall out of every future query's window recycle back to the
+        # free list (host free-list math only; the windowed kernel's DMA
+        # clamp never reads below the window and the gather masks those
+        # rows to exact-zero weight, so no device cleanup is needed).
+        self.window = int(getattr(cfg, "sliding_window", 0) or 0)
+        self.reserve_chunks = max(1, int(prefill_reserve_chunks))
+        # incremental reservation needs all three legs: a window to bound
+        # the live span, chunked prefill to grow against, and the paged
+        # pool to grow from. The speculative subclass opts out (its
+        # verify window writes gamma rows past the accepted length and
+        # its draft cache has no recycling plumbing).
+        self._incremental_reserve = (
+            self.pool is not None and self.window > 0 and self.chunk > 0
+        )
+        self._pages_recycled = 0  # owner: engine
+        self._chunks_deferred = 0  # owner: engine
+        self._recycle_lo: dict[int, int] = {}  # slot -> first live page idx
         # owner: engine (snapshot via kv_stats() for cross-thread reads)
         self.state = init_batch_state(cfg, n_slots, max_len, seed,
                                       n_pages=n_pages)
@@ -871,7 +919,7 @@ class ContinuousBatcher:
             page_size=cfg.kv_page_size if cfg.kv_layout == "paged" else 0,
             n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
             head_dim=cfg.head_dim, cache_quant=cfg.cache_quant,
-            tp=cfg.tp, chunk=self.chunk,
+            tp=cfg.tp, chunk=self.chunk, window=self.window,
         )
         log = get_logger()
         for mode, plan in self.attn_plan.items():
@@ -970,26 +1018,39 @@ class ContinuousBatcher:
         to the engine thread — an admission error THERE would kill the
         step loop)."""
         if prompt_len + max_new > self.max_len:
-            raise ValueError(
+            raise RequestTooLargeError(
                 f"prompt {prompt_len} + max_new {max_new} exceeds "
-                f"slot capacity {self.max_len}"
+                f"slot capacity {self.max_len}",
+                prompt_tokens=prompt_len, max_new=max_new,
+                limit=self.max_len,
             )
         if self.pool is not None:
             # the paged wall is POOL pressure, not the per-slot ceiling:
             # a request whose worst case outsizes the whole pool can
             # never be admitted and must be refused here (transient
             # pressure defers in _admit instead)
-            need = self.pool.pages_for_tokens(
-                self._kv_need_tokens(prompt_len, max_new)
-            )
+            tokens = self._kv_need_tokens(prompt_len, max_new)
+            if self._incremental_reserve:
+                # windowed rows never hold their whole prompt: the peak
+                # is the trailing window plus the in-flight chunks (or
+                # the decode span), so the wall moves from O(prompt) to
+                # O(window + chunk) — the long-context admission rule
+                tokens = min(tokens, self._windowed_peak_tokens(max_new))
+            need = self.pool.pages_for_tokens(tokens)
             if need > self.pool.capacity:
                 self._count_kv_rejection("request_too_large")
-                raise ValueError(
+                # the token limit the refusal reports: the largest
+                # prompt + max_new THIS pool could ever cover (windowed
+                # admissions are bounded by the peak formula instead,
+                # so their wall is effectively max_len — caught above)
+                raise RequestTooLargeError(
                     f"request needs {need} KV pages (prompt {prompt_len} "
                     f"+ max_new {max_new} @ page_size "
                     f"{self.pool.page_size}) but the pool holds "
                     f"{self.pool.capacity}; raise kv_pages or shrink "
-                    "the request"
+                    "the request",
+                    prompt_tokens=prompt_len, max_new=max_new,
+                    limit=self.pool.capacity * self.pool.page_size,
                 )
         if not self.chunk:
             _bucket(prompt_len, self.buckets)
@@ -1902,6 +1963,57 @@ class ContinuousBatcher:
         accepted length)."""
         return prompt_len + max_new
 
+    def _windowed_peak_tokens(self, max_new: int) -> int:
+        """Upper bound on the token rows one windowed row has LIVE at
+        any moment under incremental reservation: the trailing window,
+        the admission tranche plus one in-flight chunk (recycling lags
+        the cursor by the finish chunk's back-scheduled overlap), the
+        larger of one chunk and the decode span (grown at the finish
+        chunk, recycled down during decode), and two pages of boundary
+        rounding. ``validate`` admits against this bound, so a deferred
+        growth can always eventually succeed — the pool is provably big
+        enough for the peak."""
+        return (
+            self.window
+            + (self.reserve_chunks + 1) * self.chunk
+            + max(self.chunk, max_new)
+            + 2 * self.pool.page_size
+        )
+
+    def _initial_reserve_tokens(self, req: _Request) -> int:
+        """The admission tranche for a windowed request: rows through
+        the first ``reserve_chunks`` prefill chunks past the prefix
+        match (the growth path backs the rest chunk by chunk). Short
+        requests are covered whole — identical to the full reservation."""
+        total = self._kv_need_tokens(
+            len(req.prompt), req.max_new - req.prefilled_out
+        )
+        start = len(req.prefix.tokens) if req.prefix is not None else 0
+        return min(total, start + self.reserve_chunks * self.chunk)
+
+    def _outstanding_growth_pages(self) -> int:
+        """Pages the in-flight windowed prefills may still draw before
+        they peak — virtual headroom new admissions must not eat. Two
+        long prompts admitted into one window's worth of free pages
+        would starve each other forever (only the oldest mid-prefill
+        slot advances, so neither could grow and nothing would retire);
+        keeping the in-flight peaks admissible makes growth deferral
+        transient by construction."""
+        if not self._incremental_reserve:
+            return 0
+        out = 0
+        for slot, req in self.prefilling.items():
+            rem = req.max_new - req.prefilled_out
+            peak = self.pool.pages_for_tokens(min(
+                self._kv_need_tokens(len(req.prompt), rem),
+                self._windowed_peak_tokens(rem),
+            ))
+            backed = sum(
+                1 for p in (self._slot_pages.get(slot) or []) if p
+            )
+            out += max(0, peak - backed)
+        return out
+
     def _reserve_pages(self, req: _Request) -> bool:
         """Pool-pressure check + reservation for one admission: aliased
         prefix pages are already pinned (match time), so only the COW
@@ -1927,13 +2039,26 @@ class ContinuousBatcher:
                 len(req.prompt), req.max_new - req.prefilled_out
             )
         )
+        if self._incremental_reserve and req._kv_wire is None:
+            # windowed streaming prefill: reserve only the admission
+            # tranche — _prefill_one_chunk grows the rest as the cursor
+            # advances and recycling keeps the live span O(window).
+            # (A KV-transfer install keeps the full reservation: its
+            # rows arrive materialized, there is nothing to stream.)
+            total = min(total, self.pool.pages_for_tokens(
+                self._initial_reserve_tokens(req)
+            ))
+        # virtual headroom for in-flight windowed growth: counted
+        # against the free list in every pressure check below, never
+        # allocated here
+        growth = self._outstanding_growth_pages()
         aliased = 0
         if isinstance(req.prefix, PagedPrefixState):
             # full shared pages alias; a partial tail still needs a
             # fresh page (the COW destination), so it stays in ``need``
             aliased = len(req.prefix.tokens) // ps
         need = total - aliased
-        if need > self.pool.free_pages and self.prefix_cache is not None:
+        if need + growth > self.pool.free_pages and self.prefix_cache is not None:
             # Pool pressure: promoted prefixes are reclaimable capacity.
             # Evict LRU entries until the reservation fits or the cache
             # runs dry — otherwise entries pinning the last free pages
@@ -1956,8 +2081,8 @@ class ContinuousBatcher:
             reclaimable = self.pool.in_use - len(held)
             evict_one = getattr(self.prefix_cache, "evict_one", None)
             if (evict_one is not None
-                    and self.pool.free_pages + reclaimable >= need):
-                while need > self.pool.free_pages and evict_one():
+                    and self.pool.free_pages + reclaimable >= need + growth):
+                while need + growth > self.pool.free_pages and evict_one():
                     pass
         if (need > self.pool.free_pages and not self.running
                 and not self.prefilling):
@@ -1983,7 +2108,7 @@ class ContinuousBatcher:
                 if evict_one is not None:
                     while need > self.pool.free_pages and evict_one():
                         pass
-        if need > self.pool.free_pages:
+        if need + growth > self.pool.free_pages:
             if not req.defer_counted:
                 req.defer_counted = True
                 self._count_kv_rejection("pool_pressure")
@@ -2081,6 +2206,111 @@ class ContinuousBatcher:
                 )
         self._report_kv_gauges()
 
+    # --- incremental reservation + out-of-window recycling (windowed) ---
+
+    def _grow_slot_pages(self, slot: int, req: _Request,
+                         upto_tokens: int) -> bool:
+        """Extend ``slot``'s page-table row so positions
+        ``[0, upto_tokens)`` are backed by real pages. The growth half
+        of incremental reservation: host free-list math plus ONE
+        admission-style row upload per chunk — never called from the
+        decode hot path. Returns False on pool pressure (nothing
+        allocated; the caller defers the CHUNK and retries next step —
+        the request keeps its slot, its cursor, and every page grown so
+        far)."""
+        ids = self._slot_pages[slot]
+        grow = self.pool.pages_for_tokens(upto_tokens) - len(ids)
+        if grow <= 0:
+            return True
+        if self._flt_pool_alloc is not None:
+            try:
+                self._flt_pool_alloc.fire()
+            except self._fault_error:
+                # injected TRANSIENT pool pressure mid-prompt: defer the
+                # next chunk exactly like a real exhausted free list
+                self._count_chunk_deferral(req)
+                return False
+        if grow > self.pool.free_pages and self.prefix_cache is not None:
+            # the admission-time pressure valve, mid-prompt: promoted
+            # prefixes are reclaimable capacity
+            evict_one = getattr(self.prefix_cache, "evict_one", None)
+            if evict_one is not None:
+                while grow > self.pool.free_pages and evict_one():
+                    pass
+        if grow > self.pool.free_pages:
+            self._count_chunk_deferral(req)
+            if req.span is not None:
+                with attach(req.span):
+                    get_logger().debug(
+                        "prefill chunk deferred: KV pool pressure",
+                        extra={"fields": {
+                            "rid": req.rid, "need_pages": grow,
+                            "free_pages": self.pool.free_pages,
+                        }},
+                    )
+            return False
+        new = self.pool.alloc(grow)
+        self._slot_pages[slot] = ids = ids + new
+        row = np.zeros((self.state.pages.shape[1],), np.int32)
+        row[: len(ids)] = ids  # recycled entries stay 0 (the trap page)
+        self.state = _set_slot_pages(
+            self.state, jnp.asarray(row), jnp.int32(slot)
+        )
+        if self.tracer.enabled and req.span is not None:
+            self.tracer.span(
+                "page_grow", component="serving", parent=req.span,
+                pages=grow, free=self.pool.free_pages,
+            ).end()
+        self._report_kv_gauges()
+        return True
+
+    def _recycle_slot_pages(self, slot: int, pos: int) -> None:
+        """Free pages no FUTURE query of this row can attend: queries at
+        positions >= ``pos`` reach keys in ``(q - window, q]``, so a
+        page whose last position is <= ``pos - window`` is dead. Pure
+        host free-list math — no device work: the windowed kernel's DMA
+        lo-clamp never fetches blocks below the window, the XLA gather
+        masks those rows to exact-zero softmax weight, and no write ever
+        targets a passed position, so the stale table entries are
+        unreachable by construction (a freed page reallocated to another
+        slot can never be scribbled on or observed through this row)."""
+        if not self._incremental_reserve:
+            return
+        ids = self._slot_pages.get(slot)
+        if not ids:
+            return
+        ps = self.pool.page_size
+        # page k spans [k*ps, (k+1)*ps); dead iff (k+1)*ps <= pos-W+1
+        dead = min(max(0, (pos - self.window + 1) // ps), len(ids))
+        lo = self._recycle_lo.get(slot, 0)
+        if dead <= lo:
+            return
+        batch = []
+        for k in range(lo, dead):
+            p = ids[k]
+            if p:
+                ids[k] = 0
+                batch.append(p)
+        self._recycle_lo[slot] = dead
+        if batch:
+            # pool.recycle reports pages actually FREED — a
+            # prefix-shared page only drops this row's reference and
+            # stays live for its other holders
+            freed = self.pool.recycle(batch)
+            self._pages_recycled += freed
+            if freed and self.metrics is not None:
+                count = getattr(self.metrics, "on_kv_pages_recycled", None)
+                if count is not None:
+                    count(freed)
+            self._report_kv_gauges()
+
+    def _count_chunk_deferral(self, req: _Request) -> None:
+        self._chunks_deferred += 1
+        if self.metrics is not None:
+            count = getattr(self.metrics, "on_prefill_chunk_deferred", None)
+            if count is not None:
+                count("pool_pressure")
+
     # --- KV page transfer (disaggregated prefill/decode) ---
 
     def export_kv_pages(self, rid: int) -> "tuple[dict, list, list]":
@@ -2128,7 +2358,18 @@ class ContinuousBatcher:
             raise KeyError(f"unknown or finished request {rid}")
         valid = len(req.prompt) + len(req.out) - req.prefilled_out - 1
         n = self.pool.pages_for_tokens(valid)
-        ids = jnp.asarray(np.asarray(self._slot_pages[slot][:n], np.int32))
+        ids_host = self._slot_pages[slot][:n]
+        if len(ids_host) < n or any(p == 0 for p in ids_host):
+            # windowed rows recycle out-of-window pages mid-flight: the
+            # early rows no longer exist anywhere, so a full-row export
+            # cannot be assembled — the caller degrades to re-prefill
+            # (the standing hop-failure fallback)
+            raise ValueError(
+                f"request {rid}'s early KV pages were recycled "
+                "(attn_window serving): export cannot ship the full "
+                "row — resume with re-prefill instead"
+            )
+        ids = jnp.asarray(np.asarray(ids_host, np.int32))
         planes = {}
         with self._dispatch_scope():
             for name in ("k", "v", "k_scale", "v_scale"):
@@ -2197,10 +2438,13 @@ class ContinuousBatcher:
         holder lets go."""
         if self.pool is None:
             return
+        self._recycle_lo.pop(slot, None)
         ids = self._slot_pages.pop(slot, None)
         if not ids:
             return
-        freed = self.pool.decref(ids)
+        # recycled entries are 0 (already freed mid-flight): exactly the
+        # grown-minus-recycled remainder returns here, the PR-6 leak pin
+        freed = self.pool.decref([p for p in ids if p])
         if self.tracer.enabled:
             span = req.span if req is not None else None
             self.tracer.span(
@@ -2302,9 +2546,14 @@ class ContinuousBatcher:
         SNAPSHOT built from engine-owned state (the thread-ownership
         contract: /v1/health reads this cross-thread)."""
         tb = kv_token_bytes(self.cfg)
+        # attn_window only when windowed: at window=0 the surface stays
+        # BYTE-identical to the pre-feature server (the comparability
+        # pin in test_tp_serving — same rule as the tp/shards keys)
+        windowed = {"attn_window": self.window} if self.window else {}
         if self.pool is None:
             return self._kv_shard_view({
                 "layout": "dense",
+                **windowed,
                 "reserved_bytes": self.n_slots * self.max_len * tb,
             })
         # list() snapshots before iterating: /v1/health calls this from
@@ -2320,10 +2569,12 @@ class ContinuousBatcher:
         cap_tokens = self.pool.in_use * self.pool.page_size
         return self._kv_shard_view({
             "layout": "paged",
+            **windowed,
             "page_size": self.pool.page_size,
             "pages_total": self.pool.capacity,
             "pages_in_use": self.pool.in_use,
             "pages_free": self.pool.free_pages,
+            "pages_recycled_total": self._pages_recycled,
             "fragmentation_pct": (
                 100.0 * (1.0 - min(live, cap_tokens) / cap_tokens)
                 if cap_tokens else 0.0
@@ -2450,6 +2701,19 @@ class ContinuousBatcher:
         start = self._prefill_pos[slot]
         c = self.chunk
         plen = len(req.prompt)
+        if self.pool is not None:
+            # incremental reservation (windowed rows): back the pages
+            # this chunk writes — plus the decode span on the finish
+            # chunk — BEFORE dispatching. Pool pressure defers the
+            # CHUNK, never the request: it keeps its slot, its cursor,
+            # and every page grown so far, and retries next step (pages
+            # free as slots retire and as recycling runs). Fully
+            # reserved rows (window off, short prompts, KV installs)
+            # are already backed, so this is a no-op compare for them.
+            upto = (start + c if start + c < plen
+                    else plen + req.max_new - req.prefilled_out)
+            if not self._grow_slot_pages(slot, req, upto):
+                return
         if start + c < plen:  # intermediate chunk, all real tokens
             chunk = jnp.asarray(req.prompt[start:start + c], jnp.int32)
             chunk_span = None
@@ -2470,6 +2734,13 @@ class ContinuousBatcher:
                 now = time.perf_counter()
                 req.timeline.add_chunk(now, now - t_chunk)
             self._prefill_pos[slot] = start + c
+            # recycle behind the cursor — floored at the finish chunk's
+            # back-scheduled start (plen - c): its overlap window
+            # REWRITES those rows and its queries attend them, so pages
+            # under it must stay live until the finish chunk runs
+            self._recycle_slot_pages(
+                slot, min(start + c, max(plen - c, 0))
+            )
             self._count_prefill_tokens(c, "computed", req)
             if self.metrics:
                 self.metrics.on_prefill_chunk()
@@ -2504,6 +2775,10 @@ class ContinuousBatcher:
         self._on_first_token(req)
         self.running[slot] = req
         self._invalidate_slot_caches()
+        # decode queries start at plen: everything below plen - window
+        # is now dead (promotion below sees the recycled row and skips —
+        # the early rows a boundary would cache no longer exist)
+        self._recycle_slot_pages(slot, plen)
         self._maybe_promote_prefix(req)
         self._finish_if_done(req)
 
@@ -2546,6 +2821,11 @@ class ContinuousBatcher:
             # entry_factory wraps them in a PagedPrefixState). No device
             # work at all, vs one row-slice compile per boundary dense.
             slot_pages = self._slot_pages[req.slot]
+            if any(p == 0 for p in slot_pages):
+                # windowed prefill recycled out-of-window pages: the
+                # prompt's early rows are gone, so no boundary below the
+                # window is materializable — nothing cacheable here
+                return
 
             def extract(p: int):
                 # nothing between the incref and the return: a call in
@@ -3050,6 +3330,19 @@ class ContinuousBatcher:
                     self._mark_emitted_token(req, now, observe_it,
                                              exemplars)
                 self._finish_if_done(req)
+                if self._incremental_reserve:
+                    # sliding-window decode: pages falling out of the
+                    # window free as the row advances, so a windowed
+                    # row's steady-state footprint is O(window) not
+                    # O(length). Host free-list math only (one decref
+                    # per page_size tokens) — the hot path's zero-H2D
+                    # contract holds; a just-retired slot is a no-op
+                    # (its ledger entry is already gone).
+                    self._recycle_slot_pages(
+                        slot,
+                        len(req.prompt) + len(req.out)
+                        - req.prefilled_out - 1,
+                    )
         return n_emitted
 
     def _token_tracking(self):

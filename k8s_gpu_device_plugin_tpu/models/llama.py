@@ -237,6 +237,14 @@ class LlamaConfig:
     def is_moe(self) -> bool:
         return self.n_experts > 0
 
+    @property
+    def attn_window(self) -> int:
+        """Serving-facing alias of ``sliding_window`` — the name the
+        server flag (--attnWindow), /v1/health's ``kv.attn_window``,
+        and the long-context docs use. 0 = full causal attention (the
+        default; every serving graph identical to a window-less build)."""
+        return self.sliding_window
+
     def with_group_size(self, g: int) -> "LlamaConfig":
         return replace(self, moe_group_size=g)
 

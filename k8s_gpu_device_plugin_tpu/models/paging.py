@@ -60,6 +60,10 @@ class PagePool:
         #: bench's kv_hbm_saved_pct denominator needs the peak, not the
         #: instantaneous value)
         self.peak_in_use = 0  # owner: engine
+        #: pages returned through :meth:`recycle` — the out-of-window
+        #: reclamation path, counted separately from release-on-retire
+        #: decrefs (kv_stats' pages_recycled_total reads this)
+        self.recycled_total = 0  # owner: engine
 
     # --- capacity views ---
 
@@ -117,6 +121,19 @@ class PagePool:
                 freed.append(p)
             else:
                 self._refs[p] = r - 1
+        return freed
+
+    def recycle(self, pages) -> int:
+        """Return pages whose positions fell out of every live window
+        (sliding-window serving, models/batching.py). Semantically a
+        :meth:`decref` — a prefix-shared page just drops this row's
+        reference and stays live for its other holders — but tallied
+        separately: :attr:`recycled_total` counts pages actually freed
+        here, so observability can tell O(window) steady-state
+        reclamation apart from ordinary retire-time release. Returns
+        the number of pages freed."""
+        freed = len(self.decref(pages))
+        self.recycled_total += freed
         return freed
 
     # --- integrity ---

@@ -301,6 +301,12 @@ class SpeculativeBatcher(ContinuousBatcher):
         super().__init__(params, cfg, n_slots, max_len, **kw)
         if not self.chunk:
             raise ValueError("SpeculativeBatcher requires chunked_prefill")
+        # no incremental reservation / out-of-window recycling here: the
+        # verify round writes gamma rows PAST the accepted length (a
+        # recycled page could sit under a rejected draft's rewrite
+        # window) and the draft cache has no recycling plumbing — the
+        # speculative engine keeps the full worst-case reservation
+        self._incremental_reserve = False
         # the draft rides the SAME layout as the target (self.cfg is the
         # post-kwarg config): mismatched layouts would desynchronize the
         # two caches' write plumbing. Quantized drafts page fine — their

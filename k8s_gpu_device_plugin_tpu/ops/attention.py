@@ -265,6 +265,7 @@ def attention_backend_plan(
     cache_quant: str = "none",
     tp: int = 1,
     chunk: int = 0,
+    window: int = 0,
 ) -> dict:
     """The dispatcher's gates, evaluated STATICALLY per serving mode —
     {"decode"|"verify"|"prefill": {"backend": "pallas"|"xla",
@@ -337,8 +338,16 @@ def attention_backend_plan(
                     f"MAX_PREFILL_T={rpa.MAX_PREFILL_T}]: pick a chunk "
                     "divisible into kernel windows"}
         reason = "pallas ragged-paged kernel"
+        if window > 0:
+            # sliding-window attention is NOT a fork or a fallback: the
+            # same kernel body with its DMA'd KV span clamped to the
+            # trailing window (plan readers — /v1/health — see it here)
+            reason += f" (sliding window={window}: DMA span clamped)"
         if tp > 1:
             reason += f" (shard_map over the tp={tp} serving mesh)"
         return {"backend": "pallas", "reason": reason}
 
-    return {m: gate(m) for m in ("decode", "verify", "prefill")}
+    plan = {m: gate(m) for m in ("decode", "verify", "prefill")}
+    for d in plan.values():
+        d["window"] = int(window)
+    return plan

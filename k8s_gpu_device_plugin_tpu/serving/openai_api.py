@@ -589,8 +589,17 @@ class _OpenAIRoutes:
             SchedulerOverloadError,
         )
 
+        from k8s_gpu_device_plugin_tpu.models.batching import (
+            RequestTooLargeError,
+        )
+
         try:
             subs = self._submit(prompt, c)
+        except RequestTooLargeError as e:
+            # permanent refusal: the structured fields name the wall
+            # (same body shape as the native surface's 422)
+            return _oai_error(str(e), 422, code="request_too_large",
+                              extra=e.body())
         except ValueError as e:  # capacity/bucket/sampler validation
             return _oai_error(str(e), 422)
         except SchedulerOverloadError as e:  # queue full: 429 + Retry-After
@@ -866,19 +875,22 @@ def _oai_overloaded(message: str, reason: str,
     )
 
 
-def _oai_error(message: str, status: int, code: str | None = None) -> web.Response:
+def _oai_error(message: str, status: int, code: str | None = None,
+               extra: "dict | None" = None) -> web.Response:
     """OpenAI error envelope (clients pattern-match on error.message).
 
     ``error.type`` keys SDK retry logic: 5xx (engine dead — a restart may
     fix it) must read as retryable ``server_error``. Everything 4xx stays
     ``invalid_request_error``: the only 422 path here is permanent request
     validation (prompt exceeding slot capacity, bucket overflow, unknown
-    adapter), which a retry can never fix."""
+    adapter), which a retry can never fix. ``extra`` merges structured
+    fields into the error object (``request_too_large`` ships
+    ``prompt_tokens``/``max_new``/``limit`` so clients can resize)."""
     err_type = "server_error" if status >= 500 else "invalid_request_error"
-    return web.json_response(
-        {"error": {"message": message, "type": err_type, "code": code}},
-        status=status,
-    )
+    err: dict = {"message": message, "type": err_type, "code": code}
+    if extra:
+        err.update(extra)
+    return web.json_response({"error": err}, status=status)
 
 
 def add_openai_routes(server) -> None:

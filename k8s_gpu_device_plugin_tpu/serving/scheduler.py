@@ -675,7 +675,23 @@ class SloScheduler(Scheduler):
         ]
         if not victims:
             return None
-        slot = max(victims, key=lambda sr: len(sr[1].out))[0]
+        ledger = getattr(cb, "_slot_pages", None)
+        if (head.defer_counted and ledger
+                and getattr(cb, "window", 0) > 0):
+            # The head waits on PAGES, not a slot, and out-of-window
+            # recycling has broken the "longest decode = most KV"
+            # proxy: a windowed row's footprint plateaus at O(window)
+            # no matter how long it has run. Rank victims by the pages
+            # their eviction actually returns (live ledger entries;
+            # recycled slots are already 0), tie-broken toward the
+            # least wasted decode work.
+            def relief(sr):
+                ids = ledger.get(sr[0], ())
+                return (sum(1 for p in ids if p), -len(sr[1].out))
+
+            slot = max(victims, key=relief)[0]
+        else:
+            slot = max(victims, key=lambda sr: len(sr[1].out))[0]
         self._preempted_for[head.rid] = \
             self._preempted_for.get(head.rid, 0) + 1
         return slot

@@ -87,7 +87,10 @@ import time
 
 from aiohttp import web
 
-from k8s_gpu_device_plugin_tpu.models.batching import ContinuousBatcher
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    RequestTooLargeError,
+)
 from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig
 from k8s_gpu_device_plugin_tpu.models.sampling import Sampler
 from k8s_gpu_device_plugin_tpu.serving.faults import FaultError
@@ -137,6 +140,7 @@ class InferenceEngine:
         kv_layout: str | None = None,   # None = cfg.kv_layout
         kv_page_size: int | None = None,
         kv_pages: int = 0,
+        prefill_reserve_chunks: int = 2,  # windowed admission tranche
         scheduler=None,  # serving.scheduler.Scheduler (None = plain FIFO)
         default_priority: int = 1,
         default_deadline_ms: int = 0,
@@ -172,6 +176,12 @@ class InferenceEngine:
                 "pass the KV layout to the injected batcher's own "
                 "constructor; silently ignoring it here would serve the "
                 "dense layout while reporting paged flags"
+            )
+        if batcher is not None and prefill_reserve_chunks != 2:
+            raise ValueError(
+                "pass prefill_reserve_chunks to the injected batcher's "
+                "own constructor; silently ignoring it here would "
+                "reserve a different admission tranche than requested"
             )
         if batcher is not None and prompt_buckets is not None:
             raise ValueError(
@@ -246,7 +256,9 @@ class InferenceEngine:
                     pipeline_depth=pipeline_depth, trace_steps=trace_steps,
                     prefix_cache=prefix_cache,
                     kv_layout=kv_layout, kv_page_size=kv_page_size,
-                    kv_pages=kv_pages, scheduler=scheduler, tp=tp,
+                    kv_pages=kv_pages,
+                    prefill_reserve_chunks=prefill_reserve_chunks,
+                    scheduler=scheduler, tp=tp,
                     attribution=attribution, mfu=mfu, faults=faults,
                     devices=devices,
                 )
@@ -1327,6 +1339,15 @@ class InferenceServer:
                     resume_out=resume_out, resume_logp=resume_lp,
                     kv_pages=kv_pages,
                 ))
+        except RequestTooLargeError as e:
+            # permanent refusal — no deferral can admit this request:
+            # the structured body names the numbers the wall was
+            # computed from so the client can resize instead of retry
+            return web.json_response({"error": {
+                "message": str(e),
+                "code": "request_too_large",
+                **e.body(),
+            }}, status=422)
         except ValueError as e:  # capacity/bucket/sampler validation
             return web.json_response({"error": str(e)}, status=422)
         except SchedulerOverloadError as e:  # queue full: transient
@@ -1631,6 +1652,23 @@ def _main(argv: list[str] | None = None) -> int:
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--maxLen", type=int, default=2048)
     parser.add_argument("--chunkedPrefill", type=int, default=256)
+    parser.add_argument("--attnWindow", type=int, default=0,
+                        help="sliding-window attention span W (tokens): "
+                        "each query attends only the trailing (q-W, q] "
+                        "keys. 0 = full causal (the default; every "
+                        "serving graph identical to a window-less "
+                        "build). With --kvLayout paged and chunked "
+                        "prefill, long prompts admit through streaming "
+                        "chunk-prefill — pages reserve incrementally "
+                        "and out-of-window pages recycle, so a row's "
+                        "steady-state KV footprint is O(W), not "
+                        "O(length)")
+    parser.add_argument("--prefillReserveChunks", type=int, default=2,
+                        help="windowed admission tranche: prefill "
+                        "chunks' worth of pages reserved up front (the "
+                        "rest grow chunk by chunk as the prefill "
+                        "cursor advances); meaningful only with "
+                        "--attnWindow > 0 and --kvLayout paged")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel shards: weights (q/k/v/"
                         "gate/up/lm_head columns) and the KV cache "
@@ -1879,6 +1917,16 @@ def _main(argv: list[str] | None = None) -> int:
     from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import ServingMetrics
 
     cfg = getattr(LlamaConfig, args.preset)()
+    if args.attnWindow < 0:
+        raise SystemExit("--attnWindow must be >= 0 (0 = full causal)")
+    if args.prefillReserveChunks < 1:
+        raise SystemExit("--prefillReserveChunks must be >= 1: the "
+                         "tranche has to cover at least the chunk "
+                         "being prefilled")
+    if args.attnWindow:
+        from dataclasses import replace as _replace
+
+        cfg = _replace(cfg, sliding_window=args.attnWindow)
     if args.cacheQuant != "none":
         from dataclasses import replace as _replace
 
@@ -2130,6 +2178,9 @@ def _main(argv: list[str] | None = None) -> int:
             args.kvPageSize if args.kvLayout == "paged" else None
         ),
         kv_pages=0 if batcher is not None else args.kvPages,
+        prefill_reserve_chunks=(
+            2 if batcher is not None else args.prefillReserveChunks
+        ),
         scheduler=None if batcher is not None else scheduler,
         default_deadline_ms=args.defaultDeadlineMs,
         tp=None if batcher is not None else args.tp,

@@ -26,6 +26,15 @@ class Batcher:
         pages = jax.device_put(self._page_table_np, self._sharding)
         return self.step(pages)
 
+    def _prefill_grow_row(self, slot):  # graftlint: hot-path
+        # BAD: rebuilding + uploading the grown page-table row inside
+        # the prefill dispatch hot path — streaming chunk-prefill
+        # commits the grown row on the admission-style growth seam
+        # (_grow_slot_pages, one upload per chunk as the cursor
+        # advances), never per dispatch
+        row = jax.device_put(self._grown_row_np)
+        return self.step(row, slot)
+
     def _gather_adapters_step(self, sel):  # graftlint: hot-path
         # BAD: re-uploading the gathered (L, K, d_in, R) LoRA stacks
         # per decode step — the gathered multi-LoRA path commits the

@@ -15,6 +15,16 @@ class Batcher:
         # admission by _install_pages below): reading it is free
         return self.step(self._pages_cache)
 
+    def _prefill_grow_row(self, slot):  # graftlint: hot-path
+        # streaming chunk-prefill steady state: the grown table row is
+        # a cached device resident (committed by _grow_slot_pages
+        # below); the hot path does only host FREE-LIST MATH — window
+        # arithmetic for out-of-window recycling candidates — which
+        # never touches the device
+        dead = max(0, (self._pos - self._window + 1) // self._page_size)
+        self._recycle_lo = dead
+        return self.step(self._pages_cache, slot)
+
     def _decode_dispatch_gathered(self, sel):  # graftlint: hot-path
         # gathered multi-LoRA steady state: the compact stacks are
         # cached device residents (committed by _ensure_gathered below

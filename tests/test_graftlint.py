@@ -104,6 +104,17 @@ def test_hot_path_flags_transfer_and_carry():
         if v.symbol.endswith("_gather_adapters_step")
     ]
     assert {v.key for v in gather} == {"jax.device_put"}
+    # the chunk-growth reservation seam: uploading the grown page-table
+    # row inside the prefill dispatch hot path fires; the ok twin's
+    # host free-list math (window arithmetic, no device touch) and its
+    # admission-style _grow_slot_pages upload stay silent (covered by
+    # test_checker_silent_on_ok_fixture — the baseline stays EMPTY for
+    # this rule, pinned by test_checked_in_baseline_is_valid_and_justified)
+    grow = [
+        v for v in _run_on(bad, [_checker("hot-path-h2d")])
+        if v.symbol.endswith("_prefill_grow_row")
+    ]
+    assert {v.key for v in grow} == {"jax.device_put"}
 
 
 def test_thread_ownership_allows_atomic_len():

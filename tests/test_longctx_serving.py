@@ -1,0 +1,437 @@
+"""Long-context serving: streaming chunk-prefill + sliding-window KV.
+
+The contract pinned here (models/batching.py incremental reservation,
+models/paging.py recycle, serving/scheduler.py page-relief preemption,
+and the structured request_too_large surface on both HTTP planes):
+
+- **Admission past the old wall**: with ``sliding_window`` set, a
+  prompt whose FULL reservation outsizes the page pool admits through
+  the windowed peak bound, serves end-to-end bit-identical to the
+  dedicated-generate oracle, and its peak pool footprint stays
+  O(window + chunk) — not O(prompt).
+- **Recycling discipline**: out-of-window pages return to the pool
+  mid-stream (counted by ``pages_recycled_total``), retirement still
+  drains to exactly zero, and the refcount sweep stays clean — under
+  plain runs, injected pool.alloc chaos, and cancel-mid-growth.
+- **Structured refusals**: ``RequestTooLargeError`` carries
+  ``{prompt_tokens, max_new, limit}``, and both the native and the
+  OpenAI surface serialize those fields into the 422 body.
+"""
+
+import asyncio
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_device_plugin_tpu.models.batching import (
+    ContinuousBatcher,
+    RequestTooLargeError,
+)
+from k8s_gpu_device_plugin_tpu.models.generate import generate
+from k8s_gpu_device_plugin_tpu.models.llama import LlamaConfig, init_params
+from k8s_gpu_device_plugin_tpu.models.paging import PagePool
+from k8s_gpu_device_plugin_tpu.serving.faults import FaultPlane
+
+PS = 16       # page size
+W = 16        # sliding window
+BUCKETS = (8, 16, 32, 64, 128)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(n_layers=2, sliding_window=W)
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompt(key, n, cfg):
+    return jax.random.randint(
+        jax.random.key(key), (n,), 1, cfg.vocab_size, jnp.int32
+    ).tolist()
+
+
+def _oracle(params, prompt, cfg, max_new):
+    out = generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, max_new=max_new
+    )
+    return np.asarray(out)[0].tolist()
+
+
+def _batcher(params, cfg, kv_pages, n_slots=1, max_len=128, **kw):
+    return ContinuousBatcher(
+        params, cfg, n_slots=n_slots, max_len=max_len,
+        prompt_buckets=BUCKETS, chunked_prefill=8, kv_layout="paged",
+        kv_page_size=PS, kv_pages=kv_pages, **kw,
+    )
+
+
+class _Rec:
+    """metrics duck-type recording the long-context hooks."""
+
+    def __init__(self):
+        self.rejected = []
+        self.deferred = []
+        self.recycled = 0
+
+    def on_kv_admission_rejected(self, reason):
+        self.rejected.append(reason)
+
+    def on_prefill_chunk_deferred(self, reason):
+        self.deferred.append(reason)
+
+    def on_kv_pages_recycled(self, n):
+        self.recycled += n
+
+    def on_submit(self): ...
+    def on_prefill_chunk(self): ...
+    def on_first_token(self): ...
+    def on_step(self, *a): ...
+    def on_finish(self, reason): ...
+    def set_kv_pages(self, *a): ...
+    def set_kv_reserved_bytes(self, *a): ...
+
+
+# --- the host allocator's recycle seam --------------------------------------
+
+
+def test_pool_recycle_counts_only_true_frees():
+    pool = PagePool(8, 16)
+    a = pool.alloc(4)
+    pool.incref(a[:1])  # a prefix holds page a[0] too
+    assert pool.recycle(a[:2]) == 1       # a[0] survives its other holder
+    assert pool.recycled_total == 1
+    assert pool.recycle([a[0]]) == 1      # the prefix lets go
+    assert pool.recycled_total == 2
+    freed = pool.decref(a[2:])            # retire-time release: NOT recycle
+    assert freed == a[2:] and pool.recycled_total == 2
+    assert pool.in_use == 0
+    pool.check()
+
+
+# --- admission past the old request_too_large wall --------------------------
+
+
+def test_windowed_prompt_past_pool_wall_serves_o_window(setup):
+    """The acceptance pin: a prompt whose full reservation outsizes the
+    pool admits through the windowed peak bound, streams bit-identical
+    to the oracle, and peaks at O(window + chunk) pages."""
+    cfg, params = setup
+    rec = _Rec()
+    # 6 allocatable pages = 96 token rows; the request's full worst case
+    # is 120 rows = 8 pages -> refused without a window
+    cb = _batcher(params, cfg, kv_pages=6 + 1, metrics=rec)
+    assert cb._incremental_reserve is True
+    p = _prompt(300, 100, cfg)
+    rid = cb.submit(p, max_new=20)
+    results = cb.run(max_steps=400)
+    assert results[rid] == _oracle(params, p, cfg, 20)
+    # peak footprint: bounded by the admission formula, strictly under
+    # the full reservation the dense rule would have demanded
+    full = cb.pool.pages_for_tokens(120)
+    bound = cb.pool.pages_for_tokens(cb._windowed_peak_tokens(20))
+    assert cb.pool.peak_in_use <= bound < full
+    assert cb._pages_recycled > 0
+    assert cb.pool.recycled_total == cb._pages_recycled == rec.recycled
+    assert cb.pool.in_use == 0  # retirement drained what recycling left
+    cb.pool.check()
+    s = cb.kv_stats()
+    assert s["attn_window"] == W
+    assert s["pages_recycled_total"] == cb._pages_recycled
+    assert rec.rejected == []  # admitted first try: no pressure spell
+
+
+def test_full_causal_twin_is_refused_at_the_pool_wall(setup):
+    """The SAME pool without a window refuses the same request — with
+    the structured fields the HTTP surfaces serialize."""
+    _, _ = setup
+    cfg0 = LlamaConfig.tiny(n_layers=2)  # window 0: full causal
+    params0 = init_params(jax.random.key(0), cfg0)
+    rec = _Rec()
+    cb = _batcher(params0, cfg0, kv_pages=6 + 1, metrics=rec)
+    assert cb._incremental_reserve is False
+    with pytest.raises(RequestTooLargeError, match="KV pages") as ei:
+        cb.submit(_prompt(300, 100, cfg0), max_new=20)
+    assert ei.value.prompt_tokens == 100 and ei.value.max_new == 20
+    assert ei.value.limit == 6 * PS  # the pool in tokens
+    assert ei.value.body() == {
+        "prompt_tokens": 100, "max_new": 20, "limit": 96,
+    }
+    assert rec.rejected == ["request_too_large"]
+
+
+def test_slot_wall_reports_structured_fields(setup):
+    cfg, params = setup
+    cb = _batcher(params, cfg, kv_pages=12, max_len=64)
+    with pytest.raises(RequestTooLargeError, match="slot capacity") as ei:
+        cb.submit(_prompt(301, 50, cfg), max_new=30)
+    assert ei.value.body() == {
+        "prompt_tokens": 50, "max_new": 30, "limit": 64,
+    }
+
+
+def test_window_zero_and_dense_opt_out_of_incremental(setup):
+    """window=0 / dense / speculative rows keep today's full-reservation
+    path: the growth seam is a no-op compare for them (bit-identity with
+    main is the existing matrix tests' job — here we pin the flag)."""
+    cfg0 = LlamaConfig.tiny(n_layers=2)
+    params0 = init_params(jax.random.key(0), cfg0)
+    assert _batcher(params0, cfg0, kv_pages=12)._incremental_reserve \
+        is False
+    cfg, params = setup
+    dense = ContinuousBatcher(
+        params, cfg, n_slots=1, max_len=64, prompt_buckets=BUCKETS,
+        chunked_prefill=8,
+    )
+    assert dense._incremental_reserve is False
+    assert dense.kv_stats()["attn_window"] == W  # surfaced regardless
+
+
+# --- chaos: growth under injected pool pressure -----------------------------
+
+
+def test_pool_alloc_fault_mid_prompt_defers_chunk_not_request(setup):
+    """Injected pool.alloc failures during chunk growth defer the NEXT
+    chunk only: the request keeps its slot and pages, the deferral is
+    counted with reason=pool_pressure, and the stream completes
+    bit-identical to the no-fault run."""
+    cfg, params = setup
+    p = _prompt(310, 100, cfg)
+    baseline = _batcher(params, cfg, kv_pages=6 + 1)
+    rb = baseline.submit(p, max_new=20)
+    want = baseline.run(max_steps=400)[rb]
+    want_lp = list(baseline.done_requests[rb].out_logp)
+
+    rec = _Rec()
+    # hit 1 is the admission reservation; hits 2.. are growth calls
+    # (fired only when grow > 0) — nth=2:times=3 lands all three fires
+    # MID-PROMPT, deterministically
+    cb = _batcher(
+        params, cfg, kv_pages=6 + 1, metrics=rec,
+        faults=FaultPlane.from_spec("pool.alloc:nth=2:times=3"),
+    )
+    rid = cb.submit(p, max_new=20)
+    results = cb.run(max_steps=400)
+    assert results[rid] == want
+    assert list(cb.done_requests[rid].out_logp) == want_lp
+    assert cb._chunks_deferred == 3
+    assert rec.deferred == ["pool_pressure"] * 3
+    assert rec.rejected == []  # the REQUEST was never re-queued
+    assert cb.pool.in_use == 0
+    cb.pool.check()
+
+
+def test_cancel_mid_growth_returns_pool_to_baseline(setup):
+    """Cancel after the reservation has grown AND recycling has zeroed
+    early ledger entries: release must free exactly the live pages
+    (the PR-6 leak pattern, now with holes in the ledger)."""
+    cfg, params = setup
+    cb = _batcher(params, cfg, kv_pages=6 + 1)
+    rid = cb.submit(_prompt(311, 100, cfg), max_new=20)
+    for _ in range(8):  # mid-prefill: grown past the tranche, recycling
+        cb.step()
+    assert rid in {r.rid for r in cb.prefilling.values()}
+    assert cb.pool.in_use > 0
+    slot = next(s for s, r in cb.prefilling.items() if r.rid == rid)
+    assert cb._recycle_lo.get(slot, 0) > 0  # holes exist in the ledger
+    cb.cancel(rid)
+    cb.run(max_steps=50)
+    assert cb.pool.in_use == 0
+    cb.pool.check()
+
+
+# --- recycled rows refuse the seams that need the early prompt --------------
+
+
+def test_export_refused_after_recycle_prompts_reprefill(setup):
+    cfg, params = setup
+    cb = _batcher(params, cfg, kv_pages=8 + 1)
+    rid = cb.submit(_prompt(312, 40, cfg), max_new=30)
+    while rid not in {r.rid for r in cb.running.values()}:
+        cb.step()
+    with pytest.raises(ValueError, match="re-prefill"):
+        cb.export_kv_pages(rid)
+    cb.cancel(rid)
+    cb.run(max_steps=50)
+    cb.pool.check()
+
+
+def test_prefix_promotion_skips_recycled_rows_keeps_short_ones(setup):
+    from k8s_gpu_device_plugin_tpu.serving.prefix_cache import PrefixCache
+
+    cfg, params = setup
+    pc = PrefixCache(cfg, buckets=BUCKETS, budget_bytes=1 << 20)
+    cb = _batcher(params, cfg, kv_pages=8 + 1, prefix_cache=pc)
+    # long prompt: its first page is recycled by finish time — the
+    # promotion boundary rows no longer exist, so no entry may form
+    r_long = cb.submit(_prompt(313, 40, cfg), max_new=4)
+    cb.run(max_steps=200)
+    assert r_long in cb.done_requests
+    assert pc.stats.promotions == 0
+    # short prompt (inside the window): nothing recycled mid-prefill,
+    # promotion proceeds exactly as before
+    r_short = cb.submit(_prompt(314, 17, cfg), max_new=4)
+    cb.run(max_steps=200)
+    assert r_short in cb.done_requests
+    assert pc.stats.promotions > 0
+    cb.pool.check()
+
+
+# --- scheduler: page-relief preemption ranking ------------------------------
+
+
+def test_preempt_victim_ranked_by_page_relief_under_windowed_pool():
+    """With recycling live, out-length stops being a KV proxy: a pool-
+    pressured head must evict the victim holding the most pages, not
+    the longest decode. window=0 keeps the original ranking."""
+    from k8s_gpu_device_plugin_tpu.serving.scheduler import SloScheduler
+
+    def req(rid, priority, out_n, deadline=None, defer=False):
+        return types.SimpleNamespace(
+            rid=rid, tenant="t", priority=priority, max_new=20,
+            out=[0] * out_n, deadline=deadline, defer_counted=defer,
+        )
+
+    head = types.SimpleNamespace(
+        rid=9, tenant="t", priority=0, max_new=4, out=[],
+        deadline=0.0, defer_counted=True,
+    )
+    cb = types.SimpleNamespace(
+        pending=[head],
+        # slot 0: long decode, mostly recycled (2 live pages);
+        # slot 1: short decode, 6 live pages
+        running={0: req(1, 5, 10), 1: req(2, 5, 2)},
+        prefilling={}, n_slots=2, chunk=8, supports_preemption=True,
+        _slot_pages={0: [0, 0, 0, 7, 8], 1: [1, 2, 3, 4, 5, 6]},
+        window=W, metrics=None,
+    )
+    sched = SloScheduler(preempt=True)
+    assert sched._preempt_slot(cb, now=1.0, rejects=[]) == 1
+    cb.window = 0  # full causal: the original longest-decode ranking
+    sched2 = SloScheduler(preempt=True)
+    assert sched2._preempt_slot(cb, now=1.0, rejects=[]) == 0
+
+
+# --- observability ----------------------------------------------------------
+
+
+def test_serving_metrics_longctx_surface():
+    from prometheus_client import CollectorRegistry
+
+    from k8s_gpu_device_plugin_tpu.metrics.serving_metrics import (
+        ServingMetrics,
+    )
+
+    reg = CollectorRegistry()
+    m = ServingMetrics(registry=reg)
+    m.on_kv_pages_recycled(5)
+    m.on_kv_pages_recycled(2)
+    m.on_prefill_chunk_deferred("pool_pressure")
+    g = reg.get_sample_value
+    pre = "tpu_serving"
+    assert g(f"{pre}_kv_pages_recycled_total") == 7
+    assert g(f"{pre}_prefill_chunks_deferred_total",
+             {"reason": "pool_pressure"}) == 1
+    m.close()
+
+
+def test_attn_window_alias_and_health(setup):
+    from k8s_gpu_device_plugin_tpu.serving.server import InferenceEngine
+
+    cfg, params = setup
+    assert cfg.attn_window == cfg.sliding_window == W
+    assert LlamaConfig.tiny().attn_window == 0
+    engine = InferenceEngine(
+        params, cfg, n_slots=1, max_len=64, chunked_prefill=8,
+        kv_layout="paged", kv_page_size=PS, prefill_reserve_chunks=3,
+    )
+    try:
+        assert engine.cb.reserve_chunks == 3
+        kv = engine.stats()["kv"]
+        assert kv["attn_window"] == W
+        assert kv["pages_recycled_total"] == 0
+    finally:
+        engine.shutdown()
+    with pytest.raises(ValueError, match="prefill_reserve_chunks"):
+        InferenceEngine(
+            params, cfg,
+            batcher=ContinuousBatcher(
+                params, cfg, n_slots=1, max_len=64,
+                prompt_buckets=BUCKETS,
+            ),
+            prefill_reserve_chunks=3,
+        )
+
+
+# --- the structured 422 on both HTTP surfaces -------------------------------
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+async def _with_server(setup, body):
+    from k8s_gpu_device_plugin_tpu.serving.server import (
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    cfg, params = setup
+    engine = InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, chunked_prefill=8,
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    stop = asyncio.Event()
+    task = asyncio.create_task(server.run(stop))
+    for _ in range(100):
+        if server.bound_port:
+            break
+        await asyncio.sleep(0.05)
+    try:
+        import aiohttp
+
+        base = f"http://127.0.0.1:{server.bound_port}"
+        async with aiohttp.ClientSession() as session:
+            await body(session, base)
+    finally:
+        stop.set()
+        await asyncio.wait_for(task, 30)
+
+
+def test_native_422_carries_structured_fields(setup):
+    cfg, params = setup
+    p = _prompt(320, 50, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/generate", json={
+            "prompt": p, "max_new": 30,
+        }) as r:
+            assert r.status == 422
+            err = (await r.json())["error"]
+        assert err["code"] == "request_too_large"
+        assert err["prompt_tokens"] == 50
+        assert err["max_new"] == 30
+        assert err["limit"] == 64
+
+    _run(_with_server(setup, body))
+
+
+def test_openai_422_carries_structured_fields(setup):
+    cfg, params = setup
+    p = _prompt(321, 50, cfg)
+
+    async def body(session, base):
+        async with session.post(f"{base}/v1/completions", json={
+            "prompt": p, "max_tokens": 30,
+        }) as r:
+            assert r.status == 422
+            err = (await r.json())["error"]
+        assert err["type"] == "invalid_request_error"
+        assert err["code"] == "request_too_large"
+        assert err["prompt_tokens"] == 50
+        assert err["max_new"] == 30
+        assert err["limit"] == 64
+
+    _run(_with_server(setup, body))

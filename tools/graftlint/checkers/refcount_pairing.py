@@ -48,7 +48,9 @@ from tools.graftlint.core import (
 )
 
 RETAIN_METHODS = {"alloc", "incref"}
-RELEASE_METHODS = {"decref"}
+#: ``recycle`` is the out-of-window reclamation spelling of ``decref``
+#: (models/paging.py) — same release semantics, separate tally
+RELEASE_METHODS = {"decref", "recycle"}
 #: calls allowed between a retain and its ownership store (cannot
 #: meaningfully raise for the argument shapes used here)
 SAFE_CALLS = {
